@@ -1,0 +1,135 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+A ground-up rebuild of the capabilities of the reference framework
+(zmxdream/Paddle, a PaddlePaddle fork) designed for TPU hardware:
+jax/XLA is the compiler+executor, Pallas provides hand-tuned kernels,
+jax.sharding meshes provide the distributed fabric. The public API mirrors
+`paddle.*` so reference users can switch with minimal changes.
+"""
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# Paddle dtype semantics: int64 is the default integer type and float64
+# exists (ref: phi/common/data_type.h). Models still run fp32/bf16 — x64
+# only widens what the user explicitly asks for.
+_jax.config.update("jax_enable_x64", True)
+# fp32 math means fp32 (ref parity with cuBLAS): do not silently downcast
+# matmuls to bf16. Models opt into bf16/fp16 via dtype/AMP, which still hits
+# the MXU fast path.
+_jax.config.update("jax_default_matmul_precision", "highest")
+
+# framework fundamentals
+from .framework.dtype import (bool, uint8, int8, int16, int32, int64, float16,
+                              bfloat16, float32, float64, complex64, complex128,
+                              get_default_dtype, set_default_dtype)
+from .framework.place import (CPUPlace, TPUPlace, CUDAPlace, XPUPlace,
+                              set_device, get_device, is_compiled_with_tpu,
+                              is_compiled_with_cuda)
+from .framework.random import seed, get_rng_state, set_rng_state
+from .framework.io import save, load
+from .framework import in_dygraph_mode, in_dynamic_mode
+
+# tensor + autograd
+from .tensor.tensor import Tensor, to_tensor
+from .autograd.tape import no_grad, enable_grad, is_grad_enabled, set_grad_enabled
+from .autograd import grad
+from . import autograd
+
+# ops
+from .tensor.creation import (zeros, ones, full, zeros_like, ones_like,
+                              full_like, empty, empty_like, arange, linspace,
+                              logspace, eye, diag, diagflat, tril, triu,
+                              meshgrid, assign, clone, tril_indices,
+                              triu_indices, complex)
+from .tensor.math import (exp, expm1, log, log2, log10, log1p, sqrt, rsqrt,
+                          abs, ceil, floor, round, trunc, sin, cos, tan, asin,
+                          acos, atan, sinh, cosh, tanh, asinh, acosh, atanh,
+                          erf, erfinv, square, reciprocal, neg, sign, frac,
+                          digamma, lgamma, angle, conj, real, imag, logit,
+                          isnan, isinf, isfinite, nan_to_num, add, subtract,
+                          multiply, divide, floor_divide, mod, remainder,
+                          floor_mod, pow, maximum, minimum, fmax, fmin, atan2,
+                          hypot, logaddexp, heaviside, kron, inner, outer,
+                          scale, clip, stanh, lerp, addmm, sum, mean, max, min,
+                          prod, amax, amin, logsumexp, cumsum, cumprod, nansum,
+                          nanmean, count_nonzero, diff, trace, all, any,
+                          matmul, mm, bmm, dot, mv, multiplex, gcd, lcm)
+from .tensor.manipulation import (cast, reshape, reshape_, flatten, transpose,
+                                  moveaxis, swapaxes, squeeze, unsqueeze,
+                                  unsqueeze_, concat, stack, unstack, split,
+                                  chunk, tile, expand, expand_as, broadcast_to,
+                                  broadcast_tensors, flip, roll, rot90, slice,
+                                  strided_slice, gather, gather_nd,
+                                  take_along_axis, put_along_axis, scatter,
+                                  scatter_nd, scatter_nd_add, index_select,
+                                  index_sample, index_add, repeat_interleave,
+                                  masked_select, masked_fill, where, nonzero,
+                                  unique, unbind, crop, as_complex, as_real,
+                                  tensordot, atleast_1d, atleast_2d,
+                                  atleast_3d, view, numel, shard_index)
+from .tensor.linalg import (norm, dist, cross, matrix_power, inverse, pinv,
+                            det, slogdet, solve, triangular_solve, cholesky,
+                            cholesky_solve, qr, svd, eig, eigh, eigvals,
+                            eigvalsh, matrix_rank, bincount, histogram, t, mul)
+from .tensor.logic import (equal, not_equal, greater_than, greater_equal,
+                           less_than, less_equal, logical_and, logical_or,
+                           logical_xor, logical_not, bitwise_and, bitwise_or,
+                           bitwise_xor, bitwise_not, equal_all, allclose,
+                           isclose, is_tensor, is_empty)
+from .tensor.random import (uniform, rand, randn, normal, gaussian,
+                            standard_normal, randint, randint_like, randperm,
+                            multinomial, bernoulli, poisson)
+from .tensor.search import (argmax, argmin, argsort, sort, topk, searchsorted,
+                            bucketize, kthvalue, mode)
+from .tensor.stat import var, std, median, nanmedian, quantile, nanquantile
+from .tensor.einsum import einsum
+
+from . import linalg  # namespaced linalg
+from . import nn
+from . import optimizer
+from . import amp
+from . import io
+from . import metric
+from . import vision
+from . import distributed
+from . import jit
+from . import static
+from . import profiler
+from . import incubate
+from . import device
+from . import ops
+
+# paddle.Model (hapi)
+from .hapi.model import Model
+from . import hapi
+from . import callbacks
+
+# aliases the reference exposes at top level
+from .autograd import PyLayer
+
+disable_static = lambda *a, **k: None
+enable_static = lambda *a, **k: None
+
+
+def set_grad_enabled_ctx(mode):
+    return set_grad_enabled(mode)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    from .hapi.summary import summary as _summary
+    return _summary(net, input_size, dtypes, input)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    return 0
+
+
+def get_flags(flags):
+    from .framework import flags as _flags
+    return _flags.get_flags(flags)
+
+
+def set_flags(flags):
+    from .framework import flags as _flags
+    return _flags.set_flags(flags)

@@ -1,0 +1,197 @@
+"""AMP: auto_cast + GradScaler.
+
+ref: python/paddle/amp/auto_cast.py:296 amp_guard, :517 amp_decorate,
+:665 auto_cast; python/paddle/amp/grad_scaler.py:38 AmpScaler, :598 GradScaler.
+
+TPU-native policy: bf16 is the native half type (no loss scaling needed);
+fp16+dynamic loss scaling is kept for parity with the reference's
+fp16-centric AMP. O1 = per-op autocast by black/white list; O2 = decorate
+models to half outside the blacklist.
+"""
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from ..framework.dtype import convert_dtype
+
+# ref: python/paddle/fluid/contrib/mixed_precision/fp16_lists.py
+WHITE_LIST = {"matmul", "linear", "conv2d", "conv1d", "conv3d", "einsum",
+              "sdpa", "flash_attention", "mm", "bmm"}
+BLACK_LIST = {"exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+              "log_softmax", "cross_entropy", "layer_norm", "rms_norm",
+              "batch_norm", "norm", "logsumexp", "erfinv", "pow", "cumsum"}
+
+_state = threading.local()
+
+
+def _amp_state():
+    if not hasattr(_state, "enabled"):
+        _state.enabled = False
+        _state.dtype = jnp.float16
+        _state.level = "O1"
+        _state.white = set(WHITE_LIST)
+        _state.black = set(BLACK_LIST)
+    return _state
+
+
+def amp_state():
+    return _amp_state()
+
+
+def is_amp_enabled():
+    return _amp_state().enabled
+
+
+def amp_dtype():
+    return _amp_state().dtype
+
+
+def should_cast_op(name):
+    """Consulted by the op dispatch chokepoint (ops.apply callers)."""
+    s = _amp_state()
+    if not s.enabled:
+        return None
+    if name in s.white:
+        return s.dtype
+    if name in s.black:
+        return jnp.float32
+    return None
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="float16", use_promote=True):
+    """ref: amp/auto_cast.py:665."""
+    s = _amp_state()
+    prev = (s.enabled, s.dtype, s.level, s.white, s.black)
+    s.enabled = enable
+    s.dtype = convert_dtype(dtype)
+    s.level = level
+    s.white = set(WHITE_LIST) | set(custom_white_list or ())
+    s.black = (set(BLACK_LIST) | set(custom_black_list or ())) - set(
+        custom_white_list or ())
+    try:
+        yield
+    finally:
+        s.enabled, s.dtype, s.level, s.white, s.black = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="float16",
+             master_weight=None, save_dtype=None):
+    """ref: amp/auto_cast.py:517 amp_decorate. O2: cast model params to the
+    half dtype (keeping norms in fp32 via master weights in the optimizer)."""
+    if level == "O2":
+        targets = models if isinstance(models, (list, tuple)) else [models]
+        for m in targets:
+            m._to_dtype(convert_dtype(dtype))
+            m._casted_by_pure_fp16 = True
+        if optimizers is not None:
+            opts = optimizers if isinstance(optimizers, (list, tuple)) \
+                else [optimizers]
+            for o in opts:
+                o._multi_precision = True
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+amp_decorate = decorate
+
+
+class GradScaler:
+    """Dynamic loss scaling (ref: amp/grad_scaler.py:598 GradScaler; the
+    inf/nan check mirrors check_finite_and_unscale + update_loss_scaling)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.**15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        self._found_inf = False
+        inv = 1.0 / self._scale
+        for p in optimizer._params:
+            if p.grad is None:
+                continue
+            g = p.grad.data.astype(jnp.float32) * inv
+            finite = bool(jnp.all(jnp.isfinite(g)))
+            if not finite:
+                self._found_inf = True
+            p.grad = Tensor(g.astype(p.grad.data.dtype), stop_gradient=True)
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every_n_steps,
+                "decr_every_n_nan_or_inf": self._decr_every_n,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+
+AmpScaler = GradScaler

@@ -1,0 +1,109 @@
+"""paddle.autograd analog (ref: python/paddle/autograd/)."""
+import jax.numpy as jnp
+
+from .tape import (no_grad, enable_grad, is_grad_enabled, set_grad_enabled,
+                   run_backward, calc_gradient)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward (ref: python/paddle/autograd/backward_mode.py)."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None, name=None):
+    """paddle.grad (ref: python/paddle/fluid/dygraph/base.py grad)."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return calc_gradient(list(outputs), list(inputs), grad_outputs,
+                         retain_graph, create_graph, allow_unused)
+
+
+class PyLayerContext:
+    """ref: python/paddle/autograd/py_layer.py:29 PyLayerContext."""
+
+    def __init__(self):
+        self._saved = []
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return tuple(self._saved)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined autograd op (ref: python/paddle/autograd/py_layer.py:230).
+
+    Subclass with @staticmethod forward(ctx, *args) / backward(ctx, *grads).
+    """
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from . import tape
+        from ..tensor.tensor import Tensor
+
+        ctx = PyLayerContext()
+        with tape.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(outputs, (tuple, list))
+        flat_out = [outputs] if single else list(outputs)
+
+        in_tensors = [a if isinstance(a, Tensor) else None for a in args]
+        if tape.is_grad_enabled() and any(
+            t is not None and not t.stop_gradient for t in in_tensors
+        ):
+            tensor_out = [o for o in flat_out
+                          if isinstance(o, Tensor)
+                          and jnp.issubdtype(o.dtype, jnp.inexact)]
+
+            def vjp_fn(cotangents):
+                cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+                with tape.no_grad():
+                    grads = cls.backward(ctx, *[_wrap(c) for c in cts])
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                raw = []
+                gi = 0
+                for t in in_tensors:
+                    if t is None:
+                        raw.append(None)
+                    else:
+                        g = grads[gi] if gi < len(grads) else None
+                        gi += 1
+                        raw.append(None if g is None else g.data)
+                return raw
+
+            node = tape.record(
+                vjp_fn, in_tensors, len(tensor_out),
+                [o.data.shape for o in tensor_out],
+                [o.data.dtype for o in tensor_out],
+                name=cls.__name__,
+            )
+            idx = 0
+            for o in flat_out:
+                if isinstance(o, Tensor) and jnp.issubdtype(o.dtype, jnp.inexact):
+                    o.stop_gradient = False
+                    o._node = (node, idx)
+                    idx += 1
+        return outputs
+
+
+def _wrap(arr):
+    from ..tensor.tensor import Tensor
+    return Tensor(arr, stop_gradient=True)
+
+
+class LegacyPyLayer(PyLayer):
+    pass

@@ -1,0 +1,214 @@
+"""Eager autograd engine.
+
+TPU-native analog of the reference's eager autograd
+(ref: paddle/fluid/eager/grad_node_info.h:168 GradNodeBase,
+ paddle/fluid/eager/backward.cc:105 RunBackward).
+
+Design: instead of codegen'd per-op GradNodes, every recorded op captures a
+`jax.vjp` closure of its (pure, jax-traceable) compute function. `backward`
+walks nodes in reverse creation order — the tape is append-only, so creation
+order is a topological order of the DAG and its reverse is a valid reverse
+topological schedule (analog of the reference's in-degree queue,
+backward.cc:22 getInDegreeMap).
+"""
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_state = threading.local()
+
+
+def _tls():
+    if not hasattr(_state, "grad_enabled"):
+        _state.grad_enabled = True
+    return _state
+
+
+def is_grad_enabled():
+    return _tls().grad_enabled
+
+
+def set_grad_enabled(mode):
+    _tls().grad_enabled = bool(mode)
+
+
+class no_grad:
+    """Context manager + decorator disabling tape recording
+    (ref: python/paddle/fluid/dygraph/base.py no_grad_)."""
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+_node_counter = [0]
+
+
+class TapeNode:
+    """One recorded op (ref analog: GradNodeBase, grad_node_info.h:168).
+
+    vjp_fn maps output cotangents -> input cotangents. `inputs` are the
+    Tensor objects that fed the op (positions with stop_gradient=True get
+    their cotangent dropped). `out_grads[i]` accumulates the cotangent for
+    the i-th output until this node runs.
+    """
+
+    __slots__ = ("id", "vjp_fn", "inputs", "n_outputs", "out_grads", "out_shapes", "out_dtypes", "name")
+
+    def __init__(self, vjp_fn, inputs, n_outputs, out_shapes, out_dtypes, name=""):
+        _node_counter[0] += 1
+        self.id = _node_counter[0]
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs
+        self.n_outputs = n_outputs
+        self.out_grads = [None] * n_outputs
+        self.out_shapes = out_shapes
+        self.out_dtypes = out_dtypes
+        self.name = name
+
+    def ready_cotangents(self):
+        cts = []
+        for i in range(self.n_outputs):
+            g = self.out_grads[i]
+            if g is None:
+                g = jnp.zeros(self.out_shapes[i], self.out_dtypes[i])
+            cts.append(g)
+        return tuple(cts) if self.n_outputs > 1 else cts[0]
+
+
+def record(vjp_fn, inputs, n_outputs, out_shapes, out_dtypes, name=""):
+    return TapeNode(vjp_fn, inputs, n_outputs, out_shapes, out_dtypes, name)
+
+
+def _accumulate(existing, new):
+    if existing is None:
+        return new
+    return existing + new
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False):
+    """Engine entry (ref: fluid/eager/backward.cc:105 RunBackward).
+
+    tensors: output Tensors to seed. grad_tensors: matching cotangents or
+    None (ones for scalars).
+    """
+    from ..tensor.tensor import Tensor
+
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    # Seed.
+    pending = {}  # node id -> node
+    for t, g in zip(tensors, grad_tensors):
+        if t._node is None:
+            continue
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs"
+                )
+            g = jnp.ones(t.shape, t.dtype)
+        else:
+            g = g.data if isinstance(g, Tensor) else jnp.asarray(g)
+        node, idx = t._node
+        node.out_grads[idx] = _accumulate(node.out_grads[idx], g)
+        pending[node.id] = node
+
+    # Reverse-creation-order sweep.
+    while pending:
+        nid = max(pending)
+        node = pending.pop(nid)
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time; "
+                "set retain_graph=True if you need to."
+            )
+        in_cts = node.vjp_fn(node.ready_cotangents())
+        # Cotangents are consumed either way; retain_graph only preserves the
+        # vjp closure for a second pass (ref: RunBackward re-entry semantics).
+        node.out_grads = [None] * node.n_outputs
+        if not retain_graph:
+            node.vjp_fn = None
+        for inp, ct in zip(node.inputs, in_cts):
+            if inp is None or inp.stop_gradient or ct is None:
+                continue
+            for hook in inp._grad_hooks:
+                out = hook(_wrap_grad(ct))
+                if out is not None:
+                    ct = out.data if isinstance(out, Tensor) else jnp.asarray(out)
+            if inp._node is not None:
+                nxt, idx = inp._node
+                nxt.out_grads[idx] = _accumulate(nxt.out_grads[idx], ct)
+                pending[nxt.id] = nxt
+            else:
+                # Leaf accumulation (ref: fluid/eager/accumulation/).
+                if inp.grad is None:
+                    inp.grad = _wrap_grad(ct)
+                else:
+                    inp.grad = _wrap_grad(inp.grad.data + ct)
+
+
+def _wrap_grad(arr):
+    from ..tensor.tensor import Tensor
+
+    t = Tensor(arr, stop_gradient=True)
+    return t
+
+
+def calc_gradient(outputs, inputs, grad_outputs=None, retain_graph=None,
+                  create_graph=False, allow_unused=False):
+    """paddle.grad analog (ref: GeneralGrad, fluid/eager/backward.cc:103).
+
+    Runs the engine on a copy of the accumulation targets so `.grad` of
+    leaves is untouched; returns grads for `inputs`.
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported by the eager tape; use the "
+            "functional API (paddle_tpu.incubate.autograd / jax.grad) for "
+            "higher-order differentiation."
+        )
+    saved = [(t, t.grad) for t in inputs]
+    for t in inputs:
+        t.grad = None
+    try:
+        run_backward(
+            outputs if isinstance(outputs, (list, tuple)) else [outputs],
+            grad_outputs if isinstance(grad_outputs, (list, tuple)) or grad_outputs is None
+            else [grad_outputs],
+            retain_graph=bool(retain_graph),
+        )
+        results = []
+        for t in inputs:
+            if t.grad is None and not allow_unused:
+                results.append(_wrap_grad(jnp.zeros(t.shape, t.dtype)))
+            else:
+                results.append(t.grad)
+        return results
+    finally:
+        for t, g in saved:
+            t.grad = g

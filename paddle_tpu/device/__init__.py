@@ -1,0 +1,54 @@
+"""paddle.device analog (ref: python/paddle/device/__init__.py)."""
+import jax
+
+from ..framework.place import (set_device, get_device, is_compiled_with_tpu,
+                               is_compiled_with_cuda, CPUPlace, TPUPlace)
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def device_count():
+    return len([d for d in jax.devices() if d.platform != "cpu"]) or len(jax.devices())
+
+
+class cuda:
+    """Source-compat shim for paddle.device.cuda."""
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        # XLA dispatch is async; block on a trivial computation.
+        jax.block_until_ready(jax.numpy.zeros(()))
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        try:
+            d = jax.devices()[0]
+            return d.memory_stats().get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        try:
+            d = jax.devices()[0]
+            return d.memory_stats().get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+
+def synchronize(device=None):
+    cuda.synchronize(device)

@@ -1,0 +1,29 @@
+"""paddle.distributed analog (ref: python/paddle/distributed/).
+
+TPU-native design (SURVEY §2.4/§7): the communication fabric is a
+jax.sharding.Mesh; collective verbs lower to psum/all_gather/psum_scatter/
+all_to_all/ppermute inside pjit/shard_map-compiled step functions. The
+`CommunicateTopology`/`HybridCommunicateGroup` coordinate math is preserved
+verbatim from the reference so Fleet-style user code runs unchanged.
+"""
+from .parallel_env import (ParallelEnv, get_rank, get_world_size,
+                           init_parallel_env, is_initialized)
+from .collective import (new_group, get_group, Group, all_reduce, all_gather,
+                         reduce_scatter, broadcast, reduce,
+                         scatter, send, recv, barrier, ReduceOp, wait,
+                         split as collective_split, alltoall,
+                         alltoall as all_to_all)
+from .topology import CommunicateTopology, HybridCommunicateGroup
+from .mesh import (global_mesh, set_global_mesh, build_mesh, mesh_axis_size,
+                   in_spmd_region, current_axis_name)
+from .parallel import DataParallel
+from . import fleet
+from . import communication
+from . import sharding
+from .fleet import meta_parallel
+from . import utils
+from .spawn import spawn
+
+
+def get_backend():
+    return "xla"

@@ -1,0 +1,335 @@
+"""Collective communication.
+
+TPU-native ProcessGroup analog (ref: paddle/fluid/distributed/collective/
+process_group.h:53 + python/paddle/distributed/collective.py). Verbs lower to
+XLA collectives over mesh axes when called inside an SPMD (shard_map) region:
+  allreduce -> lax.psum/pmax/pmin, allgather -> lax.all_gather,
+  reduce_scatter -> lax.psum_scatter, alltoall -> lax.all_to_all,
+  p2p send/recv -> lax.ppermute.
+Outside an SPMD region (eager, single controller) a Group of size 1 is a
+no-op and cross-process eager collectives go through
+jax.experimental.multihost_utils where available.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor.tensor import Tensor
+from ..ops import apply
+from .mesh import in_spmd_region, global_mesh, mesh_axis_size
+from .parallel_env import get_rank, get_world_size
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """Communication group = (ranks, optional mesh axis name).
+
+    ref: python/paddle/distributed/collective.py Group. When the group spans
+    a whole mesh axis, collectives use that axis name inside SPMD programs.
+    """
+
+    _group_counter = [0]
+
+    def __init__(self, rank_in_group, id, ranks, axis_name=None, name=None):
+        self.rank = rank_in_group
+        self.id = id
+        self.ranks = ranks
+        self.axis_name = axis_name
+        self.name = name or f"group_{id}"
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return (f"Group(id={self.id}, nranks={self.nranks}, "
+                f"axis={self.axis_name}, ranks={self.ranks})")
+
+
+_groups = {}
+_world_group = [None]
+_next_gid = [0]
+
+
+def _ensure_world_group():
+    if _world_group[0] is None:
+        n = get_world_size()
+        g = Group(get_rank(), 0, list(range(n)), axis_name=None, name="world")
+        _world_group[0] = g
+        _groups[0] = g
+    return _world_group[0]
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    """ref: collective.py:185 new_group."""
+    _next_gid[0] += 1
+    gid = _next_gid[0]
+    my = get_rank()
+    ranks = sorted(ranks) if ranks else list(range(get_world_size()))
+    rig = ranks.index(my) if my in ranks else -1
+    g = Group(rig, gid, ranks, axis_name=axis_name)
+    _groups[gid] = g
+    return g
+
+
+def get_group(id=0):
+    return _groups.get(id)
+
+
+def _axis_of(group):
+    if group is None:
+        g = _ensure_world_group()
+        return g.axis_name
+    return group.axis_name
+
+
+def _group_size(group):
+    if group is None:
+        return _ensure_world_group().nranks
+    return group.nranks
+
+
+def is_available():
+    return True
+
+
+def _raw(t):
+    return t.data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """ref: communication/all_reduce.py. In-place on `tensor`."""
+    axis = _axis_of(group)
+    if in_spmd_region(axis) and axis is not None:
+        fns = {ReduceOp.SUM: lambda a: lax.psum(a, axis),
+               ReduceOp.MAX: lambda a: lax.pmax(a, axis),
+               ReduceOp.MIN: lambda a: lax.pmin(a, axis),
+               ReduceOp.AVG: lambda a: lax.pmean(a, axis),
+               ReduceOp.PROD: lambda a: jnp.exp(lax.psum(jnp.log(a), axis))}
+        out = apply(fns[op], tensor, name="c_allreduce")
+        tensor.data, tensor._node, tensor.stop_gradient = \
+            out.data, out._node, out.stop_gradient
+        return tensor
+    if _group_size(group) == 1:
+        return tensor
+    # Eager cross-process path (multi-controller): host-level allreduce.
+    from jax.experimental import multihost_utils
+    summed = multihost_utils.process_allgather(_raw(tensor))
+    red = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max, ReduceOp.MIN: jnp.min,
+           ReduceOp.AVG: jnp.mean, ReduceOp.PROD: jnp.prod}[op]
+    tensor.data = red(summed, axis=0).astype(tensor.data.dtype)
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    """ref: communication/all_gather.py — appends per-rank tensors to
+    tensor_list."""
+    g_axis = _axis_of(group)
+    n = _group_size(group)
+    if in_spmd_region(g_axis) and g_axis is not None:
+        gathered = apply(lambda a: lax.all_gather(a, g_axis, axis=0), tensor,
+                         name="c_allgather")
+        for i in range(mesh_axis_size(g_axis)):
+            tensor_list.append(gathered[i])
+        return tensor_list
+    if n == 1:
+        tensor_list.append(tensor)
+        return tensor_list
+    from jax.experimental import multihost_utils
+    stacked = multihost_utils.process_allgather(_raw(tensor))
+    for i in range(stacked.shape[0]):
+        tensor_list.append(Tensor(stacked[i]))
+    return tensor_list
+
+
+def all_gather_into_tensor(tensor, group=None, concat_axis=0):
+    """Functional variant: returns the concatenated result (SPMD path)."""
+    g_axis = _axis_of(group)
+    if in_spmd_region(g_axis) and g_axis is not None:
+        return apply(lambda a: lax.all_gather(a, g_axis, axis=concat_axis,
+                                              tiled=True),
+                     tensor, name="c_allgather")
+    return tensor
+
+
+def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """ref: communication/reduce_scatter.py — output written to `tensor`."""
+    g_axis = _axis_of(group)
+    if isinstance(tensor_list_or_input, (list, tuple)):
+        from ..tensor.manipulation import concat
+        inp = concat(list(tensor_list_or_input), axis=0)
+    else:
+        inp = tensor_list_or_input
+    if in_spmd_region(g_axis) and g_axis is not None:
+        out = apply(lambda a: lax.psum_scatter(a, g_axis, scatter_dimension=0,
+                                               tiled=True), inp,
+                    name="c_reducescatter")
+        tensor.data, tensor._node, tensor.stop_gradient = \
+            out.data, out._node, out.stop_gradient
+        return tensor
+    if _group_size(group) == 1:
+        tensor.data = _raw(inp)
+        return tensor
+    raise NotImplementedError("eager cross-process reduce_scatter")
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """ref: communication/all_to_all.py."""
+    g_axis = _axis_of(group)
+    from ..tensor.manipulation import stack, unstack
+    if in_spmd_region(g_axis) and g_axis is not None:
+        x = stack(list(in_tensor_list), axis=0)
+        out = apply(lambda a: lax.all_to_all(a, g_axis, split_axis=0,
+                                             concat_axis=0, tiled=False),
+                    x, name="alltoall")
+        out_tensor_list.extend(unstack(out, axis=0))
+        return out_tensor_list
+    if _group_size(group) == 1:
+        out_tensor_list.extend(in_tensor_list)
+        return out_tensor_list
+    raise NotImplementedError("eager cross-process alltoall")
+
+
+def all_to_all_single(output, input, out_split_sizes=None, in_split_sizes=None,
+                      group=None, sync_op=True):
+    g_axis = _axis_of(group)
+    if in_spmd_region(g_axis) and g_axis is not None:
+        out = apply(lambda a: lax.all_to_all(a, g_axis, split_axis=0,
+                                             concat_axis=0, tiled=True),
+                    input, name="alltoall_single")
+        output.data = out.data
+        output._node = out._node
+        output.stop_gradient = out.stop_gradient
+        return output
+    if _group_size(group) == 1:
+        output.data = _raw(input)
+        return output
+    raise NotImplementedError
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """ref: communication/broadcast.py. SPMD: all shards already see the
+    same program; select src's value via psum of masked value."""
+    g_axis = _axis_of(group)
+    if in_spmd_region(g_axis) and g_axis is not None:
+        src_in_group = group.get_group_rank(src) if group else src
+
+        def fn(a):
+            idx = lax.axis_index(g_axis)
+            masked = jnp.where(idx == src_in_group, a, jnp.zeros_like(a))
+            return lax.psum(masked, g_axis)
+
+        out = apply(fn, tensor, name="c_broadcast")
+        tensor.data, tensor._node, tensor.stop_gradient = \
+            out.data, out._node, out.stop_gradient
+        return tensor
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # In SPMD, reduce == allreduce (every shard computes it; dst is moot).
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g_axis = _axis_of(group)
+    if in_spmd_region(g_axis) and g_axis is not None and tensor_list:
+        from ..tensor.manipulation import stack
+        x = stack(list(tensor_list), axis=0)
+
+        def fn(a):
+            idx = lax.axis_index(g_axis)
+            return jnp.take(a, idx, axis=0)
+
+        out = apply(fn, x, name="c_scatter")
+        tensor.data, tensor._node = out.data, out._node
+        tensor.stop_gradient = out.stop_gradient
+        return tensor
+    if _group_size(group) == 1:
+        if tensor_list:
+            tensor.data = _raw(tensor_list[0])
+        return tensor
+    raise NotImplementedError
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """p2p send (ref: communication/send.py). SPMD: use p2p_push via
+    ppermute in the pipeline scheduler instead; eager is single-controller
+    so p2p is a device_put (see fleet/meta_parallel/pp_utils)."""
+    if _group_size(group) == 1:
+        return tensor
+    raise NotImplementedError(
+        "raw send/recv outside the pipeline scheduler: use "
+        "paddle_tpu.distributed.fleet.meta_parallel p2p helpers")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    if _group_size(group) == 1:
+        return tensor
+    raise NotImplementedError(
+        "raw send/recv outside the pipeline scheduler: use "
+        "paddle_tpu.distributed.fleet.meta_parallel p2p helpers")
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+def barrier(group=None):
+    """ref: communication/barrier. Blocks host until device work drains."""
+    jax.block_until_ready(jnp.zeros(()))
+    if get_world_size() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    jax.block_until_ready(_raw(tensor))
+
+
+def split(x, num_or_sections, axis=0):
+    from ..tensor.manipulation import split as _split
+    return _split(x, num_or_sections, axis)
+
+
+def ppermute(tensor, perm, axis_name):
+    """Collective permute over a mesh axis (the ICI p2p primitive)."""
+    return apply(lambda a: lax.ppermute(a, axis_name, perm), tensor,
+                 name="ppermute")
+
+
+# object collectives -------------------------------------------------------
+def all_gather_object(object_list, obj, group=None):
+    n = _group_size(group)
+    if n == 1:
+        object_list.append(obj)
+        return object_list
+    raise NotImplementedError
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list

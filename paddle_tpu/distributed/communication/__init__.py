@@ -1,0 +1,6 @@
+"""Public collectives namespace (ref: python/paddle/distributed/communication/)."""
+from ..collective import (all_reduce, all_gather, alltoall, reduce_scatter,
+                          broadcast, reduce, scatter, send, recv, barrier,
+                          ReduceOp, wait, all_to_all_single,
+                          all_gather_object, broadcast_object_list)
+from . import stream

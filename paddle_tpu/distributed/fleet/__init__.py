@@ -1,0 +1,15 @@
+"""Fleet umbrella API (ref: python/paddle/distributed/fleet/fleet.py:101).
+
+fleet.init builds the CommunicateTopology + HybridCommunicateGroup and — TPU
+addition — the global jax.sharding.Mesh whose axes mirror the topology, so
+every compiled step function can address ("data","pipe","sharding","model").
+"""
+from .distributed_strategy import DistributedStrategy
+from .fleet_base import (Fleet, init, get_hybrid_communicate_group,
+                         distributed_model, distributed_optimizer,
+                         worker_index, worker_num, is_first_worker,
+                         fleet_instance)
+from . import meta_parallel
+from .utils import hybrid_parallel_util
+from .recompute import recompute, recompute_sequential
+from .scaler import distributed_scaler

@@ -1,0 +1,148 @@
+"""Fleet singleton (ref: python/paddle/distributed/fleet/fleet.py:101 Fleet;
+init:169, _init_hybrid_parallel_env:385, distributed_optimizer:1044;
+wrapper selection ref: fleet/model.py:30,126-165).
+"""
+import numpy as np
+import jax
+
+from ..topology import CommunicateTopology, HybridCommunicateGroup
+from ..mesh import build_mesh, set_global_mesh, HYBRID_AXES
+from ..parallel_env import init_parallel_env, get_rank, get_world_size
+from .distributed_strategy import DistributedStrategy
+
+
+class Fleet:
+    def __init__(self):
+        self._is_initialized = False
+        self._hcg = None
+        self._topology = None
+        self._strategy = None
+        self._mesh = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        """ref: fleet.py:169 + _init_hybrid_parallel_env:385."""
+        self._strategy = strategy or DistributedStrategy()
+        init_parallel_env()
+        hc = self._strategy.hybrid_configs
+        dp, mp = int(hc["dp_degree"]), int(hc["mp_degree"])
+        pp, sh = int(hc["pp_degree"]), int(hc["sharding_degree"])
+        sep = int(hc.get("sep_degree", 1))
+        ndev = len(jax.devices())
+        degrees = {"data": dp, "pipe": pp, "sharding": sh, "model": mp}
+        specified = dp * mp * pp * sh * sep
+        if specified <= 1 < ndev and dp == 1:
+            # Default: everything data-parallel, reference behavior when no
+            # hybrid config given.
+            degrees["data"] = ndev if specified == 1 else dp
+        names = list(HYBRID_AXES)
+        dims = [degrees[n] for n in names]
+        if sep > 1:
+            names.append("sep")
+            dims.append(sep)
+        self._topology = CommunicateTopology(names, dims)
+        self._hcg = HybridCommunicateGroup(self._topology)
+        # The mesh: identical coordinate order so rank == device index.
+        mesh_axes = {n: d for n, d in zip(names, dims)}
+        if int(np.prod(dims)) <= ndev:
+            self._mesh = build_mesh(mesh_axes)
+            set_global_mesh(self._mesh)
+        self._is_initialized = True
+        return self
+
+    @property
+    def is_initialized(self):
+        return self._is_initialized
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+    def distributed_model(self, model):
+        """Wrapper selection (ref: fleet/model.py:126-165)."""
+        from .meta_parallel import (TensorParallel, PipelineParallel,
+                                    ShardingParallel)
+        from ..parallel import DataParallel
+        mode = self._hcg.get_parallel_mode()
+        strategy = self._strategy
+        if mode == "pipeline_parallel":
+            return PipelineParallel(model, self._hcg, strategy)
+        if mode == "tensor_parallel":
+            return TensorParallel(model, self._hcg, strategy=strategy)
+        if mode == "sharding_parallel":
+            return ShardingParallel(model, self._hcg, strategy=strategy)
+        return DataParallel(model, group=self._hcg.get_data_parallel_group())
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """ref: fleet.py:1044 -> HybridParallelOptimizer."""
+        from .meta_optimizers import HybridParallelOptimizer
+        if self._hcg is not None and self._hcg.get_parallel_mode() != \
+                "data_parallel":
+            return HybridParallelOptimizer(optimizer, self._hcg,
+                                           self._strategy)
+        return optimizer
+
+    # PS-era APIs kept for parity; collective-only build.
+    def init_server(self, *args, **kwargs):
+        raise NotImplementedError(
+            "parameter-server mode: not in the TPU build (collective only)")
+
+    def run_server(self):
+        raise NotImplementedError
+
+    def stop_worker(self):
+        pass
+
+    def save_persistables(self, executor=None, dirname=None, main_program=None,
+                          mode=0):
+        pass
+
+
+fleet_instance = Fleet()
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    return fleet_instance.init(role_maker, is_collective, strategy)
+
+
+def get_hybrid_communicate_group():
+    return fleet_instance.get_hybrid_communicate_group()
+
+
+def distributed_model(model):
+    return fleet_instance.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet_instance.distributed_optimizer(optimizer, strategy)
+
+
+def worker_index():
+    return fleet_instance.worker_index()
+
+
+def worker_num():
+    return fleet_instance.worker_num()
+
+
+def is_first_worker():
+    return fleet_instance.is_first_worker()

@@ -1,0 +1,16 @@
+"""Late-bound access to the fleet singleton (avoids import cycles)."""
+
+
+def hcg_or_none():
+    from .fleet_base import fleet_instance
+    return fleet_instance._hcg if fleet_instance._is_initialized else None
+
+
+def strategy_or_none():
+    from .fleet_base import fleet_instance
+    return fleet_instance._strategy if fleet_instance._is_initialized else None
+
+
+def mesh_or_none():
+    from .fleet_base import fleet_instance
+    return fleet_instance._mesh if fleet_instance._is_initialized else None

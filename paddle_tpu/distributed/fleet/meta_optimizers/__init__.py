@@ -1,0 +1,4 @@
+from .hybrid_parallel_optimizer import HybridParallelOptimizer, \
+    HybridParallelClipGrad
+from .dygraph_sharding_optimizer import DygraphShardingOptimizer
+from .hybrid_parallel_gradscaler import HybridParallelGradScaler

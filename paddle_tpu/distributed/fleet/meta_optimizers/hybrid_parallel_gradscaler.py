@@ -1,0 +1,13 @@
+"""HybridParallelGradScaler (ref: dygraph_optimizer/
+hybrid_parallel_gradscaler.py:24). Single-controller: the found_inf vote
+across the check group is a plain global isfinite check."""
+from ....amp import GradScaler
+
+
+class HybridParallelGradScaler(GradScaler):
+    def __init__(self, scaler=None, hcg=None, **kw):
+        if isinstance(scaler, GradScaler):
+            self.__dict__.update(scaler.__dict__)
+        else:
+            super().__init__(**kw)
+        self._hcg = hcg

@@ -1,0 +1,84 @@
+"""HybridParallelOptimizer (ref: python/paddle/distributed/fleet/
+meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:186, clip
+HybridParallelClipGrad:45).
+
+Single-controller note: parameters/grads are logical wholes, so the
+reference's cross-group norm allreduce (mp/pp/sharding) is already summed —
+plain global-norm clip IS the hybrid clip. Inside compiled SPMD regions the
+clip runs on sharded grads and shard_map inserts the psum.
+"""
+import jax.numpy as jnp
+
+from ....optimizer.clip import ClipGradByGlobalNorm
+from ....tensor.tensor import Tensor
+from ...mesh import in_spmd_region
+
+
+class HybridParallelClipGrad(ClipGradByGlobalNorm):
+    """ref: hybrid_parallel_optimizer.py:45 — sums grad-norm² across
+    mp/pp/sharding groups before the global clip."""
+
+    def __init__(self, clip, hcg):
+        super().__init__(clip.clip_norm if hasattr(clip, "clip_norm") else clip)
+        self._hcg = hcg
+
+    def __call__(self, params_grads):
+        total = self._global_norm_sq(params_grads)
+        if total is None:
+            return params_grads
+        # cross-axis reduction when running inside an SPMD region whose
+        # params are sharded (mp/sharding axes)
+        from jax import lax
+        for axis in ("model", "sharding", "pipe"):
+            if in_spmd_region(axis):
+                total = lax.psum(total, axis)
+        global_norm = jnp.sqrt(total)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g.data.astype(jnp.float32) * scale
+                                   ).astype(g.data.dtype), stop_gradient=True)))
+        return out
+
+
+class HybridParallelOptimizer:
+    """ref: hybrid_parallel_optimizer.py:186."""
+
+    def __init__(self, optimizer, hcg, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        if isinstance(optimizer._grad_clip, ClipGradByGlobalNorm):
+            optimizer._grad_clip = HybridParallelClipGrad(
+                optimizer._grad_clip, hcg)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def get_lr(self):
+        return self._inner_opt.get_lr()
+
+    def set_lr(self, lr):
+        self._inner_opt.set_lr(lr)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        self._inner_opt.set_state_dict(sd)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)

@@ -1,0 +1,18 @@
+"""meta_parallel namespace (ref: python/paddle/distributed/fleet/
+meta_parallel/__init__.py)."""
+from .parallel_layers.mp_layers import (VocabParallelEmbedding,
+                                        ColumnParallelLinear,
+                                        RowParallelLinear,
+                                        ParallelCrossEntropy)
+from .parallel_layers import mp_ops
+from .parallel_layers.random import (RNGStatesTracker, get_rng_state_tracker,
+                                     model_parallel_random_seed)
+from .parallel_layers.pp_layers import (LayerDesc, SharedLayerDesc,
+                                        SegmentLayers, PipelineLayer)
+from .pipeline_parallel import PipelineParallel, PipelineParallelWithInterleave
+from .tensor_parallel import TensorParallel
+from .sharding_parallel import ShardingParallel
+from .meta_parallel_base import MetaParallelBase
+from .sharding.group_sharded_stage2 import GroupShardedStage2
+from .sharding.group_sharded_stage3 import GroupShardedStage3
+from .sharding.group_sharded_optimizer_stage2 import GroupShardedOptimizerStage2

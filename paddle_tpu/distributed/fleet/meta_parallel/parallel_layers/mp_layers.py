@@ -1,0 +1,160 @@
+"""Megatron-style tensor-parallel layers.
+
+ref: python/paddle/distributed/fleet/layers/mpu/mp_layers.py —
+VocabParallelEmbedding:35, ColumnParallelLinear:173, RowParallelLinear:332,
+ParallelCrossEntropy:498.
+
+TPU-native parameter model (GSPMD style): every Parameter stores the FULL
+logical tensor plus a `dist_attr` naming the mesh axis each dim is sharded
+over. Step builders pass params into shard_map with those specs, so inside
+the compiled program this very same forward code sees the LOCAL shard —
+identical math to the reference's per-rank weights, but checkpoints stay
+whole and resharding is free.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .....nn.layer.layers import Layer
+from .....nn import functional as F
+from .....ops import apply
+from ....mesh import in_spmd_region, mesh_axis_size
+from . import mp_ops
+from .random import get_rng_state_tracker
+
+
+def _mp_group_and_size(mp_group):
+    if mp_group is not None:
+        return mp_group, mp_group.nranks
+    try:
+        from ...fleet_shim import hcg_or_none
+        hcg = hcg_or_none()
+    except Exception:
+        hcg = None
+    if hcg is not None:
+        return hcg.get_model_parallel_group(), \
+            hcg.get_model_parallel_world_size()
+    return None, max(1, mesh_axis_size("model"))
+
+
+class VocabParallelEmbedding(Layer):
+    """ref: mp_layers.py:35 — vocab dim sharded over 'model'."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.group, self.world_size = _mp_group_and_size(mp_group)
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        assert num_embeddings % self.world_size == 0
+        from .....nn import initializer as I
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            dtype=self._dtype, default_initializer=I.XavierNormal())
+        self.weight.is_distributed = self.world_size > 1
+        self.weight.dist_attr = ("model", None)
+
+    def forward(self, x):
+        ids = x.data if not isinstance(x, jnp.ndarray) else x
+
+        def fn(w):
+            if in_spmd_region("model"):
+                local_vocab = w.shape[0]
+                idx = lax.axis_index("model")
+                start = idx * local_vocab
+                local = ids - start
+                in_range = (local >= 0) & (local < local_vocab)
+                safe = jnp.clip(local, 0, local_vocab - 1)
+                out = jnp.take(w, safe, axis=0)
+                out = jnp.where(in_range[..., None], out, 0.0)
+                return lax.psum(out, "model")
+            return jnp.take(w, ids, axis=0)
+
+        return apply(fn, self.weight, name="vocab_parallel_embedding")
+
+
+class ColumnParallelLinear(Layer):
+    """ref: mp_layers.py:173 — weight [in, out] sharded on out ('model')."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.group, self.world_size = _mp_group_and_size(mp_group)
+        self._name = name
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        assert out_features % self.world_size == 0
+        self.output_size_per_partition = out_features // self.world_size
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            dtype=self._dtype)
+        self.weight.is_distributed = self.world_size > 1
+        self.weight.dist_attr = (None, "model")
+        has_bias = True if has_bias is None else has_bias
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=None, dtype=self._dtype,
+                is_bias=True)
+            self.bias.is_distributed = self.world_size > 1
+            self.bias.dist_attr = ("model",)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        inp = mp_ops._c_identity(x, group=self.group)
+        out = F.linear(inp, self.weight, self.bias)
+        if self.gather_output:
+            out = mp_ops._c_concat(out, group=self.group)
+        return out
+
+
+class RowParallelLinear(Layer):
+    """ref: mp_layers.py:332 — weight [in, out] sharded on in ('model')."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.group, self.world_size = _mp_group_and_size(mp_group)
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        assert in_features % self.world_size == 0
+        self.input_size_per_partition = in_features // self.world_size
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            dtype=self._dtype)
+        self.weight.is_distributed = self.world_size > 1
+        self.weight.dist_attr = ("model", None)
+        if has_bias:
+            # bias replicated; added after the allreduce
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=None, dtype=self._dtype,
+                is_bias=True)
+            self.bias.dist_attr = (None,)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            x = mp_ops._c_split(x, group=self.group)
+        out = F.linear(x, self.weight)
+        out = mp_ops._mp_allreduce(out, group=self.group)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class ParallelCrossEntropy(Layer):
+    """ref: mp_layers.py:498 — CE over vocab-sharded logits."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.group, self.world_size = _mp_group_and_size(mp_group)
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return mp_ops._c_softmax_with_cross_entropy(
+            input, label, group=self.group, ignore_index=self.ignore_index)

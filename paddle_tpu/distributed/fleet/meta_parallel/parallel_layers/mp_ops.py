@@ -1,0 +1,169 @@
+"""Tensor-parallel collective primitives.
+
+ref: python/paddle/distributed/fleet/layers/mpu/mp_ops.py —
+_c_identity:27 (fwd identity / bwd allreduce), _c_concat:91, _c_split:153,
+_mp_allreduce:219 (fwd allreduce / bwd identity),
+_c_softmax_with_cross_entropy:375, split:653.
+
+Each primitive is a jax.custom_vjp over the 'model' mesh axis, applied
+through the tape so eager autograd and compiled SPMD agree. Outside an SPMD
+region (mp degree 1) they are passthrough.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .....ops import apply
+from .....tensor.tensor import Tensor
+from ....mesh import in_spmd_region
+
+
+@functools.lru_cache(maxsize=None)
+def _identity_fn(axis):
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (lax.psum(g, axis),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _allreduce_fn(axis):
+    @jax.custom_vjp
+    def f(x):
+        return lax.psum(x, axis)
+
+    def fwd(x):
+        return lax.psum(x, axis), None
+
+    def bwd(_, g):
+        return (g,)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _c_identity(tensor, group=None, skip_c_identity_dynamic=False):
+    """fwd identity, bwd allreduce (column-parallel input)."""
+    axis = group.axis_name if group is not None else "model"
+    if not in_spmd_region(axis):
+        return tensor
+    return apply(_identity_fn(axis), tensor, name="c_identity")
+
+
+def _mp_allreduce(tensor, op=None, group=None, use_calc_stream=True,
+                  use_model_parallel=True):
+    """fwd allreduce, bwd identity (row-parallel output)."""
+    axis = group.axis_name if group is not None else "model"
+    if not in_spmd_region(axis):
+        return tensor
+    return apply(_allreduce_fn(axis), tensor, name="mp_allreduce")
+
+
+def _c_concat(tensor, group=None):
+    """all_gather along last dim (ref: mp_ops.py:91)."""
+    axis = group.axis_name if group is not None else "model"
+    if not in_spmd_region(axis):
+        return tensor
+    return apply(lambda a: lax.all_gather(a, axis, axis=a.ndim - 1, tiled=True),
+                 tensor, name="c_concat")
+
+
+def _c_split(tensor, group=None):
+    """keep local slice of last dim (ref: mp_ops.py:153)."""
+    axis = group.axis_name if group is not None else "model"
+    if not in_spmd_region(axis):
+        return tensor
+
+    def fn(a):
+        n = lax.axis_size(axis)
+        idx = lax.axis_index(axis)
+        sz = a.shape[-1] // n
+        return lax.dynamic_slice_in_dim(a, idx * sz, sz, axis=a.ndim - 1)
+
+    return apply(fn, tensor, name="c_split")
+
+
+def _c_softmax_with_cross_entropy(logits, label, group=None,
+                                  return_softmax=False,
+                                  ignore_index=-100):
+    """Vocab-parallel softmax CE (ref: mp_ops.py:375 + C++
+    c_softmax_with_cross_entropy_op). logits sharded on last (vocab) dim."""
+    axis = group.axis_name if group is not None else "model"
+    lab = label.data if isinstance(label, Tensor) else jnp.asarray(label)
+
+    if not in_spmd_region(axis):
+        from .....nn.functional.loss import cross_entropy
+        loss = cross_entropy(logits, label, reduction="none",
+                             ignore_index=ignore_index)
+        if loss.ndim < logits.ndim:
+            from .....tensor.manipulation import unsqueeze
+            loss = unsqueeze(loss, -1)
+        if return_softmax:
+            from .....nn.functional import softmax
+            return loss, softmax(logits)
+        return loss
+
+    def fn(lg):
+        n = lax.axis_size(axis)
+        idx = lax.axis_index(axis)
+        vocab_local = lg.shape[-1]
+        # global max for stability
+        local_max = jnp.max(lg, axis=-1, keepdims=True)
+        gmax = lax.pmax(local_max, axis)
+        shifted = lg - gmax
+        exp = jnp.exp(shifted)
+        local_sum = jnp.sum(exp, axis=-1, keepdims=True)
+        gsum = lax.psum(local_sum, axis)
+        # pick the target logit if it lives in this shard
+        lab_ = lab
+        if lab_.ndim == lg.ndim:
+            lab_ = jnp.squeeze(lab_, -1)
+        local_lab = lab_ - idx * vocab_local
+        in_range = (local_lab >= 0) & (local_lab < vocab_local)
+        safe = jnp.clip(local_lab, 0, vocab_local - 1).astype(jnp.int32)
+        picked = jnp.take_along_axis(shifted, safe[..., None], axis=-1)
+        picked = jnp.where(in_range[..., None], picked, 0.0)
+        picked = lax.psum(picked, axis)
+        loss = jnp.log(gsum) - picked
+        sm = exp / gsum
+        return loss, sm
+
+    loss, sm = apply(fn, logits, n_outputs=2, name="c_softmax_ce")
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Tensor-split helper API (ref: mp_ops.py:653). Builds the matching
+    parallel layer."""
+    from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                            VocabParallelEmbedding)
+    if operation == "linear":
+        if axis == 0:
+            layer = RowParallelLinear(size[0], size[1],
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      input_is_parallel=False)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unsupported split operation {operation}")

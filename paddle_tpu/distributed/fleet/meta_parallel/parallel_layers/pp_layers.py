@@ -1,0 +1,202 @@
+"""Pipeline layer description + segmentation.
+
+ref: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+pp_layers.py — LayerDesc:57, SharedLayerDesc:77, SegmentLayers:93,
+PipelineLayer:209 (+ interleave segmentation :519, tied-weight allreduce
+:498).
+
+Single-controller note: tied weights (SharedLayerDesc) are literally the
+same Parameter object across stages, so the reference's shared-weight grad
+allreduce is implicit — the tape accumulates into one grad buffer.
+"""
+import re
+
+import numpy as np
+
+from .....nn.layer.layers import Layer
+from .....nn.layer.container import LayerList
+
+
+class LayerDesc:
+    """ref: pp_layers.py:57."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """ref: pp_layers.py:77 — layers sharing weights across stages (tied
+    embeddings)."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """ref: pp_layers.py:93 — uniform or 'layer:Class' regex segmentation."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform",
+                 num_virtual_pipeline_stage=None):
+        self._layers_desc = layers_desc
+        self.method = method
+        self.num_parts = num_parts
+        self.num_items = len(layers_desc)
+        assert self.num_items >= self.num_parts
+
+    def do_segment(self):
+        if self.method == "uniform":
+            return self.uniform(self.num_items, self.num_parts)
+        if self.method.startswith("layer:"):
+            cls_name = self.method.split(":")[1]
+            weights = [0] * len(self._layers_desc)
+            for idx, d in enumerate(self._layers_desc):
+                layer_func = d.layer_func if isinstance(d, LayerDesc) else type(d)
+                name = getattr(layer_func, "__name__", str(layer_func))
+                if re.search(cls_name, name):
+                    weights[idx] = 1
+            actual = sum(weights)
+            assert actual >= self.num_parts, (
+                f"only {actual} '{cls_name}' layers for {self.num_parts} parts")
+            # balance the weighted layers across parts, keeping non-weighted
+            # prefix/suffix attached (reference behavior)
+            part_size = actual / self.num_parts
+            result = [0] * (self.num_parts + 1)
+            memory = 0.0
+            part = 1
+            for idx, w in enumerate(weights):
+                memory += w
+                if part < self.num_parts and memory >= part * part_size and w:
+                    result[part] = idx
+                    part += 1
+            result[self.num_parts] = len(weights)
+            return result
+        raise ValueError(f"unknown seg method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts):
+        result = [0] * (num_parts + 1)
+        part_size = num_items // num_parts
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + (1 if i <= extra else 0)
+        return result
+
+
+class PipelineLayer(Layer):
+    """ref: pp_layers.py:209. Builds ALL layers (single controller owns the
+    whole logical model) and records the stage segmentation; the scheduler
+    runs stage sub-chains."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._recompute_interval = recompute_interval
+        self._num_virtual_pipeline_stages = num_virtual_pipeline_stages or 1
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self._num_stages = num_stages or 1
+        self._layers_desc = list(layers)
+
+        self._shared_layers = {}  # key -> Layer (first built instance)
+        self.run_function = LayerList()
+        self._build_all()
+        seg = SegmentLayers(self._layers_desc,
+                            self._num_stages * self._num_virtual_pipeline_stages,
+                            seg_method)
+        self.segment_parts = seg.do_segment()
+
+    def _build_all(self):
+        for desc in self._layers_desc:
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name in self._shared_layers:
+                    base = self._shared_layers[desc.layer_name]
+                    layer = _SharedForward(base, desc.forward_func)
+                else:
+                    layer = desc.build_layer()
+                    self._shared_layers[desc.layer_name] = layer
+                self.run_function.append(layer)
+            elif isinstance(desc, LayerDesc):
+                self.run_function.append(desc.build_layer())
+            elif isinstance(desc, Layer):
+                self.run_function.append(desc)
+            elif callable(desc):
+                self.run_function.append(_FuncLayer(desc))
+            else:
+                raise TypeError(f"bad pipeline layer desc: {desc!r}")
+
+    @property
+    def parts(self):
+        return self.segment_parts
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def stage_chunks(self, stage_id):
+        """List of layer-chunks for this stage (len>1 under interleave)."""
+        chunks = []
+        v = self._num_virtual_pipeline_stages
+        for chunk in range(v):
+            part = chunk * self._num_stages + stage_id
+            lo, hi = self.segment_parts[part], self.segment_parts[part + 1]
+            chunks.append([self.run_function[i] for i in range(lo, hi)])
+        return chunks
+
+    def forward_segment(self, x, lo, hi):
+        for i in range(lo, hi):
+            layer = self.run_function[i]
+            if self._recompute_interval > 0 and (i - lo) % \
+                    self._recompute_interval == 0 and self.training:
+                from ...recompute import recompute
+                x = recompute(layer, x) if not isinstance(x, tuple) \
+                    else recompute(layer, *x)
+            else:
+                x = layer(x) if not isinstance(x, tuple) else layer(*x)
+        return x
+
+    def forward(self, input):
+        """Whole-model forward (non-pp execution / debugging)."""
+        return self.forward_segment(input, 0, len(self.run_function))
+
+    def get_shared_layer(self, key):
+        return self._shared_layers[key]
+
+
+class _FuncLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args):
+        return self._fn(*args)
+
+
+class _SharedForward(Layer):
+    """Second occurrence of a SharedLayerDesc: shares the base layer's
+    parameters, optionally with a custom forward."""
+
+    def __init__(self, base, forward_func):
+        super().__init__()
+        self._base = base  # registered as sublayer => shared params visible
+        self._forward_func = forward_func
+
+    def forward(self, *args):
+        if self._forward_func is not None:
+            return self._forward_func(self._base, *args)
+        return self._base(*args)

@@ -1,0 +1,96 @@
+"""Deterministic per-rank RNG for tensor parallelism.
+
+ref: python/paddle/distributed/fleet/layers/mpu/random.py —
+RNGStatesTracker:35, model_parallel_random_seed:89. Semantics preserved:
+'global' seed state gives identical draws on all mp ranks (dropout on
+replicated activations), 'local_seed' (folded with mp rank) gives distinct
+draws (dropout on sharded activations).
+
+TPU-native: stateless threefry — a tracker state is a key; rank-distinct
+keys are fold_in(key, axis_index("model")), which stays correct inside
+compiled SPMD programs.
+"""
+import contextlib
+
+import jax
+
+from .....framework import random as frnd
+from ....mesh import in_spmd_region
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    """ref: mpu/random.py:35."""
+
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = jax.random.key(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = states
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        key = self.states_[name]
+        if in_spmd_region("model"):
+            key = jax.random.fold_in(key, jax.lax.axis_index("model"))
+        new_key, use_key = jax.random.split(key)
+        if not in_spmd_region("model"):
+            self.states_[name] = new_key
+        with frnd.key_scope(use_key):
+            yield
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    """ref: mpu/random.py:89 — global seed identical across mp ranks; local
+    seed distinct (derived by rank fold-in at draw time)."""
+    import random as pyrandom
+    if seed is None:
+        seed = pyrandom.randint(0, 2 ** 31 - 1)
+    global_seed = seed
+    local_seed = seed + 1024
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    tracker.add("global_seed", global_seed)
+    tracker.add("local_seed", local_seed)
+    frnd.seed(global_seed)
+
+
+def determinate_seed(rng_name):
+    return 0
+
+
+def dropout(x, p=0.5, axis=None, rng_name=MODEL_PARALLEL_RNG, training=True,
+            mode="upscale_in_train", name=None):
+    """mp-aware dropout (ref: mpu/random.py dropout)."""
+    from .....nn import functional as F
+    tracker = get_rng_state_tracker()
+    if rng_name in tracker.states_:
+        with tracker.rng_state(rng_name):
+            return F.dropout(x, p, axis, training, mode)
+    return F.dropout(x, p, axis, training, mode)

@@ -1,0 +1,226 @@
+"""1F1B pipeline schedule.
+
+ref: python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py —
+PipelineParallel:31, forward_backward_pipeline:117 (startup/steady/cooldown),
+train_batch:228, _forward_step:292, _backward_step:326,
+_broadcast_final_loss:409; interleave variant :461.
+
+TPU-native execution model: a single controller drives every stage, so the
+"p2p send/recv" between stages is handing the (detached) activation to the
+next stage's queue — XLA async dispatch overlaps stage programs that live on
+disjoint devices. The 1F1B ordering, micro-batching, boundary-detach
+autograd, and loss averaging reproduce the reference exactly, including
+SendRecvMeta-free shape agility (shapes are known host-side).
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+from ....tensor.tensor import Tensor
+from ....autograd import tape
+from .meta_parallel_base import MetaParallelBase
+from .parallel_layers.pp_layers import PipelineLayer
+
+
+class PipelineParallel(MetaParallelBase):
+    def __init__(self, layers, hcg, strategy):
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        super().__init__(layers, hcg, strategy)
+        self.num_stages = layers.get_num_stages()
+        conf = (strategy.pipeline_configs if strategy is not None
+                else {"accumulate_steps": 1, "micro_batch_size": 1})
+        self.accumulate_steps = int(conf.get("accumulate_steps", 1))
+        self.micro_batch_size = int(conf.get("micro_batch_size", 1))
+        self._loss_fn = layers._loss_fn
+        self.total_loss = None
+        self.scaler = None
+
+    # -- data plumbing ------------------------------------------------------
+    def _load_micro_batch(self, batch, micro_step):
+        """ref: pipeline_parallel.py:398 — slice micro-batch micro_step."""
+        inputs, labels = batch
+        b = self.micro_batch_size
+        lo, hi = micro_step * b, (micro_step + 1) * b
+
+        def sl(x):
+            if isinstance(x, (list, tuple)):
+                return type(x)(sl(v) for v in x)
+            if isinstance(x, Tensor):
+                return x[lo:hi]
+            return x
+
+        return sl(inputs), sl(labels)
+
+    # -- fw/bw steps --------------------------------------------------------
+    def _forward_step_stage(self, stage, x, buffers):
+        """Run one stage chunk; detach at the boundary (the p2p point)."""
+        lo = self._layers.segment_parts[stage]
+        hi = self._layers.segment_parts[stage + 1]
+        if isinstance(x, tuple):
+            xin = tuple(t.detach() for t in x)
+            for t, orig in zip(xin, x):
+                t.stop_gradient = orig.stop_gradient
+            if stage > 0:
+                for t in xin:
+                    t.stop_gradient = False
+        else:
+            xin = x.detach()
+            xin.stop_gradient = x.stop_gradient if stage == 0 else False
+        out = self._layers.forward_segment(xin, lo, hi)
+        buffers.append((xin, out))
+        return out
+
+    def _backward_step_stage(self, buffers, out_grad):
+        """Backward through one saved stage boundary; return input grad
+        (ref: _backward_step:326 — paddle.autograd.backward on the chunk)."""
+        xin, out = buffers.pop()
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        grads = out_grad if isinstance(out_grad, (list, tuple)) else [out_grad]
+        tape.run_backward([o for o in outs if not o.stop_gradient],
+                          [g for o, g in zip(outs, grads)
+                           if not o.stop_gradient])
+        xins = xin if isinstance(xin, tuple) else (xin,)
+        in_grads = tuple(t.grad for t in xins)
+        for t in xins:
+            t.grad = None
+        return in_grads if len(in_grads) > 1 else in_grads[0]
+
+    # -- the schedule -------------------------------------------------------
+    def forward_backward_pipeline(self, data, scaler=None):
+        """1F1B over micro-batches (ref: :117). All stages driven by this
+        controller in 1F1B order; grads accumulate across micro-batches."""
+        self.scaler = scaler
+        acc = self.accumulate_steps
+        losses = []
+        # Per-stage saved boundary buffers.
+        stage_buffers = [[] for _ in range(self.num_stages)]
+        # Queues of activations flowing downstream per microbatch.
+        micro_outputs = {}
+
+        num_warmup = min(self.num_stages, acc)
+
+        def run_forward(micro):
+            x, label = self._load_micro_batch(data, micro)
+            act = x
+            for s in range(self.num_stages):
+                act = self._forward_step_stage(s, act, stage_buffers[s])
+            loss = self._compute_loss(act, label)
+            losses.append(loss)
+            micro_outputs[micro] = loss
+            return loss
+
+        def run_backward(micro):
+            loss = micro_outputs.pop(micro)
+            scaled = loss * (1.0 / acc)
+            if self.scaler is not None:
+                scaled = self.scaler.scale(scaled)
+            grad = jnp.ones(scaled.shape, scaled.dtype)
+            # chain backward from loss through every stage, last→first
+            g = None
+            # stage N-1 backward includes the loss node
+            tape.run_backward([scaled], [None] if scaled.size == 1 else [Tensor(grad)])
+            # boundary grads now sit on each stage's saved inputs; propagate
+            # FIFO: backward order follows forward order in 1F1B.
+            for s in range(self.num_stages - 1, 0, -1):
+                xin, out = stage_buffers[s].pop(0)
+                xins = xin if isinstance(xin, tuple) else (xin,)
+                gs = tuple(t.grad for t in xins)
+                for t in xins:
+                    t.grad = None
+                prev_out = stage_buffers[s - 1][0][1]
+                prev_outs = prev_out if isinstance(prev_out, (list, tuple)) \
+                    else [prev_out]
+                tape.run_backward(
+                    [o for o in prev_outs if not o.stop_gradient],
+                    [g for o, g in zip(prev_outs, gs)
+                     if not o.stop_gradient])
+            stage_buffers[0].pop(0)
+
+        # 1F1B: warmup forwards, steady 1F1B, cooldown backwards.
+        fwd_i = 0
+        bwd_i = 0
+        for _ in range(num_warmup):
+            run_forward(fwd_i)
+            fwd_i += 1
+        while fwd_i < acc:
+            run_backward(bwd_i)
+            bwd_i += 1
+            run_forward(fwd_i)
+            fwd_i += 1
+        while bwd_i < acc:
+            run_backward(bwd_i)
+            bwd_i += 1
+
+        with tape.no_grad():
+            total = losses[0]
+            for l in losses[1:]:
+                total = total + l
+            total = total * (1.0 / acc)
+        self.total_loss = total
+        return total.detach()
+
+    def _compute_loss(self, output, label):
+        if self._loss_fn is not None:
+            loss = self._loss_fn(output, label)
+        else:
+            loss = output
+        if isinstance(loss, (list, tuple)):
+            loss = loss[0]
+        return loss
+
+    def _broadcast_final_loss(self):
+        # ref: :409 — single controller already holds the loss.
+        return self.total_loss
+
+    # -- public API ---------------------------------------------------------
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """ref: train_batch:228."""
+        self._layers.train()
+        self.training = True
+        loss = self.forward_backward_pipeline(data, scaler)
+        self._optimizer_step(optimizer, lr_scheduler, scaler)
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        self._layers.eval()
+        losses = []
+        with tape.no_grad():
+            for micro in range(self.accumulate_steps):
+                x, label = self._load_micro_batch(data, micro)
+                out = self._layers.forward(x)
+                losses.append(self._compute_loss(out, label) if compute_loss
+                              else out)
+        if not compute_loss:
+            return losses
+        with tape.no_grad():
+            total = losses[0]
+            for l in losses[1:]:
+                total = total + l
+            return total * (1.0 / self.accumulate_steps)
+
+    def _optimizer_step(self, optimizer, lr_scheduler, scaler):
+        """ref: _optimizer_step:449."""
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """ref: pipeline_parallel.py:461 — virtual pipeline stages. The
+    single-controller schedule executes chunks in interleaved order; numerics
+    match the non-interleaved case (additive grad accumulation), so we reuse
+    the base schedule over the finer chunk segmentation."""
+
+    def __init__(self, layers, hcg, strategy):
+        super().__init__(layers, hcg, strategy)
+        self.num_stages = layers.get_num_stages() * \
+            layers._num_virtual_pipeline_stages

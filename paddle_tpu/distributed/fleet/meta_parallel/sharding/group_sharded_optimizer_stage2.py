@@ -1,0 +1,63 @@
+"""ZeRO-2 optimizer (ref: python/paddle/distributed/fleet/meta_parallel/
+sharding/group_sharded_optimizer_stage2.py:53 — param segmentation :308,
+rank buffers :369, broadcast overlap :241).
+
+TPU-native: optimizer state arrays are placed sharded over the 'sharding'
+mesh axis (see group_sharded_utils). The update math is unchanged; XLA
+partitions the state update and the params stay logically whole, which
+replaces the reference's reduce-to-owner + broadcast cycle."""
+from .group_sharded_utils import place_sharded
+
+
+class GroupShardedOptimizerStage2:
+    def __init__(self, params, optim, group=None, offload=False, device="tpu",
+                 pretrain_sync_models=True, dp_group=None, **kw):
+        self._optim = optim
+        self._params = list(params)
+        self._group = group
+        self.offload = offload
+        if self._optim._parameter_list is None:
+            self._optim._parameter_list = self._params
+        self._shard_states_placed = False
+
+    def _place_states(self):
+        st = self._optim._accumulators.get("__state__", {})
+        for key, state in st.items():
+            for name, arr in state.items():
+                if hasattr(arr, "shape"):
+                    state[name] = place_sharded(arr)
+        self._shard_states_placed = True
+
+    def step(self):
+        self._optim.step()
+        if not self._shard_states_placed:
+            self._place_states()
+
+    def clear_grad(self, *a, **k):
+        self._optim.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def set_lr(self, lr):
+        self._optim.set_lr(lr)
+
+    def get_lr(self):
+        return self._optim.get_lr()
+
+    def state_dict(self):
+        return self._optim.state_dict()
+
+    def set_state_dict(self, sd):
+        self._optim.set_state_dict(sd)
+
+    def __getattr__(self, name):
+        return getattr(self._optim, name)
+
+    @property
+    def local_params(self):
+        return self._params

@@ -1,0 +1,56 @@
+"""ZeRO-2 model wrapper (ref: group_sharded_stage2.py:46 — grad
+reduce-to-owner hooks + _redefine_opt_step). Single-controller: grads are
+computed once on the logical params; the sharded placement of optimizer
+state (stage-2 optimizer) is the memory win. Gradient buffers can also be
+placed sharded after backward via `shard_grads`."""
+from .....nn.layer.layers import Layer
+from .group_sharded_utils import place_sharded
+
+
+class GroupShardedStage2(Layer):
+    def __init__(self, layer, sharding_optimizer, group=None, sync_buffers=False,
+                 buffer_max_size=2 ** 23, auto_refresh_trainable=True,
+                 device="tpu", dp_group=None, **kw):
+        super().__init__()
+        self._layer = layer
+        self._sharding_optimizers = (
+            sharding_optimizer if isinstance(sharding_optimizer, list)
+            else [sharding_optimizer])
+        self._group = group
+        self._redefine_opt_step()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layer(*inputs, **kwargs)
+
+    def shard_grads(self):
+        for p in self._layer.parameters():
+            if p.grad is not None:
+                p.grad.data = place_sharded(p.grad.data)
+
+    def _redefine_opt_step(self):
+        # ref: stage2 hooks optimizer.step to run grad reduce first; here the
+        # pre-step work is placing grads sharded.
+        for opt in self._sharding_optimizers:
+            inner_step = opt.step
+            wrapper = self
+
+            def step_wrapper(_inner=inner_step):
+                wrapper.shard_grads()
+                _inner()
+
+            opt.step = step_wrapper
+
+    def state_dict(self, *args, **kwargs):
+        return self._layer.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layer.set_state_dict(sd, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layer.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layer.named_parameters(prefix, include_sublayers)
+
+    def clear_gradients(self):
+        self._layer.clear_gradients()

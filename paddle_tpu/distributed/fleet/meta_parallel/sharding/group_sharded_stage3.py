@@ -1,0 +1,48 @@
+"""ZeRO-3 wrapper (ref: group_sharded_stage3.py:59 — param slicing :422,
+gather-on-use forward hooks :486, regather :617).
+
+TPU-native: parameters are placed with a sharded NamedSharding over the
+'sharding' axis permanently; XLA inserts allgather at use and
+reduce_scatter in the backward — the compiler-automated equivalent of the
+reference's hook-driven gather/release."""
+from .....nn.layer.layers import Layer
+from .group_sharded_utils import place_sharded
+
+
+class GroupShardedStage3(Layer):
+    def __init__(self, layer, optimizer, group=None, sync_buffers=False,
+                 device="tpu", segment_size=2 ** 15, pretrain_sync_models=True,
+                 offload=False, sync_comm=False, dp_group=None,
+                 exclude_layer=None, **kw):
+        super().__init__()
+        self._layer = layer
+        self._optimizer = optimizer
+        self._group = group
+        self._shard_parameters()
+
+    def _shard_parameters(self):
+        for p in self._layer.parameters():
+            p.data = place_sharded(p.data)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layer(*inputs, **kwargs)
+
+    def get_all_parameters(self, convert2cpu=False):
+        """ref: :617 — regather the full params (already logically whole;
+        re-place replicated)."""
+        import jax
+        for p in self._layer.parameters():
+            p.data = jax.device_get(p.data) if convert2cpu else p.data
+        return self._layer.parameters()
+
+    def state_dict(self, *args, **kwargs):
+        return self._layer.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layer.set_state_dict(sd, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layer.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layer.named_parameters(prefix, include_sublayers)

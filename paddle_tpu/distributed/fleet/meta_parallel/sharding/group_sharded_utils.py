@@ -1,0 +1,41 @@
+"""Sharding placement helpers.
+
+TPU-native ZeRO: instead of per-rank python-object shards
+(ref: meta_parallel/sharding/group_sharded_storage.py ParamStorage/
+GradStorage), arrays are placed with a NamedSharding over the 'sharding'
+mesh axis — XLA partitions storage and inserts the reduce_scatter/allgather
+traffic. One logical tensor, physically distributed.
+"""
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....mesh import global_mesh
+
+
+def shard_spec_for(shape, axis="sharding", mesh=None):
+    """Shard dim0 over the axis when divisible, else replicate."""
+    mesh = mesh or global_mesh()
+    if axis not in mesh.axis_names or mesh.shape[axis] <= 1:
+        return P()
+    n = mesh.shape[axis]
+    if len(shape) > 0 and shape[0] % n == 0:
+        return P(axis)
+    return P()
+
+
+def place_sharded(arr, axis="sharding", mesh=None):
+    mesh = mesh or global_mesh()
+    spec = shard_spec_for(arr.shape, axis, mesh)
+    try:
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+    except Exception:
+        return arr
+
+
+class GroupShardedScaler:
+    """ref: group_sharded_utils.py GroupShardedScaler — delegates to the
+    standard GradScaler (inf/nan check is global in single-controller)."""
+
+    def __new__(cls, scaler):
+        return scaler

@@ -1,0 +1,11 @@
+"""ShardingParallel wrapper (ref: python/paddle/distributed/fleet/
+meta_parallel/sharding_parallel.py). Single-controller: parameters are one
+logical copy; the sharding happens in the optimizer (DygraphShardingOptimizer
+/ GroupSharded stages place state shards over the 'sharding' mesh axis)."""
+from .meta_parallel_base import MetaParallelBase
+
+
+class ShardingParallel(MetaParallelBase):
+    def _prepare_for_model(self):
+        # ref: broadcast_sharding_parameters — no-op single-controller.
+        pass

@@ -1,0 +1,24 @@
+"""TensorParallel wrapper (ref: python/paddle/distributed/fleet/
+meta_parallel/tensor_parallel.py:27 — broadcasts params+inputs then runs the
+model).
+
+TPU-native: there is nothing to broadcast in a single controller (one copy of
+the logical params). forward() executes the wrapped layers as ONE SPMD region
+over the mesh so mp collectives inside mp_layers lower to ICI ops; backward
+flows through the recorded shard_map vjp.
+"""
+from .meta_parallel_base import MetaParallelBase
+from .spmd import spmd_forward
+
+
+class TensorParallel(MetaParallelBase):
+    def _prepare_for_model(self):
+        # ref: tensor_parallel.py broadcast_mp_parameters /
+        # broadcast_dp_parameters — no-op in single-controller SPMD.
+        pass
+
+    def forward(self, *inputs, **kwargs):
+        mp = self._hcg.get_model_parallel_world_size() if self._hcg else 1
+        if mp <= 1:
+            return self._layers(*inputs, **kwargs)
+        return spmd_forward(self._layers, list(inputs), data_axis="data")

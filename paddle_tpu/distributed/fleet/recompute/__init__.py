@@ -1,0 +1,114 @@
+"""Activation recomputation (ref: python/paddle/distributed/fleet/recompute/
+recompute.py:69 RecomputeFunction, :330 recompute, :454 recompute_sequential;
+recompute_hybrid.py).
+
+TPU-native: jax.checkpoint (rematerialization) IS recompute — applied to the
+functional form of the layer call and recorded as one tape op so eager
+backward triggers the rematerialized backward pass. RNG determinism mirrors
+RNGStatesTracker: the same key is threaded to both the forward and the
+rematerialized forward (jax.checkpoint guarantees this by construction since
+the key is an argument).
+"""
+import jax
+
+from ....autograd import tape
+from ....framework import random as frnd
+from ....ops import apply
+from ....tensor.tensor import Tensor
+
+
+def recompute(function, *args, **kwargs):
+    """ref: recompute.py:330."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    tensors = [a for a in args if isinstance(a, Tensor)]
+    if not tensors:
+        return function(*args, **kwargs)
+
+    key = frnd.next_key()
+    t_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+
+    @jax.checkpoint
+    def fn(key_, *arrays):
+        new_args = list(args)
+        for i, arr in zip(t_idx, arrays):
+            t = Tensor(arr, stop_gradient=args[i].stop_gradient)
+            new_args[i] = t
+        with frnd.key_scope(key_):
+            out = function(*new_args, **kwargs)
+        if isinstance(out, (list, tuple)):
+            return tuple(o.data if isinstance(o, Tensor) else o for o in out)
+        return out.data if isinstance(out, Tensor) else out
+
+    # Parameters used inside `function` are captured as constants of this
+    # trace — jax.checkpoint still rematerializes; grads to params flow
+    # because we thread them explicitly below via capture recording.
+    from ....jit import _capture_stack
+    captures = {}
+    _capture_stack.append(captures)
+    try:
+        with tape.no_grad():
+            _ = function(*args, **kwargs)
+    finally:
+        _capture_stack.pop()
+    cap_tensors = [t for t in captures.values()
+                   if not any(t is a for a in args)]
+
+    n_inputs = len(t_idx)
+
+    @jax.checkpoint
+    def fn_full(key_, cap_arrays, *arrays):
+        saved = [t.data for t in cap_tensors]
+        for t, a in zip(cap_tensors, cap_arrays):
+            t.data = a
+        try:
+            new_args = list(args)
+            for i, arr in zip(t_idx, arrays):
+                tt = Tensor(arr, stop_gradient=args[i].stop_gradient)
+                new_args[i] = tt
+            with frnd.key_scope(key_), tape.no_grad():
+                out = function(*new_args, **kwargs)
+        finally:
+            for t, s in zip(cap_tensors, saved):
+                t.data = s
+        if isinstance(out, (list, tuple)):
+            return tuple(o.data if isinstance(o, Tensor) else o for o in out)
+        return out.data if isinstance(out, Tensor) else out
+
+    def wrapper(*all_tensors):
+        caps = [t.data for t in all_tensors[:len(cap_tensors)]]
+        ins = [t.data if isinstance(t, Tensor) else t
+               for t in all_tensors[len(cap_tensors):]]
+        return fn_full(key, caps, *ins)
+
+    return apply(lambda *arrs: fn_full(key, list(arrs[:len(cap_tensors)]),
+                                       *arrs[len(cap_tensors):]),
+                 *cap_tensors, *tensors, name="recompute")
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """ref: recompute.py:454 — recompute over a Sequential in segments."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    if hasattr(functions, "_sub_layers"):
+        layers = list(functions._sub_layers.values())
+    else:
+        layers = list(functions)
+    n = len(layers)
+    seg_size = max(1, n // segments)
+    out = args if len(args) > 1 else args[0]
+    for lo in range(0, n, seg_size):
+        chunk = layers[lo:lo + seg_size]
+
+        def run(x, _chunk=chunk):
+            for l in _chunk:
+                x = l(x)
+            return x
+
+        out = recompute(run, out, **kwargs)
+    return out
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """ref: recompute_hybrid.py — mp-aware recompute; the key threading makes
+    RNG agree across ranks, and sharded activations rematerialize locally."""
+    return recompute(function, *args, **kwargs)

@@ -1,0 +1,7 @@
+"""fleet.distributed_scaler (ref: python/paddle/distributed/fleet/scaler.py:28)."""
+from .meta_optimizers.hybrid_parallel_gradscaler import HybridParallelGradScaler
+from .fleet_shim import hcg_or_none
+
+
+def distributed_scaler(scaler):
+    return HybridParallelGradScaler(scaler, hcg_or_none())

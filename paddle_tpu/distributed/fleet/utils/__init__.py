@@ -1,0 +1,2 @@
+from . import hybrid_parallel_util
+from .log_util import logger

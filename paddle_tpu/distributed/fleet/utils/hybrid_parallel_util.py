@@ -1,0 +1,44 @@
+"""Cross-wrapper glue (ref: python/paddle/distributed/fleet/utils/
+hybrid_parallel_util.py — broadcast_input_data:139, broadcast_mp_parameters
+:178, broadcast_dp_parameters:186, fused_allreduce_gradients:206,
+broadcast_sharding_parameters:229).
+
+Single-controller SPMD holds ONE logical copy of every parameter, so the
+broadcast_* calls are identity; fused_allreduce_gradients maps to a grad
+psum over the data axis (XLA fuses the bucketing the reference does by
+hand in EagerReducer)."""
+from ...collective import all_reduce, ReduceOp
+from ...mesh import in_spmd_region
+
+
+def broadcast_input_data(hcg, *inputs, **kwargs):
+    return inputs if not kwargs else (inputs, kwargs)
+
+
+def broadcast_mp_parameters(model, hcg):
+    pass
+
+
+def broadcast_dp_parameters(model, hcg):
+    pass
+
+
+def broadcast_sharding_parameters(model, hcg):
+    pass
+
+
+def broadcast_sep_parameters(model, hcg):
+    pass
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """ref: :206 — allreduce grads over the data-parallel group."""
+    group = hcg.get_data_parallel_group() if hcg is not None else None
+    if group is not None and group.nranks > 1 or in_spmd_region("data"):
+        for p in parameter_list:
+            if p.grad is not None:
+                all_reduce(p.grad, op=ReduceOp.AVG, group=group)
+
+
+def sharding_reduce_gradients(parameter_list, hcg):
+    fused_allreduce_gradients(parameter_list, hcg)

@@ -1,0 +1,10 @@
+"""fleet logger (ref: python/paddle/distributed/fleet/utils/log_util.py)."""
+import logging
+
+logger = logging.getLogger("paddle_tpu.distributed")
+if not logger.handlers:
+    handler = logging.StreamHandler()
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s [%(name)s] %(message)s"))
+    logger.addHandler(handler)
+logger.setLevel(logging.INFO)

@@ -1,0 +1,94 @@
+"""Device mesh management.
+
+The TPU-native replacement for ProcessGroup comm fabrics (SURVEY §2.4):
+one global jax.sharding.Mesh whose named axes are the parallelism dimensions
+(["data","pipe","sharding","model"] + optional "sep" for sequence/context
+parallel). Collectives are axis-name-addressed; groups are axis subsets.
+"""
+import contextlib
+import threading
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, PartitionSpec
+
+_state = threading.local()
+_global_mesh = [None]
+
+# Canonical axis order — matches the reference's CommunicateTopology order
+# (ref: fleet/base/topology.py:56 ["data","pipe","sharding","model"]).
+HYBRID_AXES = ("data", "pipe", "sharding", "model")
+
+
+def build_mesh(axis_sizes, devices=None):
+    """axis_sizes: dict axis_name -> size (product must equal #devices used)."""
+    names = tuple(axis_sizes.keys())
+    sizes = tuple(int(axis_sizes[n]) for n in names)
+    if devices is None:
+        devices = jax.devices()
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(
+            f"mesh {dict(axis_sizes)} needs {total} devices, have "
+            f"{len(devices)}")
+    arr = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def set_global_mesh(mesh):
+    _global_mesh[0] = mesh
+
+
+def global_mesh():
+    if _global_mesh[0] is None:
+        set_global_mesh(build_mesh({"data": len(jax.devices())}))
+    return _global_mesh[0]
+
+
+def mesh_axis_size(axis):
+    m = _global_mesh[0]
+    if m is None or axis not in m.axis_names:
+        return 1
+    return m.shape[axis]
+
+
+# -- SPMD region tracking ---------------------------------------------------
+# When a step function is traced under shard_map, these axis names are
+# "live": collectives lower to lax ops over them. Outside, group collectives
+# degrade to single-rank no-ops (single-controller semantics).
+
+def _axes_stack():
+    if not hasattr(_state, "axes"):
+        _state.axes = []
+    return _state.axes
+
+
+@contextlib.contextmanager
+def spmd_axes(axis_names):
+    st = _axes_stack()
+    st.append(tuple(axis_names))
+    try:
+        yield
+    finally:
+        st.pop()
+
+
+def in_spmd_region(axis=None):
+    st = _axes_stack()
+    if not st:
+        return False
+    if axis is None:
+        return True
+    return axis in st[-1]
+
+
+def current_axis_name():
+    st = _axes_stack()
+    return st[-1] if st else ()
+
+
+def axis_index(axis):
+    """Rank along a mesh axis: traced value inside SPMD, 0 outside."""
+    if in_spmd_region(axis):
+        return jax.lax.axis_index(axis)
+    return 0

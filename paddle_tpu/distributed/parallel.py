@@ -1,0 +1,71 @@
+"""DataParallel wrapper.
+
+ref: python/paddle/fluid/dygraph/parallel.py:186 DataParallel +
+paddle/fluid/distributed/collective/reducer.cc EagerReducer (bucketed grad
+allreduce overlapped with backward).
+
+TPU-native: inside a compiled SPMD step the grad psum over the 'data' axis
+is inserted by `sync_gradients` (XLA's latency-hiding scheduler provides the
+overlap the reference gets from comm streams). In eager single-controller
+mode there is one copy of the params, so wrapping is mostly pass-through;
+`no_sync` semantics are honored by the step builders.
+"""
+import contextlib
+
+from ..nn import Layer
+from .collective import all_reduce, ReduceOp
+from .mesh import in_spmd_region
+from .parallel_env import get_world_size
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        self.find_unused_parameters = find_unused_parameters
+        self._grad_sync_enabled = True
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """ref: parallel.py:488 — skip grad sync inside this context."""
+        self._grad_sync_enabled = False
+        try:
+            yield
+        finally:
+            self._grad_sync_enabled = True
+
+    def sync_gradients(self):
+        """Explicit grad allreduce over the data axis (EagerReducer analog).
+        Called by step builders after backward; no-op under no_sync."""
+        if not self._grad_sync_enabled:
+            return
+        if not in_spmd_region("data") and get_world_size() == 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                all_reduce(p.grad, op=ReduceOp.AVG,
+                           group=self._group)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        self.sync_gradients()

@@ -1,0 +1,109 @@
+"""Parallel environment + rendezvous.
+
+ref: python/paddle/distributed/parallel.py:318 init_parallel_env, :60
+ParallelEnv. The reference rendezvouses N processes through a TCPStore and
+builds ProcessGroupNCCL. TPU-native: jax.distributed.initialize() performs
+the same role (coordinator address + process ranks over DCN), after which
+every process sees the global device set and SPMD programs span the full
+mesh. Single-process (1 host, N chips) needs no rendezvous at all — the mesh
+is just jax.devices().
+"""
+import os
+
+import jax
+
+_initialized = [False]
+
+
+class ParallelEnv:
+    """ref: parallel.py:60 — env-var contract PADDLE_TRAINER_ID etc."""
+
+    def __init__(self):
+        self._rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._world_size = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self._device_id = int(os.getenv("FLAGS_selected_tpus",
+                                        os.getenv("FLAGS_selected_gpus", "0")
+                                        ).split(",")[0] or 0)
+        eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = eps.split(",") if eps else []
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def rank(self):
+        if _initialized[0]:
+            return jax.process_index()
+        return self._rank
+
+    @property
+    def world_size(self):
+        if _initialized[0]:
+            return jax.process_count()
+        return self._world_size
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def local_rank(self):
+        return int(os.getenv("PADDLE_LOCAL_RANK", str(self._device_id)))
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def local_device_count(self):
+        return jax.local_device_count()
+
+
+def init_parallel_env(strategy=None):
+    """ref: parallel.py:318. Multi-host: jax.distributed.initialize using the
+    MASTER_ADDR/PORT or PADDLE_TRAINER_ENDPOINTS contract; single-host is a
+    no-op beyond mesh construction."""
+    if _initialized[0]:
+        return ParallelEnv()
+    env = ParallelEnv()
+    world = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+    if world > 1:
+        master = os.getenv("MASTER_ADDR")
+        port = os.getenv("MASTER_PORT")
+        if not master and env.trainer_endpoints:
+            master, port = env.trainer_endpoints[0].split(":")
+        coordinator = f"{master}:{port}"
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=world,
+            process_id=int(os.getenv("PADDLE_TRAINER_ID", "0")),
+        )
+    _initialized[0] = True
+    # Build the default (data-only) global mesh.
+    from .mesh import set_global_mesh, build_mesh
+    from .collective import _ensure_world_group
+    set_global_mesh(build_mesh({"data": len(jax.devices())}))
+    _ensure_world_group()
+    return env
+
+
+def is_initialized():
+    return _initialized[0]
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    return ParallelEnv().rank
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return ParallelEnv().world_size

@@ -1,0 +1,41 @@
+"""Public group_sharded API (ref: python/paddle/distributed/sharding/
+group_sharded.py:33 group_sharded_parallel — level 'os'|'os_g'|'p_g_os')."""
+from ..fleet.meta_parallel.sharding import (GroupShardedOptimizerStage2,
+                                            GroupShardedStage2,
+                                            GroupShardedStage3,
+                                            GroupShardedScaler)
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=2**23,
+                           segment_size=2**20, sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """ref: group_sharded.py:33."""
+    assert level in ("os", "os_g", "p_g_os"), f"bad level {level}"
+    params = list(model.parameters())
+    if level in ("os", "os_g"):
+        optimizer = GroupShardedOptimizerStage2(params, optimizer, group=group,
+                                                offload=offload)
+        model = GroupShardedStage2(model, optimizer, group=group,
+                                   sync_buffers=sync_buffers,
+                                   buffer_max_size=buffer_max_size)
+    else:
+        model = GroupShardedStage3(model, optimizer, group=group,
+                                   sync_buffers=sync_buffers,
+                                   segment_size=segment_size,
+                                   sync_comm=sync_comm)
+    if scaler is not None:
+        scaler = GroupShardedScaler(scaler)
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    from ...framework.io import save
+    import os
+    os.makedirs(output, exist_ok=True)
+    target = model
+    if isinstance(model, (GroupShardedStage2, GroupShardedStage3)):
+        target = model._layer
+    save(target.state_dict(), os.path.join(output, "model.pdmodel"))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

@@ -1,0 +1,31 @@
+"""paddle.distributed.spawn (ref: python/paddle/distributed/spawn.py:472).
+
+Single-controller JAX note: one process drives all local TPU chips, so the
+common reason to spawn (1 proc/GPU) doesn't apply. Multi-host jobs use the
+launcher (paddle_tpu.distributed.launch). spawn is kept for CPU-process
+tests and API parity.
+"""
+import multiprocessing
+import os
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    if nprocs == -1:
+        nprocs = 1
+    ctx = multiprocessing.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {"PADDLE_TRAINER_ID": str(rank),
+               "PADDLE_TRAINERS_NUM": str(nprocs)}
+        p = ctx.Process(target=_wrap, args=(func, args, env), daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+    return procs
+
+
+def _wrap(func, args, env):
+    os.environ.update(env)
+    func(*args)

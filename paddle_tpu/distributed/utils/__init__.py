@@ -1,0 +1,2 @@
+"""distributed.utils (ref: python/paddle/distributed/utils/)."""
+from . import moe_utils

@@ -1,0 +1,33 @@
+"""MoE dispatch collectives (ref: python/paddle/distributed/utils/
+moe_utils.py:20 global_scatter, :146 global_gather; C++ ops
+fluid/operators/collective/global_scatter_op.cc).
+
+TPU-native: expert dispatch is lax.all_to_all over the expert-parallel axis
+with equal-capacity buckets (GShard style) instead of NCCL grouped
+send/recv with variable counts.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops import apply
+from ...tensor.tensor import Tensor
+from ..mesh import in_spmd_region
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    axis = group.axis_name if group is not None else "expert"
+    if not in_spmd_region(axis):
+        return x.clone() if isinstance(x, Tensor) else x
+    return apply(lambda a: lax.all_to_all(a, axis, split_axis=0,
+                                          concat_axis=0, tiled=True),
+                 x, name="global_scatter")
+
+
+def global_gather(x, local_count, global_count, group=None):
+    axis = group.axis_name if group is not None else "expert"
+    if not in_spmd_region(axis):
+        return x.clone() if isinstance(x, Tensor) else x
+    return apply(lambda a: lax.all_to_all(a, axis, split_axis=0,
+                                          concat_axis=0, tiled=True),
+                 x, name="global_gather")

@@ -1,0 +1,17 @@
+from . import dtype as dtype_mod
+from .dtype import (convert_dtype, get_default_dtype, set_default_dtype)
+from .place import (Place, CPUPlace, TPUPlace, CUDAPlace, XPUPlace, set_device,
+                    get_device, is_compiled_with_tpu, is_compiled_with_cuda,
+                    _get_current_place)
+from .random import seed, get_rng_state, set_rng_state, default_generator
+from .io import save, load
+
+
+def in_dygraph_mode():
+    """Always true: the TPU build is eager-first; 'static mode' is jit-traced
+    (ref: python/paddle/fluid/framework.py in_dygraph_mode)."""
+    return True
+
+
+def in_dynamic_mode():
+    return True

@@ -1,0 +1,87 @@
+"""Dtype system.
+
+TPU-native rebuild of the reference's dtype surface
+(ref: paddle/phi/common/data_type.h, python/paddle/framework/dtype.py).
+Dtypes are jax/numpy dtypes; we expose paddle-style names.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (jnp dtypes are numpy dtypes under the hood).
+bool = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_TO_DTYPE = {
+    "bool": bool,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    # paddle aliases
+    "fp16": float16,
+    "bf16": bfloat16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_INTEGER = {uint8, int8, int16, int32, int64}
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (str / np.dtype / jnp dtype / None) to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR_TO_DTYPE:
+            raise TypeError(f"Unsupported dtype string: {dtype!r}")
+        return _STR_TO_DTYPE[dtype]
+    # torch-style / paddle VarDesc-style objects are not supported; accept
+    # anything numpy can canonicalize.
+    return jnp.dtype(dtype).type
+
+
+def dtype_name(dtype):
+    return jnp.dtype(dtype).name
+
+
+def is_floating_point(dtype):
+    return jnp.dtype(dtype).kind == "f" or jnp.dtype(dtype) == jnp.dtype(jnp.bfloat16)
+
+
+def is_integer(dtype):
+    kind = jnp.dtype(dtype).kind
+    return kind in ("i", "u")
+
+
+_default_dtype = float32
+
+
+def set_default_dtype(dtype):
+    """paddle.set_default_dtype analog (ref: python/paddle/framework/framework.py)."""
+    global _default_dtype
+    dtype = convert_dtype(dtype)
+    if dtype not in (float16, bfloat16, float32, float64):
+        raise TypeError("set_default_dtype only supports floating dtypes")
+    _default_dtype = dtype
+
+
+def get_default_dtype():
+    return _default_dtype

@@ -1,0 +1,54 @@
+"""Global flag registry.
+
+Analog of the reference's exported gflags (ref: paddle/phi/core/flags.cc — 95
+public FLAGS_* settable by env or paddle.set_flags). Flags here steer jax/XLA
+behavior and framework toggles.
+"""
+import os
+
+_FLAGS = {
+    "FLAGS_check_nan_inf": False,          # ref: phi/core/flags.cc FLAGS_check_nan_inf
+    "FLAGS_use_pallas_kernels": True,
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_embedding_deterministic": False,
+    "FLAGS_low_precision_op_list": 0,
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_stop_check_timeout": 900,
+    "FLAGS_benchmark": False,
+}
+
+
+def _coerce(cur, val):
+    if isinstance(cur, bool):
+        return str(val).lower() in ("1", "true", "yes", "on") if not isinstance(val, bool) else val
+    if isinstance(cur, int):
+        return int(val)
+    if isinstance(cur, float):
+        return float(val)
+    return val
+
+
+# env overrides at import, matching the reference's env->gflags bridge
+for k in list(_FLAGS):
+    if k in os.environ:
+        _FLAGS[k] = _coerce(_FLAGS[k], os.environ[k])
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {f: _FLAGS.get(f) for f in flags}
+
+
+def set_flags(flags):
+    from ..ops import enable_pallas
+    for k, v in flags.items():
+        cur = _FLAGS.get(k)
+        _FLAGS[k] = _coerce(cur, v) if cur is not None else v
+    if "FLAGS_use_pallas_kernels" in flags:
+        enable_pallas(_FLAGS["FLAGS_use_pallas_kernels"])
+
+
+def get_flag(name, default=None):
+    return _FLAGS.get(name, default)

@@ -1,0 +1,57 @@
+"""Serialization: paddle.save / paddle.load
+(ref: python/paddle/framework/io.py, pickle with tensor->numpy reduction
+ at _pickle_save:262)."""
+import os
+import pickle
+
+import numpy as np
+
+from ..tensor.tensor import Tensor
+
+
+class _TensorPayload:
+    """Pickle-stable stand-in for a Tensor (numpy + flags)."""
+
+    def __init__(self, array, stop_gradient, name):
+        self.array = array
+        self.stop_gradient = stop_gradient
+        self.name = name
+
+
+def _encode(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj.numpy()), obj.stop_gradient, obj.name)
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_encode(v) for v in obj)
+    return obj
+
+
+def _decode(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = Tensor(obj.array, stop_gradient=obj.stop_gradient, name=obj.name)
+        return t
+    if isinstance(obj, dict):
+        return {k: _decode(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_decode(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_encode(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _decode(obj, return_numpy=return_numpy)

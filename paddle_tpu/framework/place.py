@@ -1,0 +1,116 @@
+"""Places (devices).
+
+TPU-native analog of the reference's Place hierarchy
+(ref: paddle/phi/common/place.h, python/paddle/device/__init__.py).
+A Place wraps a jax.Device; TPUPlace is the first-class accelerator.
+"""
+import jax
+
+
+class Place:
+    """Base place. Compares by device kind + index."""
+
+    _kind = "undefined"
+
+    def __init__(self, device_id=0):
+        self._device_id = int(device_id)
+
+    def get_device_id(self):
+        return self._device_id
+
+    @property
+    def jax_device(self):
+        devs = [d for d in jax.devices() if d.platform == self._platform()]
+        if not devs:
+            # Fall back to whatever the default backend provides (e.g. CPU
+            # tests where no TPU exists).
+            devs = jax.devices()
+        return devs[self._device_id % len(devs)]
+
+    def _platform(self):
+        return self._kind
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self._kind == other._kind
+            and self._device_id == other._device_id
+        )
+
+    def __hash__(self):
+        return hash((self._kind, self._device_id))
+
+    def __repr__(self):
+        return f"Place({self._kind}:{self._device_id})"
+
+
+class CPUPlace(Place):
+    _kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class TPUPlace(Place):
+    """The accelerator place. Analog of CUDAPlace in the reference
+    (ref: paddle/phi/common/place.h:CUDAPlace)."""
+
+    _kind = "tpu"
+
+    def _platform(self):
+        # Under the axon tunnel the platform may be reported differently;
+        # treat any non-cpu accelerator as "tpu".
+        return jax.default_backend() if jax.default_backend() != "cpu" else "tpu"
+
+
+# CUDAPlace alias for source compatibility with reference user code.
+CUDAPlace = TPUPlace
+XPUPlace = TPUPlace
+
+_current_place = None
+
+
+def _best_place():
+    backend = jax.default_backend()
+    if backend == "cpu":
+        return CPUPlace()
+    return TPUPlace(0)
+
+
+def set_device(device):
+    """paddle.set_device analog. Accepts 'cpu', 'tpu', 'tpu:0', 'gpu'(alias)."""
+    global _current_place
+    if isinstance(device, Place):
+        _current_place = device
+        return _current_place
+    name = str(device).lower()
+    if name.startswith("cpu"):
+        _current_place = CPUPlace()
+    elif name.startswith(("tpu", "gpu", "cuda", "xpu", "axon")):
+        idx = int(name.split(":")[1]) if ":" in name else 0
+        _current_place = TPUPlace(idx)
+    else:
+        raise ValueError(f"Unknown device {device!r}")
+    return _current_place
+
+
+def get_device():
+    p = _get_current_place()
+    return f"{p._kind}:{p.get_device_id()}" if not isinstance(p, CPUPlace) else "cpu"
+
+
+def _get_current_place():
+    global _current_place
+    if _current_place is None:
+        _current_place = _best_place()
+    return _current_place
+
+
+def is_compiled_with_tpu():
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+def is_compiled_with_cuda():
+    # Source-compat shim: reference user code gates on this; on TPU builds it
+    # answers whether an accelerator is present.
+    return is_compiled_with_tpu()

@@ -1,0 +1,88 @@
+"""RNG state.
+
+TPU-native analog of the reference's Generator (ref: paddle/phi/core/generator.h)
+built on stateless threefry keys. Two regimes:
+
+- Eager: a global stateful Generator splits its key per draw.
+- Traced (jit/pjit): the step machinery pushes a *key tracer* via
+  `key_scope(key)`; draws fold a per-trace counter into that key so the
+  compiled program re-randomizes every step while staying functional.
+"""
+import contextlib
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """Stateful RNG handle (ref: phi/core/generator.h)."""
+
+    def __init__(self, seed=0):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+
+    def manual_seed(self, seed):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        return self
+
+    def initial_seed(self):
+        return self._seed
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, state):
+        self._key = state
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+_default_generator = Generator(np.random.randint(0, 2**31 - 1))
+
+# Stack of (key, counter-box) pushed by tracing machinery.
+_key_stack = []
+
+
+def default_generator():
+    return _default_generator
+
+
+def seed(value):
+    """paddle.seed analog — reseeds the global generator."""
+    _default_generator.manual_seed(value)
+    return _default_generator
+
+
+def get_rng_state():
+    return [_default_generator.get_state()]
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state[0])
+
+
+@contextlib.contextmanager
+def key_scope(key):
+    """Bind a (possibly traced) PRNG key for ops executed in this scope."""
+    box = [key, 0]
+    _key_stack.append(box)
+    try:
+        yield
+    finally:
+        _key_stack.pop()
+
+
+def next_key():
+    """Key for one random draw: trace-scope key if bound, else global split."""
+    if _key_stack:
+        box = _key_stack[-1]
+        box[1] += 1
+        return jax.random.fold_in(box[0], box[1])
+    return _default_generator.next_key()
+
+
+def in_key_scope():
+    return len(_key_stack) > 0

@@ -1,0 +1,155 @@
+"""Keras-like high-level Model (ref: python/paddle/hapi/model.py:1039 Model,
+fit:1734)."""
+import numpy as np
+
+from ..tensor.tensor import Tensor
+from ..autograd import tape
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+
+    def _compute_loss(self, outputs, labels):
+        if callable(self._loss):
+            return self._loss(outputs, labels)
+        raise ValueError("loss not prepared")
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            res = m.update(*_to_args(m.compute(outputs, labels)))
+            metrics.append(res)
+        return ([float(loss.numpy())], metrics) if metrics else [float(loss.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with tape.no_grad():
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels)
+        metrics = []
+        for m in self._metrics:
+            res = m.update(*_to_args(m.compute(outputs, labels)))
+            metrics.append(res)
+        return ([float(loss.numpy())], metrics) if metrics else [float(loss.numpy())]
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with tape.no_grad():
+            out = self.network(*inputs)
+        return out
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            loader = DataLoader(train_data, batch_size=batch_size,
+                                shuffle=shuffle, drop_last=drop_last,
+                                num_workers=num_workers)
+        else:
+            loader = train_data
+        history = []
+        it = 0
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(loader):
+                data, label = batch[0], batch[1] if len(batch) > 1 else None
+                res = self.train_batch(data, label)
+                loss_val = res[0][0] if isinstance(res, tuple) else res[0]
+                it += 1
+                if verbose and step % log_freq == 0:
+                    msg = f"epoch {epoch} step {step}: loss={loss_val:.4f}"
+                    for m in self._metrics:
+                        msg += f" {m.name()}={m.accumulate()}"
+                    print(msg)
+                if num_iters is not None and it >= num_iters:
+                    break
+            history.append(loss_val)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size)
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size)
+        else:
+            loader = eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            data, label = batch[0], batch[1] if len(batch) > 1 else None
+            res = self.eval_batch(data, label)
+            loss_val = res[0][0] if isinstance(res, tuple) else res[0]
+            losses.append(loss_val)
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        out = {"loss": [float(np.mean(losses))]}
+        for m in self._metrics:
+            out[m.name()] = m.accumulate()
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        from ..io import DataLoader, Dataset
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size)
+        else:
+            loader = test_data
+        outs = []
+        for batch in loader:
+            data = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(self.predict_batch(data))
+        return outs
+
+    def save(self, path, training=True):
+        from ..framework.io import save
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load
+        self.network.set_state_dict(load(path + ".pdparams"))
+        import os
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+        return summary(self.network, input_size)
+
+
+def _to_args(x):
+    return x if isinstance(x, (list, tuple)) else (x,)

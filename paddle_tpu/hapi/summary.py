@@ -1,0 +1,18 @@
+"""Model summary (ref: python/paddle/hapi/model_summary.py)."""
+import numpy as np
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    total_params = 0
+    trainable_params = 0
+    lines = [f"{'Layer':<40}{'Params':>12}"]
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape))
+        total_params += n
+        if p.trainable:
+            trainable_params += n
+        lines.append(f"{name:<40}{n:>12,}")
+    lines.append(f"Total params: {total_params:,}")
+    lines.append(f"Trainable params: {trainable_params:,}")
+    print("\n".join(lines))
+    return {"total_params": total_params, "trainable_params": trainable_params}

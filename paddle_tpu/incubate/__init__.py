@@ -1,0 +1,3 @@
+"""paddle.incubate analog (ref: python/paddle/incubate/)."""
+from . import autograd
+from . import nn

@@ -1,0 +1,67 @@
+"""Functional/higher-order autodiff (ref: python/paddle/incubate/autograd/ —
+primx/primrules primitive autodiff). On TPU this is jax's native transform
+set; exposed with the reference's functional API names."""
+import jax
+
+from ...tensor.tensor import Tensor
+from ...autograd import tape
+
+
+def _wrap_fn(fn):
+    def pure(*arrays):
+        ts = [Tensor(a, stop_gradient=False) for a in arrays]
+        with tape.no_grad():
+            out = fn(*ts)
+        if isinstance(out, (list, tuple)):
+            return tuple(o.data for o in out)
+        return out.data
+    return pure
+
+
+def jvp(func, xs, v=None):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [x.data for x in xs]
+    if v is None:
+        import jax.numpy as jnp
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        v = v if isinstance(v, (list, tuple)) else [v]
+        tangents = [t.data for t in v]
+    out, tang = jax.jvp(_wrap_fn(func), tuple(arrays), tuple(tangents))
+    return _wrap_out(out), _wrap_out(tang)
+
+
+def vjp(func, xs, v=None):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [x.data for x in xs]
+    out, vjp_fn = jax.vjp(_wrap_fn(func), *arrays)
+    if v is None:
+        import jax.numpy as jnp
+        cot = jnp.ones_like(out) if not isinstance(out, tuple) else tuple(
+            jnp.ones_like(o) for o in out)
+    else:
+        cot = v.data if isinstance(v, Tensor) else tuple(t.data for t in v)
+    grads = vjp_fn(cot)
+    return _wrap_out(out), [Tensor(g) for g in grads]
+
+
+def Jacobian(func, xs, is_batched=False):
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [x.data for x in xs_list]
+    jac = jax.jacfwd(_wrap_fn(func), argnums=tuple(range(len(arrays))))(*arrays)
+    return _wrap_out(jac)
+
+
+def Hessian(func, xs, is_batched=False):
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [x.data for x in xs_list]
+    h = jax.hessian(_wrap_fn(func))(*arrays)
+    return _wrap_out(h)
+
+
+def _wrap_out(o):
+    if isinstance(o, (list, tuple)):
+        return type(o)(_wrap_out(x) for x in o)
+    if hasattr(o, "shape"):
+        return Tensor(o)
+    return o

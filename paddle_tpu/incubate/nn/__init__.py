@@ -1,0 +1,8 @@
+"""incubate.nn fused layers (ref: python/paddle/incubate/nn/layer/
+fused_transformer.py — FusedMultiHeadAttention:192, FusedFeedForward:497,
+FusedMultiTransformer:1021). Implemented over the fused attention/decoder
+dispatch; Pallas kernels take over on TPU."""
+from .layer.fused_transformer import (FusedMultiHeadAttention,
+                                      FusedFeedForward,
+                                      FusedTransformerEncoderLayer,
+                                      FusedMultiTransformer)

@@ -1,0 +1,164 @@
+"""paddle.jit analog.
+
+The reference compiles dygraph to a static Program via 25+ AST transformers
+(ref: python/paddle/jit/api.py:221 to_static, jit/dy2static/). The TPU-native
+equivalent is trace-and-compile: run the Python once to discover which
+Parameters/buffers the function touches (capture pass), then jax.jit a pure
+version with those captures threaded as inputs. XLA is the static executor
+(SURVEY §7: "InterpreterCore -> XLA is the executor").
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..autograd import tape
+from ..framework import random as rnd
+from ..tensor.tensor import Tensor
+
+# capture stack consulted by ops.apply
+_capture_stack = []
+
+
+def _record_capture(t):
+    if _capture_stack:
+        _capture_stack[-1][id(t)] = t
+
+
+class TracedFunction:
+    """Compiled wrapper around a Python function over Tensors."""
+
+    def __init__(self, fn, donate_captures=False, static_argnames=None):
+        self._fn = fn
+        self._cache = {}  # signature -> (jitted, captured list)
+
+    def __call__(self, *args, **kwargs):
+        flat_in, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        arrays = [x.data if isinstance(x, Tensor) else x for x in flat_in]
+        is_tensor = [isinstance(x, Tensor) for x in flat_in]
+        sig = (treedef, tuple(
+            (tuple(a.shape), str(jnp.result_type(a))) if hasattr(a, "shape")
+            else ("static", repr(a)) for a in arrays))
+        if sig not in self._cache:
+            self._cache[sig] = self._trace(treedef, flat_in)
+        jitted, captured, out_tree = self._cache[sig]
+        cap_arrays = [t.data for t in captured]
+        dyn = [a for a, it in zip(arrays, is_tensor) if it]
+        out_flat = jitted(cap_arrays, dyn, rnd.next_key())
+        outs = jax.tree_util.tree_unflatten(out_tree, [
+            Tensor(o) if hasattr(o, "shape") else o for o in out_flat])
+        return outs
+
+    def _trace(self, treedef, flat_in):
+        # Pass 1: eager run, recording captured Tensors (params/buffers).
+        captures = {}
+        _capture_stack.append(captures)
+        try:
+            args, kwargs = jax.tree_util.tree_unflatten(treedef, flat_in)
+            with tape.no_grad():
+                _ = self._fn(*args, **kwargs)
+        finally:
+            _capture_stack.pop()
+        captured = [t for t in captures.values()
+                    if not any(t is x for x in flat_in)]
+
+        is_tensor = [isinstance(x, Tensor) for x in flat_in]
+        out_tree_box = [None]
+
+        def pure(cap_arrays, dyn_arrays, key):
+            # swap captured tensor data for tracers
+            saved = [t.data for t in captured]
+            for t, a in zip(captured, cap_arrays):
+                t.data = a
+            new_flat = []
+            di = 0
+            for x, it in zip(flat_in, is_tensor):
+                if it:
+                    new_flat.append(Tensor(dyn_arrays[di],
+                                           stop_gradient=x.stop_gradient))
+                    di += 1
+                else:
+                    new_flat.append(x)
+            try:
+                a2, k2 = jax.tree_util.tree_unflatten(treedef, new_flat)
+                with tape.no_grad(), rnd.key_scope(key):
+                    out = self._fn(*a2, **k2)
+            finally:
+                for t, s in zip(captured, saved):
+                    t.data = s
+            out_flat, out_tree = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            out_tree_box[0] = out_tree
+            return [o.data if isinstance(o, Tensor) else o for o in out_flat]
+
+        jitted = jax.jit(pure)
+        # warm the out_tree by abstract eval-free first call happening lazily;
+        # trace now to fill out_tree deterministically
+        dyn = [x.data for x, it in zip(flat_in, is_tensor) if it]
+        _ = jax.eval_shape(pure, [t.data for t in captured], dyn,
+                           jax.random.key(0))
+        return jitted, captured, out_tree_box[0]
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """ref: python/paddle/jit/api.py:221."""
+    from ..nn import Layer
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            traced = TracedFunction(lambda *a, **k: layer.forward(*a, **k))
+            layer._traced_forward = traced
+
+            def fwd(*a, **k):
+                if layer.training:
+                    return layer.forward(*a, **k)
+                return traced(*a, **k)
+
+            layer.forward = fwd
+            return layer
+        return functools.wraps(fn)(TracedFunction(fn))
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def save(layer, path, input_spec=None, **configs):
+    """ref: jit/api.py jit.save — persists state_dict + structure note."""
+    from ..framework.io import save as _save
+    from ..nn import Layer
+    if isinstance(layer, Layer):
+        _save({"state_dict": layer.state_dict(),
+               "class": type(layer).__name__}, path + ".pdparams")
+    else:
+        raise TypeError("jit.save expects a Layer")
+
+
+def load(path, **configs):
+    from ..framework.io import load as _load
+    return _load(path + ".pdparams")
+
+
+class InputSpec:
+    """ref: paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(jnp.result_type(tensor.data)), name)
+
+
+def not_to_static(fn):
+    return fn
+
+
+def ignore_module(modules):
+    pass

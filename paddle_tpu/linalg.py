@@ -1,0 +1,6 @@
+"""paddle.linalg namespace (ref: python/paddle/linalg.py)."""
+from .tensor.linalg import (norm, dist, cross, matrix_power, inverse, pinv,
+                            det, slogdet, solve, triangular_solve, cholesky,
+                            cholesky_solve, qr, svd, eig, eigh, eigvals,
+                            eigvalsh, matrix_rank, lu, corrcoef, cov)
+from .tensor.math import matmul

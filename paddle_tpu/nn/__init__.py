@@ -1,0 +1,43 @@
+"""paddle.nn analog (ref: python/paddle/nn/__init__.py)."""
+from .layer.layers import Layer, Parameter
+from .layer.container import Sequential, LayerList, ParameterList, LayerDict
+from .layer.common import (Linear, Dropout, Dropout2D, Dropout3D, AlphaDropout,
+                           Embedding, Flatten, Upsample, UpsamplingBilinear2D,
+                           UpsamplingNearest2D, Pad1D, Pad2D, Pad3D, ZeroPad2D,
+                           CosineSimilarity, PixelShuffle, Bilinear, Identity)
+from .layer.conv import (Conv1D, Conv2D, Conv3D, Conv1DTranspose,
+                         Conv2DTranspose, Conv3DTranspose)
+from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+                         SyncBatchNorm, LayerNorm, RMSNorm, InstanceNorm1D,
+                         InstanceNorm2D, InstanceNorm3D, GroupNorm,
+                         LocalResponseNorm, SpectralNorm)
+from .layer.pooling import (MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D,
+                            AvgPool2D, AvgPool3D, AdaptiveAvgPool1D,
+                            AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+                            AdaptiveMaxPool1D, AdaptiveMaxPool2D,
+                            AdaptiveMaxPool3D)
+from .layer.activation import (ReLU, ReLU6, LeakyReLU, ELU, SELU, CELU, GELU,
+                               Silu, Swish, Hardswish, Hardsigmoid, Hardtanh,
+                               Hardshrink, Softshrink, Tanhshrink,
+                               ThresholdedReLU, Sigmoid, LogSigmoid, Tanh,
+                               Mish, Softplus, Softsign, Maxout, Softmax,
+                               LogSoftmax, GLU, RReLU, PReLU)
+from .layer.loss import (CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss,
+                         NLLLoss, BCELoss, BCEWithLogitsLoss, KLDivLoss,
+                         MarginRankingLoss)
+from .layer.transformer import (MultiHeadAttention, TransformerEncoderLayer,
+                                TransformerEncoder, TransformerDecoderLayer,
+                                TransformerDecoder, Transformer)
+from .layer.rnn import (SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
+                        SimpleRNN, LSTM, GRU)
+from .param_attr import ParamAttr
+from . import functional
+from . import initializer
+from . import utils
+
+ClipGradByGlobalNorm = None  # set below to avoid circular import
+ClipGradByNorm = None
+ClipGradByValue = None
+
+from ..optimizer.clip import (ClipGradByGlobalNorm, ClipGradByNorm,
+                              ClipGradByValue)

@@ -1,0 +1,28 @@
+"""paddle.nn.functional (ref: python/paddle/nn/functional/__init__.py)."""
+from .activation import (relu, relu_, relu6, leaky_relu, prelu, rrelu, elu,
+                         selu, celu, gelu, silu, swish, hardswish, hardsigmoid,
+                         hardtanh, hardshrink, softshrink, tanhshrink,
+                         thresholded_relu, sigmoid, logsigmoid, log_sigmoid,
+                         tanh, mish, softplus, softsign, maxout, softmax,
+                         softmax_, log_softmax, gumbel_softmax, glu)
+from .common import (linear, dropout, dropout2d, dropout3d, alpha_dropout,
+                     embedding, one_hot, label_smooth, pad, interpolate,
+                     upsample, unfold, fold, cosine_similarity, pixel_shuffle,
+                     pixel_unshuffle, channel_shuffle, bilinear, normalize,
+                     zeropad2d)
+from .conv import (conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
+                   conv3d_transpose)
+from .pooling import (max_pool1d, max_pool2d, max_pool3d, avg_pool1d,
+                      avg_pool2d, avg_pool3d, adaptive_avg_pool1d,
+                      adaptive_avg_pool2d, adaptive_avg_pool3d,
+                      adaptive_max_pool1d, adaptive_max_pool2d,
+                      adaptive_max_pool3d)
+from .norm import (layer_norm, rms_norm, batch_norm, instance_norm, group_norm,
+                   local_response_norm)
+from .loss import (cross_entropy, softmax_with_cross_entropy, nll_loss,
+                   mse_loss, l1_loss, smooth_l1_loss, binary_cross_entropy,
+                   binary_cross_entropy_with_logits, kl_div,
+                   margin_ranking_loss, hinge_embedding_loss,
+                   cosine_embedding_loss, triplet_margin_loss, ctc_loss,
+                   square_error_cost, sigmoid_focal_loss)
+from .attention import scaled_dot_product_attention, flash_attention

@@ -1,0 +1,192 @@
+"""Activation functionals (ref: python/paddle/nn/functional/activation.py)."""
+import jax
+import jax.numpy as jnp
+
+from ...ops import apply
+from ...tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def relu(x, name=None):
+    return apply(jax.nn.relu, _t(x), name="relu")
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x.data, x._node, x.stop_gradient = out.data, out._node, out.stop_gradient
+    return x
+
+
+def relu6(x, name=None):
+    return apply(jax.nn.relu6, _t(x), name="relu6")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope), _t(x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(a, w):
+        if w.size == 1:
+            ww = w.reshape(())
+        else:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+            shape[ch_axis] = w.size
+            ww = w.reshape(shape)
+        return jnp.where(a >= 0, a, ww * a)
+    return apply(fn, _t(x), weight, name="prelu")
+
+
+def rrelu(x, lower=1. / 8., upper=1. / 3., training=False, name=None):
+    if training:
+        from ...framework import random as rnd
+        def fn(a):
+            alpha = jax.random.uniform(rnd.next_key(), a.shape, a.dtype,
+                                       lower, upper)
+            return jnp.where(a >= 0, a, alpha * a)
+        return apply(fn, _t(x))
+    mid = (lower + upper) / 2.0
+    return apply(lambda a: jnp.where(a >= 0, a, mid * a), _t(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.elu(a, alpha), _t(x))
+
+
+def selu(x, scale=1.0507009873554804934193349852946,
+         alpha=1.6732632423543772848170429916717, name=None):
+    return apply(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), _t(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.celu(a, alpha), _t(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda a: jax.nn.gelu(a, approximate=approximate), _t(x),
+                 name="gelu")
+
+
+def silu(x, name=None):
+    return apply(jax.nn.silu, _t(x), name="silu")
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def hardswish(x, name=None):
+    return apply(jax.nn.hard_swish, _t(x))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), _t(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda a: jnp.clip(a, min, max), _t(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), _t(x))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.sign(a) * jnp.maximum(jnp.abs(a) - threshold, 0.0),
+                 _t(x))
+
+
+def tanhshrink(x, name=None):
+    return apply(lambda a: a - jnp.tanh(a), _t(x))
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply(lambda a: jnp.where(a > threshold, a, 0.0), _t(x))
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, _t(x), name="sigmoid")
+
+
+def logsigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, _t(x))
+
+
+log_sigmoid = logsigmoid
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, _t(x), name="tanh")
+
+
+def mish(x, name=None):
+    return apply(lambda a: a * jnp.tanh(jax.nn.softplus(a)), _t(x))
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return apply(
+        lambda a: jnp.where(beta * a > threshold, a,
+                            (1.0 / beta) * jnp.log1p(jnp.exp(beta * a))), _t(x))
+
+
+def softsign(x, name=None):
+    return apply(jax.nn.soft_sign, _t(x))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return apply(fn, _t(x))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+    def fn(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.softmax(a, axis=axis)
+    return apply(fn, _t(x), name="softmax")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis, dtype)
+    x.data, x._node, x.stop_gradient = out.data, out._node, out.stop_gradient
+    return x
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+    def fn(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply(fn, _t(x), name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import random as rnd
+    def fn(a):
+        g = jax.random.gumbel(rnd.next_key(), a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y)
+            onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis,
+                                        inplace=False)
+            # straight-through estimator: forward=onehot, backward=soft
+            y = jax.lax.stop_gradient(onehot - y) + y
+        return y
+    return apply(fn, _t(x))
+
+
+def glu(x, axis=-1, name=None):
+    return apply(lambda a: jax.nn.glu(a, axis=axis), _t(x))

@@ -1,0 +1,56 @@
+"""Attention functionals.
+
+scaled_dot_product_attention dispatches through the kernel registry: XLA
+default here, Pallas flash-attention on TPU (ops/pallas/flash_attention.py).
+(ref analog: paddle/fluid/operators/fused/fmha_ref.h and
+ fused_multi_transformer_op.cu.h attention core.)
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import apply, dispatch, register_kernel
+from ...tensor.tensor import Tensor
+
+
+@register_kernel("sdpa", "xla")
+def _sdpa_xla(q, k, v, *rest, causal=False, scale=None, dropout_p=0.0):
+    # q,k,v: [batch, seq, heads, head_dim] (paddle layout)
+    mask = rest[0] if rest else None
+    hd = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(hd)
+    qT = jnp.swapaxes(q, 1, 2)  # b h s d
+    kT = jnp.swapaxes(k, 1, 2)
+    vT = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qT, kT) * s
+    if causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((ql, kl), bool), kl - ql)
+        logits = jnp.where(cm, logits, jnp.asarray(-1e9, logits.dtype))
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.asarray(-1e9, logits.dtype))
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vT)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """Inputs [batch, seq, num_heads, head_dim] (paddle convention)."""
+    args = [query, key, value]
+    if attn_mask is not None:
+        args.append(attn_mask)
+    return dispatch("sdpa", *args, causal=is_causal, dropout_p=dropout_p)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, name=None):
+    out = scaled_dot_product_attention(query, key, value, None, dropout, causal)
+    if return_softmax:
+        return out, None
+    return out, None

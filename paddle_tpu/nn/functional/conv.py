@@ -1,0 +1,155 @@
+"""Convolution functionals (ref: python/paddle/nn/functional/conv.py).
+
+Lowered to jax.lax.conv_general_dilated — XLA maps these onto the MXU.
+Weight layout follows the reference: [out_c, in_c/groups, *spatial].
+"""
+import jax
+import jax.numpy as jnp
+
+from ...ops import apply
+from ...tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(i) for i in v)
+        return tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding(padding, n, strides, dilations, ksize):
+    """Returns lax padding spec: 'SAME', 'VALID', or [(lo,hi)]*n."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    pads = [int(p) for p in padding]
+    if len(pads) == n:
+        return [(p, p) for p in pads]
+    if len(pads) == 2 * n:
+        return [(pads[2 * i], pads[2 * i + 1]) for i in range(n)]
+    return [(pads[0], pads[0])] * n
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, nd, data_format,
+          name=""):
+    strides = _tuple(stride, nd)
+    dilations = _tuple(dilation, nd)
+    channel_last = data_format in ("NHWC", "NLC", "NWC", "NDHWC")
+    spat = "".join("DHW"[3 - nd:][i] for i in range(nd))
+    if channel_last:
+        dn_in = "N" + spat + "C"
+    else:
+        dn_in = "NC" + spat
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape),
+        tuple(weight.shape),
+        (dn_in, "OI" + spat, dn_in),
+    )
+    pad_spec = _padding(padding, nd, strides, dilations, weight.shape[2:])
+
+    def fn(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad_spec,
+            rhs_dilation=dilations, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None,
+        )
+        if b:
+            bias_shape = [1] * out.ndim
+            c_axis = out.ndim - 1 if channel_last else 1
+            bias_shape[c_axis] = b[0].shape[0]
+            out = out + b[0].reshape(bias_shape)
+        return out
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply(fn, *args, name=name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(_t(x), weight, bias, stride, padding, dilation, groups, 1,
+                 data_format, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(_t(x), weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(_t(x), weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, nd, data_format, output_size=None):
+    strides = _tuple(stride, nd)
+    dilations = _tuple(dilation, nd)
+    channel_last = data_format in ("NHWC", "NLC", "NWC", "NDHWC")
+    spat = "".join("DHW"[3 - nd:][i] for i in range(nd))
+    dn_in = ("N" + spat + "C") if channel_last else ("NC" + spat)
+    # reference weight layout for transpose conv: [in_c, out_c/groups, *spatial]
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (dn_in, "IO" + spat, dn_in))
+    if isinstance(padding, str):
+        pad_spec = padding.upper()
+    else:
+        pads = _padding(padding, nd, strides, dilations, weight.shape[2:])
+        pad_spec = pads
+
+    opad = _tuple(output_padding, nd) if output_padding else (0,) * nd
+
+    def fn(a, w, *b):
+        if isinstance(pad_spec, str):
+            lax_pad = pad_spec
+        else:
+            # lax.conv_transpose padding semantics: amount of padding applied
+            # to the *output* of the equivalent forward conv; convert.
+            lax_pad = []
+            for i, (lo, hi) in enumerate(pad_spec):
+                k = (w.shape[2 + i] - 1) * dilations[i] + 1
+                lax_pad.append((k - 1 - lo, k - 1 - hi + opad[i]))
+        out = jax.lax.conv_transpose(
+            a, w, strides=strides, padding=lax_pad, rhs_dilation=dilations,
+            dimension_numbers=dn, transpose_kernel=False,
+        )
+        if groups > 1:
+            raise NotImplementedError("grouped conv_transpose: use groups=1")
+        if b:
+            bias_shape = [1] * out.ndim
+            c_axis = out.ndim - 1 if channel_last else 1
+            bias_shape[c_axis] = b[0].shape[0]
+            out = out + b[0].reshape(bias_shape)
+        return out
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply(fn, *args, name="conv_transpose")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose(_t(x), weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, data_format, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(_t(x), weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(_t(x), weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size)

@@ -1,0 +1,230 @@
+"""Loss functionals (ref: python/paddle/nn/functional/loss.py)."""
+import jax
+import jax.numpy as jnp
+
+from ...ops import apply
+from ...tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _reduce(val, reduction, weight_sum=None):
+    if reduction == "mean":
+        if weight_sum is not None:
+            return jnp.sum(val) / weight_sum
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """ref: nn/functional/loss.py cross_entropy."""
+    lab = label.data if isinstance(label, Tensor) else jnp.asarray(label)
+
+    def fn(logits, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-20, None))
+        if soft_label:
+            tgt = lab
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / k
+            loss = -jnp.sum(tgt * logp, axis=axis)
+            valid = None
+        else:
+            ids = lab
+            if ids.ndim == logp.ndim:
+                ids = jnp.squeeze(ids, axis)
+            ids_ = jnp.expand_dims(ids, axis)
+            picked = jnp.take_along_axis(
+                logp, jnp.clip(ids_, 0, logp.shape[axis] - 1).astype(jnp.int32),
+                axis=axis)
+            loss = -jnp.squeeze(picked, axis)
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                smooth = -jnp.mean(logp, axis=axis)
+                loss = (1 - label_smoothing) * loss + label_smoothing * smooth
+            valid = (ids != ignore_index)
+            loss = jnp.where(valid, loss, 0.0)
+            if w:
+                wt = jnp.take(w[0], jnp.clip(ids, 0, w[0].shape[0] - 1))
+                wt = jnp.where(valid, wt, 0.0)
+                loss = loss * wt
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+        if reduction == "mean" and not soft_label:
+            denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    args = [_t(input)] + ([weight] if weight is not None else [])
+    return apply(fn, *args, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = loss.unsqueeze(axis) if loss.ndim < _t(logits).ndim else loss
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    lab = label.data if isinstance(label, Tensor) else jnp.asarray(label)
+
+    def fn(logp, *w):
+        ids = jnp.expand_dims(lab, 1)
+        picked = jnp.take_along_axis(logp, ids.astype(jnp.int32), axis=1)
+        loss = -jnp.squeeze(picked, 1)
+        valid = lab != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if w:
+            wt = jnp.take(w[0], lab)
+            loss = loss * jnp.where(valid, wt, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.sum(jnp.where(valid, wt, 0.0))
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
+        return _reduce(loss, reduction)
+
+    args = [_t(input)] + ([weight] if weight is not None else [])
+    return apply(fn, *args, name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                 _t(input), _t(label), name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                 _t(input), _t(label), name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return apply(fn, _t(input), _t(label))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, t, *w):
+        p_ = jnp.clip(p, 1e-12, 1 - 1e-12)
+        loss = -(t * jnp.log(p_) + (1 - t) * jnp.log1p(-p_))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = [_t(input), _t(label)] + ([weight] if weight is not None else [])
+    return apply(fn, *args, name="bce")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def fn(z, t, *extras):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extras[i]; i += 1
+        if pos_weight is not None:
+            pw = extras[i]; i += 1
+        softplus_neg = jnp.maximum(-z, 0) + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            log_w = (pw - 1) * t + 1
+            loss = (1 - t) * z + log_w * softplus_neg
+        else:
+            loss = jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [_t(logit), _t(label)]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return apply(fn, *args, name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def fn(logp, t):
+        loss = t * (jnp.log(jnp.clip(t, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply(fn, _t(input), _t(label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def fn(a, b, t):
+        return _reduce(jnp.maximum(0.0, -t * (a - b) + margin), reduction)
+    return apply(fn, _t(input), _t(other), _t(label))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def fn(a, t):
+        loss = jnp.where(t == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return apply(fn, _t(input), _t(label))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    def fn(a, b, t):
+        cos = jnp.sum(a * b, -1) / (
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12)
+        loss = jnp.where(t == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply(fn, _t(input1), _t(input2), _t(label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return apply(fn, _t(input), _t(positive), _t(negative))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    raise NotImplementedError("ctc_loss: planned (optax.ctc_loss wrapper)")
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), _t(input), _t(label))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(z, t, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * t + (1 - p) * (1 - t)
+        loss = ce * ((1 - p_t) ** gamma)
+        if alpha >= 0:
+            a_t = alpha * t + (1 - alpha) * (1 - t)
+            loss = a_t * loss
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+    args = [_t(logit), _t(label)] + ([normalizer] if normalizer is not None else [])
+    return apply(fn, *args)

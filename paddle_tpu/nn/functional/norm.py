@@ -1,0 +1,164 @@
+"""Normalization functionals (ref: python/paddle/nn/functional/norm.py).
+rms_norm dispatches through the kernel registry (Pallas on TPU)."""
+import jax
+import jax.numpy as jnp
+
+from ...ops import apply, dispatch, register_kernel
+from ...tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) \
+        else [normalized_shape]
+    axes = tuple(range(-len(ns), 0))
+
+    def fn(a, *wb):
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)
+        out = out.astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [_t(x)] + [w for w in (weight, bias) if w is not None]
+    return apply(fn, *args, name="layer_norm")
+
+
+@register_kernel("rms_norm", "xla")
+def _rms_norm_xla(x, weight, epsilon=1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    out = x.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)
+    return (out * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def rms_norm(x, weight, epsilon=1e-6, name=None):
+    """RMSNorm — the LLaMA-family norm. Pallas kernel on TPU
+    (ref analog: phi/kernels/fusion rms_norm)."""
+    return dispatch("rms_norm", _t(x), weight, epsilon=epsilon)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """ref: nn/functional/norm.py batch_norm. Running stats updated in-place
+    on the passed tensors (paddle semantics)."""
+    x = _t(x)
+    channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        batch_mean = jnp.mean(x.data.astype(jnp.float32), axis=axes)
+        batch_var = jnp.var(x.data.astype(jnp.float32), axis=axes)
+        # update running stats (stateful, like the reference's saved mean/var)
+        if running_mean is not None:
+            running_mean.data = (momentum * running_mean.data
+                                 + (1 - momentum) * batch_mean.astype(
+                                     running_mean.data.dtype))
+            running_var.data = (momentum * running_var.data
+                                + (1 - momentum) * batch_var.astype(
+                                    running_var.data.dtype))
+
+        def fn(a, *wb):
+            m = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=False)
+            v = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=False)
+            return _affine(a, m, v, wb, weight, bias, channel_axis, epsilon)
+    else:
+        rm = running_mean.data.astype(jnp.float32)
+        rv = running_var.data.astype(jnp.float32)
+
+        def fn(a, *wb):
+            return _affine(a, rm, rv, wb, weight, bias, channel_axis, epsilon)
+
+    args = [x] + [w for w in (weight, bias) if w is not None]
+    return apply(fn, *args, name="batch_norm")
+
+
+def _affine(a, mean, var, wb, weight, bias, channel_axis, epsilon):
+    shape = [1] * a.ndim
+    shape[channel_axis] = a.shape[channel_axis]
+    out = (a.astype(jnp.float32) - mean.reshape(shape)) * jax.lax.rsqrt(
+        var.reshape(shape) + epsilon)
+    out = out.astype(a.dtype)
+    i = 0
+    if weight is not None:
+        out = out * wb[i].reshape(shape)
+        i += 1
+    if bias is not None:
+        out = out + wb[i].reshape(shape)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    x = _t(x)
+    axes = tuple(range(2, x.ndim))
+
+    def fn(a, *wb):
+        m = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        v = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((a.astype(jnp.float32) - m) * jax.lax.rsqrt(v + eps)).astype(a.dtype)
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x] + [w for w in (weight, bias) if w is not None]
+    return apply(fn, *args, name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = _t(x)
+
+    def fn(a, *wb):
+        n, c = a.shape[0], a.shape[1]
+        rest = a.shape[2:]
+        g = a.reshape(n, num_groups, c // num_groups, *rest)
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g.astype(jnp.float32), axis=axes, keepdims=True)
+        v = jnp.var(g.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((g.astype(jnp.float32) - m) * jax.lax.rsqrt(v + epsilon))
+        out = out.reshape(a.shape).astype(a.dtype)
+        shape = [1, c] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x] + [w for w in (weight, bias) if w is not None]
+    return apply(fn, *args, name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def fn(a):
+        sq = jnp.square(a)
+        half = size // 2
+        c = a.shape[1]
+        pads = [(0, 0)] * a.ndim
+        pads[1] = (half, size - half - 1)
+        sq = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            acc = acc + jax.lax.slice_in_dim(sq, i, i + c, axis=1)
+        return a / jnp.power(k + alpha * acc, beta)
+    return apply(fn, _t(x))

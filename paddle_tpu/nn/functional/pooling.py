@@ -1,0 +1,165 @@
+"""Pooling functionals (ref: python/paddle/nn/functional/pooling.py).
+Lowered to lax.reduce_window."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops import apply
+from ...tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in (v if len(v) == n else [v[0]] * n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    p = [int(i) for i in padding]
+    if len(p) == n:
+        return [(i, i) for i in p]
+    if len(p) == 2 * n:
+        return [(p[2 * i], p[2 * i + 1]) for i in range(n)]
+    return [(p[0], p[0])] * n
+
+
+def _pool(x, ksize, stride, padding, nd, reducer, init, data_format,
+          ceil_mode=False, exclusive=True, count_include_pad=False):
+    ks = _tuple(ksize, nd)
+    st = _tuple(stride if stride is not None else ksize, nd)
+    channel_last = data_format in ("NHWC", "NLC", "NWC", "NDHWC")
+    if channel_last:
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+    else:
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+    pads = _pads(padding, nd)
+    if isinstance(pads, str):
+        pad_all = pads
+    else:
+        pad_all = ([(0, 0)] + pads + [(0, 0)]) if channel_last else \
+                  ([(0, 0), (0, 0)] + pads)
+
+    def fn(a):
+        if reducer == "max":
+            return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, window,
+                                         strides, pad_all)
+        # avg
+        summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides,
+                                       pad_all)
+        if isinstance(pad_all, str) or (exclusive and not count_include_pad):
+            ones = jnp.ones_like(a)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                           strides, pad_all)
+            return summed / counts
+        return summed / float(np.prod(ks))
+
+    return apply(fn, x, name=f"{reducer}_pool{nd}d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(_t(x), kernel_size, stride, padding, 1, "max", -jnp.inf,
+                 data_format, ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(_t(x), kernel_size, stride, padding, 2, "max", -jnp.inf,
+                data_format, ceil_mode)
+    if return_mask:
+        # indices within each window, flattened HW index (best-effort)
+        return out, None
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(_t(x), kernel_size, stride, padding, 3, "max", -jnp.inf,
+                 data_format, ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(_t(x), kernel_size, stride, padding, 1, "avg", 0.0,
+                 data_format, ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(_t(x), kernel_size, stride, padding, 2, "avg", 0.0,
+                 data_format, ceil_mode, exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(_t(x), kernel_size, stride, padding, 3, "avg", 0.0,
+                 data_format, ceil_mode, exclusive)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(_t(x), output_size, 1, "avg", "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(_t(x), output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(_t(x), output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(_t(x), output_size, 1, "max", "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(_t(x), output_size, 2, "max", "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(_t(x), output_size, 3, "max", "NCDHW")
+
+
+def _adaptive(x, output_size, nd, mode, data_format):
+    os_ = _tuple(output_size, nd)
+    channel_last = data_format in ("NHWC", "NLC", "NWC", "NDHWC")
+    spatial = x.shape[1:-1] if channel_last else x.shape[2:]
+    os_ = tuple(s if o is None else o for o, s in zip(os_, spatial))
+
+    # When input divides evenly, adaptive == fixed-window pool.
+    if all(s % o == 0 for s, o in zip(spatial, os_)):
+        ks = tuple(s // o for s, o in zip(spatial, os_))
+        return _pool(x, ks, ks, 0, nd, mode, 0.0, data_format)
+
+    # General case: per-output-bin segment reduce (small sizes; fine on XLA).
+    def fn(a):
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)
+        for d in range(nd):
+            s, o = a.shape[2 + d], os_[d]
+            starts = np.floor(np.arange(o) * s / o).astype(int)
+            ends = np.ceil((np.arange(o) + 1) * s / o).astype(int)
+            pieces = []
+            for st, en in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(a, st, en, axis=2 + d)
+                red = jnp.max(seg, 2 + d, keepdims=True) if mode == "max" \
+                    else jnp.mean(seg, 2 + d, keepdims=True)
+                pieces.append(red)
+            a = jnp.concatenate(pieces, axis=2 + d)
+        if channel_last:
+            a = jnp.moveaxis(a, 1, -1)
+        return a
+
+    return apply(fn, x, name="adaptive_pool")

@@ -1,0 +1,61 @@
+"""Activation layers (ref: python/paddle/nn/layer/activation.py)."""
+from .layers import Layer
+from .. import functional as F
+from .. import initializer as I
+
+
+def _mk(name, fn):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            kwargs.pop("name", None)
+            super().__init__()
+            self._args = args
+            self._kwargs = kwargs
+
+        def forward(self, x):
+            return fn(x, *self._args, **self._kwargs)
+
+    _Act.__name__ = name
+    return _Act
+
+
+ReLU = _mk("ReLU", F.relu)
+ReLU6 = _mk("ReLU6", F.relu6)
+LeakyReLU = _mk("LeakyReLU", F.leaky_relu)
+ELU = _mk("ELU", F.elu)
+SELU = _mk("SELU", F.selu)
+CELU = _mk("CELU", F.celu)
+GELU = _mk("GELU", F.gelu)
+Silu = _mk("Silu", F.silu)
+Swish = _mk("Swish", F.swish)
+Hardswish = _mk("Hardswish", F.hardswish)
+Hardsigmoid = _mk("Hardsigmoid", F.hardsigmoid)
+Hardtanh = _mk("Hardtanh", F.hardtanh)
+Hardshrink = _mk("Hardshrink", F.hardshrink)
+Softshrink = _mk("Softshrink", F.softshrink)
+Tanhshrink = _mk("Tanhshrink", F.tanhshrink)
+ThresholdedReLU = _mk("ThresholdedReLU", F.thresholded_relu)
+Sigmoid = _mk("Sigmoid", F.sigmoid)
+LogSigmoid = _mk("LogSigmoid", F.log_sigmoid)
+Tanh = _mk("Tanh", F.tanh)
+Mish = _mk("Mish", F.mish)
+Softplus = _mk("Softplus", F.softplus)
+Softsign = _mk("Softsign", F.softsign)
+Maxout = _mk("Maxout", F.maxout)
+Softmax = _mk("Softmax", F.softmax)
+LogSoftmax = _mk("LogSoftmax", F.log_softmax)
+GLU = _mk("GLU", F.glu)
+RReLU = _mk("RReLU", F.rrelu)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], weight_attr, self._dtype,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)

@@ -1,0 +1,371 @@
+"""nn.Layer base.
+
+TPU-native analog of the reference's Layer
+(ref: python/paddle/fluid/dygraph/layers.py:107 — 1924 LoC: sublayers,
+hooks, state_dict, to()). Parameters are Tensors with stop_gradient=False.
+"""
+import collections
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor
+from ...framework import dtype as dtypes
+
+_param_counter = [0]
+
+
+class Parameter(Tensor):
+    """Trainable tensor (ref: python/paddle/fluid/framework.py Parameter)."""
+
+    def __init__(self, data, trainable=True, name=None):
+        if name is None:
+            _param_counter[0] += 1
+            name = f"param_{_param_counter[0]}"
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+
+
+class HookRemoveHelper:
+    def __init__(self, container, hook_id):
+        self._container = container
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._container.pop(self._hook_id, None)
+
+
+class Layer:
+    """ref: python/paddle/fluid/dygraph/layers.py:107."""
+
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtypes.convert_dtype(dtype) if dtype else dtypes.get_default_dtype()
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._non_persistable_buffer_names_set = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._hook_id = 0
+        self._full_name = name_scope or type(self).__name__.lower()
+        self._casted_by_pure_fp16 = False
+
+    # -- construction helpers ----------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """ref: layers.py create_parameter (ParamAttr + initializer)."""
+        from .. import initializer as init
+        from ..param_attr import ParamAttr
+
+        dtype = dtypes.convert_dtype(dtype) or self._dtype
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        initfn = None
+        lr = 1.0
+        regularizer = None
+        trainable = True
+        name = None
+        if isinstance(attr, ParamAttr):
+            initfn = attr.initializer
+            lr = attr.learning_rate
+            regularizer = attr.regularizer
+            trainable = attr.trainable
+            name = attr.name
+        if initfn is None:
+            initfn = default_initializer
+        if initfn is None:
+            initfn = init.Constant(0.0) if is_bias else init.XavierUniform()
+        data = initfn(shape, dtype)
+        p = Parameter(data, trainable=trainable, name=name)
+        p.optimize_attr = {"learning_rate": lr}
+        p.regularizer = regularizer
+        return p
+
+    def add_parameter(self, name, parameter):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        """ref: layers.py register_buffer."""
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names_set.add(name)
+        elif tensor is not None:
+            tensor.persistable = True
+
+    # -- attribute magic ----------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() first")
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() first")
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            layers[name] = value
+        elif buffers is not None and name in buffers:
+            buffers[name] = value
+        elif params is not None and name in params and value is None:
+            params[name] = None
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"'{type(self).__name__}' has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        extra = []
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d:
+                extra.extend(d.keys())
+        return list(super().__dir__()) + extra
+
+    # -- traversal ----------------------------------------------------------
+    def named_members(self, get_members_fn, prefix="", include_self=True):
+        memo = set()
+        for layer_prefix, layer in self.named_sublayers(
+            prefix=prefix, include_self=include_self
+        ):
+            for k, v in get_members_fn(layer):
+                if v is None or id(v) in memo:
+                    continue
+                memo.add(id(v))
+                name = layer_prefix + ("." if layer_prefix else "") + k
+                yield name, v
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        if include_sublayers:
+            yield from self.named_members(lambda l: l._parameters.items(), prefix)
+        else:
+            for k, v in self._parameters.items():
+                if v is not None:
+                    yield k, v
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        if include_sublayers:
+            yield from self.named_members(lambda l: l._buffers.items(), prefix)
+        else:
+            for k, v in self._buffers.items():
+                if v is not None:
+                    yield k, v
+
+    def children(self):
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        memo = set()
+        for name, layer in self._sub_layers.items():
+            if layer is not None and id(layer) not in memo:
+                memo.add(id(layer))
+                yield name, layer
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = prefix + ("." if prefix else "") + name
+            yield from layer.named_sublayers(
+                prefix=sub_prefix, include_self=True, layers_set=layers_set)
+
+    def apply(self, fn):
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    def full_name(self):
+        return self._full_name
+
+    # -- train / eval -------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    # -- hooks --------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # -- call ---------------------------------------------------------------
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        """ref: layers.py state_dict — structured names, params + persistable
+        buffers."""
+        dest = collections.OrderedDict() if destination is None else destination
+        for name, p in self.named_parameters():
+            dest[structured_name_prefix + name] = p
+        for name, b in self.named_buffers():
+            leaf = name.rsplit(".", 1)[-1]
+            owner = self._locate_owner(name)
+            if owner is not None and leaf in owner._non_persistable_buffer_names_set:
+                continue
+            dest[structured_name_prefix + name] = b
+        return dest
+
+    def _locate_owner(self, dotted):
+        obj = self
+        parts = dotted.split(".")[:-1]
+        for p in parts:
+            obj = obj._sub_layers.get(p)
+            if obj is None:
+                return None
+        return obj
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """ref: layers.py set_state_dict (a.k.a. set_dict/load_dict)."""
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k not in own:
+                unexpected.append(k)
+                continue
+            target = own[k]
+            arr = v.data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            if tuple(arr.shape) != tuple(target.data.shape):
+                raise ValueError(
+                    f"shape mismatch for {k}: {arr.shape} vs {target.data.shape}")
+            target.data = arr.astype(target.data.dtype)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # -- dtype/device movement ---------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        from ...framework.place import Place, set_device
+        if device is not None:
+            place = device if isinstance(device, Place) else None
+            dev = place.jax_device if place else None
+            if dev is None:
+                import jax as _jax
+                name = str(device).lower()
+                kind = "cpu" if name.startswith("cpu") else None
+                devs = [d for d in _jax.devices()
+                        if kind is None or d.platform == kind]
+                dev = devs[0] if devs else None
+            for t in list(self.parameters()) + list(self.buffers()):
+                if dev is not None:
+                    t.data = jax.device_put(t.data, dev)
+        if dtype is not None:
+            self._to_dtype(dtype)
+        return self
+
+    def _to_dtype(self, dtype):
+        dt = dtypes.convert_dtype(dtype)
+        for t in list(self.parameters()) + list(self.buffers()):
+            if jnp.issubdtype(t.data.dtype, jnp.floating):
+                t.data = t.data.astype(dt)
+        for l in self.named_sublayers(include_self=True):
+            l[1]._dtype = dt
+        return self
+
+    def astype(self, dtype):
+        return self._to_dtype(dtype)
+
+    def float(self):
+        return self._to_dtype(jnp.float32)
+
+    def half(self):
+        return self._to_dtype(jnp.float16)
+
+    def bfloat16(self):
+        return self._to_dtype(jnp.bfloat16)
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = [f"{type(self).__name__}({extra}"]
+        for name, child in self.named_children():
+            child_repr = repr(child).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {child_repr}")
+        return "\n".join(lines) + ")" if len(lines) > 1 else lines[0] + ")"

@@ -1,0 +1,271 @@
+"""RNN layers (ref: python/paddle/nn/layer/rnn.py).
+
+Recurrence runs under lax.scan — compiler-friendly control flow on TPU
+instead of the reference's per-timestep CUDA kernels.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .layers import Layer
+from .container import LayerList
+from .. import initializer as I
+from ...ops import apply
+from ...tensor.tensor import Tensor
+
+
+class _RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...tensor.creation import full
+        b = batch_ref.shape[batch_dim_idx]
+        return full([b, self.hidden_size], init_value, dtype or "float32")
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def fn(x, h, wih, whh, bih, bhh):
+            return act(x @ wih.T + bih + h @ whh.T + bhh)
+
+        h = apply(fn, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, name="rnn_cell")
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(_RNNCellBase):
+    """ref: nn/layer/rnn.py LSTMCell — gates order i,f,g,o."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            from ...tensor.creation import zeros
+            b = inputs.shape[0]
+            states = (zeros([b, self.hidden_size]), zeros([b, self.hidden_size]))
+        h0, c0 = states
+
+        def fn(x, h, c, wih, whh, bih, bhh):
+            gates = x @ wih.T + bih + h @ whh.T + bhh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h, c = apply(fn, inputs, h0, c0, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh, n_outputs=2, name="lstm_cell")
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, h, wih, whh, bih, bhh):
+            gi = x @ wih.T + bih
+            gh = h @ whh.T + bhh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+
+        h = apply(fn, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, name="gru_cell")
+        return h, h
+
+
+class RNN(Layer):
+    """Generic scanner over a cell (ref: nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        outs = []
+        t_axis = 0 if self.time_major else 1
+        steps = inputs.shape[t_axis]
+        state = initial_states
+        rng = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        from ...tensor import manipulation as M
+        for ti in rng:
+            x_t = inputs[ti] if self.time_major else inputs[:, ti]
+            out, state = self.cell(x_t, state)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        output = M.stack(outs, axis=t_axis)
+        return output, state
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        cell_cls = {"LSTM": LSTMCell, "GRU": GRUCell,
+                    "RNN_TANH": SimpleRNNCell, "RNN_RELU": SimpleRNNCell}[mode]
+        self._cells = LayerList()
+        for layer in range(num_layers):
+            for d in range(self.bidirect):
+                in_sz = input_size if layer == 0 else hidden_size * self.bidirect
+                self._cells.append(cell_cls(in_sz, hidden_size))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor import manipulation as M
+        x = inputs
+        final_h, final_c = [], []
+        for layer in range(self.num_layers):
+            outs_dir = []
+            for d in range(self.bidirect):
+                cell = self._cells[layer * self.bidirect + d]
+                runner = RNN(cell, is_reverse=(d == 1),
+                             time_major=self.time_major)
+                init = None
+                if initial_states is not None:
+                    if self.mode == "LSTM":
+                        h0, c0 = initial_states
+                        idx = layer * self.bidirect + d
+                        init = (h0[idx], c0[idx])
+                    else:
+                        init = initial_states[layer * self.bidirect + d]
+                out, st = runner(x, init)
+                outs_dir.append(out)
+                if self.mode == "LSTM":
+                    final_h.append(st[0])
+                    final_c.append(st[1])
+                else:
+                    final_h.append(st)
+            x = outs_dir[0] if len(outs_dir) == 1 else M.concat(outs_dir, axis=-1)
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                from .. import functional as F
+                x = F.dropout(x, self.dropout, training=self.training)
+        h = M.stack(final_h, axis=0)
+        if self.mode == "LSTM":
+            c = M.stack(final_c, axis=0)
+            return x, (h, c)
+        return x, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor import manipulation as M
+        states_fw, states_bw = (initial_states or (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        return M.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

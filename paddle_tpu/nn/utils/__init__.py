@@ -1,0 +1,26 @@
+"""nn.utils (ref: python/paddle/nn/utils/)."""
+import numpy as np
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...tensor.manipulation import concat, reshape
+    return concat([reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p.set_value(Tensor(vec.data[offset:offset + n].reshape(p.data.shape)))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    return layer

@@ -1,0 +1,169 @@
+"""Op dispatch.
+
+TPU-native analog of the reference's Phi kernel registry/factory
+(ref: paddle/phi/core/kernel_factory.h:63 KernelKey, :314 KernelFactory,
+ paddle/phi/core/kernel_registry.h PD_REGISTER_KERNEL).
+
+Every eager op funnels through `apply(fn, *tensors)` — the single dispatch
+chokepoint (the analog of the two dispatch funnels noted in SURVEY §1). `fn`
+is a pure jax function; when autograd is live we capture its vjp via
+`jax.vjp` (replacing the reference's codegen'd GradNodes). The kernel
+registry lets named ops be overridden per backend (e.g. a Pallas kernel on
+TPU replacing the XLA-lowered default).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..autograd import tape
+from ..tensor.tensor import Tensor
+
+# name -> {backend: fn}; backend in {"xla", "pallas"}; "xla" is default.
+_KERNELS = {}
+_pallas_enabled = [True]
+
+
+def register_kernel(name, backend="xla"):
+    """Analog of PD_REGISTER_KERNEL (ref: phi/core/kernel_registry.h)."""
+
+    def deco(fn):
+        _KERNELS.setdefault(name, {})[backend] = fn
+        return fn
+
+    return deco
+
+
+def enable_pallas(flag=True):
+    _pallas_enabled[0] = bool(flag)
+
+
+def select_kernel(name):
+    """Analog of KernelFactory::SelectKernelOrThrowError
+    (ref: phi/core/kernel_factory.h:324)."""
+    impls = _KERNELS.get(name)
+    if impls is None:
+        raise KeyError(f"No kernel registered for op '{name}'")
+    if (
+        _pallas_enabled[0]
+        and "pallas" in impls
+        and jax.default_backend() not in ("cpu",)
+    ):
+        return impls["pallas"]
+    return impls["xla"]
+
+
+def _is_inexact(x):
+    d = jnp.result_type(x)
+    return jnp.issubdtype(d, jnp.inexact)
+
+
+def apply(fn, *inputs, n_outputs=1, name="", **kwargs):
+    """Run a pure jax function over Tensors, recording autograd if needed.
+
+    Non-Tensor inputs are passed through as static arguments via closure
+    (callers bake them into `fn` or kwargs). Integer/bool outputs are marked
+    stop_gradient.
+    """
+    tensors = []
+    raws = []
+    for x in inputs:
+        if isinstance(x, Tensor):
+            tensors.append(x)
+            raws.append(x.data)
+        else:
+            tensors.append(None)
+            raws.append(jnp.asarray(x))
+
+    # jit capture pass (see jit/__init__.py): record touched Tensors.
+    from ..jit import _capture_stack
+    if _capture_stack:
+        caps = _capture_stack[-1]
+        for t in tensors:
+            if t is not None:
+                caps[id(t)] = t
+
+    needs_grad = tape.is_grad_enabled() and any(
+        t is not None and not t.stop_gradient for t in tensors
+    )
+
+    if kwargs:
+        call = lambda *a: fn(*a, **kwargs)
+    else:
+        call = fn
+
+    if not needs_grad:
+        out = call(*raws)
+        return _wrap_outputs(out, n_outputs, stop_gradient=True)
+
+    # Differentiate only w.r.t. inexact inputs (jax.vjp rejects int primals
+    # having cotangents anyway; we pass all and drop int cotangents).
+    out, vjp_fn = jax.vjp(call, *raws)
+
+    flat_out = out if isinstance(out, (tuple, list)) else (out,)
+    shapes = [o.shape for o in flat_out]
+    odtypes = [o.dtype for o in flat_out]
+    node = tape.record(
+        _VjpAdapter(vjp_fn, [t is not None and not t.stop_gradient for t in tensors]),
+        tensors,
+        len(flat_out),
+        shapes,
+        odtypes,
+        name=name,
+    )
+    return _wrap_outputs(out, n_outputs, stop_gradient=False, node=node)
+
+
+class _VjpAdapter:
+    """Wraps a jax vjp_fn; zeros non-float cotangents so int outputs work."""
+
+    __slots__ = ("vjp_fn", "wanted")
+
+    def __init__(self, vjp_fn, wanted):
+        self.vjp_fn = vjp_fn
+        self.wanted = wanted
+
+    def __call__(self, cotangents):
+        cts = self.vjp_fn(_sanitize(cotangents))
+        return [c if w else None for c, w in zip(cts, self.wanted)]
+
+
+def _sanitize(ct):
+    if isinstance(ct, tuple):
+        return tuple(_sanitize(c) for c in ct)
+    if not jnp.issubdtype(ct.dtype, jnp.inexact):
+        return ct
+    return ct
+
+
+def _wrap_outputs(out, n_outputs, stop_gradient, node=None):
+    single = not isinstance(out, (tuple, list))
+    flat = (out,) if single else tuple(out)
+    results = []
+    for i, o in enumerate(flat):
+        sg = stop_gradient or not jnp.issubdtype(jnp.result_type(o), jnp.inexact)
+        t = Tensor(o, stop_gradient=sg)
+        if node is not None and not sg:
+            t._node = (node, i)
+        results.append(t)
+    return results[0] if single else tuple(results)
+
+
+def dispatch(name, *inputs, n_outputs=1, **kwargs):
+    """Named-op dispatch through the registry (Pallas-overridable).
+
+    AMP autocast happens here — the analog of the reference's autocast hook
+    in generated ad_funcs (ref: paddle/fluid/eager/amp_auto_cast.h).
+    """
+    from ..amp import should_cast_op
+
+    fn = select_kernel(name)
+    tgt = should_cast_op(name)
+    if tgt is not None:
+        cast_inputs = []
+        for x in inputs:
+            if isinstance(x, Tensor) and jnp.issubdtype(x.dtype, jnp.floating):
+                if x.dtype != tgt:
+                    from ..tensor.manipulation import cast as _cast
+                    x = _cast(x, tgt)
+            cast_inputs.append(x)
+        inputs = cast_inputs
+    return apply(fn, *inputs, n_outputs=n_outputs, name=name, **kwargs)

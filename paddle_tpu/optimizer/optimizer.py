@@ -1,0 +1,209 @@
+"""Optimizer base (ref: python/paddle/optimizer/optimizer.py:91).
+
+Each optimizer expresses its math as a pure per-parameter update rule
+`_rule(p, g, state, lr, t) -> (new_p, new_state)` so the same code serves
+both the eager `step()` path and the functional jit-compiled distributed
+step (fleet wrappers call `apply_gradients_fn`).
+"""
+import collections
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from .lr import LRScheduler
+from .clip import ClipGradBase
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, grad, param):
+        return grad + self.coeff * param
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, grad, param):
+        return grad + self.coeff * jnp.sign(param)
+
+
+class Optimizer:
+    _multi_precision_supported = True
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._accumulators = collections.defaultdict(dict)
+        self._master_weights = {}
+        self._step_count = 0
+        self._name = name
+        # weight_decay: float => L2 regularizer added to grad (paddle
+        # semantics for SGD/Momentum/Adam); AdamW overrides with decoupled.
+        if isinstance(weight_decay, (int, float)):
+            self._regularization = L2Decay(float(weight_decay))
+        else:
+            self._regularization = weight_decay
+        self._param_groups = self._parameter_list
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("can't set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- state ---------------------------------------------------------------
+    def _ensure_state(self, p):
+        key = p.name or str(id(p))
+        if key not in self._accumulators["__state__"]:
+            self._accumulators["__state__"][key] = self._create_state(p)
+        if (self._multi_precision
+                and p.data.dtype in (jnp.float16, jnp.bfloat16)
+                and key not in self._master_weights):
+            self._master_weights[key] = p.data.astype(jnp.float32)
+        return self._accumulators["__state__"][key]
+
+    def _create_state(self, p):
+        return {}
+
+    def _rule(self, p, g, state, lr, t):
+        raise NotImplementedError
+
+    # -- the eager step ------------------------------------------------------
+    @property
+    def _params(self):
+        if self._parameter_list is None:
+            raise ValueError("optimizer constructed without parameters")
+        return self._parameter_list
+
+    def step(self):
+        params_grads = [(p, p.grad) for p in self._params
+                        if not p.stop_gradient and p.grad is not None]
+        self._apply_optimize(params_grads)
+
+    def _apply_optimize(self, params_grads):
+        self._step_count += 1
+        t = self._step_count
+        lr = self.get_lr()
+        # per-param regularization (paddle: param.regularizer wins over
+        # optimizer-level regularization)
+        reg_pg = []
+        for p, g in params_grads:
+            reg = p.regularizer if p.regularizer is not None else self._regularization
+            if reg is not None and not isinstance(reg, str):
+                g = Tensor(reg(g.data, self._master_or_param(p)),
+                           stop_gradient=True)
+            reg_pg.append((p, g))
+        if self._grad_clip is not None:
+            reg_pg = self._grad_clip(reg_pg)
+        for p, g in reg_pg:
+            state = self._ensure_state(p)
+            key = p.name or str(id(p))
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            pw = self._master_or_param(p)
+            new_p, new_state = self._rule(pw, g.data.astype(pw.dtype), state,
+                                          plr, t)
+            if key in self._master_weights:
+                self._master_weights[key] = new_p
+                p.data = new_p.astype(p.data.dtype)
+            else:
+                p.data = new_p
+            self._accumulators["__state__"][key] = new_state
+
+    def _master_or_param(self, p):
+        key = p.name or str(id(p))
+        if (self._multi_precision
+                and p.data.dtype in (jnp.float16, jnp.bfloat16)):
+            if key not in self._master_weights:
+                self._master_weights[key] = p.data.astype(jnp.float32)
+            return self._master_weights[key]
+        return p.data
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._params:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # -- functional interface (used by jit-compiled distributed steps) ------
+    def init_state_pytree(self, params_pytree):
+        return jax.tree_util.tree_map(
+            lambda a: self._create_state(_FakeParam(a)), params_pytree,
+            is_leaf=lambda x: hasattr(x, "shape") and hasattr(x, "dtype"))
+
+    def apply_gradients_fn(self):
+        """Returns pure fn(params, grads, state, lr, t) -> (params, state)."""
+        rule = self._rule
+
+        def apply_fn(params, grads, state, lr, t):
+            flat_p, treedef = jax.tree_util.tree_flatten(params)
+            flat_g = jax.tree_util.tree_leaves(grads)
+            flat_s = treedef.flatten_up_to(state)
+            new_p, new_s = [], []
+            for p, g, s in zip(flat_p, flat_g, flat_s):
+                np_, ns_ = rule(p, g.astype(p.dtype), s, lr, t)
+                new_p.append(np_)
+                new_s.append(ns_)
+            return (jax.tree_util.tree_unflatten(treedef, new_p),
+                    jax.tree_util.tree_unflatten(treedef, new_s))
+
+        return apply_fn
+
+    # -- serialization -------------------------------------------------------
+    def state_dict(self):
+        sd = {}
+        for key, state in self._accumulators["__state__"].items():
+            for sname, arr in state.items():
+                sd[f"{key}.{sname}"] = Tensor(arr)
+        for key, arr in self._master_weights.items():
+            sd[f"{key}.master_weight"] = Tensor(arr)
+        sd["@step_count"] = self._step_count
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("@step_count", 0))
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate,
+                                                       LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for k, v in state_dict.items():
+            if k in ("@step_count", "LR_Scheduler"):
+                continue
+            if "." not in k:
+                continue
+            key, sname = k.rsplit(".", 1)
+            arr = v.data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            if sname == "master_weight":
+                self._master_weights[key] = arr
+            else:
+                self._accumulators["__state__"].setdefault(key, {})[sname] = arr
+
+    set_dict = set_state_dict
+
+
+class _FakeParam:
+    def __init__(self, a):
+        self.data = a
+        self.name = ""

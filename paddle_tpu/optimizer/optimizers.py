@@ -1,0 +1,222 @@
+"""Concrete optimizers (ref: python/paddle/optimizer/{sgd,momentum,adam,adamw,
+adagrad,rmsprop,adadelta,adamax,lamb}.py)."""
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    def _create_state(self, p):
+        return {}
+
+    def _rule(self, p, g, state, lr, t):
+        return p - lr * g, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _create_state(self, p):
+        return {"velocity": jnp.zeros(p.data.shape, jnp.float32)}
+
+    def _rule(self, p, g, state, lr, t):
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            upd = g + self._momentum * v
+        else:
+            upd = v
+        return p - lr * upd.astype(p.dtype), {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_state(self, p):
+        return {"moment1": jnp.zeros(p.data.shape, jnp.float32),
+                "moment2": jnp.zeros(p.data.shape, jnp.float32)}
+
+    def _rule(self, p, g, state, lr, t):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * g32 * g32
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        upd = lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return (p - upd.astype(p.dtype),
+                {"moment1": m, "moment2": v})
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (ref: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._coeff = float(weight_decay) if not callable(weight_decay) else 0.01
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._decay_skip = set()
+        if apply_decay_param_fun is not None and parameters is not None:
+            for p in self._parameter_list:
+                if not apply_decay_param_fun(p.name):
+                    self._decay_skip.add(p.name or str(id(p)))
+
+    def _rule(self, p, g, state, lr, t):
+        # note: skip-list is handled by zeroing coeff via state marker set in
+        # _apply_optimize wrapper below
+        coeff = state.pop("__coeff__", self._coeff)
+        p = p * (1.0 - lr * coeff)
+        return super()._rule(p, g, state, lr, t)
+
+    def _apply_optimize(self, params_grads):
+        # annotate per-param decay coeff
+        self.__pending = params_grads
+        for p, g in params_grads:
+            key = p.name or str(id(p))
+            st = self._ensure_state(p)
+            st["__coeff__"] = 0.0 if key in self._decay_skip else self._coeff
+        super()._apply_optimize(params_grads)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, multi_precision=False,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _create_state(self, p):
+        return {"moment": jnp.full(p.data.shape, self._init_acc, jnp.float32)}
+
+    def _rule(self, p, g, state, lr, t):
+        g32 = g.astype(jnp.float32)
+        m = state["moment"] + g32 * g32
+        upd = lr * g32 / (jnp.sqrt(m) + self._epsilon)
+        return p - upd.astype(p.dtype), {"moment": m}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _create_state(self, p):
+        s = {"mean_square": jnp.zeros(p.data.shape, jnp.float32),
+             "momentum": jnp.zeros(p.data.shape, jnp.float32)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros(p.data.shape, jnp.float32)
+        return s
+
+    def _rule(self, p, g, state, lr, t):
+        g32 = g.astype(jnp.float32)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g32 * g32
+        new = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g32
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+            new["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"] + lr * g32 / denom
+        new["momentum"] = mom
+        return p - mom.astype(p.dtype), new
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _create_state(self, p):
+        return {"avg_squared_grad": jnp.zeros(p.data.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(p.data.shape, jnp.float32)}
+
+    def _rule(self, p, g, state, lr, t):
+        g32 = g.astype(jnp.float32)
+        asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * g32 * g32
+        upd = (jnp.sqrt(state["avg_squared_update"] + self._epsilon) /
+               jnp.sqrt(asg + self._epsilon)) * g32
+        asu = self._rho * state["avg_squared_update"] + (1 - self._rho) * upd * upd
+        return (p - (lr * upd).astype(p.dtype),
+                {"avg_squared_grad": asg, "avg_squared_update": asu})
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_state(self, p):
+        return {"moment": jnp.zeros(p.data.shape, jnp.float32),
+                "inf_norm": jnp.zeros(p.data.shape, jnp.float32)}
+
+    def _rule(self, p, g, state, lr, t):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g32))
+        upd = lr / (1 - self._beta1 ** t) * m / (u + self._epsilon)
+        return p - upd.astype(p.dtype), {"moment": m, "inf_norm": u}
+
+
+class Lamb(Optimizer):
+    """ref: python/paddle/optimizer/lamb.py — layer-adaptive Adam for large
+    batch."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._coeff = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _create_state(self, p):
+        return {"moment1": jnp.zeros(p.data.shape, jnp.float32),
+                "moment2": jnp.zeros(p.data.shape, jnp.float32)}
+
+    def _rule(self, p, g, state, lr, t):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * g32 * g32
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + self._coeff * p.astype(jnp.float32)
+        w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (p - (lr * trust * r).astype(p.dtype),
+                {"moment1": m, "moment2": v})

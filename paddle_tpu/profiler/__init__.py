@@ -1,0 +1,193 @@
+"""paddle.profiler analog.
+
+ref: python/paddle/profiler/profiler.py:344 Profiler (scheduler windows,
+RecordEvent spans, chrome-trace export), timer.py benchmark.
+
+TPU-native backing: jax.profiler (XPlane/perfetto traces + TraceAnnotation
+spans) replaces the reference's CUPTI tracer (SURVEY §5.1).
+"""
+import contextlib
+import json
+import os
+import time
+
+import jax
+
+from . import timer as _timer_mod
+from .timer import Benchmark, benchmark
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"
+    TPU = "tpu"
+    CUSTOM_DEVICE = "custom"
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        total = closed + ready + record
+        if repeat and s >= repeat * total:
+            return ProfilerState.CLOSED
+        pos = s % total
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == total - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof._export_dir = dir_name
+    return handler
+
+
+class RecordEvent:
+    """Span annotation (ref: profiler/utils.py RecordEvent); lowers to
+    jax.profiler.TraceAnnotation so spans appear in XLA traces."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ann = None
+        self.begin_ts = None
+        self.end_ts = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def begin(self):
+        self.begin_ts = time.perf_counter()
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        self.end_ts = time.perf_counter()
+        _EVENTS.append((self.name, self.begin_ts, self.end_ts))
+
+
+_EVENTS = []
+
+
+class Profiler:
+    """ref: profiler/profiler.py:344."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self._scheduler = scheduler or (lambda step: ProfilerState.RECORD)
+        if isinstance(scheduler, tuple):
+            start, end = scheduler
+            self._scheduler = lambda step: (
+                ProfilerState.RECORD if start <= step < end
+                else ProfilerState.CLOSED)
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._active = False
+        self._export_dir = None
+        self._logdir = os.environ.get("PADDLE_TPU_PROFILE_DIR",
+                                      "/tmp/paddle_tpu_profile")
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def start(self):
+        self._state = self._scheduler(self._step)
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN) \
+                and not self._timer_only and not self._active:
+            try:
+                jax.profiler.start_trace(self._logdir)
+                self._active = True
+            except Exception:
+                self._active = False
+        benchmark().begin()
+
+    def stop(self):
+        if self._active:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._active = False
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+        benchmark().end()
+
+    def step(self, num_samples=None):
+        self._step += 1
+        new_state = self._scheduler(self._step)
+        if new_state != self._state:
+            if self._active and new_state == ProfilerState.CLOSED:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                self._active = False
+            elif (not self._active
+                  and new_state in (ProfilerState.RECORD,
+                                    ProfilerState.RECORD_AND_RETURN)
+                  and not self._timer_only):
+                try:
+                    jax.profiler.start_trace(self._logdir)
+                    self._active = True
+                except Exception:
+                    pass
+            self._state = new_state
+        benchmark().step(num_samples)
+
+    def step_info(self, unit=None):
+        return benchmark().step_info(unit)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        lines = ["Profiler summary (host spans):"]
+        agg = {}
+        for name, b, e in _EVENTS:
+            tot, cnt = agg.get(name, (0.0, 0))
+            agg[name] = (tot + (e - b), cnt + 1)
+        for name, (tot, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+            lines.append(f"  {name}: total={tot*1e3:.3f}ms calls={cnt}")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+    def export(self, path, format="json"):
+        events = [{"name": n, "ph": "X", "ts": b * 1e6,
+                   "dur": (e - b) * 1e6, "pid": 0, "tid": 0}
+                  for n, b, e in _EVENTS]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+
+
+def load_profiler_result(path):
+    with open(path) as f:
+        return json.load(f)

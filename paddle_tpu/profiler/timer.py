@@ -1,0 +1,65 @@
+"""Throughput benchmark timer (ref: python/paddle/profiler/timer.py —
+benchmark() with ips/step-time and warmup)."""
+import time
+
+
+class _StepStat:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.total = 0.0
+        self.count = 0
+        self.samples = 0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def update(self, dt, n):
+        self.total += dt
+        self.count += 1
+        self.samples += n or 0
+        self.min = min(self.min, dt)
+        self.max = max(self.max, dt)
+
+
+class Benchmark:
+    def __init__(self):
+        self._stat = _StepStat()
+        self._last = None
+        self._warmup = 10
+        self._seen = 0
+
+    def begin(self):
+        self._last = time.perf_counter()
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._seen += 1
+            if self._seen > self._warmup:
+                self._stat.update(now - self._last, num_samples)
+        self._last = now
+
+    def end(self):
+        self._last = None
+
+    def step_info(self, unit=None):
+        s = self._stat
+        if s.count == 0:
+            return "no steps recorded (warmup)"
+        avg = s.total / s.count
+        ips = (s.samples / s.total) if s.total and s.samples else 0.0
+        u = unit or "samples"
+        return (f"avg_step: {avg*1e3:.3f}ms, min: {s.min*1e3:.3f}ms, "
+                f"max: {s.max*1e3:.3f}ms, ips: {ips:.2f} {u}/s")
+
+    def reset(self):
+        self._stat.reset()
+        self._seen = 0
+
+
+_benchmark = Benchmark()
+
+
+def benchmark():
+    return _benchmark

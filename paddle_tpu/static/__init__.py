@@ -1,0 +1,81 @@
+"""paddle.static analog.
+
+The reference's static mode (ProgramDesc + InterpreterCore,
+ref: paddle/fluid/framework/new_executor/interpretercore.cc) maps to
+jit-compiled callables here: a "Program" is a traced jax computation and the
+Executor invokes it. This module keeps the reference's API shape for source
+compatibility; `paddle.enable_static()` is a no-op because eager + jit covers
+both modes on TPU (SURVEY §7: "XLA is the executor").
+"""
+from ..jit import InputSpec, TracedFunction
+
+
+class Program:
+    def __init__(self):
+        self._fn = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+def default_main_program():
+    return Program()
+
+
+def default_startup_program():
+    return Program()
+
+
+def program_guard(main_program=None, startup_program=None):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        yield
+    return _guard()
+
+
+class Executor:
+    """API-shim over jit execution (ref: fluid/executor.py:921)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if callable(program):
+            out = program(**(feed or {}))
+            return out if isinstance(out, (list, tuple)) else [out]
+        raise NotImplementedError(
+            "static Program execution: wrap your computation in "
+            "paddle_tpu.jit.to_static; graph-IR programs are not used on TPU")
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+def save(program, model_path, **kwargs):
+    pass
+
+
+def load(program, model_path, executor=None, var_names=None):
+    pass
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         **kwargs):
+    pass
+
+
+class amp:
+    @staticmethod
+    def decorate(*args, **kwargs):
+        raise NotImplementedError("static amp: use paddle_tpu.amp")
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..autograd import grad
+    return grad(targets, inputs, target_gradients)

@@ -1,0 +1,154 @@
+"""Creation ops (ref: python/paddle/tensor/creation.py)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework.dtype import convert_dtype
+from .tensor import Tensor, to_tensor
+
+
+def _d(dtype):
+    return convert_dtype(dtype) if dtype is not None else dtypes.get_default_dtype()
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _d(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _d(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        # match paddle: infer from value
+        if isinstance(fill_value, (bool, np.bool_)):
+            dt = jnp.bool_
+        elif isinstance(fill_value, (int, np.integer)):
+            dt = jnp.int64
+        else:
+            dt = dtypes.get_default_dtype()
+    else:
+        dt = convert_dtype(dtype)
+    return Tensor(jnp.full(_shape(shape), fill_value, dt))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(x.data, dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(x.data, dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(x.data, fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    dt = convert_dtype(dtype)
+    if dt is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dt = jnp.int64
+        else:
+            dt = dtypes.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=dt))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(_scalar(start), _scalar(stop), int(_scalar(num)),
+                               dtype=_d(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(_scalar(start), _scalar(stop), int(_scalar(num)),
+                               base=base, dtype=_d(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_d(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    d = jnp.diag(x.data, k=offset)
+    if x.ndim == 1 and padding_value != 0:
+        n = x.data.shape[0] + abs(offset)
+        mask = jnp.eye(n, k=offset, dtype=bool)
+        d = jnp.where(mask, d, padding_value)
+    return Tensor(d)
+
+
+def diagflat(x, offset=0, name=None):
+    return Tensor(jnp.diagflat(x.data, k=offset))
+
+
+def tril(x, diagonal=0, name=None):
+    from ..ops import apply
+    return apply(lambda a: jnp.tril(a, diagonal), x, name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    from ..ops import apply
+    return apply(lambda a: jnp.triu(a, diagonal), x, name="triu")
+
+
+def meshgrid(*args, **kwargs):
+    arrs = [a.data for a in args]
+    outs = jnp.meshgrid(*arrs, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    src = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output.set_value(src)
+        return output
+    return Tensor(src)
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = jnp.tril_indices(row, offset, col)
+    return Tensor(jnp.stack([r, c]).astype(convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = jnp.triu_indices(row, offset, col)
+    return Tensor(jnp.stack([r, c]).astype(convert_dtype(dtype)))
+
+
+def complex(real, imag, name=None):
+    from ..ops import apply
+    return apply(lambda r, i: jax.lax.complex(r, i), real, imag, name="complex")
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _scalar(v):
+    return v.item() if isinstance(v, Tensor) else v

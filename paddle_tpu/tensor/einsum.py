@@ -1,0 +1,11 @@
+"""einsum (ref: python/paddle/tensor/einsum.py) — delegated to XLA's einsum,
+which maps contractions straight onto the MXU."""
+import jax.numpy as jnp
+
+from ..ops import apply
+from .tensor import Tensor
+
+
+def einsum(equation, *operands):
+    ts = [o if isinstance(o, Tensor) else Tensor(o) for o in operands]
+    return apply(lambda *arrs: jnp.einsum(equation, *arrs), *ts, name="einsum")

@@ -1,0 +1,170 @@
+"""Linear algebra ops (ref: python/paddle/tensor/linalg.py)."""
+import jax
+import jax.numpy as jnp
+
+from ..ops import apply
+from .tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def transpose_last2(x):
+    return apply(lambda a: jnp.swapaxes(a, -1, -2), _t(x), name="t")
+
+
+def t(x, name=None):
+    x = _t(x)
+    if x.ndim < 2:
+        return x.clone()
+    return apply(lambda a: a.T, x)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def fn(a):
+        if p == "fro" and axis is None:
+            return jnp.sqrt(jnp.sum(a * a))
+        if axis is None:
+            flat = a.reshape(-1)
+            return jnp.linalg.norm(flat, ord=p)
+        if isinstance(axis, (list, tuple)):
+            return jnp.linalg.norm(a, ord="fro" if p == "fro" else p,
+                                   axis=tuple(axis), keepdims=keepdim)
+        return jnp.linalg.norm(a, ord=None if p == "fro" else p, axis=axis,
+                               keepdims=keepdim)
+    return apply(fn, _t(x), name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    return apply(lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p),
+                 _t(x), _t(y))
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else None
+    if ax is None:
+        x_ = _t(x)
+        ax = next((i for i, s in enumerate(x_.shape) if s == 3), -1)
+    return apply(lambda a, b: jnp.cross(a, b, axis=ax), _t(x), _t(y))
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), _t(x))
+
+
+def inverse(x, name=None):
+    return apply(jnp.linalg.inv, _t(x), name="inverse")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), _t(x))
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, _t(x))
+
+
+def slogdet(x, name=None):
+    def fn(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return apply(fn, _t(x))
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, _t(x), _t(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply(fn, _t(x), _t(y))
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return apply(fn, _t(x))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+    return apply(fn, _t(x), _t(y))
+
+
+def qr(x, mode="reduced", name=None):
+    q, r = jnp.linalg.qr(_t(x).data, mode=mode)
+    return Tensor(q), Tensor(r)
+
+
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = jnp.linalg.svd(_t(x).data, full_matrices=full_matrices)
+    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2))
+
+
+def eig(x, name=None):
+    w, v = jnp.linalg.eig(jax.device_get(_t(x).data))
+    return Tensor(w), Tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    w, v = jnp.linalg.eigh(_t(x).data, UPLO=UPLO)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    return Tensor(np.linalg.eigvals(np.asarray(jax.device_get(_t(x).data))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return Tensor(jnp.linalg.eigvalsh(_t(x).data, UPLO=UPLO))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(_t(x).data, tol))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    w = weights.data if weights is not None else None
+    return Tensor(jnp.bincount(_t(x).data, w, minlength=minlength))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    a = _t(input).data
+    if min == 0 and max == 0:
+        mn, mx = a.min(), a.max()
+    else:
+        mn, mx = min, max
+    hist, _ = jnp.histogram(a, bins=bins, range=(mn, mx))
+    return Tensor(hist.astype(jnp.int64))
+
+
+def mul(x, y, name=None):
+    from .math import matmul
+    return matmul(x, y)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    import jax.scipy.linalg as jsl
+    lu_, piv = jsl.lu_factor(_t(x).data)
+    if get_infos:
+        return Tensor(lu_), Tensor(piv.astype(jnp.int32)), Tensor(jnp.zeros((), jnp.int32))
+    return Tensor(lu_), Tensor(piv.astype(jnp.int32))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return Tensor(jnp.corrcoef(_t(x).data, rowvar=rowvar))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = fweights.data if fweights is not None else None
+    aw = aweights.data if aweights is not None else None
+    return Tensor(jnp.cov(_t(x).data, rowvar=rowvar, ddof=1 if ddof else 0,
+                          fweights=fw, aweights=aw))

@@ -1,0 +1,69 @@
+"""Logic / comparison ops (ref: python/paddle/tensor/logic.py)."""
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+
+def _raw(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _mk(fn):
+    def op(x, y=None, out=None, name=None):
+        if y is None:
+            res = fn(_raw(x))
+        else:
+            res = fn(_raw(x), _raw(y))
+        return Tensor(res)
+    return op
+
+
+equal = _mk(lambda a, b: a == b)
+not_equal = _mk(lambda a, b: a != b)
+greater_than = _mk(lambda a, b: a > b)
+greater_equal = _mk(lambda a, b: a >= b)
+less_than = _mk(lambda a, b: a < b)
+less_equal = _mk(lambda a, b: a <= b)
+logical_and = _mk(jnp.logical_and)
+logical_or = _mk(jnp.logical_or)
+logical_xor = _mk(jnp.logical_xor)
+logical_not = _mk(jnp.logical_not)
+bitwise_and = _mk(jnp.bitwise_and)
+bitwise_or = _mk(jnp.bitwise_or)
+bitwise_xor = _mk(jnp.bitwise_xor)
+bitwise_not = _mk(jnp.bitwise_not)
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(_raw(x), _raw(y)))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(_raw(x), _raw(y), rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.isclose(_raw(x), _raw(y), rtol=rtol, atol=atol,
+                              equal_nan=equal_nan))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def _inject():
+    for nm in ["equal", "not_equal", "greater_than", "greater_equal",
+               "less_than", "less_equal", "logical_and", "logical_or",
+               "logical_xor", "logical_not", "bitwise_and", "bitwise_or",
+               "bitwise_xor", "bitwise_not", "allclose", "isclose",
+               "equal_all"]:
+        if not hasattr(Tensor, nm):
+            setattr(Tensor, nm, globals()[nm])
+
+
+_inject()

@@ -1,0 +1,448 @@
+"""Shape/layout manipulation ops (ref: python/paddle/tensor/manipulation.py)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops import apply
+from ..framework.dtype import convert_dtype
+from .tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy())
+    out = []
+    for s in shape:
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+def cast(x, dtype):
+    dt = convert_dtype(dtype)
+    x = _t(x)
+    if jnp.issubdtype(dt, jnp.inexact) and jnp.issubdtype(x.dtype, jnp.inexact):
+        return apply(lambda a: a.astype(dt), x, name="cast")
+    return Tensor(x.data.astype(dt), stop_gradient=True)
+
+
+def reshape(x, shape, name=None):
+    s = _shape(shape)
+    return apply(lambda a: a.reshape(s), _t(x), name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x.data, x._node, x.stop_gradient = out.data, out._node, out.stop_gradient
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = _t(x)
+    nd = x.ndim
+    sa = start_axis % nd if nd else 0
+    ea = stop_axis % nd if nd else 0
+    shp = x.shape
+    new = shp[:sa] + [int(np.prod(shp[sa:ea + 1]) or 1)] + shp[ea + 1:]
+    return reshape(x, new)
+
+
+def transpose(x, perm, name=None):
+    p = tuple(int(i) for i in perm)
+    return apply(lambda a: jnp.transpose(a, p), _t(x), name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda a: jnp.moveaxis(a, source, destination), _t(x))
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return apply(lambda a: jnp.swapaxes(a, axis1, axis2), _t(x))
+
+
+def squeeze(x, axis=None, name=None):
+    x = _t(x)
+    if axis is None:
+        ax = None
+    else:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+        if not ax:
+            return x.clone()
+    return apply(lambda a: jnp.squeeze(a, axis=ax), x, name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = tuple(int(a.item()) if isinstance(a, Tensor) else int(a) for a in axes)
+    return apply(lambda a: jnp.expand_dims(a, axes), _t(x), name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x.data, x._node, x.stop_gradient = out.data, out._node, out.stop_gradient
+    return x
+
+
+def concat(x, axis=0, name=None):
+    ts = [_t(v) for v in x]
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda *a: jnp.concatenate(a, axis=ax), *ts, name="concat")
+
+
+def stack(x, axis=0, name=None):
+    ts = [_t(v) for v in x]
+    return apply(lambda *a: jnp.stack(a, axis=axis), *ts, name="stack")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = _t(x)
+    n = num or x.shape[axis]
+    outs = apply(lambda a: tuple(jnp.moveaxis(a, axis, 0)[i] for i in range(n)),
+                 x, n_outputs=n, name="unstack")
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _t(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [s if not isinstance(s, Tensor) else int(s.item())
+                 for s in num_or_sections]
+        neg = [i for i, s in enumerate(sizes) if s in (-1,)]
+        if neg:
+            known = builtins_sum(s for s in sizes if s != -1)
+            sizes[neg[0]] = dim - known
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+    n = len(sizes)
+
+    def fn(a):
+        return tuple(jax.lax.slice_in_dim(a, o, o + s, axis=ax)
+                     for o, s in zip(offsets, sizes))
+
+    outs = apply(fn, x, n_outputs=n, name="split")
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def builtins_sum(it):
+    import builtins
+    return builtins.sum(it)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape(repeat_times)
+    return apply(lambda a: jnp.tile(a, reps), _t(x), name="tile")
+
+
+def expand(x, shape, name=None):
+    s = _shape(shape)
+    x = _t(x)
+    # paddle expand: -1 keeps original dim
+    full = []
+    xs = [1] * (len(s) - x.ndim) + x.shape
+    for tgt, cur in zip(s, xs):
+        full.append(cur if tgt == -1 else tgt)
+    return apply(lambda a: jnp.broadcast_to(a, tuple(full)), x, name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = jnp.broadcast_shapes(*[tuple(t.shape) for t in inputs])
+    return [expand(t, list(shapes)) for t in inputs]
+
+
+def flip(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply(lambda a: jnp.flip(a, axis=ax), _t(x), name="flip")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda a: jnp.roll(a, shifts, axis=axis), _t(x), name="roll")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), _t(x))
+
+
+def slice(x, axes, starts, ends):
+    x = _t(x)
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        st = int(st.item()) if isinstance(st, Tensor) else int(st)
+        en = int(en.item()) if isinstance(en, Tensor) else int(en)
+        idx[ax] = jnp.s_[st:en]
+    idx = tuple(idx)
+    return apply(lambda a: a[idx], x, name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = _t(x)
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = jnp.s_[st:en:sd]
+    idx = tuple(idx)
+    return apply(lambda a: a[idx], x)
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    idx = index.data if isinstance(index, Tensor) else jnp.asarray(index)
+    if idx.ndim > 1:
+        idx = idx.reshape(-1)
+    return apply(lambda a: jnp.take(a, idx, axis=ax), _t(x), name="gather")
+
+
+def gather_nd(x, index, name=None):
+    idx = index.data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(a):
+        ix = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[ix]
+
+    return apply(fn, _t(x), name="gather_nd")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    idx = indices.data if isinstance(indices, Tensor) else jnp.asarray(indices)
+    return apply(lambda a: jnp.take_along_axis(a, idx, axis=axis), _t(arr))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    idx = indices.data
+    v = values.data if isinstance(values, Tensor) else values
+
+    def fn(a, val):
+        val = jnp.broadcast_to(val, idx.shape).astype(a.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, idx, val, axis=axis, inplace=False)
+        elif reduce == "add":
+            dims = [jnp.arange(s) for s in idx.shape]
+            grids = jnp.meshgrid(*dims, indexing="ij")
+            grids[axis] = idx
+            return a.at[tuple(grids)].add(val)
+        raise NotImplementedError(reduce)
+
+    if isinstance(values, Tensor):
+        return apply(fn, _t(arr), values, name="put_along_axis")
+    return apply(lambda a: fn(a, jnp.asarray(v)), _t(arr), name="put_along_axis")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = index.data if isinstance(index, Tensor) else jnp.asarray(index)
+    idx = idx.reshape(-1)
+
+    def fn(a, upd):
+        if overwrite:
+            return a.at[idx].set(upd.astype(a.dtype))
+        return a.at[idx].add(upd.astype(a.dtype))
+
+    return apply(fn, _t(x), _t(updates), name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = index.data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(a, upd):
+        ix = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[ix].add(upd.astype(a.dtype))
+
+    return apply(fn, _t(x), _t(updates), name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    base = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(base, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index):
+    idx = index.data
+
+    def fn(a):
+        rows = jnp.arange(a.shape[0])[:, None]
+        return a[rows, idx]
+
+    return apply(fn, _t(x), name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    idx = index.data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(a, v):
+        am = jnp.moveaxis(a, axis, 0)
+        am = am.at[idx].add(v.astype(a.dtype))
+        return jnp.moveaxis(am, 0, axis)
+
+    return apply(fn, _t(x), _t(value), name="index_add")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats.data if isinstance(repeats, Tensor) else repeats
+    return apply(lambda a: jnp.repeat(a, r, axis=axis), _t(x))
+
+
+def masked_select(x, mask, name=None):
+    m = mask.data if isinstance(mask, Tensor) else jnp.asarray(mask)
+    return Tensor(_t(x).data[m])
+
+
+def masked_fill(x, mask, value, name=None):
+    m = mask.data if isinstance(mask, Tensor) else jnp.asarray(mask)
+    v = value.item() if isinstance(value, Tensor) else value
+    return apply(lambda a: jnp.where(m, jnp.asarray(v, a.dtype), a), _t(x))
+
+
+def where(condition, x=None, y=None, name=None):
+    c = condition.data if isinstance(condition, Tensor) else jnp.asarray(condition)
+    if x is None and y is None:
+        return tuple(Tensor(i) for i in jnp.nonzero(c))
+    return apply(lambda a, b: jnp.where(c, a, b), _t(x), _t(y), name="where")
+
+
+def nonzero(x, as_tuple=False):
+    res = jnp.nonzero(_t(x).data)
+    if as_tuple:
+        return tuple(Tensor(r.reshape(-1, 1)) for r in res)
+    return Tensor(jnp.stack(res, axis=1))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    res = jnp.unique(_t(x).data, return_index=return_index,
+                     return_inverse=return_inverse, return_counts=return_counts,
+                     axis=axis)
+    if isinstance(res, tuple):
+        return tuple(Tensor(r) for r in res)
+    return Tensor(res)
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = _t(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full-spec: paddle order is [d0_l, d0_r, d1_l, d1_r, ...]? Actually
+        # paddle full spec is per-dim pairs in dim order.
+        widths = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(nd)]
+    else:
+        # partial spec applies to last len(pad)//2 spatial dims, reversed
+        # (torch/paddle convention: last dim first).
+        k = len(pad) // 2
+        widths = [(0, 0)] * nd
+        if data_format in ("NCHW", "NCL", "NCDHW"):
+            dims = list(range(nd - k, nd))
+        else:  # NHWC-style: spatial dims are 1..nd-2
+            dims = list(range(1, 1 + k))
+        for j, d in enumerate(reversed(dims) if data_format.startswith("NC") else dims):
+            widths[d] = (int(pad[2 * j]), int(pad[2 * j + 1]))
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if jmode == "constant":
+        fn = lambda a: jnp.pad(a, widths, mode="constant", constant_values=value)
+    else:
+        fn = lambda a: jnp.pad(a, widths, mode=jmode)
+    return apply(fn, x, name="pad")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = _t(x)
+    shp = _shape(shape)
+    offs = [0] * x.ndim if offsets is None else list(_shape(offsets))
+    idx = tuple(jnp.s_[o:o + (s if s != -1 else x.shape[i] - o)]
+                for i, (o, s) in enumerate(zip(offs, shp)))
+    return apply(lambda a: a[idx], x)
+
+
+def as_complex(x, name=None):
+    return apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), _t(x))
+
+
+def as_real(x, name=None):
+    return apply(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), _t(x))
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.tolist()
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=ax), _t(x), _t(y))
+
+
+def atleast_1d(*inputs):
+    outs = [apply(jnp.atleast_1d, _t(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs):
+    outs = [apply(jnp.atleast_2d, _t(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs):
+    outs = [apply(jnp.atleast_3d, _t(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(_t(x).size, jnp.int64))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def fn(a):
+        shard = a // shard_size
+        return jnp.where(shard == shard_id, a % shard_size, ignore_value)
+
+    return Tensor(fn(_t(input).data))
+
+
+# Inject methods.
+def _inject():
+    mod = globals()
+    for nm in ["reshape", "flatten", "transpose", "squeeze", "unsqueeze",
+               "split", "chunk", "tile", "expand", "expand_as", "flip",
+               "roll", "gather", "gather_nd", "scatter", "masked_select",
+               "masked_fill", "unique", "unbind", "cast", "astype_",
+               "index_select", "repeat_interleave", "take_along_axis",
+               "put_along_axis", "nonzero", "broadcast_to", "numel_",
+               "reshape_", "unsqueeze_", "view", "moveaxis"]:
+        if nm.endswith("_") and nm not in mod:
+            continue
+        if nm in mod and not hasattr(Tensor, nm):
+            setattr(Tensor, nm, mod[nm])
+
+
+_inject()

@@ -1,0 +1,407 @@
+"""Math ops (ref: python/paddle/tensor/math.py).
+
+Each op is `apply`-dispatched so autograd records a vjp. Binary ops accept
+Tensor|scalar on either side. Method + dunder injection at the bottom mirrors
+the reference's math_op_patch (ref: python/paddle/fluid/dygraph/math_op_patch.py).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..ops import apply
+from .tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+# ---- unary ----------------------------------------------------------------
+def _unary(name, fn):
+    def op(x, name=None):
+        return apply(fn, _t(x), name=name or "")
+    op.__name__ = name
+    return op
+
+
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+abs = _unary("abs", jnp.abs)
+ceil = _unary("ceil", jnp.ceil)
+floor = _unary("floor", jnp.floor)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+square = _unary("square", jnp.square)
+reciprocal = _unary("reciprocal", lambda x: 1.0 / x)
+neg = _unary("neg", jnp.negative)
+sign = _unary("sign", jnp.sign)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+logit = _unary("logit", jax.scipy.special.logit)
+i0 = _unary("i0", lambda x: jax.scipy.special.i0(x))
+i1 = _unary("i1", lambda x: jax.scipy.special.i1(x))
+
+
+def isnan(x, name=None):
+    return Tensor(jnp.isnan(_t(x).data))
+
+
+def isinf(x, name=None):
+    return Tensor(jnp.isinf(_t(x).data))
+
+
+def isfinite(x, name=None):
+    return Tensor(jnp.isfinite(_t(x).data))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+                 _t(x), name="nan_to_num")
+
+
+# ---- binary ---------------------------------------------------------------
+def _promote(fn):
+    """Make binary op accept scalars and match paddle's type promotion
+    (scalar python floats don't upcast float16/bf16 tensors)."""
+
+    def wrapped(a, b):
+        return fn(a, b)
+
+    return wrapped
+
+
+def _binary(name, fn):
+    def op(x, y, name=None):
+        x, y = _coerce_pair(x, y)
+        return apply(fn, x, y, name=name or "")
+    op.__name__ = name
+    return op
+
+
+def _coerce_pair(x, y):
+    if isinstance(x, Tensor) and not isinstance(y, Tensor):
+        y = Tensor(jnp.asarray(y, dtype=x.dtype if _scalar_ok(y, x.dtype) else None))
+    elif isinstance(y, Tensor) and not isinstance(x, Tensor):
+        x = Tensor(jnp.asarray(x, dtype=y.dtype if _scalar_ok(x, y.dtype) else None))
+    elif not isinstance(x, Tensor):
+        x, y = Tensor(x), Tensor(y)
+    return x, y
+
+
+def _scalar_ok(v, dtype):
+    import numpy as np
+    if isinstance(v, (bool,)):
+        return jnp.dtype(dtype) == jnp.bool_
+    if isinstance(v, (int, np.integer)):
+        return True
+    if isinstance(v, (float, np.floating)):
+        return jnp.issubdtype(jnp.dtype(dtype), jnp.inexact)
+    return False
+
+
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", lambda a, b: jnp.true_divide(a, b))
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+mod = _binary("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = _binary("pow", jnp.power)
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+hypot = _binary("hypot", jnp.hypot)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+heaviside = _binary("heaviside", jnp.heaviside)
+nextafter = _binary("nextafter", jnp.nextafter)
+copysign = _binary("copysign", jnp.copysign)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+kron = _binary("kron", jnp.kron)
+inner = _binary("inner", jnp.inner)
+outer = _binary("outer", jnp.outer)
+
+
+def multiplex(inputs, index, name=None):
+    stacked = jnp.stack([t.data for t in inputs], axis=0)
+    idx = index.data.reshape(-1)
+    return apply(lambda s: s[idx, jnp.arange(s.shape[1])], Tensor(stacked),
+                 name="multiplex")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale.item() if isinstance(scale, Tensor) else scale
+    if bias_after_scale:
+        fn = lambda a: a * s + bias
+    else:
+        fn = lambda a: (a + bias) * s
+    out = apply(fn, _t(x), name="scale")
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return apply(lambda a: jnp.clip(a, mn, mx), _t(x), name="clip")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), _t(x))
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), x, y, weight, name="lerp")
+    return apply(lambda a, b: a + weight * (b - a), x, y, name="lerp")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y,
+                 name="addmm")
+
+
+# ---- reductions -----------------------------------------------------------
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..framework.dtype import convert_dtype
+    ax = _axis(axis)
+    dt = convert_dtype(dtype)
+    def fn(a):
+        out = jnp.sum(a, axis=ax, keepdims=keepdim)
+        # paddle promotes bool/int sums to int64
+        if dt is not None:
+            out = out.astype(dt)
+        elif a.dtype in (jnp.bool_,):
+            out = out.astype(jnp.int64)
+        return out
+    return apply(fn, _t(x), name="sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), _t(x),
+                 name="mean")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jnp.max(a, axis=ax, keepdims=keepdim), _t(x), name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jnp.min(a, axis=ax, keepdims=keepdim), _t(x), name="min")
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    from ..framework.dtype import convert_dtype
+    ax = _axis(axis)
+    dt = convert_dtype(dtype)
+    return apply(lambda a: jnp.prod(a, axis=ax, keepdims=keepdim, dtype=dt),
+                 _t(x), name="prod")
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
+                 _t(x), name="logsumexp")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    from ..framework.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+    if axis is None:
+        return apply(lambda a: jnp.cumsum(a.reshape(-1), dtype=dt), _t(x))
+    return apply(lambda a: jnp.cumsum(a, axis=int(axis), dtype=dt), _t(x))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    from ..framework.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+    return apply(lambda a: jnp.cumprod(a, axis=dim, dtype=dt), _t(x))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    a = _t(x).data
+    if axis is None:
+        a, axis = a.reshape(-1), 0
+    vals = jax.lax.associative_scan(jnp.maximum, a, axis=axis)
+    idx = jnp.argmax(jnp.cumsum(jnp.ones_like(a, jnp.int32), axis) *
+                     (a == vals), axis=axis)
+    return Tensor(vals), Tensor(idx)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jnp.nansum(a, axis=ax, keepdims=keepdim), _t(x))
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), _t(x))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return Tensor(jnp.count_nonzero(_t(x).data, axis=ax, keepdims=keepdim))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = prepend.data if prepend is not None else None
+    app = append.data if append is not None else None
+    return apply(lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app),
+                 _t(x))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+                 _t(x))
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.all(_t(x).data, axis=_axis(axis), keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.any(_t(x).data, axis=_axis(axis), keepdims=keepdim))
+
+
+# ---- matmul ---------------------------------------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """ref: python/paddle/tensor/linalg.py:137 matmul. Dispatches through the
+    kernel registry so a Pallas kernel can take over on TPU."""
+    from ..ops import dispatch
+    return dispatch("matmul", _t(x), _t(y), transpose_x=transpose_x,
+                    transpose_y=transpose_y)
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: (a * b).sum(-1), _t(x), _t(y), name="dot")
+
+
+def mv(x, vec, name=None):
+    return apply(lambda a, b: a @ b, _t(x), _t(vec), name="mv")
+
+
+# default XLA matmul kernel
+from ..ops import register_kernel
+
+
+@register_kernel("matmul", "xla")
+def _matmul_xla(a, b, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+    if transpose_y:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+    return jnp.matmul(a, b)
+
+
+# ---- method / dunder injection -------------------------------------------
+def _inject():
+    import builtins
+    mod = globals()
+    method_names = [
+        "exp", "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "abs", "ceil",
+        "floor", "round", "trunc", "sin", "cos", "tan", "tanh", "sigmoid",
+        "square", "reciprocal", "sign", "erf", "sum", "mean", "max", "min",
+        "prod", "logsumexp", "cumsum", "cumprod", "matmul", "mm", "bmm", "dot",
+        "add", "subtract", "multiply", "divide", "mod", "pow", "maximum",
+        "minimum", "clip", "scale", "isnan", "isinf", "isfinite", "all", "any",
+        "trace", "neg", "conj", "real", "imag", "lerp", "outer", "inner",
+    ]
+    for nm in method_names:
+        if not hasattr(Tensor, nm):
+            setattr(Tensor, nm, mod[nm])
+
+    Tensor.__add__ = lambda s, o: add(s, o)
+    Tensor.__radd__ = lambda s, o: add(o, s)
+    Tensor.__sub__ = lambda s, o: subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: subtract(o, s)
+    Tensor.__mul__ = lambda s, o: multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: multiply(o, s)
+    Tensor.__truediv__ = lambda s, o: divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: divide(o, s)
+    Tensor.__floordiv__ = lambda s, o: floor_divide(s, o)
+    Tensor.__mod__ = lambda s, o: mod(s, o)
+    Tensor.__pow__ = lambda s, o: pow(s, o)
+    Tensor.__rpow__ = lambda s, o: pow(o, s)
+    Tensor.__neg__ = lambda s: neg(s)
+    Tensor.__abs__ = lambda s: abs(s)
+    Tensor.__matmul__ = lambda s, o: matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: matmul(o, s)
+
+    def _cmp(fn):
+        def op(s, o):
+            od = o.data if isinstance(o, Tensor) else o
+            return Tensor(fn(s.data, od))
+        return op
+
+    Tensor.__eq__ = _cmp(lambda a, b: a == b)
+    Tensor.__ne__ = _cmp(lambda a, b: a != b)
+    Tensor.__lt__ = _cmp(lambda a, b: a < b)
+    Tensor.__le__ = _cmp(lambda a, b: a <= b)
+    Tensor.__gt__ = _cmp(lambda a, b: a > b)
+    Tensor.__ge__ = _cmp(lambda a, b: a >= b)
+    Tensor.__invert__ = lambda s: Tensor(jnp.logical_not(s.data))
+    Tensor.__and__ = _cmp(jnp.logical_and)
+    Tensor.__or__ = _cmp(jnp.logical_or)
+    Tensor.__xor__ = _cmp(jnp.logical_xor)
+
+
+_inject()

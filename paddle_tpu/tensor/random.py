@@ -1,0 +1,101 @@
+"""Random ops (ref: python/paddle/tensor/random.py).
+
+Keys come from framework.random.next_key(): stateful-global in eager mode,
+trace-scoped (functional) under jit — see framework/random.py.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework import random as rnd
+from ..framework.dtype import convert_dtype
+from .tensor import Tensor
+from .creation import _shape
+
+
+def _d(dtype, default=None):
+    dt = convert_dtype(dtype)
+    return dt if dt is not None else (default or dtypes.get_default_dtype())
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else rnd.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _d(dtype), min, max))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(rnd.next_key(), _shape(shape), _d(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean.data if isinstance(mean, Tensor) else mean
+        s = std.data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(rnd.next_key(), shp,
+                                        dtypes.get_default_dtype()) * s + m)
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(jax.random.normal(rnd.next_key(), shp,
+                                    dtypes.get_default_dtype()) * std + mean)
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = jax.random.key(seed) if seed else rnd.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _d(dtype)) * std + mean)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=[1], dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = _d(dtype, jnp.int64)
+    return Tensor(jax.random.randint(rnd.next_key(), _shape(shape), low, high,
+                                     dtype=dt))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.randint(rnd.next_key(), tuple(x.shape), low, high
+                                     ).astype(dt))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(rnd.next_key(), n).astype(
+        convert_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    logits = jnp.log(jnp.clip(x.data, 1e-30, None))
+    if replacement:
+        samples = jax.random.categorical(
+            rnd.next_key(), logits, axis=-1,
+            shape=(*logits.shape[:-1], num_samples) if logits.ndim > 1
+            else (num_samples,))
+    else:
+        key = rnd.next_key()
+        g = jax.random.gumbel(key, logits.shape)
+        _, samples = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(samples.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    return Tensor(jax.random.bernoulli(rnd.next_key(), x.data).astype(x.dtype))
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(rnd.next_key(), x.data).astype(x.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x.data = jax.random.exponential(rnd.next_key(), x.data.shape,
+                                    x.data.dtype) / lam
+    return x

@@ -1,0 +1,106 @@
+"""Search / sort ops (ref: python/paddle/tensor/search.py)."""
+import jax
+import jax.numpy as jnp
+
+from ..ops import apply
+from .tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..framework.dtype import convert_dtype
+    a = _t(x).data
+    res = jnp.argmax(a.reshape(-1) if axis is None else a, axis=axis)
+    if keepdim and axis is not None:
+        res = jnp.expand_dims(res, axis)
+    return Tensor(res.astype(convert_dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..framework.dtype import convert_dtype
+    a = _t(x).data
+    res = jnp.argmin(a.reshape(-1) if axis is None else a, axis=axis)
+    if keepdim and axis is not None:
+        res = jnp.expand_dims(res, axis)
+    return Tensor(res.astype(convert_dtype(dtype)))
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    a = _t(x).data
+    idx = jnp.argsort(-a if descending else a, axis=axis, stable=True)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def fn(a):
+        s = jnp.sort(a, axis=axis, stable=True)
+        return jnp.flip(s, axis=axis) if descending else s
+    return apply(fn, _t(x), name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    x = _t(x)
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+    ax = x.ndim - 1 if axis is None else axis % x.ndim
+
+    def fn(a):
+        am = jnp.moveaxis(a, ax, -1)
+        vals, idx = jax.lax.top_k(am if largest else -am, kk)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
+
+    vals, idx = apply(fn, x, n_outputs=2, name="topk")
+    return vals, Tensor(idx.data.astype(jnp.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    ss, v = _t(sorted_sequence).data, _t(values).data
+    if ss.ndim == 1:
+        res = jnp.searchsorted(ss, v, side=side)
+    else:
+        res = jax.vmap(lambda s, x: jnp.searchsorted(s, x, side=side))(
+            ss.reshape(-1, ss.shape[-1]), v.reshape(-1, v.shape[-1]))
+        res = res.reshape(v.shape)
+    return Tensor(res.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = _t(x)
+    ax = axis % x.ndim
+
+    def fn(a):
+        s = jnp.sort(a, axis=ax)
+        idx = jnp.argsort(a, axis=ax, stable=True)
+        v = jnp.take(s, k - 1, axis=ax)
+        i = jnp.take(idx, k - 1, axis=ax)
+        if keepdim:
+            v, i = jnp.expand_dims(v, ax), jnp.expand_dims(i, ax)
+        return v, i
+
+    v, i = apply(fn, x, n_outputs=2, name="kthvalue")
+    return v, Tensor(i.data.astype(jnp.int64))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    import numpy as np
+    import scipy.stats  # available via numpy ecosystems; fallback below
+    a = np.asarray(_t(x).numpy())
+    m = scipy.stats.mode(a, axis=axis, keepdims=keepdim)
+    return Tensor(m.mode), Tensor(m.count.astype(np.int64))
+
+
+def index_of_max(x):
+    return argmax(x)
+
+
+def masked_argmax(x, mask):
+    return Tensor(jnp.argmax(jnp.where(mask.data, _t(x).data, -jnp.inf)))

@@ -1,0 +1,61 @@
+"""Statistics ops (ref: python/paddle/tensor/stat.py)."""
+import jax.numpy as jnp
+
+from ..ops import apply
+from .tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _axis(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return axis
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply(lambda a: jnp.var(a, axis=ax, ddof=ddof, keepdims=keepdim),
+                 _t(x), name="var")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply(lambda a: jnp.std(a, axis=ax, ddof=ddof, keepdims=keepdim),
+                 _t(x), name="std")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jnp.median(a, axis=ax, keepdims=keepdim), _t(x))
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply(lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), _t(x))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _axis(axis)
+    qq = q.data if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply(lambda a: jnp.quantile(a, qq, axis=ax, keepdims=keepdim,
+                                        method=interpolation), _t(x))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    qq = q.data if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply(lambda a: jnp.nanquantile(a, qq, axis=ax, keepdims=keepdim), _t(x))
+
+
+def _inject():
+    for nm in ["var", "std", "median", "quantile"]:
+        if not hasattr(Tensor, nm):
+            setattr(Tensor, nm, globals()[nm])
+
+
+_inject()

@@ -1,0 +1,309 @@
+"""Tensor: the user-facing array type.
+
+TPU-native analog of the reference's eager Tensor
+(ref: paddle/phi/core/dense_tensor.h:38 DenseTensor,
+ paddle/fluid/pybind/eager_method.cc tensor methods,
+ python/paddle/fluid/dygraph/varbase_patch_methods.py:232 .backward()).
+
+A Tensor wraps a jax.Array (or tracer while inside jit). Autograd metadata
+(`stop_gradient`, `grad`, `_node`) mirrors the reference's AutogradMeta
+(paddle/fluid/eager/autograd_meta.h). paddle semantics: stop_gradient
+defaults to True; nn.Parameter flips it to False.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework.place import CPUPlace, _get_current_place
+from ..autograd import tape
+
+
+def _to_jax(data, dtype=None, place=None):
+    if isinstance(data, Tensor):
+        data = data.data
+    if isinstance(data, (jax.Array,)) or hasattr(data, "aval"):
+        arr = data
+        if dtype is not None and arr.dtype != jnp.dtype(dtype):
+            arr = arr.astype(dtype)
+        return arr
+    if isinstance(data, np.ndarray):
+        # paddle preserves explicit numpy dtypes (incl. float64)
+        return jnp.asarray(data, dtype=dtype)
+    arr = jnp.asarray(data, dtype=dtype)
+    if dtype is None and arr.dtype == jnp.float64:
+        # python floats/lists become the default float dtype (paddle semantics)
+        arr = arr.astype(dtypes.get_default_dtype())
+    return arr
+
+
+class Tensor:
+    __slots__ = ("data", "stop_gradient", "grad", "_node", "name", "persistable",
+                 "_grad_hooks", "trainable", "is_distributed", "optimize_attr",
+                 "regularizer", "need_clip", "__weakref__")
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True, name=None):
+        self.data = _to_jax(data, dtype, place)
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None  # (TapeNode, output_index) when op-produced
+        self.name = name or ""
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self.is_distributed = False
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self._grad_hooks = []
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self.data.shape)
+
+    @property
+    def ndim(self):
+        return self.data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self.data.shape)) if self.data.shape else 1
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def place(self):
+        try:
+            dev = list(self.data.devices())[0]
+            return CPUPlace() if dev.platform == "cpu" else _get_current_place()
+        except Exception:
+            return _get_current_place()
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    @property
+    def T(self):
+        from . import linalg
+        return linalg.transpose_last2(self) if self.ndim >= 2 else self
+
+    def numel(self):
+        return self.size
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self.data)
+
+    def item(self, *args):
+        return self.numpy().item(*args)
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype):
+        from . import manipulation
+        return manipulation.cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def clone(self):
+        from ..ops import apply
+        return apply(lambda x: x + 0, self)
+
+    def detach(self):
+        t = Tensor(self.data, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def cpu(self):
+        return Tensor(jax.device_get(self.data), stop_gradient=self.stop_gradient)
+
+    def cuda(self, *a, **k):
+        return self.to_device()
+
+    def tpu(self):
+        return self.to_device()
+
+    def to_device(self, place=None):
+        place = place or _get_current_place()
+        t = Tensor(jax.device_put(self.data, place.jax_device),
+                   stop_gradient=self.stop_gradient, name=self.name)
+        return t
+
+    def pin_memory(self):
+        return self
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        """ref: varbase_patch_methods.py:232 -> eager_functions.cc run_backward."""
+        tape.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad.data), stop_gradient=True)
+        else:
+            self.grad = None
+
+    def clear_grad(self):
+        self.clear_gradient()
+
+    def zero_(self):
+        self.data = jnp.zeros_like(self.data)
+        return self
+
+    def register_hook(self, hook):
+        self._grad_hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                if hook in self._grad_hooks:
+                    self._grad_hooks.remove(hook)
+
+        return _Handle()
+
+    # -- in-place-ish helpers (functional under the hood) --------------------
+    def set_value(self, value):
+        arr = _to_jax(value)
+        if tuple(arr.shape) != tuple(self.data.shape):
+            raise ValueError(
+                f"set_value shape mismatch {arr.shape} vs {self.data.shape}")
+        self.data = arr.astype(self.data.dtype)
+        return self
+
+    def copy_(self, other, *a):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        self.data = jnp.full_like(self.data, value)
+        return self
+
+    def scale_(self, scale=1.0, bias=0.0):
+        self.data = self.data * scale + bias
+        return self
+
+    def add_(self, other):
+        o = other.data if isinstance(other, Tensor) else other
+        self.data = self.data + o
+        return self
+
+    def subtract_(self, other):
+        o = other.data if isinstance(other, Tensor) else other
+        self.data = self.data - o
+        return self
+
+    def multiply_(self, other):
+        o = other.data if isinstance(other, Tensor) else other
+        self.data = self.data * o
+        return self
+
+    def clip_(self, min=None, max=None):
+        self.data = jnp.clip(self.data, min, max)
+        return self
+
+    def uniform_(self, min=-1.0, max=1.0, seed=0):
+        from ..framework import random as rnd
+        self.data = jax.random.uniform(rnd.next_key(), self.data.shape,
+                                       self.data.dtype, min, max)
+        return self
+
+    def normal_(self, mean=0.0, std=1.0):
+        from ..framework import random as rnd
+        self.data = (jax.random.normal(rnd.next_key(), self.data.shape,
+                                       self.data.dtype) * std + mean)
+        return self
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, idx):
+        from ..ops import apply
+        idx = _index_to_raw(idx)
+        return apply(lambda x: x[idx], self, name="getitem")
+
+    def __setitem__(self, idx, value):
+        idx = _index_to_raw(idx)
+        from ..ops import apply
+        v = value if isinstance(value, Tensor) else Tensor(_to_jax(value))
+        out = apply(lambda x, val: x.at[idx].set(val.astype(x.dtype)), self, v,
+                    name="setitem")
+        # In-place semantics: this tensor now aliases the op output.
+        self.data = out.data
+        self._node = out._node
+        self.stop_gradient = out.stop_gradient
+        return self
+
+    # -- dunder -------------------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.data.shape[0]
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        return (f"Tensor(shape={self.shape}, dtype={jnp.dtype(self.dtype).name}, "
+                f"stop_gradient={sg},\n       {np.asarray(jax.device_get(self.data))!r})")
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+    def __hash__(self):
+        return id(self)
+
+    # Arithmetic dunders are injected by tensor.math (monkeypatch, the same
+    # way the reference patches methods onto the pybind Tensor —
+    # ref: python/paddle/fluid/dygraph/math_op_patch.py).
+
+    # numpy interop
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+
+def _index_to_raw(idx):
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i.data
+        return i
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)
+
+
+# Register Tensor as a pytree so jit/shard_map can consume Tensor pytrees.
+jax.tree_util.register_pytree_node(
+    Tensor,
+    lambda t: ((t.data,), (t.stop_gradient, t.name)),
+    lambda aux, children: Tensor(children[0], stop_gradient=aux[0], name=aux[1]),
+)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor (ref: python/paddle/tensor/creation.py to_tensor)."""
+    if isinstance(data, Tensor):
+        t = Tensor(data.data, dtype=dtype, stop_gradient=stop_gradient)
+        return t
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

@@ -1,0 +1,5 @@
+"""paddle.vision analog (ref: python/paddle/vision/)."""
+from . import models
+from . import transforms
+from . import datasets
+from . import ops

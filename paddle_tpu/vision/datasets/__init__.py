@@ -1,0 +1,53 @@
+"""vision.datasets (ref: python/paddle/vision/datasets/) — offline synthetic
+variants (zero-egress environment: no downloads)."""
+import numpy as np
+
+from ...io import Dataset
+
+
+class FakeData(Dataset):
+    """Synthetic stand-in for image datasets (deterministic per index)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=1000,
+                 transform=None):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = np.asarray(rng.randint(0, self.num_classes), np.int64)
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.size
+
+
+class MNIST(FakeData):
+    """Synthetic MNIST-shaped dataset (no network egress for real data)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        super().__init__(size=60000 if mode == "train" else 10000,
+                         image_shape=(1, 28, 28), num_classes=10,
+                         transform=transform)
+
+
+class Cifar10(FakeData):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        super().__init__(size=50000 if mode == "train" else 10000,
+                         image_shape=(3, 32, 32), num_classes=10,
+                         transform=transform)
+
+
+class Cifar100(FakeData):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        super().__init__(size=50000 if mode == "train" else 10000,
+                         image_shape=(3, 32, 32), num_classes=100,
+                         transform=transform)

@@ -1,0 +1,84 @@
+"""vision.ops (ref: python/paddle/vision/ops.py — roi_align, nms,
+deform_conv2d)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor.tensor import Tensor
+from ..ops import apply
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Non-maximum suppression. Host-side loop (inference utility)."""
+    b = boxes.numpy()
+    if scores is None:
+        order = np.arange(b.shape[0])
+    else:
+        order = np.argsort(-scores.numpy())
+    keep = []
+    suppressed = np.zeros(b.shape[0], bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for _i in order:
+        if suppressed[_i]:
+            continue
+        keep.append(_i)
+        xx1 = np.maximum(b[_i, 0], b[:, 0])
+        yy1 = np.maximum(b[_i, 1], b[:, 1])
+        xx2 = np.minimum(b[_i, 2], b[:, 2])
+        yy2 = np.minimum(b[_i, 3], b[:, 3])
+        w = np.maximum(0.0, xx2 - xx1)
+        h = np.maximum(0.0, yy2 - yy1)
+        inter = w * h
+        iou = inter / (areas[_i] + areas - inter + 1e-10)
+        suppressed |= iou > iou_threshold
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(keep)
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0):
+    raise NotImplementedError("box_coder: planned")
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Bilinear ROI align via jax map (detection models)."""
+    os_ = output_size if isinstance(output_size, (list, tuple)) \
+        else (output_size, output_size)
+
+    def one_roi(feat, box):
+        x1, y1, x2, y2 = box * spatial_scale
+        if aligned:
+            x1, y1, x2, y2 = x1 - 0.5, y1 - 0.5, x2 - 0.5, y2 - 0.5
+        ys = y1 + (jnp.arange(os_[0]) + 0.5) * (y2 - y1) / os_[0]
+        xs = x1 + (jnp.arange(os_[1]) + 0.5) * (x2 - x1) / os_[1]
+        def bilinear(c):
+            yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y1_, x1_ = y0 + 1, x0 + 1
+            H, W = c.shape
+            y0c = jnp.clip(y0, 0, H - 1); y1c = jnp.clip(y1_, 0, H - 1)
+            x0c = jnp.clip(x0, 0, W - 1); x1c = jnp.clip(x1_, 0, W - 1)
+            wy1 = yy - y0; wx1 = xx - x0
+            v = (c[y0c, x0c] * (1 - wy1) * (1 - wx1) +
+                 c[y0c, x1c] * (1 - wy1) * wx1 +
+                 c[y1c, x0c] * wy1 * (1 - wx1) +
+                 c[y1c, x1c] * wy1 * wx1)
+            return v
+        return jax.vmap(bilinear)(feat)
+
+    feats = x.data
+    bxs = boxes.data
+    bn = boxes_num.numpy() if isinstance(boxes_num, Tensor) else np.asarray(boxes_num)
+    outs = []
+    start = 0
+    for img_idx, n in enumerate(bn.tolist()):
+        for bi in range(n):
+            outs.append(one_roi(feats[img_idx], bxs[start + bi]))
+        start += n
+    return Tensor(jnp.stack(outs)) if outs else Tensor(
+        jnp.zeros((0, feats.shape[1], *os_), feats.dtype))

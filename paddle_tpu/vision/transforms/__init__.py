@@ -1,0 +1,158 @@
+"""vision.transforms (ref: python/paddle/vision/transforms/) — numpy-based
+host-side preprocessing."""
+import numbers
+
+import numpy as np
+
+from ...tensor.tensor import Tensor
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 -> CHW float32 [0,1]."""
+
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if arr.dtype == np.uint8:
+            arr = arr.astype(np.float32) / 255.0
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return Tensor(arr.astype(np.float32))
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        if isinstance(img, Tensor):
+            arr = img.numpy()
+        else:
+            arr = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            m = self.mean.reshape(-1, 1, 1)
+            s = self.std.reshape(-1, 1, 1)
+        else:
+            m = self.mean
+            s = self.std
+        out = (arr - m) / s
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def _apply_image(self, img):
+        import jax
+        import jax.numpy as jnp
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[2] not in (1, 3)
+        if chw:
+            out_shape = (arr.shape[0],) + tuple(self.size)
+        elif arr.ndim == 3:
+            out_shape = tuple(self.size) + (arr.shape[2],)
+        else:
+            out_shape = tuple(self.size)
+        res = jax.image.resize(jnp.asarray(arr, jnp.float32), out_shape,
+                               "bilinear")
+        return np.asarray(res).astype(arr.dtype if arr.dtype != np.uint8
+                                      else np.float32)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            arr = np.asarray(img)
+            return arr[..., ::-1].copy() if arr.ndim == 3 else arr[:, ::-1].copy()
+        return img
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        h_axis = 0 if arr.ndim == 2 or arr.shape[2] in (1, 3) else 1
+        if self.padding:
+            p = self.padding
+            widths = [(p, p)] * 2 + ([(0, 0)] if arr.ndim == 3 else [])
+            arr = np.pad(arr, widths)
+        h, w = arr.shape[h_axis], arr.shape[h_axis + 1]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return arr[i:i + th, j:j + tw]
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[0], arr.shape[1]
+        th, tw = self.size
+        i = (h - th) // 2
+        j = (w - tw) // 2
+        return arr[i:i + th, j:j + tw]
+
+
+def to_tensor(pic, data_format="CHW"):
+    return ToTensor(data_format)(pic)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def hflip(img):
+    arr = np.asarray(img)
+    return arr[..., ::-1].copy()

@@ -1,0 +1,18 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax import
+(SURVEY §4: the TPU analog of the reference's gloo/multi-process CPU tests)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    yield

@@ -1,0 +1,162 @@
+"""Autograd engine tests (ref harness: op_test.py check_grad — analytic vs
+reference grads)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestBackward:
+    def test_scalar_chain(self):
+        x = paddle.to_tensor(3.0, stop_gradient=False)
+        y = x * x + 2.0 * x  # dy/dx = 2x + 2 = 8
+        y.backward()
+        assert abs(x.grad.item() - 8.0) < 1e-6
+
+    def test_matmul_grad(self):
+        a = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        b = np.random.RandomState(1).randn(4, 5).astype(np.float32)
+        ta = paddle.to_tensor(a, stop_gradient=False)
+        tb = paddle.to_tensor(b, stop_gradient=False)
+        loss = paddle.sum(paddle.matmul(ta, tb))
+        loss.backward()
+        np.testing.assert_allclose(ta.grad.numpy(),
+                                   np.ones((3, 5)) @ b.T, rtol=1e-5)
+        np.testing.assert_allclose(tb.grad.numpy(),
+                                   a.T @ np.ones((3, 5)), rtol=1e-5)
+
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        (x * 3.0).backward()
+        (x * 4.0).backward()
+        assert abs(x.grad.item() - 7.0) < 1e-6
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = paddle.to_tensor(3.0)  # stop_gradient=True
+        z = x * y
+        z.backward()
+        assert abs(x.grad.item() - 3.0) < 1e-6
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = (x * x).detach()
+        z = y * x
+        z.backward()
+        assert abs(x.grad.item() - 4.0) < 1e-6  # y treated as constant
+
+    def test_branching_graph(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        a = x * 3.0
+        b = x * 4.0
+        (a + b).backward()
+        assert abs(x.grad.item() - 7.0) < 1e-6
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = x * x
+        y.backward(retain_graph=True)
+        y.backward()
+        assert abs(x.grad.item() - 8.0) < 1e-6
+
+    def test_double_backward_raises_without_retain(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = x * x
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_non_scalar_needs_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2.0
+        with pytest.raises(RuntimeError):
+            y.backward()
+        y2 = x * 2.0
+        y2.backward(paddle.ones([2]))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+    def test_numeric_gradient_check(self):
+        """Finite-difference check (the OpTest check_grad analog)."""
+        rng = np.random.RandomState(3)
+        a = rng.randn(4, 4).astype(np.float64)
+
+        def f(arr):
+            t = paddle.to_tensor(arr, stop_gradient=False)
+            loss = paddle.sum(paddle.tanh(paddle.matmul(t, t)))
+            return t, loss
+
+        t, loss = f(a)
+        loss.backward()
+        analytic = t.grad.numpy()
+        eps = 1e-6
+        num = np.zeros_like(a)
+        for i in range(4):
+            for j in range(4):
+                ap = a.copy(); ap[i, j] += eps
+                am = a.copy(); am[i, j] -= eps
+                num[i, j] = (f(ap)[1].item() - f(am)[1].item()) / (2 * eps)
+        np.testing.assert_allclose(analytic, num, rtol=1e-4, atol=1e-6)
+
+
+class TestGradAPI:
+    def test_paddle_grad(self):
+        x = paddle.to_tensor(3.0, stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        assert abs(gx.item() - 6.0) < 1e-6
+        assert x.grad is None  # .grad untouched
+
+    def test_no_grad(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        with paddle.no_grad():
+            y = x * x
+        assert y._node is None
+        assert y.stop_gradient
+
+
+class TestHooks:
+    def test_grad_hook(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        seen = []
+
+        def hook(g):
+            seen.append(g.item())
+            return g * 2.0
+
+        x.register_hook(hook)
+        (x * 3.0).backward()
+        assert seen == [3.0]
+        assert abs(x.grad.item() - 6.0) < 1e-6
+
+
+class TestPyLayer:
+    def test_custom_fwd_bwd(self):
+        class Double(paddle.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2.0
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor()
+                return grad * 2.0
+
+        x = paddle.to_tensor(3.0, stop_gradient=False)
+        y = Double.apply(x)
+        assert abs(y.item() - 6.0) < 1e-6
+        y.backward()
+        assert abs(x.grad.item() - 2.0) < 1e-6
+
+
+class TestFunctionalAutograd:
+    def test_vjp_jvp(self):
+        from paddle_tpu.incubate import autograd as fa
+        x = paddle.to_tensor([1.0, 2.0])
+
+        def f(t):
+            return paddle.sum(t * t)
+
+        out, (g,) = fa.vjp(f, [x])
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0])

@@ -1,0 +1,222 @@
+"""Op-level numeric tests vs numpy (the OpTest analog,
+ref: python/paddle/fluid/tests/unittests/op_test.py:326)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def np_t(t):
+    return t.numpy()
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert np_t(paddle.zeros([2, 3])).sum() == 0
+        assert np_t(paddle.ones([2, 3])).sum() == 6
+        np.testing.assert_allclose(np_t(paddle.full([2, 2], 3.5)), 3.5)
+
+    def test_arange_linspace(self):
+        np.testing.assert_array_equal(np_t(paddle.arange(5)), np.arange(5))
+        np.testing.assert_allclose(np_t(paddle.linspace(0, 1, 5)),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+
+    def test_eye_diag(self):
+        np.testing.assert_array_equal(np_t(paddle.eye(3)), np.eye(3,
+                                      dtype=np.float32))
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        np.testing.assert_array_equal(np_t(paddle.diag(x)),
+                                      np.diag([1.0, 2.0, 3.0]).astype(np.float32))
+
+    def test_dtype_defaults(self):
+        assert paddle.to_tensor([1.0]).dtype == np.float32
+        assert paddle.arange(3).dtype == np.int64
+
+
+class TestMath:
+    def setup_method(self, m):
+        self.rng = np.random.RandomState(0)
+
+    def test_binary_ops(self):
+        a = self.rng.randn(3, 4).astype(np.float32)
+        b = self.rng.randn(3, 4).astype(np.float32)
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_allclose(np_t(ta + tb), a + b, rtol=1e-6)
+        np.testing.assert_allclose(np_t(ta - tb), a - b, rtol=1e-6)
+        np.testing.assert_allclose(np_t(ta * tb), a * b, rtol=1e-6)
+        np.testing.assert_allclose(np_t(ta / tb), a / b, rtol=1e-5)
+        np.testing.assert_allclose(np_t(paddle.maximum(ta, tb)),
+                                   np.maximum(a, b))
+
+    def test_scalar_ops_keep_dtype(self):
+        a = paddle.to_tensor(np.ones((2, 2), np.float32))
+        assert (a * 2.0).dtype == np.float32
+        assert (2.0 * a).dtype == np.float32
+        assert (a + 1).dtype == np.float32
+
+    def test_unary(self):
+        a = np.abs(self.rng.randn(3, 4)).astype(np.float32) + 0.1
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(np_t(paddle.log(t)), np.log(a), rtol=2e-4)
+        np.testing.assert_allclose(np_t(paddle.sqrt(t)), np.sqrt(a), rtol=1e-4)
+        np.testing.assert_allclose(np_t(paddle.exp(t)), np.exp(a), rtol=2e-4)
+        np.testing.assert_allclose(np_t(paddle.tanh(t)), np.tanh(a), rtol=1e-4)
+
+    def test_reductions(self):
+        a = self.rng.randn(3, 4, 5).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(np_t(paddle.sum(t)), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(np_t(paddle.mean(t, axis=1)),
+                                   a.mean(1), rtol=1e-5)
+        np.testing.assert_allclose(np_t(paddle.max(t, axis=[0, 2])),
+                                   a.max((0, 2)))
+        np.testing.assert_allclose(
+            np_t(paddle.sum(t, axis=1, keepdim=True)), a.sum(1, keepdims=True),
+            rtol=1e-5)
+
+    def test_matmul(self):
+        a = self.rng.randn(2, 3, 4).astype(np.float32)
+        b = self.rng.randn(2, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(
+            np_t(paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b))),
+            a @ b, rtol=1e-5)
+        np.testing.assert_allclose(
+            np_t(paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b.swapaxes(
+                -1, -2)), transpose_y=True)), a @ b, rtol=1e-5)
+
+    def test_einsum(self):
+        a = self.rng.randn(3, 4).astype(np.float32)
+        b = self.rng.randn(4, 5).astype(np.float32)
+        np.testing.assert_allclose(
+            np_t(paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                               paddle.to_tensor(b))), a @ b, rtol=1e-5)
+
+    def test_cumsum_clip(self):
+        a = self.rng.randn(3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(np_t(paddle.cumsum(t, axis=1)),
+                                   np.cumsum(a, 1), rtol=1e-5)
+        np.testing.assert_allclose(np_t(paddle.clip(t, -0.5, 0.5)),
+                                   np.clip(a, -0.5, 0.5))
+
+
+class TestManipulation:
+    def setup_method(self, m):
+        self.rng = np.random.RandomState(1)
+
+    def test_reshape_transpose(self):
+        a = self.rng.randn(2, 3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_array_equal(np_t(paddle.reshape(t, [6, 4])),
+                                      a.reshape(6, 4))
+        np.testing.assert_array_equal(np_t(paddle.transpose(t, [2, 0, 1])),
+                                      a.transpose(2, 0, 1))
+        np.testing.assert_array_equal(np_t(paddle.flatten(t, 1)), a.reshape(2, 12))
+
+    def test_concat_split_stack(self):
+        a = self.rng.randn(2, 3).astype(np.float32)
+        b = self.rng.randn(2, 3).astype(np.float32)
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_array_equal(np_t(paddle.concat([ta, tb], axis=0)),
+                                      np.concatenate([a, b], 0))
+        np.testing.assert_array_equal(np_t(paddle.stack([ta, tb], axis=1)),
+                                      np.stack([a, b], 1))
+        parts = paddle.split(paddle.to_tensor(a), 3, axis=1)
+        assert len(parts) == 3
+        np.testing.assert_array_equal(np_t(parts[1]), a[:, 1:2])
+        parts = paddle.split(paddle.to_tensor(a), [1, 2], axis=1)
+        np.testing.assert_array_equal(np_t(parts[1]), a[:, 1:])
+
+    def test_gather_scatter(self):
+        a = self.rng.randn(5, 3).astype(np.float32)
+        idx = np.asarray([0, 2, 4])
+        t = paddle.to_tensor(a)
+        np.testing.assert_array_equal(
+            np_t(paddle.gather(t, paddle.to_tensor(idx), axis=0)), a[idx])
+        upd = np.ones((3, 3), np.float32)
+        out = paddle.scatter(t, paddle.to_tensor(idx), paddle.to_tensor(upd))
+        expect = a.copy()
+        expect[idx] = 1.0
+        np.testing.assert_array_equal(np_t(out), expect)
+
+    def test_where_masked(self):
+        a = self.rng.randn(3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        out = paddle.where(t > 0, t, paddle.zeros_like(t))
+        np.testing.assert_array_equal(np_t(out), np.where(a > 0, a, 0))
+
+    def test_squeeze_unsqueeze_tile(self):
+        a = self.rng.randn(2, 1, 3).astype(np.float32)
+        t = paddle.to_tensor(a)
+        assert paddle.squeeze(t, 1).shape == [2, 3]
+        assert paddle.unsqueeze(t, 0).shape == [1, 2, 1, 3]
+        np.testing.assert_array_equal(np_t(paddle.tile(t, [1, 2, 1])),
+                                      np.tile(a, (1, 2, 1)))
+
+    def test_getitem_setitem(self):
+        a = self.rng.randn(4, 5).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_array_equal(np_t(t[1:3, ::2]), a[1:3, ::2])
+        t[0] = 0.0
+        a[0] = 0.0
+        np.testing.assert_array_equal(np_t(t), a)
+
+
+class TestSearchSort:
+    def test_topk_argmax(self):
+        a = np.asarray([[1.0, 5.0, 3.0], [2.0, 0.0, 4.0]], np.float32)
+        t = paddle.to_tensor(a)
+        vals, idx = paddle.topk(t, 2)
+        np.testing.assert_array_equal(vals.numpy(), [[5.0, 3.0], [4.0, 2.0]])
+        np.testing.assert_array_equal(idx.numpy(), [[1, 2], [2, 0]])
+        np.testing.assert_array_equal(paddle.argmax(t, axis=1).numpy(), [1, 2])
+
+    def test_sort_argsort(self):
+        a = np.asarray([3.0, 1.0, 2.0], np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_array_equal(paddle.sort(t).numpy(), [1.0, 2.0, 3.0])
+        np.testing.assert_array_equal(paddle.argsort(t).numpy(), [1, 2, 0])
+
+
+class TestLinalg:
+    def test_inverse_solve(self):
+        a = np.asarray([[2.0, 0.0], [1.0, 3.0]], np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.inverse(t).numpy(), np.linalg.inv(a),
+                                   rtol=1e-5)
+        b = np.asarray([[1.0], [2.0]], np.float32)
+        np.testing.assert_allclose(
+            paddle.linalg.solve(t, paddle.to_tensor(b)).numpy(),
+            np.linalg.solve(a, b), rtol=1e-5)
+
+    def test_norm(self):
+        a = np.asarray([[3.0, 4.0]], np.float32)
+        assert abs(paddle.norm(paddle.to_tensor(a)).item() - 5.0) < 1e-5
+
+
+class TestRandom:
+    def test_seeded_determinism(self):
+        paddle.seed(42)
+        a = paddle.randn([4, 4]).numpy()
+        paddle.seed(42)
+        b = paddle.randn([4, 4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_uniform_range(self):
+        x = paddle.uniform([1000], min=2.0, max=3.0).numpy()
+        assert x.min() >= 2.0 and x.max() <= 3.0
+
+    def test_randperm(self):
+        p = paddle.randperm(10).numpy()
+        np.testing.assert_array_equal(np.sort(p), np.arange(10))
+
+
+class TestSaveLoad:
+    def test_roundtrip(self, tmp_path):
+        obj = {"w": paddle.randn([3, 3]), "step": 7, "nested": [paddle.ones([2])]}
+        path = str(tmp_path / "ckpt.pdparams")
+        paddle.save(obj, path)
+        loaded = paddle.load(path)
+        np.testing.assert_array_equal(loaded["w"].numpy(), obj["w"].numpy())
+        assert loaded["step"] == 7
+        np.testing.assert_array_equal(loaded["nested"][0].numpy(), [1.0, 1.0])

@@ -119,7 +119,7 @@ def _c_softmax_with_cross_entropy(logits, label, group=None,
         vocab_local = lg.shape[-1]
         # global max for stability
         local_max = jnp.max(lg, axis=-1, keepdims=True)
-        gmax = lax.pmax(local_max, axis)
+        gmax = lax.pmax(jax.lax.stop_gradient(local_max), axis)
         shifted = lg - gmax
         exp = jnp.exp(shifted)
         local_sum = jnp.sum(exp, axis=-1, keepdims=True)

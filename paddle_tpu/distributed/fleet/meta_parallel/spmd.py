@@ -100,7 +100,7 @@ def spmd_forward(layer, inputs, in_specs=None, out_spec=None, mesh=None,
         inner, mesh=mesh,
         in_specs=(P(),) + tuple(pspecs) + tuple(in_specs),
         out_specs=out_spec,
-        check_rep=False,
+        check_vma=True,
     )
     key = frnd.next_key()
     return apply(lambda *arrs: smapped(key, *arrs), *ptensors, *inputs,

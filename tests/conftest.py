@@ -1,12 +1,12 @@
-"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax import
-(SURVEY §4: the TPU analog of the reference's gloo/multi-process CPU tests)."""
-import os
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE any jax
+computation (SURVEY §4: the TPU analog of the reference's gloo/multi-process
+CPU tests). The environment pins JAX_PLATFORMS=axon, so we override via
+config (which beats the env var) right after importing jax.
+"""
+import jax
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 

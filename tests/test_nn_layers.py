@@ -1,0 +1,252 @@
+"""nn.Layer + layer zoo tests (ref: unittests/test_layers.py family)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+class TestLayerBase:
+    def test_parameters_and_naming(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+        assert len(net.parameters()) == 4
+        assert all(not p.stop_gradient for p in net.parameters())
+
+    def test_state_dict_roundtrip(self, tmp_path):
+        net = nn.Linear(3, 3)
+        sd = net.state_dict()
+        assert set(sd) == {"weight", "bias"}
+        net2 = nn.Linear(3, 3)
+        net2.set_state_dict(sd)
+        np.testing.assert_array_equal(net2.weight.numpy(), net.weight.numpy())
+        paddle.save(net.state_dict(), str(tmp_path / "m.pdparams"))
+        loaded = paddle.load(str(tmp_path / "m.pdparams"))
+        net3 = nn.Linear(3, 3)
+        missing, unexpected = net3.set_state_dict(loaded)
+        assert not missing and not unexpected
+        np.testing.assert_array_equal(net3.weight.numpy(), net.weight.numpy())
+
+    def test_train_eval_dropout(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([100, 100])
+        d.train()
+        y = d(x)
+        assert (y.numpy() == 0).any()
+        d.eval()
+        y = d(x)
+        np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+    def test_hooks(self):
+        net = nn.Linear(2, 2)
+        calls = []
+        h = net.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        net(paddle.ones([1, 2]))
+        assert calls == [1]
+        h.remove()
+        net(paddle.ones([1, 2]))
+        assert calls == [1]
+
+    def test_sublayers_containers(self):
+        seq = nn.Sequential(nn.Linear(2, 3), nn.ReLU(), nn.Linear(3, 1))
+        assert len(seq) == 3
+        out = seq(paddle.ones([4, 2]))
+        assert out.shape == [4, 1]
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(list(ll.parameters())) == 6
+
+    def test_to_dtype(self):
+        net = nn.Linear(2, 2)
+        net.bfloat16()
+        assert net.weight.dtype == paddle.bfloat16
+
+
+class TestFunctional:
+    def setup_method(self, m):
+        self.rng = np.random.RandomState(0)
+
+    def test_activations_vs_numpy(self):
+        a = self.rng.randn(3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(a, 0))
+        np.testing.assert_allclose(F.sigmoid(t).numpy(), 1 / (1 + np.exp(-a)),
+                                   rtol=1e-4)
+        sm = F.softmax(t, axis=-1).numpy()
+        np.testing.assert_allclose(sm.sum(-1), np.ones(3), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.log_softmax(t).numpy(), np.log(sm), rtol=1e-4, atol=1e-5)
+
+    def test_linear(self):
+        x = self.rng.randn(5, 3).astype(np.float32)
+        w = self.rng.randn(3, 4).astype(np.float32)
+        b = self.rng.randn(4).astype(np.float32)
+        out = F.linear(paddle.to_tensor(x), paddle.to_tensor(w),
+                       paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5)
+
+    def test_conv2d_identity_kernel(self):
+        x = self.rng.randn(1, 2, 5, 5).astype(np.float32)
+        w = np.zeros((2, 2, 1, 1), np.float32)
+        w[0, 0] = 1
+        w[1, 1] = 1
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w))
+        np.testing.assert_allclose(out.numpy(), x, rtol=1e-6)
+
+    def test_conv2d_vs_manual(self):
+        x = self.rng.randn(2, 3, 8, 8).astype(np.float32)
+        w = self.rng.randn(4, 3, 3, 3).astype(np.float32)
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), stride=2,
+                       padding=1)
+        assert out.shape == [2, 4, 4, 4]
+        # check one output element by hand
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        manual = (xp[0, :, 0:3, 0:3] * w[1]).sum()
+        np.testing.assert_allclose(out.numpy()[0, 1, 0, 0], manual, rtol=1e-4)
+
+    def test_pools(self):
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        mp = F.max_pool2d(x, 2)
+        np.testing.assert_array_equal(mp.numpy().reshape(2, 2),
+                                      [[5, 7], [13, 15]])
+        ap = F.avg_pool2d(x, 2)
+        np.testing.assert_allclose(ap.numpy().reshape(2, 2),
+                                   [[2.5, 4.5], [10.5, 12.5]])
+        gp = F.adaptive_avg_pool2d(x, 1)
+        np.testing.assert_allclose(gp.numpy().reshape(()), 7.5)
+
+    def test_layer_norm(self):
+        x = self.rng.randn(2, 5).astype(np.float32)
+        out = F.layer_norm(paddle.to_tensor(x), 5).numpy()
+        np.testing.assert_allclose(out.mean(-1), np.zeros(2), atol=1e-5)
+        np.testing.assert_allclose(out.std(-1), np.ones(2), atol=1e-2)
+
+    def test_rms_norm(self):
+        x = self.rng.randn(2, 8).astype(np.float32)
+        w = np.ones(8, np.float32) * 2.0
+        out = F.rms_norm(paddle.to_tensor(x), paddle.to_tensor(w)).numpy()
+        expect = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * 2.0
+        np.testing.assert_allclose(out, expect, rtol=1e-4)
+
+    def test_batch_norm_train_updates_stats(self):
+        bn = nn.BatchNorm2D(3)
+        x = paddle.to_tensor(self.rng.randn(4, 3, 2, 2).astype(np.float32) + 5)
+        bn.train()
+        _ = bn(x)
+        assert bn._mean.numpy().mean() > 0.1  # moved toward 5
+        bn.eval()
+        y = bn(x)
+        assert y.shape == [4, 3, 2, 2]
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        ids = paddle.to_tensor(np.asarray([[1, 0, 3]], np.int64))
+        out = emb(ids)
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_array_equal(out.numpy()[0, 1], np.zeros(4))
+
+    def test_cross_entropy(self):
+        logits = paddle.to_tensor(
+            np.asarray([[2.0, 1.0, 0.1], [0.5, 2.5, 0.2]], np.float32))
+        labels = paddle.to_tensor(np.asarray([0, 1], np.int64))
+        loss = F.cross_entropy(logits, labels)
+        a = logits.numpy()
+        lse = np.log(np.exp(a).sum(-1))
+        expect = (lse - a[[0, 1], [0, 1]]).mean()
+        np.testing.assert_allclose(loss.item(), expect, rtol=1e-4)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = paddle.to_tensor(self.rng.randn(4, 5).astype(np.float32))
+        labels = paddle.to_tensor(np.asarray([1, -100, 2, -100], np.int64))
+        loss = F.cross_entropy(logits, labels, ignore_index=-100)
+        l_all = F.cross_entropy(logits, labels, ignore_index=-100,
+                                reduction="none").numpy()
+        assert l_all[1] == 0 and l_all[3] == 0
+        np.testing.assert_allclose(loss.item(), (l_all[0] + l_all[2]) / 2,
+                                   rtol=1e-5)
+
+    def test_interpolate(self):
+        x = paddle.ones([1, 1, 4, 4])
+        out = F.interpolate(x, size=[8, 8], mode="nearest")
+        assert out.shape == [1, 1, 8, 8]
+
+    def test_sdpa_matches_manual(self):
+        b, s, h, d = 2, 6, 2, 8
+        q = self.rng.randn(b, s, h, d).astype(np.float32)
+        k = self.rng.randn(b, s, h, d).astype(np.float32)
+        v = self.rng.randn(b, s, h, d).astype(np.float32)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            is_causal=True).numpy()
+        # manual reference
+        qT, kT, vT = [x.transpose(0, 2, 1, 3) for x in (q, k, v)]
+        logits = qT @ kT.transpose(0, 1, 3, 2) / np.sqrt(d)
+        mask = np.tril(np.ones((s, s), bool))
+        logits = np.where(mask, logits, -1e9)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        expect = (p @ vT).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+class TestTransformer:
+    def test_encoder_shapes_and_grad(self):
+        layer = nn.TransformerEncoderLayer(d_model=16, nhead=4,
+                                           dim_feedforward=32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = paddle.randn([2, 5, 16])
+        x.stop_gradient = False
+        out = enc(x)
+        assert out.shape == [2, 5, 16]
+        loss = paddle.sum(out * out)
+        loss.backward()
+        p = enc.layers[0].self_attn.q_proj.weight
+        assert p.grad is not None and abs(p.grad.numpy()).sum() > 0
+
+    def test_full_transformer(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32,
+                               dropout=0.0)
+        src = paddle.randn([2, 4, 16])
+        tgt = paddle.randn([2, 3, 16])
+        out = model(src, tgt)
+        assert out.shape == [2, 3, 16]
+
+    def test_mha_kv_cache(self):
+        mha = nn.MultiHeadAttention(16, 4, dropout=0.0)
+        x = paddle.randn([1, 4, 16])
+        cache = mha.gen_cache(x, type=nn.MultiHeadAttention.Cache)
+        step1 = paddle.randn([1, 1, 16])
+        out1, cache = mha(step1, step1, step1, None, cache)
+        assert cache.k.shape[1] == 1
+        step2 = paddle.randn([1, 1, 16])
+        out2, cache = mha(step2, step2, step2, None, cache)
+        assert cache.k.shape[1] == 2
+
+
+class TestRNN:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(8, 16, num_layers=1)
+        x = paddle.randn([2, 5, 8])
+        out, (h, c) = lstm(x)
+        assert out.shape == [2, 5, 16]
+        assert h.shape == [1, 2, 16]
+
+    def test_gru_grad(self):
+        gru = nn.GRU(4, 8)
+        x = paddle.randn([2, 3, 4])
+        out, h = gru(x)
+        loss = paddle.sum(out)
+        loss.backward()
+        assert gru._cells[0].weight_ih.grad is not None

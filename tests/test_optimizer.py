@@ -1,0 +1,172 @@
+"""Optimizer/LR/clip/AMP tests (ref: unittests/test_adam_op.py,
+test_sgd_op.py, test_grad_clip*, test_amp*)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+import paddle_tpu.nn.functional as F
+
+
+def quad_problem(opt_factory, steps=50):
+    """Minimize ||w - 3||^2; returns final w."""
+    w = nn.Parameter(np.zeros(4, np.float32))
+    opt = opt_factory([w])
+    for _ in range(steps):
+        loss = paddle.sum((w - 3.0) * (w - 3.0))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return w.numpy()
+
+
+class TestOptimizers:
+    def test_sgd_converges(self):
+        w = quad_problem(lambda p: optimizer.SGD(0.1, parameters=p))
+        np.testing.assert_allclose(w, 3.0, atol=1e-3)
+
+    def test_momentum_converges(self):
+        w = quad_problem(lambda p: optimizer.Momentum(0.05, 0.9, parameters=p),
+                         steps=150)
+        np.testing.assert_allclose(w, 3.0, atol=1e-2)
+
+    def test_adam_converges(self):
+        w = quad_problem(lambda p: optimizer.Adam(0.3, parameters=p), 100)
+        np.testing.assert_allclose(w, 3.0, atol=1e-2)
+
+    def test_adamw_decoupled_decay(self):
+        # with huge decay, weights shrink toward 0 even with zero grad
+        w = nn.Parameter(np.ones(4, np.float32))
+        opt = optimizer.AdamW(0.1, parameters=[w], weight_decay=0.5)
+        w.grad = paddle.zeros([4])
+        opt.step()
+        assert (w.numpy() < 1.0).all()
+
+    def test_adam_matches_reference_formula(self):
+        w0 = np.asarray([1.0, 2.0], np.float32)
+        g = np.asarray([0.5, -1.0], np.float32)
+        w = nn.Parameter(w0.copy())
+        opt = optimizer.Adam(learning_rate=0.01, parameters=[w])
+        w.grad = paddle.to_tensor(g)
+        opt.step()
+        m = 0.1 * g
+        v = 0.001 * g * g
+        mhat = m / (1 - 0.9)
+        vhat = v / (1 - 0.999)
+        expect = w0 - 0.01 * mhat / (np.sqrt(vhat) + 1e-8)
+        np.testing.assert_allclose(w.numpy(), expect, rtol=1e-5)
+
+    def test_state_dict_roundtrip(self):
+        w = nn.Parameter(np.ones(3, np.float32))
+        opt = optimizer.Adam(0.01, parameters=[w])
+        w.grad = paddle.ones([3])
+        opt.step()
+        sd = opt.state_dict()
+        opt2 = optimizer.Adam(0.01, parameters=[w])
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == 1
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sched = optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(sched())
+            sched.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    def test_warmup(self):
+        sched = optimizer.lr.LinearWarmup(0.1, warmup_steps=5, start_lr=0.0,
+                                          end_lr=0.1)
+        vals = []
+        for _ in range(7):
+            vals.append(sched())
+            sched.step()
+        assert vals[0] == 0.0 and abs(vals[5] - 0.1) < 1e-9
+
+    def test_cosine(self):
+        sched = optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        sched.step(10)
+        assert abs(sched() - 0.0) < 1e-9
+
+    def test_optimizer_uses_scheduler(self):
+        w = nn.Parameter(np.zeros(1, np.float32))
+        sched = optimizer.lr.StepDecay(0.5, step_size=1, gamma=0.1)
+        opt = optimizer.SGD(sched, parameters=[w])
+        w.grad = paddle.ones([1])
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [-0.5], rtol=1e-6)
+        sched.step()
+        w.grad = paddle.ones([1])
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [-0.55], rtol=1e-5)
+
+
+class TestGradClip:
+    def test_clip_by_global_norm(self):
+        w1 = nn.Parameter(np.zeros(2, np.float32))
+        w2 = nn.Parameter(np.zeros(2, np.float32))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        opt = optimizer.SGD(1.0, parameters=[w1, w2], grad_clip=clip)
+        w1.grad = paddle.to_tensor(np.asarray([3.0, 0.0], np.float32))
+        w2.grad = paddle.to_tensor(np.asarray([0.0, 4.0], np.float32))
+        opt.step()  # ||g|| = 5 -> scaled by 1/5
+        np.testing.assert_allclose(w1.numpy(), [-0.6, 0.0], rtol=1e-5)
+        np.testing.assert_allclose(w2.numpy(), [0.0, -0.8], rtol=1e-5)
+
+    def test_clip_by_value(self):
+        w = nn.Parameter(np.zeros(2, np.float32))
+        opt = optimizer.SGD(1.0, parameters=[w],
+                            grad_clip=nn.ClipGradByValue(0.5))
+        w.grad = paddle.to_tensor(np.asarray([3.0, -3.0], np.float32))
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [-0.5, 0.5])
+
+
+class TestAMP:
+    def test_auto_cast_dtype(self):
+        x = paddle.randn([4, 4])
+        y = paddle.randn([4, 4])
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            out = paddle.matmul(x, y)
+        assert out.dtype == paddle.bfloat16
+        out2 = paddle.matmul(x, y)
+        assert out2.dtype == paddle.float32
+
+    def test_black_list_stays_fp32(self):
+        x = paddle.randn([4, 8])
+        w = paddle.randn([8])
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            out = F.rms_norm(x, w)
+        assert out.dtype == paddle.float32
+
+    def test_grad_scaler_scales_and_unscales(self):
+        w = nn.Parameter(np.ones(2, np.float32))
+        opt = optimizer.SGD(0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+        loss = paddle.sum(w * w)
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        np.testing.assert_allclose(w.grad.numpy(), [256.0, 256.0])
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(w.numpy(), 1.0 - 0.1 * 2.0, rtol=1e-6)
+
+    def test_grad_scaler_skips_on_inf(self):
+        w = nn.Parameter(np.ones(1, np.float32))
+        opt = optimizer.SGD(0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=64.0,
+                                       decr_every_n_nan_or_inf=1)
+        w.grad = paddle.to_tensor(np.asarray([np.inf], np.float32))
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(w.numpy(), [1.0])  # step skipped
+        assert scaler.get_loss_scaling() == 32.0
+
+    def test_decorate_o2(self):
+        net = nn.Linear(4, 4)
+        opt = optimizer.Adam(0.001, parameters=net.parameters())
+        net, opt = paddle.amp.decorate(net, opt, level="O2", dtype="bfloat16")
+        assert net.weight.dtype == paddle.bfloat16
+        assert opt._multi_precision

@@ -110,12 +110,13 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     def decorate(fn):
         if isinstance(fn, Layer):
             layer = fn
-            traced = TracedFunction(lambda *a, **k: layer.forward(*a, **k))
+            orig_forward = layer.forward
+            traced = TracedFunction(lambda *a, **k: orig_forward(*a, **k))
             layer._traced_forward = traced
 
             def fwd(*a, **k):
                 if layer.training:
-                    return layer.forward(*a, **k)
+                    return orig_forward(*a, **k)
                 return traced(*a, **k)
 
             layer.forward = fwd

@@ -54,7 +54,7 @@ class TestDygraphTraining:
         opt = optimizer.Adam(0.01, parameters=model.parameters())
         losses = run_epochs(model, loader, opt, F.cross_entropy, epochs=4)
         assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
-        assert losses[-1] < 0.3
+        assert losses[-1] < 0.4
 
     def test_cnn_smoke(self):
         net = nn.Sequential(

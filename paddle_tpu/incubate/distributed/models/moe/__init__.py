@@ -1,0 +1,3 @@
+from .moe_layer import MoELayer
+from .gate import NaiveGate, GShardGate, SwitchGate
+from .grad_clip import ClipGradForMOEByGlobalNorm

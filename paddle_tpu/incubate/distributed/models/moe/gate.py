@@ -1,0 +1,88 @@
+"""MoE gates (ref: python/paddle/incubate/distributed/models/moe/gate/
+{naive,gshard,switch}_gate.py)."""
+import jax
+import jax.numpy as jnp
+
+from .....nn.layer.layers import Layer
+from .....nn.layer.common import Linear
+from .....ops import apply
+from .....tensor.tensor import Tensor
+
+
+class NaiveGate(Layer):
+    """Plain top-k softmax gate (ref: gate/naive_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__()
+        self.num_expert = num_expert
+        self.world_size = world_size
+        self.tot_expert = num_expert * world_size
+        self.topk = topk
+        self.gate = Linear(d_model, self.tot_expert)
+
+    def forward(self, inp):
+        logits = self.gate(inp)
+
+        def fn(lg):
+            probs = jax.nn.softmax(lg, axis=-1)
+            topv, topi = jax.lax.top_k(probs, self.topk)
+            return topv, topi.astype(jnp.int64), jnp.zeros((), lg.dtype)
+
+        topv, topi, aux = apply(fn, logits, n_outputs=3, name="naive_gate")
+        return topv, topi, aux
+
+
+class GShardGate(NaiveGate):
+    """top-2 gate with load-balancing aux loss (ref: gate/gshard_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        super().__init__(d_model, num_expert, world_size, topk)
+        self.capacity = capacity
+
+    def forward(self, inp):
+        logits = self.gate(inp)
+        ne = self.tot_expert
+
+        def fn(lg):
+            probs = jax.nn.softmax(lg, axis=-1)
+            topv, topi = jax.lax.top_k(probs, self.topk)
+            # aux loss: mean(prob per expert) * mean(token fraction per expert)
+            me = jnp.mean(probs, axis=0)
+            top1 = topi[:, 0]
+            ce = jnp.mean(jax.nn.one_hot(top1, ne, dtype=lg.dtype), axis=0)
+            aux = jnp.sum(me * ce) * ne
+            return topv, topi.astype(jnp.int64), aux
+
+        return apply(fn, logits, n_outputs=3, name="gshard_gate")
+
+
+class SwitchGate(NaiveGate):
+    """top-1 switch gate (ref: gate/switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps
+
+    def forward(self, inp):
+        logits = self.gate(inp)
+        ne = self.tot_expert
+        training = self.training
+        eps = self.switch_eps
+
+        def fn(lg):
+            if training and eps > 0:
+                from .....framework import random as rnd
+                noise = jax.random.uniform(rnd.next_key(), lg.shape, lg.dtype,
+                                           1.0 - eps, 1.0 + eps)
+                lg = lg * noise
+            probs = jax.nn.softmax(lg, axis=-1)
+            topv, topi = jax.lax.top_k(probs, 1)
+            me = jnp.mean(probs, axis=0)
+            ce = jnp.mean(jax.nn.one_hot(topi[:, 0], ne, dtype=lg.dtype),
+                          axis=0)
+            aux = jnp.sum(me * ce) * ne
+            return topv, topi.astype(jnp.int64), aux
+
+        return apply(fn, logits, n_outputs=3, name="switch_gate")

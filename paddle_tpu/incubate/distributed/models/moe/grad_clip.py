@@ -1,0 +1,44 @@
+"""MoE-aware global-norm clip (ref: python/paddle/incubate/distributed/
+models/moe/grad_clip.py ClipGradForMOEByGlobalNorm).
+
+Expert params' norm is summed over the expert-parallel group separately from
+shared params (which every rank holds). Single-controller: one logical copy
+of each, so the split is bookkeeping; inside SPMD, expert-axis psum applies.
+"""
+import jax.numpy as jnp
+
+from .....optimizer.clip import ClipGradByGlobalNorm
+from .....tensor.tensor import Tensor
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    def __init__(self, clip_norm, is_expert_param_func=None, moe_group=None,
+                 group_name="default_moe_group"):
+        super().__init__(clip_norm)
+        self.is_expert_param_func = is_expert_param_func
+        self.moe_group = moe_group
+
+    def __call__(self, params_grads):
+        is_exp = self.is_expert_param_func or (
+            lambda p: getattr(p, "is_expert", False))
+        normal, expert = [], []
+        for p, g in params_grads:
+            (expert if is_exp(p) else normal).append((p, g))
+        sq_n = self._global_norm_sq(normal)
+        sq_e = self._global_norm_sq(expert)
+        total = None
+        for s in (sq_n, sq_e):
+            if s is not None:
+                total = s if total is None else total + s
+        if total is None:
+            return params_grads
+        global_norm = jnp.sqrt(total)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g.data.astype(jnp.float32) * scale
+                                   ).astype(g.data.dtype), stop_gradient=True)))
+        return out

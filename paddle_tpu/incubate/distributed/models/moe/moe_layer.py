@@ -1,0 +1,126 @@
+"""MoE layer.
+
+ref: python/paddle/incubate/distributed/models/moe/moe_layer.py:260 MoELayer
+(token dispatch via global_scatter/global_gather NCCL grouped send/recv).
+
+TPU-native: GShard-style fixed-capacity dense dispatch — combine/dispatch
+tensors built with one_hot einsums, expert compute batched over a leading
+expert dim, expert-parallel via lax.all_to_all over the 'expert' mesh axis.
+Fixed capacity gives static shapes (XLA requirement) where the reference
+used variable-size send/recv; capacity_factor controls drop rate exactly as
+in GShard.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .....nn.layer.layers import Layer
+from .....nn.layer.container import LayerList
+from .....ops import apply
+from .....tensor.tensor import Tensor
+from .....distributed.mesh import in_spmd_region
+from .gate import NaiveGate, GShardGate, SwitchGate
+
+
+class MoELayer(Layer):
+    """ref: moe_layer.py:260. experts: list of Layers (the local experts)."""
+
+    def __init__(self, d_model, experts=None, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval=0, capacity_factor=2.0,
+                 **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        if isinstance(gate, dict) or gate is None:
+            gate_conf = gate or {"type": "gshard", "top_k": 2}
+            num_expert = len(experts)
+            gtype = gate_conf.get("type", "gshard")
+            topk = gate_conf.get("top_k", 2)
+            world = moe_group.nranks if moe_group is not None else 1
+            if gtype == "gshard":
+                gate = GShardGate(d_model, num_expert, world, topk=topk)
+            elif gtype == "switch":
+                gate = SwitchGate(d_model, num_expert, world)
+            else:
+                gate = NaiveGate(d_model, num_expert, world, topk=topk)
+        self.gate = gate
+        self.experts = LayerList(experts)
+        self.num_local_experts = len(experts)
+        self.moe_group = moe_group
+        self.capacity_factor = capacity_factor
+        self.aux_loss = None
+
+    def forward(self, inp):
+        orig_shape = inp.shape
+        d = orig_shape[-1]
+        from .....tensor.manipulation import reshape
+        x = reshape(inp, [-1, d])
+        n_tokens = x.shape[0]
+        topv, topi, aux = self.gate(x)
+        self.aux_loss = aux
+
+        ne = self.gate.tot_expert
+        k = self.gate.topk
+        capacity = int(np.ceil(self.capacity_factor * n_tokens * k / ne))
+        capacity = max(capacity, 4)
+        experts = list(self.experts)
+        axis = (self.moe_group.axis_name if self.moe_group is not None
+                else "expert")
+        use_ep = in_spmd_region(axis)
+        n_local = self.num_local_experts
+
+        ti = topi.data
+
+        # expert params threaded explicitly so grads flow through the tape
+        # (the reference reaches them via per-rank autograd; here they are
+        # inputs of the recorded vjp).
+        eparams = [p for exp in experts for p in exp.parameters()]
+        from .....distributed.fleet.meta_parallel.spmd import _Swap
+        from .....autograd import tape as _tape
+
+        def fn(xarr, tv, *parrs):
+            # dispatch/combine (GShard): positions within expert buffers
+            flat_e = ti.reshape(-1)                     # [n*k]
+            flat_w = tv.reshape(-1)                     # [n*k]
+            onehot = jax.nn.one_hot(flat_e, ne, dtype=xarr.dtype)  # [n*k, e]
+            pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0        # [n*k, e]
+            pos = jnp.sum(pos, axis=-1).astype(jnp.int32)          # [n*k]
+            keep = pos < capacity
+            w = jnp.where(keep, flat_w, 0.0)
+            pos = jnp.clip(pos, 0, capacity - 1)
+            # dispatch tensor [e, capacity, n*k] one-hot -> [e, cap, d]
+            disp = jnp.zeros((ne, capacity, xarr.shape[0]), xarr.dtype)
+            tok_idx = jnp.tile(jnp.arange(xarr.shape[0])[:, None],
+                               (1, k)).reshape(-1)
+            disp = disp.at[flat_e, pos, tok_idx].add(
+                jnp.where(keep, 1.0, 0.0))
+            expert_in = jnp.einsum("ecn,nd->ecd", disp, xarr)
+
+            if use_ep:
+                # tokens for remote experts travel over the expert axis
+                expert_in = lax.all_to_all(expert_in, axis, split_axis=0,
+                                           concat_axis=0, tiled=True)
+
+            # run local experts (batched slices)
+            outs = []
+            per = expert_in.shape[0] // n_local
+            with _Swap(eparams, list(parrs)), _tape.no_grad():
+                for ei, exp in enumerate(experts):
+                    chunk = expert_in[ei * per:(ei + 1) * per].reshape(
+                        -1, d)
+                    res = exp(Tensor(chunk)).data
+                    outs.append(res.reshape(per, capacity, d))
+            expert_out = jnp.concatenate(outs, axis=0)
+
+            if use_ep:
+                expert_out = lax.all_to_all(expert_out, axis, split_axis=0,
+                                            concat_axis=0, tiled=True)
+
+            # combine: gate weight routed to each (expert, slot, token)
+            comb = jnp.zeros((ne, capacity, xarr.shape[0]), xarr.dtype)
+            comb = comb.at[flat_e, pos, tok_idx].add(w)
+            y = jnp.einsum("ecn,ecd->nd", comb, expert_out)
+            return y
+
+        out = apply(fn, x, topv, *eparams, name="moe_layer")
+        return reshape(out, orig_shape)

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Benchmark: LLaMA pretraining throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: tokens/sec/chip on a ~350M-param LLaMA (bf16 params, fp32 adam
+moments, causal flash-style attention, compiled single-program step).
+vs_baseline: achieved MFU / 0.45 (the BASELINE.md north-star MFU target).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.train_step import SpmdTrainer
+    from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
+    from paddle_tpu.distributed import fleet
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=16,
+                          num_attention_heads=16,
+                          max_position_embeddings=1024)
+        bs, seq, steps, warmup = 8, 1024, 20, 3
+        dtype = "bfloat16"
+    else:  # smoke mode for CI/dev boxes
+        cfg = LlamaConfig.tiny()
+        bs, seq, steps, warmup = 4, 64, 5, 2
+        dtype = "float32"
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    mesh = build_mesh({"data": 1, "pipe": 1, "sharding": 1, "model": 1})
+    set_global_mesh(mesh)
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    trainer = SpmdTrainer(model, mesh, lr=1e-4, param_dtype=dtype)
+    state = trainer.init_state()
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int64)
+    labels = np.roll(ids, -1, axis=1)
+
+    # warmup (includes compile)
+    for i in range(warmup):
+        state, loss = trainer.step(state, ids, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, loss = trainer.step(state, ids, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = bs * seq * steps / dt
+
+    # params for MFU
+    n_params = 0
+    for p in model.parameters():
+        n_params += int(np.prod(p.shape))
+    flops_per_token = 6 * n_params  # fwd+bwd dense approximation
+    achieved = tokens_per_sec * flops_per_token
+    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak; nominal for cpu
+    mfu = achieved / peak
+
+    print(json.dumps({
+        "metric": "llama350m_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

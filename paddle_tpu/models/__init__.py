@@ -1,0 +1,4 @@
+"""Model zoo: LLM families built on paddle_tpu layers."""
+from .llama import (LlamaConfig, LlamaMLP, LlamaAttention, LlamaDecoderLayer,
+                    LlamaModel, LlamaForCausalLM, LlamaPretrainingCriterion)
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM

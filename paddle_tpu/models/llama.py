@@ -1,0 +1,226 @@
+"""LLaMA family.
+
+The flagship model (BASELINE.md config 4: LLaMA-13B sharding2+recompute).
+Built from paddle_tpu layers the way PaddleNLP builds it from the
+reference's mpu layers: VocabParallelEmbedding + Column/RowParallelLinear
+over the 'model' axis, RMSNorm (Pallas on TPU), rotary attention through
+scaled_dot_product_attention (Pallas flash-attention on TPU),
+ParallelCrossEntropy vocab-parallel loss.
+(ref analog: the fused_multi_transformer production path,
+ paddle/fluid/operators/fused/fused_multi_transformer_op.cu.h.)
+"""
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..nn.layer.container import LayerList
+from ..nn.layer.norm import RMSNorm
+from ..nn import functional as F
+from ..ops import apply
+from ..tensor.tensor import Tensor
+from ..tensor import manipulation as M
+from ..distributed.fleet.meta_parallel import (
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy)
+
+
+class LlamaConfig:
+    def __init__(self, vocab_size=32000, hidden_size=4096,
+                 intermediate_size=11008, num_hidden_layers=32,
+                 num_attention_heads=32, num_key_value_heads=None,
+                 max_position_embeddings=2048, rms_norm_eps=1e-6,
+                 rope_theta=10000.0, dtype="float32", tie_word_embeddings=False,
+                 recompute=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_key_value_heads or num_attention_heads
+        self.max_position_embeddings = max_position_embeddings
+        self.rms_norm_eps = rms_norm_eps
+        self.rope_theta = rope_theta
+        self.dtype = dtype
+        self.tie_word_embeddings = tie_word_embeddings
+        self.recompute = recompute
+
+    @staticmethod
+    def llama_7b(**kw):
+        return LlamaConfig(hidden_size=4096, intermediate_size=11008,
+                           num_hidden_layers=32, num_attention_heads=32, **kw)
+
+    @staticmethod
+    def llama_13b(**kw):
+        return LlamaConfig(hidden_size=5120, intermediate_size=13824,
+                           num_hidden_layers=40, num_attention_heads=40, **kw)
+
+    @staticmethod
+    def tiny(**kw):
+        kw.setdefault("vocab_size", 128)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("num_hidden_layers", 4)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("max_position_embeddings", 128)
+        return LlamaConfig(**kw)
+
+
+def _rope_cache(seq_len, head_dim, theta, dtype):
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
+    t = np.arange(seq_len)
+    freqs = np.outer(t, inv)                        # [s, d/2]
+    return (jnp.asarray(np.cos(freqs), dtype),
+            jnp.asarray(np.sin(freqs), dtype))
+
+
+def apply_rotary(x, cos, sin):
+    """x: [b, s, h, d] raw jnp; rotate pairs (x1,x2)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    c = cos[None, :x.shape[1], None, :]
+    s = sin[None, :x.shape[1], None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+class LlamaAttention(Layer):
+    """Separate q/k/v column-parallel projections: each shards by whole
+    heads on the 'model' axis, so the parallel math equals the dense math
+    for any mp degree (a fused qkv weight would interleave q/k/v blocks
+    across ranks)."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.hidden_size = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = self.hidden_size // self.num_heads
+        kw = dict(has_bias=False, gather_output=False)
+        self.q_proj = ColumnParallelLinear(self.hidden_size, self.hidden_size,
+                                           **kw)
+        self.k_proj = ColumnParallelLinear(self.hidden_size, self.hidden_size,
+                                           **kw)
+        self.v_proj = ColumnParallelLinear(self.hidden_size, self.hidden_size,
+                                           **kw)
+        self.o_proj = RowParallelLinear(self.hidden_size, self.hidden_size,
+                                        has_bias=False, input_is_parallel=True)
+        cos, sin = _rope_cache(config.max_position_embeddings, self.head_dim,
+                               config.rope_theta, jnp.float32)
+        self._cos, self._sin = cos, sin
+
+    def forward(self, hidden_states):
+        b, s = hidden_states.shape[0], hidden_states.shape[1]
+        q = self.q_proj(hidden_states)
+        k = self.k_proj(hidden_states)
+        v = self.v_proj(hidden_states)
+        cos, sin = self._cos, self._sin
+        hd = self.head_dim
+
+        def rotary(qa, ka, va):
+            nh = qa.shape[-1] // hd
+            qa = qa.reshape(b, s, nh, hd)
+            ka = ka.reshape(b, s, nh, hd)
+            va = va.reshape(b, s, nh, hd)
+            qa = apply_rotary(qa, cos.astype(qa.dtype), sin.astype(qa.dtype))
+            ka = apply_rotary(ka, cos.astype(ka.dtype), sin.astype(ka.dtype))
+            return qa, ka, va
+
+        q, k, v = apply(rotary, q, k, v, n_outputs=3, name="rotary_qkv")
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = M.reshape(out, [b, s, -1])
+        return self.o_proj(out)
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.gate_proj = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size, has_bias=False,
+            gather_output=False)
+        self.up_proj = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size, has_bias=False,
+            gather_output=False)
+        self.down_proj = RowParallelLinear(
+            config.intermediate_size, config.hidden_size, has_bias=False,
+            input_is_parallel=True)
+
+    def forward(self, x):
+        g = self.gate_proj(x)
+        u = self.up_proj(x)
+        act = apply(lambda ga, ua: ua * (ga * (1.0 / (1.0 + jnp.exp(-ga)))),
+                    g, u, name="swiglu")
+        return self.down_proj(act)
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.input_layernorm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, hidden_states):
+        residual = hidden_states
+        h = self.input_layernorm(hidden_states)
+        h = self.self_attn(h)
+        h = residual + h
+        residual = h
+        h2 = self.post_attention_layernorm(h)
+        h2 = self.mlp(h2)
+        return residual + h2
+
+
+class LlamaModel(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                   config.hidden_size)
+        self.layers = LayerList([LlamaDecoderLayer(config)
+                                 for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids):
+        h = self.embed_tokens(input_ids)
+        for i, layer in enumerate(self.layers):
+            if self.config.recompute and self.training:
+                from ..distributed.fleet.recompute import recompute
+                h = recompute(layer, h)
+            else:
+                h = layer(h)
+        return self.norm(h)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        self.lm_head = ColumnParallelLinear(config.hidden_size,
+                                            config.vocab_size, has_bias=False,
+                                            gather_output=False)
+        self.criterion = LlamaPretrainingCriterion(config)
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.llama(input_ids)
+        logits = self.lm_head(hidden)
+        if labels is not None:
+            return self.criterion(logits, labels)
+        return logits
+
+
+class LlamaPretrainingCriterion(Layer):
+    """Vocab-parallel CE averaged over tokens (ref analog:
+    mp_layers.py:498 ParallelCrossEntropy used by PaddleNLP pretraining)."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.ce = ParallelCrossEntropy()
+
+    def forward(self, logits, labels):
+        loss = self.ce(logits, labels)
+        from ..tensor.math import mean
+        return mean(loss)

@@ -1,0 +1,98 @@
+"""Compiled hybrid train step tests: the one-program tp/pp/dp/ZeRO path
+(configs 3/4 analog on the virtual 8-device CPU mesh)."""
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.train_step import SpmdTrainer
+from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
+
+
+def make_batch(rng, bs, seq, vocab):
+    ids = rng.randint(0, vocab, (bs, seq)).astype(np.int64)
+    labels = np.roll(ids, -1, axis=1)
+    return ids, labels
+
+
+def build_model(mesh):
+    set_global_mesh(mesh)
+    # re-init fleet-style topology so mp layers pick up mesh sizes
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": mesh.shape.get("data", 1),
+        "mp_degree": mesh.shape.get("model", 1),
+        "pp_degree": mesh.shape.get("pipe", 1),
+        "sharding_degree": mesh.shape.get("sharding", 1)}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(11)
+    cfg = LlamaConfig.tiny()
+    return LlamaForCausalLM(cfg), cfg
+
+
+@pytest.mark.parametrize("axes", [
+    {"data": 1, "pipe": 1, "sharding": 1, "model": 1},
+    {"data": 2, "pipe": 1, "sharding": 1, "model": 2},
+    {"data": 1, "pipe": 2, "sharding": 1, "model": 2},
+    {"data": 2, "pipe": 2, "sharding": 2, "model": 1},
+])
+def test_trainer_runs_and_learns(axes):
+    mesh = build_mesh(axes)
+    model, cfg = build_model(mesh)
+    trainer = SpmdTrainer(model, mesh, lr=1e-2,
+                          micro_batch_size=2 if axes["pipe"] > 1 else None)
+    state = trainer.init_state()
+    rng = np.random.RandomState(0)
+    ids, labels = make_batch(rng, 8, 16, cfg.vocab_size)
+    losses = []
+    for i in range(5):
+        state, loss = trainer.step(state, ids, labels)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+def test_parallel_configs_agree():
+    """Same data + same init => same loss trajectory regardless of mesh
+    split (the reference's N-proc-vs-1-proc loss comparison,
+    test_dist_base.py:902 analog)."""
+    rng = np.random.RandomState(1)
+    ids, labels = make_batch(rng, 8, 16, 128)
+    trajs = {}
+    for name, axes in {
+        "single": {"data": 1, "pipe": 1, "sharding": 1, "model": 1},
+        "tp2xdp2": {"data": 2, "pipe": 1, "sharding": 1, "model": 2},
+        "pp2": {"data": 1, "pipe": 2, "sharding": 1, "model": 1},
+        "zero2": {"data": 1, "pipe": 1, "sharding": 2, "model": 1},
+    }.items():
+        mesh = build_mesh(axes)
+        model, cfg = build_model(mesh)  # paddle.seed(11) inside
+        trainer = SpmdTrainer(model, mesh, lr=1e-2,
+                              micro_batch_size=4 if axes["pipe"] > 1 else None)
+        state = trainer.init_state()
+        ls = []
+        for i in range(3):
+            state, loss = trainer.step(state, ids, labels,
+                                       key=jax.random.key(i))
+            ls.append(float(loss))
+        trajs[name] = ls
+    base = trajs["single"]
+    for name, ls in trajs.items():
+        np.testing.assert_allclose(ls, base, rtol=2e-3,
+                                   err_msg=f"{name} diverged: {ls} vs {base}")
+
+
+def test_sync_to_model_roundtrip():
+    mesh = build_mesh({"data": 1, "pipe": 1, "sharding": 1, "model": 1})
+    model, cfg = build_model(mesh)
+    trainer = SpmdTrainer(model, mesh, lr=1e-2)
+    state = trainer.init_state()
+    rng = np.random.RandomState(2)
+    ids, labels = make_batch(rng, 4, 8, cfg.vocab_size)
+    state, _ = trainer.step(state, ids, labels)
+    trainer.sync_to_model(state)
+    # eager forward with synced weights gives finite loss
+    out = model(paddle.to_tensor(ids), paddle.to_tensor(labels))
+    assert np.isfinite(out.item())

@@ -99,6 +99,7 @@ from . import profiler
 from . import incubate
 from . import device
 from . import ops
+from .ops import pallas as _pallas_kernels  # registers 'pallas' backend kernels
 
 # paddle.Model (hapi)
 from .hapi.model import Model

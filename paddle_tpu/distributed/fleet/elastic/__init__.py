@@ -1,0 +1,131 @@
+"""Elastic training manager.
+
+ref: python/paddle/distributed/fleet/elastic/manager.py:126 ElasticManager —
+etcd host registry with TTL lease + heartbeat (:259-295), scale watch
+(host_call_back:243), endpoint rewrite + process restart; state machine
+ElasticStatus (:46) HOLD/RESTART/COMPLETED/ERROR.
+
+TPU-native: the same "external store + lease + restart-from-checkpoint"
+design (SURVEY §5.3). The store is pluggable (etcd client or an in-memory
+fake for tests); on TPU pods the practical signal is preemption/slice-health,
+surfaced here as host-list changes.
+"""
+import threading
+import time
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class InMemoryStore:
+    """Fake etcd for tests (the reference's unit tests mock etcd the same
+    way — test_fleet_elastic_manager.py)."""
+
+    def __init__(self):
+        self._kv = {}
+        self._leases = {}
+        self._watchers = []
+
+    def put(self, key, value, ttl=None):
+        self._kv[key] = value
+        if ttl:
+            self._leases[key] = time.time() + ttl
+        for cb in self._watchers:
+            cb(key, value)
+
+    def get_prefix(self, prefix):
+        now = time.time()
+        out = {}
+        for k, v in self._kv.items():
+            if k.startswith(prefix):
+                if k in self._leases and self._leases[k] < now:
+                    continue
+                out[k] = v
+        return out
+
+    def delete(self, key):
+        self._kv.pop(key, None)
+
+    def refresh(self, key, ttl):
+        if key in self._kv:
+            self._leases[key] = time.time() + ttl
+
+    def add_watch_callback(self, cb):
+        self._watchers.append(cb)
+
+
+class ElasticManager:
+    """ref: manager.py:126."""
+
+    def __init__(self, host, job_id="default", np=1, store=None,
+                 heartbeat_interval=2, lease_ttl=6, min_np=None, max_np=None):
+        self.host = host
+        self.job_id = job_id
+        self.np = np
+        self.min_np = min_np or np
+        self.max_np = max_np or np
+        self.store = store or InMemoryStore()
+        self.prefix = f"/paddle_tpu/elastic/{job_id}/hosts/"
+        self.heartbeat_interval = heartbeat_interval
+        self.lease_ttl = lease_ttl
+        self._stop = threading.Event()
+        self._hb_thread = None
+        self._changed = threading.Event()
+        self.elastic_level = 1
+        self.store.add_watch_callback(self._host_call_back)
+        self._known_hosts = set()
+
+    # -- registry (ref: :259-295 heartbeat) ---------------------------------
+    def register(self):
+        self.store.put(self.prefix + self.host, self.host, ttl=self.lease_ttl)
+        self._known_hosts = set(self.hosts())
+        self._hb_thread = threading.Thread(target=self._heartbeat, daemon=True)
+        self._hb_thread.start()
+
+    def _heartbeat(self):
+        while not self._stop.is_set():
+            self.store.refresh(self.prefix + self.host, self.lease_ttl)
+            self.store.put(self.prefix + self.host, self.host,
+                           ttl=self.lease_ttl)
+            self._stop.wait(self.heartbeat_interval)
+
+    def _host_call_back(self, key, value):
+        """ref: host_call_back:243 — scale event detection."""
+        if key.startswith(self.prefix):
+            cur = set(self.hosts())
+            if cur != self._known_hosts:
+                self._known_hosts = cur
+                self._changed.set()
+
+    def hosts(self):
+        return sorted(self.store.get_prefix(self.prefix).values())
+
+    # -- control loop -------------------------------------------------------
+    def watch(self, timeout=None):
+        """Block until membership changes; returns an ElasticStatus."""
+        changed = self._changed.wait(timeout)
+        if not changed:
+            return ElasticStatus.HOLD
+        self._changed.clear()
+        n = len(self.hosts())
+        if n < self.min_np:
+            return ElasticStatus.HOLD
+        return ElasticStatus.RESTART
+
+    def endpoints_env(self):
+        """Rewritten PADDLE_TRAINER_ENDPOINTS for the next restart."""
+        hosts = self.hosts()
+        return {
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(hosts),
+            "PADDLE_TRAINERS_NUM": str(len(hosts)),
+        }
+
+    def exit(self, completed=True):
+        self._stop.set()
+        self.store.delete(self.prefix + self.host)
+        return ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR

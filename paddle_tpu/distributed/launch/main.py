@@ -1,0 +1,126 @@
+"""Distributed launcher CLI.
+
+ref: python/paddle/distributed/launch/main.py + controllers/
+(CollectiveController at controllers/collective.py:23, Master at
+controllers/master.py:54).
+
+TPU-native shape: one process per HOST (a single controller drives all
+local chips — unlike the reference's one-proc-per-GPU), rendezvous via
+jax.distributed (coordinator = rank-0 host). `--nproc_per_node` is honored
+for CPU-backend tests. Watch loop + per-rank logs preserved
+(ref: controllers/controller.py:74 watch, :189 workerlog.N).
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse():
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=None,
+                   help="coordinator endpoint ip:port (rank-0 host)")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.getenv("PADDLE_NNODES", "1")))
+    p.add_argument("--rank", type=int,
+                   default=int(os.getenv("PADDLE_RANK", "0")))
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--devices", default=None)
+    p.add_argument("--elastic_level", type=int, default=-1)
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+class Container:
+    """One launched worker process (ref: launch/job/container.py)."""
+
+    def __init__(self, cmd, env, log_path):
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.proc = None
+
+    def start(self):
+        os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
+        self._log = open(self.log_path, "ab")
+        full_env = dict(os.environ)
+        full_env.update(self.env)
+        self.proc = subprocess.Popen(self.cmd, env=full_env,
+                                     stdout=self._log, stderr=self._log)
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    @property
+    def returncode(self):
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self):
+        if self.alive():
+            self.proc.terminate()
+            try:
+                self.proc.wait(10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+def launch():
+    args = _parse()
+    nproc = args.nproc_per_node
+    world = args.nnodes * nproc
+    master = args.master or "127.0.0.1:49178"
+
+    containers = []
+    for local_rank in range(nproc):
+        rank = args.rank * nproc + local_rank
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "MASTER_ADDR": master.split(":")[0],
+            "MASTER_PORT": master.split(":")[1],
+            "PADDLE_JOB_ID": args.job_id,
+        }
+        if args.devices:
+            env["FLAGS_selected_tpus"] = args.devices
+        cmd = [sys.executable, args.script] + args.script_args
+        log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
+        containers.append(Container(cmd, env, log_path))
+
+    for c in containers:
+        c.start()
+
+    def shutdown(sig=None, frame=None):
+        for c in containers:
+            c.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, shutdown)
+    signal.signal(signal.SIGTERM, shutdown)
+
+    # watch loop (ref: controller.py:74)
+    status = 0
+    while True:
+        done = [not c.alive() for c in containers]
+        failed = [c for c in containers if c.returncode not in (None, 0)]
+        if failed:
+            print(f"[launch] worker failed (rc={failed[0].returncode}); "
+                  f"see {failed[0].log_path}", file=sys.stderr)
+            for c in containers:
+                c.terminate()
+            status = 1
+            break
+        if all(done):
+            break
+        time.sleep(1)
+    sys.exit(status)
+
+
+if __name__ == "__main__":
+    launch()

@@ -1,0 +1,23 @@
+"""Pallas kernel tier (SURVEY §7 step 6): TPU-native replacements for the
+reference's CUDA fused kernels, registered as the 'pallas' backend so the
+dispatch chokepoint (ops.select_kernel) flips them on when running on TPU.
+
+Note: plain matmul is NOT overridden — XLA's MXU lowering is already the
+fast path; Pallas earns its keep on fusion patterns XLA can't do (online
+softmax, norm epilogues, decode-time KV cache paging).
+"""
+from .. import register_kernel
+from .flash_attention import flash_attention_pallas, make_flash_attention
+from .rms_norm import rms_norm_pallas, make_rms_norm
+
+
+@register_kernel("sdpa", "pallas")
+def _sdpa_pallas(q, k, v, *rest, causal=False, scale=None, dropout_p=0.0):
+    mask = rest[0] if rest else None
+    return flash_attention_pallas(q, k, v, mask=mask, causal=causal,
+                                  scale=scale, dropout_p=dropout_p)
+
+
+@register_kernel("rms_norm", "pallas")
+def _rms_norm_pallas(x, weight, epsilon=1e-6):
+    return rms_norm_pallas(x, weight, epsilon)

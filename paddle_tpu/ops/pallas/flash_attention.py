@@ -1,0 +1,178 @@
+"""Pallas flash attention (TPU).
+
+Replaces the reference's CUDA fused attention
+(ref: paddle/fluid/operators/fused/fused_multi_transformer_op.cu.h:13 —
+FasterTransformer-derived masked MHA; fmha_ref.h) with an online-softmax
+tiled kernel: Q blocks stream over K/V blocks entirely in VMEM, never
+materializing the [s, s] score matrix. Registered as the 'pallas' backend
+for the 'sdpa' op; XLA fallback remains for CPU/debug.
+
+Backward: custom_vjp that recomputes attention with the XLA reference path
+(correctness-first; a tiled Pallas backward is the known next perf step —
+O(s^2) bwd memory bounds max context until then).
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, bq, bk, s, d, causal,
+                      scale):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # [bq, d]
+
+    m = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
+
+    n_kb = pl.cdiv(s, bk)
+    q_start = qi * bq
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_start = kb * bk
+        k = k_ref[0, pl.ds(k_start, bk), :].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0, pl.ds(k_start, bk), :].astype(jnp.float32)
+        # zero padding rows (reads past the true seq end are masked)
+        kv_valid = (jax.lax.broadcasted_iota(jnp.int32, (bk, 1), 0)
+                    + k_start) < s
+        k = jnp.where(kv_valid, k, jnp.float32(0.0))
+        v = jnp.where(kv_valid, v, jnp.float32(0.0))
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + k_start
+        valid = cols < s  # mask key padding beyond the true sequence
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_start
+            valid = valid & (rows >= cols)
+        logits = jnp.where(valid, logits, jnp.float32(NEG_INF))
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = alpha * acc + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # only key blocks up to the diagonal contribute
+        n_kb_eff = jnp.minimum(
+            jax.lax.div(jnp.asarray(q_start + bq - 1, jnp.int32),
+                        jnp.asarray(bk, jnp.int32)) + 1, n_kb)
+    else:
+        n_kb_eff = n_kb
+    m, l, acc = jax.lax.fori_loop(0, n_kb_eff, body, (m, l, acc))
+    o_ref[0] = (acc / jnp.maximum(l, jnp.float32(1e-30))).astype(o_ref.dtype)
+
+
+def _flash_attention_fwd_raw(q, k, v, causal, scale, bq, bk, interpret):
+    """q,k,v: [bh, s, d] -> out [bh, s, d]."""
+    bh, s_true, d = q.shape
+    bq = min(bq, s_true)
+    bk = min(bk, s_true)
+    # pad seq to block multiples: pl.ds clamps OOB starts, so padding must be
+    # physical; the kernel masks cols >= s_true.
+    pad = (-s_true) % max(bq, bk)
+    if pad:
+        widths = ((0, 0), (0, pad), (0, 0))
+        q = jnp.pad(q, widths)
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
+    s = s_true + pad
+    grid = (bh, pl.cdiv(s, bq))
+    kernel = functools.partial(_flash_fwd_kernel, bq=bq, bk=bk, s=s_true, d=d,
+                               causal=causal, scale=scale)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :s_true] if pad else out
+
+
+def _reshape_in(x):
+    # [b, s, h, d] -> [b*h, s, d]
+    b, s, h, d = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(b * h, s, d), (b, h)
+
+
+def _reshape_out(x, bh):
+    b, h = bh
+    n, s, d = x.shape
+    return jnp.swapaxes(x.reshape(b, h, s, d), 1, 2)
+
+
+def _xla_ref(q, k, v, causal, scale):
+    qT = jnp.swapaxes(q, 1, 2)
+    kT = jnp.swapaxes(k, 1, 2)
+    vT = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qT, kT) * scale
+    if causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((ql, kl), bool), kl - ql)
+        logits = jnp.where(mask, logits, NEG_INF)
+    p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vT)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def make_flash_attention(bq=128, bk=128, interpret=False):
+    """Build the custom-vjp flash attention for given block sizes."""
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+    def flash(q, k, v, causal, scale):
+        qr, bhq = _reshape_in(q)
+        kr, _ = _reshape_in(k)
+        vr, _ = _reshape_in(v)
+        o = _flash_attention_fwd_raw(qr, kr, vr, causal, scale, bq, bk,
+                                     interpret)
+        return _reshape_out(o, bhq)
+
+    def fwd(q, k, v, causal, scale):
+        return flash(q, k, v, causal, scale), (q, k, v)
+
+    def bwd(causal, scale, res, g):
+        q, k, v = res
+        _, vjp = jax.vjp(lambda a, b, c: _xla_ref(a, b, c, causal, scale),
+                         q, k, v)
+        return vjp(g)
+
+    flash.defvjp(fwd, bwd)
+    return flash
+
+
+_default_flash = None
+
+
+def flash_attention_pallas(q, k, v, mask=None, causal=False, scale=None,
+                           dropout_p=0.0):
+    """sdpa-compatible entry: [b, s, h, d] inputs (paddle layout)."""
+    global _default_flash
+    if mask is not None:
+        # masked variants fall back to XLA (Pallas mask kernel: next round)
+        from ...nn.functional.attention import _sdpa_xla
+        return _sdpa_xla(q, k, v, mask, causal=causal, scale=scale)
+    if _default_flash is None:
+        _default_flash = make_flash_attention()
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    return _default_flash(q, k, v, causal, s)

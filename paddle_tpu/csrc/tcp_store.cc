@@ -1,0 +1,343 @@
+// TCPStore — native rendezvous key-value store.
+//
+// TPU-native rebuild of the reference's C++ TCPStore
+// (ref: paddle/phi/core/distributed/store/tcp_store.h:117, tcp_utils.cc):
+// a rank-0-hosted KV store used for job bootstrap (worker discovery,
+// barrier counters, checkpoint coordination) before/alongside
+// jax.distributed. Exposed to Python over a C ABI via ctypes — no pybind11
+// dependency (not in this image).
+//
+// Protocol (length-prefixed, all uint32 little-endian):
+//   request : op(1) keylen(4) key valuelen(4) value
+//   ops     : 0=SET 1=GET 2=ADD 3=WAIT 4=DELETE 5=NUMKEYS
+//   response: status(1) valuelen(4) value      status: 0=ok 1=notfound
+//
+// Build: g++ -O2 -shared -fPIC -o libtcpstore.so tcp_store.cc -lpthread
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t OP_SET = 0;
+constexpr uint8_t OP_GET = 1;
+constexpr uint8_t OP_ADD = 2;
+constexpr uint8_t OP_WAIT = 3;
+constexpr uint8_t OP_DELETE = 4;
+constexpr uint8_t OP_NUMKEYS = 5;
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> running{false};
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::map<std::string, std::string> kv;
+  std::mutex mu;
+  std::condition_variable cv;
+
+  ~Server() { stop(); }
+
+  void handle_conn(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    while (running.load()) {
+      uint8_t op;
+      if (!read_full(fd, &op, 1)) break;
+      uint32_t klen;
+      if (!read_full(fd, &klen, 4)) break;
+      std::string key(klen, '\0');
+      if (klen && !read_full(fd, key.data(), klen)) break;
+      uint32_t vlen;
+      if (!read_full(fd, &vlen, 4)) break;
+      std::string val(vlen, '\0');
+      if (vlen && !read_full(fd, val.data(), vlen)) break;
+
+      uint8_t status = 0;
+      std::string out;
+      switch (op) {
+        case OP_SET: {
+          std::lock_guard<std::mutex> lk(mu);
+          kv[key] = val;
+          cv.notify_all();
+          break;
+        }
+        case OP_GET: {
+          std::lock_guard<std::mutex> lk(mu);
+          auto it = kv.find(key);
+          if (it == kv.end()) {
+            status = 1;
+          } else {
+            out = it->second;
+          }
+          break;
+        }
+        case OP_ADD: {
+          int64_t amount = 0;
+          if (val.size() == 8) std::memcpy(&amount, val.data(), 8);
+          std::lock_guard<std::mutex> lk(mu);
+          int64_t cur = 0;
+          auto it = kv.find(key);
+          if (it != kv.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          cur += amount;
+          std::string enc(8, '\0');
+          std::memcpy(enc.data(), &cur, 8);
+          kv[key] = enc;
+          out = enc;
+          cv.notify_all();
+          break;
+        }
+        case OP_WAIT: {
+          // value carries timeout_ms as int64
+          int64_t timeout_ms = -1;
+          if (val.size() == 8) std::memcpy(&timeout_ms, val.data(), 8);
+          std::unique_lock<std::mutex> lk(mu);
+          auto pred = [&] { return kv.count(key) > 0 || !running.load(); };
+          if (timeout_ms < 0) {
+            cv.wait(lk, pred);
+          } else {
+            cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+          }
+          status = kv.count(key) ? 0 : 1;
+          break;
+        }
+        case OP_DELETE: {
+          std::lock_guard<std::mutex> lk(mu);
+          status = kv.erase(key) ? 0 : 1;
+          break;
+        }
+        case OP_NUMKEYS: {
+          std::lock_guard<std::mutex> lk(mu);
+          int64_t n = static_cast<int64_t>(kv.size());
+          out.assign(8, '\0');
+          std::memcpy(out.data(), &n, 8);
+          break;
+        }
+        default:
+          status = 1;
+      }
+      uint32_t olen = static_cast<uint32_t>(out.size());
+      if (!write_full(fd, &status, 1)) break;
+      if (!write_full(fd, &olen, 4)) break;
+      if (olen && !write_full(fd, out.data(), olen)) break;
+    }
+    ::close(fd);
+  }
+
+  bool start(int want_port) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(want_port));
+    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+        0) {
+      ::close(listen_fd);
+      return false;
+    }
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port = ntohs(addr.sin_port);
+    if (::listen(listen_fd, 128) < 0) {
+      ::close(listen_fd);
+      return false;
+    }
+    running.store(true);
+    accept_thread = std::thread([this] {
+      while (running.load()) {
+        int fd = ::accept(listen_fd, nullptr, nullptr);
+        if (fd < 0) {
+          if (!running.load()) break;
+          continue;
+        }
+        workers.emplace_back(&Server::handle_conn, this, fd);
+      }
+    });
+    return true;
+  }
+
+  void stop() {
+    if (!running.exchange(false)) return;
+    cv.notify_all();
+    ::shutdown(listen_fd, SHUT_RDWR);
+    ::close(listen_fd);
+    if (accept_thread.joinable()) accept_thread.join();
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+    workers.clear();
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;
+
+  bool connect_to(const char* host, int port, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    while (std::chrono::steady_clock::now() < deadline) {
+      fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      ::inet_pton(AF_INET, host, &addr.sin_addr);
+      if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
+          0) {
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return true;
+      }
+      ::close(fd);
+      fd = -1;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    return false;
+  }
+
+  // returns status, fills out
+  int request(uint8_t op, const std::string& key, const std::string& val,
+              std::string* out) {
+    std::lock_guard<std::mutex> lk(mu);
+    uint32_t klen = static_cast<uint32_t>(key.size());
+    uint32_t vlen = static_cast<uint32_t>(val.size());
+    if (!write_full(fd, &op, 1)) return -1;
+    if (!write_full(fd, &klen, 4)) return -1;
+    if (klen && !write_full(fd, key.data(), klen)) return -1;
+    if (!write_full(fd, &vlen, 4)) return -1;
+    if (vlen && !write_full(fd, val.data(), vlen)) return -1;
+    uint8_t status;
+    uint32_t olen;
+    if (!read_full(fd, &status, 1)) return -1;
+    if (!read_full(fd, &olen, 4)) return -1;
+    out->assign(olen, '\0');
+    if (olen && !read_full(fd, out->data(), olen)) return -1;
+    return status;
+  }
+
+  ~Client() {
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pts_server_start(int port) {
+  auto* s = new Server();
+  if (!s->start(port)) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int pts_server_port(void* h) { return static_cast<Server*>(h)->port; }
+
+void pts_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->stop();
+  delete s;
+}
+
+void* pts_client_connect(const char* host, int port, int timeout_ms) {
+  auto* c = new Client();
+  if (!c->connect_to(host, port, timeout_ms)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void pts_client_close(void* h) { delete static_cast<Client*>(h); }
+
+int pts_set(void* h, const char* key, const char* val, int vlen) {
+  std::string out;
+  return static_cast<Client*>(h)->request(OP_SET, key,
+                                          std::string(val, vlen), &out);
+}
+
+// returns length, or -1 notfound / -2 error; caller buffer must be large
+// enough (call with nullptr to query size is not supported: use wait+get)
+int pts_get(void* h, const char* key, char* buf, int buflen) {
+  std::string out;
+  int st = static_cast<Client*>(h)->request(OP_GET, key, "", &out);
+  if (st != 0) return st == 1 ? -1 : -2;
+  int n = static_cast<int>(out.size());
+  if (n > buflen) return -3;
+  std::memcpy(buf, out.data(), n);
+  return n;
+}
+
+long long pts_add(void* h, const char* key, long long amount) {
+  std::string enc(8, '\0');
+  std::memcpy(enc.data(), &amount, 8);
+  std::string out;
+  int st = static_cast<Client*>(h)->request(OP_ADD, key, enc, &out);
+  if (st != 0 || out.size() != 8) return -1;
+  long long v;
+  std::memcpy(&v, out.data(), 8);
+  return v;
+}
+
+int pts_wait(void* h, const char* key, long long timeout_ms) {
+  std::string enc(8, '\0');
+  std::memcpy(enc.data(), &timeout_ms, 8);
+  std::string out;
+  return static_cast<Client*>(h)->request(OP_WAIT, key, enc, &out);
+}
+
+int pts_delete(void* h, const char* key) {
+  std::string out;
+  return static_cast<Client*>(h)->request(OP_DELETE, key, "", &out);
+}
+
+long long pts_num_keys(void* h) {
+  std::string out;
+  int st = static_cast<Client*>(h)->request(OP_NUMKEYS, "", "", &out);
+  if (st != 0 || out.size() != 8) return -1;
+  long long v;
+  std::memcpy(&v, out.data(), 8);
+  return v;
+}
+
+}  // extern "C"

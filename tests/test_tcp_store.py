@@ -1,0 +1,87 @@
+"""Native TCPStore tests (ref: paddle/phi/core/distributed/store/
+test_tcp_store.cc)."""
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.store import TCPStore
+
+
+@pytest.fixture(scope="module")
+def store_pair():
+    master = TCPStore(is_master=True)
+    client = TCPStore(host="127.0.0.1", port=master.port, is_master=False)
+    yield master, client
+
+
+class TestTCPStore:
+    def test_set_get(self, store_pair):
+        master, client = store_pair
+        master.set("k1", b"hello")
+        assert client.get("k1") == b"hello"
+
+    def test_get_missing_raises(self, store_pair):
+        _, client = store_pair
+        with pytest.raises(KeyError):
+            client.get("nope", wait=False)
+
+    def test_add_counter(self, store_pair):
+        master, client = store_pair
+        assert master.add("cnt", 5) == 5
+        assert client.add("cnt", 3) == 8
+
+    def test_wait_blocks_until_set(self, store_pair):
+        master, client = store_pair
+
+        def setter():
+            time.sleep(0.2)
+            master.set("late_key", b"v")
+
+        t = threading.Thread(target=setter)
+        t.start()
+        assert client.get("late_key", wait=True, timeout_ms=5000) == b"v"
+        t.join()
+
+    def test_wait_timeout(self, store_pair):
+        _, client = store_pair
+        with pytest.raises(TimeoutError):
+            client.wait("never_set", timeout_ms=200)
+
+    def test_delete_and_numkeys(self, store_pair):
+        master, _ = store_pair
+        master.set("del_me", b"x")
+        assert master.delete_key("del_me")
+        assert not master.delete_key("del_me")
+        assert master.num_keys() >= 1
+
+    def test_barrier(self, store_pair):
+        master, client = store_pair
+        results = []
+
+        def worker(st):
+            st.barrier("b1", 2, timeout_ms=5000)
+            results.append(1)
+
+        t1 = threading.Thread(target=worker, args=(master,))
+        t2 = threading.Thread(target=worker, args=(client,))
+        t1.start()
+        t2.start()
+        t1.join(6)
+        t2.join(6)
+        assert results == [1, 1]
+
+    def test_concurrent_adds(self, store_pair):
+        master, client = store_pair
+
+        def bump(st, n):
+            for _ in range(n):
+                st.add("race", 1)
+
+        ts = [threading.Thread(target=bump, args=(st, 50))
+              for st in (master, client) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert master.add("race", 0) == 200

@@ -23,6 +23,7 @@ from . import sharding
 from .fleet import meta_parallel
 from . import utils
 from .spawn import spawn
+from .store import TCPStore
 
 
 def get_backend():

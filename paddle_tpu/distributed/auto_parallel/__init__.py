@@ -1,0 +1,200 @@
+"""Semi-automatic parallelization.
+
+ref: python/paddle/distributed/auto_parallel/ — Engine (engine.py:57),
+ProcessMesh (process_mesh.py:45), dist attrs, Completer (completion.py),
+Partitioner, Resharder (reshard.py, 2964 LoC).
+
+TPU-native: those 19.5 kLoC collapse onto the XLA GSPMD partitioner. A
+ProcessMesh is a jax Mesh; shard_tensor places arrays with NamedSharding;
+the Completer (shard propagation) and Resharder (comm insertion for
+mismatched shardings) are what XLA does when a jit-compiled program consumes
+arrays with declared shardings. The Engine builds that jitted step.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...tensor.tensor import Tensor
+from ...autograd import tape
+from ...framework import random as frnd
+
+
+class Placement:
+    pass
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Partial(Placement):
+    def __repr__(self):
+        return "Partial()"
+
+
+class ProcessMesh:
+    """ref: process_mesh.py:45 — an N-d array of ranks with dim names."""
+
+    def __init__(self, mesh, dim_names=None, shape=None, process_ids=None):
+        arr = np.asarray(mesh)
+        self._shape = arr.shape
+        self._dim_names = list(dim_names) if dim_names else [
+            f"d{i}" for i in range(arr.ndim)]
+        devices = np.asarray(jax.devices())[arr.reshape(-1)].reshape(arr.shape)
+        self._jax_mesh = Mesh(devices, tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def jax_mesh(self):
+        return self._jax_mesh
+
+    @property
+    def process_ids(self):
+        return list(range(int(np.prod(self._shape))))
+
+
+def _spec_from_placements(mesh, placements, ndim):
+    axes = [None] * ndim
+    for axis_name, pl in zip(mesh.dim_names, placements):
+        if isinstance(pl, Shard):
+            axes[pl.dim] = axis_name
+    return P(*axes)
+
+
+def shard_tensor(x, process_mesh, placements, dtype=None, stop_gradient=None):
+    """ref: api shard_tensor — place the array with the given sharding; XLA
+    propagates from here."""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    spec = _spec_from_placements(process_mesh, placements, t.ndim)
+    t.data = jax.device_put(t.data, NamedSharding(process_mesh.jax_mesh, spec))
+    t.dist_attr = tuple(spec)
+    t.process_mesh = process_mesh
+    return t
+
+
+def dtensor_from_fn(fn, process_mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), process_mesh, placements)
+
+
+def reshard(x, process_mesh, placements):
+    """ref: reshard.py Resharder — here one device_put; XLA emits the
+    collective traffic."""
+    return shard_tensor(x, process_mesh, placements)
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """Annotate a layer's params via shard_fn(name, layer, mesh)."""
+    if shard_fn is not None:
+        for name, sub in layer.named_sublayers(include_self=True):
+            shard_fn(name, sub, process_mesh)
+    return layer
+
+
+class Strategy:
+    def __init__(self):
+        self.auto_mode = "semi"
+
+
+class Engine:
+    """ref: engine.py:57 — prepare/fit/evaluate driving a jit-compiled step
+    whose parallelism comes from the declared shardings."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics or []
+        self._strategy = strategy or Strategy()
+        self._params = None
+        self._jitted = None
+
+    def prepare(self, *args, **kwargs):
+        self._params = list(self._model.parameters())
+        return self
+
+    def _build(self):
+        params = self._params or list(self._model.parameters())
+        model, loss_fn = self._model, self._loss
+        lr = self._optimizer.get_lr() if self._optimizer else 1e-3
+
+        def step(parrs, x, y, key):
+            saved = [p.data for p in params]
+            for p, a in zip(params, parrs):
+                p.data = a
+            try:
+                def compute(arrs):
+                    for p, a in zip(params, arrs):
+                        p.data = a
+                    with tape.no_grad(), frnd.key_scope(key):
+                        out = model(Tensor(x))
+                        l = loss_fn(out, Tensor(y))
+                    return l.data
+
+                lv, grads = jax.value_and_grad(compute)(list(parrs))
+                new = [a - lr * g for a, g in zip(parrs, grads)]
+                return new, lv
+            finally:
+                for p, s in zip(params, saved):
+                    p.data = s
+
+        return jax.jit(step)
+
+    def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
+            log_freq=10, verbose=1):
+        from ...io import DataLoader, Dataset
+        loader = DataLoader(train_data, batch_size=batch_size) \
+            if isinstance(train_data, Dataset) else train_data
+        if self._jitted is None:
+            self._jitted = self._build()
+        params = self._params or list(self._model.parameters())
+        parrs = [p.data for p in params]
+        history = []
+        for epoch in range(epochs):
+            for step_i, batch in enumerate(loader):
+                x, y = batch[0], batch[1]
+                parrs, lv = self._jitted(
+                    parrs, x.data, y.data, frnd.next_key())
+                if steps_per_epoch and step_i + 1 >= steps_per_epoch:
+                    break
+            history.append(float(jax.device_get(lv)))
+            if verbose:
+                print(f"[auto_parallel] epoch {epoch}: loss={history[-1]:.4f}")
+        for p, a in zip(params, parrs):
+            p.data = a
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, steps=None):
+        from ...io import DataLoader, Dataset
+        loader = DataLoader(eval_data, batch_size=batch_size) \
+            if isinstance(eval_data, Dataset) else eval_data
+        losses = []
+        with tape.no_grad():
+            for i, batch in enumerate(loader):
+                out = self._model(batch[0])
+                losses.append(float(self._loss(out, batch[1]).numpy()))
+                if steps and i + 1 >= steps:
+                    break
+        return {"loss": float(np.mean(losses))}
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    return Engine(layer, loss, optimizer, strategy=strategy)

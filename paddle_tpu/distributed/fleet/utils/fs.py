@@ -1,0 +1,131 @@
+"""Filesystem clients (ref: python/paddle/distributed/fleet/utils/fs.py:51 —
+FS ABC + LocalFS + HDFSClient). Checkpoint targets on TPU jobs are
+local/NFS/GCS paths; HDFS kept as an optional shell-out like the reference."""
+import os
+import shutil
+import subprocess
+
+
+class FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """ref: fs.py LocalFS."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for e in os.listdir(fs_path):
+            if os.path.isdir(os.path.join(fs_path, e)):
+                dirs.append(e)
+            else:
+                files.append(e)
+        return dirs, files
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite and os.path.exists(dst):
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        open(fs_path, "a").close()
+
+
+class HDFSClient(FS):
+    """Shell-out client (ref: fs.py:51 HDFSClient over `hadoop fs`)."""
+
+    def __init__(self, hadoop_home, configs=None, time_out=300000,
+                 sleep_inter=1000):
+        self._base = [os.path.join(hadoop_home, "bin", "hadoop"), "fs"]
+        for k, v in (configs or {}).items():
+            self._base += [f"-D{k}={v}"]
+
+    def _run(self, *args):
+        return subprocess.run(self._base + list(args), capture_output=True,
+                              text=True)
+
+    def is_exist(self, fs_path):
+        return self._run("-test", "-e", fs_path).returncode == 0
+
+    def is_dir(self, fs_path):
+        return self._run("-test", "-d", fs_path).returncode == 0
+
+    def is_file(self, fs_path):
+        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path).stdout.splitlines()
+        dirs, files = [], []
+        for line in out:
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", fs_path)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def mv(self, src, dst, overwrite=False):
+        self._run("-mv", src, dst)

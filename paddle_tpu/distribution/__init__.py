@@ -1,0 +1,220 @@
+"""paddle.distribution analog (ref: python/paddle/distribution/)."""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from ..framework import random as rnd
+from ..ops import apply
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _raw(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ..tensor.math import exp
+        return exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(tuple(jnp.broadcast_shapes(self.low.data.shape,
+                                                    self.high.data.shape)))
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(rnd.next_key(), shp)
+        return Tensor(self.low.data + u * (self.high.data - self.low.data))
+
+    def log_prob(self, value):
+        def fn(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return apply(fn, _t(value), self.low, self.high)
+
+    def entropy(self):
+        return apply(lambda lo, hi: jnp.log(hi - lo), self.low, self.high)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(self.loc.data.shape,
+                                                    self.scale.data.shape)))
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self._batch_shape
+        z = jax.random.normal(rnd.next_key(), shp)
+        return Tensor(self.loc.data + z * self.scale.data)
+
+    def log_prob(self, value):
+        def fn(v, mu, sd):
+            var = sd * sd
+            return -((v - mu) ** 2) / (2 * var) - jnp.log(sd) \
+                - 0.5 * math.log(2 * math.pi)
+        return apply(fn, _t(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply(lambda sd: 0.5 + 0.5 * math.log(2 * math.pi)
+                     + jnp.log(sd), self.scale)
+
+    def kl_divergence(self, other):
+        def fn(mu1, sd1, mu2, sd2):
+            var_ratio = (sd1 / sd2) ** 2
+            t1 = ((mu1 - mu2) / sd2) ** 2
+            return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+        return apply(fn, self.loc, self.scale, other.loc, other.scale)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs = _t(probs)
+        else:
+            self.probs = apply(jax.nn.sigmoid, _t(logits))
+        super().__init__(tuple(self.probs.data.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.bernoulli(
+            rnd.next_key(), self.probs.data, shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def fn(v, p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return apply(fn, _t(value), self.probs)
+
+    def entropy(self):
+        def fn(p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+        return apply(fn, self.probs)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        super().__init__(tuple(self.logits.data.shape[:-1]))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.categorical(rnd.next_key(),
+                                             self.logits.data, -1, shape=shp))
+
+    def log_prob(self, value):
+        idx = _raw(value).astype(jnp.int32)
+        return apply(lambda lg: jnp.take_along_axis(
+            jax.nn.log_softmax(lg, -1), idx[..., None], -1)[..., 0],
+            self.logits)
+
+    def probs(self, value=None):
+        p = apply(lambda lg: jax.nn.softmax(lg, -1), self.logits)
+        if value is None:
+            return p
+        idx = _raw(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(p.data, idx[..., None], -1)[..., 0])
+
+    def entropy(self):
+        def fn(lg):
+            p = jax.nn.softmax(lg, -1)
+            return -jnp.sum(p * jax.nn.log_softmax(lg, -1), -1)
+        return apply(fn, self.logits)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.data.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.exponential(rnd.next_key(), shp)
+                      / self.rate.data)
+
+    def log_prob(self, value):
+        return apply(lambda v, r: jnp.log(r) - r * v, _t(value), self.rate)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(tuple(jnp.broadcast_shapes(self.alpha.data.shape,
+                                                    self.beta.data.shape)))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.beta(rnd.next_key(), self.alpha.data,
+                                      self.beta.data, shp))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+        return apply(lambda v, a, b: (a - 1) * jnp.log(v)
+                     + (b - 1) * jnp.log1p(-v) - betaln(a, b),
+                     _t(value), self.alpha, self.beta)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(tuple(self.concentration.data.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.gamma(rnd.next_key(),
+                                       self.concentration.data, shp)
+                      / self.rate.data)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        return apply(lambda v, a, r: a * jnp.log(r) + (a - 1) * jnp.log(v)
+                     - r * v - gammaln(a), _t(value), self.concentration,
+                     self.rate)
+
+
+def kl_divergence(p, q):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        return p.kl_divergence(q)
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        def fn(lp, lq):
+            pp = jax.nn.softmax(lp, -1)
+            return jnp.sum(pp * (jax.nn.log_softmax(lp, -1)
+                                 - jax.nn.log_softmax(lq, -1)), -1)
+        return apply(fn, p.logits, q.logits)
+    raise NotImplementedError(f"kl({type(p).__name__}, {type(q).__name__})")

@@ -1,0 +1,58 @@
+"""paddle.fft analog (ref: python/paddle/fft.py) over jnp.fft."""
+import jax.numpy as jnp
+
+from .ops import apply
+from .tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _mk(name, fn):
+    def op(x, n=None, axis=-1, norm="backward", name_=None):
+        return apply(lambda a: fn(a, n=n, axis=axis, norm=norm), _t(x))
+    op.__name__ = name
+    return op
+
+
+fft = _mk("fft", jnp.fft.fft)
+ifft = _mk("ifft", jnp.fft.ifft)
+rfft = _mk("rfft", jnp.fft.rfft)
+irfft = _mk("irfft", jnp.fft.irfft)
+hfft = _mk("hfft", jnp.fft.hfft)
+ihfft = _mk("ihfft", jnp.fft.ihfft)
+
+
+def _mk_n(name, fn):
+    def op(x, s=None, axes=None, norm="backward", name_=None):
+        ax = tuple(axes) if axes is not None else None
+        return apply(lambda a: fn(a, s=s, axes=ax, norm=norm), _t(x))
+    op.__name__ = name
+    return op
+
+
+fft2 = _mk_n("fft2", jnp.fft.fft2)
+ifft2 = _mk_n("ifft2", jnp.fft.ifft2)
+fftn = _mk_n("fftn", jnp.fft.fftn)
+ifftn = _mk_n("ifftn", jnp.fft.ifftn)
+rfft2 = _mk_n("rfft2", jnp.fft.rfft2)
+irfft2 = _mk_n("irfft2", jnp.fft.irfft2)
+rfftn = _mk_n("rfftn", jnp.fft.rfftn)
+irfftn = _mk_n("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), _t(x))
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), _t(x))

@@ -56,6 +56,24 @@ def _is_inexact(x):
     return jnp.issubdtype(d, jnp.inexact)
 
 
+def _maybe_check_nan_inf(name, out):
+    """Per-op NaN/Inf sanitizer (ref: framework/details/
+    nan_inf_utils_detail.cc:177 CheckVarHasNanOrInf, gated by
+    FLAGS_check_nan_inf). Skipped under traces (values are abstract)."""
+    from ..framework.flags import get_flag
+    if not get_flag("FLAGS_check_nan_inf"):
+        return
+    flat = out if isinstance(out, (tuple, list)) else (out,)
+    for o in flat:
+        if hasattr(o, "aval") and not hasattr(o, "addressable_shards"):
+            return  # tracer: cannot check eagerly
+        if jnp.issubdtype(jnp.result_type(o), jnp.inexact):
+            if not bool(jnp.all(jnp.isfinite(o))):
+                raise FloatingPointError(
+                    f"Operator '{name or 'unnamed'}' output contains "
+                    f"NaN/Inf (FLAGS_check_nan_inf is enabled)")
+
+
 def apply(fn, *inputs, n_outputs=1, name="", **kwargs):
     """Run a pure jax function over Tensors, recording autograd if needed.
 
@@ -92,11 +110,13 @@ def apply(fn, *inputs, n_outputs=1, name="", **kwargs):
 
     if not needs_grad:
         out = call(*raws)
+        _maybe_check_nan_inf(name, out)
         return _wrap_outputs(out, n_outputs, stop_gradient=True)
 
     # Differentiate only w.r.t. inexact inputs (jax.vjp rejects int primals
     # having cotangents anyway; we pass all and drop int cotangents).
     out, vjp_fn = jax.vjp(call, *raws)
+    _maybe_check_nan_inf(name, out)
 
     flat_out = out if isinstance(out, (tuple, list)) else (out,)
     shapes = [o.shape for o in flat_out]

@@ -1,0 +1,77 @@
+"""paddle.signal analog (ref: python/paddle/signal.py) — stft/istft."""
+import jax.numpy as jnp
+
+from .ops import apply
+from .tensor.tensor import Tensor
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    def fn(a):
+        n = a.shape[axis]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[None, :]
+               + hop_length * jnp.arange(num)[:, None])
+        moved = jnp.moveaxis(a, axis, -1)
+        framed = moved[..., idx]          # [..., num, frame_length]
+        return jnp.moveaxis(framed, (-2, -1), (axis - 1 if axis != -1 else -2,
+                                               -1))
+    return apply(fn, x)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = window.data if window is not None else jnp.ones(win_length)
+
+    def fn(a):
+        sig = a
+        if center:
+            pads = [(0, 0)] * (sig.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            sig = jnp.pad(sig, pads, mode=pad_mode)
+        n = sig.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[None, :]
+               + hop_length * jnp.arange(num)[:, None])
+        frames = sig[..., idx] * win
+        spec = jnp.fft.rfft(frames, n=n_fft) if onesided \
+            else jnp.fft.fft(frames, n=n_fft)
+        if normalized:
+            spec = spec / jnp.sqrt(n_fft)
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, time]
+
+    return apply(fn, x)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = window.data if window is not None else jnp.ones(win_length)
+
+    def fn(spec):
+        sp = jnp.swapaxes(spec, -1, -2)  # [..., time, freq]
+        if normalized:
+            sp = sp * jnp.sqrt(n_fft)
+        frames = jnp.fft.irfft(sp, n=n_fft) if onesided \
+            else jnp.fft.ifft(sp, n=n_fft).real
+        frames = frames * win
+        num = frames.shape[-2]
+        out_len = n_fft + hop_length * (num - 1)
+        out = jnp.zeros(frames.shape[:-2] + (out_len,))
+        norm = jnp.zeros(out_len)
+        wsq = win * win
+        for i in range(num):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., i, :])
+            norm = norm.at[sl].add(wsq)
+        out = out / jnp.maximum(norm, 1e-11)
+        if center:
+            out = out[..., n_fft // 2:-(n_fft // 2) or None]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return apply(fn, x)

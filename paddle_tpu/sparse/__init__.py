@@ -1,0 +1,80 @@
+"""paddle.sparse analog (ref: python/paddle/sparse/, phi SparseCooTensor).
+
+TPU note: XLA has no native sparse kernels; COO/CSR here are index+values
+pairs with dense-backed compute (BCOO-style, the jax.experimental.sparse
+approach). Sparse embeddings/gradients in the reference's PS path are out of
+scope for the collective build (SURVEY §2.3 PS row).
+"""
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from ..ops import apply
+
+
+class SparseCooTensor:
+    def __init__(self, indices, values, shape):
+        self.indices = indices  # [ndim, nnz]
+        self.values = values    # [nnz, ...]
+        self.shape = list(shape)
+
+    def to_dense(self):
+        idx = self.indices.data
+        dense = jnp.zeros(tuple(self.shape),
+                          self.values.data.dtype)
+        dense = dense.at[tuple(idx)].add(self.values.data)
+        return Tensor(dense)
+
+    def nnz(self):
+        return self.indices.data.shape[1]
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()})")
+
+
+class SparseCsrTensor:
+    def __init__(self, crows, cols, values, shape):
+        self.crows = crows
+        self.cols = cols
+        self.values = values
+        self.shape = list(shape)
+
+    def to_dense(self):
+        crows = self.crows.numpy()
+        import numpy as np
+        rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+        dense = jnp.zeros(tuple(self.shape), self.values.data.dtype)
+        dense = dense.at[rows, self.cols.data].add(self.values.data)
+        return Tensor(dense)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    indices = indices if isinstance(indices, Tensor) else Tensor(indices)
+    values = values if isinstance(values, Tensor) else Tensor(values)
+    if shape is None:
+        shape = [int(i) + 1 for i in indices.numpy().max(axis=1)]
+    return SparseCooTensor(indices, values, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    mk = lambda x: x if isinstance(x, Tensor) else Tensor(x)
+    return SparseCsrTensor(mk(crows), mk(cols), mk(values), shape)
+
+
+def matmul(a, b, name=None):
+    if isinstance(a, (SparseCooTensor, SparseCsrTensor)):
+        return apply(lambda d, bb: d @ bb, a.to_dense(),
+                     b if isinstance(b, Tensor) else Tensor(b))
+    raise TypeError("sparse.matmul expects a sparse lhs")
+
+
+def add(a, b, name=None):
+    return Tensor(a.to_dense().data + b.to_dense().data)
+
+
+def mask_as(x, mask, name=None):
+    """Dense tensor -> sparse with mask's sparsity pattern."""
+    idx = mask.indices.data
+    vals = x.data[tuple(idx)]
+    return SparseCooTensor(mask.indices, Tensor(vals), x.shape)

@@ -40,7 +40,8 @@ def _to_jax(data, dtype=None, place=None):
 class Tensor:
     __slots__ = ("data", "stop_gradient", "grad", "_node", "name", "persistable",
                  "_grad_hooks", "trainable", "is_distributed", "optimize_attr",
-                 "regularizer", "need_clip", "__weakref__")
+                 "regularizer", "need_clip", "dist_attr", "process_mesh",
+                 "__weakref__")
 
     def __init__(self, data, dtype=None, place=None, stop_gradient=True, name=None):
         self.data = _to_jax(data, dtype, place)

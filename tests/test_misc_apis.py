@@ -1,0 +1,138 @@
+"""Tests for distribution / fft / signal / sparse / auto_parallel /
+generation surfaces."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestDistribution:
+    def test_normal(self):
+        from paddle_tpu.distribution import Normal
+        d = Normal(0.0, 1.0)
+        s = d.sample([1000])
+        assert abs(float(s.numpy().mean())) < 0.2
+        lp = d.log_prob(paddle.to_tensor(0.0))
+        np.testing.assert_allclose(lp.item(), -0.5 * np.log(2 * np.pi),
+                                   rtol=1e-5)
+
+    def test_categorical(self):
+        from paddle_tpu.distribution import Categorical
+        d = Categorical(paddle.to_tensor([0.0, 0.0, 10.0]))
+        s = d.sample([100])
+        assert (s.numpy() == 2).mean() > 0.95
+
+    def test_kl(self):
+        from paddle_tpu.distribution import Normal, kl_divergence
+        kl = kl_divergence(Normal(0.0, 1.0), Normal(0.0, 1.0))
+        np.testing.assert_allclose(kl.item(), 0.0, atol=1e-6)
+
+
+class TestFFT:
+    def test_roundtrip(self):
+        from paddle_tpu import fft
+        x = paddle.randn([16])
+        y = fft.ifft(fft.fft(x))
+        np.testing.assert_allclose(np.real(y.numpy()), x.numpy(), atol=1e-5)
+
+    def test_rfft_shape(self):
+        from paddle_tpu import fft
+        x = paddle.randn([8, 32])
+        assert fft.rfft(x).shape == [8, 17]
+
+
+class TestSignal:
+    def test_stft_istft_roundtrip(self):
+        from paddle_tpu import signal
+        x = paddle.randn([1, 256])
+        spec = signal.stft(x, n_fft=64, hop_length=16)
+        assert spec.shape[1] == 33  # freq bins
+        rec = signal.istft(spec, n_fft=64, hop_length=16, length=256)
+        np.testing.assert_allclose(rec.numpy(), x.numpy(), atol=1e-4)
+
+
+class TestSparse:
+    def test_coo_roundtrip(self):
+        from paddle_tpu import sparse
+        idx = paddle.to_tensor(np.asarray([[0, 1, 2], [1, 2, 0]]))
+        vals = paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], np.float32))
+        sp = sparse.sparse_coo_tensor(idx, vals, [3, 3])
+        dense = sp.to_dense().numpy()
+        assert dense[0, 1] == 1.0 and dense[2, 0] == 3.0
+        assert sp.nnz() == 3
+
+    def test_sparse_matmul(self):
+        from paddle_tpu import sparse
+        idx = paddle.to_tensor(np.asarray([[0, 1], [0, 1]]))
+        vals = paddle.to_tensor(np.asarray([2.0, 3.0], np.float32))
+        sp = sparse.sparse_coo_tensor(idx, vals, [2, 2])
+        out = sparse.matmul(sp, paddle.ones([2, 2]))
+        np.testing.assert_allclose(out.numpy(), [[2, 2], [3, 3]])
+
+
+class TestAutoParallel:
+    def test_process_mesh_and_shard_tensor(self):
+        from paddle_tpu.distributed.auto_parallel import (
+            ProcessMesh, shard_tensor, Shard, Replicate)
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        assert mesh.shape == [2, 4]
+        x = paddle.randn([8, 16])
+        x = shard_tensor(x, mesh, [Shard(0), Shard(1)])
+        assert x.dist_attr == ("dp", "mp")
+        # array really is distributed
+        assert len(x.data.sharding.device_set) == 8
+
+    def test_engine_fit(self):
+        from paddle_tpu.distributed.auto_parallel import Engine, ProcessMesh
+        from paddle_tpu import nn
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.io import Dataset
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                rng = np.random.RandomState(i)
+                return (rng.randn(4).astype(np.float32),
+                        rng.randn(2).astype(np.float32))
+
+            def __len__(self):
+                return 16
+
+        net = nn.Linear(4, 2)
+        eng = Engine(net, loss=F.mse_loss)
+        eng.prepare()
+        hist = eng.fit(DS(), epochs=2, batch_size=8, verbose=0)
+        assert len(hist) == 2 and np.isfinite(hist[-1])
+
+
+class TestGeneration:
+    def test_greedy_generate(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.generation import generate
+        paddle.seed(5)
+        cfg = LlamaConfig.tiny(num_hidden_layers=2)
+        model = LlamaForCausalLM(cfg)
+        ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 4))
+        out = generate(model, ids, max_new_tokens=6)
+        assert out.shape == (2, 10)
+        assert (out[:, :4] == ids).all()
+        # deterministic greedy
+        out2 = generate(model, ids, max_new_tokens=6)
+        np.testing.assert_array_equal(out, out2)
+
+    def test_cached_decode_matches_full_forward(self):
+        """KV-cache decode must agree with running the whole prefix."""
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.generation import generate
+        paddle.seed(6)
+        cfg = LlamaConfig.tiny(num_hidden_layers=2)
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        ids = np.random.RandomState(1).randint(0, cfg.vocab_size, (1, 5))
+        out = generate(model, ids, max_new_tokens=3)
+        # recompute the 6th token from the full 5+1... verify greedy argmax
+        # of the full forward equals the first generated token
+        import paddle_tpu.autograd.tape as tape
+        with tape.no_grad():
+            logits = model(paddle.to_tensor(ids))
+        nxt = int(np.argmax(logits.numpy()[0, -1]))
+        assert out[0, 5] == nxt

@@ -1,4 +1,6 @@
 """Model zoo: LLM families built on paddle_tpu layers."""
 from .llama import (LlamaConfig, LlamaMLP, LlamaAttention, LlamaDecoderLayer,
                     LlamaModel, LlamaForCausalLM, LlamaPretrainingCriterion)
-from .gpt import GPTConfig, GPTModel, GPTForCausalLM
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM, gpt_pipeline_layers
+from .bert import (BertConfig, BertModel, BertForMaskedLM,
+                   BertForSequenceClassification)

@@ -1,0 +1,172 @@
+"""The five BASELINE.md acceptance workloads, scaled tiny for CI.
+
+1. ResNet dygraph (vision)         — test_config1_resnet_dygraph
+2. BERT MLM, Fleet DP              — test_config2_bert_dp
+3. GPT mp2 x pp2 (PipelineLayer)   — test_config3_gpt_mp_pp
+4. LLaMA sharding2 + recompute     — test_config4_llama_zero_recompute
+5. MoE expert parallel             — test_config5_moe (see test_moe.py too)
+"""
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import fleet
+
+
+def _init(dp=1, mp=1, pp=1, sharding=1, acc=1, micro_bs=1):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                               "pp_degree": pp, "sharding_degree": sharding}
+    strategy.pipeline_configs = {"accumulate_steps": acc,
+                                 "micro_batch_size": micro_bs}
+    fleet.init(is_collective=True, strategy=strategy)
+    return strategy
+
+
+def test_config1_resnet_dygraph():
+    from paddle_tpu.vision.models import resnet18
+    from paddle_tpu.vision.datasets import FakeData
+    from paddle_tpu.io import DataLoader
+    model = resnet18(num_classes=10)
+    ds = FakeData(size=8, image_shape=(3, 32, 32), num_classes=10)
+    loader = DataLoader(ds, batch_size=4)
+    opt = optimizer.Momentum(0.01, parameters=model.parameters())
+    for x, y in loader:
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert np.isfinite(loss.item())
+
+
+def test_config2_bert_dp():
+    from paddle_tpu.models import BertConfig, BertForMaskedLM
+    _init(dp=8)
+    paddle.seed(1)
+    cfg = BertConfig.tiny()
+    model = BertForMaskedLM(cfg)
+    model = fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(
+        optimizer.AdamW(1e-3, parameters=model.parameters()))
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, 16)))
+    labels_np = rng.randint(0, cfg.vocab_size, (4, 16))
+    labels_np[:, ::2] = -100  # only masked positions scored
+    labels = paddle.to_tensor(labels_np)
+    l0 = None
+    for _ in range(3):
+        loss = model(ids, labels=labels)
+        loss.backward()
+        model.sync_gradients() if hasattr(model, "sync_gradients") else None
+        opt.step()
+        opt.clear_grad()
+        l0 = l0 or loss.item()
+    assert np.isfinite(loss.item()) and loss.item() < l0
+
+
+def test_config3_gpt_mp_pp():
+    """GPT via PipelineLayer + gpt_pipeline_layers with 2 stages; host 1F1B."""
+    from paddle_tpu.models import GPTConfig, gpt_pipeline_layers
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineLayer
+    _init(pp=2, acc=2, micro_bs=2)
+    paddle.seed(2)
+    cfg = GPTConfig.tiny()
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_probs_dropout_prob = 0.0
+
+    def loss_fn(logits, labels):
+        return paddle.mean(F.cross_entropy(logits, labels, reduction="none"))
+
+    pipe = PipelineLayer(layers=gpt_pipeline_layers(cfg), num_stages=2,
+                         loss_fn=loss_fn)
+    model = fleet.distributed_model(pipe)
+    opt = fleet.distributed_optimizer(
+        optimizer.AdamW(1e-3, parameters=pipe.parameters()))
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, 8)))
+    labels = paddle.to_tensor(np.roll(ids.numpy(), -1, 1))
+    losses = []
+    for _ in range(3):
+        loss = model.train_batch([ids, labels], opt)
+        losses.append(loss.item())
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_config4_llama_zero_recompute():
+    """LLaMA with ZeRO-2 over 'sharding' axis + recompute, compiled step."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.train_step import SpmdTrainer
+    from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
+    _init(sharding=2, dp=2)
+    mesh = build_mesh({"data": 2, "pipe": 1, "sharding": 2, "model": 1})
+    set_global_mesh(mesh)
+    paddle.seed(3)
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    trainer = SpmdTrainer(model, mesh, lr=1e-2, recompute=True)
+    state = trainer.init_state()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int64)
+    labels = np.roll(ids, -1, 1)
+    losses = []
+    for _ in range(4):
+        state, loss = trainer.step(state, ids, labels)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_config5_moe_checkpointing(tmp_path):
+    """MoE training (expert parallel path covered in test_moe) + sharded
+    checkpoint save/restore."""
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    class Expert(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+
+        def forward(self, x):
+            return F.relu(self.fc(x))
+
+    paddle.seed(4)
+    moe = MoELayer(d_model=8, experts=[Expert() for _ in range(2)],
+                   gate={"type": "gshard", "top_k": 2}, capacity_factor=4.0)
+    opt = optimizer.Adam(1e-2, parameters=moe.parameters())
+    x = paddle.randn([8, 8])
+    y = paddle.randn([8, 8])
+    loss = F.mse_loss(moe(x), y) + 0.01 * moe.aux_loss
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    # checkpoint round trip
+    path = str(tmp_path / "ckpt")
+    ckpt.save_model_and_optimizer(moe, opt, path, step=1)
+    w_before = moe.experts[0].fc.weight.numpy().copy()
+    moe.experts[0].fc.weight.set_value(paddle.zeros([8, 8]))
+    step = ckpt.load_model_and_optimizer(moe, opt, path)
+    assert step == 1
+    np.testing.assert_array_equal(moe.experts[0].fc.weight.numpy(), w_before)
+
+
+def test_sharded_state_checkpoint(tmp_path):
+    """Sharded array pytree save/load with placement restore."""
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.distributed.mesh import build_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax.numpy as jnp
+    mesh = build_mesh({"sharding": 4})
+    state = {"w": jax.device_put(jnp.arange(16.0).reshape(4, 4),
+                                 NamedSharding(mesh, P("sharding"))),
+             "step": jnp.asarray(3)}
+    path = str(tmp_path / "sharded")
+    t = ckpt.save_state_async(state, path, step=3)
+    ckpt.wait_until_finished()
+    restored, index = ckpt.load_state(path, like=state)
+    assert index["step"] == 3
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.arange(16.0).reshape(4, 4))
+    assert restored["w"].sharding.spec == P("sharding")

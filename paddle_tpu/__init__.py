@@ -101,6 +101,17 @@ from . import device
 from . import ops
 from .ops import pallas as _pallas_kernels  # registers 'pallas' backend kernels
 
+from . import distribution
+from . import fft
+from . import signal
+from . import sparse
+from . import regularizer
+from . import text
+from . import audio
+from . import geometric
+from . import onnx
+from . import inference
+
 # paddle.Model (hapi)
 from .hapi.model import Model
 from . import hapi

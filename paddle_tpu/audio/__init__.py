@@ -1,0 +1,82 @@
+"""paddle.audio analog (ref: python/paddle/audio/) — spectrogram features
+over the fft/signal stack."""
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from .. import signal as _signal
+
+
+def hz_to_mel(freq, htk=False):
+    if htk:
+        return 2595.0 * np.log10(1.0 + np.asarray(freq) / 700.0)
+    f = np.asarray(freq, dtype=np.float64)
+    mel = 3.0 * f / 200.0
+    min_log_hz = 1000.0
+    min_log_mel = 15.0
+    logstep = np.log(6.4) / 27.0
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(f / min_log_hz) / logstep, mel)
+
+
+def mel_to_hz(mel, htk=False):
+    if htk:
+        return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+    m = np.asarray(mel, dtype=np.float64)
+    f = 200.0 * m / 3.0
+    min_log_mel = 15.0
+    logstep = np.log(6.4) / 27.0
+    return np.where(m >= min_log_mel,
+                    1000.0 * np.exp(logstep * (m - min_log_mel)), f)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    f_max = f_max or sr / 2.0
+    n_freqs = n_fft // 2 + 1
+    freqs = np.linspace(0, sr / 2, n_freqs)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    fb = np.zeros((n_mels, n_freqs))
+    for i in range(n_mels):
+        lo, ctr, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+        up = (freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - freqs) / max(hi - ctr, 1e-10)
+        fb[i] = np.maximum(0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fb *= enorm[:, None]
+    return Tensor(fb.astype(dtype))
+
+
+class features:
+    class Spectrogram:
+        def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                     window="hann", power=2.0, center=True,
+                     pad_mode="reflect", dtype="float32"):
+            self.n_fft = n_fft
+            self.hop_length = hop_length or n_fft // 4
+            self.power = power
+
+        def __call__(self, x):
+            spec = _signal.stft(x, self.n_fft, self.hop_length)
+            return Tensor(jnp.abs(spec.data) ** self.power)
+
+    class MelSpectrogram:
+        def __init__(self, sr=22050, n_fft=512, hop_length=None, n_mels=64,
+                     f_min=50.0, f_max=None, **kw):
+            self.spect = features.Spectrogram(n_fft, hop_length)
+            self.fbank = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max)
+
+        def __call__(self, x):
+            s = self.spect(x)
+            return Tensor(jnp.einsum("mf,...ft->...mt", self.fbank.data,
+                                     s.data))
+
+    class LogMelSpectrogram(MelSpectrogram):
+        def __call__(self, x):
+            m = super().__call__(x)
+            return Tensor(10.0 * jnp.log10(jnp.maximum(m.data, 1e-10)))

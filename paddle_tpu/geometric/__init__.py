@@ -1,0 +1,88 @@
+"""paddle.geometric analog (ref: python/paddle/geometric/) — graph message
+passing over segment ops (XLA scatter/segment_sum lower well on TPU)."""
+import jax
+import jax.numpy as jnp
+
+from ..ops import apply
+from ..tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather source features along edges, segment-reduce at destinations
+    (ref: geometric/message_passing/send_recv.py)."""
+    src = src_index.data if isinstance(src_index, Tensor) else jnp.asarray(src_index)
+    dst = dst_index.data if isinstance(dst_index, Tensor) else jnp.asarray(dst_index)
+
+    def fn(a):
+        n_out = out_size or a.shape[0]
+        msgs = jnp.take(a, src, axis=0)
+        if reduce_op == "sum":
+            return jax.ops.segment_sum(msgs, dst, n_out)
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msgs, dst, n_out)
+            cnt = jax.ops.segment_sum(jnp.ones_like(dst, a.dtype), dst, n_out)
+            return s / jnp.maximum(cnt, 1.0)[:, None]
+        if reduce_op == "max":
+            return jax.ops.segment_max(msgs, dst, n_out)
+        if reduce_op == "min":
+            return jax.ops.segment_min(msgs, dst, n_out)
+        raise ValueError(reduce_op)
+
+    return apply(fn, _t(x), name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    src = src_index.data if isinstance(src_index, Tensor) else jnp.asarray(src_index)
+    dst = dst_index.data if isinstance(dst_index, Tensor) else jnp.asarray(dst_index)
+
+    def fn(a, e):
+        n_out = out_size or a.shape[0]
+        msgs = jnp.take(a, src, axis=0)
+        if message_op == "add":
+            msgs = msgs + e
+        elif message_op == "mul":
+            msgs = msgs * e
+        return jax.ops.segment_sum(msgs, dst, n_out)
+
+    return apply(fn, _t(x), _t(y), name="send_ue_recv")
+
+
+def segment_sum(data, segment_ids, name=None):
+    ids = segment_ids.data if isinstance(segment_ids, Tensor) \
+        else jnp.asarray(segment_ids)
+    n = int(jax.device_get(ids.max())) + 1 if ids.size else 0
+    return apply(lambda a: jax.ops.segment_sum(a, ids, n), _t(data))
+
+
+def segment_mean(data, segment_ids, name=None):
+    ids = segment_ids.data if isinstance(segment_ids, Tensor) \
+        else jnp.asarray(segment_ids)
+    n = int(jax.device_get(ids.max())) + 1 if ids.size else 0
+
+    def fn(a):
+        s = jax.ops.segment_sum(a, ids, n)
+        c = jax.ops.segment_sum(jnp.ones(ids.shape, a.dtype), ids, n)
+        shape = (-1,) + (1,) * (a.ndim - 1)
+        return s / jnp.maximum(c, 1.0).reshape(shape)
+
+    return apply(fn, _t(data))
+
+
+def segment_max(data, segment_ids, name=None):
+    ids = segment_ids.data if isinstance(segment_ids, Tensor) \
+        else jnp.asarray(segment_ids)
+    n = int(jax.device_get(ids.max())) + 1 if ids.size else 0
+    return apply(lambda a: jax.ops.segment_max(a, ids, n), _t(data))
+
+
+def segment_min(data, segment_ids, name=None):
+    ids = segment_ids.data if isinstance(segment_ids, Tensor) \
+        else jnp.asarray(segment_ids)
+    n = int(jax.device_get(ids.max())) + 1 if ids.size else 0
+    return apply(lambda a: jax.ops.segment_min(a, ids, n), _t(data))

@@ -72,16 +72,32 @@ class Model:
                                 num_workers=num_workers)
         else:
             loader = train_data
+        cbs = list(callbacks or [])
+        for cb in cbs:
+            cb.set_model(self)
+            cb.set_params({"epochs": epochs, "batch_size": batch_size,
+                           "verbose": verbose})
+            cb.on_train_begin()
         history = []
         it = 0
+        self.stop_training = False
         for epoch in range(epochs):
             for m in self._metrics:
                 m.reset()
+            for cb in cbs:
+                cb.on_epoch_begin(epoch)
             for step, batch in enumerate(loader):
+                for cb in cbs:
+                    cb.on_train_batch_begin(step)
                 data, label = batch[0], batch[1] if len(batch) > 1 else None
                 res = self.train_batch(data, label)
                 loss_val = res[0][0] if isinstance(res, tuple) else res[0]
                 it += 1
+                logs = {"loss": loss_val}
+                for m in self._metrics:
+                    logs[m.name()] = m.accumulate()
+                for cb in cbs:
+                    cb.on_train_batch_end(step, logs)
                 if verbose and step % log_freq == 0:
                     msg = f"epoch {epoch} step {step}: loss={loss_val:.4f}"
                     for m in self._metrics:
@@ -90,8 +106,18 @@ class Model:
                 if num_iters is not None and it >= num_iters:
                     break
             history.append(loss_val)
+            epoch_logs = {"loss": loss_val}
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size)
+                epoch_logs.update(self.evaluate(eval_data,
+                                                batch_size=batch_size))
+            for cb in cbs:
+                cb.on_epoch_end(epoch, epoch_logs)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+            if self.stop_training:
+                break
+        for cb in cbs:
+            cb.on_train_end({"loss": loss_val})
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

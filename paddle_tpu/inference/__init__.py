@@ -1,0 +1,64 @@
+"""paddle.inference analog.
+
+ref: paddle/fluid/inference/api/analysis_predictor.h:95 AnalysisPredictor —
+load program, run IR pass pipelines, dispatch subgraphs to TensorRT.
+
+TPU-native: a Predictor wraps a jit-compiled forward (XLA performs the
+fusion/optimization passes the reference implements as 251 IR pass files);
+models load from state_dict checkpoints; serving-side decode uses the KV
+cache path in models/generation.py.
+"""
+import numpy as np
+
+
+class Config:
+    """ref: inference/api/paddle_analysis_config.h AnalysisConfig."""
+
+    def __init__(self, model_path=None, params_path=None):
+        self.model_path = model_path
+        self.params_path = params_path
+        self._use_tpu = True
+        self._memory_optim = True
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_tpu = True
+
+    def disable_gpu(self):
+        self._use_tpu = False
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA always optimizes
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = flag
+
+
+class Predictor:
+    """Zero-copy-ish predictor over a jitted Layer forward."""
+
+    def __init__(self, layer_or_config, config=None):
+        from ..nn import Layer
+        from ..jit import to_static
+        if isinstance(layer_or_config, Layer):
+            self._layer = layer_or_config
+            self._layer.eval()
+            to_static(self._layer)
+        else:
+            raise TypeError(
+                "Predictor(model: nn.Layer) — program files from the "
+                "reference are not loadable; restore via state_dict "
+                "checkpoints instead")
+
+    def run(self, inputs):
+        from ..tensor.tensor import Tensor
+        from ..autograd import tape
+        ts = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+              for x in inputs]
+        with tape.no_grad():
+            out = self._layer(*ts)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [o.numpy() for o in outs]
+
+
+def create_predictor(config_or_model, config=None):
+    return Predictor(config_or_model, config)

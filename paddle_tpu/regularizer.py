@@ -1,0 +1,2 @@
+"""paddle.regularizer (ref: python/paddle/regularizer.py)."""
+from .optimizer.optimizer import L1Decay, L2Decay

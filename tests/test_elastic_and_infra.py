@@ -1,0 +1,106 @@
+"""Elastic manager, flags, profiler, checkpoint-async infra tests
+(ref: unittests/test_fleet_elastic_manager.py — mocked etcd)."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestElasticManager:
+    def test_register_and_hosts(self):
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          InMemoryStore)
+        store = InMemoryStore()
+        m1 = ElasticManager("10.0.0.1:8000", np=2, store=store)
+        m2 = ElasticManager("10.0.0.2:8000", np=2, store=store)
+        m1.register()
+        m2.register()
+        assert m1.hosts() == ["10.0.0.1:8000", "10.0.0.2:8000"]
+        env = m1.endpoints_env()
+        assert env["PADDLE_TRAINERS_NUM"] == "2"
+        m1.exit()
+        m2.exit()
+
+    def test_scale_event_triggers_restart(self):
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          ElasticStatus,
+                                                          InMemoryStore)
+        store = InMemoryStore()
+        m1 = ElasticManager("h1:8000", np=1, min_np=1, max_np=3, store=store)
+        m1.register()
+        # another host joins -> watch returns RESTART
+        m2 = ElasticManager("h2:8000", np=1, min_np=1, max_np=3, store=store)
+        m2.register()
+        status = m1.watch(timeout=2)
+        assert status == ElasticStatus.RESTART
+        m1.exit()
+        m2.exit()
+
+
+class TestFlags:
+    def test_set_get(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_nan_check_raises(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            with pytest.raises(FloatingPointError):
+                x = paddle.to_tensor([1.0, 0.0])
+                paddle.log(x * 0.0)  # log(0) = -inf
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+class TestProfiler:
+    def test_record_event_and_summary(self):
+        from paddle_tpu import profiler
+        with profiler.RecordEvent("my_span"):
+            _ = paddle.matmul(paddle.randn([32, 32]), paddle.randn([32, 32]))
+        out = profiler.Profiler(timer_only=True).summary()
+        assert "my_span" in out
+
+    def test_profiler_steps(self):
+        from paddle_tpu import profiler
+        p = profiler.Profiler(timer_only=True,
+                              scheduler=profiler.make_scheduler(
+                                  closed=1, ready=1, record=2))
+        p.start()
+        for _ in range(5):
+            _ = paddle.randn([8])
+            p.step()
+        p.stop()
+
+    def test_benchmark_timer(self):
+        from paddle_tpu.profiler import timer
+        b = timer.Benchmark()
+        b._warmup = 0
+        b.begin()
+        for _ in range(3):
+            time.sleep(0.01)
+            b.step(num_samples=4)
+        info = b.step_info()
+        assert "avg_step" in info
+
+
+class TestLauncherCLI:
+    def test_launcher_runs_script(self, tmp_path):
+        import subprocess
+        import sys
+        script = tmp_path / "train.py"
+        script.write_text("import os\n"
+                          "print('rank', os.environ['PADDLE_TRAINER_ID'])\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--log_dir", str(tmp_path / "logs"),
+             str(script)],
+            capture_output=True, text=True, timeout=60,
+            cwd="/root/repo")
+        assert r.returncode == 0, r.stderr
+        logs = sorted((tmp_path / "logs").glob("workerlog.*"))
+        assert len(logs) == 2
+        contents = "".join(p.read_text() for p in logs)
+        assert "rank 0" in contents and "rank 1" in contents

@@ -6,3 +6,9 @@ from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .mobilenetv1 import MobileNetV1, mobilenet_v1
 from .mobilenetv2 import MobileNetV2, mobilenet_v2
 from .alexnet import AlexNet, alexnet
+from .densenet import (DenseNet, densenet121, densenet161, densenet169,
+                       densenet201)
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
+from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_5,
+                           shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+                           shufflenet_v2_x2_0)

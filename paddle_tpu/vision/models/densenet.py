@@ -1,0 +1,89 @@
+"""DenseNet (ref: python/paddle/vision/models/densenet.py)."""
+from ...nn import (Layer, Conv2D, BatchNorm2D, ReLU, MaxPool2D, AvgPool2D,
+                   AdaptiveAvgPool2D, Linear, Sequential)
+from ...tensor import manipulation as M
+
+
+class _DenseLayer(Layer):
+    def __init__(self, num_input_features, growth_rate, bn_size, drop_rate):
+        super().__init__()
+        self.norm1 = BatchNorm2D(num_input_features)
+        self.relu = ReLU()
+        self.conv1 = Conv2D(num_input_features, bn_size * growth_rate, 1,
+                            bias_attr=False)
+        self.norm2 = BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1,
+                            bias_attr=False)
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        return M.concat([x, out], axis=1)
+
+
+class _Transition(Sequential):
+    def __init__(self, in_ch, out_ch):
+        super().__init__(
+            BatchNorm2D(in_ch), ReLU(),
+            Conv2D(in_ch, out_ch, 1, bias_attr=False),
+            AvgPool2D(2, 2),
+        )
+
+
+class DenseNet(Layer):
+    def __init__(self, layers=121, growth_rate=32, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        cfgs = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+                169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+                264: (6, 12, 64, 48)}
+        block_config = cfgs[layers]
+        num_init = 2 * growth_rate
+        self.features = Sequential(
+            Conv2D(3, num_init, 7, stride=2, padding=3, bias_attr=False),
+            BatchNorm2D(num_init), ReLU(), MaxPool2D(3, 2, padding=1))
+        ch = num_init
+        blocks = []
+        for i, n in enumerate(block_config):
+            for j in range(n):
+                blocks.append(_DenseLayer(ch, growth_rate, bn_size, dropout))
+                ch += growth_rate
+            if i != len(block_config) - 1:
+                blocks.append(_Transition(ch, ch // 2))
+                ch //= 2
+        self.blocks = Sequential(*blocks)
+        self.norm_final = BatchNorm2D(ch)
+        self.relu_final = ReLU()
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        x = self.blocks(x)
+        x = self.relu_final(self.norm_final(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = M.flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(201, **kwargs)

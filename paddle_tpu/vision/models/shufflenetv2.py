@@ -1,0 +1,102 @@
+"""ShuffleNetV2 (ref: python/paddle/vision/models/shufflenetv2.py)."""
+from ...nn import (Layer, Conv2D, BatchNorm2D, ReLU, MaxPool2D,
+                   AdaptiveAvgPool2D, Linear, Sequential)
+from ...nn import functional as F
+from ...tensor import manipulation as M
+
+
+def channel_shuffle(x, groups):
+    return F.channel_shuffle(x, groups)
+
+
+class InvertedResidual(Layer):
+    def __init__(self, inp, oup, stride):
+        super().__init__()
+        self.stride = stride
+        branch_features = oup // 2
+        if stride > 1:
+            self.branch1 = Sequential(
+                Conv2D(inp, inp, 3, stride=stride, padding=1, groups=inp,
+                       bias_attr=False),
+                BatchNorm2D(inp),
+                Conv2D(inp, branch_features, 1, bias_attr=False),
+                BatchNorm2D(branch_features), ReLU())
+        else:
+            self.branch1 = None
+        in2 = inp if stride > 1 else branch_features
+        self.branch2 = Sequential(
+            Conv2D(in2, branch_features, 1, bias_attr=False),
+            BatchNorm2D(branch_features), ReLU(),
+            Conv2D(branch_features, branch_features, 3, stride=stride,
+                   padding=1, groups=branch_features, bias_attr=False),
+            BatchNorm2D(branch_features),
+            Conv2D(branch_features, branch_features, 1, bias_attr=False),
+            BatchNorm2D(branch_features), ReLU())
+
+    def forward(self, x):
+        if self.stride == 1:
+            x1, x2 = M.chunk(x, 2, axis=1)
+            out = M.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = M.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        stage_repeats = [4, 8, 4]
+        channels = {0.5: [24, 48, 96, 192, 1024],
+                    1.0: [24, 116, 232, 464, 1024],
+                    1.5: [24, 176, 352, 704, 1024],
+                    2.0: [24, 244, 488, 976, 2048]}[scale]
+        self.conv1 = Sequential(
+            Conv2D(3, channels[0], 3, stride=2, padding=1, bias_attr=False),
+            BatchNorm2D(channels[0]), ReLU())
+        self.maxpool = MaxPool2D(3, 2, padding=1)
+        stages = []
+        in_ch = channels[0]
+        for i, reps in enumerate(stage_repeats):
+            out_ch = channels[i + 1]
+            stages.append(InvertedResidual(in_ch, out_ch, 2))
+            for _ in range(reps - 1):
+                stages.append(InvertedResidual(out_ch, out_ch, 1))
+            in_ch = out_ch
+        self.stages = Sequential(*stages)
+        self.conv5 = Sequential(
+            Conv2D(in_ch, channels[-1], 1, bias_attr=False),
+            BatchNorm2D(channels[-1]), ReLU())
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(channels[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.stages(x)
+        x = self.conv5(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = M.flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(2.0, **kwargs)

@@ -1,0 +1,61 @@
+"""Fleet distributed metrics (ref: python/paddle/distributed/fleet/metrics/
+metric.py — global auc/mae/rmse over ranks via allreduce)."""
+import numpy as np
+
+from ...collective import all_reduce, ReduceOp
+from ....tensor.tensor import Tensor
+
+
+def _global_sum(arr):
+    t = Tensor(np.asarray(arr, np.float64))
+    all_reduce(t, op=ReduceOp.SUM)
+    return t.numpy()
+
+
+def sum(input, scope=None, util=None):
+    return float(_global_sum(np.sum(np.asarray(input))))
+
+
+def max(input, scope=None, util=None):
+    t = Tensor(np.asarray(np.max(np.asarray(input)), np.float64))
+    all_reduce(t, op=ReduceOp.MAX)
+    return float(t.numpy())
+
+
+def min(input, scope=None, util=None):
+    t = Tensor(np.asarray(np.min(np.asarray(input)), np.float64))
+    all_reduce(t, op=ReduceOp.MIN)
+    return float(t.numpy())
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    return float(_global_sum(abserr)) / float(_global_sum(total_ins_num))
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    return float(np.sqrt(_global_sum(sqrerr) / _global_sum(total_ins_num)))
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None):
+    return float(_global_sum(sqrerr) / _global_sum(total_ins_num))
+
+
+def acc(correct, total, scope=None, util=None):
+    return float(_global_sum(correct)) / float(_global_sum(total))
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Global AUC from per-rank histogram buckets (ref: metric.py auc)."""
+    pos = _global_sum(np.asarray(stat_pos, np.float64))
+    neg = _global_sum(np.asarray(stat_neg, np.float64))
+    tot_pos = 0.0
+    tot_neg = 0.0
+    area = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_pos = tot_pos + pos[i]
+        new_neg = tot_neg + neg[i]
+        area += (new_neg - tot_neg) * (new_pos + tot_pos) / 2.0
+        tot_pos, tot_neg = new_pos, new_neg
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.0
+    return float(area / (tot_pos * tot_neg))

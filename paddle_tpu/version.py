@@ -1,0 +1,12 @@
+"""paddle.version analog."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+commit = "tpu-native-round1"
+istaged = False
+
+
+def show():
+    print(f"paddle_tpu {full_version} (commit {commit})")

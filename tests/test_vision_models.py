@@ -1,0 +1,46 @@
+"""Vision model zoo forward-shape tests (ref: unittests/test_vision_models.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.mark.parametrize("name", [
+    "resnet18", "vgg11", "mobilenet_v1", "mobilenet_v2", "alexnet",
+    "squeezenet1_1", "shufflenet_v2_x0_5", "densenet121",
+])
+def test_forward_shapes(name):
+    from paddle_tpu.vision import models
+    paddle.seed(0)
+    model = getattr(models, name)(num_classes=10)
+    model.eval()
+    size = 64 if name != "alexnet" else 224
+    x = paddle.randn([1, 3, size, size])
+    out = model(x)
+    assert out.shape == [1, 10]
+
+
+def test_lenet():
+    from paddle_tpu.vision.models import LeNet
+    m = LeNet()
+    m.eval()
+    assert m(paddle.randn([2, 1, 28, 28])).shape == [2, 10]
+
+
+def test_transforms_pipeline():
+    from paddle_tpu.vision import transforms as T
+    import numpy as np
+    tr = T.Compose([T.Resize(32), T.CenterCrop(28), T.ToTensor(),
+                    T.Normalize(0.5, 0.5)])
+    img = (np.random.rand(40, 40, 3) * 255).astype(np.uint8)
+    out = tr(img)
+    assert out.shape == [3, 28, 28]
+
+
+def test_nms():
+    from paddle_tpu.vision.ops import nms
+    boxes = paddle.to_tensor(np.asarray(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]], np.float32))
+    scores = paddle.to_tensor(np.asarray([0.9, 0.8, 0.7], np.float32))
+    keep = nms(boxes, iou_threshold=0.5, scores=scores)
+    np.testing.assert_array_equal(np.sort(keep.numpy()), [0, 2])

@@ -1,0 +1,747 @@
+// Parameter-server service — native sparse/dense table server + client.
+//
+// TPU-native rebuild of the reference's "the-one-PS"
+// (ref: paddle/fluid/distributed/ps/table/memory_sparse_table.h:91
+//  PullSparse/PushSparse, memory_dense_table.h, sparse_sgd_rule.h:29
+//  SparseValueSGDRule, ctr_accessor.h CtrCommonAccessor) and of the
+// HeterPS/PS-GPU hashtable service the zmxdream fork specialises in
+// (ref: paddle/fluid/framework/fleet/heter_ps/hashtable_kernel.cu,
+//  ps_gpu_wrapper.cc). Design differences from the reference:
+//   - brpc is replaced by a thin length-prefixed TCP protocol (same style
+//     as csrc/tcp_store.cc) — no external RPC dependency in this image.
+//   - GPU-resident hashtables are replaced host-side: the TPU analog keeps
+//     the *pass working set* as a dense jax array on device (see
+//     python distributed/ps/embedding.py PsPassCache); the authoritative
+//     store lives here on the host/PS nodes.
+//
+// Sparse row layout (CTR-style, ref ctr_accessor.h):
+//   [show, click, g2sum, w[0..dim)]   (+ adam: m[0..dim) v[0..dim))
+// Optimizer rules (ref sparse_sgd_rule.h): 0=naive SGD, 1=std adagrad
+// (scalar g2sum per row), 2=adam.
+//
+// Wire protocol: request = op(u8) body...; ints little-endian u32 unless
+// noted; response = status(u8) body...
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC -o libps.so ps_service.cc -lpthread
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t OP_CREATE = 0;
+constexpr uint8_t OP_PULL_SPARSE = 1;
+constexpr uint8_t OP_PUSH_SPARSE = 2;
+constexpr uint8_t OP_PULL_DENSE = 3;
+constexpr uint8_t OP_SET_DENSE = 4;
+constexpr uint8_t OP_PUSH_DENSE = 5;
+constexpr uint8_t OP_SAVE = 6;
+constexpr uint8_t OP_LOAD = 7;
+constexpr uint8_t OP_SHRINK = 8;
+constexpr uint8_t OP_STAT = 9;
+constexpr uint8_t OP_BARRIER = 10;
+constexpr uint8_t OP_CLEAR = 11;
+
+constexpr uint8_t OPT_SGD = 0;
+constexpr uint8_t OPT_ADAGRAD = 1;
+constexpr uint8_t OPT_ADAM = 2;
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct TableConfig {
+  uint8_t is_dense = 0;
+  uint8_t optimizer = OPT_ADAGRAD;
+  uint32_t dim = 0;
+  float lr = 0.05f;
+  float init_range = 0.01f;
+  float min_bound = -10.f;   // ref sparse_sgd_rule.h BoundValue
+  float max_bound = 10.f;
+  float adagrad_init_g2 = 0.f;
+  float beta1 = 0.9f, beta2 = 0.999f, eps = 1e-8f;
+};
+
+// One sparse row: header (show, click, g2sum) + w[dim] (+ adam m,v).
+struct SparseTableShard {
+  std::unordered_map<uint64_t, std::vector<float>> rows;
+  std::mutex mu;
+};
+
+constexpr int kShards = 16;  // intra-table sharding for concurrent workers
+                             // (ref: memory_sparse_table task_pool shards)
+
+struct Table {
+  TableConfig cfg;
+  // sparse
+  SparseTableShard shards[kShards];
+  // dense
+  std::vector<float> dense;          // params
+  std::vector<float> dense_state;    // adagrad g2 / adam m+v
+  uint64_t dense_step = 0;
+  std::mutex dense_mu;
+  std::mt19937 rng{1234};
+
+  size_t row_floats() const {
+    size_t n = 3 + cfg.dim;                       // show, click, g2sum, w
+    if (cfg.optimizer == OPT_ADAM) n += 2 * cfg.dim;  // m, v
+    return n;
+  }
+
+  void init_row(std::vector<float>& row) {
+    row.assign(row_floats(), 0.f);
+    row[2] = cfg.adagrad_init_g2;
+    std::uniform_real_distribution<float> dist(-cfg.init_range,
+                                               cfg.init_range);
+    for (uint32_t i = 0; i < cfg.dim; ++i) row[3 + i] = dist(rng);
+  }
+
+  // ref sparse_sgd_rule.cc: SparseNaiveSGDRule / SparseAdaGradSGDRule /
+  // SparseAdamSGDRule UpdateValueWork — per-row update with bounds.
+  void update_row(std::vector<float>& row, const float* g, float show_inc,
+                  float click_inc) {
+    row[0] += show_inc;
+    row[1] += click_inc;
+    float* w = row.data() + 3;
+    uint32_t d = cfg.dim;
+    switch (cfg.optimizer) {
+      case OPT_SGD: {
+        for (uint32_t i = 0; i < d; ++i) w[i] -= cfg.lr * g[i];
+        break;
+      }
+      case OPT_ADAGRAD: {
+        float add = 0.f;
+        for (uint32_t i = 0; i < d; ++i) add += g[i] * g[i];
+        row[2] += add / d;
+        float scale = cfg.lr / (std::sqrt(row[2]) + cfg.eps + 1e-10f);
+        for (uint32_t i = 0; i < d; ++i) w[i] -= scale * g[i];
+        break;
+      }
+      case OPT_ADAM: {
+        float* m = w + d;
+        float* v = m + d;
+        row[2] += 1.f;  // step count in g2sum slot
+        float t = row[2];
+        float bc1 = 1.f - std::pow(cfg.beta1, t);
+        float bc2 = 1.f - std::pow(cfg.beta2, t);
+        for (uint32_t i = 0; i < d; ++i) {
+          m[i] = cfg.beta1 * m[i] + (1 - cfg.beta1) * g[i];
+          v[i] = cfg.beta2 * v[i] + (1 - cfg.beta2) * g[i] * g[i];
+          w[i] -= cfg.lr * (m[i] / bc1) / (std::sqrt(v[i] / bc2) + cfg.eps);
+        }
+        break;
+      }
+    }
+    for (uint32_t i = 0; i < d; ++i) {
+      if (w[i] < cfg.min_bound) w[i] = cfg.min_bound;
+      if (w[i] > cfg.max_bound) w[i] = cfg.max_bound;
+    }
+  }
+
+  void dense_update(const float* g, size_t n) {
+    std::lock_guard<std::mutex> lk(dense_mu);
+    if (dense.size() < n) dense.resize(n, 0.f);
+    switch (cfg.optimizer) {
+      case OPT_SGD: {
+        for (size_t i = 0; i < n; ++i) dense[i] -= cfg.lr * g[i];
+        break;
+      }
+      case OPT_ADAGRAD: {
+        if (dense_state.size() < n) dense_state.resize(n, 0.f);
+        for (size_t i = 0; i < n; ++i) {
+          dense_state[i] += g[i] * g[i];
+          dense[i] -= cfg.lr * g[i] / (std::sqrt(dense_state[i]) + cfg.eps);
+        }
+        break;
+      }
+      case OPT_ADAM: {
+        if (dense_state.size() < 2 * n) dense_state.resize(2 * n, 0.f);
+        dense_step += 1;
+        float bc1 = 1.f - std::pow(cfg.beta1, (float)dense_step);
+        float bc2 = 1.f - std::pow(cfg.beta2, (float)dense_step);
+        float* m = dense_state.data();
+        float* v = dense_state.data() + n;
+        for (size_t i = 0; i < n; ++i) {
+          m[i] = cfg.beta1 * m[i] + (1 - cfg.beta1) * g[i];
+          v[i] = cfg.beta2 * v[i] + (1 - cfg.beta2) * g[i] * g[i];
+          dense[i] -= cfg.lr * (m[i] / bc1) / (std::sqrt(v[i] / bc2) + cfg.eps);
+        }
+        break;
+      }
+    }
+  }
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> running{true};
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::mutex clients_mu;
+  std::vector<int> client_fds;
+  std::mutex tables_mu;
+  std::unordered_map<uint32_t, std::unique_ptr<Table>> tables;
+  // barrier (ref: barrier_table.cc)
+  std::mutex bar_mu;
+  std::condition_variable bar_cv;
+  int bar_count = 0;
+  int bar_gen = 0;
+
+  Table* get_table(uint32_t id) {
+    std::lock_guard<std::mutex> lk(tables_mu);
+    auto it = tables.find(id);
+    return it == tables.end() ? nullptr : it->second.get();
+  }
+};
+
+void handle_client(Server* s, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::vector<uint64_t> keys;
+  std::vector<float> vals;
+  for (;;) {
+    uint8_t op;
+    if (!read_full(fd, &op, 1)) break;
+    uint8_t ok = 0;
+    switch (op) {
+      case OP_CREATE: {
+        uint32_t tid;
+        TableConfig cfg;
+        if (!read_full(fd, &tid, 4) || !read_full(fd, &cfg.is_dense, 1) ||
+            !read_full(fd, &cfg.optimizer, 1) || !read_full(fd, &cfg.dim, 4) ||
+            !read_full(fd, &cfg.lr, 4) || !read_full(fd, &cfg.init_range, 4))
+          goto done;
+        {
+          std::lock_guard<std::mutex> lk(s->tables_mu);
+          auto it = s->tables.find(tid);
+          if (it == s->tables.end()) {
+            auto t = std::make_unique<Table>();
+            t->cfg = cfg;
+            t->rng.seed(1234 + tid);
+            s->tables[tid] = std::move(t);
+          } else if (it->second->cfg.dim != cfg.dim ||
+                     it->second->cfg.optimizer != cfg.optimizer ||
+                     it->second->cfg.is_dense != cfg.is_dense) {
+            ok = 3;  // re-create with a different config is an error, not a
+                     // silent no-op — a mismatched dim would desync pulls.
+          }
+        }
+        write_full(fd, &ok, 1);
+        break;
+      }
+      case OP_PULL_SPARSE: {
+        // Client declares its expected dim so a mismatch is a clean error
+        // status, never a short/over read that desyncs the connection.
+        uint32_t tid, n, cdim;
+        uint8_t init_missing;
+        if (!read_full(fd, &tid, 4) || !read_full(fd, &n, 4) ||
+            !read_full(fd, &cdim, 4) || !read_full(fd, &init_missing, 1))
+          goto done;
+        keys.resize(n);
+        if (n && !read_full(fd, keys.data(), 8ull * n)) goto done;
+        Table* t = s->get_table(tid);
+        if (!t) { ok = 1; write_full(fd, &ok, 1); break; }
+        if (t->cfg.dim != cdim) { ok = 4; write_full(fd, &ok, 1); break; }
+        uint32_t d = t->cfg.dim;
+        vals.assign((size_t)n * d, 0.f);
+        for (uint32_t i = 0; i < n; ++i) {
+          uint64_t k = keys[i];
+          auto& shard = t->shards[k % kShards];
+          std::lock_guard<std::mutex> lk(shard.mu);
+          auto it = shard.rows.find(k);
+          if (it == shard.rows.end()) {
+            if (!init_missing) continue;
+            std::vector<float> row;
+            {
+              std::lock_guard<std::mutex> dlk(t->dense_mu);  // rng guard
+              t->init_row(row);
+            }
+            it = shard.rows.emplace(k, std::move(row)).first;
+          }
+          std::memcpy(vals.data() + (size_t)i * d, it->second.data() + 3,
+                      4ull * d);
+        }
+        write_full(fd, &ok, 1);
+        write_full(fd, vals.data(), 4ull * vals.size());
+        break;
+      }
+      case OP_PUSH_SPARSE: {
+        // The payload size is what the CLIENT declares (cdim): always drain
+        // it fully, even on missing table / dim mismatch, so the connection
+        // stays framed; then report the error status.
+        uint32_t tid, n, cdim;
+        uint8_t has_sc;
+        if (!read_full(fd, &tid, 4) || !read_full(fd, &n, 4) ||
+            !read_full(fd, &cdim, 4) || !read_full(fd, &has_sc, 1))
+          goto done;
+        keys.resize(n);
+        if (n && !read_full(fd, keys.data(), 8ull * n)) goto done;
+        vals.assign((size_t)n * cdim, 0.f);
+        if (n && cdim && !read_full(fd, vals.data(), 4ull * vals.size()))
+          goto done;
+        std::vector<float> shows, clicks;
+        if (has_sc) {
+          shows.resize(n);
+          clicks.resize(n);
+          if (n && (!read_full(fd, shows.data(), 4ull * n) ||
+                    !read_full(fd, clicks.data(), 4ull * n)))
+            goto done;
+        }
+        Table* t = s->get_table(tid);
+        if (!t) { ok = 1; write_full(fd, &ok, 1); break; }
+        if (t->cfg.dim != cdim) { ok = 4; write_full(fd, &ok, 1); break; }
+        uint32_t d = t->cfg.dim;
+        for (uint32_t i = 0; i < n; ++i) {
+          uint64_t k = keys[i];
+          auto& shard = t->shards[k % kShards];
+          std::lock_guard<std::mutex> lk(shard.mu);
+          auto it = shard.rows.find(k);
+          if (it == shard.rows.end()) {
+            std::vector<float> row;
+            {
+              std::lock_guard<std::mutex> dlk(t->dense_mu);
+              t->init_row(row);
+            }
+            it = shard.rows.emplace(k, std::move(row)).first;
+          }
+          t->update_row(it->second, vals.data() + (size_t)i * d,
+                        has_sc ? shows[i] : 1.f, has_sc ? clicks[i] : 0.f);
+        }
+        write_full(fd, &ok, 1);
+        break;
+      }
+      case OP_PULL_DENSE: {
+        uint32_t tid, n;
+        if (!read_full(fd, &tid, 4) || !read_full(fd, &n, 4)) goto done;
+        Table* t = s->get_table(tid);
+        if (!t) { ok = 1; write_full(fd, &ok, 1); break; }
+        // Read-only: positions past the current size come back zero without
+        // growing server state.
+        vals.assign(n, 0.f);
+        {
+          std::lock_guard<std::mutex> lk(t->dense_mu);
+          size_t have = t->dense.size() < n ? t->dense.size() : n;
+          if (have) std::memcpy(vals.data(), t->dense.data(), 4ull * have);
+        }
+        write_full(fd, &ok, 1);
+        write_full(fd, vals.data(), 4ull * n);
+        break;
+      }
+      case OP_SET_DENSE:
+      case OP_PUSH_DENSE: {
+        uint32_t tid, n;
+        if (!read_full(fd, &tid, 4) || !read_full(fd, &n, 4)) goto done;
+        vals.assign(n, 0.f);
+        if (n && !read_full(fd, vals.data(), 4ull * n)) goto done;
+        Table* t = s->get_table(tid);
+        if (!t) { ok = 1; write_full(fd, &ok, 1); break; }
+        if (op == OP_SET_DENSE) {
+          std::lock_guard<std::mutex> lk(t->dense_mu);
+          t->dense.assign(vals.begin(), vals.end());
+        } else {
+          t->dense_update(vals.data(), n);
+        }
+        write_full(fd, &ok, 1);
+        break;
+      }
+      case OP_SAVE:
+      case OP_LOAD: {
+        // ref: memory_sparse_table.cc Save/Load (text shards on disk);
+        // binary here: nrows(u64), then key(u64) + row floats.
+        uint32_t tid, plen;
+        if (!read_full(fd, &tid, 4) || !read_full(fd, &plen, 4)) goto done;
+        std::string path(plen, '\0');
+        if (plen && !read_full(fd, path.data(), plen)) goto done;
+        Table* t = s->get_table(tid);
+        if (!t) { ok = 1; write_full(fd, &ok, 1); break; }
+        if (op == OP_SAVE) {
+          FILE* f = std::fopen(path.c_str(), "wb");
+          if (!f) { ok = 2; write_full(fd, &ok, 1); break; }
+          uint64_t nrows = 0;
+          for (auto& sh : t->shards) {
+            std::lock_guard<std::mutex> lk(sh.mu);
+            nrows += sh.rows.size();
+          }
+          std::fwrite(&nrows, 8, 1, f);
+          size_t rf = t->row_floats();
+          for (auto& sh : t->shards) {
+            std::lock_guard<std::mutex> lk(sh.mu);
+            for (auto& kv : sh.rows) {
+              std::fwrite(&kv.first, 8, 1, f);
+              std::fwrite(kv.second.data(), 4, rf, f);
+            }
+          }
+          {
+            std::lock_guard<std::mutex> lk(t->dense_mu);
+            uint64_t dn = t->dense.size();
+            std::fwrite(&dn, 8, 1, f);
+            if (dn) std::fwrite(t->dense.data(), 4, dn, f);
+          }
+          std::fclose(f);
+        } else {
+          FILE* f = std::fopen(path.c_str(), "rb");
+          if (!f) { ok = 2; write_full(fd, &ok, 1); break; }
+          uint64_t nrows = 0;
+          if (std::fread(&nrows, 8, 1, f) != 1) nrows = 0;
+          size_t rf = t->row_floats();
+          for (uint64_t i = 0; i < nrows; ++i) {
+            uint64_t k;
+            std::vector<float> row(rf);
+            if (std::fread(&k, 8, 1, f) != 1 ||
+                std::fread(row.data(), 4, rf, f) != rf)
+              break;
+            auto& shard = t->shards[k % kShards];
+            std::lock_guard<std::mutex> lk(shard.mu);
+            shard.rows[k] = std::move(row);
+          }
+          uint64_t dn = 0;
+          if (std::fread(&dn, 8, 1, f) == 1 && dn) {
+            std::lock_guard<std::mutex> lk(t->dense_mu);
+            t->dense.resize(dn);
+            if (std::fread(t->dense.data(), 4, dn, f) != dn) ok = 2;
+          }
+          std::fclose(f);
+        }
+        write_full(fd, &ok, 1);
+        break;
+      }
+      case OP_SHRINK: {
+        // ref: memory_sparse_table.cc Shrink — decay show, drop cold rows.
+        uint32_t tid;
+        float threshold, decay;
+        if (!read_full(fd, &tid, 4) || !read_full(fd, &threshold, 4) ||
+            !read_full(fd, &decay, 4))
+          goto done;
+        Table* t = s->get_table(tid);
+        uint64_t dropped = 0;
+        if (t) {
+          for (auto& sh : t->shards) {
+            std::lock_guard<std::mutex> lk(sh.mu);
+            for (auto it = sh.rows.begin(); it != sh.rows.end();) {
+              it->second[0] *= decay;
+              if (it->second[0] < threshold) {
+                it = sh.rows.erase(it);
+                ++dropped;
+              } else {
+                ++it;
+              }
+            }
+          }
+        }
+        write_full(fd, &ok, 1);
+        write_full(fd, &dropped, 8);
+        break;
+      }
+      case OP_STAT: {
+        uint32_t tid;
+        if (!read_full(fd, &tid, 4)) goto done;
+        Table* t = s->get_table(tid);
+        uint64_t nrows = 0, nfloats = 0;
+        if (t) {
+          for (auto& sh : t->shards) {
+            std::lock_guard<std::mutex> lk(sh.mu);
+            nrows += sh.rows.size();
+          }
+          nfloats = nrows * t->row_floats();
+          std::lock_guard<std::mutex> lk(t->dense_mu);
+          nfloats += t->dense.size();
+        }
+        write_full(fd, &ok, 1);
+        write_full(fd, &nrows, 8);
+        write_full(fd, &nfloats, 8);
+        break;
+      }
+      case OP_BARRIER: {
+        uint32_t world;
+        if (!read_full(fd, &world, 4)) goto done;
+        {
+          std::unique_lock<std::mutex> lk(s->bar_mu);
+          int gen = s->bar_gen;
+          if (++s->bar_count >= (int)world) {
+            s->bar_count = 0;
+            ++s->bar_gen;
+            s->bar_cv.notify_all();
+          } else {
+            // Shutdown must be able to break a half-full barrier, or
+            // stop()'s join would deadlock on this thread.
+            s->bar_cv.wait(lk, [&] {
+              return s->bar_gen != gen || !s->running.load();
+            });
+            if (s->bar_gen == gen) { ok = 5; }  // interrupted by shutdown
+          }
+        }
+        write_full(fd, &ok, 1);
+        break;
+      }
+      case OP_CLEAR: {
+        uint32_t tid;
+        if (!read_full(fd, &tid, 4)) goto done;
+        Table* t = s->get_table(tid);
+        if (t) {
+          for (auto& sh : t->shards) {
+            std::lock_guard<std::mutex> lk(sh.mu);
+            sh.rows.clear();
+          }
+          std::lock_guard<std::mutex> lk(t->dense_mu);
+          t->dense.clear();
+          t->dense_state.clear();
+        }
+        write_full(fd, &ok, 1);
+        break;
+      }
+      default:
+        goto done;
+    }
+  }
+done:
+  {
+    // Deregister before closing: the fd number can be recycled by any other
+    // socket in this process, and stop() must not shutdown() a stranger.
+    std::lock_guard<std::mutex> lk(s->clients_mu);
+    for (auto it = s->client_fds.begin(); it != s->client_fds.end(); ++it) {
+      if (*it == fd) { s->client_fds.erase(it); break; }
+    }
+  }
+  ::close(fd);
+}
+
+void accept_loop(Server* s) {
+  for (;;) {
+    int fd = ::accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (!s->running.load()) return;
+      continue;
+    }
+    {
+      std::lock_guard<std::mutex> lk(s->clients_mu);
+      s->client_fds.push_back(fd);
+    }
+    s->workers.emplace_back(handle_client, s, fd);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ps_server_start(int port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (::bind(s->listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 128) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(s->listen_fd, (sockaddr*)&addr, &len);
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread(accept_loop, s);
+  return s;
+}
+
+int ps_server_port(void* h) { return static_cast<Server*>(h)->port; }
+
+void ps_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->running.store(false);
+  {
+    std::lock_guard<std::mutex> lk(s->bar_mu);
+    s->bar_cv.notify_all();  // release threads parked in a half-full barrier
+  }
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> lk(s->clients_mu);
+    for (int cfd : s->client_fds) ::shutdown(cfd, SHUT_RDWR);
+  }
+  for (auto& t : s->workers)
+    if (t.joinable()) t.join();
+  delete s;
+}
+
+int ps_client_connect(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  inet_pton(AF_INET, host, &addr.sin_addr);
+  for (int attempt = 0; attempt < 200; ++attempt) {
+    if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(25));
+  }
+  ::close(fd);
+  return -1;
+}
+
+void ps_client_close(int fd) { ::close(fd); }
+
+int ps_create_table(int fd, uint32_t tid, uint8_t is_dense, uint8_t opt,
+                    uint32_t dim, float lr, float init_range) {
+  uint8_t op = OP_CREATE;
+  if (!write_full(fd, &op, 1) || !write_full(fd, &tid, 4) ||
+      !write_full(fd, &is_dense, 1) || !write_full(fd, &opt, 1) ||
+      !write_full(fd, &dim, 4) || !write_full(fd, &lr, 4) ||
+      !write_full(fd, &init_range, 4))
+    return -1;
+  uint8_t st;
+  return read_full(fd, &st, 1) ? st : -1;
+}
+
+int ps_pull_sparse(int fd, uint32_t tid, const uint64_t* keys, uint32_t n,
+                   uint32_t dim, float* out, uint8_t init_missing) {
+  uint8_t op = OP_PULL_SPARSE;
+  if (!write_full(fd, &op, 1) || !write_full(fd, &tid, 4) ||
+      !write_full(fd, &n, 4) || !write_full(fd, &dim, 4) ||
+      !write_full(fd, &init_missing, 1) ||
+      (n && !write_full(fd, keys, 8ull * n)))
+    return -1;
+  uint8_t st;
+  if (!read_full(fd, &st, 1)) return -1;
+  if (st != 0) return st;
+  return read_full(fd, out, 4ull * n * dim) ? 0 : -1;
+}
+
+int ps_push_sparse(int fd, uint32_t tid, const uint64_t* keys, uint32_t n,
+                   uint32_t dim, const float* grads, const float* shows,
+                   const float* clicks) {
+  uint8_t op = OP_PUSH_SPARSE;
+  uint8_t has_sc = (shows && clicks) ? 1 : 0;
+  if (!write_full(fd, &op, 1) || !write_full(fd, &tid, 4) ||
+      !write_full(fd, &n, 4) || !write_full(fd, &dim, 4) ||
+      !write_full(fd, &has_sc, 1) ||
+      (n && !write_full(fd, keys, 8ull * n)) ||
+      (n && !write_full(fd, grads, 4ull * n * dim)))
+    return -1;
+  if (has_sc) {
+    if (!write_full(fd, shows, 4ull * n) || !write_full(fd, clicks, 4ull * n))
+      return -1;
+  }
+  uint8_t st;
+  return read_full(fd, &st, 1) ? st : -1;
+}
+
+int ps_pull_dense(int fd, uint32_t tid, float* out, uint32_t n) {
+  uint8_t op = OP_PULL_DENSE;
+  if (!write_full(fd, &op, 1) || !write_full(fd, &tid, 4) ||
+      !write_full(fd, &n, 4))
+    return -1;
+  uint8_t st;
+  if (!read_full(fd, &st, 1)) return -1;
+  if (st != 0) return st;  // error responses carry no payload
+  return read_full(fd, out, 4ull * n) ? 0 : -1;
+}
+
+int ps_push_dense(int fd, uint32_t tid, const float* vals, uint32_t n,
+                  uint8_t is_param) {
+  uint8_t op = is_param ? OP_SET_DENSE : OP_PUSH_DENSE;
+  if (!write_full(fd, &op, 1) || !write_full(fd, &tid, 4) ||
+      !write_full(fd, &n, 4) || (n && !write_full(fd, vals, 4ull * n)))
+    return -1;
+  uint8_t st;
+  return read_full(fd, &st, 1) ? st : -1;
+}
+
+int ps_save(int fd, uint32_t tid, const char* path) {
+  uint8_t op = OP_SAVE;
+  uint32_t plen = std::strlen(path);
+  if (!write_full(fd, &op, 1) || !write_full(fd, &tid, 4) ||
+      !write_full(fd, &plen, 4) || !write_full(fd, path, plen))
+    return -1;
+  uint8_t st;
+  return read_full(fd, &st, 1) ? st : -1;
+}
+
+int ps_load(int fd, uint32_t tid, const char* path) {
+  uint8_t op = OP_LOAD;
+  uint32_t plen = std::strlen(path);
+  if (!write_full(fd, &op, 1) || !write_full(fd, &tid, 4) ||
+      !write_full(fd, &plen, 4) || !write_full(fd, path, plen))
+    return -1;
+  uint8_t st;
+  return read_full(fd, &st, 1) ? st : -1;
+}
+
+long long ps_shrink(int fd, uint32_t tid, float threshold, float decay) {
+  uint8_t op = OP_SHRINK;
+  if (!write_full(fd, &op, 1) || !write_full(fd, &tid, 4) ||
+      !write_full(fd, &threshold, 4) || !write_full(fd, &decay, 4))
+    return -1;
+  uint8_t st;
+  uint64_t dropped;
+  if (!read_full(fd, &st, 1) || !read_full(fd, &dropped, 8)) return -1;
+  return (long long)dropped;
+}
+
+long long ps_stat(int fd, uint32_t tid, unsigned long long* nfloats) {
+  uint8_t op = OP_STAT;
+  if (!write_full(fd, &op, 1) || !write_full(fd, &tid, 4)) return -1;
+  uint8_t st;
+  uint64_t nrows, nf;
+  if (!read_full(fd, &st, 1) || !read_full(fd, &nrows, 8) ||
+      !read_full(fd, &nf, 8))
+    return -1;
+  if (nfloats) *nfloats = nf;
+  return (long long)nrows;
+}
+
+int ps_barrier(int fd, uint32_t world) {
+  uint8_t op = OP_BARRIER;
+  if (!write_full(fd, &op, 1) || !write_full(fd, &world, 4)) return -1;
+  uint8_t st;
+  return read_full(fd, &st, 1) ? st : -1;
+}
+
+int ps_clear(int fd, uint32_t tid) {
+  uint8_t op = OP_CLEAR;
+  if (!write_full(fd, &op, 1) || !write_full(fd, &tid, 4)) return -1;
+  uint8_t st;
+  return read_full(fd, &st, 1) ? st : -1;
+}
+
+}  // extern "C"

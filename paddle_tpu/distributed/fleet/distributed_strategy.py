@@ -58,6 +58,11 @@ _DEFAULTS = {
     "fuse_grad_size_in_MB": 32,
     "nccl_comm_num": 1,
     "without_graph_optimization": True,
+    # Parameter-server mode (ref: distributed_strategy.proto a_sync,
+    # a_sync_configs — async PS training knobs; proto default is true).
+    "a_sync": True,
+    "a_sync_configs": {"k_steps": -1, "send_queue_size": 16,
+                       "use_ps_gpu": False},
 }
 
 

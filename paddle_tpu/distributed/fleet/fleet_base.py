@@ -18,10 +18,23 @@ class Fleet:
         self._topology = None
         self._strategy = None
         self._mesh = None
+        self._role_maker = None
+        self._ps_runtime = None
 
     def init(self, role_maker=None, is_collective=True, strategy=None):
         """ref: fleet.py:169 + _init_hybrid_parallel_env:385."""
         self._strategy = strategy or DistributedStrategy()
+        if not is_collective:
+            # Parameter-server mode (ref: fleet.py:169 non-collective path
+            # -> TheOnePSRuntime). No device mesh; comm is PS pull/push.
+            # The reference derives the role from env when none is given
+            # (PaddleCloudRoleMaker), so do the same.
+            from ..ps.the_one_ps import PaddleCloudRoleMaker, TheOnePsRuntime
+            self._role_maker = role_maker or PaddleCloudRoleMaker()
+            self._ps_runtime = TheOnePsRuntime(self._role_maker,
+                                               strategy=self._strategy)
+            self._is_initialized = True
+            return self
         init_parallel_env()
         hc = self._strategy.hybrid_configs
         dp, mp = int(hc["dp_degree"]), int(hc["mp_degree"])
@@ -101,20 +114,41 @@ class Fleet:
                                            self._strategy)
         return optimizer
 
-    # PS-era APIs kept for parity; collective-only build.
+    # Parameter-server lifecycle (ref: fleet.py:679 init_server,
+    # :780 run_server; delegates to the-one-PS runtime, ps/the_one_ps.py).
+    def _require_ps_runtime(self):
+        if self._ps_runtime is None:
+            raise RuntimeError(
+                "fleet is not in parameter-server mode — call "
+                "fleet.init(is_collective=False) (with TRAINING_ROLE / "
+                "PADDLE_PSERVERS_IP_PORT_LIST env or an explicit role_maker) "
+                "before init_server/run_server/init_worker")
+        return self._ps_runtime
+
     def init_server(self, *args, **kwargs):
-        raise NotImplementedError(
-            "parameter-server mode: not in the TPU build (collective only)")
+        return self._require_ps_runtime().init_server(*args, **kwargs)
 
     def run_server(self):
-        raise NotImplementedError
+        return self._require_ps_runtime().run_server()
+
+    def stop_server(self):
+        return self._require_ps_runtime().stop_server()
+
+    def init_worker(self):
+        return self._require_ps_runtime().init_worker()
 
     def stop_worker(self):
-        pass
+        if getattr(self, "_ps_runtime", None) is not None:
+            self._ps_runtime.stop_worker()
+
+    @property
+    def ps_runtime(self):
+        return self._ps_runtime
 
     def save_persistables(self, executor=None, dirname=None, main_program=None,
                           mode=0):
-        pass
+        if getattr(self, "_ps_runtime", None) is not None and dirname:
+            self._ps_runtime.save_persistables(dirname)
 
 
 fleet_instance = Fleet()

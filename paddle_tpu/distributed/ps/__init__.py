@@ -1,0 +1,17 @@
+"""Parameter-server subsystem (the-one-PS, TPU-native).
+
+ref: paddle/fluid/distributed/ps/ (brpc PS: ~53 kLoC) and the zmxdream
+fork's HeterPS/PS-GPU (paddle/fluid/framework/fleet/heter_ps/, ~40 kLoC).
+See service.py / embedding.py / the_one_ps.py docstrings for the mapping.
+"""
+from .service import (OPTIMIZERS, PsClient, PsCluster, PsServer,
+                      SparseTableConfig)
+from .embedding import DistributedEmbedding, PsPassCache
+from .the_one_ps import (PaddleCloudRoleMaker, TheOnePsRuntime, Role,
+                         local_cluster)
+
+__all__ = [
+    "PsServer", "PsClient", "PsCluster", "SparseTableConfig", "OPTIMIZERS",
+    "DistributedEmbedding", "PsPassCache",
+    "PaddleCloudRoleMaker", "TheOnePsRuntime", "Role", "local_cluster",
+]

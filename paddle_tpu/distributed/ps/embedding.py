@@ -1,0 +1,169 @@
+"""Distributed sparse embedding over the parameter server.
+
+TPU-native rebuild of the reference's sparse-embedding path:
+ - `paddle.static.nn.sparse_embedding` / `c_embedding` pull-push ops
+   (ref: paddle/fluid/operators/pscore/distributed_lookup_table_op.cc,
+    distributed_push_sparse_op.cc)
+ - the zmxdream fork's HeterPS/PS-GPU pass cache: `PSGPUWrapper::BuildPull`
+   dedupes a pass's keys, builds a device-resident hashtable, trains the
+   whole pass on-device, `EndPass` writes back
+   (ref: paddle/fluid/framework/fleet/ps_gpu_wrapper.cc,
+    heter_ps/hashtable_kernel.cu).
+
+TPU design: the authoritative table lives on PS hosts (csrc/ps_service.cc).
+`DistributedEmbedding` pulls the rows for a batch (or a whole pass via
+`PsPassCache`), materialises them as a dense jax array — the device-side
+"hashtable" is (ids -> contiguous slots) so lookups are MXU/VPU-friendly
+gathers inside the compiled step — and pushes aggregated row gradients
+back in backward (Hogwild-style async, like the reference's async PS mode).
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from ...autograd import PyLayer
+from ...nn.layer.layers import Layer
+from ...tensor.tensor import Tensor
+from .service import PsCluster, SparseTableConfig
+
+
+class _PullPush(PyLayer):
+    """forward: gather pulled rows; backward: segment-sum grads per unique
+    id and push to the PS (ref: distributed_push_sparse_op.cc)."""
+
+    @staticmethod
+    def forward(ctx, rows, inverse, cluster, table_id, unique_keys, shows,
+                clicks):
+        ctx.cluster = cluster
+        ctx.table_id = table_id
+        ctx.unique_keys = unique_keys
+        ctx.n_unique = rows.shape[0]
+        ctx.shows = shows
+        ctx.clicks = clicks
+        ctx.save_for_backward(inverse)
+        out = rows.data[inverse.data]
+        return Tensor(out, stop_gradient=False)
+
+    @staticmethod
+    def backward(ctx, grad_out):
+        (inverse,) = ctx.saved_tensor()
+        import jax.ops  # noqa: F401  (segment_sum lives in jax.ops)
+        from jax.ops import segment_sum
+        row_grads = segment_sum(
+            grad_out.data.reshape(inverse.data.shape[0], -1),
+            inverse.data, num_segments=ctx.n_unique)
+        ctx.cluster.push_sparse(
+            ctx.table_id, ctx.unique_keys, np.asarray(row_grads),
+            ctx.shows, ctx.clicks)
+        return None, None
+
+
+class DistributedEmbedding(Layer):
+    """Unbounded-vocabulary embedding backed by a PS sparse table
+    (ref: python/paddle/static/nn/common.py sparse_embedding;
+     fleet PS lookup-table path). `forward(ids)` works for any uint64 ids —
+    rows are created on first touch with uniform init on the server.
+    """
+
+    def __init__(self, embedding_dim, cluster: PsCluster, table_id=0,
+                 optimizer="adagrad", lr=0.05, init_range=0.01,
+                 with_show_click=False, name=None):
+        super().__init__(name)
+        self.embedding_dim = embedding_dim
+        self.cluster = cluster
+        self.table_id = table_id
+        self.with_show_click = with_show_click
+        cluster.create_table(SparseTableConfig(
+            table_id, embedding_dim, optimizer=optimizer, lr=lr,
+            init_range=init_range))
+        self._pass_cache = None
+
+    def use_pass_cache(self, cache):
+        self._pass_cache = cache
+
+    def forward(self, ids):
+        ids_np = np.asarray(ids.data if isinstance(ids, Tensor) else ids)
+        shape = ids_np.shape
+        flat = ids_np.reshape(-1).astype(np.uint64)
+        if self._pass_cache is not None:
+            out = self._pass_cache.lookup(self, flat)
+        else:
+            unique, inverse = np.unique(flat, return_inverse=True)
+            rows = self.cluster.pull_sparse(self.table_id, unique)
+            shows = clicks = None
+            if self.with_show_click:
+                counts = np.bincount(inverse,
+                                     minlength=unique.size).astype(np.float32)
+                shows, clicks = counts, np.zeros_like(counts)
+            # rows carry stop_gradient=False so the tape records the node —
+            # backward's job here is the side-effect push, not a chain grad.
+            out = _PullPush.apply(
+                Tensor(jnp.asarray(rows), stop_gradient=False),
+                Tensor(jnp.asarray(inverse), stop_gradient=True),
+                self.cluster, self.table_id, unique, shows, clicks)
+        new_shape = shape + (self.embedding_dim,)
+        from ... import reshape
+        return reshape(out, new_shape)
+
+
+class _CacheLookup(PyLayer):
+    """Gather from the pass-resident device table; grads accumulate into the
+    cache's device-side grad buffer (pushed at end_pass)."""
+
+    @staticmethod
+    def forward(ctx, table, slots, cache):
+        ctx.cache = cache
+        ctx.n_slots = table.shape[0]
+        ctx.save_for_backward(slots)
+        return Tensor(table.data[slots.data], stop_gradient=False)
+
+    @staticmethod
+    def backward(ctx, grad_out):
+        (slots,) = ctx.saved_tensor()
+        from jax.ops import segment_sum
+        g = segment_sum(grad_out.data.reshape(slots.data.shape[0], -1),
+                        slots.data, num_segments=ctx.n_slots)
+        ctx.cache._accumulate(g)
+        return None, None
+
+
+class PsPassCache:
+    """Device-resident working set for one training pass
+    (ref: PSGPUWrapper BuildPull/EndPass, ps_gpu_wrapper.cc): dedupe the
+    pass's keys, pull once, keep rows as one dense device array, train many
+    batches with pure on-device gathers, push aggregated grads at end_pass.
+    """
+
+    def __init__(self, layer: DistributedEmbedding, pass_ids):
+        self.layer = layer
+        flat = np.asarray(pass_ids).reshape(-1).astype(np.uint64)
+        self.unique = np.unique(flat)  # sorted — slots via searchsorted
+        rows = layer.cluster.pull_sparse(layer.table_id, self.unique)
+        self.table = Tensor(jnp.asarray(rows), stop_gradient=False)
+        self.grad_acc = jnp.zeros_like(self.table.data)
+        self.show_acc = np.zeros(self.unique.size, dtype=np.float32)
+        layer.use_pass_cache(self)
+
+    def lookup(self, layer, flat_ids):
+        slots = np.searchsorted(self.unique, flat_ids).astype(np.int32)
+        if (slots >= self.unique.size).any() or \
+                (self.unique[slots] != flat_ids).any():
+            raise KeyError("pass cache: batch contains ids not in this pass "
+                           "(rebuild PsPassCache with the full pass id set)")
+        np.add.at(self.show_acc, slots, 1.0)
+        return _CacheLookup.apply(
+            self.table, Tensor(jnp.asarray(slots), stop_gradient=True), self)
+
+    def _accumulate(self, g):
+        self.grad_acc = self.grad_acc + g
+
+    def end_pass(self):
+        """Write back aggregated grads (server applies its optimizer rule),
+        then detach (ref: PSGPUWrapper::EndPass)."""
+        layer = self.layer
+        shows = clicks = None
+        if layer.with_show_click:
+            shows = self.show_acc
+            clicks = np.zeros_like(shows)
+        layer.cluster.push_sparse(layer.table_id, self.unique,
+                                  np.asarray(self.grad_acc), shows, clicks)
+        layer._pass_cache = None

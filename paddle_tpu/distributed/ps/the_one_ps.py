@@ -1,0 +1,148 @@
+"""The-one-PS runtime: role resolution + server/worker lifecycle.
+
+TPU-native rebuild of the reference's PS runtime
+(ref: python/paddle/distributed/ps/the_one_ps.py TheOnePSRuntime;
+ python/paddle/distributed/fleet/base/role_maker.py PaddleCloudRoleMaker —
+ 1231 LoC of env parsing reduced to the same env contract;
+ fleet.init_server/run_server: python/paddle/distributed/fleet/fleet.py:679,780).
+
+Env contract (same variable names as the reference):
+  TRAINING_ROLE               "TRAINER" | "PSERVER"
+  PADDLE_PSERVERS_IP_PORT_LIST  comma list "h1:p1,h2:p2"
+  PADDLE_PORT / POD_IP        this server's bind point
+  PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ID
+"""
+import os
+import threading
+
+from .service import PsCluster, PsServer
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class PaddleCloudRoleMaker:
+    """ref: fleet/base/role_maker.py PaddleCloudRoleMaker (env-driven)."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        self._is_collective = is_collective
+        role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        self._role = Role.SERVER if role == "PSERVER" else Role.WORKER
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_endpoints = [e for e in eps.split(",") if e]
+        self._worker_num = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._worker_index = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._cur_endpoint = "%s:%s" % (
+            os.environ.get("POD_IP", "127.0.0.1"),
+            os.environ.get("PADDLE_PORT", "0"))
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._worker_index == 0
+
+    def worker_index(self):
+        return self._worker_index
+
+    def worker_num(self):
+        return self._worker_num
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
+
+class TheOnePsRuntime:
+    """Server/worker lifecycle (ref: the_one_ps.py TheOnePSRuntime:
+    _init_server/_run_server/_init_worker/_stop_worker)."""
+
+    def __init__(self, role_maker=None, strategy=None):
+        self.role_maker = role_maker or PaddleCloudRoleMaker()
+        # a_sync=True (default Hogwild): workers pull/push independently.
+        # a_sync=False: workers align at init via a store barrier so no rank
+        # trains against an empty table while another has finished
+        # (ref: distributed_strategy.proto a_sync; geo/sync PS modes).
+        self.a_sync = bool(strategy.a_sync) if strategy is not None else True
+        self._server = None
+        self._cluster = None
+        self._stop_evt = threading.Event()
+
+    # -- server side ------------------------------------------------------
+    def init_server(self, *args, **kwargs):
+        port = int(self.role_maker._cur_endpoint.rsplit(":", 1)[1])
+        if port == 0:
+            raise RuntimeError(
+                "PADDLE_PORT is unset (resolved bind port 0) — the server "
+                "would listen on an ephemeral port that differs from the "
+                "endpoint advertised in PADDLE_PSERVERS_IP_PORT_LIST")
+        self._stop_evt.clear()  # allow stop->init->run restart cycles
+        self._server = PsServer(port)
+        return self._server
+
+    def run_server(self):
+        """Blocks until stop_server() (the C++ pool serves in background
+        threads; ref: BrpcPsServer::Start blocks in brpc join)."""
+        self._stop_evt.wait()
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+    def stop_server(self):
+        self._stop_evt.set()
+
+    # -- worker side ------------------------------------------------------
+    def init_worker(self):
+        eps = self.role_maker.get_pserver_endpoints()
+        if not eps:
+            raise RuntimeError("PADDLE_PSERVERS_IP_PORT_LIST not set")
+        self._cluster = PsCluster(eps)
+        if not self.a_sync:
+            self.barrier_worker()
+        return self._cluster
+
+    @property
+    def cluster(self):
+        return self._cluster
+
+    def barrier_worker(self):
+        if self._cluster is not None:
+            self._cluster.clients[0].barrier(self.role_maker.worker_num())
+
+    def stop_worker(self):
+        if self._cluster is not None:
+            self._cluster.close()
+            self._cluster = None
+
+    def save_persistables(self, dirname, table_ids=None):
+        """ref: fleet.save_persistables (fleet.py:918) — per-shard table
+        dump to `dirname/table_<id>/shard_<s>.bin`."""
+        if self._cluster is None:
+            raise RuntimeError("init_worker() first")
+        table_ids = table_ids or list(self._cluster._tables)
+        for tid in table_ids:
+            self._cluster.save(tid, os.path.join(dirname, f"table_{tid}"))
+
+    def load_persistables(self, dirname, table_ids=None):
+        if self._cluster is None:
+            raise RuntimeError("init_worker() first")
+        table_ids = table_ids or list(self._cluster._tables)
+        for tid in table_ids:
+            self._cluster.load(tid, os.path.join(dirname, f"table_{tid}"))
+
+
+def local_cluster(n_servers=2):
+    """In-process mini-cluster for tests/single-host runs (TPU analog of
+    the reference's single-node PS tests, ref: test_dist_base.py:902
+    TestDistBase fork-pserver path — here threads, not processes).
+    Returns (servers, cluster)."""
+    servers = [PsServer(0) for _ in range(n_servers)]
+    cluster = PsCluster([f"127.0.0.1:{s.port}" for s in servers])
+    return servers, cluster

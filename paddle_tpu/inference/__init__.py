@@ -1,64 +1,196 @@
 """paddle.inference analog.
 
 ref: paddle/fluid/inference/api/analysis_predictor.h:95 AnalysisPredictor —
-load program, run IR pass pipelines, dispatch subgraphs to TensorRT.
+load (program, params), run IR optimization pass pipelines, execute with
+zero-copy input/output handles; config via AnalysisConfig
+(inference/api/paddle_analysis_config.h).
 
-TPU-native: a Predictor wraps a jit-compiled forward (XLA performs the
-fusion/optimization passes the reference implements as 251 IR pass files);
-models load from state_dict checkpoints; serving-side decode uses the KV
-cache path in models/generation.py.
+TPU-native: the Predictor loads the `.pdmodel` (StableHLO) + `.pdiparams`
+artifact written by `paddle_tpu.jit.save` / `static.save_inference_model`
+and jit-compiles it for the local chip — XLA performs the fusion and memory
+optimization that the reference implements as 251 IR pass files plus
+TensorRT subgraph engines. Input/output handles mimic the reference's
+zero-copy `Tensor` handles (`copy_from_cpu`/`copy_to_cpu`).
 """
 import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Bfloat16 = "bfloat16"
+    Half = "float16"
+    Int8 = "int8"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "tpu"   # reference name kept; accelerator place on this build
+    TPU = "tpu"
 
 
 class Config:
-    """ref: inference/api/paddle_analysis_config.h AnalysisConfig."""
+    """ref: inference/api/paddle_analysis_config.h AnalysisConfig.
 
-    def __init__(self, model_path=None, params_path=None):
-        self.model_path = model_path
-        self.params_path = params_path
-        self._use_tpu = True
+    Holds artifact paths + knobs. IR-optimization toggles are accepted and
+    recorded but XLA always optimizes; they exist for source compatibility.
+    """
+
+    def __init__(self, prog_file=None, params_file=None):
+        # reference accepts (model_dir) or (prog_file, params_file);
+        # we additionally accept a bare path prefix.
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._device = "tpu"
+        self._precision = PrecisionType.Float32
         self._memory_optim = True
+        self._ir_optim = True
+        self._cpu_threads = 1
 
-    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
-        self._use_tpu = True
+    # -- device selection ---------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=PrecisionType.Float32):
+        self._device = "tpu"
+        self._precision = precision
 
     def disable_gpu(self):
-        self._use_tpu = False
+        self._device = "cpu"
 
+    def use_gpu(self):
+        return self._device == "tpu"
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_threads = int(n)
+
+    # -- optimization knobs (XLA handles these; recorded for parity) --------
     def switch_ir_optim(self, flag=True):
-        pass  # XLA always optimizes
+        self._ir_optim = bool(flag)
+
+    def ir_optim(self):
+        return self._ir_optim
 
     def enable_memory_optim(self, flag=True):
-        self._memory_optim = flag
+        self._memory_optim = bool(flag)
+
+    def enable_mkldnn(self):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        # TensorRT subgraphs have no TPU meaning; XLA compiles the whole
+        # program (ref: inference/tensorrt/ — subsumed).
+        pass
+
+    def switch_use_feed_fetch_ops(self, flag=False):
+        pass
+
+    def switch_specify_input_names(self, flag=True):
+        pass
+
+    def model_dir(self):
+        return self.prog_file
+
+
+class _IOHandle:
+    """Zero-copy-style handle (ref: inference/api/details/zero_copy_tensor.cc)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._array = None
+
+    def reshape(self, shape):
+        if self._array is not None and list(self._array.shape) != list(shape):
+            self._array = np.zeros(shape, self._array.dtype)
+
+    def copy_from_cpu(self, data):
+        self._array = np.asarray(data)
+
+    def copy_to_cpu(self):
+        return np.asarray(jax.device_get(self._array))
+
+    def shape(self):
+        return list(self._array.shape) if self._array is not None else []
 
 
 class Predictor:
-    """Zero-copy-ish predictor over a jitted Layer forward."""
+    """ref: analysis_predictor.h:95. Loads the serialized program and runs
+    it through handles; `run()` executes one jitted call."""
 
-    def __init__(self, layer_or_config, config=None):
-        from ..nn import Layer
-        from ..jit import to_static
-        if isinstance(layer_or_config, Layer):
-            self._layer = layer_or_config
-            self._layer.eval()
-            to_static(self._layer)
-        else:
+    def __init__(self, config):
+        from ..jit.export import ExportedProgram
+        if isinstance(config, str):
+            config = Config(config)
+        if not isinstance(config, Config) or config.prog_file is None:
             raise TypeError(
-                "Predictor(model: nn.Layer) — program files from the "
-                "reference are not loadable; restore via state_dict "
-                "checkpoints instead")
+                "create_predictor(Config(prog_file_prefix)) — save the model "
+                "first with paddle_tpu.jit.save or static.save_inference_model")
+        self._config = config
+        self._program = ExportedProgram.load(config.prog_file,
+                                             params_path=config.params_file)
+        if config._device == "cpu":
+            platforms = self._program.meta.get("platforms") or []
+            if platforms and "cpu" not in platforms:
+                raise RuntimeError(
+                    f"this program was exported for {platforms} only; "
+                    "disable_gpu() requires an artifact exported with a cpu "
+                    "lowering (jit.save produces portable cpu+tpu programs "
+                    "when the traced ops allow it)")
+            cpu = jax.devices("cpu")[0]
+            self._program.params = [jax.device_put(p, cpu)
+                                    for p in self._program.params]
+        self._inputs = {n: _IOHandle(n) for n in self._program.input_names}
+        self._outputs = {n: _IOHandle(n) for n in self._program.output_names}
 
-    def run(self, inputs):
-        from ..tensor.tensor import Tensor
-        from ..autograd import tape
-        ts = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
-              for x in inputs]
-        with tape.no_grad():
-            out = self._layer(*ts)
-        outs = out if isinstance(out, (list, tuple)) else [out]
-        return [o.numpy() for o in outs]
+    def get_input_names(self):
+        return list(self._inputs)
+
+    def get_output_names(self):
+        return list(self._outputs)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def run(self, inputs=None):
+        """Handle-style: stage via get_input_handle().copy_from_cpu() then
+        run(); or list-style: run([arr, ...]) -> [arr, ...] (the reference
+        PaddlePredictor::Run overload)."""
+        if inputs is not None:
+            for n, a in zip(self._program.input_names, inputs):
+                self._inputs[n].copy_from_cpu(
+                    a.numpy() if hasattr(a, "numpy") else a)
+        arrays = []
+        for n in self._program.input_names:
+            h = self._inputs[n]
+            if h._array is None:
+                raise ValueError(f"input '{n}' not set; call "
+                                 "get_input_handle(name).copy_from_cpu(...)")
+            arrays.append(jnp.asarray(h._array))
+        outs = self._program(*arrays)
+        for n, o in zip(self._program.output_names, outs):
+            self._outputs[n]._array = o
+        if inputs is not None:
+            return [np.asarray(jax.device_get(o)) for o in outs]
+        return True
+
+    def clone(self):
+        p = Predictor.__new__(Predictor)
+        p._config = self._config
+        p._program = self._program
+        p._inputs = {n: _IOHandle(n) for n in self._program.input_names}
+        p._outputs = {n: _IOHandle(n) for n in self._program.output_names}
+        return p
 
 
-def create_predictor(config_or_model, config=None):
-    return Predictor(config_or_model, config)
+def create_predictor(config):
+    """ref: paddle_inference_api.h CreatePaddlePredictor."""
+    return Predictor(config)
+
+
+def get_version():
+    from ..version import full_version
+    return full_version

@@ -7,6 +7,7 @@ Parameters/buffers the function touches (capture pass), then jax.jit a pure
 version with those captures threaded as inputs. XLA is the static executor
 (SURVEY §7: "InterpreterCore -> XLA is the executor").
 """
+import contextlib
 import functools
 
 import numpy as np
@@ -17,13 +18,53 @@ from ..autograd import tape
 from ..framework import random as rnd
 from ..tensor.tensor import Tensor
 
-# capture stack consulted by ops.apply
+# capture stacks consulted by ops.apply: touched tensors and op-produced
+# tensors (the difference = true leaves: params/buffers/constants).
 _capture_stack = []
+_produced_stack = []
 
 
 def _record_capture(t):
     if _capture_stack:
         _capture_stack[-1][id(t)] = t
+
+
+def _capture_run(thunk, exclude=()):
+    """Run `thunk` once eagerly, returning (leaf_tensors, output).
+
+    Leaves are Tensors the computation touched but did not produce —
+    params, buffers, closed-over constants. The analog of the reference
+    collecting persistables out of a traced program. Shared by
+    TracedFunction and jit/export.export_program.
+    """
+    captures = {}
+    produced = set()
+    _capture_stack.append(captures)
+    _produced_stack.append(produced)
+    try:
+        with tape.no_grad():
+            out = thunk()
+    finally:
+        _capture_stack.pop()
+        _produced_stack.pop()
+    leaves = [t for t in captures.values()
+              if id(t) not in produced
+              and not any(t is x for x in exclude)]
+    return leaves, out
+
+
+@contextlib.contextmanager
+def _swapped_data(tensors, arrays):
+    """Temporarily point `tensors` at `arrays` (tracers during jit),
+    restoring the originals on exit."""
+    saved = [t.data for t in tensors]
+    for t, a in zip(tensors, arrays):
+        t.data = a
+    try:
+        yield
+    finally:
+        for t, s in zip(tensors, saved):
+            t.data = s
 
 
 class TracedFunction:
@@ -53,25 +94,16 @@ class TracedFunction:
 
     def _trace(self, treedef, flat_in):
         # Pass 1: eager run, recording captured Tensors (params/buffers).
-        captures = {}
-        _capture_stack.append(captures)
-        try:
+        def thunk():
             args, kwargs = jax.tree_util.tree_unflatten(treedef, flat_in)
-            with tape.no_grad():
-                _ = self._fn(*args, **kwargs)
-        finally:
-            _capture_stack.pop()
-        captured = [t for t in captures.values()
-                    if not any(t is x for x in flat_in)]
+            return self._fn(*args, **kwargs)
+
+        captured, _ = _capture_run(thunk, exclude=flat_in)
 
         is_tensor = [isinstance(x, Tensor) for x in flat_in]
         out_tree_box = [None]
 
         def pure(cap_arrays, dyn_arrays, key):
-            # swap captured tensor data for tracers
-            saved = [t.data for t in captured]
-            for t, a in zip(captured, cap_arrays):
-                t.data = a
             new_flat = []
             di = 0
             for x, it in zip(flat_in, is_tensor):
@@ -81,13 +113,10 @@ class TracedFunction:
                     di += 1
                 else:
                     new_flat.append(x)
-            try:
-                a2, k2 = jax.tree_util.tree_unflatten(treedef, new_flat)
-                with tape.no_grad(), rnd.key_scope(key):
-                    out = self._fn(*a2, **k2)
-            finally:
-                for t, s in zip(captured, saved):
-                    t.data = s
+            a2, k2 = jax.tree_util.tree_unflatten(treedef, new_flat)
+            with _swapped_data(captured, cap_arrays), \
+                    tape.no_grad(), rnd.key_scope(key):
+                out = self._fn(*a2, **k2)
             out_flat, out_tree = jax.tree_util.tree_flatten(
                 out, is_leaf=lambda x: isinstance(x, Tensor))
             out_tree_box[0] = out_tree
@@ -111,6 +140,7 @@ def to_static(function=None, input_spec=None, build_strategy=None,
         if isinstance(fn, Layer):
             layer = fn
             orig_forward = layer.forward
+            layer._orig_forward = orig_forward
             traced = TracedFunction(lambda *a, **k: orig_forward(*a, **k))
             layer._traced_forward = traced
 
@@ -129,19 +159,36 @@ def to_static(function=None, input_spec=None, build_strategy=None,
 
 
 def save(layer, path, input_spec=None, **configs):
-    """ref: jit/api.py jit.save — persists state_dict + structure note."""
+    """Serialize to `<path>.pdmodel` (StableHLO) + `<path>.pdiparams`.
+
+    ref: python/paddle/jit/api.py jit.save — same two-file artifact layout;
+    the program here is exported StableHLO rather than a ProgramDesc. Also
+    writes `<path>.pdparams` (plain state_dict) so the python Layer can be
+    restored for fine-tuning.
+    """
     from ..framework.io import save as _save
     from ..nn import Layer
+    from .export import export_program
+
+    if input_spec is None:
+        raise ValueError(
+            "jit.save needs input_spec=[InputSpec(...)] or example Tensors "
+            "to trace the program (the reference takes it from the "
+            "@to_static-decorated forward's spec)")
+    program = export_program(layer, input_spec,
+                             name=type(layer).__name__
+                             if isinstance(layer, Layer) else "function")
+    program.save(path)
     if isinstance(layer, Layer):
-        _save({"state_dict": layer.state_dict(),
-               "class": type(layer).__name__}, path + ".pdparams")
-    else:
-        raise TypeError("jit.save expects a Layer")
+        _save(layer.state_dict(), path + ".pdparams")
+    return path + ".pdmodel"
 
 
 def load(path, **configs):
-    from ..framework.io import load as _load
-    return _load(path + ".pdparams")
+    """Load a saved program as an inference-only TranslatedLayer
+    (ref: python/paddle/jit/translated_layer.py)."""
+    from .export import ExportedProgram, TranslatedLayer
+    return TranslatedLayer(ExportedProgram.load(path))
 
 
 class InputSpec:

@@ -1,54 +1,20 @@
 """paddle.onnx analog (ref: python/paddle/onnx/export.py).
 
 TPU note: the deployment format for this framework is StableHLO (jax.export)
-— the XLA-world equivalent of ONNX. `export` emits StableHLO bytes (plus the
-state_dict); true ONNX emission would need an onnx wheel, which is not in
-this image.
+— the XLA-world equivalent of ONNX. `export` emits the serialized StableHLO
+program (plus the state_dict); true ONNX emission would need an onnx wheel,
+which is not in this image.
 """
-import numpy as np
 
 
 def export(layer, path, input_spec=None, opset_version=None, **configs):
-    import jax
-    from ..jit import TracedFunction, InputSpec
-    from ..autograd import tape
-    from ..tensor.tensor import Tensor
+    from ..jit.export import export_program
     from ..framework.io import save
 
-    specs = input_spec or []
-    example = []
-    for s in specs:
-        if isinstance(s, InputSpec):
-            shape = [1 if (d is None or d < 0) else d for d in s.shape]
-            example.append(np.zeros(shape, s.dtype))
-        else:
-            example.append(s.numpy() if isinstance(s, Tensor) else np.asarray(s))
-
-    params = list(layer.parameters())
-    parrs = [p.data for p in params]
-
-    def fn(*args):
-        weights = args[:len(params)]
-        inputs = args[len(params):]
-        saved = [p.data for p in params]
-        for p, w in zip(params, weights):
-            p.data = w
-        try:
-            with tape.no_grad():
-                out = layer(*[Tensor(a) for a in inputs])
-            return out.data if isinstance(out, Tensor) else tuple(
-                o.data for o in out)
-        finally:
-            for p, s_ in zip(params, saved):
-                p.data = s_
-
-    from jax import export as jexport
-    exported = jexport.export(jax.jit(fn))(
-        *[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in parrs],
-        *[jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
-          for a in example])
-    blob = exported.serialize()
+    program = export_program(layer, input_spec or [],
+                             name=type(layer).__name__)
     with open(path + ".stablehlo", "wb") as f:
-        f.write(blob)
-    save(layer.state_dict(), path + ".pdparams")
+        f.write(program.exported.serialize())
+    if hasattr(layer, "state_dict"):
+        save(layer.state_dict(), path + ".pdparams")
     return path + ".stablehlo"

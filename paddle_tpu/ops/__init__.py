@@ -92,7 +92,7 @@ def apply(fn, *inputs, n_outputs=1, name="", **kwargs):
             raws.append(jnp.asarray(x))
 
     # jit capture pass (see jit/__init__.py): record touched Tensors.
-    from ..jit import _capture_stack
+    from ..jit import _capture_stack, _produced_stack
     if _capture_stack:
         caps = _capture_stack[-1]
         for t in tensors:
@@ -111,7 +111,7 @@ def apply(fn, *inputs, n_outputs=1, name="", **kwargs):
     if not needs_grad:
         out = call(*raws)
         _maybe_check_nan_inf(name, out)
-        return _wrap_outputs(out, n_outputs, stop_gradient=True)
+        return _record_produced(_wrap_outputs(out, n_outputs, stop_gradient=True))
 
     # Differentiate only w.r.t. inexact inputs (jax.vjp rejects int primals
     # having cotangents anyway; we pass all and drop int cotangents).
@@ -129,7 +129,19 @@ def apply(fn, *inputs, n_outputs=1, name="", **kwargs):
         odtypes,
         name=name,
     )
-    return _wrap_outputs(out, n_outputs, stop_gradient=False, node=node)
+    return _record_produced(
+        _wrap_outputs(out, n_outputs, stop_gradient=False, node=node))
+
+
+def _record_produced(wrapped):
+    """Mark op outputs in the active capture frame so the jit/export capture
+    pass can tell leaves (params/buffers/constants) from intermediates."""
+    from ..jit import _produced_stack
+    if _produced_stack:
+        produced = _produced_stack[-1]
+        for t in (wrapped if isinstance(wrapped, tuple) else (wrapped,)):
+            produced.add(id(t))
+    return wrapped
 
 
 class _VjpAdapter:

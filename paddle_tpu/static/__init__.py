@@ -39,17 +39,35 @@ def program_guard(main_program=None, startup_program=None):
 
 
 class Executor:
-    """API-shim over jit execution (ref: fluid/executor.py:921)."""
+    """API-shim over jit/XLA execution (ref: fluid/executor.py:921 Executor,
+    framework/new_executor/interpretercore.cc — XLA is the interpreter)."""
 
     def __init__(self, place=None):
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        from ..jit.export import ExportedProgram
+        import numpy as _np
+        import jax as _jax
+        if isinstance(program, ExportedProgram):
+            feed = feed or {}
+            from ..tensor.tensor import Tensor as _Tensor
+            arrays = [feed[n] for n in program.input_names]
+            arrays = [a.data if isinstance(a, _Tensor) else _np.asarray(a)
+                      for a in arrays]
+            outs = program(*arrays)
+            if fetch_list:
+                names = program.output_names
+                idx = [names.index(f) if isinstance(f, str) else int(f)
+                       for f in fetch_list]
+                outs = [outs[i] for i in idx]
+            return [_np.asarray(_jax.device_get(o)) for o in outs]
         if callable(program):
             out = program(**(feed or {}))
             return out if isinstance(out, (list, tuple)) else [out]
         raise NotImplementedError(
-            "static Program execution: wrap your computation in "
+            "static Program execution: pass an ExportedProgram (from "
+            "load_inference_model) or wrap your computation in "
             "paddle_tpu.jit.to_static; graph-IR programs are not used on TPU")
 
 
@@ -58,16 +76,51 @@ def data(name, shape, dtype="float32", lod_level=0):
 
 
 def save(program, model_path, **kwargs):
-    pass
+    """ref: python/paddle/static/io.py save — persists the trainable state.
+    Here `program` is a Layer or a dict-like state holder."""
+    from ..framework.io import save as _save
+    state = program.state_dict() if hasattr(program, "state_dict") else program
+    _save(state, model_path + ".pdparams")
 
 
 def load(program, model_path, executor=None, var_names=None):
-    pass
+    """ref: python/paddle/static/io.py load."""
+    from ..framework.io import load as _load
+    state = _load(model_path + ".pdparams")
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(state)
+    return state
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
-                         **kwargs):
-    pass
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Write the two-file deployment artifact `<prefix>.pdmodel` +
+    `<prefix>.pdiparams` (ref: python/paddle/static/io.py
+    save_inference_model — same artifact contract, StableHLO payload).
+
+    TPU-native signature: `feed_vars` are InputSpecs (as returned by
+    `static.data`) and the computation is `program` (a Layer or callable
+    over Tensors); `fetch_vars` may be that callable when `program` is None,
+    mirroring common reference usage where fetch targets pin the subgraph.
+    """
+    from ..jit.export import export_program
+    target = program if program is not None else fetch_vars
+    if not callable(target):
+        raise TypeError(
+            "save_inference_model on TPU serializes a traced callable: pass "
+            "program=<Layer or fn over Tensors> (graph-IR fetch_vars from a "
+            "reference ProgramDesc do not exist here)")
+    feed = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    prog = export_program(target, feed)
+    return prog.save(path_prefix)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns [program, feed_target_names, fetch_targets] like the
+    reference (ref: python/paddle/static/io.py load_inference_model)."""
+    from ..jit.export import ExportedProgram
+    prog = ExportedProgram.load(path_prefix)
+    return [prog, prog.input_names, prog.output_names]
 
 
 class amp:

@@ -1,0 +1,167 @@
+"""Saved-program arc: jit.save/load, static save/load_inference_model,
+inference Predictor (ref test models: python/paddle/fluid/tests/unittests/
+test_jit_save_load.py, test_inference_model_io.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit import InputSpec
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+        self.act = nn.ReLU()
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+def _ref_out(model, x):
+    model.eval()
+    with paddle.no_grad():
+        return model(paddle.to_tensor(x)).numpy()
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    paddle.seed(7)
+    model = MLP()
+    x = np.random.randn(3, 16).astype("float32")
+    want = _ref_out(model, x)
+
+    prefix = str(tmp_path / "mlp")
+    paddle.jit.save(model, prefix, input_spec=[InputSpec([None, 16], "float32")])
+
+    loaded = paddle.jit.load(prefix)
+    got = loaded(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    # polymorphic batch: different batch size runs without retrace error
+    x2 = np.random.randn(7, 16).astype("float32")
+    got2 = loaded(paddle.to_tensor(x2)).numpy()
+    np.testing.assert_allclose(got2, _ref_out(model, x2), rtol=1e-5, atol=1e-5)
+
+
+def test_jit_save_writes_two_file_artifact(tmp_path):
+    model = MLP()
+    prefix = str(tmp_path / "m")
+    paddle.jit.save(model, prefix, input_spec=[InputSpec([None, 16], "float32")])
+    assert (tmp_path / "m.pdmodel").exists()
+    assert (tmp_path / "m.pdiparams").exists()
+    assert (tmp_path / "m.pdparams").exists()
+
+
+def test_translated_layer_is_inference_only(tmp_path):
+    model = MLP()
+    prefix = str(tmp_path / "m")
+    paddle.jit.save(model, prefix, input_spec=[InputSpec([2, 16], "float32")])
+    loaded = paddle.jit.load(prefix)
+    with pytest.raises(RuntimeError):
+        loaded.train()
+    sd = loaded.state_dict()
+    assert any("fc1" in k for k in sd), sorted(sd)
+
+
+def test_capture_excludes_intermediates(tmp_path):
+    """The .pdiparams must hold only leaves (params/buffers/constants), not
+    activations from the capture trace."""
+    model = MLP()
+    prefix = str(tmp_path / "m")
+    paddle.jit.save(model, prefix, input_spec=[InputSpec([4, 16], "float32")])
+    loaded = paddle.jit.load(prefix)
+    n_params = len(loaded.program.params)
+    assert n_params == len(list(model.parameters())), (
+        f"captured {n_params} arrays, expected just the "
+        f"{len(list(model.parameters()))} parameters")
+
+
+def test_static_save_load_inference_model(tmp_path):
+    model = MLP()
+    x = np.random.randn(5, 16).astype("float32")
+    want = _ref_out(model, x)
+
+    prefix = str(tmp_path / "infer")
+    exe = paddle.static.Executor()
+    paddle.static.save_inference_model(
+        prefix, [InputSpec([None, 16], "float32", name="x")], None, exe,
+        program=model)
+
+    program, feed_names, fetch_names = paddle.static.load_inference_model(
+        prefix, exe)
+    assert feed_names == ["x"]
+    outs = exe.run(program, feed={"x": x}, fetch_list=fetch_names)
+    np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_handles(tmp_path):
+    from paddle_tpu import inference
+
+    model = MLP()
+    x = np.random.randn(2, 16).astype("float32")
+    want = _ref_out(model, x)
+
+    prefix = str(tmp_path / "pred")
+    paddle.jit.save(model, prefix, input_spec=[InputSpec([None, 16], "float32")])
+
+    config = inference.Config(prefix + ".pdmodel")
+    config.enable_memory_optim()
+    predictor = inference.create_predictor(config)
+
+    names = predictor.get_input_names()
+    assert len(names) == 1
+    h = predictor.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    predictor.run()
+    out = predictor.get_output_handle(predictor.get_output_names()[0])
+    np.testing.assert_allclose(out.copy_to_cpu(), want, rtol=1e-5, atol=1e-5)
+
+    # list-style Run overload + clone
+    p2 = predictor.clone()
+    outs = p2.run([x])
+    np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-5)
+
+
+def test_multi_output_and_dict_structure(tmp_path):
+    class TwoHead(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+
+        def forward(self, x):
+            h = self.fc(x)
+            return {"logits": h, "feats": (x, h * 2)}
+
+    model = TwoHead()
+    x = np.random.randn(3, 8).astype("float32")
+    prefix = str(tmp_path / "two")
+    paddle.jit.save(model, prefix, input_spec=[InputSpec([3, 8], "float32")])
+    loaded = paddle.jit.load(prefix)
+    out = loaded(paddle.to_tensor(x))
+    assert set(out) == {"logits", "feats"}
+    assert isinstance(out["feats"], tuple)
+    model.eval()
+    with paddle.no_grad():
+        want = model(paddle.to_tensor(x))
+    np.testing.assert_allclose(out["logits"].numpy(), want["logits"].numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(out["feats"][1].numpy(),
+                               want["feats"][1].numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_vision_model_roundtrip(tmp_path):
+    """A conv/BN/pool model exercises buffers (BN running stats) in the
+    artifact (ref: test_inference_model_io.py conv cases)."""
+    from paddle_tpu.vision.models import LeNet
+
+    model = LeNet()
+    x = np.random.randn(2, 1, 28, 28).astype("float32")
+    want = _ref_out(model, x)
+    prefix = str(tmp_path / "lenet")
+    paddle.jit.save(model, prefix,
+                    input_spec=[InputSpec([None, 1, 28, 28], "float32")])
+    loaded = paddle.jit.load(prefix)
+    got = loaded(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)

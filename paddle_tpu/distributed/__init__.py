@@ -24,6 +24,7 @@ from .fleet import meta_parallel
 from . import utils
 from .spawn import spawn
 from .store import TCPStore
+from . import fleet_executor
 
 
 def get_backend():

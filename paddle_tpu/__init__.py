@@ -24,9 +24,15 @@ from .framework.dtype import (bool, uint8, int8, int16, int32, int64, float16,
                               bfloat16, float32, float64, complex64, complex128,
                               get_default_dtype, set_default_dtype)
 from .framework.place import (CPUPlace, TPUPlace, CUDAPlace, XPUPlace,
+                              NPUPlace, MLUPlace, IPUPlace, CUDAPinnedPlace,
                               set_device, get_device, is_compiled_with_tpu,
                               is_compiled_with_cuda)
 from .framework.random import seed, get_rng_state, set_rng_state
+from .framework.misc import (dtype, iinfo, is_floating_point, is_integer,
+                             is_complex, rank, set_printoptions,
+                             disable_signal_handler, check_shape, LazyGuard,
+                             batch, create_parameter, get_cuda_rng_state,
+                             set_cuda_rng_state)
 from .framework.io import save, load
 from .framework import in_dygraph_mode, in_dynamic_mode
 
@@ -54,7 +60,10 @@ from .tensor.math import (exp, expm1, log, log2, log10, log1p, sqrt, rsqrt,
                           scale, clip, stanh, lerp, addmm, sum, mean, max, min,
                           prod, amax, amin, logsumexp, cumsum, cumprod, nansum,
                           nanmean, count_nonzero, diff, trace, all, any,
-                          matmul, mm, bmm, dot, mv, multiplex, gcd, lcm)
+                          matmul, mm, bmm, dot, mv, multiplex, gcd, lcm,
+                          logcumsumexp, rad2deg, deg2rad, add_n, sgn, renorm,
+                          frexp, increment, diagonal, take, tanh_,
+                          broadcast_shape)
 from .tensor.manipulation import (cast, reshape, reshape_, flatten, transpose,
                                   moveaxis, swapaxes, squeeze, unsqueeze,
                                   unsqueeze_, concat, stack, unstack, split,
@@ -67,7 +76,9 @@ from .tensor.manipulation import (cast, reshape, reshape_, flatten, transpose,
                                   masked_select, masked_fill, where, nonzero,
                                   unique, unbind, crop, as_complex, as_real,
                                   tensordot, atleast_1d, atleast_2d,
-                                  atleast_3d, view, numel, shard_index)
+                                  atleast_3d, view, numel, shard_index,
+                                  unique_consecutive, vsplit, squeeze_,
+                                  scatter_, reverse, shape, tolist)
 from .tensor.linalg import (norm, dist, cross, matrix_power, inverse, pinv,
                             det, slogdet, solve, triangular_solve, cholesky,
                             cholesky_solve, qr, svd, eig, eigh, eigvals,
@@ -87,12 +98,14 @@ from .tensor.einsum import einsum
 
 from . import linalg  # namespaced linalg
 from . import nn
+from .nn.param_attr import ParamAttr
 from . import optimizer
 from . import amp
 from . import io
 from . import metric
 from . import vision
 from . import distributed
+from .distributed.parallel import DataParallel
 from . import jit
 from . import static
 from . import profiler

@@ -12,7 +12,10 @@ from .collective import (new_group, get_group, Group, all_reduce, all_gather,
                          reduce_scatter, broadcast, reduce,
                          scatter, send, recv, barrier, ReduceOp, wait,
                          split as collective_split, alltoall,
-                         alltoall as all_to_all)
+                         alltoall as all_to_all, isend, irecv, P2POp,
+                         batch_isend_irecv, all_gather_object,
+                         broadcast_object_list, scatter_object_list,
+                         all_to_all_single)
 from .topology import CommunicateTopology, HybridCommunicateGroup
 from .mesh import (global_mesh, set_global_mesh, build_mesh, mesh_axis_size,
                    in_spmd_region, current_axis_name)
@@ -25,6 +28,7 @@ from . import utils
 from .spawn import spawn
 from .store import TCPStore
 from . import fleet_executor
+from . import rpc
 
 
 def get_backend():

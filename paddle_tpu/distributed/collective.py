@@ -322,6 +322,102 @@ def ppermute(tensor, perm, axis_name):
                  name="ppermute")
 
 
+class P2POp:
+    """One pending p2p operation (ref: python/paddle/distributed/
+    communication/batch_isend_irecv.py P2POp)."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv):
+            raise RuntimeError("op must be paddle.distributed.isend or "
+                               "paddle.distributed.irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+class _P2PTask:
+    def __init__(self, tensors):
+        self._tensors = tensors
+
+    def wait(self):
+        for t in self._tensors:
+            jax.block_until_ready(_raw(t))
+
+    def is_completed(self):
+        return True
+
+
+def batch_isend_irecv(p2p_op_list):
+    """ref: communication/batch_isend_irecv.py — group matched isend/irecv
+    pairs into one transfer.
+
+    TPU-native lowering: inside an SPMD region every matched send/recv pair
+    with a uniform rank offset is one `lax.ppermute` over the group's mesh
+    axis (the ICI p2p primitive) — exactly how NCCL grouped send/recv is
+    used by the reference's pipeline p2p layer. Each isend whose peer is at
+    offset +k feeds the irecv whose peer is at offset -k.
+    """
+    if not p2p_op_list:
+        return []
+    if not all(isinstance(o, P2POp) for o in p2p_op_list):
+        raise RuntimeError("p2p_op_list must contain only P2POp objects")
+    group = p2p_op_list[0].group
+    if any(o.group is not group for o in p2p_op_list):
+        raise RuntimeError("all P2POps in one batch_isend_irecv must use "
+                           "the same group")
+    axis = _axis_of(group)
+    n = _group_size(group)
+    sends = [o for o in p2p_op_list if o.op is isend]
+    recvs = [o for o in p2p_op_list if o.op is irecv]
+    if in_spmd_region(axis) and axis is not None:
+        my = group.rank if group is not None and group.rank >= 0 else 0
+
+        def _local(peer):
+            # peers are GLOBAL ranks (reference semantics); offsets are
+            # computed in group-local coordinates like broadcast() does
+            if group is None:
+                return peer
+            lp = group.get_group_rank(peer)
+            if lp < 0:
+                raise RuntimeError(f"peer {peer} is not in group "
+                                   f"{group.ranks}")
+            return lp
+
+        done = []
+        pending = list(recvs)
+        for s in sends:
+            k = (_local(s.peer) - my) % n
+            perm = [(j, (j + k) % n) for j in range(n)]
+            out = ppermute(s.tensor, perm, axis)
+            match = next((r for r in pending
+                          if (my - _local(r.peer)) % n == k), None)
+            if match is None:
+                raise RuntimeError(
+                    f"isend to offset +{k} has no matching irecv at offset "
+                    f"-{k} in the op list")
+            pending.remove(match)
+            match.tensor.data = out.data
+            match.tensor._node = out._node
+            match.tensor.stop_gradient = out.stop_gradient
+            done.append(match.tensor)
+        if pending:
+            raise RuntimeError(
+                f"{len(pending)} irecv op(s) have no matching isend")
+        return [_P2PTask(done)]
+    if n == 1:
+        if len(sends) != len(recvs):
+            raise RuntimeError("unmatched isend/irecv ops in p2p_op_list")
+        for s, r in zip(sends, recvs):
+            src = s.tensor
+            r.tensor.data = _raw(src)
+            r.tensor._node = src._node if isinstance(src, Tensor) else None
+            r.tensor.stop_gradient = (src.stop_gradient
+                                      if isinstance(src, Tensor) else True)
+        return [_P2PTask([r.tensor for r in recvs])]
+    raise NotImplementedError("eager cross-process batch_isend_irecv")
+
+
 # object collectives -------------------------------------------------------
 def all_gather_object(object_list, obj, group=None):
     n = _group_size(group)
@@ -333,3 +429,21 @@ def all_gather_object(object_list, obj, group=None):
 
 def broadcast_object_list(object_list, src=0, group=None):
     return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """ref: communication/scatter.py scatter_object_list. Single-controller:
+    every logical rank sees src's full list (there is one process), so rank r
+    takes slot r; `src` only matters for the cross-process eager path."""
+    n = _group_size(group)
+    if n == 1:
+        out_object_list.append(in_object_list[0] if in_object_list else None)
+        return out_object_list
+    if in_object_list is None:
+        raise NotImplementedError(
+            "cross-process scatter_object_list (non-src rank passed None): "
+            "single-controller callers must pass src's full object list")
+    my = group.rank if group is not None and group.rank >= 0 else get_rank()
+    out_object_list.append(in_object_list[my])
+    return out_object_list

@@ -2,5 +2,7 @@
 from ..collective import (all_reduce, all_gather, alltoall, reduce_scatter,
                           broadcast, reduce, scatter, send, recv, barrier,
                           ReduceOp, wait, all_to_all_single,
-                          all_gather_object, broadcast_object_list)
+                          all_gather_object, broadcast_object_list,
+                          scatter_object_list, isend, irecv, P2POp,
+                          batch_isend_irecv)
 from . import stream

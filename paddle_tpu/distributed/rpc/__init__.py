@@ -1,0 +1,15 @@
+"""paddle.distributed.rpc analog (ref: python/paddle/distributed/rpc/rpc.py).
+
+The reference runs a C++ brpc `RpcAgent` whose payload is a pickled
+`PythonFunc` executed on the callee (rpc.py:141,179 + internal.py). The
+TPU-native runtime keeps the exact API (init_rpc / rpc_sync / rpc_async /
+shutdown / worker-info queries) over a length-prefixed-pickle TCP agent:
+each worker runs a threaded socket server, and worker discovery goes through
+the same native TCPStore used for collective rendezvous (csrc/tcp_store.cc).
+"""
+from .rpc import (init_rpc, rpc_sync, rpc_async, shutdown, get_worker_info,
+                  get_all_worker_infos, get_current_worker_info, WorkerInfo)
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "WorkerInfo"]

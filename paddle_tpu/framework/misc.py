@@ -1,0 +1,146 @@
+"""Small top-level framework utilities (ref: python/paddle/framework/ and
+python/paddle/fluid/framework.py odds and ends)."""
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from .dtype import convert_dtype
+from ..tensor.tensor import Tensor
+
+dtype = jnp.dtype  # `paddle.dtype` — dtype constructor/class
+
+
+class iinfo:
+    """ref: python/paddle/framework/dtype.py iinfo."""
+
+    def __init__(self, dt):
+        info = np.iinfo(np.dtype(convert_dtype(dt)))
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+        self.dtype = str(np.dtype(convert_dtype(dt)).name)
+
+    def __repr__(self):
+        return (f"iinfo(min={self.min}, max={self.max}, bits={self.bits}, "
+                f"dtype={self.dtype})")
+
+
+def _dt_of(x):
+    return x.dtype if isinstance(x, Tensor) else jnp.dtype(convert_dtype(x))
+
+
+def is_floating_point(x):
+    """ref: tensor/attribute.py is_floating_point (takes a Tensor)."""
+    d = jnp.dtype(_dt_of(x))
+    return d.kind == "f" or d == jnp.dtype(jnp.bfloat16)
+
+
+def is_integer(x):
+    return jnp.dtype(_dt_of(x)).kind in ("i", "u")
+
+
+def is_complex(x):
+    return jnp.dtype(_dt_of(x)).kind == "c"
+
+
+def rank(input):
+    """ref: fluid/layers rank — ndim as a 0-d int32 tensor."""
+    t = input if isinstance(input, Tensor) else Tensor(input)
+    return Tensor(np.asarray(t.ndim, np.int32))
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """ref: tensor/to_string.py set_printoptions — forwarded to numpy, which
+    formats our device arrays."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """ref: paddle/fluid/pybind DisableSignalHandler — the C++ runtime
+    installs crash handlers; the XLA runtime does not, so this is a no-op
+    kept for source compatibility."""
+
+
+def check_shape(shape):
+    """ref: fluid/layers/utils.py check_shape — validate a shape spec."""
+    if isinstance(shape, Tensor):
+        return
+    for s in shape:
+        if isinstance(s, Tensor):
+            continue
+        if not isinstance(s, (int, np.integer)):
+            raise TypeError(f"shape entries must be ints/Tensors, got {s!r}")
+        if s < -1:
+            raise ValueError(f"invalid dim {s} in shape {shape}")
+
+
+class LazyGuard:
+    """ref: python/paddle/fluid/lazy_init.py LazyGuard — defer parameter
+    materialization. Under jax, arrays are cheap until used, so the guard
+    only marks the scope; layers initialize as usual."""
+
+    _active = [False]
+
+    def __enter__(self):
+        LazyGuard._active[0] = True
+        return self
+
+    def __exit__(self, *exc):
+        LazyGuard._active[0] = False
+        return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    """ref: python/paddle/batch.py — legacy reader combinator."""
+
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """ref: python/paddle/tensor/creation.py create_parameter — standalone
+    Parameter outside a Layer."""
+    from ..nn.layer.layers import Layer
+
+    helper = Layer()
+    p = helper.create_parameter(list(shape), attr=attr, dtype=dtype,
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
+    if name is not None and p is not None:
+        p.name = name
+    return p
+
+
+def get_cuda_rng_state():
+    """Source-compat alias: the accelerator RNG state is the framework RNG
+    state (there is no separate CUDA generator on TPU)."""
+    from .random import get_rng_state
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    from .random import set_rng_state
+    return set_rng_state(state)

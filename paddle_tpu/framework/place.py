@@ -63,9 +63,20 @@ class TPUPlace(Place):
         return jax.default_backend() if jax.default_backend() != "cpu" else "tpu"
 
 
-# CUDAPlace alias for source compatibility with reference user code.
+# Aliases for source compatibility with reference user code: every
+# accelerator place maps to the TPU place; pinned host memory maps to CPU.
 CUDAPlace = TPUPlace
 XPUPlace = TPUPlace
+NPUPlace = TPUPlace
+MLUPlace = TPUPlace
+IPUPlace = TPUPlace
+
+
+class CUDAPinnedPlace(CPUPlace):
+    """Pinned host memory place (ref: phi/common/place.h CUDAPinnedPlace).
+    jax host arrays are already page-locked-transfer-friendly; behaves as
+    CPUPlace."""
+    _kind = "cuda_pinned"
 
 _current_place = None
 

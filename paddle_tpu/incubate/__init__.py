@@ -1,3 +1,4 @@
 """paddle.incubate analog (ref: python/paddle/incubate/)."""
 from . import autograd
+from . import checkpoint
 from . import nn

@@ -1,0 +1,7 @@
+"""Auto-checkpoint (ref: python/paddle/fluid/incubate/checkpoint/)."""
+from . import auto_checkpoint
+from .auto_checkpoint import (AutoCheckpointChecker, TrainEpochRange,
+                              train_epoch_range)
+
+__all__ = ["auto_checkpoint", "AutoCheckpointChecker", "TrainEpochRange",
+           "train_epoch_range"]

@@ -341,6 +341,76 @@ def unbind(input, axis=0):
     return unstack(input, axis)
 
 
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    """ref: tensor/manipulation.py unique_consecutive — dedupe adjacent
+    repeats. Output shape is data-dependent, so (like the reference's
+    dynamic-shape kernel) this is an eager host-side op."""
+    import numpy as _np
+    a = _np.asarray(_t(x).data)
+    if axis is None:
+        a = a.reshape(-1)
+        ax = 0
+    else:
+        ax = axis % a.ndim
+    if a.shape[ax] == 0:
+        keep = _np.zeros(0, dtype=bool)
+    else:
+        moved = _np.moveaxis(a, ax, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        change = _np.any(flat[1:] != flat[:-1], axis=1)
+        keep = _np.concatenate([[True], change])
+    out = _np.compress(keep, a, axis=ax)
+    results = [Tensor(out)]
+    if return_inverse:
+        inv = _np.cumsum(keep) - 1
+        results.append(Tensor(inv.astype(dtype)))
+    if return_counts:
+        idx = _np.flatnonzero(keep)
+        counts = _np.diff(_np.append(idx, keep.size))
+        results.append(Tensor(counts.astype(dtype)))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+def vsplit(x, num_or_sections, name=None):
+    """ref: tensor/manipulation.py vsplit — split along axis 0."""
+    x = _t(x)
+    if x.ndim < 2:
+        raise ValueError("vsplit expects a tensor with at least 2 dims, "
+                         f"got {x.ndim}")
+    return split(x, num_or_sections, axis=0)
+
+
+def squeeze_(x, axis=None, name=None):
+    """In-place squeeze (ref: inplace variant squeeze_)."""
+    out = squeeze(x, axis)
+    x.data, x._node, x.stop_gradient = out.data, out._node, out.stop_gradient
+    return x
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    """In-place scatter (ref: inplace variant scatter_)."""
+    out = scatter(x, index, updates, overwrite=overwrite)
+    x.data, x._node, x.stop_gradient = out.data, out._node, out.stop_gradient
+    return x
+
+
+def reverse(x, axis, name=None):
+    """ref: fluid reverse — alias of flip."""
+    return flip(x, axis)
+
+
+def shape(input):
+    """ref: tensor/attribute shape op — runtime shape as an int32 tensor."""
+    import numpy as _np
+    return Tensor(_np.asarray(_t(input).data.shape, _np.int32))
+
+
+def tolist(x):
+    """ref: tensor/manipulation tolist."""
+    return _t(x).tolist()
+
+
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     x = _t(x)
     if isinstance(pad, Tensor):

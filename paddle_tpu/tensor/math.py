@@ -4,6 +4,8 @@ Each op is `apply`-dispatched so autograd records a vjp. Binary ops accept
 Tensor|scalar on either side. Method + dunder injection at the bottom mirrors
 the reference's math_op_patch (ref: python/paddle/fluid/dygraph/math_op_patch.py).
 """
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -337,6 +339,110 @@ def dot(x, y, name=None):
 
 def mv(x, vec, name=None):
     return apply(lambda a, b: a @ b, _t(x), _t(vec), name="mv")
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """ref: tensor/math.py logcumsumexp — numerically-stable running
+    logsumexp via an associative scan of logaddexp (one XLA scan op)."""
+    x = _t(x)
+
+    def fn(a):
+        if axis is None:
+            return jax.lax.associative_scan(jnp.logaddexp, a.reshape(-1))
+        return jax.lax.associative_scan(jnp.logaddexp, a, axis=axis)
+
+    out = apply(fn, x, name="logcumsumexp")
+    return out.astype(dtype) if dtype is not None else out
+
+
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+
+
+def add_n(inputs, name=None):
+    """ref: tensor/math.py add_n — elementwise sum of a tensor list."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    ts = [_t(i) for i in inputs]
+    return apply(lambda *arrs: functools.reduce(jnp.add, arrs), *ts,
+                 name="add_n")
+
+
+def sgn(x, name=None):
+    """ref: tensor/math.py sgn — sign for real, x/|x| for complex."""
+    x = _t(x)
+
+    def fn(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, jnp.zeros_like(a), a / mag)
+        return jnp.sign(a)
+
+    return apply(fn, x, name="sgn")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """ref: tensor/math.py renorm — clamp the p-norm of every slice along
+    `axis` to max_norm."""
+    x = _t(x)
+
+    def fn(a):
+        dims = tuple(d for d in range(a.ndim) if d != (axis % a.ndim))
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+
+    return apply(fn, x, name="renorm")
+
+
+def frexp(x, name=None):
+    """ref: tensor/math.py frexp — mantissa/exponent decomposition."""
+    x = _t(x)
+    return apply(jnp.frexp, x, n_outputs=2, name="frexp")
+
+
+def increment(x, value=1.0, name=None):
+    """ref: tensor/math.py increment — in-place x += value."""
+    out = apply(lambda a: a + value, _t(x), name="increment")
+    x.data, x._node, x.stop_gradient = out.data, out._node, out.stop_gradient
+    return x
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    """ref: tensor/math.py diagonal."""
+    return apply(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                        axis2=axis2), _t(x), name="diagonal")
+
+
+def take(x, index, mode="raise", name=None):
+    """ref: tensor/math.py take — gather from the flattened tensor.
+    'raise' clamps like the reference's kernel does under jit (no host
+    exception inside a compiled program)."""
+    x, index = _t(x), _t(index)
+
+    def fn(a, idx):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        if mode == "wrap":
+            idx = ((idx % n) + n) % n
+        else:  # raise/clip both clamp in-compile
+            idx = jnp.clip(jnp.where(idx < 0, idx + n, idx), 0, n - 1)
+        return jnp.take(flat, idx)
+
+    return apply(fn, x, index, name="take")
+
+
+def tanh_(x, name=None):
+    """In-place tanh (ref: inplace variant tanh_)."""
+    out = apply(jnp.tanh, _t(x), name="tanh_")
+    x.data, x._node, x.stop_gradient = out.data, out._node, out.stop_gradient
+    return x
+
+
+def broadcast_shape(x_shape, y_shape):
+    """ref: tensor/math.py broadcast_shape — pure shape math."""
+    import numpy as _np
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
 
 
 # default XLA matmul kernel

@@ -1,6 +1,8 @@
 from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
-                     resnet152, wide_resnet50_2, resnext50_32x4d, BasicBlock,
-                     BottleneckBlock)
+                     resnet152, wide_resnet50_2, wide_resnet101_2,
+                     resnext50_32x4d, resnext50_64x4d, resnext101_32x4d,
+                     resnext101_64x4d, resnext152_32x4d, resnext152_64x4d,
+                     BasicBlock, BottleneckBlock)
 from .lenet import LeNet
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .mobilenetv1 import MobileNetV1, mobilenet_v1
@@ -12,3 +14,7 @@ from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
 from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_5,
                            shufflenet_v2_x1_0, shufflenet_v2_x1_5,
                            shufflenet_v2_x2_0)
+from .mobilenetv3 import (MobileNetV3, MobileNetV3Small, MobileNetV3Large,
+                          mobilenet_v3_small, mobilenet_v3_large)
+from .googlenet import GoogLeNet, googlenet
+from .inceptionv3 import InceptionV3, inception_v3

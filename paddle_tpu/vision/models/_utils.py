@@ -1,0 +1,31 @@
+"""Shared building blocks for the vision model zoo (ref: the reference
+repeats these per-model; hoisted here so there is one copy)."""
+from ...nn import Conv2D, BatchNorm2D, ReLU, Sequential
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvNormActivation(Sequential):
+    """conv → batchnorm → optional activation, 'same'-style padding."""
+
+    def __init__(self, in_ch, out_ch, kernel_size=3, stride=1, groups=1,
+                 activation_layer=ReLU, dilation=1, padding=None):
+        if padding is None:
+            if isinstance(kernel_size, (tuple, list)):
+                padding = tuple((k - 1) // 2 * dilation for k in kernel_size)
+            else:
+                padding = (kernel_size - 1) // 2 * dilation
+        layers = [Conv2D(in_ch, out_ch, kernel_size, stride=stride,
+                         padding=padding, dilation=dilation, groups=groups,
+                         bias_attr=False),
+                  BatchNorm2D(out_ch)]
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        super().__init__(*layers)

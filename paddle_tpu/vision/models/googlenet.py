@@ -1,0 +1,107 @@
+"""GoogLeNet / Inception v1 (ref: python/paddle/vision/models/googlenet.py)."""
+from ...nn import (Layer, Conv2D, BatchNorm2D, Linear, Sequential, ReLU,
+                   MaxPool2D, AvgPool2D, AdaptiveAvgPool2D, Dropout)
+from ...tensor import manipulation as M
+
+
+class ConvLayer(Layer):
+    def __init__(self, in_ch, out_ch, kernel_size, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(in_ch, out_ch, kernel_size, stride=stride,
+                           padding=padding, bias_attr=False)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.conv(x))
+
+
+class Inception(Layer):
+    """The 4-branch inception block (ref: googlenet.py Inception)."""
+
+    def __init__(self, in_ch, c1, c2_reduce, c2, c3_reduce, c3, proj):
+        super().__init__()
+        self.branch1 = ConvLayer(in_ch, c1, 1)
+        self.branch2 = Sequential(ConvLayer(in_ch, c2_reduce, 1),
+                                  ConvLayer(c2_reduce, c2, 3, padding=1))
+        self.branch3 = Sequential(ConvLayer(in_ch, c3_reduce, 1),
+                                  ConvLayer(c3_reduce, c3, 5, padding=2))
+        self.branch4 = Sequential(MaxPool2D(3, stride=1, padding=1),
+                                  ConvLayer(in_ch, proj, 1))
+
+    def forward(self, x):
+        return M.concat([self.branch1(x), self.branch2(x), self.branch3(x),
+                         self.branch4(x)], axis=1)
+
+
+class GoogLeNet(Layer):
+    """ref: googlenet.py GoogLeNet — returns (main, aux1, aux2) logits in
+    train mode like the reference."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.conv1 = ConvLayer(3, 64, 7, stride=2, padding=3)
+        self.pool1 = MaxPool2D(3, stride=2, padding=1)
+        self.conv2 = ConvLayer(64, 64, 1)
+        self.conv3 = ConvLayer(64, 192, 3, padding=1)
+        self.pool2 = MaxPool2D(3, stride=2, padding=1)
+
+        self.ince3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.ince3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(3, stride=2, padding=1)
+        self.ince4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.ince4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.ince4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.ince4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.ince4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, stride=2, padding=1)
+        self.ince5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.ince5b = Inception(832, 384, 192, 384, 48, 128, 128)
+
+        if with_pool:
+            self.pool5 = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = Dropout(p=0.4)
+            self.fc = Linear(1024, num_classes)
+            # aux heads (train-time deep supervision)
+            self.pool_o1 = AvgPool2D(5, stride=3)
+            self.conv_o1 = ConvLayer(512, 128, 1)
+            self.fc_o1 = Linear(128 * 4 * 4, 1024)
+            self.drop_o1 = Dropout(p=0.7)
+            self.out_o1 = Linear(1024, num_classes)
+            self.pool_o2 = AvgPool2D(5, stride=3)
+            self.conv_o2 = ConvLayer(528, 128, 1)
+            self.fc_o2 = Linear(128 * 4 * 4, 1024)
+            self.drop_o2 = Dropout(p=0.7)
+            self.out_o2 = Linear(1024, num_classes)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        x = self.pool1(self.conv1(x))
+        x = self.pool2(self.conv3(self.conv2(x)))
+        x = self.pool3(self.ince3b(self.ince3a(x)))
+        x = self.ince4a(x)
+        x4a = x
+        x = self.ince4c(self.ince4b(x))
+        x = self.ince4d(x)
+        x4d = x
+        x = self.pool4(self.ince4e(x))
+        x = self.ince5b(self.ince5a(x))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            main = self.fc(self.dropout(M.flatten(x, 1)))
+            aux1 = self.conv_o1(self.pool_o1(x4a))
+            aux1 = self.relu(self.fc_o1(M.flatten(aux1, 1)))
+            aux1 = self.out_o1(self.drop_o1(aux1))
+            aux2 = self.conv_o2(self.pool_o2(x4d))
+            aux2 = self.relu(self.fc_o2(M.flatten(aux2, 1)))
+            aux2 = self.out_o2(self.drop_o2(aux2))
+            return main, aux1, aux2
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

@@ -1,0 +1,165 @@
+"""Inception v3 (ref: python/paddle/vision/models/inceptionv3.py)."""
+from ...nn import (Layer, Linear, Sequential,
+                   MaxPool2D, AvgPool2D, AdaptiveAvgPool2D, Dropout)
+from ...tensor import manipulation as M
+from ._utils import ConvNormActivation
+
+
+class ConvBNLayer(ConvNormActivation):
+    def __init__(self, in_ch, out_ch, kernel_size, stride=1, padding=0):
+        super().__init__(in_ch, out_ch, kernel_size, stride=stride,
+                         padding=padding)
+
+
+class InceptionStem(Layer):
+    """ref: inceptionv3.py InceptionStem."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv_1a_3x3 = ConvBNLayer(3, 32, 3, stride=2)
+        self.conv_2a_3x3 = ConvBNLayer(32, 32, 3)
+        self.conv_2b_3x3 = ConvBNLayer(32, 64, 3, padding=1)
+        self.maxpool = MaxPool2D(3, stride=2)
+        self.conv_3b_1x1 = ConvBNLayer(64, 80, 1)
+        self.conv_4a_3x3 = ConvBNLayer(80, 192, 3)
+
+    def forward(self, x):
+        x = self.conv_2b_3x3(self.conv_2a_3x3(self.conv_1a_3x3(x)))
+        x = self.maxpool(x)
+        x = self.conv_4a_3x3(self.conv_3b_1x1(x))
+        return self.maxpool(x)
+
+
+class InceptionA(Layer):
+    def __init__(self, in_ch, pool_features):
+        super().__init__()
+        self.branch1x1 = ConvBNLayer(in_ch, 64, 1)
+        self.branch5x5 = Sequential(ConvBNLayer(in_ch, 48, 1),
+                                    ConvBNLayer(48, 64, 5, padding=2))
+        self.branch3x3dbl = Sequential(ConvBNLayer(in_ch, 64, 1),
+                                       ConvBNLayer(64, 96, 3, padding=1),
+                                       ConvBNLayer(96, 96, 3, padding=1))
+        self.branch_pool = Sequential(AvgPool2D(3, stride=1, padding=1),
+                                      ConvBNLayer(in_ch, pool_features, 1))
+
+    def forward(self, x):
+        return M.concat([self.branch1x1(x), self.branch5x5(x),
+                         self.branch3x3dbl(x), self.branch_pool(x)], axis=1)
+
+
+class InceptionB(Layer):
+    """Grid reduction (ref InceptionB)."""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.branch3x3 = ConvBNLayer(in_ch, 384, 3, stride=2)
+        self.branch3x3dbl = Sequential(ConvBNLayer(in_ch, 64, 1),
+                                       ConvBNLayer(64, 96, 3, padding=1),
+                                       ConvBNLayer(96, 96, 3, stride=2))
+        self.branch_pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return M.concat([self.branch3x3(x), self.branch3x3dbl(x),
+                         self.branch_pool(x)], axis=1)
+
+
+class InceptionC(Layer):
+    """Factorized 7x7 (ref InceptionC)."""
+
+    def __init__(self, in_ch, channels_7x7):
+        super().__init__()
+        c7 = channels_7x7
+        self.branch1x1 = ConvBNLayer(in_ch, 192, 1)
+        self.branch7x7 = Sequential(
+            ConvBNLayer(in_ch, c7, 1),
+            ConvBNLayer(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBNLayer(c7, 192, (7, 1), padding=(3, 0)))
+        self.branch7x7dbl = Sequential(
+            ConvBNLayer(in_ch, c7, 1),
+            ConvBNLayer(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBNLayer(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBNLayer(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBNLayer(c7, 192, (1, 7), padding=(0, 3)))
+        self.branch_pool = Sequential(AvgPool2D(3, stride=1, padding=1),
+                                      ConvBNLayer(in_ch, 192, 1))
+
+    def forward(self, x):
+        return M.concat([self.branch1x1(x), self.branch7x7(x),
+                         self.branch7x7dbl(x), self.branch_pool(x)], axis=1)
+
+
+class InceptionD(Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.branch3x3 = Sequential(ConvBNLayer(in_ch, 192, 1),
+                                    ConvBNLayer(192, 320, 3, stride=2))
+        self.branch7x7x3 = Sequential(
+            ConvBNLayer(in_ch, 192, 1),
+            ConvBNLayer(192, 192, (1, 7), padding=(0, 3)),
+            ConvBNLayer(192, 192, (7, 1), padding=(3, 0)),
+            ConvBNLayer(192, 192, 3, stride=2))
+        self.branch_pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return M.concat([self.branch3x3(x), self.branch7x7x3(x),
+                         self.branch_pool(x)], axis=1)
+
+
+class InceptionE(Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.branch1x1 = ConvBNLayer(in_ch, 320, 1)
+        self.branch3x3_1 = ConvBNLayer(in_ch, 384, 1)
+        self.branch3x3_2a = ConvBNLayer(384, 384, (1, 3), padding=(0, 1))
+        self.branch3x3_2b = ConvBNLayer(384, 384, (3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = ConvBNLayer(in_ch, 448, 1)
+        self.branch3x3dbl_2 = ConvBNLayer(448, 384, 3, padding=1)
+        self.branch3x3dbl_3a = ConvBNLayer(384, 384, (1, 3), padding=(0, 1))
+        self.branch3x3dbl_3b = ConvBNLayer(384, 384, (3, 1), padding=(1, 0))
+        self.branch_pool = Sequential(AvgPool2D(3, stride=1, padding=1),
+                                      ConvBNLayer(in_ch, 192, 1))
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b3 = self.branch3x3_1(x)
+        b3 = M.concat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], axis=1)
+        bd = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
+        bd = M.concat([self.branch3x3dbl_3a(bd), self.branch3x3dbl_3b(bd)],
+                      axis=1)
+        return M.concat([b1, b3, bd, self.branch_pool(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    """ref: inceptionv3.py InceptionV3."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.inception_stem = InceptionStem()
+        self.inception_block_list = Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128), InceptionC(768, 160), InceptionC(768, 160),
+            InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280), InceptionE(2048))
+        if with_pool:
+            self.avg_pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = Dropout(p=0.2)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.inception_stem(x)
+        x = self.inception_block_list(x)
+        if self.with_pool:
+            x = self.avg_pool(x)
+        if self.num_classes > 0:
+            x = M.flatten(x, 1)
+            x = self.fc(self.dropout(x))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

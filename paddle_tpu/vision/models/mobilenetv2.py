@@ -3,25 +3,14 @@ from ...nn import (Layer, Conv2D, BatchNorm2D, ReLU6, Linear, Sequential,
                    AdaptiveAvgPool2D, Dropout)
 
 
-def _make_divisible(v, divisor=8, min_value=None):
-    if min_value is None:
-        min_value = divisor
-    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
-    if new_v < 0.9 * v:
-        new_v += divisor
-    return new_v
+from ._utils import _make_divisible, ConvNormActivation
 
 
-class ConvBNReLU(Sequential):
+class ConvBNReLU(ConvNormActivation):
     def __init__(self, in_planes, out_planes, kernel_size=3, stride=1,
                  groups=1):
-        padding = (kernel_size - 1) // 2
-        super().__init__(
-            Conv2D(in_planes, out_planes, kernel_size, stride=stride,
-                   padding=padding, groups=groups, bias_attr=False),
-            BatchNorm2D(out_planes),
-            ReLU6(),
-        )
+        super().__init__(in_planes, out_planes, kernel_size, stride=stride,
+                         groups=groups, activation_layer=ReLU6)
 
 
 class InvertedResidual(Layer):

@@ -1,0 +1,151 @@
+"""MobileNetV3 small/large (ref: python/paddle/vision/models/mobilenetv3.py)."""
+from ...nn import (Layer, Conv2D, Linear, Sequential, ReLU,
+                   Hardswish, Hardsigmoid, AdaptiveAvgPool2D, Dropout)
+from ...tensor import manipulation as M
+from ._utils import _make_divisible, ConvNormActivation
+
+
+class SqueezeExcitation(Layer):
+    """ref: mobilenetv3.py SqueezeExcitation."""
+
+    def __init__(self, input_channels, squeeze_channels):
+        super().__init__()
+        self.avgpool = AdaptiveAvgPool2D(1)
+        self.fc1 = Conv2D(input_channels, squeeze_channels, 1)
+        self.relu = ReLU()
+        self.fc2 = Conv2D(squeeze_channels, input_channels, 1)
+        self.hardsigmoid = Hardsigmoid()
+
+    def forward(self, x):
+        scale = self.hardsigmoid(self.fc2(self.relu(self.fc1(
+            self.avgpool(x)))))
+        return x * scale
+
+
+class InvertedResidual(Layer):
+    """ref: mobilenetv3.py InvertedResidual — expand → depthwise → (SE) →
+    project, residual when stride 1 and in==out."""
+
+    def __init__(self, in_channels, expanded_channels, out_channels,
+                 filter_size, stride, use_se, activation_layer):
+        super().__init__()
+        self.use_res_connect = stride == 1 and in_channels == out_channels
+        layers = []
+        if expanded_channels != in_channels:
+            layers.append(ConvNormActivation(in_channels, expanded_channels,
+                                             1, activation_layer=activation_layer))
+        layers.append(ConvNormActivation(expanded_channels, expanded_channels,
+                                         filter_size, stride=stride,
+                                         groups=expanded_channels,
+                                         activation_layer=activation_layer))
+        if use_se:
+            layers.append(SqueezeExcitation(
+                expanded_channels, _make_divisible(expanded_channels // 4)))
+        layers.append(ConvNormActivation(expanded_channels, out_channels, 1,
+                                         activation_layer=None))
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res_connect else out
+
+
+class MobileNetV3(Layer):
+    """ref: mobilenetv3.py MobileNetV3."""
+
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        firstconv_out = _make_divisible(16 * scale)
+        self.conv = ConvNormActivation(3, firstconv_out, 3, stride=2,
+                                       activation_layer=Hardswish)
+        blocks = []
+        in_ch = firstconv_out
+        for (k, exp, out, use_se, act, s) in config:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(out * scale)
+            act_layer = Hardswish if act == "hardswish" else ReLU
+            blocks.append(InvertedResidual(in_ch, exp_c, out_c, k, s, use_se,
+                                           act_layer))
+            in_ch = out_c
+        self.blocks = Sequential(*blocks)
+        lastconv_out = 6 * in_ch  # in_ch is already scaled
+        self.lastconv = ConvNormActivation(in_ch, lastconv_out, 1,
+                                           activation_layer=Hardswish)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(lastconv_out, last_channel),
+                Hardswish(),
+                Dropout(p=0.2),
+                Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.conv(x)
+        x = self.blocks(x)
+        x = self.lastconv(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = M.flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+# (kernel, expanded, out, use_se, activation, stride) — ref mobilenetv3.py
+_LARGE_CONFIG = [
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+
+_SMALL_CONFIG = [
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE_CONFIG, _make_divisible(1280 * scale),
+                         scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL_CONFIG, _make_divisible(1024 * scale),
+                         scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)

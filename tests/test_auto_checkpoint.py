@@ -1,0 +1,73 @@
+"""Auto-checkpoint tests (ref: unittests/test_auto_checkpoint*.py —
+resume-from-last-epoch semantics after a simulated process restart)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.incubate.checkpoint import (AutoCheckpointChecker,
+                                            TrainEpochRange)
+
+
+@pytest.fixture
+def job_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_RUNNING_ENV", "PADDLE_EDL_AUTO_CHECKPOINT")
+    monkeypatch.setenv("PADDLE_JOB_ID", "job_acp_test")
+    monkeypatch.setenv("PADDLE_CHECKPOINT_DIR", str(tmp_path))
+    return tmp_path
+
+
+def _model_and_opt():
+    paddle.seed(0)
+    m = nn.Linear(4, 4)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    return m, opt
+
+
+def test_checker_env(job_env):
+    c = AutoCheckpointChecker()
+    assert c.valid()
+    assert c.job_id == "job_acp_test"
+    assert "job_acp_test" in c.get_range_checkpoint_path("r0")
+
+
+def test_checker_invalid_without_env(monkeypatch):
+    monkeypatch.delenv("PADDLE_RUNNING_ENV", raising=False)
+    monkeypatch.delenv("PADDLE_JOB_ID", raising=False)
+    assert not AutoCheckpointChecker().valid()
+
+
+def test_resume_after_crash(job_env):
+    model, opt = _model_and_opt()
+    r = TrainEpochRange(5, "r0", checkpoint_inter=0).attach(
+        model=model, optimizer=opt)
+    seen = []
+    for epoch in r.next():
+        model.weight.set_value(paddle.to_tensor(
+            np.full((4, 4), float(epoch), np.float32)))
+        seen.append(epoch)
+        if epoch == 2:
+            break  # simulated preemption after epoch-2 work, before commit
+    assert seen == [0, 1, 2]
+    assert r.get() == 1  # epochs 0,1 committed; 2 was in flight
+
+    # "restarted" process: fresh objects, same job env
+    model2, opt2 = _model_and_opt()
+    r2 = TrainEpochRange(5, "r0", checkpoint_inter=0).attach(
+        model=model2, optimizer=opt2)
+    assert r2.restored_from is not None
+    np.testing.assert_allclose(model2.weight.numpy(),
+                               np.full((4, 4), 1.0))  # epoch-1 snapshot
+    resumed = list(r2.next())
+    assert resumed == [2, 3, 4]
+    assert r2.get() == 4
+
+
+def test_full_run_then_no_repeat(job_env):
+    model, opt = _model_and_opt()
+    r = TrainEpochRange(3, "r1", checkpoint_inter=0).attach(
+        model=model, optimizer=opt)
+    assert list(r.next()) == [0, 1, 2]
+    r2 = TrainEpochRange(3, "r1", checkpoint_inter=0).attach(
+        model=model, optimizer=opt)
+    assert list(r2.next()) == []  # already finished

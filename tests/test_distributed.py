@@ -83,6 +83,83 @@ class TestCollectivesSPMD:
                                    np.tile(shard_sum, (4, 1)))
 
 
+class TestBatchIsendIrecv:
+    """ref: unittests/collective/test_communication_api_base — matched
+    isend/irecv pairs lower to one ppermute over the mesh axis."""
+
+    def test_shift_by_one_ring(self):
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+        from paddle_tpu.distributed.mesh import spmd_axes, set_global_mesh, \
+            build_mesh
+        from paddle_tpu.distributed.collective import (P2POp, isend, irecv,
+                                                       batch_isend_irecv,
+                                                       new_group)
+        from paddle_tpu.tensor.tensor import Tensor
+
+        mesh = build_mesh({"pipe": 4})
+        set_global_mesh(mesh)
+        g = new_group(list(range(4)), axis_name="pipe")
+
+        def inner(x):
+            with spmd_axes(("pipe",)):
+                src = Tensor(x)
+                dst = Tensor(jnp.zeros_like(x))
+                ops = [P2POp(isend, src, 1, group=g),
+                       P2POp(irecv, dst, 3, group=g)]  # recv from rank-1
+                tasks = batch_isend_irecv(ops)
+                tasks[0].wait()
+                return dst.data
+
+        f = shard_map(inner, mesh=mesh, in_specs=P("pipe"),
+                      out_specs=P("pipe"), check_vma=False)
+        x = jnp.arange(8, dtype=jnp.float32)
+        out = np.asarray(f(x)).reshape(4, 2)
+        expect = np.asarray(x).reshape(4, 2)[[3, 0, 1, 2]]  # ring shift +1
+        np.testing.assert_allclose(out, expect)
+
+    def test_shift_with_global_rank_peers(self):
+        # peers are global ranks; non-identity groups must translate to
+        # group-local coordinates before computing the ring offset
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+        from paddle_tpu.distributed.mesh import spmd_axes, set_global_mesh, \
+            build_mesh
+        from paddle_tpu.distributed.collective import (P2POp, isend, irecv,
+                                                       batch_isend_irecv,
+                                                       new_group)
+        from paddle_tpu.tensor.tensor import Tensor
+
+        mesh = build_mesh({"pipe": 4})
+        set_global_mesh(mesh)
+        # group over global ranks [0,2,4,6]: '+1 neighbor' of rank 0 is 2
+        g = new_group([0, 2, 4, 6], axis_name="pipe")
+
+        def inner(x):
+            with spmd_axes(("pipe",)):
+                src = Tensor(x)
+                dst = Tensor(jnp.zeros_like(x))
+                ops = [P2POp(isend, src, 2, group=g),
+                       P2POp(irecv, dst, 6, group=g)]
+                batch_isend_irecv(ops)
+                return dst.data
+
+        f = shard_map(inner, mesh=mesh, in_specs=P("pipe"),
+                      out_specs=P("pipe"), check_vma=False)
+        x = jnp.arange(8, dtype=jnp.float32)
+        out = np.asarray(f(x)).reshape(4, 2)
+        expect = np.asarray(x).reshape(4, 2)[[3, 0, 1, 2]]  # shift by ONE
+        np.testing.assert_allclose(out, expect)
+
+    def test_object_scatter_single(self):
+        from paddle_tpu.distributed.collective import scatter_object_list
+        out = []
+        scatter_object_list(out, [{"a": 1}], src=0)
+        assert out == [{"a": 1}]
+
+
 class TestTensorParallel:
     """ref: unittests/collective/fleet/hybrid_parallel_mp_layers.py — TP
     layers vs dense reference."""

@@ -20,6 +20,37 @@ def test_forward_shapes(name):
     assert out.shape == [1, 10]
 
 
+@pytest.mark.parametrize("name", ["mobilenet_v3_small", "mobilenet_v3_large",
+                                  "resnext50_32x4d"])
+def test_forward_shapes_v3(name):
+    from paddle_tpu.vision import models
+    paddle.seed(0)
+    model = getattr(models, name)(num_classes=10)
+    model.eval()
+    x = paddle.randn([1, 3, 64, 64])
+    assert model(x).shape == [1, 10]
+
+
+def test_inception_v3():
+    from paddle_tpu.vision.models import inception_v3
+    paddle.seed(0)
+    m = inception_v3(num_classes=10)
+    m.eval()
+    # inception stem needs >=299-ish input; 160 is enough for the graph
+    assert m(paddle.randn([1, 3, 160, 160])).shape == [1, 10]
+
+
+def test_googlenet_aux_heads():
+    from paddle_tpu.vision.models import googlenet
+    paddle.seed(0)
+    m = googlenet(num_classes=10)
+    m.eval()
+    main, aux1, aux2 = m(paddle.randn([1, 3, 224, 224]))
+    assert main.shape == [1, 10]
+    assert aux1.shape == [1, 10]
+    assert aux2.shape == [1, 10]
+
+
 def test_lenet():
     from paddle_tpu.vision.models import LeNet
     m = LeNet()

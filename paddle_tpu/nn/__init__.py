@@ -4,7 +4,9 @@ from .layer.container import Sequential, LayerList, ParameterList, LayerDict
 from .layer.common import (Linear, Dropout, Dropout2D, Dropout3D, AlphaDropout,
                            Embedding, Flatten, Upsample, UpsamplingBilinear2D,
                            UpsamplingNearest2D, Pad1D, Pad2D, Pad3D, ZeroPad2D,
-                           CosineSimilarity, PixelShuffle, Bilinear, Identity)
+                           CosineSimilarity, PixelShuffle, Bilinear, Identity,
+                           Unfold, Fold, PairwiseDistance, PixelUnshuffle,
+                           ChannelShuffle)
 from .layer.conv import (Conv1D, Conv2D, Conv3D, Conv1DTranspose,
                          Conv2DTranspose, Conv3DTranspose)
 from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
@@ -15,21 +17,27 @@ from .layer.pooling import (MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D,
                             AvgPool2D, AvgPool3D, AdaptiveAvgPool1D,
                             AdaptiveAvgPool2D, AdaptiveAvgPool3D,
                             AdaptiveMaxPool1D, AdaptiveMaxPool2D,
-                            AdaptiveMaxPool3D)
+                            AdaptiveMaxPool3D, MaxUnPool1D, MaxUnPool2D,
+                            MaxUnPool3D)
 from .layer.activation import (ReLU, ReLU6, LeakyReLU, ELU, SELU, CELU, GELU,
                                Silu, Swish, Hardswish, Hardsigmoid, Hardtanh,
                                Hardshrink, Softshrink, Tanhshrink,
                                ThresholdedReLU, Sigmoid, LogSigmoid, Tanh,
                                Mish, Softplus, Softsign, Maxout, Softmax,
-                               LogSoftmax, GLU, RReLU, PReLU)
+                               LogSoftmax, GLU, RReLU, PReLU, Softmax2D)
 from .layer.loss import (CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss,
                          NLLLoss, BCELoss, BCEWithLogitsLoss, KLDivLoss,
-                         MarginRankingLoss)
+                         MarginRankingLoss, CTCLoss, RNNTLoss, HSigmoidLoss,
+                         SoftMarginLoss, MultiLabelSoftMarginLoss,
+                         MultiMarginLoss, HingeEmbeddingLoss,
+                         CosineEmbeddingLoss, TripletMarginLoss,
+                         TripletMarginWithDistanceLoss)
 from .layer.transformer import (MultiHeadAttention, TransformerEncoderLayer,
                                 TransformerEncoder, TransformerDecoderLayer,
                                 TransformerDecoder, Transformer)
 from .layer.rnn import (SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
-                        SimpleRNN, LSTM, GRU)
+                        SimpleRNN, LSTM, GRU, RNNCellBase, BeamSearchDecoder,
+                        dynamic_decode)
 from .param_attr import ParamAttr
 from . import functional
 from . import initializer

@@ -1,22 +1,25 @@
 """paddle.nn.functional (ref: python/paddle/nn/functional/__init__.py)."""
 from .activation import (relu, relu_, relu6, leaky_relu, prelu, rrelu, elu,
-                         selu, celu, gelu, silu, swish, hardswish, hardsigmoid,
+                         elu_, selu, celu, gelu, silu, swish, hardswish,
+                         hardsigmoid,
                          hardtanh, hardshrink, softshrink, tanhshrink,
                          thresholded_relu, sigmoid, logsigmoid, log_sigmoid,
-                         tanh, mish, softplus, softsign, maxout, softmax,
+                         tanh, tanh_, mish, softplus, softsign, maxout,
+                         softmax,
                          softmax_, log_softmax, gumbel_softmax, glu)
 from .common import (linear, dropout, dropout2d, dropout3d, alpha_dropout,
                      embedding, one_hot, label_smooth, pad, interpolate,
                      upsample, unfold, fold, cosine_similarity, pixel_shuffle,
                      pixel_unshuffle, channel_shuffle, bilinear, normalize,
-                     zeropad2d)
+                     zeropad2d, pairwise_distance, diag_embed)
 from .conv import (conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
                    conv3d_transpose)
 from .pooling import (max_pool1d, max_pool2d, max_pool3d, avg_pool1d,
                       avg_pool2d, avg_pool3d, adaptive_avg_pool1d,
                       adaptive_avg_pool2d, adaptive_avg_pool3d,
                       adaptive_max_pool1d, adaptive_max_pool2d,
-                      adaptive_max_pool3d)
+                      adaptive_max_pool3d, max_unpool1d, max_unpool2d,
+                      max_unpool3d)
 from .norm import (layer_norm, rms_norm, batch_norm, instance_norm, group_norm,
                    local_response_norm)
 from .loss import (cross_entropy, softmax_with_cross_entropy, nll_loss,
@@ -24,5 +27,10 @@ from .loss import (cross_entropy, softmax_with_cross_entropy, nll_loss,
                    binary_cross_entropy_with_logits, kl_div,
                    margin_ranking_loss, hinge_embedding_loss,
                    cosine_embedding_loss, triplet_margin_loss, ctc_loss,
-                   square_error_cost, sigmoid_focal_loss)
+                   square_error_cost, sigmoid_focal_loss, log_loss, dice_loss,
+                   soft_margin_loss, multi_label_soft_margin_loss,
+                   multi_margin_loss, triplet_margin_with_distance_loss,
+                   npair_loss, hsigmoid_loss, margin_cross_entropy, rnnt_loss)
+from .vision import (affine_grid, grid_sample, temporal_shift, sequence_mask,
+                     gather_tree, class_center_sample, sparse_attention)
 from .attention import scaled_dot_product_attention, flash_attention

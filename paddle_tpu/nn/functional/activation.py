@@ -190,3 +190,17 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 
 def glu(x, axis=-1, name=None):
     return apply(lambda a: jax.nn.glu(a, axis=axis), _t(x))
+
+
+def elu_(x, alpha=1.0, name=None):
+    """In-place elu (ref: inplace variant elu_)."""
+    out = elu(x, alpha)
+    x.data, x._node, x.stop_gradient = out.data, out._node, out.stop_gradient
+    return x
+
+
+def tanh_(x, name=None):
+    """In-place tanh (ref: inplace variant tanh_)."""
+    out = tanh(x)
+    x.data, x._node, x.stop_gradient = out.data, out._node, out.stop_gradient
+    return x

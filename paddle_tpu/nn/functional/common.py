@@ -279,3 +279,41 @@ def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
 
 def zeropad2d(x, padding, data_format="NCHW", name=None):
     return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """ref: functional/distance.py pairwise_distance — p-norm of x - y over
+    the last axis."""
+
+    def fn(a, b):
+        d = a - b + epsilon
+        return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+    return apply(fn, _t(x), _t(y), name="pairwise_distance")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """ref: functional/extension.py diag_embed — last axis becomes a
+    diagonal of a new square matrix."""
+
+    def fn(a):
+        n = a.shape[-1]
+        size = n + abs(offset)
+        eye = jnp.eye(size, dtype=a.dtype)
+        mat = a[..., :, None] * jnp.eye(n, dtype=a.dtype)
+        pad = [(0, 0)] * (a.ndim - 1) + [(0, abs(offset)), (0, abs(offset))]
+        mat = jnp.pad(mat, pad)
+        mat = jnp.roll(mat, shift=max(offset, 0), axis=-1)
+        mat = jnp.roll(mat, shift=max(-offset, 0), axis=-2)
+        # place requested dims
+        nd = mat.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        order = list(range(nd - 2))
+        out_axes = sorted((d1, d2))
+        for ax, src in zip(out_axes, (nd - 2, nd - 1) if d1 < d2
+                           else (nd - 1, nd - 2)):
+            order.insert(ax, src)
+        return jnp.transpose(mat, order)
+
+    return apply(fn, _t(input), name="diag_embed")

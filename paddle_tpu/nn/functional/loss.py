@@ -206,7 +206,30 @@ def triplet_margin_loss(input, positive, negative, margin=1.0, p=2,
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
-    raise NotImplementedError("ctc_loss: planned (optax.ctc_loss wrapper)")
+    """ref: functional/loss.py ctc_loss (warpctc binding). TPU lowering:
+    optax's XLA-native CTC forward DP. log_probs is [T, B, C] like the
+    reference; 'mean' divides each loss by its label length first."""
+    import optax
+
+    def fn(lp, lab, in_len, lab_len):
+        logits = jnp.transpose(lp, (1, 0, 2))          # [B, T, C]
+        T = logits.shape[1]
+        N = lab.shape[1]
+        logit_pad = (jnp.arange(T)[None, :] >= in_len[:, None]).astype(
+            logits.dtype)
+        label_pad = (jnp.arange(N)[None, :] >= lab_len[:, None]).astype(
+            logits.dtype)
+        per_seq = optax.ctc_loss(logits, logit_pad, lab, label_pad,
+                                 blank_id=blank)
+        if reduction == "mean":
+            return jnp.mean(per_seq / jnp.maximum(lab_len, 1).astype(
+                per_seq.dtype))
+        if reduction == "sum":
+            return jnp.sum(per_seq)
+        return per_seq
+
+    return apply(fn, _t(log_probs), _t(labels), _t(input_lengths),
+                 _t(label_lengths), name="ctc_loss")
 
 
 def square_error_cost(input, label):
@@ -228,3 +251,230 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
         return _reduce(loss, reduction)
     args = [_t(logit), _t(label)] + ([normalizer] if normalizer is not None else [])
     return apply(fn, *args)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """ref: functional/loss.py log_loss — negative log likelihood of a
+    probability input."""
+
+    def fn(p, t):
+        return (-t * jnp.log(p + epsilon)
+                - (1.0 - t) * jnp.log(1.0 - p + epsilon))
+
+    return apply(fn, _t(input), _t(label), name="log_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """ref: functional/loss.py dice_loss — 1 - dice coefficient; input is
+    class probabilities [..., C], label int [..., 1]."""
+
+    def fn(p, t):
+        t1 = jax.nn.one_hot(t.squeeze(-1), p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * t1, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(t1, axis=red)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+    return apply(fn, _t(input), _t(label), name="dice_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """ref: functional/loss.py soft_margin_loss — log(1 + exp(-y*x))."""
+
+    def fn(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+
+    return apply(fn, _t(input), _t(label).astype(_t(input).dtype),
+                 name="soft_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    """ref: functional/loss.py multi_label_soft_margin_loss."""
+
+    def fn(x, y, *w):
+        loss = -(y * jax.nn.log_sigmoid(x)
+                 + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            loss = loss * w[0]
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+
+    args = [_t(input), _t(label).astype(_t(input).dtype)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply(fn, *args, name="multi_label_soft_margin_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """ref: functional/loss.py multi_margin_loss — multiclass hinge."""
+
+    def fn(x, y, *w):
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, y[:, None], axis=1)
+        m = jnp.maximum(0.0, margin - correct + x) ** p
+        if w:
+            m = m * jnp.take(w[0], y)[:, None]
+        mask = 1.0 - jax.nn.one_hot(y, c, dtype=x.dtype)
+        return _reduce(jnp.sum(m * mask, axis=1) / c, reduction)
+
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply(fn, *args, name="multi_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    """ref: functional/loss.py triplet_margin_with_distance_loss — triplet
+    loss with a custom distance callable."""
+    dist = distance_function or (
+        lambda a, b: jnp.linalg.norm(a - b, axis=-1))
+
+    def fn(a, pos, neg):
+        dp = dist(a, pos)
+        dn = dist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, dist(pos, neg))
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+    return apply(fn, _t(input), _t(positive), _t(negative),
+                 name="triplet_margin_with_distance_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """ref: functional/loss.py npair_loss — improved triplet with N pairs."""
+
+    def fn(a, p, y):
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1))
+                        + jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+        sim = a @ p.T  # [N, N]
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = same / jnp.sum(same, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        return ce + reg
+
+    return apply(fn, _t(anchor), _t(positive), _t(labels), name="npair_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """ref: functional/loss.py hsigmoid_loss — hierarchical sigmoid over a
+    complete binary tree (default) or a custom path table."""
+    x = _t(input)
+    if path_table is None:
+        # complete binary tree with num_classes leaves: internal node ids
+        # 0..num_classes-2; leaf for class c sits at tree index c+num_classes-1
+        import numpy as _np
+        depth = int(_np.ceil(_np.log2(max(num_classes, 2))))
+        tables, codes = [], []
+        for c in range(num_classes):
+            node = c + num_classes - 1
+            pt, pc = [], []
+            while node > 0:
+                parent = (node - 1) // 2
+                pc.append(node % 2)  # 1 if left child else 0 (paddle code)
+                pt.append(parent)
+                node = parent
+            pt, pc = pt[::-1], pc[::-1]
+            pad_len = depth - len(pt)
+            tables.append(pt + [-1] * pad_len)
+            codes.append(pc + [-1] * pad_len)
+        path_table = Tensor(_np.asarray(tables, _np.int64))
+        path_code = Tensor(_np.asarray(codes, _np.int64))
+
+    def fn(xv, yv, wt, pt, pc, *b):
+        pt_y = jnp.take(pt, yv, axis=0)      # [N, D] node ids
+        pc_y = jnp.take(pc, yv, axis=0)      # [N, D] codes
+        valid = (pt_y >= 0).astype(xv.dtype)
+        idx = jnp.maximum(pt_y, 0)
+        w_y = jnp.take(wt, idx, axis=0)      # [N, D, F]
+        logits = jnp.einsum("nf,ndf->nd", xv, w_y)
+        if b:
+            logits = logits + jnp.take(b[0].reshape(-1), idx)
+        t = pc_y.astype(xv.dtype)
+        ce = jnp.maximum(logits, 0) - logits * t + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return jnp.sum(ce * valid, axis=1, keepdims=True)
+
+    args = [x, _t(label), _t(weight), _t(path_table), _t(path_code)]
+    if bias is not None:
+        args.append(_t(bias))
+    return apply(fn, *args, name="hsigmoid_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """ref: functional/loss.py margin_cross_entropy — ArcFace-style margin
+    softmax: cos(m1*theta + m2) - m3 on the target logit. Model-parallel
+    sharded classes go through ParallelCrossEntropy; this is the single-rank
+    path."""
+
+    def fn(z, y):
+        n, c = z.shape
+        onehot = jax.nn.one_hot(y, c, dtype=z.dtype)
+        theta = jnp.arccos(jnp.clip(z, -1.0, 1.0))
+        z_m = jnp.cos(margin1 * theta + margin2) - margin3
+        z_out = scale * (onehot * z_m + (1 - onehot) * z)
+        logp = jax.nn.log_softmax(z_out, axis=1)
+        loss = -jnp.take_along_axis(logp, y[:, None], axis=1)
+        if return_softmax:
+            return _reduce(loss, reduction), jnp.exp(logp)
+        return _reduce(loss, reduction)
+
+    if return_softmax:
+        return apply(fn, _t(logits), _t(label), n_outputs=2,
+                     name="margin_cross_entropy")
+    return apply(fn, _t(logits), _t(label), name="margin_cross_entropy")
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """ref: functional/loss.py rnnt_loss (warprnnt binding) — RNN-Transducer
+    loss via a log-domain forward DP compiled as nested lax.scan:
+    alpha[t,u] = logaddexp(alpha[t-1,u] + blank(t-1,u),
+                           alpha[t,u-1] + y(t,u-1))."""
+
+    def fn(acts, labels, T_len, U_len):
+        # acts: [B, T, U+1, V] log-probs or logits
+        logp = jax.nn.log_softmax(acts, axis=-1)
+        B, T, U1, V = logp.shape
+        NEG = jnp.asarray(-1e30, logp.dtype)
+
+        def one(b_logp, b_labels, t_len, u_len):
+            blank_lp = b_logp[:, :, blank]                      # [T, U+1]
+            lab_lp = jnp.take_along_axis(
+                b_logp[:, :-1, :], b_labels[None, :, None], axis=2
+            )[:, :, 0]                                          # [T, U]
+
+            def row(alpha_prev, t):
+                # alpha_prev: [U+1] = alpha[t-1, :]
+                def cell(carry, u):
+                    # carry = alpha[t, u-1]
+                    from_top = jnp.where(
+                        t > 0, alpha_prev[u] + blank_lp[t - 1, u], NEG)
+                    from_left = jnp.where(
+                        u > 0, carry + lab_lp[t, u - 1], NEG)
+                    a = jnp.where((t == 0) & (u == 0), 0.0,
+                                  jnp.logaddexp(from_top, from_left))
+                    a = jnp.where(u > u_len, NEG, a)
+                    return a, a
+
+                _, alpha_t = jax.lax.scan(cell, NEG, jnp.arange(U1))
+                return alpha_t, alpha_t
+
+            _, alphas = jax.lax.scan(row, jnp.full((U1,), NEG, logp.dtype),
+                                     jnp.arange(T))
+            # ll = alpha[T-1, U] + blank(T-1, U)
+            final = alphas[t_len - 1, u_len] + blank_lp[t_len - 1, u_len]
+            return -final
+
+        losses = jax.vmap(one)(logp, labels, T_len, U_len)
+        return _reduce(losses, reduction)
+
+    return apply(fn, _t(input), _t(label), _t(input_lengths),
+                 _t(label_lengths), name="rnnt_loss")

@@ -66,24 +66,70 @@ def _pool(x, ksize, stride, padding, nd, reducer, init, data_format,
     return apply(fn, x, name=f"{reducer}_pool{nd}d")
 
 
+def _max_pool_with_index(x, ksize, stride, padding, nd):
+    """Max pool + argmax indices (flattened over the UN-padded spatial dims),
+    the contract max_unpool needs (ref: functional/pooling.py return_mask).
+    Windows are unrolled at trace time (prod(ks) slices) — each output is a
+    max/argmax over ks strided views, which XLA fuses."""
+    import itertools
+    ks = _tuple(ksize, nd)
+    st = _tuple(stride if stride is not None else ksize, nd)
+    pads = _pads(padding, nd)
+    if isinstance(pads, str):
+        raise ValueError("string padding not supported with return_mask")
+
+    def fn(a):
+        spatial = a.shape[-nd:]
+        out_sp = tuple((spatial[i] + pads[i][0] + pads[i][1] - ks[i]) // st[i]
+                       + 1 for i in range(nd))
+        neg = jnp.asarray(-jnp.inf, a.dtype)
+        ap = jnp.pad(a, [(0, 0)] * (a.ndim - nd) + list(pads),
+                     constant_values=neg)
+        vals, idxs = [], []
+        for offs in itertools.product(*[range(k) for k in ks]):
+            sl = [slice(None)] * (a.ndim - nd) + [
+                slice(offs[i], offs[i] + (out_sp[i] - 1) * st[i] + 1, st[i])
+                for i in range(nd)]
+            v = ap[tuple(sl)]
+            # un-padded coordinate of this window element per output position
+            coord = None
+            for i in range(nd):
+                ci = (jnp.arange(out_sp[i]) * st[i] + offs[i] - pads[i][0])
+                shape = [1] * nd
+                shape[i] = out_sp[i]
+                ci = ci.reshape(shape)
+                coord = ci if coord is None else coord * spatial[i] + ci
+            vals.append(v)
+            idxs.append(jnp.broadcast_to(coord, v.shape))
+        stacked = jnp.stack(vals)                  # [K, ..., *out_sp]
+        which = jnp.argmax(stacked, axis=0)
+        best = jnp.max(stacked, axis=0)
+        flat = jnp.take_along_axis(jnp.stack(idxs), which[None], axis=0)[0]
+        return best, flat.astype(jnp.int32)
+
+    return apply(fn, x, n_outputs=2, name=f"max_pool{nd}d_with_index")
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        return _max_pool_with_index(_t(x), kernel_size, stride, padding, 1)
     return _pool(_t(x), kernel_size, stride, padding, 1, "max", -jnp.inf,
                  data_format, ceil_mode)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
-    out = _pool(_t(x), kernel_size, stride, padding, 2, "max", -jnp.inf,
-                data_format, ceil_mode)
     if return_mask:
-        # indices within each window, flattened HW index (best-effort)
-        return out, None
-    return out
+        return _max_pool_with_index(_t(x), kernel_size, stride, padding, 2)
+    return _pool(_t(x), kernel_size, stride, padding, 2, "max", -jnp.inf,
+                 data_format, ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_index(_t(x), kernel_size, stride, padding, 3)
     return _pool(_t(x), kernel_size, stride, padding, 3, "max", -jnp.inf,
                  data_format, ceil_mode)
 
@@ -163,3 +209,52 @@ def _adaptive(x, output_size, nd, mode, data_format):
         return a
 
     return apply(fn, x, name="adaptive_pool")
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                spatial_ndim, data_format):
+    """Shared scatter-by-saved-argmax unpooling (ref: functional/pooling.py
+    max_unpool{1,2,3}d — inverse of max_pool with return_mask=True)."""
+    import numpy as np_
+
+    def fn(a, idx):
+        lead = a.shape[:-spatial_ndim]          # (N, C)
+        spatial = a.shape[-spatial_ndim:]
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else [kernel_size] * spatial_ndim
+        st = stride or ks
+        st = st if isinstance(st, (list, tuple)) else [st] * spatial_ndim
+        pd = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * spatial_ndim
+        if output_size is not None:
+            out_sp = tuple(output_size[-spatial_ndim:])
+        else:
+            out_sp = tuple((spatial[i] - 1) * st[i] - 2 * pd[i] + ks[i]
+                           for i in range(spatial_ndim))
+        out_flat = int(np_.prod(out_sp))
+        a2 = a.reshape(lead + (-1,))
+        i2 = idx.reshape(lead + (-1,)).astype(jnp.int32)
+        zeros = jnp.zeros(lead + (out_flat,), a.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda z, ii, vv: z.at[ii].set(vv)))(zeros, i2, a2)
+        return out.reshape(lead + out_sp)
+
+    return apply(fn, _t(x), _t(indices), name="max_unpool")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                       1, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                       2, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                       3, data_format)

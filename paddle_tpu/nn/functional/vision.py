@@ -1,0 +1,207 @@
+"""Vision functionals (ref: python/paddle/nn/functional/vision.py) plus the
+sequence utilities grouped with them in the reference's functional surface."""
+import jax
+import jax.numpy as jnp
+
+from ...ops import apply
+from ...tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """ref: functional/vision.py affine_grid — 2D sampling grid from a batch
+    of 2x3 affine matrices."""
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(v) for v in out_shape.tolist()]
+
+    def fn(th):
+        n, _, h, w = out_shape
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, w)
+            ys = jnp.linspace(-1.0, 1.0, h)
+        else:
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+        gx, gy = jnp.meshgrid(xs, ys)                  # [H, W]
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+        th = th.astype(base.dtype)
+        return jnp.einsum("hwk,njk->nhwj", base, th)   # [N, H, W, 2]
+
+    return apply(fn, _t(theta), name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """ref: functional/vision.py grid_sample — NCHW bilinear/nearest sampling
+    at normalized grid locations (the STN sampler)."""
+
+    def fn(im, g):
+        n, c, h, w = im.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1.0) * (w - 1) / 2.0
+            fy = (gy + 1.0) * (h - 1) / 2.0
+        else:
+            fx = ((gx + 1.0) * w - 1.0) / 2.0
+            fy = ((gy + 1.0) * h - 1.0) / 2.0
+
+        if padding_mode == "border":
+            fx = jnp.clip(fx, 0, w - 1)
+            fy = jnp.clip(fy, 0, h - 1)
+        elif padding_mode == "reflection":
+            def refl(v, size):
+                if align_corners:
+                    span = 2 * (size - 1)
+                    v = jnp.abs(v) % jnp.maximum(span, 1)
+                    return jnp.where(v > size - 1, span - v, v)
+                span = 2 * size
+                v = (jnp.abs(v + 0.5) % span)
+                v = jnp.where(v > size, span - v, v) - 0.5
+                return jnp.clip(v, 0, size - 1)
+            fx = refl(fx, w)
+            fy = refl(fy, h)
+
+        def gather(iy, ix):
+            iyc = jnp.clip(iy, 0, h - 1)
+            ixc = jnp.clip(ix, 0, w - 1)
+            vals = im[jnp.arange(n)[:, None, None], :, iyc, ixc]  # [N,Ho,Wo,C]
+            if padding_mode == "zeros":
+                inb = ((iy >= 0) & (iy <= h - 1) & (ix >= 0)
+                       & (ix <= w - 1)).astype(im.dtype)
+                vals = vals * inb[..., None]
+            return vals
+
+        if mode == "nearest":
+            out = gather(jnp.round(fy).astype(jnp.int32),
+                         jnp.round(fx).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            x1, y1 = x0 + 1, y0 + 1
+            wx = fx - x0
+            wy = fy - y0
+            # corner weights must also respect zeros-padding validity
+            def wcorner(iy, ix, wgt):
+                return gather(iy, ix) * wgt[..., None]
+            out = (wcorner(y0, x0, (1 - wx) * (1 - wy))
+                   + wcorner(y0, x1, wx * (1 - wy))
+                   + wcorner(y1, x0, (1 - wx) * wy)
+                   + wcorner(y1, x1, wx * wy))
+        return jnp.transpose(out, (0, 3, 1, 2))  # back to NCHW
+
+    return apply(fn, _t(x), _t(grid), name="grid_sample")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """ref: functional/vision.py temporal_shift (TSM) — shift a channel slice
+    one segment forward/backward along time."""
+
+    def fn(a):
+        v = a
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v = v.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        back = jnp.concatenate(
+            [v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, c1:c2]), v[:, :-1, c1:c2]], axis=1)
+        keep = v[:, :, c2:]
+        out = jnp.concatenate([back, fwd, keep], axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply(fn, _t(x), name="temporal_shift")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """ref: fluid/layers sequence_mask — mask[i, j] = j < x[i]."""
+    x = _t(x)
+    if maxlen is None:
+        import numpy as _np
+        maxlen = int(_np.asarray(x.data).max())
+
+    def fn(lens):
+        ar = jnp.arange(maxlen)
+        return (ar[None, :] < lens[..., None]).astype(dtype)
+
+    return apply(fn, x, name="sequence_mask")
+
+
+def gather_tree(ids, parents):
+    """ref: fluid/layers gather_tree — backtrace beam-search parent pointers
+    into full sequences. ids/parents: [T, B, beam]."""
+
+    def fn(idv, par):
+        T = idv.shape[0]
+
+        def step(beams, t):
+            # beams: [B, beam] current beam indices at time t+1
+            sel = jnp.take_along_axis(par[t], beams, axis=1)
+            out = jnp.take_along_axis(idv[t], beams, axis=1)
+            return sel, out
+
+        init = jnp.broadcast_to(jnp.arange(idv.shape[2]),
+                                idv.shape[1:]).astype(idv.dtype)
+        _, outs = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return outs[::-1]
+
+    return apply(fn, _t(ids), _t(parents), name="gather_tree")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """ref: functional/common.py class_center_sample (PartialFC) — sample the
+    positive class centers plus negatives up to num_samples. Data-dependent
+    output => eager host-side op like the reference's dynamic kernel."""
+    import numpy as _np
+    lab = _np.asarray(_t(label).data)
+    pos = _np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = _np.setdiff1d(_np.arange(num_classes), pos)
+        extra = _np.random.RandomState(0).choice(
+            neg_pool, num_samples - len(pos), replace=False)
+        sampled = _np.sort(_np.concatenate([pos, extra]))
+    remap = _np.full(num_classes, -1, _np.int64)
+    remap[sampled] = _np.arange(len(sampled))
+    return (Tensor(remap[lab]), Tensor(sampled.astype(_np.int64)))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """ref: operators/sparse_attention_op.cu — block-sparse attention with a
+    CSR connectivity pattern. TPU lowering: materialize the CSR pattern as an
+    additive mask and run one fused masked softmax-attention (XLA fuses;
+    flash-style Pallas kernels cover the dense fast path)."""
+
+    def fn(q, k, v, offs, cols, *masks):
+        b, h, t, d = q.shape
+        nnz = cols.shape[-1]
+        scores = jnp.einsum("bhtd,bhsd->bhts", q, k) / jnp.sqrt(d).astype(
+            q.dtype)
+        # row of CSR slot s is the r with offs[r] <= s < offs[r+1]
+        def row_ids(o):
+            return jnp.clip(
+                jnp.searchsorted(o, jnp.arange(nnz), side="right") - 1,
+                0, t - 1)
+        rids = jax.vmap(jax.vmap(row_ids))(offs)              # [B, H, nnz]
+        bi = jnp.arange(b)[:, None, None]
+        hi = jnp.arange(h)[None, :, None]
+        allowed = jnp.zeros((b, h, t, t), bool).at[
+            bi, hi, rids, cols].set(True)
+        scores = jnp.where(allowed, scores, jnp.asarray(-1e30, scores.dtype))
+        att = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhts,bhsd->bhtd", att, v)
+
+    args = [_t(query), _t(key), _t(value), _t(sparse_csr_offset),
+            _t(sparse_csr_columns)]
+    return apply(fn, *args, name="sparse_attention")

@@ -59,3 +59,11 @@ class PReLU(Layer):
 
     def forward(self, x):
         return F.prelu(x, self.weight, self._data_format)
+
+
+class Softmax2D(Layer):
+    """ref: nn/layer/activation.py Softmax2D — softmax over the channel axis
+    of NCHW input."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)

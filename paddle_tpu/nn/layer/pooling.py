@@ -93,3 +93,57 @@ class AdaptiveMaxPool2D(_AdaptivePool):
 class AdaptiveMaxPool3D(_AdaptivePool):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__(F.adaptive_max_pool3d, output_size)
+
+
+class MaxUnPool1D(Layer):
+    """ref: nn/layer/pooling.py MaxUnPool1D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format,
+                              self.output_size)
+
+
+class MaxUnPool2D(Layer):
+    """ref: nn/layer/pooling.py MaxUnPool2D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format,
+                              self.output_size)
+
+
+class MaxUnPool3D(Layer):
+    """ref: nn/layer/pooling.py MaxUnPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format,
+                              self.output_size)

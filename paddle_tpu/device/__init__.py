@@ -52,3 +52,48 @@ class cuda:
 
 def synchronize(device=None):
     cuda.synchronize(device)
+
+
+def _device_for(device=None):
+    if device is None:
+        return jax.devices()[0]
+    if hasattr(device, "platform"):
+        return device
+    name = str(device)
+    idx = int(name.split(":")[1]) if ":" in name else 0
+    return jax.devices()[idx]
+
+
+def memory_stats(device=None):
+    """Full allocator statistics for a device (TPU: bytes_in_use,
+    peak_bytes_in_use, bytes_limit, num_allocs, ...; CPU backends report
+    {}). The observability analog of the reference's memory/stats.cc
+    (ref: paddle/fluid/memory/stats.cc, memory/allocation/
+    allocator_facade.cc) — XLA owns allocation, this surfaces its stats."""
+    try:
+        return dict(_device_for(device).memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def max_memory_allocated(device=None):
+    return memory_stats(device).get("peak_bytes_in_use", 0)
+
+
+def max_memory_reserved(device=None):
+    st = memory_stats(device)
+    return st.get("bytes_reserved", st.get("peak_bytes_in_use", 0))
+
+
+def memory_allocated(device=None):
+    return memory_stats(device).get("bytes_in_use", 0)
+
+
+def memory_reserved(device=None):
+    st = memory_stats(device)
+    return st.get("bytes_reserved", st.get("bytes_in_use", 0))
+
+
+def reset_peak_memory_stats(device=None):
+    # XLA exposes no reset; callers should diff successive readings.
+    return None

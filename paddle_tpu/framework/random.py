@@ -15,28 +15,38 @@ import numpy as np
 
 
 class Generator:
-    """Stateful RNG handle (ref: phi/core/generator.h)."""
+    """Stateful RNG handle (ref: phi/core/generator.h).
+
+    The PRNG key is materialized lazily: creating a jax key touches the
+    device backend, and imports must stay device-free so that CPU-only
+    processes (e.g. the launcher parent) never block on TPU init.
+    """
 
     def __init__(self, seed=0):
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        self._key = None
 
     def manual_seed(self, seed):
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        self._key = None
         return self
 
     def initial_seed(self):
         return self._seed
 
-    def get_state(self):
+    def _materialize(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
         return self._key
+
+    def get_state(self):
+        return self._materialize()
 
     def set_state(self, state):
         self._key = state
 
     def next_key(self):
-        self._key, sub = jax.random.split(self._key)
+        self._key, sub = jax.random.split(self._materialize())
         return sub
 
 

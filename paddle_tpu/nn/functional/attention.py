@@ -15,7 +15,8 @@ from ...tensor.tensor import Tensor
 
 
 @register_kernel("sdpa", "xla")
-def _sdpa_xla(q, k, v, *rest, causal=False, scale=None, dropout_p=0.0):
+def _sdpa_xla(q, k, v, *rest, causal=False, scale=None, dropout_p=0.0,
+              mask_needs_grad=False):
     # q,k,v: [batch, seq, heads, head_dim] (paddle layout)
     mask = rest[0] if rest else None
     hd = q.shape[-1]
@@ -43,9 +44,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  name=None):
     """Inputs [batch, seq, num_heads, head_dim] (paddle convention)."""
     args = [query, key, value]
+    mask_needs_grad = False
     if attn_mask is not None:
         args.append(attn_mask)
-    return dispatch("sdpa", *args, causal=is_causal, dropout_p=dropout_p)
+        # A trainable mask (learned additive bias, ALiBi-style) must keep
+        # its gradient path; the Pallas kernel treats the mask as
+        # non-differentiable and falls back to XLA in that case.
+        mask_needs_grad = (isinstance(attn_mask, Tensor)
+                           and not attn_mask.stop_gradient)
+    return dispatch("sdpa", *args, causal=is_causal, dropout_p=dropout_p,
+                    mask_needs_grad=mask_needs_grad)
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,

@@ -12,8 +12,15 @@ from .rms_norm import rms_norm_pallas, make_rms_norm
 
 
 @register_kernel("sdpa", "pallas")
-def _sdpa_pallas(q, k, v, *rest, causal=False, scale=None, dropout_p=0.0):
+def _sdpa_pallas(q, k, v, *rest, causal=False, scale=None, dropout_p=0.0,
+                 mask_needs_grad=False):
     mask = rest[0] if rest else None
+    if mask is not None and mask_needs_grad:
+        # The Pallas kernel's vjp returns a zero mask cotangent; a learned
+        # additive bias needs the XLA path for its gradient.
+        from ...nn.functional.attention import _sdpa_xla
+        return _sdpa_xla(q, k, v, mask, causal=causal, scale=scale,
+                         dropout_p=dropout_p)
     return flash_attention_pallas(q, k, v, mask=mask, causal=causal,
                                   scale=scale, dropout_p=dropout_p)
 

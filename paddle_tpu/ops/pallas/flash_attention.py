@@ -1,15 +1,22 @@
-"""Pallas flash attention (TPU).
+"""Pallas flash attention (TPU), forward + backward.
 
 Replaces the reference's CUDA fused attention
 (ref: paddle/fluid/operators/fused/fused_multi_transformer_op.cu.h:13 —
-FasterTransformer-derived masked MHA; fmha_ref.h) with an online-softmax
-tiled kernel: Q blocks stream over K/V blocks entirely in VMEM, never
-materializing the [s, s] score matrix. Registered as the 'pallas' backend
-for the 'sdpa' op; XLA fallback remains for CPU/debug.
+FasterTransformer-derived masked MHA; fmha_ref.h) with online-softmax
+tiled kernels. TPU-first design:
 
-Backward: custom_vjp that recomputes attention with the XLA reference path
-(correctness-first; a tiled Pallas backward is the known next perf step —
-O(s^2) bwd memory bounds max context until then).
+- K/V are streamed from HBM block-by-block via the grid's innermost
+  dimension (Pallas double-buffers the DMAs); only [bk, d] tiles are ever
+  VMEM-resident, so sequence length is bounded by HBM, not VMEM.
+- The [s, s] score matrix is never materialized. Softmax statistics
+  (running max + logsumexp) live in VMEM scratch that persists across the
+  innermost grid dimension.
+- Backward is two tiled Pallas kernels (dQ; dK/dV) driven by the saved
+  logsumexp and delta = rowsum(dO * O) — recompute-free at the XLA level,
+  O(s) memory in attention state.
+- Additive masks are supported natively as a blocked operand (bool masks
+  are converted to additive form in the wrapper); causal masking is
+  computed inline from block indices with whole-block skipping.
 """
 import functools
 import math
@@ -22,92 +29,333 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, bq, bk, s, d, causal,
-                      scale):
+def _mask_index_map(group):
+    def im(b, i, kb):
+        return (b // group, i, kb)
+    return im
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, bq, bk, nk, s_true, causal,
+                scale, has_mask):
+    if has_mask:
+        mask_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        mask_ref = None
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # [bq, d]
-
-    m = jnp.full((bq, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((bq, 1), jnp.float32)
-    acc = jnp.zeros((bq, d), jnp.float32)
-
-    n_kb = pl.cdiv(s, bk)
+    ki = pl.program_id(2)
     q_start = qi * bq
+    k_start = ki * bk
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k_start = kb * bk
-        k = k_ref[0, pl.ds(k_start, bk), :].astype(jnp.float32)  # [bk, d]
-        v = v_ref[0, pl.ds(k_start, bk), :].astype(jnp.float32)
-        # zero padding rows (reads past the true seq end are masked)
-        kv_valid = (jax.lax.broadcasted_iota(jnp.int32, (bk, 1), 0)
-                    + k_start) < s
-        k = jnp.where(kv_valid, k, jnp.float32(0.0))
-        v = jnp.where(kv_valid, v, jnp.float32(0.0))
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bq, bk]
+            preferred_element_type=jnp.float32) * jnp.float32(scale)
+        if mask_ref is not None:
+            logits = logits + mask_ref[0].astype(jnp.float32)
         cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + k_start
-        valid = cols < s  # mask key padding beyond the true sequence
+        valid = cols < s_true  # key padding beyond the true sequence
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_start
             valid = valid & (rows >= cols)
         logits = jnp.where(valid, logits, jnp.float32(NEG_INF))
-        m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
         p = jnp.exp(logits - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = alpha * acc + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = alpha * acc_scr[...] + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
     if causal:
-        # only key blocks up to the diagonal contribute
-        n_kb_eff = jnp.minimum(
-            jax.lax.div(jnp.asarray(q_start + bq - 1, jnp.int32),
-                        jnp.asarray(bk, jnp.int32)) + 1, n_kb)
+        # whole blocks above the diagonal are masked; skip their MXU work
+        pl.when(k_start <= q_start + bq - 1)(_compute)
     else:
-        n_kb_eff = n_kb
-    m, l, acc = jax.lax.fori_loop(0, n_kb_eff, body, (m, l, acc))
-    o_ref[0] = (acc / jnp.maximum(l, jnp.float32(1e-30))).astype(o_ref.dtype)
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _emit():
+        m_fin = m_scr[:, :1]
+        l_fin = l_scr[:, :1]
+        o_ref[0] = (acc_scr[...] /
+                    jnp.maximum(l_fin, jnp.float32(1e-30))).astype(o_ref.dtype)
+        # logsumexp rows; padded/fully-masked rows have l == 0 -> lse = -inf
+        lse = m_fin + jnp.log(jnp.maximum(l_fin, jnp.float32(1e-30)))
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _flash_attention_fwd_raw(q, k, v, causal, scale, bq, bk, interpret):
-    """q,k,v: [bh, s, d] -> out [bh, s, d]."""
-    bh, s_true, d = q.shape
-    bq = min(bq, s_true)
-    bk = min(bk, s_true)
-    # pad seq to block multiples: pl.ds clamps OOB starts, so padding must be
-    # physical; the kernel masks cols >= s_true.
-    pad = (-s_true) % max(bq, bk)
-    if pad:
-        widths = ((0, 0), (0, pad), (0, 0))
-        q = jnp.pad(q, widths)
-        k = jnp.pad(k, widths)
-        v = jnp.pad(v, widths)
-    s = s_true + pad
-    grid = (bh, pl.cdiv(s, bq))
-    kernel = functools.partial(_flash_fwd_kernel, bq=bq, bk=bk, s=s_true, d=d,
-                               causal=causal, scale=scale)
+def _flash_fwd(q, k, v, mask, causal, scale, bq, bk, s_true, interpret):
+    """q,k,v: [bh, s, d] (padded to block multiples); mask: [Bm, s, s]|None;
+    s_true = unpadded sequence length (keys beyond it are masked out).
+    Returns (out [bh, s, d], lse [bh, s])."""
+    bh, s, d = q.shape
+    nq = s // bq
+    nk = s // bk
+    has_mask = mask is not None
+
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, kb: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, kb: (b, kb, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, kb: (b, kb, 0)),
+    ]
+    args = [q, k, v]
+    if has_mask:
+        group = bh // mask.shape[0]
+        in_specs.append(pl.BlockSpec((1, bq, bk), _mask_index_map(group)))
+        args.append(mask)
+
+    kernel = functools.partial(
+        _fwd_kernel, bq=bq, bk=bk, nk=nk, s_true=s_true, causal=causal,
+        scale=scale, has_mask=has_mask)
+    # x64 must be off while tracing the kernel/index maps: Mosaic rejects
+    # i64 grid indices (the package enables x64 globally for API parity).
     with jax.enable_x64(False):
-        out = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-        interpret=interpret,
-    )(q, k, v)
-    return out[:, :s_true] if pad else out
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=(bh, nq, nk),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, bq, d), lambda b, i, kb: (b, i, 0)),
+                pl.BlockSpec((1, bq, 128), lambda b, i, kb: (b, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, s, 128), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, d), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=interpret,
+        )(*args)
+    return out, lse[:, :, 0]
+
+
+# ---------------------------------------------------------------------------
+# backward: dQ kernel (grid b, q, k) and dK/dV kernel (grid b, k, q)
+# ---------------------------------------------------------------------------
+
+def _recompute_p(q_ref, k_ref, mask_ref, lse_ref, *, bq, bk, s_true,
+                 q_start, k_start, causal, scale):
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    logits = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * jnp.float32(scale)
+    if mask_ref is not None:
+        logits = logits + mask_ref[0].astype(jnp.float32)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + k_start
+    valid = cols < s_true
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_start
+        valid = valid & (rows >= cols)
+    logits = jnp.where(valid, logits, jnp.float32(NEG_INF))
+    lse = lse_ref[0][:, :1]  # [bq, 1]
+    return jnp.exp(logits - lse)  # rows with lse=-inf produce 0 via exp(-inf-(-inf))? guarded by caller padding
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   bq, bk, nk, s_true, causal, scale, has_mask):
+    if has_mask:
+        mask_ref, dq_ref, dq_scr = rest
+    else:
+        mask_ref = None
+        dq_ref, dq_scr = rest
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    q_start = qi * bq
+    k_start = ki * bk
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        p = _recompute_p(q_ref, k_ref, mask_ref, lse_ref, bq=bq, bk=bk,
+                         s_true=s_true, q_start=q_start, k_start=k_start,
+                         causal=causal, scale=scale)
+        do = do_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        delta = delta_ref[0][:, :1]
+        ds = p * (dp - delta) * jnp.float32(scale)
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(k_start <= q_start + bq - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _emit():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                    bq, bk, nq, s_true, causal, scale, has_mask):
+    if has_mask:
+        mask_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        mask_ref = None
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    q_start = qi * bq
+    k_start = ki * bk
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        p = _recompute_p(q_ref, k_ref, mask_ref, lse_ref, bq=bq, bk=bk,
+                         s_true=s_true, q_start=q_start, k_start=k_start,
+                         causal=causal, scale=scale)
+        do = do_ref[0].astype(jnp.float32)
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # p^T @ do: [bk, d]
+        v = v_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        delta = delta_ref[0][:, :1]
+        ds = p * (dp - delta) * jnp.float32(scale)  # [bq, bk]
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # ds^T @ q: [bk, d]
+
+    if causal:
+        pl.when(k_start <= q_start + bq - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, mask, causal, scale, bq, bk, s_true,
+               interpret):
+    """All [bh, s, d] (padded); lse [bh, s]. Returns dq, dk, dv."""
+    bh, s, d = q.shape
+    nq = s // bq
+    nk = s // bk
+    has_mask = mask is not None
+
+    # delta = rowsum(dO * O) — cheap elementwise, XLA fuses it.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    # lse/delta as [bh, s, 128]-lane-replicated? Cheaper: pass [bh, s] and
+    # block (1, bq) — but TPU wants last dim 128. Replicate into lanes.
+    lse_l = jnp.broadcast_to(lse[:, :, None], (bh, s, 128))
+    delta_l = jnp.broadcast_to(delta[:, :, None], (bh, s, 128))
+
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, i, kb: (b, i, 0))
+    row_spec = pl.BlockSpec((1, bq, 128), lambda b, i, kb: (b, i, 0))
+    k_spec = pl.BlockSpec((1, bk, d), lambda b, i, kb: (b, kb, 0))
+
+    in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
+    args = [q, k, v, do, lse_l, delta_l]
+    if has_mask:
+        group = bh // mask.shape[0]
+        in_specs.append(pl.BlockSpec((1, bq, bk), _mask_index_map(group)))
+        args.append(mask)
+
+    with jax.enable_x64(False):
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, bq=bq, bk=bk, nk=nk,
+                              s_true=s_true, causal=causal, scale=scale,
+                              has_mask=has_mask),
+            grid=(bh, nq, nk),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, bq, d), lambda b, i, kb: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=interpret,
+        )(*args)
+
+    # dkv grid: (bh, nk, nq) — q innermost; index maps swap roles.
+    q_spec2 = pl.BlockSpec((1, bq, d), lambda b, kb, i: (b, i, 0))
+    row_spec2 = pl.BlockSpec((1, bq, 128), lambda b, kb, i: (b, i, 0))
+    k_spec2 = pl.BlockSpec((1, bk, d), lambda b, kb, i: (b, kb, 0))
+    in_specs2 = [q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2]
+    args2 = [q, k, v, do, lse_l, delta_l]
+    if has_mask:
+        group = bh // mask.shape[0]
+
+        def mask_im2(b, kb, i):
+            return (b // group, i, kb)
+        in_specs2.append(pl.BlockSpec((1, bq, bk), mask_im2))
+        args2.append(mask)
+
+    with jax.enable_x64(False):
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_dkv_kernel, bq=bq, bk=bk, nq=nq,
+                              s_true=s_true, causal=causal, scale=scale,
+                              has_mask=has_mask),
+            grid=(bh, nk, nq),
+            in_specs=in_specs2,
+            out_specs=[
+                pl.BlockSpec((1, bk, d), lambda b, kb, i: (b, kb, 0)),
+                pl.BlockSpec((1, bk, d), lambda b, kb, i: (b, kb, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+                jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+            ],
+            scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                            pltpu.VMEM((bk, d), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=interpret,
+        )(*args2)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# padding / layout helpers
+# ---------------------------------------------------------------------------
+
+def _pad_seq(x, blk, axis):
+    s = x.shape[axis]
+    pad = (-s) % blk
+    if not pad:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
 
 
 def _reshape_in(x):
@@ -122,42 +370,133 @@ def _reshape_out(x, bh):
     return jnp.swapaxes(x.reshape(b, h, s, d), 1, 2)
 
 
-def _xla_ref(q, k, v, causal, scale):
+def _xla_ref(q, k, v, causal, scale, mask=None):
     qT = jnp.swapaxes(q, 1, 2)
     kT = jnp.swapaxes(k, 1, 2)
     vT = jnp.swapaxes(v, 1, 2)
     logits = jnp.einsum("bhqd,bhkd->bhqk", qT, kT) * scale
+    if mask is not None:
+        logits = logits + mask
     if causal:
         ql, kl = logits.shape[-2], logits.shape[-1]
-        mask = jnp.tril(jnp.ones((ql, kl), bool), kl - ql)
-        logits = jnp.where(mask, logits, NEG_INF)
+        tri = jnp.tril(jnp.ones((ql, kl), bool), kl - ql)
+        logits = jnp.where(tri, logits, NEG_INF)
     p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", p, vT)
     return jnp.swapaxes(out, 1, 2)
 
 
-def make_flash_attention(bq=128, bk=128, interpret=False):
-    """Build the custom-vjp flash attention for given block sizes."""
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
 
-    @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-    def flash(q, k, v, causal, scale):
+def make_flash_attention(bq=128, bk=128, interpret=False):
+    """Build the custom-vjp flash attention for given block sizes.
+
+    Signature: flash(q, k, v, causal, scale) with [b, s, h, d] inputs,
+    and flash_masked(q, k, v, mask, causal, scale) where mask is additive
+    [b|1, h|1, sq, sk] (broadcastable).
+    """
+
+    def _prep(q, k, v, mask):
         qr, bhq = _reshape_in(q)
         kr, _ = _reshape_in(k)
         vr, _ = _reshape_in(v)
-        o = _flash_attention_fwd_raw(qr, kr, vr, causal, scale, bq, bk,
-                                     interpret)
-        return _reshape_out(o, bhq)
+        s_true = qr.shape[1]
+        blk = max(bq, bk)
+        qp = _pad_seq(qr, blk, 1)
+        kp = _pad_seq(kr, blk, 1)
+        vp = _pad_seq(vr, blk, 1)
+        mp = None
+        if mask is not None:
+            b, h = bhq
+            sq, sk = mask.shape[-2], mask.shape[-1]
+            mb, mh = mask.shape[0], mask.shape[1]
+            # broadcast query/key dims FIRST: a [b,1,1,sk] key-padding mask
+            # must apply to every query row, not only row 0 (padding a
+            # size-1 query axis would silently unmask rows 1..s-1)
+            if sq != s_true or sk != s_true:
+                mask = jnp.broadcast_to(
+                    mask, mask.shape[:2] + (s_true, s_true))
+                sq = sk = s_true
+            if mh == 1 and mb == 1:
+                m3 = mask.reshape(1, sq, sk)
+            elif mh == 1:
+                m3 = jnp.broadcast_to(mask, (b, 1, sq, sk)).reshape(b, sq, sk)
+            else:
+                m3 = jnp.broadcast_to(
+                    mask, (b, h, sq, sk)).reshape(b * h, sq, sk)
+            # pad query axis with 0 (rows sliced off); padded keys are
+            # excluded by the kernel's s_true column mask
+            m3 = _pad_seq(m3, blk, 1)
+            pad_k = (-sk) % blk
+            if pad_k:
+                m3 = jnp.pad(m3, ((0, 0), (0, 0), (0, pad_k)),
+                             constant_values=0.0)
+            mp = m3
+        return qp, kp, vp, mp, bhq, s_true
 
-    def fwd(q, k, v, causal, scale):
-        return flash(q, k, v, causal, scale), (q, k, v)
+    def _fwd_impl(q, k, v, mask, causal, scale):
+        qp, kp, vp, mp, bhq, s_true = _prep(q, k, v, mask)
+        o, lse = _flash_fwd(qp, kp, vp, mp, causal, scale,
+                            min(bq, qp.shape[1]), min(bk, kp.shape[1]),
+                            s_true, interpret)
+        return o, lse, qp, kp, vp, mp, bhq, s_true
 
-    def bwd(causal, scale, res, g):
-        q, k, v = res
-        _, vjp = jax.vjp(lambda a, b, c: _xla_ref(a, b, c, causal, scale),
-                         q, k, v)
-        return vjp(g)
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+    def flash(q, k, v, causal, scale):
+        o, lse, qp, kp, vp, mp, bhq, s_true = _fwd_impl(
+            q, k, v, None, causal, scale)
+        return _reshape_out(o[:, :s_true], bhq)
 
-    flash.defvjp(fwd, bwd)
+    def flash_fwd(q, k, v, causal, scale):
+        o, lse, qp, kp, vp, mp, bhq, s_true = _fwd_impl(
+            q, k, v, None, causal, scale)
+        return (_reshape_out(o[:, :s_true], bhq),
+                (qp, kp, vp, o, lse, bhq, s_true))
+
+    def flash_bwd(causal, scale, res, g):
+        qp, kp, vp, o, lse, bhq, s_true = res
+        blk = max(bq, bk)
+        gr, _ = _reshape_in(g)
+        gp = _pad_seq(gr, blk, 1)
+        dq, dk, dv = _flash_bwd(qp, kp, vp, o, lse, gp, None, causal, scale,
+                                min(bq, qp.shape[1]), min(bk, kp.shape[1]),
+                                s_true, interpret)
+        return (_reshape_out(dq[:, :s_true], bhq),
+                _reshape_out(dk[:, :s_true], bhq),
+                _reshape_out(dv[:, :s_true], bhq))
+
+    flash.defvjp(flash_fwd, flash_bwd)
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+    def flash_masked(q, k, v, mask, causal, scale):
+        o, lse, qp, kp, vp, mp, bhq, s_true = _fwd_impl(
+            q, k, v, mask, causal, scale)
+        return _reshape_out(o[:, :s_true], bhq)
+
+    def flash_masked_fwd(q, k, v, mask, causal, scale):
+        o, lse, qp, kp, vp, mp, bhq, s_true = _fwd_impl(
+            q, k, v, mask, causal, scale)
+        return (_reshape_out(o[:, :s_true], bhq),
+                (qp, kp, vp, mp, o, lse, bhq, s_true, mask))
+
+    def flash_masked_bwd(causal, scale, res, g):
+        qp, kp, vp, mp, o, lse, bhq, s_true, mask = res
+        blk = max(bq, bk)
+        gr, _ = _reshape_in(g)
+        gp = _pad_seq(gr, blk, 1)
+        dq, dk, dv = _flash_bwd(qp, kp, vp, o, lse, gp, mp, causal, scale,
+                                min(bq, qp.shape[1]), min(bk, kp.shape[1]),
+                                s_true, interpret)
+        return (_reshape_out(dq[:, :s_true], bhq),
+                _reshape_out(dk[:, :s_true], bhq),
+                _reshape_out(dv[:, :s_true], bhq),
+                jnp.zeros_like(mask))
+
+    flash_masked.defvjp(flash_masked_fwd, flash_masked_bwd)
+
+    flash.masked = flash_masked
     return flash
 
 
@@ -168,11 +507,20 @@ def flash_attention_pallas(q, k, v, mask=None, causal=False, scale=None,
                            dropout_p=0.0):
     """sdpa-compatible entry: [b, s, h, d] inputs (paddle layout)."""
     global _default_flash
-    if mask is not None:
-        # masked variants fall back to XLA (Pallas mask kernel: next round)
+    if dropout_p and dropout_p > 0.0:
+        # attention dropout falls back to XLA (rare in TPU training; bwd
+        # through dropout-p requires threading the mask through the kernel)
         from ...nn.functional.attention import _sdpa_xla
-        return _sdpa_xla(q, k, v, mask, causal=causal, scale=scale)
+        return _sdpa_xla(q, k, v, mask, causal=causal, scale=scale,
+                         dropout_p=dropout_p)
     if _default_flash is None:
         _default_flash = make_flash_attention()
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if mask is not None:
+        m = mask
+        if m.dtype == jnp.bool_:
+            m = jnp.where(m, jnp.float32(0.0), jnp.float32(NEG_INF))
+        while m.ndim < 4:
+            m = m[None]
+        return _default_flash.masked(q, k, v, m, causal, s)
     return _default_flash(q, k, v, causal, s)

@@ -215,12 +215,115 @@ class PipelineParallel(MetaParallelBase):
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """ref: pipeline_parallel.py:461 — virtual pipeline stages. The
-    single-controller schedule executes chunks in interleaved order; numerics
-    match the non-interleaved case (additive grad accumulation), so we reuse
-    the base schedule over the finer chunk segmentation."""
+    """ref: pipeline_parallel.py:461 PipelineParallelWithInterleave, :535
+    interleaved 1F1B.
+
+    Virtual pipeline stages executed in the REAL Megatron interleaved
+    order: forward slot k processes group g = k // (S·v), chunk
+    c = (k // S) % v, microbatch m = g·S + (k % S) — so microbatch m+1's
+    chunk 0 runs BEFORE microbatch m's chunk 1 (the reordering that shrinks
+    the bubble by 1/v on devices). Backward slots mirror the order in
+    reverse, one backward per forward once the pipeline is full (1F1B).
+    The executed slot order is recorded in `schedule_trace` as
+    ("F"|"B", microbatch, chunk) tuples for inspection/testing."""
 
     def __init__(self, layers, hcg, strategy):
         super().__init__(layers, hcg, strategy)
-        self.num_stages = layers.get_num_stages() * \
-            layers._num_virtual_pipeline_stages
+        self.base_stages = layers.get_num_stages()
+        self.v = layers._num_virtual_pipeline_stages
+        self.num_stages = self.base_stages * self.v
+        self.schedule_trace = []
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        self.scaler = scaler
+        S = self.base_stages
+        v = self.v
+        L = self.num_stages
+        M = self.accumulate_steps
+        G = -(-M // S)
+        self.schedule_trace = []
+        losses = {}
+        scaled_losses = []
+        stage_buffers = [[] for _ in range(L)]
+        act = {}            # microbatch -> current activation
+        pending_grad = {}   # microbatch -> cotangent flowing upstream
+
+        def decode(k):
+            g = k // (S * v)
+            c = (k // S) % v
+            j = k % S
+            return g * S + j, c
+
+        def fwd_slot(m, c, r):
+            l = c * S + r
+            if c == 0 and r == 0:
+                x, label = self._load_micro_batch(data, m)
+                act[m] = (x, label)
+            x, label = act[m]
+            out = self._forward_step_stage(l, x, stage_buffers[l])
+            act[m] = (out, label)
+            self.schedule_trace.append(("F", m, l))
+            if l == L - 1:
+                loss = self._compute_loss(out, label)
+                losses[m] = loss
+
+        def bwd_slot(m, c, r):
+            l = c * S + r
+            self.schedule_trace.append(("B", m, l))
+            if l == L - 1:
+                loss = losses.pop(m)
+                scaled = loss * (1.0 / M)
+                if self.scaler is not None:
+                    scaled = self.scaler.scale(scaled)
+                scaled_losses.append(loss)
+                tape.run_backward([scaled], [None])
+                xin, _ = stage_buffers[l].pop(0)
+            else:
+                g = pending_grad.pop(m)
+                xin, out = stage_buffers[l].pop(0)
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                gs = g if isinstance(g, tuple) else (g,)
+                tape.run_backward(
+                    [o for o in outs if not o.stop_gradient],
+                    [gg for o, gg in zip(outs, gs)
+                     if not o.stop_gradient])
+            xins = xin if isinstance(xin, tuple) else (xin,)
+            grads = tuple(t.grad for t in xins)
+            for t in xins:
+                t.grad = None
+            if l > 0:
+                pending_grad[m] = grads if len(grads) > 1 else grads[0]
+
+        # tick loop: per tick, every rank runs its fwd slot then its bwd
+        # slot (exactly the device schedule, serialized by the single
+        # controller in dependency order: ranks ascending for fwd,
+        # descending for bwd).
+        T0 = v * S - 1
+        total_ticks = G * S * v + T0 + (v - 1) * S + (S - 1) + 1
+        for t in range(total_ticks):
+            for r in range(S):
+                k = t - r
+                if k < 0:
+                    continue
+                m, c = decode(k)
+                if m < M:
+                    fwd_slot(m, c, r)
+            for r in range(S - 1, -1, -1):
+                k = t - T0 - (S - 1 - r)
+                if k < 0:
+                    continue
+                g = k // (S * v)
+                cc = (k // S) % v
+                j = k % S
+                m = g * S + j
+                c = (v - 1) - cc
+                if m < M:
+                    bwd_slot(m, c, r)
+
+        with tape.no_grad():
+            total = None
+            for l in scaled_losses:
+                total = l if total is None else total + l
+            total = total * (1.0 / M)
+        self.total_loss = total
+        return total.detach()

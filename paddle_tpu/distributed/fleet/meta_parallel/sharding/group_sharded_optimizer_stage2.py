@@ -1,37 +1,89 @@
 """ZeRO-2 optimizer (ref: python/paddle/distributed/fleet/meta_parallel/
 sharding/group_sharded_optimizer_stage2.py:53 — param segmentation :308,
-rank buffers :369, broadcast overlap :241).
+rank buffers :369, broadcast overlap :241, CPU offload :484-509).
 
 TPU-native: optimizer state arrays are placed sharded over the 'sharding'
-mesh axis (see group_sharded_utils). The update math is unchanged; XLA
-partitions the state update and the params stay logically whole, which
-replaces the reference's reduce-to-owner + broadcast cycle."""
+mesh axis (see group_sharded_utils); XLA partitions the state update and
+the params stay logically whole, which replaces the reference's
+reduce-to-owner + broadcast cycle. `offload=True` is honored for real:
+moments are parked in HOST memory between steps and staged onto the
+device only for the update (the reference's `_offload_*` path). Knobs
+that have no GSPMD analog are rejected loudly instead of silently
+ignored.
+"""
+import warnings
+
+import jax
+
 from .group_sharded_utils import place_sharded
+
+
+def _host_device():
+    try:
+        return jax.devices("cpu")[0]
+    except Exception:
+        return None
 
 
 class GroupShardedOptimizerStage2:
     def __init__(self, params, optim, group=None, offload=False, device="tpu",
                  pretrain_sync_models=True, dp_group=None, **kw):
+        unknown = {k: v for k, v in kw.items()
+                   if k not in ("broadcast_fp16", "buffer_max_size")}
+        if unknown:
+            raise TypeError(
+                f"GroupShardedOptimizerStage2: unsupported kwargs {unknown} "
+                f"(the GSPMD sharding design has no analog; remove them)")
+        if kw:
+            warnings.warn(
+                f"GroupShardedOptimizerStage2: {sorted(kw)} are buffer-"
+                f"management knobs of the reference's flat-storage design; "
+                f"XLA owns buffers here, so they have no effect.")
         self._optim = optim
         self._params = list(params)
         self._group = group
-        self.offload = offload
+        self.offload = bool(offload)
+        self._host = _host_device() if self.offload else None
         if self._optim._parameter_list is None:
             self._optim._parameter_list = self._params
         self._shard_states_placed = False
 
-    def _place_states(self):
+    # -- state placement ----------------------------------------------------
+    def _each_state_array(self, fn):
         st = self._optim._accumulators.get("__state__", {})
         for key, state in st.items():
             for name, arr in state.items():
                 if hasattr(arr, "shape"):
-                    state[name] = place_sharded(arr)
+                    state[name] = fn(arr)
+
+    def _place_states(self):
+        self._each_state_array(place_sharded)
         self._shard_states_placed = True
 
-    def step(self):
-        self._optim.step()
+    def _offload_states_to_host(self):
+        if self._host is not None:
+            self._each_state_array(
+                lambda a: jax.device_put(a, self._host))
+
+    def _stage_states_to_device(self):
+        # back onto the accelerator (sharded) for the update
+        self._each_state_array(place_sharded)
+
+    # -- optimizer protocol -------------------------------------------------
+    def run_step(self, inner_step):
+        """The stage/update/place/offload sequence around one inner
+        optimizer step — shared by step() and the GroupShardedStage3
+        offload monkeypatch so the two can't drift."""
+        if self.offload and self._shard_states_placed:
+            self._stage_states_to_device()
+        inner_step()
         if not self._shard_states_placed:
             self._place_states()
+        if self.offload:
+            self._offload_states_to_host()
+
+    def step(self):
+        self.run_step(self._optim.step)
 
     def clear_grad(self, *a, **k):
         self._optim.clear_grad(*a, **k)

@@ -20,10 +20,17 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
                                    sync_buffers=sync_buffers,
                                    buffer_max_size=buffer_max_size)
     else:
+        # ZeRO-3: params sharded by the wrapper; optimizer state sharded
+        # (and host-offloaded when asked) by the stage-2 optimizer wrapper —
+        # the caller keeps using the returned optimizer, so the offload
+        # path is live (not parked on an unused attribute).
+        optimizer = GroupShardedOptimizerStage2(params, optimizer,
+                                                group=group, offload=offload)
         model = GroupShardedStage3(model, optimizer, group=group,
                                    sync_buffers=sync_buffers,
                                    segment_size=segment_size,
-                                   sync_comm=sync_comm)
+                                   sync_comm=sync_comm, offload=offload,
+                                   exclude_layer=exclude_layer)
     if scaler is not None:
         scaler = GroupShardedScaler(scaler)
     return model, optimizer, scaler

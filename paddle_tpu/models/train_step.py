@@ -10,8 +10,19 @@ covering
            parts"; the host-driven 1F1B scheduler in meta_parallel covers the
            schedule-faithful path)
   - DP   : gradient psum over 'data' (+ 'sharding') axes
-  - ZeRO : optimizer state sharded over 'sharding'; each rank updates its
-           chunk and all-gathers updated params (stage-1/2 semantics)
+  - ZeRO : (ref: sharding/group_sharded_optimizer_stage2.py:53,
+           group_sharded_stage3.py:59) three stages, all inside the one
+           compiled program:
+             stage 1/2 — params replicated; grads reduce-SCATTERED to the
+               owning 'sharding' rank (lax.psum_scatter — true
+               reduce-to-owner, not allreduce+slice); adam moments sharded;
+               updated param shards all-gathered.
+             stage 3 — params STORED as flat per-rank chunks over
+               'sharding'; all-gathered on use per pipeline stage (inside
+               the layer scan, so with recompute only one stage's full
+               params are ever live); AD through the gather yields the
+               grad reduce-scatter automatically; the update runs on the
+               local chunk and nothing is re-gathered after it.
   - recompute : jax.checkpoint around each pipeline stage
 
 Decoder layers are stacked [L, ...] and sharded P('pipe') so every stage
@@ -50,12 +61,35 @@ def _named_params(layer):
     return list(layer.named_parameters())
 
 
+def _local_shape(gshape, spec, mesh):
+    """Per-device block shape of a global array under a PartitionSpec."""
+    loc = list(gshape)
+    for d, ax in enumerate(tuple(spec)):
+        if ax is None:
+            continue
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        for a in axes:
+            loc[d] //= mesh.shape[a]
+    return tuple(loc)
+
+
 class SpmdTrainer:
     """Builds and runs the one-program hybrid step for a CausalLM model."""
 
     def __init__(self, model, mesh, lr=1e-3, betas=(0.9, 0.95), eps=1e-8,
                  weight_decay=0.01, micro_batch_size=None, recompute=False,
-                 param_dtype=None):
+                 param_dtype=None, sharding_stage=2, pp_schedule="gpipe",
+                 virtual_pp_degree=1):
+        if sharding_stage not in (1, 2, 3):
+            raise ValueError(f"sharding_stage must be 1/2/3, got "
+                             f"{sharding_stage}")
+        if pp_schedule not in ("gpipe", "1f1b", "interleave"):
+            raise ValueError(f"pp_schedule must be gpipe/1f1b/interleave, "
+                             f"got {pp_schedule}")
+        if pp_schedule == "interleave" and virtual_pp_degree < 2:
+            raise ValueError("interleave needs virtual_pp_degree >= 2")
+        if pp_schedule in ("gpipe", "1f1b") and virtual_pp_degree != 1:
+            raise ValueError(f"{pp_schedule} uses virtual_pp_degree=1")
         self.model = model
         self.mesh = mesh
         self.lr = lr
@@ -64,20 +98,40 @@ class SpmdTrainer:
         self.wd = weight_decay
         self.recompute = recompute
         self.micro_batch_size = micro_batch_size
+        self.sharding_stage = sharding_stage
+        self.pp_schedule = pp_schedule
+        self.v_pp = virtual_pp_degree
 
         self.S_pipe = mesh.shape.get("pipe", 1)
         self.S_shard = mesh.shape.get("sharding", 1)
         self.batch_axes = tuple(a for a in ("data", "sharding")
                                 if a in mesh.axis_names)
+        self.data_axes = tuple(a for a in ("data",) if a in mesh.axis_names)
+        # mesh axes a stage-3 chunk varies over (model-sharded params differ
+        # per model rank; every sharding rank owns a distinct chunk)
+        self._chunk_axes = tuple(a for a in ("model", "sharding")
+                                 if a in mesh.axis_names)
 
         embed, decoders, tail, ce = _model_parts(model)
-        assert len(decoders) % self.S_pipe == 0, \
-            "num layers must divide pp degree"
+        assert len(decoders) % (self.S_pipe * self.v_pp) == 0, \
+            "num layers must divide pp degree x virtual_pp_degree"
         self.embed = embed
         self.decoders = decoders
         self.tail = tail
         self.template = decoders[0]
         self.n_layers = len(decoders)
+        self.per = self.n_layers // self.S_pipe       # layers per rank
+        self.per_v = self.per // self.v_pp            # layers per chunk
+        # Physical stacking order: P('pipe') splits dim0 contiguously, so
+        # rank r's block must hold ITS chunks back-to-back. phys position
+        # p = r*(v*per_v) + c*per_v + i  <->  logical layer
+        # (c*S + r)*per_v + i  (interleave assignment; identity when v=1).
+        self.phys_order = []
+        for rr in range(self.S_pipe):
+            for c in range(self.v_pp):
+                for i in range(self.per_v):
+                    self.phys_order.append((c * self.S_pipe + rr)
+                                           * self.per_v + i)
 
         # ---- parameter bookkeeping ----------------------------------------
         # "outer" params: embed + tail (replicated over pipe)
@@ -97,14 +151,108 @@ class SpmdTrainer:
         for _, p in _named_params(self.template):
             base = param_spec(p)
             self.stacked_specs.append(P("pipe", *base))
+
+        # stage-3 chunk geometry: per-device local block -> flat [chunk]
+        S = max(self.S_shard, 1)
+        self.outer_loc_shapes = [
+            _local_shape(tuple(p.shape), s, mesh)
+            for p, s in zip(self.outer_tensors, self.outer_specs)]
+        self.outer_loc_n = [int(np.prod(s)) for s in self.outer_loc_shapes]
+        self.outer_chunk = [(n + (-n) % S) // S for n in self.outer_loc_n]
+        self.layer_loc_shapes = [
+            _local_shape(tuple(p.shape), param_spec(p), mesh)
+            for p in self.layer_param_tensors]
+        self.layer_loc_n = [int(np.prod(s)) for s in self.layer_loc_shapes]
+        self.layer_chunk = [(n + (-n) % S) // S for n in self.layer_loc_n]
+
         if param_dtype is not None:
             self._pdt = jnp.dtype(param_dtype)
         else:
             self._pdt = None
         self._jitted = None
 
+    # ---- specs -------------------------------------------------------------
+    def _param_specs12(self):
+        return {"outer": list(self.outer_specs),
+                "stacked": list(self.stacked_specs)}
+
+    def _chunk_spec_outer(self):
+        return P(self._chunk_axes) if self._chunk_axes else P()
+
+    def _chunk_spec_stacked(self):
+        return (P("pipe", self._chunk_axes) if self._chunk_axes
+                else P("pipe"))
+
+    def _param_specs(self):
+        if self.sharding_stage == 3:
+            return {"outer": [self._chunk_spec_outer()
+                              for _ in self.outer_tensors],
+                    "stacked": [self._chunk_spec_stacked()
+                                for _ in self.layer_param_tensors]}
+        return self._param_specs12()
+
+    def _opt_specs(self):
+        if self.sharding_stage == 3:
+            return jax.tree_util.tree_map(
+                lambda s: {"m": s, "v": s},
+                self._param_specs(), is_leaf=lambda x: isinstance(x, P))
+        all_axes = P(tuple(self.mesh.axis_names))
+        return jax.tree_util.tree_map(
+            lambda s: {"m": all_axes, "v": all_axes},
+            self._param_specs12(), is_leaf=lambda x: isinstance(x, P))
+
+    def _state_specs(self):
+        return {"params": self._param_specs(), "opt": self._opt_specs(),
+                "step": P()}
+
+    # ---- stage-3 chunk <-> block conversion (runs inside shard_map) --------
+    def _chunkify_outer(self, p_loc, i):
+        S = self.S_shard
+        n = self.outer_loc_n[i]
+        chunk = self.outer_chunk[i]
+        flat = p_loc.reshape(-1)
+        pad = S * chunk - n
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros(pad, flat.dtype)])
+        if S > 1:
+            r = lax.axis_index("sharding")
+            return lax.dynamic_slice_in_dim(flat, r * chunk, chunk)
+        return flat
+
+    def _chunkify_stacked(self, p_loc, i):
+        S = self.S_shard
+        n = self.layer_loc_n[i]
+        chunk = self.layer_chunk[i]
+        per = p_loc.shape[0]
+        flat = p_loc.reshape(per, -1)
+        pad = S * chunk - n
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((per, pad), flat.dtype)], axis=1)
+        if S > 1:
+            r = lax.axis_index("sharding")
+            return lax.dynamic_slice_in_dim(flat, r * chunk, chunk, axis=1)
+        return flat
+
+    def _ungather_outer(self, chunk, i):
+        n = self.outer_loc_n[i]
+        if self.S_shard > 1:
+            flat = lax.all_gather(chunk, "sharding", axis=0, tiled=True)
+        else:
+            flat = chunk
+        return flat[:n].reshape(self.outer_loc_shapes[i])
+
+    def _ungather_layer(self, chunk, i):
+        """chunk: [chunk_i] for ONE layer -> local block."""
+        n = self.layer_loc_n[i]
+        if self.S_shard > 1:
+            flat = lax.all_gather(chunk, "sharding", axis=0, tiled=True)
+        else:
+            flat = chunk
+        return flat[:n].reshape(self.layer_loc_shapes[i])
+
     # ---- state ------------------------------------------------------------
-    def init_state(self):
+    def _init_params12(self):
         cast = (lambda a: a.astype(self._pdt)
                 if self._pdt is not None and jnp.issubdtype(a.dtype, jnp.floating)
                 else a)
@@ -112,19 +260,44 @@ class SpmdTrainer:
         stacked = []
         for pi, name in enumerate(self.layer_param_names):
             arrs = []
-            for layer in self.decoders:
-                arrs.append(cast(dict(_named_params(layer))[name].data))
+            for li in self.phys_order:  # physical (chunk-major) order
+                arrs.append(cast(
+                    dict(_named_params(self.decoders[li]))[name].data))
             stacked.append(jnp.stack(arrs, axis=0))  # [L, ...]
         params = {"outer": outer, "stacked": stacked}
-        params = jax.tree_util.tree_map(
+        return jax.tree_util.tree_map(
             lambda a, s: jax.device_put(a, NamedSharding(self.mesh, s)),
-            params, self._param_specs())
+            params, self._param_specs12())
 
-        # AdamW moments created INSIDE the SPMD region so chunk sizes follow
-        # the LOCAL (model/pipe-sharded) param shapes; flat dim then chunks
-        # over 'sharding' (ZeRO).
+    def init_state(self):
+        params12 = self._init_params12()
         S = self.S_shard
 
+        if self.sharding_stage == 3:
+            def to_chunks(p12):
+                outer = [self._chunkify_outer(p, i)
+                         for i, p in enumerate(p12["outer"])]
+                stacked = [self._chunkify_stacked(p, i)
+                           for i, p in enumerate(p12["stacked"])]
+                opt = jax.tree_util.tree_map(
+                    lambda a: {"m": jnp.zeros(a.shape, jnp.float32),
+                               "v": jnp.zeros(a.shape, jnp.float32)},
+                    {"outer": outer, "stacked": stacked},
+                    is_leaf=lambda x: hasattr(x, "shape"))
+                return {"outer": outer, "stacked": stacked}, opt
+
+            smapped = shard_map(to_chunks, mesh=self.mesh,
+                                in_specs=(self._param_specs12(),),
+                                out_specs=(self._param_specs(),
+                                           self._opt_specs()),
+                                check_vma=False)
+            params, opt = jax.jit(smapped)(params12)
+            return {"params": params, "opt": opt,
+                    "step": jnp.zeros((), jnp.int32)}
+
+        # stage 1/2: AdamW moments created INSIDE the SPMD region so chunk
+        # sizes follow the LOCAL (model/pipe-sharded) param shapes; flat dim
+        # then chunks over 'sharding' (ZeRO).
         def init_fn(p):
             def zstate(a):
                 n = int(np.prod(a.shape))
@@ -136,61 +309,69 @@ class SpmdTrainer:
                                           is_leaf=lambda x: hasattr(x, "shape"))
 
         smapped = shard_map(init_fn, mesh=self.mesh,
-                            in_specs=(self._param_specs(),),
+                            in_specs=(self._param_specs12(),),
                             out_specs=self._opt_specs(), check_vma=False)
-        opt = jax.jit(smapped)(params)
-        return {"params": params, "opt": opt,
+        opt = jax.jit(smapped)(params12)
+        return {"params": params12, "opt": opt,
                 "step": jnp.zeros((), jnp.int32)}
-
-    def _param_specs(self):
-        return {"outer": list(self.outer_specs),
-                "stacked": list(self.stacked_specs)}
-
-    def _opt_specs(self):
-        all_axes = P(tuple(self.mesh.axis_names))
-        return jax.tree_util.tree_map(
-            lambda s: {"m": all_axes, "v": all_axes},
-            self._param_specs(), is_leaf=lambda x: isinstance(x, P))
-
-    def _state_specs(self):
-        return {"params": self._param_specs(), "opt": self._opt_specs(),
-                "step": P()}
 
     # ---- the step ---------------------------------------------------------
     def _build(self, ids_shape):
         mesh = self.mesh
         axis_names = tuple(mesh.axis_names)
         S = self.S_pipe
-        per = self.n_layers // S
+        per = self.per
         outer_tensors = self.outer_tensors
         layer_tensors = self.layer_param_tensors
         embed, tail, template = self.embed, self.tail, self.template
         recompute = self.recompute
         batch_axes = self.batch_axes
+        data_axes = self.data_axes
         mb = self.micro_batch_size
         b1, b2, eps, wd = self.b1, self.b2, self.eps, self.wd
         S_shard = self.S_shard
+        stage3 = self.sharding_stage == 3
+
+        def materialize_outer(outer):
+            if not stage3:
+                return outer
+            return [self._ungather_outer(c, i) for i, c in enumerate(outer)]
 
         def apply_embed(outer, ids):
-            with _Swap(outer_tensors, outer), tape.no_grad():
+            with _Swap(outer_tensors, materialize_outer(outer)), \
+                    tape.no_grad():
                 return embed(Tensor(ids)).data
 
         def apply_tail_loss(outer, h, labels):
-            with _Swap(outer_tensors, outer), tape.no_grad():
+            with _Swap(outer_tensors, materialize_outer(outer)), \
+                    tape.no_grad():
                 out = h
                 for l in tail[:-1]:
                     out = l(Tensor(out) if not isinstance(out, Tensor) else out)
                 logits = tail[-1](out)
-                from ..distributed.fleet.meta_parallel.parallel_layers import \
-                    mp_ops
                 _, _, _, ce = _model_parts(self.model)
                 loss = ce(logits, Tensor(labels))
                 return jnp.mean(loss.data)
 
+        if recompute or stage3:
+            # stage 3 always remats the outer gathers so the full embedding
+            # table is never saved for backward — only its chunks are.
+            apply_embed = jax.checkpoint(apply_embed)
+            apply_tail_loss = jax.checkpoint(apply_tail_loss)
+
         def apply_stage(stacked_local, h):
-            """Run this rank's `per` decoder layers over h."""
+            """Run this rank's `per` decoder layers over h.
+
+            stage 1/2: stacked_local[i] = [per, *block] full local blocks.
+            stage 3  : stacked_local[i] = [per, chunk_i]; each scan tick
+            all-gathers ONE layer's params (gather-on-use; released after
+            the tick — with recompute the backward regathers instead of
+            keeping them)."""
 
             def body(carry, layer_params):
+                if stage3:
+                    layer_params = [self._ungather_layer(c, i)
+                                    for i, c in enumerate(layer_params)]
                 with _Swap(layer_tensors, list(layer_params)), tape.no_grad():
                     out = template(Tensor(carry)).data
                 return out, None
@@ -202,7 +383,7 @@ class SpmdTrainer:
 
         def loss_fn(params, ids, labels, key):
             outer = params["outer"]
-            stacked = params["stacked"]  # local: [per, ...]
+            stacked = params["stacked"]  # local: [per, ...] or [per, chunk]
             with spmd_axes(axis_names), frnd.key_scope(key):
                 emb = apply_embed(outer, ids)  # [B_loc, T, H]
                 if S == 1:
@@ -242,7 +423,11 @@ class SpmdTrainer:
                     loss = lax.pmean(loss, ax)
                 return loss
 
-        def adamw_update(p, g, st, step, lr):
+        def adamw_update12(p, g, st, step, lr):
+            """stage 1/2: p is the full local block; g is psum'd over 'data'
+            but still PARTIAL over 'sharding' — reduce-scatter completes the
+            sum while handing each rank exactly its owned chunk
+            (ref: group_sharded_stage2.py grad reduce-to-owner hooks)."""
             shape = p.shape
             n = int(np.prod(shape))
             pad = (-n) % S_shard
@@ -254,8 +439,9 @@ class SpmdTrainer:
                 pf = jnp.concatenate([pf, jnp.zeros(pad, jnp.float32)])
             if S_shard > 1:
                 chunk = gf.shape[0] // S_shard
+                gl = lax.psum_scatter(gf, "sharding", scatter_dimension=0,
+                                      tiled=True)
                 r = lax.axis_index("sharding")
-                gl = lax.dynamic_slice_in_dim(gf, r * chunk, chunk)
                 pl = lax.dynamic_slice_in_dim(pf, r * chunk, chunk)
             else:
                 gl, pl = gf, pf
@@ -273,16 +459,92 @@ class SpmdTrainer:
                 pf = pf[:n]
             return pf.reshape(shape).astype(p.dtype), {"m": m, "v": v}
 
+        def adamw_update3(p, g, st, step, lr):
+            """stage 3: p IS the owned chunk; g arrived reduce-scattered by
+            the AD transpose of the gather-on-use all_gather. Elementwise
+            update, nothing re-gathered (ref: group_sharded_stage3.py:486)."""
+            gf = g.astype(jnp.float32)
+            m = b1 * st["m"] + (1 - b1) * gf
+            v = b2 * st["v"] + (1 - b2) * gf * gf
+            t = step.astype(jnp.float32)
+            mhat = m / (1 - b1 ** t)
+            vhat = v / (1 - b2 ** t)
+            pf = (p.astype(jnp.float32) * (1 - lr * wd)
+                  - lr * mhat / (jnp.sqrt(vhat) + eps))
+            return pf.astype(p.dtype), {"m": m, "v": v}
+
+        adamw_update = adamw_update3 if stage3 else adamw_update12
+
+        # ---- 1F1B / interleaved schedule (hand-rolled bwd) ----------------
+        use_1f1b = S > 1 and self.pp_schedule in ("1f1b", "interleave")
+        if use_1f1b:
+            from .pipeline_1f1b import build_1f1b_loss_and_grads
+            v = self.v_pp
+            per_v = self.per_v
+            n_batch = 1
+            for ax in batch_axes:
+                n_batch *= mesh.shape[ax]
+
+            def stage_fwd(chunk_list, h):
+                def body(carry, layer_params):
+                    if stage3:
+                        layer_params = [self._ungather_layer(c, i)
+                                        for i, c in enumerate(layer_params)]
+                    with _Swap(layer_tensors, list(layer_params)), \
+                            tape.no_grad():
+                        out = template(Tensor(carry)).data
+                    return out, None
+                if recompute:
+                    body = jax.checkpoint(body)
+                h, _ = lax.scan(body, h, chunk_list)
+                return h
+
+            def embed_fwd_1f1b(outer_p, ids_mb):
+                return apply_embed(outer_p, ids_mb)
+
+            def tail_loss_1f1b(outer_p, h, labels_mb):
+                # f32 scalar: the schedule seeds its vjp with an f32
+                # cotangent and accumulates losses in f32
+                return apply_tail_loss(outer_p, h, labels_mb).astype(
+                    jnp.float32)
+
+            def loss_and_grads(params, ids, labels, key):
+                B_loc, T = ids.shape
+                m = mb or B_loc
+                M = B_loc // m
+                # logical hidden width = embedding table's last dim
+                H = int(self.outer_tensors[0].shape[-1])
+                run = build_1f1b_loss_and_grads(
+                    S=S, v=v, per_v=per_v, stage_fwd=stage_fwd,
+                    embed_fwd=embed_fwd_1f1b, tail_loss=tail_loss_1f1b,
+                    n_micro=M, micro_bs=m, seq=T, hidden=H,
+                    h_dtype=self._pdt or jnp.float32)
+                ids_m = ids.reshape(M, m, T)
+                lab_m = labels.reshape(M, m, T)
+                inv = jnp.asarray(1.0 / (M * n_batch), jnp.float32)
+                with spmd_axes(axis_names), frnd.key_scope(key):
+                    loss, grads = run(params, ids_m, lab_m, inv)
+                for ax in batch_axes:
+                    loss = lax.pmean(loss, ax)
+                return loss, grads
+        else:
+            def loss_and_grads(params, ids, labels, key):
+                return jax.value_and_grad(loss_fn)(params, ids, labels, key)
+
         def step_fn(state, ids, labels, key, lr):
             params = state["params"]
             step = state["step"] + 1
-            loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels, key)
-            # replicated-param grads: sum over batch axes (mean: loss is
-            # already pmean'd so AD emits 1/N-scaled partials -> psum)
+            loss, grads = loss_and_grads(params, ids, labels, key)
+            # grads partial over 'data' replicas: sum them (mean: loss is
+            # already pmean'd so AD emits 1/N-scaled partials -> psum).
+            # 'sharding'-axis completion happens in the update:
+            # psum_scatter (stage 1/2) or the AD-inserted reduce-scatter of
+            # the gather-on-use (stage 3).
             def reduce_grad(g):
-                for ax in batch_axes:
+                for ax in data_axes:
                     g = lax.psum(g, ax)
                 return g
+
             grads = jax.tree_util.tree_map(reduce_grad, grads)
             # pipe-replicated outer params: sum partials across stages
             if S > 1:
@@ -321,13 +583,64 @@ class SpmdTrainer:
         state, loss = self._jitted(state, ids, labels, key, lr)
         return state, loss
 
+    # ---- observability -----------------------------------------------------
+    def memory_analysis(self, state, ids, labels):
+        """Compile-time per-device memory accounting of the step program
+        (argument/output/temp/code bytes). The TPU answer to the reference's
+        allocator stats (ref: fluid/memory/stats.cc) for the compiled path:
+        ZeRO stage claims are judged against these numbers, not placement
+        metadata."""
+        ids = ids.data if isinstance(ids, Tensor) else jnp.asarray(ids)
+        labels = (labels.data if isinstance(labels, Tensor)
+                  else jnp.asarray(labels))
+        if self._jitted is None:
+            self._jitted = self._build(tuple(np.shape(ids)))
+        key = jax.random.key(0)
+        lr = jnp.asarray(self.lr, jnp.float32)
+        compiled = self._jitted.lower(state, ids, labels, key, lr).compile()
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return None
+        return {
+            "argument_size_in_bytes": ma.argument_size_in_bytes,
+            "output_size_in_bytes": ma.output_size_in_bytes,
+            "temp_size_in_bytes": ma.temp_size_in_bytes,
+            "alias_size_in_bytes": ma.alias_size_in_bytes,
+            "generated_code_size_in_bytes": ma.generated_code_size_in_bytes,
+        }
+
     # ---- checkpoint bridge -------------------------------------------------
+    def gather_params(self, state):
+        """Return params in the logical (stage-1/2) layout regardless of
+        sharding_stage (ref: group_sharded_stage3.py:617
+        get_all_parameters)."""
+        if self.sharding_stage != 3:
+            return state["params"]
+
+        def gather_fn(chunks):
+            outer = [self._ungather_outer(c, i)
+                     for i, c in enumerate(chunks["outer"])]
+            stacked = []
+            for i, c in enumerate(chunks["stacked"]):  # [per, chunk]
+                blocks = jnp.stack([self._ungather_layer(c[j], i)
+                                    for j in range(c.shape[0])])
+                stacked.append(blocks)
+            return {"outer": outer, "stacked": stacked}
+
+        smapped = shard_map(gather_fn, mesh=self.mesh,
+                            in_specs=(self._param_specs(),),
+                            out_specs=self._param_specs12(),
+                            check_vma=False)
+        return jax.jit(smapped)(state["params"])
+
     def sync_to_model(self, state):
         """Write compiled-state params back into the eager model."""
-        outer = state["params"]["outer"]
+        params12 = self.gather_params(state)
+        outer = params12["outer"]
         for p, a in zip(self.outer_tensors, outer):
             p.data = a
-        stacked = state["params"]["stacked"]
+        stacked = params12["stacked"]
         for pi, name in enumerate(self.layer_param_names):
-            for li, layer in enumerate(self.decoders):
-                dict(_named_params(layer))[name].data = stacked[pi][li]
+            for phys, li in enumerate(self.phys_order):
+                dict(_named_params(self.decoders[li]))[name].data = \
+                    stacked[pi][phys]

@@ -1,0 +1,123 @@
+"""Single-program 1F1B + interleave schedule tests (VERDICT round-1 #3):
+- loss-trajectory parity with the GPipe path (same params, same data),
+- interleave (virtual stages) actually runs and matches too,
+- 1F1B's activation memory stays bounded as microbatch count grows,
+  while GPipe's grows linearly (compiled temp-bytes assertion).
+"""
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.train_step import SpmdTrainer
+from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
+
+
+def make_batch(rng, bs, seq, vocab):
+    ids = rng.randint(0, vocab, (bs, seq)).astype(np.int64)
+    labels = np.roll(ids, -1, axis=1)
+    return ids, labels
+
+
+def build_model(mesh, n_layers=4):
+    set_global_mesh(mesh)
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": mesh.shape.get("data", 1),
+        "mp_degree": mesh.shape.get("model", 1),
+        "pp_degree": mesh.shape.get("pipe", 1),
+        "sharding_degree": mesh.shape.get("sharding", 1)}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(11)
+    cfg = LlamaConfig.tiny()
+    cfg.num_hidden_layers = n_layers
+    return LlamaForCausalLM(cfg), cfg
+
+
+PP2 = {"data": 1, "pipe": 2, "sharding": 1, "model": 1}
+
+
+def run_losses(schedule, v=1, steps=4, mbs=2, axes=PP2, n_layers=4,
+               recompute=False):
+    mesh = build_mesh(axes)
+    model, cfg = build_model(mesh, n_layers)
+    trainer = SpmdTrainer(model, mesh, lr=1e-2, micro_batch_size=mbs,
+                          pp_schedule=schedule, virtual_pp_degree=v,
+                          recompute=recompute)
+    state = trainer.init_state()
+    rng = np.random.RandomState(0)
+    ids, labels = make_batch(rng, 8, 16, cfg.vocab_size)
+    losses = []
+    key = jax.random.key(7)
+    for i in range(steps):
+        state, loss = trainer.step(state, ids, labels,
+                                   key=jax.random.fold_in(key, i))
+        losses.append(float(loss))
+    return losses
+
+
+class TestOneFOneB:
+    def test_1f1b_matches_gpipe(self):
+        lg = run_losses("gpipe")
+        l1 = run_losses("1f1b")
+        assert all(np.isfinite(l1)), l1
+        np.testing.assert_allclose(l1, lg, rtol=2e-4, atol=2e-5)
+        assert l1[-1] < l1[0]
+
+    def test_interleave_matches_gpipe(self):
+        lg = run_losses("gpipe")
+        li = run_losses("interleave", v=2)
+        assert all(np.isfinite(li)), li
+        np.testing.assert_allclose(li, lg, rtol=2e-4, atol=2e-5)
+
+    def test_1f1b_with_recompute(self):
+        l1 = run_losses("1f1b", recompute=True)
+        lg = run_losses("gpipe", recompute=True)
+        np.testing.assert_allclose(l1, lg, rtol=2e-4, atol=2e-5)
+
+    def test_1f1b_memory_bounded_in_microbatches(self):
+        """GPipe-in-scan stores O(M) activations for backward; 1F1B's
+        hand-rolled backward keeps a constant-depth buffer. Compare the
+        compiled step's temp bytes at M=2 vs M=8: 1F1B's growth must be a
+        small fraction of GPipe's."""
+        mesh = build_mesh(PP2)
+        rng = np.random.RandomState(0)
+
+        def temp_bytes(schedule, bs):
+            model, cfg = build_model(build_mesh(PP2))
+            trainer = SpmdTrainer(model, build_mesh(PP2), lr=1e-2,
+                                  micro_batch_size=2, pp_schedule=schedule)
+            state = trainer.init_state()
+            ids, labels = make_batch(rng, bs, 16, cfg.vocab_size)
+            ma = trainer.memory_analysis(state, ids, labels)
+            if ma is None:
+                pytest.skip("memory_analysis unavailable")
+            return ma["temp_size_in_bytes"]
+
+        growth = {}
+        for sched in ("gpipe", "1f1b"):
+            small = temp_bytes(sched, 4)    # M=2 microbatches
+            big = temp_bytes(sched, 16)     # M=8 microbatches
+            growth[sched] = big - small
+        # 1F1B's temp growth should be well under GPipe's (it only adds
+        # input buffers for the larger batch, not per-microbatch residuals)
+        assert growth["1f1b"] < 0.6 * growth["gpipe"], growth
+
+
+class TestOneFOneBBf16:
+    def test_1f1b_bf16_params(self):
+        """bf16 param_dtype (the realistic TPU config): the cotangent ring
+        carry must stay dtype-stable across scan ticks (code-review
+        round-2 finding)."""
+        mesh = build_mesh(PP2)
+        model, cfg = build_model(mesh, 4)
+        trainer = SpmdTrainer(model, mesh, lr=1e-2, micro_batch_size=2,
+                              pp_schedule="1f1b", param_dtype="bfloat16")
+        state = trainer.init_state()
+        rng = np.random.RandomState(0)
+        ids, labels = make_batch(rng, 8, 16, cfg.vocab_size)
+        for _ in range(2):
+            state, loss = trainer.step(state, ids, labels)
+        assert np.isfinite(float(loss))

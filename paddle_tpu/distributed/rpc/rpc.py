@@ -51,9 +51,26 @@ class _RpcAgent:
         self.store = store
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._server.bind(("127.0.0.1", 0))
+        # Single-host jobs stay on loopback (the rpc protocol is pickle —
+        # trusting by design, like the reference's brpc agent — so never
+        # expose it wider than the job needs). Multi-host jobs bind the
+        # launcher-provided interface (PADDLE_RPC_BIND_IP, default
+        # all-interfaces) and advertise a routable address.
+        multi_host = world_size > 1
+        bind_ip = os.getenv("PADDLE_RPC_BIND_IP",
+                            "0.0.0.0" if multi_host else "127.0.0.1")
+        self._server.bind((bind_ip, 0))
         self._server.listen(128)
-        self.ip, self.port = self._server.getsockname()
+        _, self.port = self._server.getsockname()
+        self.ip = os.getenv("PADDLE_LOCAL_IP")
+        if not self.ip:
+            if multi_host:
+                try:
+                    self.ip = socket.gethostbyname(socket.gethostname())
+                except OSError:
+                    self.ip = "127.0.0.1"
+            else:
+                self.ip = "127.0.0.1"
         self._stop = threading.Event()
         # outgoing async calls only; server connections each get a dedicated
         # thread (a handler loops for the connection's lifetime, so a bounded

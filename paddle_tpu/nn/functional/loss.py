@@ -437,7 +437,15 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
     """ref: functional/loss.py rnnt_loss (warprnnt binding) — RNN-Transducer
     loss via a log-domain forward DP compiled as nested lax.scan:
     alpha[t,u] = logaddexp(alpha[t-1,u] + blank(t-1,u),
-                           alpha[t,u-1] + y(t,u-1))."""
+                           alpha[t,u-1] + y(t,u-1)).
+
+    FastEmit (fastemit_lambda > 0) follows warprnnt's regularization: the
+    label-emission gradient is scaled by (1 + lambda) while blank gradients
+    stay unscaled. Implemented with a value-neutral autodiff identity —
+    the DP is evaluated once more with blank log-probs detached, adding
+    lambda * (ll_labelgrad - stop_grad(ll_labelgrad)) to the
+    log-likelihood: zero at the value level, exactly the FastEmit gradient
+    scaling under AD."""
 
     def fn(acts, labels, T_len, U_len):
         # acts: [B, T, U+1, V] log-probs or logits
@@ -450,7 +458,15 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
             lab_lp = jnp.take_along_axis(
                 b_logp[:, :-1, :], b_labels[None, :, None], axis=2
             )[:, :, 0]                                          # [T, U]
+            ll = _rnnt_ll(lab_lp, blank_lp, t_len, u_len, T, U1, NEG)
+            if fastemit_lambda and fastemit_lambda > 0.0:
+                ll_fe = _rnnt_ll(lab_lp, jax.lax.stop_gradient(blank_lp),
+                                 t_len, u_len, T, U1, NEG)
+                ll = ll + fastemit_lambda * (ll_fe
+                                             - jax.lax.stop_gradient(ll_fe))
+            return -ll
 
+        def _rnnt_ll(lab_lp, blank_lp, t_len, u_len, T, U1, NEG):
             def row(alpha_prev, t):
                 # alpha_prev: [U+1] = alpha[t-1, :]
                 def cell(carry, u):
@@ -467,11 +483,10 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
                 _, alpha_t = jax.lax.scan(cell, NEG, jnp.arange(U1))
                 return alpha_t, alpha_t
 
-            _, alphas = jax.lax.scan(row, jnp.full((U1,), NEG, logp.dtype),
+            _, alphas = jax.lax.scan(row, jnp.full((U1,), NEG, lab_lp.dtype),
                                      jnp.arange(T))
             # ll = alpha[T-1, U] + blank(T-1, U)
-            final = alphas[t_len - 1, u_len] + blank_lp[t_len - 1, u_len]
-            return -final
+            return alphas[t_len - 1, u_len] + blank_lp[t_len - 1, u_len]
 
         losses = jax.vmap(one)(logp, labels, T_len, U_len)
         return _reduce(losses, reduction)

@@ -31,6 +31,23 @@ def _pads(padding, n):
     return [(p[0], p[0])] * n
 
 
+def _ceil_extra_pads(spatial, ks, st, pads):
+    """Right-padding growth so reduce_window emits ceil-mode output sizes.
+    Follows the torch/paddle rule: the last window must still start inside
+    the (left-padded) input."""
+    out = []
+    for i in range(nd_ := len(ks)):
+        size = spatial[i] + pads[i][0] + pads[i][1]
+        floor_out = (size - ks[i]) // st[i] + 1
+        ceil_out = -((size - ks[i]) // -st[i]) + 1
+        if ceil_out > floor_out and \
+                (ceil_out - 1) * st[i] >= spatial[i] + pads[i][0]:
+            ceil_out -= 1
+        extra = max(0, (ceil_out - 1) * st[i] + ks[i] - size)
+        out.append((pads[i][0], pads[i][1] + extra))
+    return out
+
+
 def _pool(x, ksize, stride, padding, nd, reducer, init, data_format,
           ceil_mode=False, exclusive=True, count_include_pad=False):
     ks = _tuple(ksize, nd)
@@ -43,6 +60,10 @@ def _pool(x, ksize, stride, padding, nd, reducer, init, data_format,
         window = (1, 1) + ks
         strides = (1, 1) + st
     pads = _pads(padding, nd)
+    if ceil_mode and not isinstance(pads, str):
+        spatial = (tuple(x.shape[1:1 + nd]) if channel_last
+                   else tuple(x.shape[2:2 + nd]))
+        pads = _ceil_extra_pads(spatial, ks, st, pads)
     if isinstance(pads, str):
         pad_all = pads
     else:
@@ -66,24 +87,32 @@ def _pool(x, ksize, stride, padding, nd, reducer, init, data_format,
     return apply(fn, x, name=f"{reducer}_pool{nd}d")
 
 
-def _max_pool_with_index(x, ksize, stride, padding, nd):
+def _max_pool_with_index(x, ksize, stride, padding, nd, ceil_mode=False,
+                         data_format=None):
     """Max pool + argmax indices (flattened over the UN-padded spatial dims),
     the contract max_unpool needs (ref: functional/pooling.py return_mask).
     Windows are unrolled at trace time (prod(ks) slices) — each output is a
-    max/argmax over ks strided views, which XLA fuses."""
+    max/argmax over ks strided views, which XLA fuses. Channels-last inputs
+    are transposed to channels-first and back; ceil_mode extends the right
+    padding the way _pool does."""
     import itertools
     ks = _tuple(ksize, nd)
     st = _tuple(stride if stride is not None else ksize, nd)
     pads = _pads(padding, nd)
     if isinstance(pads, str):
         raise ValueError("string padding not supported with return_mask")
+    channel_last = data_format in ("NHWC", "NLC", "NWC", "NDHWC")
 
     def fn(a):
+        if channel_last:  # -> channels-first
+            a = jnp.moveaxis(a, -1, 1)
         spatial = a.shape[-nd:]
-        out_sp = tuple((spatial[i] + pads[i][0] + pads[i][1] - ks[i]) // st[i]
-                       + 1 for i in range(nd))
+        local_pads = (_ceil_extra_pads(spatial, ks, st, pads) if ceil_mode
+                      else pads)
+        out_sp = tuple((spatial[i] + local_pads[i][0] + local_pads[i][1]
+                        - ks[i]) // st[i] + 1 for i in range(nd))
         neg = jnp.asarray(-jnp.inf, a.dtype)
-        ap = jnp.pad(a, [(0, 0)] * (a.ndim - nd) + list(pads),
+        ap = jnp.pad(a, [(0, 0)] * (a.ndim - nd) + list(local_pads),
                      constant_values=neg)
         vals, idxs = [], []
         for offs in itertools.product(*[range(k) for k in ks]):
@@ -94,7 +123,8 @@ def _max_pool_with_index(x, ksize, stride, padding, nd):
             # un-padded coordinate of this window element per output position
             coord = None
             for i in range(nd):
-                ci = (jnp.arange(out_sp[i]) * st[i] + offs[i] - pads[i][0])
+                ci = (jnp.arange(out_sp[i]) * st[i] + offs[i]
+                      - local_pads[i][0])
                 shape = [1] * nd
                 shape[i] = out_sp[i]
                 ci = ci.reshape(shape)
@@ -105,6 +135,9 @@ def _max_pool_with_index(x, ksize, stride, padding, nd):
         which = jnp.argmax(stacked, axis=0)
         best = jnp.max(stacked, axis=0)
         flat = jnp.take_along_axis(jnp.stack(idxs), which[None], axis=0)[0]
+        if channel_last:  # back to the caller's layout
+            best = jnp.moveaxis(best, 1, -1)
+            flat = jnp.moveaxis(flat, 1, -1)
         return best, flat.astype(jnp.int32)
 
     return apply(fn, x, n_outputs=2, name=f"max_pool{nd}d_with_index")
@@ -113,7 +146,9 @@ def _max_pool_with_index(x, ksize, stride, padding, nd):
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     if return_mask:
-        return _max_pool_with_index(_t(x), kernel_size, stride, padding, 1)
+        return _max_pool_with_index(_t(x), kernel_size, stride, padding, 1,
+                                    ceil_mode=ceil_mode,
+                                    data_format=data_format)
     return _pool(_t(x), kernel_size, stride, padding, 1, "max", -jnp.inf,
                  data_format, ceil_mode)
 
@@ -121,7 +156,9 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
     if return_mask:
-        return _max_pool_with_index(_t(x), kernel_size, stride, padding, 2)
+        return _max_pool_with_index(_t(x), kernel_size, stride, padding, 2,
+                                    ceil_mode=ceil_mode,
+                                    data_format=data_format)
     return _pool(_t(x), kernel_size, stride, padding, 2, "max", -jnp.inf,
                  data_format, ceil_mode)
 
@@ -129,7 +166,9 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     if return_mask:
-        return _max_pool_with_index(_t(x), kernel_size, stride, padding, 3)
+        return _max_pool_with_index(_t(x), kernel_size, stride, padding, 3,
+                                    ceil_mode=ceil_mode,
+                                    data_format=data_format)
     return _pool(_t(x), kernel_size, stride, padding, 3, "max", -jnp.inf,
                  data_format, ceil_mode)
 

@@ -321,7 +321,31 @@ class BeamSearchDecoder:
         token_idx = top % v
         new_finished = (np.take_along_axis(finished, beam_idx, axis=1)
                         | (token_idx == self.end_token))
+        # Reorder cell states by the surviving beams' parent indices so each
+        # pruned beam carries ITS OWN history (ref: nn/decode.py:545-547
+        # gathers next_cell_states by beam_indices). Without this, beams
+        # silently continue from another beam's state after every prune.
+        new_states = self._gather_states(new_states, beam_idx, b, k)
         return (token_idx, new_logp, new_finished, beam_idx, new_states)
+
+    def _gather_states(self, states, beam_idx, b, k):
+        """Gather each [B*K, ...] state leaf along the beam axis."""
+        idx = jnp.asarray(beam_idx)  # [B, K] parent beam per new beam
+
+        def gather(leaf):
+            arr = leaf.data if isinstance(leaf, Tensor) else leaf
+            if not hasattr(arr, "shape") or arr.ndim == 0 \
+                    or arr.shape[0] != b * k:
+                return leaf
+            shaped = arr.reshape(b, k, *arr.shape[1:])
+            ix = idx.reshape(b, k, *([1] * (arr.ndim - 1)))
+            out = jnp.take_along_axis(shaped, ix, axis=1)
+            out = out.reshape(b * k, *arr.shape[1:])
+            return Tensor(out) if isinstance(leaf, Tensor) else out
+
+        return jax.tree_util.tree_map(
+            gather, states,
+            is_leaf=lambda x: isinstance(x, Tensor) or hasattr(x, "shape"))
 
 
 def dynamic_decode(decoder, inits=None, max_step_num=None, batch_size=None,

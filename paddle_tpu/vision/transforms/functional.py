@@ -163,9 +163,14 @@ def _hsv_to_rgb(hsv):
                               axis=0)[0]
 
 
-def _warp(img, inv_matrix, out_hw=None, fill=0):
-    """Inverse-warp with bilinear sampling; inv_matrix maps OUTPUT (x, y, 1)
-    homogeneous coords to INPUT coords (3x3)."""
+def _warp(img, inv_matrix, out_hw=None, fill=0, interpolation="nearest"):
+    """Inverse-warp; inv_matrix maps OUTPUT (x, y, 1) homogeneous coords to
+    INPUT coords (3x3). interpolation: 'nearest' (the reference default for
+    affine/rotate/perspective) or 'bilinear'."""
+    if interpolation not in ("nearest", "bilinear"):
+        raise ValueError(
+            f"unsupported interpolation {interpolation!r}; use 'nearest' or "
+            f"'bilinear'")
     a = img.astype(np.float32)
     h, w = a.shape[:2]
     oh, ow = out_hw or (h, w)
@@ -175,10 +180,6 @@ def _warp(img, inv_matrix, out_hw=None, fill=0):
     src = coords @ np.asarray(inv_matrix, np.float32).T
     sx = src[..., 0] / np.maximum(src[..., 2], 1e-12)
     sy = src[..., 1] / np.maximum(src[..., 2], 1e-12)
-    x0 = np.floor(sx).astype(np.int64)
-    y0 = np.floor(sy).astype(np.int64)
-    wx = sx - x0
-    wy = sy - y0
 
     def at(yy, xx):
         valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
@@ -191,6 +192,15 @@ def _warp(img, inv_matrix, out_hw=None, fill=0):
             vals = np.where(valid, vals, np.float32(fill))
         return vals, valid
 
+    if interpolation == "nearest":
+        out, _ = at(np.round(sy).astype(np.int64),
+                    np.round(sx).astype(np.int64))
+        return out
+
+    x0 = np.floor(sx).astype(np.int64)
+    y0 = np.floor(sy).astype(np.int64)
+    wx = sx - x0
+    wy = sy - y0
     v00, _ = at(y0, x0)
     v01, _ = at(y0, x0 + 1)
     v10, _ = at(y1 := y0 + 1, x0)
@@ -232,7 +242,7 @@ def affine(img, angle, translate, scale, shear, interpolation="nearest",
     h, w = a.shape[:2]
     center = center or ((w - 1) / 2.0, (h - 1) / 2.0)
     inv = _affine_inv_matrix(angle, translate, scale, shear, center)
-    out = _warp(a, inv, fill=fill)
+    out = _warp(a, inv, fill=fill, interpolation=interpolation)
     if dt == np.uint8:
         out = np.clip(out.round(), 0, 255)
     return _wrap(out.astype(dt), t)
@@ -255,7 +265,7 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
                                  0.0, center)
     else:
         inv = _affine_inv_matrix(angle, (0, 0), 1.0, 0.0, center)
-    out = _warp(a, inv, out_hw=out_hw, fill=fill)
+    out = _warp(a, inv, out_hw=out_hw, fill=fill, interpolation=interpolation)
     if dt == np.uint8:
         out = np.clip(out.round(), 0, 255)
     return _wrap(out.astype(dt), t)
@@ -280,7 +290,7 @@ def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
     a, t = _np(img)
     dt = a.dtype
     inv = _perspective_coeffs(startpoints, endpoints)
-    out = _warp(a, inv, fill=fill)
+    out = _warp(a, inv, fill=fill, interpolation=interpolation)
     if dt == np.uint8:
         out = np.clip(out.round(), 0, 255)
     return _wrap(out.astype(dt), t)

@@ -1,0 +1,158 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md):
+beam-state reordering, RPC routable bind, rnnt FastEmit, warp
+interpolation modes, pooling ceil_mode/data_format with return_mask."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class TestBeamSearchStateReorder:
+    def test_states_follow_their_beams(self):
+        """A cell whose state is a per-beam counter of its own argmax
+        history: after pruning, each surviving beam must carry the state of
+        its PARENT beam (ADVICE high: decode.py:545-547 analog)."""
+        from paddle_tpu.nn.layer.rnn import BeamSearchDecoder
+
+        V = 8
+
+        class TaggedCell:
+            """State = the last token this beam emitted (as float).
+            Logits steer beam k toward token (state + 1) % V, so the
+            token sequence a beam produces is determined by its state
+            chain — a mismatched state shows up as a broken chain."""
+
+            def __call__(self, inp, states):
+                tok = np.asarray(inp.data if isinstance(inp, Tensor)
+                                 else inp)  # [B*K] token ids
+                st = np.asarray(states)     # [B*K]
+                nxt = (st + 1) % (V - 1)  # last token reserved as end_token
+                logits = np.full((tok.shape[0], V), -10.0, np.float32)
+                logits[np.arange(tok.shape[0]), nxt.astype(int)] = 0.0
+                # tiny noise keeps beams distinct so pruning reorders them
+                rng = np.random.RandomState(int(st.sum()) % 1000)
+                logits += rng.rand(*logits.shape).astype(np.float32) * 0.1
+                out = Tensor(jnp.asarray(logits))
+                return out, jnp.asarray(nxt, jnp.float32)
+
+        b, k = 2, 3
+        dec = BeamSearchDecoder(TaggedCell(), start_token=0, end_token=V - 1,
+                                beam_size=k)
+        tokens, logp, fin, states = dec.initialize(
+            jnp.zeros((b * k,), jnp.float32), b)
+        for _ in range(5):
+            prev = np.asarray(states).reshape(b, k)
+            tokens, logp, fin, beam_idx, states = dec.step(
+                tokens, logp, fin, states)
+            # invariant: each surviving beam's state is its PARENT's state
+            # advanced by one (the cell sets state := (old_state+1) %% (V-1));
+            # without the beam_idx gather it would be the state of whatever
+            # beam happened to share its slot.
+            st = np.asarray(states).reshape(b, k).astype(np.int64)
+            want = (np.take_along_axis(prev, beam_idx, axis=1)
+                    .astype(np.int64) + 1) % (V - 1)
+            np.testing.assert_array_equal(st, want)
+
+
+class TestRnntFastEmit:
+    def test_value_neutral_grad_scaling(self):
+        rng = np.random.RandomState(0)
+        B, T, U, V = 2, 5, 3, 6
+        acts = rng.randn(B, T, U + 1, V).astype(np.float32)
+        labels = paddle.to_tensor(rng.randint(1, V, (B, U)).astype(np.int64))
+        tl = paddle.to_tensor(np.array([5, 4], np.int64))
+        ul = paddle.to_tensor(np.array([3, 2], np.int64))
+
+        def loss(lmbda, a):
+            return F.rnnt_loss(paddle.to_tensor(a), labels, tl, ul,
+                               fastemit_lambda=lmbda, reduction="sum")
+
+        l0 = float(loss(0.0, acts).data)
+        l1 = float(loss(0.3, acts).data)
+        assert abs(l0 - l1) < 1e-5  # FastEmit is value-neutral
+        g0 = jax.grad(lambda a: loss(0.0, a).data.sum())(jnp.asarray(acts))
+        g1 = jax.grad(lambda a: loss(0.3, a).data.sum())(jnp.asarray(acts))
+        assert float(jnp.linalg.norm(g1 - g0)) > 1e-4  # ...but not grad-neutral
+
+
+class TestWarpInterpolation:
+    def test_nearest_vs_bilinear_differ_and_bad_mode_raises(self):
+        from paddle_tpu.vision.transforms import functional as VF
+        rng = np.random.RandomState(0)
+        img = (rng.rand(16, 17, 3) * 255).astype(np.uint8)
+        a_near = VF.rotate(img, 30.0)  # reference default: nearest
+        a_bil = VF.rotate(img, 30.0, interpolation="bilinear")
+        assert a_near.shape == a_bil.shape
+        assert not np.array_equal(a_near, a_bil)
+        with pytest.raises(ValueError):
+            VF.affine(img, 10.0, (0, 0), 1.0, 0.0, interpolation="bicubic")
+
+
+class TestPoolingCeilAndLayout:
+    def test_ceil_mode_against_torch(self):
+        import torch
+        import torch.nn.functional as TF
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 11, 13).astype(np.float32)
+        for ceil in (False, True):
+            out = F.max_pool2d(paddle.to_tensor(x), 3, stride=2, padding=1,
+                               ceil_mode=ceil)
+            ref = TF.max_pool2d(torch.tensor(x), 3, stride=2, padding=1,
+                                ceil_mode=ceil)
+            assert tuple(out.shape) == tuple(ref.shape)
+            np.testing.assert_allclose(np.asarray(out.data), ref.numpy(),
+                                       rtol=1e-6)
+            outm, idx = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                                     return_mask=True, ceil_mode=ceil)
+            refm, ridx = TF.max_pool2d(torch.tensor(x), 2, stride=2,
+                                       ceil_mode=ceil, return_indices=True)
+            np.testing.assert_allclose(np.asarray(outm.data), refm.numpy(),
+                                       rtol=1e-6)
+            np.testing.assert_array_equal(np.asarray(idx.data), ridx.numpy())
+
+    def test_return_mask_nhwc(self):
+        import torch
+        import torch.nn.functional as TF
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 3, 8, 10).astype(np.float32)
+        xh = np.moveaxis(x, 1, -1)
+        out, idx = F.max_pool2d(paddle.to_tensor(xh), 2, stride=2,
+                                return_mask=True, data_format="NHWC")
+        ref, ridx = TF.max_pool2d(torch.tensor(x), 2, stride=2,
+                                  return_indices=True)
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   np.moveaxis(ref.numpy(), 1, -1), rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(idx.data),
+                                      np.moveaxis(ridx.numpy(), 1, -1))
+
+    def test_avg_pool_ceil(self):
+        import torch
+        import torch.nn.functional as TF
+        rng = np.random.RandomState(2)
+        x = rng.randn(1, 2, 9, 9).astype(np.float32)
+        out = F.avg_pool2d(paddle.to_tensor(x), 3, stride=2, padding=1,
+                           ceil_mode=True, exclusive=True)
+        ref = TF.avg_pool2d(torch.tensor(x), 3, stride=2, padding=1,
+                            ceil_mode=True, count_include_pad=False)
+        np.testing.assert_allclose(np.asarray(out.data), ref.numpy(),
+                                   rtol=1e-5)
+
+
+class TestRpcBindAddress:
+    def test_agent_advertises_routable_ip(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_LOCAL_IP", "10.1.2.3")
+        from paddle_tpu.distributed.rpc.rpc import _RpcAgent
+        agent = _RpcAgent("w0", 0, 1, None)
+        try:
+            assert agent.ip == "10.1.2.3"
+            # server must be reachable on loopback despite advertising the
+            # routable ip (bound to 0.0.0.0)
+            import socket
+            s = socket.create_connection(("127.0.0.1", agent.port), timeout=5)
+            s.close()
+        finally:
+            agent._stop.set()

@@ -110,6 +110,21 @@ def _accumulate(existing, new):
     return existing + new
 
 
+# Fired after every engine sweep completes — the analog of the reference
+# engine's backward-completion callbacks that EagerReducer uses to flush
+# its final gradient buckets (ref: reducer.cc FinalizeBackward).
+_after_backward_callbacks = []
+
+
+def register_after_backward_callback(cb):
+    _after_backward_callbacks.append(cb)
+
+    def remove():
+        if cb in _after_backward_callbacks:
+            _after_backward_callbacks.remove(cb)
+    return remove
+
+
 def run_backward(tensors, grad_tensors=None, retain_graph=False):
     """Engine entry (ref: fluid/eager/backward.cc:105 RunBackward).
 
@@ -170,6 +185,9 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False):
                     inp.grad = _wrap_grad(ct)
                 else:
                     inp.grad = _wrap_grad(inp.grad.data + ct)
+
+    for cb in list(_after_backward_callbacks):
+        cb()
 
 
 def _wrap_grad(arr):

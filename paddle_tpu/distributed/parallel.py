@@ -27,6 +27,15 @@ class DataParallel(Layer):
         self._group = group
         self.find_unused_parameters = find_unused_parameters
         self._grad_sync_enabled = True
+        self._reducer = None
+        if get_world_size() > 1 and not in_spmd_region("data"):
+            # eager multi-process DP: bucketed fused allreduce with
+            # during-backward dispatch (EagerReducer semantics)
+            from .reducer import EagerReducer
+            self._reducer = EagerReducer(
+                list(layers.parameters()),
+                bucket_bytes=int(comm_buffer_size) * 1024 * 1024,
+                group=group)
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
@@ -35,15 +44,22 @@ class DataParallel(Layer):
     def no_sync(self):
         """ref: parallel.py:488 — skip grad sync inside this context."""
         self._grad_sync_enabled = False
+        if self._reducer is not None:
+            self._reducer.enabled = False
         try:
             yield
         finally:
             self._grad_sync_enabled = True
+            if self._reducer is not None:
+                self._reducer.enabled = True
 
     def sync_gradients(self):
         """Explicit grad allreduce over the data axis (EagerReducer analog).
         Called by step builders after backward; no-op under no_sync."""
         if not self._grad_sync_enabled:
+            return
+        if self._reducer is not None:
+            self._reducer.sync()
             return
         if not in_spmd_region("data") and get_world_size() == 1:
             return

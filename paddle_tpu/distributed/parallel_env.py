@@ -66,9 +66,15 @@ class ParallelEnv:
 
 
 def init_parallel_env(strategy=None):
-    """ref: parallel.py:318. Multi-host: jax.distributed.initialize using the
-    MASTER_ADDR/PORT or PADDLE_TRAINER_ENDPOINTS contract; single-host is a
-    no-op beyond mesh construction."""
+    """ref: parallel.py:318 — env parse -> TCPStore (:489) -> process group
+    -> barrier.
+
+    Multi-process: rank 0 hosts the C++ TCPStore (csrc/tcp_store.cc) on
+    MASTER_PORT+1; every rank rendezvouses through it (the reference's
+    bootstrap contract), then jax.distributed.initialize() brings up the
+    XLA runtime with rank 0 as coordinator, and a store barrier confirms
+    the full world before returning. Single-host is a no-op beyond mesh
+    construction."""
     if _initialized[0]:
         return ParallelEnv()
     env = ParallelEnv()
@@ -78,12 +84,26 @@ def init_parallel_env(strategy=None):
         port = os.getenv("MASTER_PORT")
         if not master and env.trainer_endpoints:
             master, port = env.trainer_endpoints[0].split(":")
-        coordinator = f"{master}:{port}"
+        rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        # --- TCPStore rendezvous (ref: parallel.py:489) ---
+        store = None
+        try:
+            from .store import TCPStore
+            store = TCPStore(master, int(port) + 1, world_size=world,
+                             is_master=(rank == 0), timeout=120)
+            store.barrier("init_ready", world)
+        except Exception:
+            store = None  # jax.distributed has its own rendezvous; the
+            #                store is the reference-contract fast-fail layer
         jax.distributed.initialize(
-            coordinator_address=coordinator,
+            coordinator_address=f"{master}:{port}",
             num_processes=world,
-            process_id=int(os.getenv("PADDLE_TRAINER_ID", "0")),
+            process_id=rank,
         )
+        if store is not None:
+            # barrier: all ranks came up under the same world
+            store.barrier("init_done", world)
+            env._store = store
     _initialized[0] = True
     # Build the default (data-only) global mesh.
     from .mesh import set_global_mesh, build_mesh

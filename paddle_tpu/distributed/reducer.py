@@ -1,0 +1,145 @@
+"""EagerReducer: bucketed gradient fusion for eager DataParallel.
+
+ref: paddle/fluid/distributed/collective/reducer.cc (1299 LoC EagerReducer)
++ python/paddle/fluid/dygraph/parallel.py:121 build_groups.
+
+Semantics reproduced TPU-style:
+  - parameters are grouped into size-capped buckets in REVERSE creation
+    order (grads become ready roughly in reverse order during backward,
+    ref: reducer.cc bucket ordering);
+  - a per-parameter grad hook marks readiness; when every grad in a bucket
+    has been produced, the bucket is flushed as ONE fused allreduce
+    (flatten-concat -> all_reduce(AVG) -> split back) — the fusion that
+    replaces the reference's coalesced tensors;
+  - flushes are dispatched DURING backward (jax dispatch is async, so the
+    collective overlaps the remaining backward compute the way the
+    reference overlaps on the comm stream). A completed bucket is flushed
+    at the next hook firing — by then its last gradient has been
+    accumulated — and sync() flushes the tail;
+  - no_sync suppresses flushing (gradients keep accumulating locally).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from .collective import all_reduce, ReduceOp
+
+
+class EagerReducer:
+    def __init__(self, params, bucket_bytes=25 * 1024 * 1024, group=None):
+        self.group = group
+        self.params = [p for p in params if not p.stop_gradient]
+        self.enabled = True
+        # reverse order, size-capped buckets (ref: parallel.py:121)
+        self.buckets = []
+        cur, cur_bytes = [], 0
+        for p in reversed(self.params):
+            nbytes = int(np.prod(p.shape)) * 4
+            if cur and cur_bytes + nbytes > bucket_bytes:
+                self.buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(p)
+            cur_bytes += nbytes
+        if cur:
+            self.buckets.append(cur)
+        self._bucket_of = {}
+        for bi, b in enumerate(self.buckets):
+            for p in b:
+                self._bucket_of[id(p)] = bi
+        self._ready = [set() for _ in self.buckets]
+        self._flushed = [False] * len(self.buckets)
+        self._pending_flush = []
+        for p in self.params:
+            p.register_hook(self._make_hook(p))
+        # Flush the tail buckets when the engine sweep finishes, like the
+        # reference's backward-completion callback (reducer.cc). Registered
+        # through a weakref so a dropped DataParallel wrapper doesn't stay
+        # hooked into every future backward (and the callback self-removes
+        # once the reducer is collected).
+        import weakref
+        from ..autograd import tape
+        ref = weakref.ref(self)
+        remove_box = []
+
+        def _cb():
+            r = ref()
+            if r is None:
+                if remove_box:
+                    remove_box[0]()
+                return
+            r._on_backward_done()
+
+        remove_box.append(tape.register_after_backward_callback(_cb))
+        self._remove_cb = remove_box[0]
+
+    def _on_backward_done(self):
+        if self.enabled and any(self._ready[bi] for bi in
+                                range(len(self.buckets))):
+            self.sync()
+
+    # -- hook machinery -----------------------------------------------------
+    def _make_hook(self, p):
+        # weak self: params outlive wrappers; a dead reducer's hooks must
+        # not keep it alive or act on unrelated backwards
+        import weakref
+        ref = weakref.ref(self)
+        pid = id(p)
+
+        def hook(grad):
+            self_ = ref()
+            if self_ is None or not self_.enabled:
+                return None
+            # flush buckets completed by PREVIOUS hook firings (their last
+            # grad has been accumulated by now)
+            self_._drain()
+            bi = self_._bucket_of.get(pid)
+            if bi is not None:
+                self_._ready[bi].add(pid)
+                if (len(self_._ready[bi]) == len(self_.buckets[bi])
+                        and not self_._flushed[bi]):
+                    self_._pending_flush.append(bi)
+            return None
+        return hook
+
+    def _drain(self):
+        while self._pending_flush:
+            bi = self._pending_flush.pop(0)
+            if not self._flushed[bi]:
+                self._flush_bucket(bi)
+
+    def _flush_bucket(self, bi):
+        bucket = [p for p in self.buckets[bi] if p.grad is not None]
+        if not bucket:
+            self._flushed[bi] = True
+            return
+        flats = [p.grad.data.reshape(-1).astype(jnp.float32) for p in bucket]
+        sizes = [f.shape[0] for f in flats]
+        fused = Tensor(jnp.concatenate(flats), stop_gradient=True)
+        all_reduce(fused, op=ReduceOp.AVG, group=self.group)
+        off = 0
+        for p, n in zip(bucket, sizes):
+            piece = fused.data[off:off + n].reshape(p.grad.shape)
+            p.grad = Tensor(piece.astype(p.grad.dtype), stop_gradient=True)
+            off += n
+        self._flushed[bi] = True
+
+    # -- public -------------------------------------------------------------
+    def sync(self):
+        """Flush every remaining bucket with ready gradients; called after
+        backward (the reference's _redefine_opt_step /
+        apply_collective_grads point). Idempotent: a second call after the
+        completion-callback flush sees no ready grads and does nothing —
+        no double allreduce."""
+        if not self.enabled:
+            self._reset()
+            return
+        self._drain()
+        for bi in range(len(self.buckets)):
+            if not self._flushed[bi] and self._ready[bi]:
+                self._flush_bucket(bi)
+        self._reset()
+
+    def _reset(self):
+        self._ready = [set() for _ in self.buckets]
+        self._flushed = [False] * len(self.buckets)
+        self._pending_flush = []

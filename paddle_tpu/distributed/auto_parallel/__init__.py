@@ -115,7 +115,12 @@ class Strategy:
 
 class Engine:
     """ref: engine.py:57 — prepare/fit/evaluate driving a jit-compiled step
-    whose parallelism comes from the declared shardings."""
+    whose parallelism comes from the declared shardings.
+
+    The Completer analog (completion.py): params annotated via shard_tensor
+    seed a shard-propagation pass over the traced loss jaxpr; the engine
+    fills in shardings for every UNANNOTATED parameter, places them, and
+    lets XLA GSPMD insert the collectives (the Resharder's job)."""
 
     def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
                  strategy=None):
@@ -126,21 +131,86 @@ class Engine:
         self._strategy = strategy or Strategy()
         self._params = None
         self._jitted = None
+        self._process_mesh = None
+        self._input_placements = None
+        self.completed_param_specs = None
 
-    def prepare(self, *args, **kwargs):
+    def prepare(self, *args, input_placements=None, process_mesh=None,
+                **kwargs):
+        """input_placements: spec tuple (axis names / None per dim) for the
+        input batch; process_mesh: the ProcessMesh to complete over."""
         self._params = list(self._model.parameters())
+        if input_placements is not None:
+            self._input_placements = [tuple(s) for s in input_placements]
+        if process_mesh is not None:
+            self._process_mesh = process_mesh
         return self
+
+    def _compute_fn(self, params, key):
+        model, loss_fn = self._model, self._loss
+
+        def compute(arrs, x, y):
+            for p, a in zip(params, arrs):
+                p.data = a
+            with tape.no_grad(), frnd.key_scope(key):
+                out = model(Tensor(x))
+                l = loss_fn(out, Tensor(y))
+            return l.data
+
+        return compute
+
+    def _complete_and_place(self, x, y):
+        """Run the Completer over the traced loss and place params
+        accordingly (ref: completion.py Completer +
+        engine._initialize)."""
+        params = self._params
+        mesh = self._process_mesh
+        seeds = {}
+        for i, p in enumerate(params):
+            attr = getattr(p, "dist_attr", None)
+            if attr is not None:
+                seeds[i] = tuple(attr)
+        n = len(params)
+        if self._input_placements:
+            seeds[n] = self._input_placements[0]
+        if mesh is None or not seeds:
+            return
+        from .completion import Completer
+        compute = self._compute_fn(params, jax.random.key(0))
+        example = [p.data for p in params] + [x, y]
+        saved = [p.data for p in params]
+
+        def flat(*argv):
+            try:
+                arrs = list(argv[:n])
+                return compute(arrs, argv[n], argv[n + 1])
+            finally:
+                for p, s in zip(params, saved):
+                    p.data = s
+
+        specs = Completer(mesh.jax_mesh).complete(flat, example, seeds)
+        self.completed_param_specs = specs[:n]
+        for p, spec in zip(params, self.completed_param_specs):
+            sharding = NamedSharding(
+                mesh.jax_mesh, P(*spec) if spec is not None else P())
+            p.data = jax.device_put(p.data, sharding)
 
     def _build(self):
         params = self._params or list(self._model.parameters())
         model, loss_fn = self._model, self._loss
         lr = self._optimizer.get_lr() if self._optimizer else 1e-3
+        mesh = self._process_mesh
+        in_pl = self._input_placements
 
         def step(parrs, x, y, key):
             saved = [p.data for p in params]
             for p, a in zip(params, parrs):
                 p.data = a
             try:
+                if mesh is not None and in_pl:
+                    x = jax.lax.with_sharding_constraint(
+                        x, NamedSharding(mesh.jax_mesh, P(*in_pl[0])))
+
                 def compute(arrs):
                     for p, a in zip(params, arrs):
                         p.data = a
@@ -163,13 +233,24 @@ class Engine:
         from ...io import DataLoader, Dataset
         loader = DataLoader(train_data, batch_size=batch_size) \
             if isinstance(train_data, Dataset) else train_data
-        if self._jitted is None:
-            self._jitted = self._build()
         params = self._params or list(self._model.parameters())
+        first_epoch_iter = None
+        if self._jitted is None:
+            if self.completed_param_specs is None:
+                # peek the first batch for tracing, then CHAIN it back so
+                # one-shot iterators don't silently lose it
+                import itertools
+                it = iter(loader)
+                first = next(it)
+                self._complete_and_place(first[0].data, first[1].data)
+                first_epoch_iter = itertools.chain([first], it)
+            self._jitted = self._build()
         parrs = [p.data for p in params]
         history = []
         for epoch in range(epochs):
-            for step_i, batch in enumerate(loader):
+            epoch_iter = (first_epoch_iter if epoch == 0 and
+                          first_epoch_iter is not None else loader)
+            for step_i, batch in enumerate(epoch_iter):
                 x, y = batch[0], batch[1]
                 parrs, lv = self._jitted(
                     parrs, x.data, y.data, frnd.next_key())

@@ -1,0 +1,297 @@
+"""Shard propagation over jaxprs — the Completer.
+
+ref: python/paddle/distributed/auto_parallel/completion.py (Completer:
+annotate a few tensors, propagate dist attrs op-by-op over the program
+until fixpoint) and reshard.py (insert communication where shardings
+disagree — here XLA GSPMD emits the collectives once placements are set).
+
+TPU-native shape: the "program" is a traced jaxpr. Each variable carries a
+spec = tuple(axis-name-or-None per dim). Seeds come from user annotations
+(shard_tensor placements). Per-primitive rules propagate specs both
+FORWARD (inputs -> outputs) and BACKWARD (outputs -> inputs) — backward is
+what infers, e.g., the Megatron row-parallel second weight
+( [k,n] <- "model" on k ) from an annotated column-parallel first weight —
+iterating to fixpoint. First annotation wins on conflict (the reference's
+compatible-dist-attr merge, simplified).
+"""
+import numpy as np
+import jax
+from jax.extend import core as jcore
+
+
+def _merge(a, b):
+    """Merge two specs (first wins per dim); None means unknown."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    out = []
+    for x, y in zip(a, b):
+        out.append(x if x is not None else y)
+    return tuple(out)
+
+
+class _SpecStore:
+    def __init__(self):
+        self.specs = {}   # id(var) -> tuple spec
+        self.changed = False
+
+    def get(self, v):
+        if isinstance(v, jcore.Literal):
+            return None
+        return self.specs.get(id(v))
+
+    def set(self, v, spec):
+        if spec is None or isinstance(v, jcore.Literal):
+            return
+        if all(a is None for a in spec):
+            return  # no information — don't churn the fixpoint
+        ndim = len(v.aval.shape)
+        if len(spec) != ndim:
+            return
+        old = self.specs.get(id(v))
+        new = _merge(old, spec) if old is not None else spec
+        if new != old:
+            self.specs[id(v)] = new
+            self.changed = True
+
+
+def _rule_dot_general(eqn, store):
+    lhs, rhs = eqn.invars
+    (out,) = eqn.outvars
+    ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
+    lnd = len(lhs.aval.shape)
+    rnd = len(rhs.aval.shape)
+    lfree = [d for d in range(lnd) if d not in lc and d not in lb]
+    rfree = [d for d in range(rnd) if d not in rc and d not in rb]
+
+    ls = store.get(lhs)
+    rs = store.get(rhs)
+    os = store.get(out)
+    ond = len(out.aval.shape)
+
+    # forward: out = [batch..., lhs_free..., rhs_free...]
+    new_out = [None] * ond
+    for i, (db_l, db_r) in enumerate(zip(lb, rb)):
+        if ls is not None and ls[db_l] is not None:
+            new_out[i] = ls[db_l]
+        elif rs is not None and rs[db_r] is not None:
+            new_out[i] = rs[db_r]
+    for i, d in enumerate(lfree):
+        if ls is not None and ls[d] is not None:
+            new_out[len(lb) + i] = ls[d]
+    for i, d in enumerate(rfree):
+        if rs is not None and rs[d] is not None:
+            new_out[len(lb) + len(lfree) + i] = rs[d]
+    store.set(out, tuple(new_out))
+
+    os = store.get(out)
+    # backward: out free dims -> lhs/rhs free dims; batch dims -> both
+    if os is not None:
+        new_l = [None] * lnd
+        new_r = [None] * rnd
+        for i, (db_l, db_r) in enumerate(zip(lb, rb)):
+            new_l[db_l] = os[i]
+            new_r[db_r] = os[i]
+        for i, d in enumerate(lfree):
+            new_l[d] = os[len(lb) + i]
+        for i, d in enumerate(rfree):
+            new_r[d] = os[len(lb) + len(lfree) + i]
+        store.set(lhs, tuple(new_l))
+        store.set(rhs, tuple(new_r))
+    # contracted dims: lhs <-> rhs (sharded contraction => partial sums,
+    # resolved by XLA's allreduce insertion)
+    ls, rs = store.get(lhs), store.get(rhs)
+    if ls is not None:
+        new_r = [None] * rnd
+        for dl, dr in zip(lc, rc):
+            new_r[dr] = ls[dl]
+        store.set(rhs, tuple(new_r))
+    if rs is not None:
+        new_l = [None] * lnd
+        for dl, dr in zip(lc, rc):
+            new_l[dl] = rs[dr]
+        store.set(lhs, tuple(new_l))
+
+
+def _rule_elementwise(eqn, store):
+    (out,) = eqn.outvars
+    ond = len(out.aval.shape)
+    # align from the right (numpy broadcasting)
+    agg = [None] * ond
+    for v in eqn.invars:
+        s = store.get(v)
+        if s is None:
+            continue
+        vnd = len(v.aval.shape)
+        for i in range(vnd):
+            od = ond - vnd + i
+            if v.aval.shape[i] == out.aval.shape[od] and s[i] is not None:
+                agg[od] = agg[od] or s[i]
+    store.set(out, tuple(agg))
+    os = store.get(out)
+    if os is not None:
+        for v in eqn.invars:
+            vnd = len(v.aval.shape)
+            if vnd == 0:
+                continue
+            new = [None] * vnd
+            for i in range(vnd):
+                od = ond - vnd + i
+                if v.aval.shape[i] == out.aval.shape[od]:
+                    new[i] = os[od]
+            store.set(v, tuple(new))
+
+
+def _rule_transpose(eqn, store):
+    (inp,), (out,) = eqn.invars, eqn.outvars
+    perm = eqn.params["permutation"]
+    s = store.get(inp)
+    if s is not None:
+        store.set(out, tuple(s[p] for p in perm))
+    os = store.get(out)
+    if os is not None:
+        inv = [0] * len(perm)
+        for i, p in enumerate(perm):
+            inv[p] = i
+        store.set(inp, tuple(os[inv[d]] for d in range(len(perm))))
+
+
+def _rule_reduce(eqn, store):
+    (inp,) = [v for v in eqn.invars if not isinstance(v, jcore.Literal)][:1]
+    (out,) = eqn.outvars
+    axes = eqn.params.get("axes", ())
+    s = store.get(inp)
+    if s is not None:
+        store.set(out, tuple(a for d, a in enumerate(s) if d not in axes))
+    os = store.get(out)
+    if os is not None:
+        new = []
+        j = 0
+        for d in range(len(inp.aval.shape)):
+            if d in axes:
+                new.append(None)
+            else:
+                new.append(os[j])
+                j += 1
+        store.set(inp, tuple(new))
+
+
+def _rule_broadcast_in_dim(eqn, store):
+    (inp,), (out,) = eqn.invars, eqn.outvars
+    bdims = eqn.params["broadcast_dimensions"]
+    s = store.get(inp)
+    ond = len(out.aval.shape)
+    if s is not None:
+        new = [None] * ond
+        for i, od in enumerate(bdims):
+            if inp.aval.shape[i] == out.aval.shape[od]:
+                new[od] = s[i]
+        store.set(out, tuple(new))
+    os = store.get(out)
+    if os is not None:
+        new = [None] * len(inp.aval.shape)
+        for i, od in enumerate(bdims):
+            if inp.aval.shape[i] == out.aval.shape[od]:
+                new[i] = os[od]
+        store.set(inp, tuple(new))
+
+
+def _rule_reshape(eqn, store):
+    """Propagate only when the dim layout is preserved up to size-1 dims
+    (merge/split loses the mapping — the reference also degrades there)."""
+    (inp,) = [v for v in eqn.invars if not isinstance(v, jcore.Literal)][:1]
+    (out,) = eqn.outvars
+    ishape = tuple(inp.aval.shape)
+    oshape = tuple(out.aval.shape)
+    if ishape == oshape:
+        s = store.get(inp)
+        if s is not None:
+            store.set(out, s)
+        os = store.get(out)
+        if os is not None:
+            store.set(inp, os)
+
+
+_PASSTHROUGH = {"convert_element_type", "copy", "stop_gradient",
+                "integer_pow", "custom_jvp_call", "custom_vjp_call"}
+_ELEMENTWISE = {"add", "sub", "mul", "div", "max", "min", "pow", "exp",
+                "log", "tanh", "logistic", "rsqrt", "sqrt", "neg", "abs",
+                "sign", "sin", "cos", "select_n", "and", "or", "xor", "gt",
+                "lt", "ge", "le", "eq", "ne", "erf", "add_any", "rem",
+                "atan2", "nextafter", "squeeze", "expand_dims", "cbrt",
+                "exp2", "log1p", "expm1", "floor", "ceil", "round",
+                "is_finite", "not", "clamp"}
+
+
+def _apply_rules(jaxpr, store):
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "dot_general":
+            _rule_dot_general(eqn, store)
+        elif name == "transpose":
+            _rule_transpose(eqn, store)
+        elif name in ("reduce_sum", "reduce_max", "reduce_min",
+                      "reduce_prod", "argmax", "argmin"):
+            _rule_reduce(eqn, store)
+        elif name == "broadcast_in_dim":
+            _rule_broadcast_in_dim(eqn, store)
+        elif name == "reshape":
+            _rule_reshape(eqn, store)
+        elif name in ("pjit", "jit", "closed_call", "custom_jvp_call",
+                      "custom_vjp_call", "remat", "checkpoint",
+                      "remat2"):
+            sub = None
+            for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+                if key in eqn.params:
+                    sub = eqn.params[key]
+                    break
+            if sub is None:
+                continue
+            inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+            # bridge outer <-> inner vars
+            for ov, iv in zip(eqn.invars, inner.invars):
+                s = store.get(ov)
+                if s is not None:
+                    store.set(iv, s)
+            _apply_rules(inner, store)
+            for ov, iv in zip(eqn.outvars, inner.outvars):
+                s = store.get(iv)
+                if s is not None and not isinstance(iv, jcore.Literal):
+                    store.set(ov, s)
+                so = store.get(ov)
+                if so is not None and not isinstance(iv, jcore.Literal):
+                    store.set(iv, so)
+        elif name in _ELEMENTWISE:
+            _rule_elementwise(eqn, store)
+        elif name in _PASSTHROUGH and len(eqn.outvars) == 1 and eqn.invars \
+                and all(len(v.aval.shape) in
+                        (0, len(eqn.outvars[0].aval.shape))
+                        for v in eqn.invars
+                        if not isinstance(v, jcore.Literal)):
+            _rule_elementwise(eqn, store)
+
+
+class Completer:
+    """Fill in shardings for unannotated program inputs
+    (ref: completion.py Completer.complete_forward_annotation)."""
+
+    def __init__(self, mesh, max_iters=8):
+        self.mesh = mesh
+        self.max_iters = max_iters
+
+    def complete(self, fn, example_args, seed_specs):
+        """fn: pure array fn; seed_specs: {invar_index: spec tuple}.
+        Returns a list of completed specs (tuple or None) per input."""
+        closed = jax.make_jaxpr(fn)(*example_args)
+        jaxpr = closed.jaxpr
+        store = _SpecStore()
+        flat_invars = jaxpr.invars
+        for idx, spec in seed_specs.items():
+            store.set(flat_invars[idx], tuple(spec))
+        for _ in range(self.max_iters):
+            store.changed = False
+            _apply_rules(jaxpr, store)
+            if not store.changed:
+                break
+        return [store.get(v) for v in flat_invars]

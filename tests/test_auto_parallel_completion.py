@@ -1,0 +1,126 @@
+"""Auto-parallel lite (VERDICT round-1 #7): the Completer propagates
+shardings over traced jaxprs from a few seed annotations, and Engine.fit
+trains with only input+first-weight annotations at parity with fully
+manual annotations (ref: auto_parallel/completion.py, engine.py:57)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.auto_parallel.completion import Completer
+
+
+def make_mesh():
+    return Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "model"))
+
+
+class TestCompleter:
+    def test_megatron_mlp_inference(self):
+        mesh = make_mesh()
+
+        def f(x, w1, w2):
+            return jax.nn.relu(x @ w1) @ w2
+
+        c = Completer(mesh)
+        specs = c.complete(
+            f, (np.ones((16, 64), np.float32),
+                np.ones((64, 256), np.float32),
+                np.ones((256, 64), np.float32)),
+            {0: ("data", None), 1: (None, "model")})
+        assert specs[0] == ("data", None)
+        assert specs[1] == (None, "model")
+        # inferred: row-parallel second matmul
+        assert specs[2] == ("model", None)
+
+    def test_propagates_through_transpose_and_bias(self):
+        mesh = make_mesh()
+
+        def f(x, w, b):
+            return jnp.transpose(x @ w + b, (1, 0))
+
+        c = Completer(mesh)
+        specs = c.complete(
+            f, (np.ones((8, 16), np.float32), np.ones((16, 32), np.float32),
+                np.ones((32,), np.float32)),
+            {1: (None, "model")})
+        # bias aligns with the matmul's model-sharded output column
+        assert specs[2] == ("model",)
+
+    def test_deep_chain_fixpoint(self):
+        mesh = make_mesh()
+
+        def f(x, w1, w2, w3, w4):
+            h = jnp.tanh(x @ w1)
+            h = jnp.tanh(h @ w2)
+            h = jnp.tanh(h @ w3)
+            return h @ w4
+
+        c = Completer(mesh)
+        ws = [np.ones((32, 32), np.float32) for _ in range(4)]
+        specs = c.complete(f, (np.ones((4, 32), np.float32), *ws),
+                           {0: ("data", None), 1: (None, "model")})
+        # alternating column/row parallel pattern emerges
+        assert specs[1] == (None, "model")
+        assert specs[2] == ("model", None)
+
+    def test_unseeded_stays_none(self):
+        mesh = make_mesh()
+
+        def f(x, w):
+            return x @ w
+
+        c = Completer(mesh)
+        specs = c.complete(f, (np.ones((4, 8), np.float32),
+                               np.ones((8, 4), np.float32)), {})
+        assert specs == [None, None]
+
+
+class TestEngineCompletion:
+    def _run(self, annotate_all):
+        from paddle_tpu.distributed.auto_parallel import (
+            Engine, ProcessMesh, Shard, Replicate, shard_tensor)
+        from paddle_tpu import optimizer
+
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4),
+                           dim_names=["data", "model"])
+        paddle.seed(5)
+        model = nn.Sequential(nn.Linear(16, 32, bias_attr=False),
+                              nn.ReLU(),
+                              nn.Linear(32, 16, bias_attr=False))
+        params = list(model.parameters())
+        shard_tensor(params[0], mesh, [Replicate(), Shard(1)])
+        if annotate_all:
+            shard_tensor(params[1], mesh, [Shard(0), Replicate()])
+
+        opt = optimizer.SGD(learning_rate=0.05,
+                            parameters=model.parameters())
+        eng = Engine(model, loss=F.mse_loss, optimizer=opt)
+        eng.prepare(input_placements=[("data", None)], process_mesh=mesh)
+
+        rng = np.random.RandomState(0)
+        X = rng.randn(32, 16).astype(np.float32)
+        Y = rng.randn(32, 16).astype(np.float32)
+
+        class DS(paddle.io.Dataset):
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                return X[i], Y[i]
+
+        hist = eng.fit(DS(), epochs=3, batch_size=16, verbose=0)
+        return hist, eng
+
+    def test_fit_with_completion_matches_manual(self):
+        h_auto, eng = self._run(annotate_all=False)
+        h_manual, _ = self._run(annotate_all=True)
+        assert all(np.isfinite(h_auto))
+        np.testing.assert_allclose(h_auto, h_manual, rtol=1e-5)
+        assert h_auto[-1] < h_auto[0]
+        # the engine actually completed the second weight row-parallel
+        specs = eng.completed_param_specs
+        assert specs[1] == ("model", None), specs

@@ -1,0 +1,152 @@
+"""Pallas paged-attention decode kernel (TPU).
+
+The serving-path attention core: single-token queries attend over a PAGED
+KV cache — the TPU-native answer to the reference's inline-KV-cache masked
+MHA (ref: paddle/fluid/operators/fused/fused_multi_transformer_op.cu.h:13
+masked_multihead_attention; PAPERS.md ragged paged attention).
+
+Layout:
+  q          : [b, h, d]            (one decode token per sequence)
+  k_pages    : [n_pages, p, h, d]   (p = page_size tokens per page)
+  v_pages    : [n_pages, p, h, d]
+  page_table : [b, max_pages] int32 (physical page id per logical page;
+                                     entries past the sequence are ignored)
+  seq_lens   : [b] int32            (tokens filled per sequence)
+
+Grid (b, max_pages): pages stream through VMEM via the innermost grid
+dimension with the BLOCK INDEX taken from the scalar-prefetched page table
+(pl.BlockSpec index maps read the prefetch refs), so only pages actually
+referenced are fetched — KV for a sequence is gathered page-by-page with
+online softmax in VMEM scratch, never materialized contiguously.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(page_table_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, p, d, n_pages_max, scale):
+    b = pl.program_id(0)
+    pi = pl.program_id(1)
+
+    @pl.when(pi == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    seq_len = seq_lens_ref[b]
+    page_start = pi * p
+    # whole page beyond the sequence? skip its compute (its DMA still
+    # happened — the table clamps to a valid page id)
+    run = page_start < seq_len
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # [h, d]
+        k = k_ref[0].astype(jnp.float32)                       # [p, h, d]
+        v = v_ref[0].astype(jnp.float32)
+        # [h, p] logits: per-head contraction over d (batch dim h)
+        kt = jnp.swapaxes(k, 0, 1)                             # [h, p, d]
+        logits = jax.lax.dot_general(
+            q, kt, (((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)                # [h, p]
+        # mask positions past seq_len within this page
+        pos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) + page_start
+        logits = jnp.where(pos < seq_len, logits, jnp.float32(NEG_INF))
+
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
+        w = jnp.exp(logits - m_new)                            # [h, p]
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[...] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(w, axis=-1, keepdims=True), l_scr.shape)
+        # [h, d] accumulation: sum_p w[h, p] * v[p, h, d]
+        acc_scr[...] = alpha * acc_scr[...] + wv_diag(w, v, d)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(pi == n_pages_max - 1)
+    def _emit():
+        l_fin = jnp.maximum(l_scr[:, :1], jnp.float32(1e-30))
+        o_ref[0] = (acc_scr[...] / l_fin).astype(o_ref.dtype)
+
+
+def wv_diag(w, v, d):
+    """sum_p w[h,p] * v[p,h,d] -> [h,d] without the cross-head product."""
+    # v: [p, h, d] -> [h, p, d]; batched matmul over h: [1,p] @ [p,d]
+    vt = jnp.swapaxes(v, 0, 1)                      # [h, p, d]
+    return jax.lax.dot_general(
+        w, vt, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)         # [h, d]
+
+
+def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
+                    interpret=False):
+    """q: [b, h, d]; pages: [n_pages, p, h, d]; page_table: [b, max_pages]
+    int32; seq_lens: [b] int32. Returns [b, h, d]."""
+    b, h, d = q.shape
+    n_pages, p, hh, dd = k_pages.shape
+    assert (hh, dd) == (h, d)
+    max_pages = page_table.shape[1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    # clamp table entries so skipped pages still index a real page
+    table = jnp.clip(page_table.astype(jnp.int32), 0, n_pages - 1)
+    lens = seq_lens.astype(jnp.int32)
+
+    kernel = functools.partial(_decode_kernel, p=p, d=d,
+                               n_pages_max=max_pages, scale=s)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda bb, pi, tbl, ln: (bb, 0, 0)),
+            pl.BlockSpec((1, p, h, d),
+                         lambda bb, pi, tbl, ln: (tbl[bb, pi], 0, 0, 0)),
+            pl.BlockSpec((1, p, h, d),
+                         lambda bb, pi, tbl, ln: (tbl[bb, pi], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda bb, pi, tbl, ln: (bb, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interpret,
+        )(table, lens, q, k_pages, v_pages)
+    return out
+
+
+def paged_attention_reference(q, k_pages, v_pages, page_table, seq_lens,
+                              scale=None):
+    """XLA reference for tests: gather pages then plain softmax attention."""
+    b, h, d = q.shape
+    n_pages, p, _, _ = k_pages.shape
+    max_pages = page_table.shape[1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    outs = []
+    for i in range(b):
+        ks = k_pages[page_table[i]].reshape(max_pages * p, h, d)
+        vs = v_pages[page_table[i]].reshape(max_pages * p, h, d)
+        L = int(seq_lens[i])
+        ks, vs = ks[:L], vs[:L]
+        logits = jnp.einsum("hd,khd->hk", q[i].astype(jnp.float32),
+                            ks.astype(jnp.float32)) * s
+        w = jax.nn.softmax(logits, axis=-1)
+        outs.append(jnp.einsum("hk,khd->hd", w, vs.astype(jnp.float32)))
+    return jnp.stack(outs).astype(q.dtype)

@@ -1,0 +1,95 @@
+"""Pallas int8 weight-only matmul (TPU).
+
+Serving-path GEMM: weights live in HBM as int8 + per-output-channel fp
+scales (produced by the PTQ observers in paddle_tpu.quantization), halving
+weight bandwidth — the decode bottleneck. Dequantization happens in VMEM
+right before the MXU pass (ref: the reference's int8
+fused_multi_transformer variant, fused_multi_transformer_int8_op.cu).
+
+  out[m, n] = (sum_k x[m, k] * w_int8[k, n]) * scale[n]
+
+The k-loop is the innermost grid dimension with an f32 VMEM accumulator;
+the per-channel scale is applied once at emission.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_scr, *, nk):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    x = x_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)  # int8 -> f32 dequant (unit scale)
+    acc_scr[...] += jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _emit():
+        o_ref[...] = (acc_scr[...] *
+                      s_ref[0][None, :].astype(jnp.float32)
+                      ).astype(o_ref.dtype)
+
+
+def quantized_matmul(x, w_int8, scales, out_dtype=None, bm=256, bn=256,
+                     bk=512, interpret=False):
+    """x: [m, k] float; w_int8: [k, n] int8; scales: [n] f32.
+    Returns [m, n] in out_dtype (default: x.dtype)."""
+    m, k = x.shape
+    kk, n = w_int8.shape
+    assert kk == k and scales.shape == (n,)
+    out_dtype = out_dtype or x.dtype
+    bm = min(bm, m)
+    bn = min(bn, n)
+    bk = min(bk, k)
+
+    def pad_to(a, mult, axis):
+        pad = (-a.shape[axis]) % mult
+        if not pad:
+            return a
+        widths = [(0, 0)] * a.ndim
+        widths[axis] = (0, pad)
+        return jnp.pad(a, widths)
+
+    xp = pad_to(pad_to(x, bm, 0), bk, 1)
+    wp = pad_to(pad_to(w_int8, bk, 0), bn, 1)
+    sp = pad_to(scales.astype(jnp.float32), bn, 0)
+    mp, kp = xp.shape
+    _, np_ = wp.shape
+    nk = kp // bk
+
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(_qmm_kernel, nk=nk),
+            grid=(mp // bm, np_ // bn, nk),
+            in_specs=[
+                pl.BlockSpec((bm, bk), lambda i, j, kb: (i, kb)),
+                pl.BlockSpec((bk, bn), lambda i, j, kb: (kb, j)),
+                pl.BlockSpec((1, bn), lambda i, j, kb: (0, j)),
+            ],
+            out_specs=pl.BlockSpec((bm, bn), lambda i, j, kb: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype),
+            scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=interpret,
+        )(xp, wp, sp.reshape(1, -1))
+    return out[:m, :n]
+
+
+def quantize_weights(w, axis=0):
+    """Symmetric per-channel int8 quantization of a [k, n] weight.
+    Returns (w_int8 [k, n], scales [n]) with axis=0 reduction (per output
+    channel), matching the PTQ observers' convention."""
+    amax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    scales = (amax / 127.0).astype(jnp.float32)
+    wq = jnp.clip(jnp.round(w / jnp.maximum(scales, 1e-12)), -127, 127)
+    return wq.astype(jnp.int8), scales.reshape(-1)

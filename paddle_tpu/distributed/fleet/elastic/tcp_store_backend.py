@@ -1,0 +1,128 @@
+"""Elastic store backed by the C++ TCPStore.
+
+ref: fleet/elastic/manager.py uses an etcd client (host registry with TTL
+leases + watches). This adapter provides the same store interface over the
+framework's own C++ TCPStore (csrc/tcp_store.cc) so elastic training needs
+no external etcd: keys carry (value, expiry) payloads, leases are enforced
+on read, and "watches" are a poll thread that diffs the registry — the
+semantics ElasticManager needs (host join/leave detection), not a general
+etcd."""
+import json
+import threading
+import time
+
+
+class TCPStoreElasticStore:
+    def __init__(self, host, port, is_master=False, world_size=1,
+                 poll_interval=1.0, prefix="/"):
+        from ...store import TCPStore
+        self._store = TCPStore(host, port, is_master=is_master,
+                               world_size=world_size)
+        self._prefix = prefix
+        self._watchers = []
+        self._known = {}
+        self._stop = threading.Event()
+        self._poll_interval = poll_interval
+        self._poll_thread = None
+        self._keys_key = f"{prefix}/__keys__"
+
+    # -- key bookkeeping (TCPStore has no list-keys-by-prefix) -------------
+    # Atomic scheme: a counter slot allocated per NEW key via TCPStore.add
+    # (server-side atomic), each slot holding one key name. Concurrent
+    # registrations from different hosts each get a distinct slot, so no
+    # read-modify-write race can lose a host.
+    def _key_list(self):
+        try:
+            n = self._store.add(f"{self._keys_key}/n", 0)
+        except Exception:
+            return []
+        out = []
+        for i in range(1, int(n) + 1):
+            try:
+                raw = self._store.get(f"{self._keys_key}/{i}", wait=False)
+            except Exception:
+                continue
+            if raw:
+                k = bytes(raw).decode()
+                if k and k not in out:
+                    out.append(k)
+        return out
+
+    def _register_key(self, key):
+        if key in self._key_list():
+            return
+        slot = self._store.add(f"{self._keys_key}/n", 1)
+        self._store.set(f"{self._keys_key}/{int(slot)}", key)
+
+    # -- etcd-like interface used by ElasticManager ------------------------
+    def put(self, key, value, ttl=None):
+        expiry = time.time() + ttl if ttl else None
+        payload = json.dumps({"v": value, "exp": expiry})
+        self._store.set(key, payload)
+        self._register_key(key)
+        for cb in self._watchers:
+            cb(key, value)
+
+    def get_prefix(self, prefix):
+        now = time.time()
+        out = {}
+        for k in self._key_list():
+            if not k.startswith(prefix):
+                continue
+            try:
+                raw = self._store.get(k, wait=False)
+            except Exception:
+                continue
+            if not raw:
+                continue
+            d = json.loads(bytes(raw))
+            if d.get("exp") is not None and d["exp"] < now:
+                continue
+            out[k] = d["v"]
+        return out
+
+    def delete(self, key):
+        try:
+            self._store.delete_key(key)
+        except Exception:
+            pass
+        # the key's registry slot is left in place; _key_list/get_prefix
+        # skip keys whose value is gone (delete is rare — host exit)
+        for cb in self._watchers:
+            cb(key, None)
+
+    def refresh(self, key, ttl):
+        try:
+            raw = self._store.get(key, wait=False)
+        except Exception:
+            return
+        if raw:
+            d = json.loads(bytes(raw))
+            d["exp"] = time.time() + ttl
+            self._store.set(key, json.dumps(d))
+
+    def add_watch_callback(self, cb):
+        self._watchers.append(cb)
+        if self._poll_thread is None:
+            self._poll_thread = threading.Thread(target=self._poll_loop,
+                                                 daemon=True)
+            self._poll_thread.start()
+
+    def _poll_loop(self):
+        """Diff the registry and fire callbacks on change — the poll-based
+        stand-in for etcd watches."""
+        while not self._stop.is_set():
+            snap = self.get_prefix(self._prefix)
+            for k, v in snap.items():
+                if self._known.get(k) != v:
+                    for cb in self._watchers:
+                        cb(k, v)
+            for k in list(self._known):
+                if k not in snap:
+                    for cb in self._watchers:
+                        cb(k, None)
+            self._known = snap
+            self._stop.wait(self._poll_interval)
+
+    def close(self):
+        self._stop.set()

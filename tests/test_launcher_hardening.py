@@ -1,0 +1,125 @@
+"""Launcher/elastic hardening (VERDICT round-1 #9): HTTP master KV+barrier,
+worker restart-on-failure, TCPStore-backed elastic store
+(ref: launch/controllers/master.py:65, controller.py:74 watch,
+fleet/elastic/manager.py:126)."""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+class TestHTTPMaster:
+    def test_kv_barrier_sync_peers(self):
+        from paddle_tpu.distributed.launch.master import (HTTPMaster,
+                                                          MasterClient)
+        m = HTTPMaster()
+        try:
+            c = MasterClient(f"127.0.0.1:{m.port}")
+            c.wait_healthy()
+            c.put("a/b", "hello")
+            assert c.get("a/b") == b"hello"
+
+            # sync_peers from two "nodes" concurrently
+            results = {}
+
+            def node(rank):
+                cl = MasterClient(f"127.0.0.1:{m.port}")
+                results[rank] = cl.sync_peers("job1", rank,
+                                              f"10.0.0.{rank}", 2)
+
+            ts = [threading.Thread(target=node, args=(r,)) for r in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(30)
+            assert results[0] == results[1] == ["10.0.0.0", "10.0.0.1"]
+        finally:
+            m.stop()
+
+    def test_barrier_timeout(self):
+        from paddle_tpu.distributed.launch.master import (HTTPMaster,
+                                                          MasterClient)
+        m = HTTPMaster()
+        try:
+            c = MasterClient(f"127.0.0.1:{m.port}", timeout=2)
+            with pytest.raises(Exception):
+                c.barrier("lonely", 2, timeout=2)
+        finally:
+            m.stop()
+
+
+class TestWorkerRestart:
+    def test_launcher_restarts_failed_worker(self, tmp_path):
+        """Worker rank 1 crashes on its first life (flag file governs);
+        the watch loop restarts the pod and the job completes rc=0
+        (ref: controller.py watch + elastic restart)."""
+        script = tmp_path / "train.py"
+        flag = tmp_path / "crashed_once"
+        script.write_text(
+            "import os, sys\n"
+            f"flag = {str(repr(str(flag)))}\n"
+            "rank = os.environ['PADDLE_TRAINER_ID']\n"
+            "if rank == '1' and not os.path.exists(flag):\n"
+            "    open(flag, 'w').write('x')\n"
+            "    sys.exit(3)\n"
+            "print('rank', rank, 'ok')\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--max_restart", "2",
+             "--log_dir", str(tmp_path / "logs"), str(script)],
+            capture_output=True, text=True, timeout=120, cwd="/root/repo")
+        assert r.returncode == 0, r.stderr
+        assert "restart 1/2" in r.stderr
+        logs = "".join(p.read_text()
+                       for p in (tmp_path / "logs").glob("workerlog.*"))
+        assert "rank 0 ok" in logs and "rank 1 ok" in logs
+
+    def test_launcher_gives_up_after_max_restarts(self, tmp_path):
+        script = tmp_path / "always_fail.py"
+        script.write_text("import sys; sys.exit(7)\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "1", "--max_restart", "1",
+             "--log_dir", str(tmp_path / "logs"), str(script)],
+            capture_output=True, text=True, timeout=120, cwd="/root/repo")
+        assert r.returncode == 1
+        assert "giving up" in r.stderr
+
+
+class TestTCPStoreElasticBackend:
+    def test_elastic_manager_over_tcp_store(self):
+        from paddle_tpu.distributed.fleet.elastic.tcp_store_backend import (
+            TCPStoreElasticStore)
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+        store = TCPStoreElasticStore("127.0.0.1", 0, is_master=True,
+                                     poll_interval=0.2)
+        try:
+            store.put("/elastic/x", "1", ttl=60)
+            assert store.get_prefix("/elastic/")["/elastic/x"] == "1"
+            store.put("/elastic/y", "2", ttl=0.2)
+            time.sleep(0.4)
+            assert "/elastic/y" not in store.get_prefix("/elastic/")
+
+            seen = []
+            store.add_watch_callback(lambda k, v: seen.append((k, v)))
+            store.put("/elastic/z", "3", ttl=60)
+            deadline = time.time() + 5
+            while time.time() < deadline and not any(
+                    k == "/elastic/z" for k, _ in seen):
+                time.sleep(0.1)
+            assert any(k == "/elastic/z" for k, _ in seen)
+
+            # ElasticManager heartbeats through it like the etcd client
+            mgr = ElasticManager("host-a", job_id="j1", np=2, store=store,
+                                 heartbeat_interval=0.2, lease_ttl=1)
+            mgr.register()
+            time.sleep(0.5)
+            assert mgr.hosts() == ["host-a"], mgr.hosts()
+            mgr.exit()
+        finally:
+            store.close()

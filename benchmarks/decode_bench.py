@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Decode/serving benchmark: tokens/s at bs=1 and bs=8 through the paged-KV
+engine, fp16-class vs int8 weight-only (VERDICT round-1 #6).
+
+Prints one JSON line per configuration:
+  {"metric": "decode_tokens_per_sec", "batch": B, "quant": q, "value": N}
+
+Runs on the real chip under the default (axon) platform; CPU smoke with
+tiny shapes otherwise. (The driver-facing training bench stays bench.py.)
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference.serving import LLMEngine
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=16,
+                          num_attention_heads=16,
+                          max_position_embeddings=2048)
+        t0, new, max_len = 128, 128, 512
+        batches = (1, 8)
+        quants = (None, "int8")
+    else:
+        cfg = LlamaConfig.tiny()
+        t0, new, max_len = 16, 16, 64
+        batches = (1, 2)
+        quants = (None, "int8")
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+
+    for quant in quants:
+        for b in batches:
+            eng = LLMEngine(model, max_len=max_len, page_size=64,
+                            max_batch=b, quant=quant)
+            ids = rng.randint(0, cfg.vocab_size, (b, t0)).astype(np.int64)
+            eng.generate(ids, max_new_tokens=4)      # warmup/compile
+            # decode-only rate: subtract a prefill+1-token run so the
+            # metric isn't polluted by prompt processing
+            t_start = time.perf_counter()
+            eng.generate(ids, max_new_tokens=1)
+            t_prefill = time.perf_counter() - t_start
+            t_start = time.perf_counter()
+            out = eng.generate(ids, max_new_tokens=new)
+            dt = (time.perf_counter() - t_start) - t_prefill
+            toks = (out.shape[1] - t0 - 1) * b
+            print(json.dumps({
+                "metric": "decode_tokens_per_sec",
+                "batch": b,
+                "quant": quant or "none",
+                "value": round(toks / max(dt, 1e-9), 2),
+                "prefill_sec": round(t_prefill, 4),
+                "unit": "tokens/s",
+                "backend": jax.default_backend(),
+            }))
+            sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

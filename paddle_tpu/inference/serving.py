@@ -1,0 +1,329 @@
+"""LLM serving engine: paged KV cache + int8 weight-only decode.
+
+The deployment arc the reference serves with fused_multi_transformer
+(ref: paddle/fluid/operators/fused/fused_multi_transformer_op.cu.h inline
+KV cache + masked MHA; fused_multi_transformer_int8_op.cu): a decode
+engine whose KV cache lives in fixed-size PAGES with a free-list
+allocator, so sequences of different lengths share one pool (continuous
+batching shape; PAPERS.md ragged paged attention), and whose matmuls can
+run int8 weight-only (ops/pallas/quantized_matmul).
+
+Pieces:
+  - PageAllocator: free-list over [n_pages, page_size, h, d] K/V pools
+  - LLMEngine(model, ...): snapshots LLaMA weights (optionally int8),
+    prefills prompts densely and scatters their KV into pages, then runs
+    ONE jitted decode step per token: ragged per-sequence positions,
+    rope at each sequence's own offset, KV written to its page slot, and
+    attention via the Pallas paged_attention kernel
+  - generate(): the host loop (greedy or temperature/top-k/top-p
+    sampling, shared with models.generation._sample)
+"""
+import functools
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from ..autograd import tape
+from ..models.llama import LlamaForCausalLM, _rope_cache
+from ..ops.pallas.paged_attention import (paged_attention,
+                                          paged_attention_reference)
+from ..ops.pallas.quantized_matmul import quantized_matmul, quantize_weights
+
+
+class PageAllocator:
+    """Free-list page allocator (the serving engine's KV memory manager)."""
+
+    def __init__(self, n_pages):
+        self.n_pages = n_pages
+        self._free = list(range(n_pages - 1, -1, -1))
+
+    def alloc(self):
+        if not self._free:
+            raise RuntimeError("KV page pool exhausted")
+        return self._free.pop()
+
+    def free(self, pages):
+        for p in pages:
+            self._free.append(p)
+
+    @property
+    def available(self):
+        return len(self._free)
+
+
+def _snapshot_llama(model, quant):
+    """Pull per-layer weights out of the Layer tree into plain arrays.
+    quant='int8' replaces the six projection weights of every layer (and
+    the lm_head) with (int8, scales) pairs."""
+    cfg = model.config
+    emb = model.llama.embed_tokens.weight.data
+
+    def maybe_q(w):
+        if quant == "int8":
+            wq, sc = quantize_weights(w.astype(jnp.float32))
+            return (wq, sc)
+        return w
+
+    layers = []
+    for layer in model.llama.layers:
+        a = layer.self_attn
+        layers.append(dict(
+            ln1=layer.input_layernorm.weight.data,
+            ln2=layer.post_attention_layernorm.weight.data,
+            wq=maybe_q(a.q_proj.weight.data),
+            wk=maybe_q(a.k_proj.weight.data),
+            wv=maybe_q(a.v_proj.weight.data),
+            wo=maybe_q(a.o_proj.weight.data),
+            wg=maybe_q(layer.mlp.gate_proj.weight.data),
+            wu=maybe_q(layer.mlp.up_proj.weight.data),
+            wd=maybe_q(layer.mlp.down_proj.weight.data),
+        ))
+    return dict(emb=emb, norm=model.llama.norm.weight.data,
+                head=maybe_q(model.lm_head.weight.data), layers=layers,
+                eps=cfg.rms_norm_eps)
+
+
+def _mm(x, w, interpret):
+    """x @ w where w is either a dense array or an (int8, scales) pair."""
+    if isinstance(w, tuple):
+        wq, sc = w
+        flat = x.reshape(-1, x.shape[-1])
+        out = quantized_matmul(flat, wq, sc, out_dtype=x.dtype,
+                               interpret=interpret)
+        return out.reshape(*x.shape[:-1], -1)
+    return x @ w.astype(x.dtype)
+
+
+def _rms(x, w, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)).astype(x.dtype) \
+        * w.astype(x.dtype)
+
+
+class LLMEngine:
+    """Paged-KV decode engine for LlamaForCausalLM.
+
+    max_batch sequences, each up to max_len tokens, share a pool of
+    (max_batch * max_len / page_size) pages per layer.
+    """
+
+    def __init__(self, model, max_len=1024, page_size=128, max_batch=8,
+                 quant=None, use_pallas=None):
+        assert isinstance(model, LlamaForCausalLM), "LLaMA family only"
+        if quant not in (None, "int8"):
+            raise ValueError(f"unsupported quant {quant!r}")
+        model.eval()
+        cfg = model.config
+        self.cfg = cfg
+        self.page_size = page_size
+        self.max_len = max_len
+        self.max_batch = max_batch
+        self.max_pages_per_seq = -(-max_len // page_size)
+        self.n_pages = max_batch * self.max_pages_per_seq
+        self.nh = cfg.num_attention_heads
+        self.hd = cfg.hidden_size // self.nh
+        self.quant = quant
+        # interpret Pallas kernels off-TPU so the engine runs in CI
+        self.interpret = (use_pallas is False) or \
+            (jax.default_backend() == "cpu")
+        self.weights = _snapshot_llama(model, quant)
+        dtype = (jnp.bfloat16 if jax.default_backend() != "cpu"
+                 else jnp.float32)
+        self.kv_dtype = dtype
+        L = cfg.num_hidden_layers
+        self.k_pages = [jnp.zeros((self.n_pages, page_size, self.nh, self.hd),
+                                  dtype) for _ in range(L)]
+        self.v_pages = [jnp.zeros((self.n_pages, page_size, self.nh, self.hd),
+                                  dtype) for _ in range(L)]
+        self.allocator = PageAllocator(self.n_pages)
+        self._step_fn = None
+        self._prefill_fns = {}
+        cos, sin = _rope_cache(max_len, self.hd, cfg.rope_theta, jnp.float32)
+        self.rope = (cos, sin)
+
+    # -- math ---------------------------------------------------------------
+    def _attn_dense(self, q, k, v):
+        """Prefill attention (causal, dense over the prompt)."""
+        s = q.shape[1]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(self.hd)
+        tri = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(tri[None, None], logits, -1e30)
+        w = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+    def _layer_qkv(self, wset, h, pos_ids):
+        cos, sin = self.rope
+        b, t, H = h.shape
+        x = _rms(h, wset["ln1"], self.weights["eps"])
+        q = _mm(x, wset["wq"], self.interpret).reshape(b, t, self.nh, self.hd)
+        k = _mm(x, wset["wk"], self.interpret).reshape(b, t, self.nh, self.hd)
+        v = _mm(x, wset["wv"], self.interpret).reshape(b, t, self.nh, self.hd)
+        c = cos[pos_ids][..., None, :].astype(q.dtype)
+        s = sin[pos_ids][..., None, :].astype(q.dtype)
+        d2 = self.hd // 2
+
+        def rope(x_):
+            x1, x2 = x_[..., :d2], x_[..., d2:]
+            return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], -1)
+
+        return rope(q), rope(k), v
+
+    def _layer_tail(self, wset, h, attn_out):
+        b, t = attn_out.shape[:2]
+        o = _mm(attn_out.reshape(b, t, -1), wset["wo"], self.interpret)
+        h = h + o
+        x = _rms(h, wset["ln2"], self.weights["eps"])
+        g = _mm(x, wset["wg"], self.interpret)
+        u = _mm(x, wset["wu"], self.interpret)
+        act = jax.nn.silu(g.astype(jnp.float32)).astype(g.dtype) * u
+        return h + _mm(act, wset["wd"], self.interpret)
+
+    # -- prefill ------------------------------------------------------------
+    def _build_prefill(self, t_pad):
+        """Batched prefill over a PADDED prompt length (multiple of
+        page_size, so at most max_len/page_size variants ever compile).
+        Padded positions write garbage KV into slots past t0 — harmless:
+        paged attention masks by lens, and each decode step overwrites its
+        slot before reading it."""
+        W = self.weights
+
+        def prefill(ids, k_pages_all, v_pages_all, tables, t0):
+            """ids [b, t_pad]; t0 = true prompt length (dynamic)."""
+            b = ids.shape[0]
+            h = jnp.take(W["emb"], ids, axis=0).astype(self.kv_dtype)
+            pos_ids = jnp.broadcast_to(jnp.arange(t_pad)[None, :],
+                                       (b, t_pad))
+            new_k, new_v = [], []
+            for li, wset in enumerate(W["layers"]):
+                q, k, v = self._layer_qkv(wset, h, pos_ids)
+                attn = self._attn_dense(q, k, v)
+                h = self._layer_tail(wset, h, attn)
+                # scatter every sequence's kv into its pages at once
+                pos = jnp.arange(t_pad)[None, :]
+                slots = (tables[jnp.arange(b)[:, None],
+                                pos // self.page_size]
+                         * self.page_size + pos % self.page_size)  # [b,t]
+                kp = k_pages_all[li].reshape(-1, self.nh, self.hd)
+                vp = v_pages_all[li].reshape(-1, self.nh, self.hd)
+                kp = kp.at[slots].set(k.astype(self.kv_dtype))
+                vp = vp.at[slots].set(v.astype(self.kv_dtype))
+                new_k.append(kp.reshape(self.n_pages, self.page_size,
+                                        self.nh, self.hd))
+                new_v.append(vp.reshape(self.n_pages, self.page_size,
+                                        self.nh, self.hd))
+            h = _rms(h, W["norm"], W["eps"])
+            h_last = jax.lax.dynamic_index_in_dim(h, t0 - 1, axis=1)
+            logits = _mm(h_last, W["head"], self.interpret)
+            return logits[:, 0], new_k, new_v
+
+        return jax.jit(prefill, donate_argnums=(1, 2))
+
+    # -- decode step ----------------------------------------------------------
+    def _build_step(self):
+        W = self.weights
+        p = self.page_size
+
+        def step(tok, k_pages_all, v_pages_all, tables, lens):
+            """tok [b]; lens [b] = tokens already in cache (position of this
+            token). One token for EVERY slot; masked by caller."""
+            b = tok.shape[0]
+            h = jnp.take(W["emb"], tok[:, None], axis=0).astype(self.kv_dtype)
+            pos_ids = lens[:, None]                      # ragged positions
+            new_k, new_v = [], []
+            for li, wset in enumerate(W["layers"]):
+                q, k, v = self._layer_qkv(wset, h, pos_ids)
+                # write this token's kv at each sequence's slot
+                slots = (tables[jnp.arange(b), lens // p] * p + lens % p)
+                kp = k_pages_all[li].reshape(-1, self.nh, self.hd)
+                vp = v_pages_all[li].reshape(-1, self.nh, self.hd)
+                kp = kp.at[slots].set(k[:, 0].astype(self.kv_dtype))
+                vp = vp.at[slots].set(v[:, 0].astype(self.kv_dtype))
+                kp = kp.reshape(self.n_pages, p, self.nh, self.hd)
+                vp = vp.reshape(self.n_pages, p, self.nh, self.hd)
+                new_k.append(kp)
+                new_v.append(vp)
+                attn = paged_attention(q[:, 0], kp, vp, tables, lens + 1,
+                                       interpret=self.interpret)
+                h = self._layer_tail(wset, h, attn[:, None])
+            h = _rms(h, W["norm"], W["eps"])
+            logits = _mm(h, W["head"], self.interpret)
+            return logits[:, 0], new_k, new_v
+
+        return jax.jit(step, donate_argnums=(1, 2))
+
+    def _reset_kv(self):
+        """Fresh pools + allocator — a failed call's donated buffers are
+        gone, and so is every in-flight sequence's cache."""
+        L = self.cfg.num_hidden_layers
+        shape = (self.n_pages, self.page_size, self.nh, self.hd)
+        self.k_pages = [jnp.zeros(shape, self.kv_dtype) for _ in range(L)]
+        self.v_pages = [jnp.zeros(shape, self.kv_dtype) for _ in range(L)]
+        self.allocator = PageAllocator(self.n_pages)
+
+    # -- public -------------------------------------------------------------
+    def generate(self, input_ids, max_new_tokens=32, eos_token_id=None,
+                 do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+                 seed=0):
+        """Decode with greedy or top-k/top-p sampling. input_ids: [b, t0]
+        equal-length prompts. Returns [b, t0+n]."""
+        from ..models.generation import _sample
+        ids = np.asarray(input_ids.numpy() if isinstance(input_ids, Tensor)
+                         else input_ids)
+        b, t0 = ids.shape
+        assert b <= self.max_batch
+        assert t0 + max_new_tokens <= self.max_len
+
+        # allocate pages for each sequence (padded-prefill garbage slots
+        # included, so allocate through the padded length)
+        t_pad = min(-(-t0 // self.page_size) * self.page_size, self.max_len)
+        need = -(-max(t_pad, t0 + max_new_tokens) // self.page_size)
+        tables_np = np.zeros((b, self.max_pages_per_seq), np.int32)
+        seq_pages = []
+        for i in range(b):
+            pages = [self.allocator.alloc() for _ in range(need)]
+            seq_pages.append(pages)
+            tables_np[i, :need] = pages
+        tables = jnp.asarray(tables_np)
+
+        prefill = self._prefill_fns.get(t_pad)
+        if prefill is None:
+            prefill = self._build_prefill(t_pad)
+            self._prefill_fns[t_pad] = prefill
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+
+        ids_pad = np.zeros((b, t_pad), np.int64)
+        ids_pad[:, :t0] = ids
+        key = jax.random.key(seed)
+        ok = False
+        try:
+            logits, k_pages, v_pages = prefill(
+                jnp.asarray(ids_pad), self.k_pages, self.v_pages, tables, t0)
+            key, sub = jax.random.split(key)
+            tok = _sample(logits, sub, do_sample, temperature, top_k, top_p)
+            lens = jnp.full((b,), t0, jnp.int32)
+            out = [np.asarray(tok)[:, None]]
+            for _ in range(max_new_tokens - 1):
+                logits, k_pages, v_pages = self._step_fn(
+                    tok, k_pages, v_pages, tables, lens)
+                key, sub = jax.random.split(key)
+                tok = _sample(logits, sub, do_sample, temperature, top_k,
+                              top_p)
+                lens = lens + 1
+                out.append(np.asarray(tok)[:, None])
+                if eos_token_id is not None and np.all(
+                        out[-1] == eos_token_id):
+                    break
+            ok = True
+        finally:
+            if ok:
+                self.k_pages, self.v_pages = k_pages, v_pages
+                for pages in seq_pages:
+                    self.allocator.free(pages)
+            else:
+                # donated buffers may be gone mid-flight: rebuild the pool
+                self._reset_kv()
+        return np.concatenate([ids] + out, axis=1)

@@ -1,0 +1,64 @@
+"""LLM serving engine (VERDICT round-1 #6): paged-KV decode matches the
+dense-cache generate() path token-for-token; int8 weight-only engine runs;
+page allocator recycles."""
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.generation import generate
+from paddle_tpu.inference.serving import LLMEngine, PageAllocator
+
+
+def tiny_model():
+    paddle.seed(3)
+    cfg = LlamaConfig.tiny()
+    return LlamaForCausalLM(cfg), cfg
+
+
+class TestPageAllocator:
+    def test_alloc_free_cycle(self):
+        a = PageAllocator(4)
+        pages = [a.alloc() for _ in range(4)]
+        assert sorted(pages) == [0, 1, 2, 3]
+        with pytest.raises(RuntimeError):
+            a.alloc()
+        a.free(pages[:2])
+        assert a.available == 2
+
+
+class TestLLMEngine:
+    def test_paged_decode_matches_dense_generate(self):
+        model, cfg = tiny_model()
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (2, 12)).astype(np.int64)
+        ref = generate(model, ids, max_new_tokens=8)
+        eng = LLMEngine(model, max_len=64, page_size=16, max_batch=2)
+        got = eng.generate(ids, max_new_tokens=8)
+        np.testing.assert_array_equal(got, ref)
+
+    def test_pages_recycled_across_calls(self):
+        model, cfg = tiny_model()
+        eng = LLMEngine(model, max_len=32, page_size=16, max_batch=2)
+        free0 = eng.allocator.available
+        ids = np.random.RandomState(1).randint(
+            0, cfg.vocab_size, (2, 8)).astype(np.int64)
+        eng.generate(ids, max_new_tokens=4)
+        assert eng.allocator.available == free0
+        eng.generate(ids, max_new_tokens=4)  # second call reuses pages
+        assert eng.allocator.available == free0
+
+    def test_int8_engine_decodes(self):
+        model, cfg = tiny_model()
+        rng = np.random.RandomState(2)
+        ids = rng.randint(0, cfg.vocab_size, (1, 8)).astype(np.int64)
+        ref = generate(model, ids, max_new_tokens=6)
+        eng = LLMEngine(model, max_len=32, page_size=16, max_batch=1,
+                        quant="int8")
+        got = eng.generate(ids, max_new_tokens=6)
+        assert got.shape == ref.shape
+        # int8 rounding may flip late tokens; the continuation must at
+        # least start identically (same argmax under ~1% weight error)
+        assert np.array_equal(got[:, :ids.shape[1] + 2],
+                              ref[:, :ids.shape[1] + 2]), (got, ref)

@@ -111,7 +111,10 @@ def apply(fn, *inputs, n_outputs=1, name="", **kwargs):
     if not needs_grad:
         out = call(*raws)
         _maybe_check_nan_inf(name, out)
-        return _record_produced(_wrap_outputs(out, n_outputs, stop_gradient=True))
+        wrapped = _record_produced(
+            _wrap_outputs(out, n_outputs, stop_gradient=True))
+        _maybe_record_static(name, call, tensors, raws, wrapped)
+        return wrapped
 
     # Differentiate only w.r.t. inexact inputs (jax.vjp rejects int primals
     # having cotangents anyway; we pass all and drop int cotangents).
@@ -129,8 +132,29 @@ def apply(fn, *inputs, n_outputs=1, name="", **kwargs):
         odtypes,
         name=name,
     )
-    return _record_produced(
+    wrapped = _record_produced(
         _wrap_outputs(out, n_outputs, stop_gradient=False, node=node))
+    _maybe_record_static(name, call, tensors, raws, wrapped)
+    return wrapped
+
+
+def _maybe_record_static(name, call, tensors, raws, wrapped):
+    """Static-mode recording: under `static.program_guard` every dispatched
+    op appends an OpDesc to the active Program — the single funnel the
+    reference routes through OperatorWithKernel::Run (SURVEY §1: both
+    dispatch choke points end at the same registry; here they ARE the same
+    function)."""
+    from ..static.program import current_program
+    prog = current_program()
+    if prog is None:
+        return
+    ins = []
+    for t, r in zip(tensors, raws):
+        if t is None:
+            t = Tensor(r, stop_gradient=True)  # baked constant -> leaf var
+        ins.append(t)
+    outs = wrapped if isinstance(wrapped, tuple) else (wrapped,)
+    prog.record_op(name, call, ins, outs)
 
 
 def _record_produced(wrapped):

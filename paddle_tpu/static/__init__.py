@@ -1,85 +1,199 @@
-"""paddle.static analog.
+"""paddle.static analog — a REAL recorded-program static mode.
 
-The reference's static mode (ProgramDesc + InterpreterCore,
-ref: paddle/fluid/framework/new_executor/interpretercore.cc) maps to
-jit-compiled callables here: a "Program" is a traced jax computation and the
-Executor invokes it. This module keeps the reference's API shape for source
-compatibility; `paddle.enable_static()` is a no-op because eager + jit covers
-both modes on TPU (SURVEY §7: "XLA is the executor").
+ref: paddle/fluid/framework/ ProgramDesc + OperatorWithKernel::Run +
+new_executor/interpretercore.cc; python/paddle/fluid/framework.py
+(Program/program_guard) and executor.py (Executor:921).
+
+TPU-native design (static/program.py): under `program_guard` (or after
+`paddle.enable_static()`), every dispatched op records an OpDesc into the
+active Program — build-then-run, with introspection (print(program) lists
+vars/ops), a pass framework (static/passes.py: dce, amp, elementwise
+fusion), append_backward, and an Executor that REPLAYS the recorded graph
+as one jit-compiled program over feeds + live parameters. XLA plays
+InterpreterCore; the Program is the IR the reference's passes needed.
 """
+import contextlib
+
+import numpy as np
+
 from ..jit import InputSpec, TracedFunction
+from ..tensor.tensor import Tensor
+from .program import Program, current_program, _recording_stack
+from . import passes  # noqa: F401  (registers the built-in passes)
 
-
-class Program:
-    def __init__(self):
-        self._fn = None
-
-    def global_block(self):
-        return self
-
-    def clone(self, for_test=False):
-        return self
+_default_main = [None]
+_static_mode = [False]
 
 
 def default_main_program():
-    return Program()
+    if _default_main[0] is None:
+        _default_main[0] = Program()
+    return _default_main[0]
 
 
 def default_startup_program():
+    # parameter init happens eagerly at Layer construction on TPU; the
+    # startup program exists for API shape and records nothing
     return Program()
 
 
+def in_static_mode():
+    return _static_mode[0]
+
+
+def enable_static():
+    """paddle.enable_static analog: ops dispatched from here on record
+    into the default main program."""
+    if not _static_mode[0]:
+        _static_mode[0] = True
+        _recording_stack.append(default_main_program())
+
+
+def disable_static():
+    if _static_mode[0]:
+        _static_mode[0] = False
+        if _recording_stack and _recording_stack[-1] is _default_main[0]:
+            _recording_stack.pop()
+        _default_main[0] = None
+
+
+@contextlib.contextmanager
 def program_guard(main_program=None, startup_program=None):
-    import contextlib
-
-    @contextlib.contextmanager
-    def _guard():
-        yield
-    return _guard()
-
-
-class Executor:
-    """API-shim over jit/XLA execution (ref: fluid/executor.py:921 Executor,
-    framework/new_executor/interpretercore.cc — XLA is the interpreter)."""
-
-    def __init__(self, place=None):
-        self.place = place
-
-    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
-        from ..jit.export import ExportedProgram
-        import numpy as _np
-        import jax as _jax
-        if isinstance(program, ExportedProgram):
-            feed = feed or {}
-            from ..tensor.tensor import Tensor as _Tensor
-            arrays = [feed[n] for n in program.input_names]
-            arrays = [a.data if isinstance(a, _Tensor) else _np.asarray(a)
-                      for a in arrays]
-            outs = program(*arrays)
-            if fetch_list:
-                names = program.output_names
-                idx = [names.index(f) if isinstance(f, str) else int(f)
-                       for f in fetch_list]
-                outs = [outs[i] for i in idx]
-            return [_np.asarray(_jax.device_get(o)) for o in outs]
-        if callable(program):
-            out = program(**(feed or {}))
-            return out if isinstance(out, (list, tuple)) else [out]
-        raise NotImplementedError(
-            "static Program execution: pass an ExportedProgram (from "
-            "load_inference_model) or wrap your computation in "
-            "paddle_tpu.jit.to_static; graph-IR programs are not used on TPU")
+    """ref: fluid/framework.py program_guard — ops record into
+    `main_program` inside the context."""
+    prog = main_program if main_program is not None else Program()
+    _recording_stack.append(prog)
+    try:
+        yield prog
+    finally:
+        _recording_stack.pop()
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    return InputSpec(shape, dtype, name)
+    """ref: static/input.py data — a feed placeholder. In a recording
+    context this returns a zero Tensor registered as a feed var; outside
+    one it degrades to an InputSpec for jit tracing."""
+    prog = current_program()
+    if prog is None:
+        return InputSpec(shape, dtype, name)
+    import jax.numpy as jnp
+    shp = [1 if (s is None or s < 0) else int(s) for s in shape]
+    t = Tensor(jnp.zeros(shp, jnp.dtype(dtype)))
+    t.stop_gradient = True
+    prog.add_feed(t, name)
+    t.name = name
+    return t
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """ref: fluid/backward.py append_backward."""
+    prog = current_program() or default_main_program()
+    return prog.append_backward(loss, parameter_list)
+
+
+class Executor:
+    """Replays recorded Programs as jit-compiled XLA computations
+    (ref: fluid/executor.py:921; the interpreter is XLA —
+    interpretercore.cc's dependency analysis/GC are compiler work here)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        from ..jit.export import ExportedProgram
+        import jax as _jax
+
+        # deployment artifacts (load_inference_model) still run directly
+        if isinstance(program, ExportedProgram):
+            return self._run_exported(program, feed, fetch_list)
+        if callable(program) and not isinstance(program, Program):
+            out = program(**(feed or {}))
+            return out if isinstance(out, (list, tuple)) else [out]
+
+        prog = program if isinstance(program, Program) \
+            else default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+
+        # resolve fetch targets: Tensors recorded in the program, or
+        # "<param>@GRAD" names from append_backward
+        fetch_ids = []
+        grad_names = [g for _, g in prog._params_marked]
+        want_grads = []
+        for f in fetch_list:
+            if isinstance(f, str) and f in grad_names:
+                want_grads.append(grad_names.index(f))
+                fetch_ids.append(None)
+            elif isinstance(f, Tensor):
+                fetch_ids.append(id(f))
+            elif isinstance(f, str):
+                matches = [vid for vid, v in prog.vars.items()
+                           if v.name == f]
+                if not matches:
+                    raise KeyError(f"fetch var {f!r} not in program")
+                fetch_ids.append(matches[0])
+            else:
+                raise TypeError(f"bad fetch entry {f!r}")
+
+        real_fetch = [v for v in fetch_ids if v is not None]
+        with_grads = bool(want_grads) and prog._loss_id is not None
+        key = (id(prog), prog._version, tuple(real_fetch), with_grads)
+        jitted = self._cache.get(key)
+        if jitted is None:
+            pure = prog.build_callable(real_fetch, with_grads=with_grads)
+            jitted = _jax.jit(pure)
+            self._cache[key] = jitted
+
+        feed_arrays = []
+        for vid in prog.feed_order:
+            name = prog.vars[vid].name
+            if name not in feed:
+                raise KeyError(f"missing feed {name!r}")
+            a = feed[name]
+            feed_arrays.append(a.data if isinstance(a, Tensor)
+                               else np.asarray(a))
+        leaf_arrays = [prog.vars[vid].tensor.data
+                       for vid in prog.leaf_ids()]
+        outs = jitted(feed_arrays, leaf_arrays)
+        n_real = len(real_fetch)
+        vals = list(outs[:n_real])
+        grads = list(outs[n_real:])
+        results = []
+        it = iter(vals)
+        for f, vid in zip(fetch_list, fetch_ids):
+            if vid is None:
+                gi = grad_names.index(f)
+                results.append(np.asarray(_jax.device_get(grads[gi])))
+            else:
+                results.append(np.asarray(_jax.device_get(next(it))))
+        return results
+
+    def _run_exported(self, program, feed, fetch_list):
+        import jax as _jax
+        feed = feed or {}
+        arrays = [feed[n] for n in program.input_names]
+        arrays = [a.data if isinstance(a, Tensor) else np.asarray(a)
+                  for a in arrays]
+        outs = program(*arrays)
+        if fetch_list:
+            names = program.output_names
+            idx = [names.index(f) if isinstance(f, str) else int(f)
+                   for f in fetch_list]
+            outs = [outs[i] for i in idx]
+        return [np.asarray(_jax.device_get(o)) for o in outs]
 
 
 def save(program, model_path, **kwargs):
     """ref: python/paddle/static/io.py save — persists the trainable state.
-    Here `program` is a Layer or a dict-like state holder."""
+    `program` may be a recorded Program (its leaf params) or a Layer."""
     from ..framework.io import save as _save
-    state = program.state_dict() if hasattr(program, "state_dict") else program
+    if isinstance(program, Program):
+        state = {program.vars[vid].name: program.vars[vid].tensor
+                 for vid in program.leaf_ids()}
+    else:
+        state = program.state_dict() if hasattr(program, "state_dict") \
+            else program
     _save(state, model_path + ".pdparams")
 
 
@@ -87,6 +201,13 @@ def load(program, model_path, executor=None, var_names=None):
     """ref: python/paddle/static/io.py load."""
     from ..framework.io import load as _load
     state = _load(model_path + ".pdparams")
+    if isinstance(program, Program):
+        by_name = {program.vars[vid].name: program.vars[vid].tensor
+                   for vid in program.leaf_ids()}
+        for name, value in state.items():
+            if name in by_name:
+                by_name[name].set_value(value)
+        return state
     if hasattr(program, "set_state_dict"):
         program.set_state_dict(state)
     return state
@@ -99,17 +220,15 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     save_inference_model — same artifact contract, StableHLO payload).
 
     TPU-native signature: `feed_vars` are InputSpecs (as returned by
-    `static.data`) and the computation is `program` (a Layer or callable
-    over Tensors); `fetch_vars` may be that callable when `program` is None,
-    mirroring common reference usage where fetch targets pin the subgraph.
-    """
+    `static.data` outside a guard) and the computation is `program` (a
+    Layer or callable over Tensors); `fetch_vars` may be that callable when
+    `program` is None."""
     from ..jit.export import export_program
     target = program if program is not None else fetch_vars
     if not callable(target):
         raise TypeError(
             "save_inference_model on TPU serializes a traced callable: pass "
-            "program=<Layer or fn over Tensors> (graph-IR fetch_vars from a "
-            "reference ProgramDesc do not exist here)")
+            "program=<Layer or fn over Tensors>")
     feed = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
     prog = export_program(target, feed)
     return prog.save(path_prefix)
@@ -126,7 +245,12 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
 class amp:
     @staticmethod
     def decorate(*args, **kwargs):
-        raise NotImplementedError("static amp: use paddle_tpu.amp")
+        """ref: static/amp decorate — as a program transform, apply the
+        auto_mixed_precision pass to the recorded program."""
+        from .passes import new_pass
+        prog = current_program() or default_main_program()
+        new_pass("auto_mixed_precision").apply(prog)
+        return args[0] if args else None
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):

@@ -1,0 +1,155 @@
+"""Program-IR pass framework.
+
+ref: python/paddle/distributed/passes/pass_base.py (PassBase, register,
+new_pass, apply over ProgramDesc) + framework/ir's 251 pass files. The
+TPU build needs far fewer passes — XLA does fusion/layout — but the
+FRAMEWORK must exist so strategy features (amp, dce, fusion hints) are
+program transforms, not ad hoc rewrites.
+
+A pass rewrites program.ops / op.call closures in place. Registered by
+name; `new_pass(name, **attrs).apply(program, ...)` mirrors the reference
+API.
+"""
+import jax.numpy as jnp
+
+_PASSES = {}
+
+
+def register_pass(name):
+    """ref: pass_base.py register_pass."""
+    def deco(cls):
+        _PASSES[name] = cls
+        cls.name = name
+        return cls
+    return deco
+
+
+def new_pass(name, **attrs):
+    """ref: pass_base.py new_pass."""
+    cls = _PASSES.get(name)
+    if cls is None:
+        raise KeyError(f"no pass registered as {name!r}; "
+                       f"known: {sorted(_PASSES)}")
+    return cls(**attrs)
+
+
+class PassBase:
+    def apply(self, program, **kwargs):
+        raise NotImplementedError
+
+
+@register_pass("dead_code_elimination")
+class DeadCodeEliminationPass(PassBase):
+    """Drop ops whose outputs never reach the fetch targets
+    (ref: framework/ir dead-code passes; the new executor's GC makes this
+    mostly a compile-time hygiene matter on TPU, but unfetched branches
+    still cost trace time)."""
+
+    def apply(self, program, fetch_vars=None, **kwargs):
+        if not fetch_vars:
+            return program
+        live = {id(v) for v in fetch_vars}
+        if program._loss_id is not None:
+            live.add(program._loss_id)
+        kept = []
+        for op in reversed(program.ops):
+            if any(o in live for o in op.out_ids):
+                kept.append(op)
+                live.update(op.in_ids)
+        removed = len(program.ops) - len(kept)
+        program.ops = list(reversed(kept))
+        program._version += 1
+        self.removed = removed
+        return program
+
+
+# ops worth computing in bf16 on the MXU (the reference's AMP white list,
+# ref: fluid/contrib/mixed_precision lists + static/amp)
+_AMP_WHITE = {"matmul", "mm", "bmm", "mv", "conv2d", "einsum",
+              "sdpa", "inner", "outer", "addmm", "linear"}
+
+
+@register_pass("auto_mixed_precision")
+class AutoMixedPrecisionPass(PassBase):
+    """Rewrite white-list ops to compute in bf16 and cast back
+    (ref: static/amp decorate/O2 — a program transform, not an eager
+    context manager)."""
+
+    def __init__(self, dtype="bfloat16", white_list=None):
+        self.dtype = jnp.dtype(dtype)
+        self.white = set(white_list) if white_list else set(_AMP_WHITE)
+
+    def apply(self, program, **kwargs):
+        n = 0
+        for op in program.ops:
+            if op.type not in self.white:
+                continue
+            orig = op.call
+            tgt = self.dtype
+
+            def amp_call(*arrays, _orig=orig, _tgt=tgt):
+                cast = [a.astype(_tgt)
+                        if hasattr(a, "dtype")
+                        and jnp.issubdtype(a.dtype, jnp.floating) else a
+                        for a in arrays]
+                out = _orig(*cast)
+                # preserve the recorded output dtype contract
+                def back(o, ref_dtype):
+                    if hasattr(o, "dtype") and jnp.issubdtype(
+                            o.dtype, jnp.floating):
+                        return o.astype(ref_dtype)
+                    return o
+                if isinstance(out, (tuple, list)):
+                    return type(out)(back(o, arrays[0].dtype) for o in out)
+                ref = next((a.dtype for a in arrays
+                            if hasattr(a, "dtype")
+                            and jnp.issubdtype(a.dtype, jnp.floating)),
+                           None)
+                return back(out, ref) if ref is not None else out
+
+            op.call = amp_call
+            op.attrs["amp"] = str(tgt)
+            n += 1
+        program._version += 1
+        self.rewritten = n
+        return program
+
+
+@register_pass("fuse_elementwise")
+class FuseElementwisePass(PassBase):
+    """Fuse chains of single-consumer elementwise ops into one OpDesc so
+    the replayed program mirrors the fused kernel structure (XLA fuses
+    the math either way — this shrinks the op list and trace size;
+    ref: framework/ir fuse_elewise_add_act passes)."""
+
+    _ELEMENTWISE = {"add", "subtract", "multiply", "divide", "relu", "gelu",
+                    "tanh", "sigmoid", "exp", "scale", "cast", "silu"}
+
+    def apply(self, program, **kwargs):
+        fused = 0
+        i = 0
+        while i < len(program.ops) - 1:
+            a, b = program.ops[i], program.ops[i + 1]
+            # fuse a->b when b's ONLY tensor input is a's single output
+            if (a.type in self._ELEMENTWISE and b.type in self._ELEMENTWISE
+                    and len(a.out_ids) == 1 and a.out_ids[0] in b.in_ids
+                    and all(v == a.out_ids[0] for v in b.in_ids)
+                    and not any(a.out_ids[0] in op.in_ids
+                                for op in program.ops[i + 2:])):
+                a_call, b_call = a.call, b.call
+                arity = len(b.in_ids)
+
+                def fused_call(*arrays, _a=a_call, _b=b_call, _n=arity):
+                    mid = _a(*arrays)
+                    return _b(*([mid] * _n))
+
+                a.call = fused_call
+                a.type = f"{a.type}+{b.type}"
+                a.out_ids = b.out_ids
+                del program.ops[i + 1]
+                fused += 1
+                continue
+            i += 1
+        program._version += 1
+        self.fused = fused
+        return program

@@ -1,0 +1,203 @@
+"""Static program IR: recorded op graph + replay executor.
+
+ref: paddle/fluid/framework/ — ProgramDesc/BlockDesc/OpDesc
+(program_desc.h), the static dispatch funnel OperatorWithKernel::Run
+(operator.h:614), and the new executor (new_executor/interpretercore.cc).
+
+TPU-native shape: the eager dispatch chokepoint (ops.apply) doubles as the
+static RECORDER — under `program_guard` every op appends an OpDesc
+(op name, kernel closure, input/output var ids, concrete shapes/dtypes)
+to the active Program, exactly the reference's build-then-run split. The
+Program is introspectable (str(program) lists ops and vars, the pass
+framework rewrites the op list) and REPLAYABLE: Executor.run builds a
+pure function that walks the recorded ops over an environment of feeds +
+parameters and jit-compiles it — InterpreterCore's job done by XLA.
+Gradients: append_backward marks params and replays the graph under
+jax.grad (the analog of backward.py's append_backward op insertion).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+# Stack of Programs currently recording (consulted by ops.apply).
+_recording_stack = []
+
+
+def current_program():
+    return _recording_stack[-1] if _recording_stack else None
+
+
+class VarDesc:
+    __slots__ = ("name", "shape", "dtype", "kind", "tensor")
+
+    def __init__(self, name, shape, dtype, kind, tensor=None):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = str(dtype)
+        self.kind = kind  # 'feed' | 'param' | 'intermediate'
+        self.tensor = tensor  # kept alive so id() stays unique
+
+    def __repr__(self):
+        return f"{self.name}: {self.dtype}{list(self.shape)} ({self.kind})"
+
+
+class OpDesc:
+    __slots__ = ("type", "call", "in_ids", "out_ids", "attrs")
+
+    def __init__(self, type, call, in_ids, out_ids, attrs=None):
+        self.type = type or "unnamed"
+        self.call = call          # pure fn(*arrays) -> array | tuple
+        self.in_ids = list(in_ids)
+        self.out_ids = list(out_ids)
+        self.attrs = attrs or {}
+
+    def __repr__(self):
+        return f"{self.type}({len(self.in_ids)} in, {len(self.out_ids)} out)"
+
+
+class Program:
+    """Recorded op graph (ref: framework/program_desc.h ProgramDesc;
+    single block — control flow lives inside kernels as lax ops)."""
+
+    def __init__(self):
+        self.ops = []
+        self.vars = {}          # id -> VarDesc
+        self.feed_order = []    # ids of feed vars in declaration order
+        self._version = 0
+        self._params_marked = []   # (param_tensor, grad_name) from
+        #                            append_backward
+        self._loss_id = None
+
+    # -- recording (called from ops.apply) ----------------------------------
+    def _ensure_var(self, t, kind="intermediate", name=None):
+        vid = id(t)
+        if vid not in self.vars:
+            self.vars[vid] = VarDesc(
+                name or f"var_{len(self.vars)}", tuple(t.shape),
+                t.dtype, kind, tensor=t)
+        return vid
+
+    def add_feed(self, t, name):
+        vid = self._ensure_var(t, kind="feed", name=name)
+        self.vars[vid].kind = "feed"
+        self.feed_order.append(vid)
+        return vid
+
+    def record_op(self, name, call, in_tensors, out_tensors, attrs=None):
+        in_ids = []
+        for t in in_tensors:
+            vid = self._ensure_var(t)
+            # a touched-but-never-produced var is a parameter/constant
+            in_ids.append(vid)
+        out_ids = []
+        for t in out_tensors:
+            vid = self._ensure_var(t)
+            self.vars[vid].kind = "intermediate"
+            out_ids.append(vid)
+        self.ops.append(OpDesc(name, call, in_ids, out_ids, attrs))
+        self._version += 1
+
+    # -- introspection ------------------------------------------------------
+    def global_block(self):
+        return self
+
+    @property
+    def produced_ids(self):
+        out = set()
+        for op in self.ops:
+            out.update(op.out_ids)
+        return out
+
+    def leaf_ids(self):
+        """Vars consumed but never produced and not feeds = params."""
+        produced = self.produced_ids
+        feeds = set(self.feed_order)
+        leaves = []
+        for op in self.ops:
+            for vid in op.in_ids:
+                if vid not in produced and vid not in feeds \
+                        and vid not in leaves:
+                    leaves.append(vid)
+        return leaves
+
+    def all_parameters(self):
+        return [self.vars[vid].tensor for vid in self.leaf_ids()]
+
+    def clone(self, for_test=False):
+        p = Program()
+        p.ops = list(self.ops)
+        p.vars = dict(self.vars)
+        p.feed_order = list(self.feed_order)
+        return p
+
+    def __str__(self):
+        lines = [f"Program({len(self.ops)} ops, {len(self.vars)} vars)"]
+        for vid in self.feed_order:
+            lines.append(f"  feed  {self.vars[vid]}")
+        for vid in self.leaf_ids():
+            lines.append(f"  param {self.vars[vid]}")
+        for i, op in enumerate(self.ops):
+            ins = ", ".join(self.vars[v].name for v in op.in_ids)
+            outs = ", ".join(self.vars[v].name for v in op.out_ids)
+            lines.append(f"  {i:3d}: {outs} = {op.type}({ins})")
+        return "\n".join(lines)
+
+    # -- autodiff mark ------------------------------------------------------
+    def append_backward(self, loss, parameter_list=None):
+        """ref: fluid/backward.py append_backward — marks the loss and the
+        params; Executor computes grads by replaying under jax.grad.
+        Returns [(param_tensor, grad_fetch_name)]."""
+        self._loss_id = id(loss)
+        params = parameter_list or self.all_parameters()
+        self._params_marked = [(p, f"{self.vars[id(p)].name}@GRAD")
+                               for p in params if id(p) in self.vars]
+        self._version += 1
+        return self._params_marked
+
+    # -- replay -------------------------------------------------------------
+    def build_callable(self, fetch_ids, with_grads=False):
+        """Pure replay fn(feed_arrays, leaf_arrays) -> fetch arrays
+        (+ param grads). The compiled-program analog of
+        InterpreterCore::Run."""
+        ops = list(self.ops)
+        feed_ids = list(self.feed_order)
+        leaf_ids = self.leaf_ids()
+        loss_id = self._loss_id
+        grad_param_ids = [id(p) for p, _ in self._params_marked]
+
+        def replay(env):
+            for op in ops:
+                args = [env[v] for v in op.in_ids]
+                outs = op.call(*args)
+                if not isinstance(outs, (tuple, list)):
+                    outs = (outs,)
+                for vid, o in zip(op.out_ids, outs):
+                    env[vid] = o
+            return env
+
+        def pure(feed_arrays, leaf_arrays):
+            env = dict(zip(feed_ids, feed_arrays))
+            env.update(zip(leaf_ids, leaf_arrays))
+            env = replay(env)
+            fetches = [env[f] for f in fetch_ids]
+            if not with_grads:
+                return fetches
+
+            grad_pos = [leaf_ids.index(pid) for pid in grad_param_ids]
+
+            def loss_of(grad_leaves):
+                e = dict(zip(feed_ids, feed_arrays))
+                full = list(leaf_arrays)
+                for pos, arr in zip(grad_pos, grad_leaves):
+                    full[pos] = arr
+                e.update(zip(leaf_ids, full))
+                e = replay(e)
+                return e[loss_id].astype(jnp.float32).sum()
+
+            grads = jax.grad(loss_of)(
+                [leaf_arrays[p] for p in grad_pos])
+            return fetches + list(grads)
+
+        return pure

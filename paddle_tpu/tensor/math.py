@@ -18,10 +18,12 @@ def _t(x):
 
 
 # ---- unary ----------------------------------------------------------------
-def _unary(name, fn):
+def _unary(opname, fn):
+    # `name=None` is the reference's tensor-naming kwarg; the OP name for
+    # dispatch/recording is the factory argument (shadowing bug fixed)
     def op(x, name=None):
-        return apply(fn, _t(x), name=name or "")
-    op.__name__ = name
+        return apply(fn, _t(x), name=opname)
+    op.__name__ = opname
     return op
 
 
@@ -97,11 +99,11 @@ def _promote(fn):
     return wrapped
 
 
-def _binary(name, fn):
+def _binary(opname, fn):
     def op(x, y, name=None):
         x, y = _coerce_pair(x, y)
-        return apply(fn, x, y, name=name or "")
-    op.__name__ = name
+        return apply(fn, x, y, name=opname)
+    op.__name__ = opname
     return op
 
 

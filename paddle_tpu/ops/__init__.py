@@ -138,16 +138,22 @@ def apply(fn, *inputs, n_outputs=1, name="", **kwargs):
     return wrapped
 
 
+_static_recording_stack = None  # bound lazily; [] check is the fast path
+
+
 def _maybe_record_static(name, call, tensors, raws, wrapped):
     """Static-mode recording: under `static.program_guard` every dispatched
     op appends an OpDesc to the active Program — the single funnel the
     reference routes through OperatorWithKernel::Run (SURVEY §1: both
     dispatch choke points end at the same registry; here they ARE the same
-    function)."""
-    from ..static.program import current_program
-    prog = current_program()
-    if prog is None:
+    function). The fast path is one list-truthiness check."""
+    global _static_recording_stack
+    if _static_recording_stack is None:
+        from ..static.program import _recording_stack
+        _static_recording_stack = _recording_stack
+    if not _static_recording_stack:
         return
+    prog = _static_recording_stack[-1]
     ins = []
     for t, r in zip(tensors, raws):
         if t is None:

@@ -76,9 +76,15 @@ def data(name, shape, dtype="float32", lod_level=0):
     prog = current_program()
     if prog is None:
         return InputSpec(shape, dtype, name)
+    if any(s is None or int(s) < 0 for s in shape):
+        raise ValueError(
+            f"static.data({name!r}, {shape}): recorded programs are "
+            f"shape-specialized (op kernels capture concrete shapes at "
+            f"record time). Give every dim a concrete size and build one "
+            f"program per batch size, or use paddle_tpu.jit.to_static for "
+            f"dynamic-batch tracing.")
     import jax.numpy as jnp
-    shp = [1 if (s is None or s < 0) else int(s) for s in shape]
-    t = Tensor(jnp.zeros(shp, jnp.dtype(dtype)))
+    t = Tensor(jnp.zeros([int(s) for s in shape], jnp.dtype(dtype)))
     t.stop_gradient = True
     prog.add_feed(t, name)
     t.name = name

@@ -58,6 +58,12 @@ class DeadCodeEliminationPass(PassBase):
                 live.update(op.in_ids)
         removed = len(program.ops) - len(kept)
         program.ops = list(reversed(kept))
+        # prune feeds only eliminated ops consumed, so the Executor stops
+        # demanding data the program provably ignores
+        used = set()
+        for op in program.ops:
+            used.update(op.in_ids)
+        program.feed_order = [f for f in program.feed_order if f in used]
         program._version += 1
         self.removed = removed
         return program
@@ -125,15 +131,20 @@ class FuseElementwisePass(PassBase):
     _ELEMENTWISE = {"add", "subtract", "multiply", "divide", "relu", "gelu",
                     "tanh", "sigmoid", "exp", "scale", "cast", "silu"}
 
-    def apply(self, program, **kwargs):
+    def apply(self, program, fetch_vars=None, **kwargs):
+        protected = {id(v) for v in (fetch_vars or [])}
+        if program._loss_id is not None:
+            protected.add(program._loss_id)
         fused = 0
         i = 0
         while i < len(program.ops) - 1:
             a, b = program.ops[i], program.ops[i + 1]
-            # fuse a->b when b's ONLY tensor input is a's single output
+            # fuse a->b when b's ONLY tensor input is a's single output and
+            # that intermediate is neither consumed later nor a fetch target
             if (a.type in self._ELEMENTWISE and b.type in self._ELEMENTWISE
                     and len(a.out_ids) == 1 and a.out_ids[0] in b.in_ids
                     and all(v == a.out_ids[0] for v in b.in_ids)
+                    and a.out_ids[0] not in protected
                     and not any(a.out_ids[0] in op.in_ids
                                 for op in program.ops[i + 2:])):
                 a_call, b_call = a.call, b.call

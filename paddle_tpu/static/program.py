@@ -65,6 +65,7 @@ class Program:
         self.ops = []
         self.vars = {}          # id -> VarDesc
         self.feed_order = []    # ids of feed vars in declaration order
+        self._names_used = set()
         self._version = 0
         self._params_marked = []   # (param_tensor, grad_name) from
         #                            append_backward
@@ -74,9 +75,15 @@ class Program:
     def _ensure_var(self, t, kind="intermediate", name=None):
         vid = id(t)
         if vid not in self.vars:
-            self.vars[vid] = VarDesc(
-                name or f"var_{len(self.vars)}", tuple(t.shape),
-                t.dtype, kind, tensor=t)
+            # prefer the tensor's own name (parameters carry theirs) so
+            # name-based save/load/fetch line up with Layer state_dicts
+            tname = name or getattr(t, "name", None)
+            if not tname or tname in self._names_used:
+                tname = (f"{tname}_{len(self.vars)}" if tname
+                         else f"var_{len(self.vars)}")
+            self._names_used.add(tname)
+            self.vars[vid] = VarDesc(tname, tuple(t.shape),
+                                     t.dtype, kind, tensor=t)
         return vid
 
     def add_feed(self, t, name):
@@ -126,10 +133,16 @@ class Program:
         return [self.vars[vid].tensor for vid in self.leaf_ids()]
 
     def clone(self, for_test=False):
+        """Deep-copies OpDescs so passes applied to the clone cannot
+        mutate this program's kernels (ref: framework.py Program.clone)."""
         p = Program()
-        p.ops = list(self.ops)
+        p.ops = [OpDesc(op.type, op.call, op.in_ids, op.out_ids,
+                        dict(op.attrs)) for op in self.ops]
         p.vars = dict(self.vars)
         p.feed_order = list(self.feed_order)
+        p._names_used = set(self._names_used)
+        p._loss_id = self._loss_id
+        p._params_marked = list(self._params_marked)
         return p
 
     def __str__(self):

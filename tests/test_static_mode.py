@@ -28,8 +28,8 @@ class TestProgramRecording:
         prog, net, x, y, loss = build_mlp_program()
         assert len(prog.ops) >= 4  # 2 matmul+bias, relu, mul/mean
         s = str(prog)
-        assert "feed" in s and "param" in s and "matmul" in s.lower() or \
-            "linear" in s.lower() or len(prog.ops) > 0
+        assert "feed" in s and "param" in s
+        assert "linear" in s.lower()
         # leaf params found: 2 weights + 2 biases
         assert len(prog.all_parameters()) == 4
 
@@ -143,3 +143,62 @@ class TestPasses:
         a = np.random.RandomState(0).randn(2, 4).astype(np.float32)
         (got,) = exe.run(prog, feed={"x": a}, fetch_list=[y])
         np.testing.assert_allclose(got, np.tanh(np.exp(a)), rtol=1e-5)
+
+    def test_fuse_protects_fetch_targets(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4], "float32")
+            y1 = paddle.exp(x)
+            y2 = paddle.tanh(y1)
+        p = new_pass("fuse_elementwise")
+        p.apply(prog, fetch_vars=[y1, y2])
+        exe = static.Executor()
+        a = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        o1, o2 = exe.run(prog, feed={"x": a}, fetch_list=[y1, y2])
+        np.testing.assert_allclose(o1, np.exp(a), rtol=1e-5)
+        np.testing.assert_allclose(o2, np.tanh(np.exp(a)), rtol=1e-5)
+
+    def test_dce_prunes_unused_feeds(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4], "float32")
+            z = static.data("z", [2, 4], "float32")
+            y = paddle.exp(x)
+            _dead = paddle.tanh(z)
+        new_pass("dead_code_elimination").apply(prog, fetch_vars=[y])
+        exe = static.Executor()
+        a = np.zeros((2, 4), np.float32)
+        # z no longer required
+        (got,) = exe.run(prog, feed={"x": a}, fetch_list=[y])
+        np.testing.assert_allclose(got, np.ones((2, 4)), rtol=1e-6)
+
+
+class TestCloneIsolation:
+    def test_pass_on_clone_leaves_original(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            paddle.seed(0)
+            net = nn.Linear(4, 4)
+            x = static.data("x", [2, 4], "float32")
+            y = net(x)
+        test_prog = prog.clone(for_test=True)
+        new_pass("auto_mixed_precision").apply(test_prog)
+        assert any(op.attrs.get("amp") for op in test_prog.ops)
+        assert not any(op.attrs.get("amp") for op in prog.ops)
+
+    def test_dynamic_dims_rejected(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            with pytest.raises(ValueError, match="shape-specialized"):
+                static.data("x", [None, 8], "float32")
+
+    def test_param_names_in_program(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            paddle.seed(0)
+            net = nn.Linear(4, 4)
+            x = static.data("x", [2, 4], "float32")
+            _ = net(x)
+        names = [prog.vars[v].name for v in prog.leaf_ids()]
+        # parameter names come from the tensors, not positional var_N
+        assert not all(n.startswith("var_") for n in names), names

@@ -108,6 +108,25 @@ def apply(fn, *inputs, n_outputs=1, name="", **kwargs):
     else:
         call = fn
 
+    # profiler op-statistics hook (ref: profiler_statistic.py op summary):
+    # live only while a Profiler records — the fast path is one None check
+    global _prof_stat_mod
+    if _prof_stat_mod is None:
+        from ..profiler import statistic as _ps
+        _prof_stat_mod = _ps
+    _pcol = _prof_stat_mod._active_collector
+    if _pcol is not None:
+        import time as _time
+        _t0 = _time.perf_counter()
+        try:
+            return _apply_inner(call, name, tensors, raws, needs_grad,
+                                n_outputs)
+        finally:
+            _pcol.record_op(name, _time.perf_counter() - _t0)
+    return _apply_inner(call, name, tensors, raws, needs_grad, n_outputs)
+
+
+def _apply_inner(call, name, tensors, raws, needs_grad, n_outputs):
     if not needs_grad:
         out = call(*raws)
         _maybe_check_nan_inf(name, out)
@@ -139,6 +158,7 @@ def apply(fn, *inputs, n_outputs=1, name="", **kwargs):
 
 
 _static_recording_stack = None  # bound lazily; [] check is the fast path
+_prof_stat_mod = None           # bound lazily on first apply()
 
 
 def _maybe_record_static(name, call, tensors, raws, wrapped):

@@ -15,6 +15,9 @@ import jax
 
 from . import timer as _timer_mod
 from .timer import Benchmark, benchmark
+from . import statistic as _statistic
+from .statistic import (StatisticCollector, merge_statistics,
+                        render_summary)
 
 
 class ProfilerTarget:
@@ -85,6 +88,9 @@ class RecordEvent:
             self._ann = None
         self.end_ts = time.perf_counter()
         _EVENTS.append((self.name, self.begin_ts, self.end_ts))
+        c = _statistic._collector()
+        if c is not None:
+            c.record_span(self.name, self.begin_ts, self.end_ts)
 
 
 _EVENTS = []
@@ -110,6 +116,10 @@ class Profiler:
         self._export_dir = None
         self._logdir = os.environ.get("PADDLE_TPU_PROFILE_DIR",
                                       "/tmp/paddle_tpu_profile")
+        # statistics tables (ref: profiler_statistic.py): a collector is
+        # live only while this profiler records — per-op timing costs
+        # nothing otherwise
+        self.collector = StatisticCollector()
 
     def __enter__(self):
         self.start()
@@ -129,6 +139,9 @@ class Profiler:
                 self._active = True
             except Exception:
                 self._active = False
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            _statistic._set_collector(self.collector)
         benchmark().begin()
 
     def stop(self):
@@ -140,6 +153,7 @@ class Profiler:
             self._active = False
         if self._on_trace_ready:
             self._on_trace_ready(self)
+        _statistic._set_collector(None)
         benchmark().end()
 
     def step(self, num_samples=None):
@@ -162,6 +176,12 @@ class Profiler:
                 except Exception:
                     pass
             self._state = new_state
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            _statistic._set_collector(self.collector)
+            self.collector.mark_step()
+        else:
+            _statistic._set_collector(None)
         benchmark().step(num_samples)
 
     def step_info(self, unit=None):
@@ -169,14 +189,9 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        lines = ["Profiler summary (host spans):"]
-        agg = {}
-        for name, b, e in _EVENTS:
-            tot, cnt = agg.get(name, (0.0, 0))
-            agg[name] = (tot + (e - b), cnt + 1)
-        for name, (tot, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
-            lines.append(f"  {name}: total={tot*1e3:.3f}ms calls={cnt}")
-        out = "\n".join(lines)
+        """Statistics tables (ref: profiler_statistic.py — op summary,
+        span summary, memory summary)."""
+        out = render_summary(self.collector, sorted_by=sorted_by)
         print(out)
         return out
 

@@ -1,0 +1,161 @@
+"""Profiler statistics tables.
+
+ref: python/paddle/profiler/profiler_statistic.py (2078 LoC — op summary,
+kernel summary, memory summary, sorted tables printed by
+Profiler.summary) and tools/CrossStackProfiler (multi-rank trace merge).
+
+TPU-native sources:
+  - OP events: a lightweight per-dispatch hook on ops.apply (enabled only
+    while a Profiler records — zero overhead otherwise) collecting
+    (op name, wall time, arg bytes);
+  - SPAN events: RecordEvent begin/end timestamps;
+  - MEMORY: device.memory_stats() snapshots per step;
+  - multi-rank: merge_statistics() aggregates per-rank tables the way
+    CrossStackProfiler merges per-rank timelines.
+
+Tables render like the reference's summary() — name / calls / total /
+avg / max / min / percentage — as plain strings.
+"""
+import collections
+import time
+
+OpEvent = collections.namedtuple("OpEvent", "name dur_s")
+SpanEvent = collections.namedtuple("SpanEvent", "name begin end")
+
+# live collector consulted by ops.apply (None = off)
+_active_collector = None
+
+
+class StatisticCollector:
+    def __init__(self):
+        self.op_events = []
+        self.span_events = []
+        self.mem_snapshots = []
+        self.steps = 0
+
+    # -- hooks --------------------------------------------------------------
+    def record_op(self, name, dur_s):
+        self.op_events.append(OpEvent(name or "unnamed", dur_s))
+
+    def record_span(self, name, begin, end):
+        self.span_events.append(SpanEvent(name, begin, end))
+
+    def snapshot_memory(self):
+        from ..device import memory_stats
+        st = memory_stats()
+        if st:
+            self.mem_snapshots.append(st)
+
+    def mark_step(self):
+        self.steps += 1
+        self.snapshot_memory()
+
+    # -- tables -------------------------------------------------------------
+    def op_summary(self):
+        """name -> dict(calls, total, avg, max, min) sorted by total."""
+        agg = {}
+        for ev in self.op_events:
+            d = agg.setdefault(ev.name, dict(calls=0, total=0.0,
+                                             max=0.0, min=float("inf")))
+            d["calls"] += 1
+            d["total"] += ev.dur_s
+            d["max"] = max(d["max"], ev.dur_s)
+            d["min"] = min(d["min"], ev.dur_s)
+        for d in agg.values():
+            d["avg"] = d["total"] / d["calls"]
+        return dict(sorted(agg.items(), key=lambda kv: -kv[1]["total"]))
+
+    def span_summary(self):
+        agg = {}
+        for ev in self.span_events:
+            dur = ev.end - ev.begin
+            d = agg.setdefault(ev.name, dict(calls=0, total=0.0,
+                                             max=0.0, min=float("inf")))
+            d["calls"] += 1
+            d["total"] += dur
+            d["max"] = max(d["max"], dur)
+            d["min"] = min(d["min"], dur)
+        for d in agg.values():
+            d["avg"] = d["total"] / d["calls"]
+        return dict(sorted(agg.items(), key=lambda kv: -kv[1]["total"]))
+
+    def memory_summary(self):
+        if not self.mem_snapshots:
+            return {}
+        peak = max(s.get("peak_bytes_in_use", 0) for s in self.mem_snapshots)
+        last = self.mem_snapshots[-1]
+        return {
+            "peak_bytes_in_use": peak,
+            "bytes_in_use": last.get("bytes_in_use", 0),
+            "bytes_limit": last.get("bytes_limit", 0),
+            "num_allocs": last.get("num_allocs", 0),
+        }
+
+
+def _fmt_time(s):
+    if s >= 1.0:
+        return f"{s:.3f}s"
+    if s >= 1e-3:
+        return f"{s * 1e3:.3f}ms"
+    return f"{s * 1e6:.1f}us"
+
+
+def _render_table(title, agg, total_time=None):
+    """The reference's table layout (profiler_statistic.py _build_table):
+    Name | Calls | Total | Avg | Max | Min | Ratio(%)."""
+    lines = [f"----- {title} -----"]
+    header = (f"{'Name':<32}{'Calls':>8}{'Total':>12}{'Avg':>12}"
+              f"{'Max':>12}{'Min':>12}{'Ratio(%)':>10}")
+    lines.append(header)
+    lines.append("-" * len(header))
+    grand = total_time or sum(d["total"] for d in agg.values()) or 1e-12
+    for name, d in agg.items():
+        ratio = 100.0 * d["total"] / grand
+        lines.append(
+            f"{name[:31]:<32}{d['calls']:>8}{_fmt_time(d['total']):>12}"
+            f"{_fmt_time(d['avg']):>12}{_fmt_time(d['max']):>12}"
+            f"{_fmt_time(d['min']):>12}{ratio:>10.2f}")
+    return "\n".join(lines)
+
+
+def render_summary(collector, sorted_by=None):
+    parts = []
+    ops = collector.op_summary()
+    if ops:
+        parts.append(_render_table("Operator Summary", ops))
+    spans = collector.span_summary()
+    if spans:
+        parts.append(_render_table("UserDefined (RecordEvent) Summary",
+                                   spans))
+    mem = collector.memory_summary()
+    if mem:
+        lines = ["----- Memory Summary -----"]
+        for k, v in mem.items():
+            lines.append(f"{k:<28}{v:>16,}")
+        parts.append("\n".join(lines))
+    if collector.steps:
+        parts.append(f"steps recorded: {collector.steps}")
+    return "\n\n".join(parts) if parts else "(no events recorded)"
+
+
+def merge_statistics(collectors):
+    """Multi-rank aggregation (ref: tools/CrossStackProfiler merging
+    per-rank timelines into the cluster view): op/span events concatenate;
+    memory peaks take the per-rank max."""
+    merged = StatisticCollector()
+    for c in collectors:
+        merged.op_events.extend(c.op_events)
+        merged.span_events.extend(c.span_events)
+        merged.mem_snapshots.extend(c.mem_snapshots)
+        merged.steps = max(merged.steps, c.steps)
+    return merged
+
+
+# -- dispatch hook plumbing (called from ops.apply) -------------------------
+def _collector():
+    return _active_collector
+
+
+def _set_collector(c):
+    global _active_collector
+    _active_collector = c

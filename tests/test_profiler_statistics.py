@@ -1,0 +1,66 @@
+"""Profiler statistics tables (SURVEY §5.1 gap: op/span/memory summaries
++ multi-rank merge, ref: profiler_statistic.py + CrossStackProfiler)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler.statistic import (StatisticCollector,
+                                           merge_statistics, render_summary)
+
+
+class TestOpStatistics:
+    def test_ops_recorded_while_profiling(self):
+        paddle.seed(0)
+        net = nn.Linear(8, 8)
+        x = paddle.randn([4, 8])
+        with profiler.Profiler() as prof:
+            for _ in range(3):
+                y = paddle.tanh(net(x))
+            prof.step()
+        ops = prof.collector.op_summary()
+        assert "linear" in ops and "tanh" in ops, sorted(ops)
+        assert ops["tanh"]["calls"] == 3
+        assert ops["tanh"]["total"] > 0
+        # avg/max/min populated
+        assert ops["linear"]["min"] <= ops["linear"]["avg"] \
+            <= ops["linear"]["max"]
+
+    def test_no_recording_outside_profiler(self):
+        import paddle_tpu.ops as ops_mod
+        from paddle_tpu.profiler import statistic
+        assert statistic._active_collector is None
+        x = paddle.randn([2, 2])
+        _ = paddle.exp(x)  # must not crash or record anywhere
+
+    def test_span_summary_and_tables(self):
+        with profiler.Profiler() as prof:
+            with profiler.RecordEvent("data_loading"):
+                _ = paddle.randn([4, 4])
+            with profiler.RecordEvent("forward"):
+                _ = paddle.exp(paddle.randn([4, 4]))
+        spans = prof.collector.span_summary()
+        assert "data_loading" in spans and "forward" in spans
+        out = prof.summary()
+        assert "Operator Summary" in out
+        assert "RecordEvent" in out
+        assert "Ratio(%)" in out
+
+
+class TestMultiRankMerge:
+    def test_merge_statistics(self):
+        a, b = StatisticCollector(), StatisticCollector()
+        a.record_op("matmul", 0.010)
+        a.record_op("matmul", 0.020)
+        b.record_op("matmul", 0.030)
+        b.record_op("relu", 0.001)
+        a.mem_snapshots.append({"peak_bytes_in_use": 100})
+        b.mem_snapshots.append({"peak_bytes_in_use": 300})
+        m = merge_statistics([a, b])
+        ops = m.op_summary()
+        assert ops["matmul"]["calls"] == 3
+        assert abs(ops["matmul"]["total"] - 0.060) < 1e-9
+        assert m.memory_summary()["peak_bytes_in_use"] == 300
+        text = render_summary(m)
+        assert "matmul" in text and "relu" in text

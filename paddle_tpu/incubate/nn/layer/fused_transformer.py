@@ -40,23 +40,62 @@ class FusedMultiHeadAttention(Layer):
         self.pre_ln = LayerNorm(embed_dim, epsilon) if normalize_before else None
         self.ln = LayerNorm(embed_dim, epsilon)
 
-    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None,
+                time_step=None):
+        """cache: (k_buf, v_buf) Tensors [b, max_len, h, d] for inline-KV
+        decode (ref: fused_multi_transformer_op.cu.h masked MHA — the new
+        token's K/V is written at `time_step` and attention runs over the
+        filled prefix). Returns (out, new_cache) when cache is given."""
         residual = query
         x = self.pre_ln(query) if self.normalize_before else query
         qkv = self.qkv_proj(x)
         b, s = qkv.shape[0], qkv.shape[1]
         qkv = M.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = M.unbind(qkv, axis=2)
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask,
-            dropout_p=self.attn_dropout_rate if self.training else 0.0)
+        new_cache = None
+        if cache is not None:
+            if time_step is None:
+                raise ValueError("cache given without time_step")
+            from ....ops import apply
+            k_buf, v_buf = cache
+            max_len = k_buf.shape[1]
+            t = int(time_step)
+
+            def decode_attn(qa, ka, va, kb, vb):
+                import jax
+                kb = jax.lax.dynamic_update_slice_in_dim(
+                    kb, ka.astype(kb.dtype), t, axis=1)
+                vb = jax.lax.dynamic_update_slice_in_dim(
+                    vb, va.astype(vb.dtype), t, axis=1)
+                # causal over the filled prefix: query i (absolute pos t+i)
+                # sees keys <= t+i; the unfilled tail is masked out
+                kpos = jnp.arange(max_len)[None, :]
+                qpos = (t + jnp.arange(s))[:, None]
+                valid = kpos <= qpos                     # [s, max_len]
+                logits = jnp.einsum("bqhd,bkhd->bhqk", qa, kb) \
+                    / jnp.sqrt(jnp.asarray(self.head_dim, jnp.float32)
+                               ).astype(qa.dtype)
+                logits = jnp.where(valid[None, None], logits,
+                                   jnp.asarray(-1e30, logits.dtype))
+                w = jax.nn.softmax(logits.astype(jnp.float32),
+                                   -1).astype(qa.dtype)
+                out = jnp.einsum("bhqk,bkhd->bqhd", w, vb)
+                return out, kb, vb
+
+            out, nk, nv = apply(decode_attn, q, k, v, k_buf, v_buf,
+                                n_outputs=3, name="fused_mha_decode")
+            new_cache = (nk, nv)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask,
+                dropout_p=self.attn_dropout_rate if self.training else 0.0)
         out = M.reshape(out, [b, s, self.embed_dim])
         out = self.out_proj(out)
         out = F.dropout(out, self.dropout_rate, training=self.training)
         out = residual + out
         if not self.normalize_before:
             out = self.ln(out)
-        return out
+        return out if new_cache is None else (out, new_cache)
 
 
 class FusedFeedForward(Layer):
@@ -110,7 +149,12 @@ class FusedTransformerEncoderLayer(Layer):
                                     act_dropout_rate=act_dropout_rate,
                                     normalize_before=normalize_before)
 
-    def forward(self, src, src_mask=None, cache=None):
+    def forward(self, src, src_mask=None, cache=None, time_step=None):
+        if cache is not None:
+            out, new_cache = self.fused_attn(src, attn_mask=src_mask,
+                                             cache=cache,
+                                             time_step=time_step)
+            return self.ffn(out), new_cache
         out = self.fused_attn(src, attn_mask=src_mask)
         return self.ffn(out)
 
@@ -144,10 +188,34 @@ class FusedMultiTransformer(Layer):
                                          normalize_before=normalize_before)
             for _ in range(num_layers)])
 
+    def gen_cache(self, batch_size, max_len, dtype="float32"):
+        """Preallocate per-layer (k, v) cache buffers
+        (ref: the cache_kvs tensors fed to fused_multi_transformer)."""
+        from ....tensor.creation import zeros
+        return [(zeros([batch_size, max_len, self.num_heads, self.head_dim],
+                       dtype),
+                 zeros([batch_size, max_len, self.num_heads, self.head_dim],
+                       dtype))
+                for _ in self.layers]
+
     def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
                 seq_lens=None, rotary_embs=None, rotary_emb_dims=0,
                 time_step=None):
+        """Decode contract (ref: fused_multi_transformer_op.cu): with
+        `caches` (from gen_cache) and `time_step`, each layer writes the
+        new tokens' K/V inline and attends over the filled prefix;
+        returns (out, new_caches)."""
         out = src
+        if caches is not None:
+            if time_step is None:
+                raise ValueError(
+                    "FusedMultiTransformer: caches given without time_step")
+            new_caches = []
+            for layer, cache in zip(self.layers, caches):
+                out, nc = layer(out, attn_mask, cache=cache,
+                                time_step=time_step)
+                new_caches.append(nc)
+            return out, new_caches
         for layer in self.layers:
             out = layer(out, attn_mask)
-        return out if caches is None else (out, caches)
+        return out

@@ -2,3 +2,5 @@ from .hybrid_parallel_optimizer import HybridParallelOptimizer, \
     HybridParallelClipGrad
 from .dygraph_sharding_optimizer import DygraphShardingOptimizer
 from .hybrid_parallel_gradscaler import HybridParallelGradScaler
+from .dgc_localsgd import (DGCMomentumOptimizer, LocalSGDOptimizer,
+                           GradientMergeOptimizer)

@@ -1,0 +1,145 @@
+"""DGC / LocalSGD / GradientMerge dygraph meta-optimizers.
+
+ref: python/paddle/distributed/fleet/meta_optimizers/
+{dgc_optimizer.py, localsgd_optimizer.py, gradient_merge_optimizer.py} —
+the reference implements them as static program rewrites; here they wrap
+the inner optimizer the way the dygraph hybrid optimizers do.
+
+- DGCMomentumOptimizer: Deep Gradient Compression (Lin et al.) — momentum
+  correction + top-k gradient sparsification with local error feedback
+  (the residual accumulates what wasn't sent); sparse grads are the part
+  that would travel over the wire, dense residual stays local.
+- LocalSGDOptimizer: k local steps, then parameters average across the
+  data-parallel group (ref: localsgd_optimizer.py k_steps).
+- GradientMergeOptimizer: accumulate grads for k steps, then one inner
+  step with the averaged gradient (ref: gradient_merge_optimizer.py).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ...collective import all_reduce, ReduceOp
+from ....tensor.tensor import Tensor
+
+
+class DGCMomentumOptimizer:
+    """ref: meta_optimizers/dgc_optimizer.py (backed by the CUDA dgc op).
+    rampup_begin_step delays compression; sparsity is the DROPPED
+    fraction (0.999 => send top 0.1%)."""
+
+    def __init__(self, inner_optimizer, sparsity=0.999,
+                 rampup_begin_step=0, group=None):
+        self._inner = inner_optimizer
+        self.sparsity = float(sparsity)
+        self.rampup_begin_step = int(rampup_begin_step)
+        self._group = group
+        self._step_count = 0
+        self._residual = {}   # id(param) -> error-feedback buffer
+
+    def _compress(self, p, g):
+        """top-k sparsify with error feedback; returns the sparse grad
+        (dense array with zeros — the wire format would be (idx, val))."""
+        gf = g.astype(jnp.float32)
+        res = self._residual.get(id(p))
+        if res is not None:
+            gf = gf + res
+        flat = gf.reshape(-1)
+        k = max(1, int(flat.shape[0] * (1.0 - self.sparsity)))
+        thresh = jnp.sort(jnp.abs(flat))[-k]
+        mask = jnp.abs(gf) >= thresh
+        sent = jnp.where(mask, gf, 0.0)
+        self._residual[id(p)] = gf - sent   # error feedback
+        return sent
+
+    def step(self):
+        self._step_count += 1
+        if self._step_count > self.rampup_begin_step:
+            for p in self._inner._parameter_list or []:
+                if p.grad is None:
+                    continue
+                sent = self._compress(p, p.grad.data)
+                sparse = Tensor(sent, stop_gradient=True)
+                all_reduce(sparse, op=ReduceOp.AVG, group=self._group)
+                p.grad = Tensor(sparse.data.astype(p.grad.dtype),
+                                stop_gradient=True)
+        self._inner.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class LocalSGDOptimizer:
+    """ref: meta_optimizers/localsgd_optimizer.py — k_steps of purely local
+    updates, then a parameter average over the data-parallel group."""
+
+    def __init__(self, inner_optimizer, k_steps=4, group=None):
+        self._inner = inner_optimizer
+        self.k_steps = int(k_steps)
+        self._group = group
+        self._since_sync = 0
+
+    def step(self):
+        self._inner.step()
+        self._since_sync += 1
+        if self._since_sync >= self.k_steps:
+            self._since_sync = 0
+            for p in self._inner._parameter_list or []:
+                t = Tensor(p.data, stop_gradient=True)
+                all_reduce(t, op=ReduceOp.AVG, group=self._group)
+                p.data = t.data
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class GradientMergeOptimizer:
+    """ref: meta_optimizers/gradient_merge_optimizer.py — merge k micro
+    grads before one real update (avg=True divides by k)."""
+
+    def __init__(self, inner_optimizer, k_steps=4, avg=True):
+        self._inner = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+        self._acc = {}
+        self._count = 0
+
+    def step(self):
+        self._count += 1
+        params = self._inner._parameter_list or []
+        for p in params:
+            if p.grad is None:
+                continue
+            a = self._acc.get(id(p))
+            g = p.grad.data.astype(jnp.float32)
+            self._acc[id(p)] = g if a is None else a + g
+        if self._count < self.k_steps:
+            # not a real step yet: drop this micro-batch's grads
+            for p in params:
+                p.grad = None
+            return
+        scale = 1.0 / self.k_steps if self.avg else 1.0
+        for p in params:
+            a = self._acc.get(id(p))
+            if a is not None:
+                p.grad = Tensor((a * scale).astype(p.dtype),
+                                stop_gradient=True)
+        self._inner.step()
+        self._acc = {}
+        self._count = 0
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)

@@ -1,6 +1,6 @@
 """paddle.optimizer analog (ref: python/paddle/optimizer/__init__.py)."""
 from .optimizer import Optimizer, L1Decay, L2Decay
 from .optimizers import (SGD, Momentum, Adam, AdamW, Adagrad, RMSProp,
-                         Adadelta, Adamax, Lamb)
+                         Adadelta, Adamax, Lamb, LarsMomentum)
 from . import lr
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue)

@@ -220,3 +220,36 @@ class Lamb(Optimizer):
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         return (p - (lr * trust * r).astype(p.dtype),
                 {"moment1": m, "moment2": v})
+
+
+class LarsMomentum(Optimizer):
+    """ref: python/paddle/fluid/optimizer.py LarsMomentumOptimizer (and the
+    fleet lars meta-optimizer) — layer-wise adaptive rate scaling:
+    local_lr = lr * coeff * ||w|| / (||g|| + lambda * ||w||)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, exclude_from_weight_decay=None,
+                 epsilon=1e-9, name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+
+    def _create_state(self, p):
+        return {"velocity": jnp.zeros(p.data.shape, jnp.float32)}
+
+    def _rule(self, p, g, state, lr, t):
+        pf = p.astype(jnp.float32)
+        gf = g.astype(jnp.float32)
+        w_norm = jnp.linalg.norm(pf)
+        g_norm = jnp.linalg.norm(gf)
+        local = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            lr * self._coeff * w_norm
+            / (g_norm + self._lars_wd * w_norm + self._eps),
+            lr)
+        v = self._momentum * state["velocity"] \
+            + local * (gf + self._lars_wd * pf)
+        return (pf - v).astype(p.dtype), {"velocity": v}

@@ -170,3 +170,85 @@ class TestAMP:
         net, opt = paddle.amp.decorate(net, opt, level="O2", dtype="bfloat16")
         assert net.weight.dtype == paddle.bfloat16
         assert opt._multi_precision
+
+
+class TestMetaOptimizers:
+    """fleet meta-optimizer zoo (VERDICT: 'none of the static zoo') —
+    dygraph DGC/LocalSGD/GradientMerge + LARS."""
+
+    def _mlp_and_data(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(16, 4).astype(np.float32))
+        return net, x, y
+
+    def test_lars_momentum_trains(self):
+        net, x, y = self._mlp_and_data()
+        opt = optimizer.LarsMomentum(learning_rate=0.1,
+                                     parameters=net.parameters())
+        losses = []
+        for _ in range(10):
+            loss = F.mse_loss(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_gradient_merge_equals_big_batch(self):
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            GradientMergeOptimizer)
+        # k micro-steps with merge == one step on the averaged grad
+        net, x, y = self._mlp_and_data()
+        inner = optimizer.SGD(0.1, parameters=net.parameters())
+        gm = GradientMergeOptimizer(inner, k_steps=2, avg=True)
+        w_before = net[0].weight.numpy().copy()
+        for i in range(2):
+            loss = F.mse_loss(net(x[i * 8:(i + 1) * 8]),
+                              y[i * 8:(i + 1) * 8])
+            loss.backward()
+            gm.step()
+            gm.clear_grad()
+        w_after = net[0].weight.numpy()
+
+        net2, x2, y2 = self._mlp_and_data()
+        opt2 = optimizer.SGD(0.1, parameters=net2.parameters())
+        l1 = F.mse_loss(net2(x2[:8]), y2[:8])
+        l2 = F.mse_loss(net2(x2[8:]), y2[8:])
+        loss = (l1 + l2) * 0.5
+        loss.backward()
+        opt2.step()
+        np.testing.assert_allclose(w_after, net2[0].weight.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_dgc_sparsifies_and_trains(self):
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            DGCMomentumOptimizer)
+        net, x, y = self._mlp_and_data()
+        inner = optimizer.Momentum(0.05, parameters=net.parameters())
+        dgc = DGCMomentumOptimizer(inner, sparsity=0.75)
+        losses = []
+        for _ in range(12):
+            loss = F.mse_loss(net(x), y)
+            loss.backward()
+            dgc.step()
+            dgc.clear_grad()
+            losses.append(float(loss.numpy()))
+        # error feedback keeps convergence despite 75% dropped entries
+        assert losses[-1] < losses[0] * 0.8, losses
+        assert dgc._residual  # residual buffers live
+
+    def test_localsgd_syncs_every_k(self):
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            LocalSGDOptimizer)
+        net, x, y = self._mlp_and_data()
+        inner = optimizer.SGD(0.05, parameters=net.parameters())
+        ls = LocalSGDOptimizer(inner, k_steps=3)
+        for i in range(7):
+            loss = F.mse_loss(net(x), y)
+            loss.backward()
+            ls.step()
+            ls.clear_grad()
+        assert ls._since_sync == 1  # 7 = 2 syncs + 1 local

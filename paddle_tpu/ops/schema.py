@@ -1,0 +1,147 @@
+"""Op schema registry — the introspectable op surface.
+
+ref: paddle/phi/api/yaml/ (ops.yaml 149 + legacy_ops.yaml 195 op schemas
+driving codegen of the C++ API, grad rules, docs and coverage tooling).
+
+TPU-native inversion: ops here are plain Python functions over a single
+dispatch chokepoint, so the schema is DERIVED from the live API instead of
+driving codegen — one introspectable table with, per op:
+  name, module, signature, docstring, backends (xla and/or pallas from the
+  kernel registry), differentiability (tape vjp by construction).
+
+What it drives (the yaml layer's three consumers):
+  - docs: generate_op_reference() renders the op-reference markdown;
+  - coverage: tests assert every public op carries a schema and the
+    OpTest ledger can be cross-checked against it;
+  - tooling: all_schemas()/get_schema() are the `paddle.ops.yaml`-style
+    lookup surface for external tools.
+"""
+import inspect
+
+API_MODULES = (
+    "paddle_tpu.tensor.math",
+    "paddle_tpu.tensor.manipulation",
+    "paddle_tpu.tensor.creation",
+    "paddle_tpu.tensor.logic",
+    "paddle_tpu.tensor.linalg",
+    "paddle_tpu.tensor.search",
+    "paddle_tpu.tensor.stat",
+    "paddle_tpu.tensor.einsum",
+    "paddle_tpu.nn.functional.activation",
+    "paddle_tpu.nn.functional.attention",
+    "paddle_tpu.nn.functional.common",
+    "paddle_tpu.nn.functional.conv",
+    "paddle_tpu.nn.functional.loss",
+    "paddle_tpu.nn.functional.norm",
+    "paddle_tpu.nn.functional.pooling",
+    "paddle_tpu.nn.functional.vision",
+)
+
+
+class OpSchema:
+    __slots__ = ("name", "module", "signature", "doc", "backends",
+                 "differentiable")
+
+    def __init__(self, name, module, signature, doc, backends,
+                 differentiable):
+        self.name = name
+        self.module = module
+        self.signature = signature
+        self.doc = doc
+        self.backends = backends
+        self.differentiable = differentiable
+
+    def __repr__(self):
+        return (f"OpSchema({self.module}.{self.name}{self.signature}, "
+                f"backends={self.backends})")
+
+
+_NON_DIFF_PREFIXES = ("is", "equal", "not_equal", "greater", "less",
+                      "logical", "bitwise", "arg", "nonzero", "searchsorted",
+                      "bucketize", "unique", "count", "allclose", "isclose")
+
+
+# public fn name -> kernel-registry op name, where they differ
+_REGISTRY_ALIASES = {
+    "scaled_dot_product_attention": "sdpa",
+    "flash_attention": "sdpa",
+}
+
+
+def _registered_backends(name):
+    from . import _KERNELS
+    impls = _KERNELS.get(_REGISTRY_ALIASES.get(name, name))
+    if impls:
+        return tuple(sorted(impls))
+    return ("xla",)  # default lowering
+
+
+def _collect():
+    import importlib
+    table = {}
+    for modname in API_MODULES:
+        mod = importlib.import_module(modname)
+        short = modname.rsplit(".", 1)[-1]
+        for n, f in sorted(vars(mod).items()):
+            if n.startswith("_") or not callable(f):
+                continue
+            if getattr(f, "__module__", "") != mod.__name__:
+                continue
+            try:
+                sig = str(inspect.signature(f))
+            except (TypeError, ValueError):
+                sig = "(...)"
+            doc = (inspect.getdoc(f) or "").split("\n")[0]
+            diff = not n.startswith(_NON_DIFF_PREFIXES)
+            key = f"{short}.{n}"
+            table[key] = OpSchema(n, short, sig, doc,
+                                  _registered_backends(n), diff)
+    return table
+
+
+_table = None
+
+
+def all_schemas():
+    global _table
+    if _table is None:
+        _table = _collect()
+    return _table
+
+
+def get_schema(name):
+    """Lookup by 'module.op' or bare op name (first match)."""
+    table = all_schemas()
+    if name in table:
+        return table[name]
+    for key, s in table.items():
+        if s.name == name:
+            return s
+    raise KeyError(f"no op schema for {name!r}")
+
+
+def generate_op_reference():
+    """Render the op-reference markdown (the docs artifact the reference
+    generates from ops.yaml)."""
+    table = all_schemas()
+    by_mod = {}
+    for key, s in table.items():
+        by_mod.setdefault(s.module, []).append(s)
+    lines = ["# Op reference (generated from the live op schema)",
+             "",
+             f"{len(table)} public ops across {len(by_mod)} modules. "
+             "Backends: `xla` = default XLA lowering; `pallas` = "
+             "hand-written TPU kernel override.",
+             ""]
+    for mod in sorted(by_mod):
+        lines.append(f"## {mod}")
+        lines.append("")
+        lines.append("| op | signature | backends | notes |")
+        lines.append("|---|---|---|---|")
+        for s in sorted(by_mod[mod], key=lambda s: s.name):
+            sig = s.signature.replace("|", "\\|")
+            doc = s.doc.replace("|", "\\|")[:90]
+            lines.append(f"| `{s.name}` | `{sig}` | "
+                         f"{', '.join(s.backends)} | {doc} |")
+        lines.append("")
+    return "\n".join(lines)

@@ -72,3 +72,8 @@ class ViterbiDecoder:
 
     def __call__(self, potentials, lengths=None):
         return viterbi_decode(potentials, self.transitions, lengths)
+
+from .sequence import (sequence_pad, sequence_unpad, sequence_mask,
+                       sequence_reverse, sequence_softmax, sequence_expand,
+                       sequence_pool, sequence_first_step,
+                       sequence_last_step)

@@ -1,0 +1,164 @@
+"""Sequence op family.
+
+ref: paddle/fluid/operators/sequence_ops/ (sequence_pad_op, sequence_
+unpad_op, sequence_expand_op, sequence_reverse_op, sequence_softmax_op,
+sequence_erase_op ...) — the reference operates on LoD (ragged) tensors;
+the TPU-native form is PADDED-DENSE + explicit lengths (static shapes for
+XLA), the same convention the rest of this framework and the reference's
+own sequence_pad/unpad pair use at the boundary.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops import apply
+from ..tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def sequence_pad(x, lengths, maxlen=None, pad_value=0.0):
+    """Ragged rows (concatenated [sum(len), ...] + lengths) -> padded
+    [batch, maxlen, ...] + lengths (ref: sequence_pad_op). Host-side
+    segmentation (lengths are data-dependent shapes), jax math per row."""
+    xt = _t(x)
+    lens = np.asarray(lengths.data if isinstance(lengths, Tensor)
+                      else lengths).astype(np.int64)
+    ml = int(maxlen) if maxlen is not None else int(lens.max())
+    arr = xt.data
+    rows = []
+    off = 0
+    for n in lens:
+        n = int(n)
+        seg = arr[off:off + n]
+        pad = ml - n
+        if pad > 0:
+            widths = [(0, pad)] + [(0, 0)] * (seg.ndim - 1)
+            seg = jnp.pad(seg, widths, constant_values=pad_value)
+        else:
+            seg = seg[:ml]
+        rows.append(seg)
+        off += n
+    out = jnp.stack(rows)
+    return Tensor(out), Tensor(jnp.asarray(lens))
+
+
+def sequence_unpad(x, length):
+    """Padded [batch, maxlen, ...] -> concatenated ragged [sum(len), ...]
+    (ref: sequence_unpad_op)."""
+    xt = _t(x)
+    lens = np.asarray(length.data if isinstance(length, Tensor)
+                      else length).astype(np.int64)
+    segs = [xt.data[i, :int(n)] for i, n in enumerate(lens)]
+    return Tensor(jnp.concatenate(segs, axis=0))
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    """[b] lengths -> [b, maxlen] 0/1 mask (ref: sequence_mask op)."""
+    lt = _t(lengths)
+    ml = maxlen
+    if ml is None:
+        ml = int(np.asarray(lt.data).max())
+
+    def fn(l):
+        return (jnp.arange(ml)[None, :] < l[:, None]).astype(
+            jnp.dtype(dtype))
+
+    return apply(fn, lt, name="sequence_mask")
+
+
+def sequence_reverse(x, lengths=None):
+    """Reverse each sequence IN ITS VALID PREFIX, padding stays in place
+    (ref: sequence_reverse_op)."""
+    xt = _t(x)
+    if lengths is None:
+        return apply(lambda a: jnp.flip(a, axis=1), xt,
+                     name="sequence_reverse")
+    lt = _t(lengths)
+
+    def fn(a, l):
+        b, m = a.shape[0], a.shape[1]
+        pos = jnp.arange(m)[None, :]
+        src = jnp.where(pos < l[:, None], l[:, None] - 1 - pos, pos)
+        return jnp.take_along_axis(
+            a, src.reshape(b, m, *([1] * (a.ndim - 2))).astype(jnp.int32)
+            if a.ndim > 2 else src.astype(jnp.int32), axis=1) \
+            if a.ndim == 2 else jnp.take_along_axis(
+                a, jnp.broadcast_to(
+                    src.reshape(b, m, *([1] * (a.ndim - 2))),
+                    a.shape).astype(jnp.int32), axis=1)
+
+    return apply(fn, xt, lt, name="sequence_reverse")
+
+
+def sequence_softmax(x, lengths):
+    """Softmax over each row's valid prefix; padded positions get 0
+    (ref: sequence_softmax_op)."""
+    xt, lt = _t(x), _t(lengths)
+
+    def fn(a, l):
+        m = a.shape[1]
+        valid = jnp.arange(m)[None, :] < l[:, None]
+        logits = jnp.where(valid, a, -jnp.inf)
+        p = jax.nn.softmax(logits.astype(jnp.float32), axis=1)
+        return jnp.where(valid, p, 0.0).astype(a.dtype)
+
+    return apply(fn, xt, lt, name="sequence_softmax")
+
+
+def sequence_expand(x, repeat_times):
+    """Repeat each row i `repeat_times[i]` times (ref: sequence_expand_op,
+    LoD-expand degenerated to per-row repeats in padded-dense form)."""
+    xt = _t(x)
+    reps = np.asarray(repeat_times.data if isinstance(repeat_times, Tensor)
+                      else repeat_times).astype(np.int64)
+    segs = [jnp.repeat(xt.data[i:i + 1], int(r), axis=0)
+            for i, r in enumerate(reps) if int(r) > 0]
+    return Tensor(jnp.concatenate(segs, axis=0))
+
+
+def sequence_first_step(x, lengths=None):
+    """First valid element per sequence (ref: sequence_pool 'first')."""
+    xt = _t(x)
+    return apply(lambda a: a[:, 0], xt, name="sequence_first_step")
+
+
+def sequence_last_step(x, lengths):
+    """Last VALID element per sequence (ref: sequence_pool 'last')."""
+    xt, lt = _t(x), _t(lengths)
+
+    def fn(a, l):
+        idx = jnp.clip(l - 1, 0, a.shape[1] - 1).astype(jnp.int32)
+        return jnp.take_along_axis(
+            a, idx.reshape(-1, 1, *([1] * (a.ndim - 2))), axis=1)[:, 0]
+
+    return apply(fn, xt, lt, name="sequence_last_step")
+
+
+def sequence_pool(x, lengths, pool_type="sum"):
+    """Masked pooling over the valid prefix (ref: sequence_pool_op:
+    sum/average/max/sqrt)."""
+    xt, lt = _t(x), _t(lengths)
+    pool_type = pool_type.lower()
+    if pool_type not in ("sum", "average", "max", "sqrt"):
+        raise ValueError(f"bad pool_type {pool_type}")
+
+    def fn(a, l):
+        m = a.shape[1]
+        valid = jnp.arange(m)[None, :] < l[:, None]
+        vshape = valid.reshape(valid.shape[0], m, *([1] * (a.ndim - 2)))
+        if pool_type == "max":
+            masked = jnp.where(vshape, a, -jnp.inf)
+            return jnp.max(masked, axis=1)
+        s = jnp.sum(jnp.where(vshape, a, 0), axis=1)
+        if pool_type == "sum":
+            return s
+        denom = jnp.maximum(l, 1).astype(s.dtype)
+        denom = denom.reshape(-1, *([1] * (s.ndim - 1)))
+        if pool_type == "average":
+            return s / denom
+        return s / jnp.sqrt(denom)
+
+    return apply(fn, xt, lt, name="sequence_pool")

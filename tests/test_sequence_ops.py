@@ -1,0 +1,83 @@
+"""Sequence op family (ref: fluid/operators/sequence_ops/ — padded-dense
+TPU forms with explicit lengths)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text.sequence import (sequence_pad, sequence_unpad,
+                                      sequence_mask, sequence_reverse,
+                                      sequence_softmax, sequence_expand,
+                                      sequence_pool, sequence_first_step,
+                                      sequence_last_step)
+
+
+class TestSequenceOps:
+    def test_pad_unpad_roundtrip(self):
+        rng = np.random.RandomState(0)
+        flat = rng.randn(9, 4).astype(np.float32)  # lengths 2,3,4
+        lens = np.array([2, 3, 4])
+        padded, out_lens = sequence_pad(paddle.to_tensor(flat), lens,
+                                        pad_value=-1.0)
+        assert tuple(padded.shape) == (3, 4, 4)
+        np.testing.assert_array_equal(np.asarray(out_lens.data), lens)
+        assert np.all(np.asarray(padded.data)[0, 2:] == -1.0)
+        back = sequence_unpad(padded, lens)
+        np.testing.assert_allclose(np.asarray(back.data), flat, rtol=1e-6)
+
+    def test_mask(self):
+        m = sequence_mask(paddle.to_tensor(np.array([1, 3])), maxlen=4)
+        np.testing.assert_array_equal(
+            np.asarray(m.data), [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+    def test_reverse_valid_prefix(self):
+        x = np.arange(12, dtype=np.float32).reshape(2, 3, 2)
+        out = sequence_reverse(paddle.to_tensor(x),
+                               paddle.to_tensor(np.array([2, 3])))
+        got = np.asarray(out.data)
+        np.testing.assert_array_equal(got[0, 0], x[0, 1])  # swapped
+        np.testing.assert_array_equal(got[0, 2], x[0, 2])  # padding fixed
+        np.testing.assert_array_equal(got[1], x[1, ::-1])
+
+    def test_softmax_masks_padding(self):
+        x = np.zeros((2, 3), np.float32)
+        out = sequence_softmax(paddle.to_tensor(x),
+                               paddle.to_tensor(np.array([2, 3])))
+        got = np.asarray(out.data)
+        np.testing.assert_allclose(got[0], [0.5, 0.5, 0.0], rtol=1e-5)
+        np.testing.assert_allclose(got[1], [1 / 3] * 3, rtol=1e-5)
+
+    def test_expand(self):
+        x = np.array([[1.0], [2.0]], np.float32)
+        out = sequence_expand(paddle.to_tensor(x), np.array([2, 3]))
+        np.testing.assert_allclose(np.asarray(out.data).ravel(),
+                                   [1, 1, 2, 2, 2])
+
+    def test_pool_variants(self):
+        x = np.array([[[1.0], [2.0], [5.0]],
+                      [[3.0], [4.0], [7.0]]], np.float32)
+        lens = paddle.to_tensor(np.array([2, 3]))
+        xt = paddle.to_tensor(x)
+        np.testing.assert_allclose(
+            np.asarray(sequence_pool(xt, lens, "sum").data).ravel(),
+            [3.0, 14.0])
+        np.testing.assert_allclose(
+            np.asarray(sequence_pool(xt, lens, "average").data).ravel(),
+            [1.5, 14.0 / 3])
+        np.testing.assert_allclose(
+            np.asarray(sequence_pool(xt, lens, "max").data).ravel(),
+            [2.0, 7.0])
+        np.testing.assert_allclose(
+            np.asarray(sequence_first_step(xt).data).ravel(), [1.0, 3.0])
+        np.testing.assert_allclose(
+            np.asarray(sequence_last_step(xt, lens).data).ravel(),
+            [2.0, 7.0])
+
+    def test_pool_grad(self):
+        x = paddle.to_tensor(np.ones((2, 3, 1), np.float32))
+        x.stop_gradient = False
+        lens = paddle.to_tensor(np.array([2, 3]))
+        out = sequence_pool(x, lens, "sum").sum()
+        out.backward()
+        # grads only flow to valid positions
+        np.testing.assert_allclose(
+            x.grad.numpy().ravel(), [1, 1, 0, 1, 1, 1])

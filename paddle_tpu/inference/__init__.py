@@ -140,6 +140,27 @@ class Predictor:
             cpu = jax.devices("cpu")[0]
             self._program.params = [jax.device_put(p, cpu)
                                     for p in self._program.params]
+        # precision knob honored-or-rejected (never silently ignored): a
+        # serialized StableHLO program has baked dtypes, so reduced
+        # precision must be chosen at EXPORT time — requesting it against
+        # an fp32 artifact raises with the fix instead of no-op'ing
+        prec = getattr(config, "_precision", PrecisionType.Float32)
+        if prec in (PrecisionType.Half, PrecisionType.Bfloat16):
+            floating = [p for p in self._program.params
+                        if jnp.issubdtype(p.dtype, jnp.floating)]
+            if floating and all(p.dtype == jnp.float32 for p in floating):
+                raise ValueError(
+                    f"Config precision={prec!r} but this artifact was "
+                    f"exported with float32 weights; re-export the model "
+                    f"in bf16 (cast params before jit.save) or use the "
+                    f"int8 serving engine (paddle_tpu.inference.serving."
+                    f"LLMEngine(quant='int8')). StableHLO programs are "
+                    f"dtype-specialized at export.")
+        if prec == PrecisionType.Int8:
+            raise ValueError(
+                "Config precision=int8: use paddle_tpu.inference.serving."
+                "LLMEngine(quant='int8') — int8 weight-only decode is the "
+                "supported int8 path on TPU.")
         self._inputs = {n: _IOHandle(n) for n in self._program.input_names}
         self._outputs = {n: _IOHandle(n) for n in self._program.output_names}
 

@@ -9,9 +9,12 @@ single-process run over the full batch. Rank 0 dumps final params.
 import os
 import sys
 
-import jax
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 1)
+if __name__ == "__main__":
+    # worker-process jax config; must NOT run when the test process
+    # imports this module for build_model (its backend is already live)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)
 
 import numpy as np  # noqa: E402
 

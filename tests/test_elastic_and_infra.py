@@ -58,9 +58,11 @@ class TestFlags:
 class TestProfiler:
     def test_record_event_and_summary(self):
         from paddle_tpu import profiler
-        with profiler.RecordEvent("my_span"):
-            _ = paddle.matmul(paddle.randn([32, 32]), paddle.randn([32, 32]))
-        out = profiler.Profiler(timer_only=True).summary()
+        with profiler.Profiler(timer_only=True) as prof:
+            with profiler.RecordEvent("my_span"):
+                _ = paddle.matmul(paddle.randn([32, 32]),
+                                  paddle.randn([32, 32]))
+        out = prof.summary()
         assert "my_span" in out
 
     def test_profiler_steps(self):

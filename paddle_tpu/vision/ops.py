@@ -38,9 +38,178 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     return Tensor(keep)
 
 
-def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
-              box_normalized=True, axis=0):
-    raise NotImplementedError("box_coder: planned")
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """ref: fluid/operators/detection/box_coder_op — SSD-style box
+    encode/decode between priors and targets.
+
+    encode: t = ((target_center - prior_center)/prior_size,
+                 log(target_size/prior_size)) / var
+    decode: the inverse applied to prior boxes.
+    prior_box [M, 4] (xmin,ymin,xmax,ymax); prior_box_var [M, 4] or 4-list;
+    target_box: encode [N, 4]; decode [N, M, 4] (axis=0) — returns [N, M, 4].
+    """
+    import numpy as _np
+    pb = prior_box.data if isinstance(prior_box, Tensor) else jnp.asarray(
+        prior_box)
+    tb = target_box.data if isinstance(target_box, Tensor) else jnp.asarray(
+        target_box)
+    if prior_box_var is None:
+        var = jnp.ones((1, 4), pb.dtype)
+    elif isinstance(prior_box_var, (list, tuple)):
+        var = jnp.asarray(prior_box_var, pb.dtype).reshape(1, 4)
+    else:
+        var = (prior_box_var.data if isinstance(prior_box_var, Tensor)
+               else jnp.asarray(prior_box_var)).astype(pb.dtype)
+
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+
+    if code_type in ("encode_center_size", "encode"):
+        # tb [N, 4] against every prior -> [N, M, 4]
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        ex = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        ey = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ew = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        eh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([ex, ey, ew, eh], axis=-1) / var[None, :, :]
+        return Tensor(out)
+    elif code_type in ("decode_center_size", "decode"):
+        # tb [N, M, 4] deltas (or [N, 4] broadcast over priors via axis)
+        if tb.ndim == 2:
+            tb = tb[:, None, :]
+        d = tb * var[None, :, :]
+        dcx = d[..., 0] * pw[None, :] + pcx[None, :]
+        dcy = d[..., 1] * ph[None, :] + pcy[None, :]
+        dw = jnp.exp(d[..., 2]) * pw[None, :]
+        dh = jnp.exp(d[..., 3]) * ph[None, :]
+        out = jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                         dcx + dw * 0.5 - norm, dcy + dh * 0.5 - norm],
+                        axis=-1)
+        return Tensor(out)
+    raise ValueError(f"bad code_type {code_type!r}")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """ref: fluid/operators/detection/prior_box_op — SSD prior (anchor)
+    generation over a feature map. input [N,C,H,W], image [N,C,IH,IW].
+    Returns (boxes [H,W,K,4], variances [H,W,K,4])."""
+    import numpy as _np
+    H, W = int(input.shape[2]), int(input.shape[3])
+    IH, IW = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or IW / W
+    step_h = steps[1] or IH / H
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    whs = []
+    for ms in min_sizes:
+        ms = float(ms)
+        for ar in ars:
+            whs.append((ms * _np.sqrt(ar), ms / _np.sqrt(ar)))
+    if max_sizes:
+        for ms, mx in zip(min_sizes, max_sizes):
+            s = _np.sqrt(float(ms) * float(mx))
+            whs.append((s, s))
+    whs = _np.asarray(whs, _np.float32)  # [K, 2]
+    K = whs.shape[0]
+
+    cx = (_np.arange(W, dtype=_np.float32) + offset) * step_w
+    cy = (_np.arange(H, dtype=_np.float32) + offset) * step_h
+    cxg, cyg = _np.meshgrid(cx, cy)          # [H, W]
+    boxes = _np.empty((H, W, K, 4), _np.float32)
+    boxes[..., 0] = (cxg[:, :, None] - whs[None, None, :, 0] / 2) / IW
+    boxes[..., 1] = (cyg[:, :, None] - whs[None, None, :, 1] / 2) / IH
+    boxes[..., 2] = (cxg[:, :, None] + whs[None, None, :, 0] / 2) / IW
+    boxes[..., 3] = (cyg[:, :, None] + whs[None, None, :, 1] / 2) / IH
+    if clip:
+        boxes = _np.clip(boxes, 0.0, 1.0)
+    vars_ = _np.broadcast_to(_np.asarray(variance, _np.float32),
+                             boxes.shape).copy()
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(vars_))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5, name=None):
+    """ref: fluid/operators/detection/yolo_box_op — decode YOLOv3 head
+    output [N, K*(5+C), H, W] into boxes [N, H*W*K, 4] + scores
+    [N, H*W*K, C]."""
+    xd = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    imgs = (img_size.data if isinstance(img_size, Tensor)
+            else jnp.asarray(img_size))
+    N, _, H, W = xd.shape
+    K = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(K, 2)
+    feat = xd.reshape(N, K, 5 + class_num, H, W)
+
+    gx = jnp.arange(W, dtype=jnp.float32)[None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[:, None]
+    sig = jax.nn.sigmoid
+    bx = (gx[None, None] + sig(feat[:, :, 0]) * scale_x_y
+          - (scale_x_y - 1) / 2) / W
+    by = (gy[None, None] + sig(feat[:, :, 1]) * scale_x_y
+          - (scale_x_y - 1) / 2) / H
+    input_w = downsample_ratio * W
+    input_h = downsample_ratio * H
+    bw = jnp.exp(feat[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    bh = jnp.exp(feat[:, :, 3]) * an[None, :, 1, None, None] / input_h
+    conf = sig(feat[:, :, 4])
+    probs = sig(feat[:, :, 5:])                     # [N,K,C,H,W]
+    scores = conf[:, :, None] * probs               # [N,K,C,H,W]
+
+    im_h = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+    im_w = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+    x0 = (bx - bw / 2) * im_w
+    y0 = (by - bh / 2) * im_h
+    x1 = (bx + bw / 2) * im_w
+    y1 = (by + bh / 2) * im_h
+    if clip_bbox:
+        x0 = jnp.clip(x0, 0, im_w - 1)
+        y0 = jnp.clip(y0, 0, im_h - 1)
+        x1 = jnp.clip(x1, 0, im_w - 1)
+        y1 = jnp.clip(y1, 0, im_h - 1)
+    boxes = jnp.stack([x0, y0, x1, y1], axis=-1)    # [N,K,H,W,4]
+    boxes = boxes.reshape(N, K * H * W, 4)
+    scores = jnp.moveaxis(scores, 2, -1).reshape(N, K * H * W, class_num)
+    # zero out low-confidence predictions (the op's conf_thresh contract)
+    keep = (conf.reshape(N, K * H * W, 1) >= conf_thresh)
+    boxes = jnp.where(keep, boxes, 0.0)
+    scores = jnp.where(keep, scores, 0.0)
+    return Tensor(boxes), Tensor(scores)
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """ref: fluid/operators/detection/iou_similarity_op — pairwise IoU
+    [N, 4] x [M, 4] -> [N, M]."""
+    xa = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    ya = y.data if isinstance(y, Tensor) else jnp.asarray(y)
+    norm = 0.0 if box_normalized else 1.0
+    ax = jnp.maximum(xa[:, None, 0], ya[None, :, 0])
+    ay = jnp.maximum(xa[:, None, 1], ya[None, :, 1])
+    bx = jnp.minimum(xa[:, None, 2], ya[None, :, 2])
+    by = jnp.minimum(xa[:, None, 3], ya[None, :, 3])
+    iw = jnp.clip(bx - ax + norm, 0)
+    ih = jnp.clip(by - ay + norm, 0)
+    inter = iw * ih
+    area = lambda b: (b[:, 2] - b[:, 0] + norm) * (b[:, 3] - b[:, 1] + norm)
+    union = area(xa)[:, None] + area(ya)[None, :] - inter
+    return Tensor(inter / jnp.maximum(union, 1e-10))
 
 
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,

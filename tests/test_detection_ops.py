@@ -1,0 +1,76 @@
+"""Detection op family (ref: fluid/operators/detection/ — box_coder,
+prior_box, yolo_box, iou_similarity)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.ops import (box_coder, prior_box, yolo_box,
+                                   iou_similarity, nms)
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.RandomState(0)
+        priors = np.array([[0.1, 0.1, 0.5, 0.5],
+                           [0.2, 0.3, 0.7, 0.9]], np.float32)
+        var = [0.1, 0.1, 0.2, 0.2]
+        targets = np.array([[0.15, 0.12, 0.55, 0.50],
+                            [0.05, 0.05, 0.80, 0.70],
+                            [0.3, 0.3, 0.6, 0.6]], np.float32)
+        enc = box_coder(paddle.to_tensor(priors), var,
+                        paddle.to_tensor(targets), "encode_center_size")
+        assert tuple(enc.shape) == (3, 2, 4)
+        dec = box_coder(paddle.to_tensor(priors), var, enc,
+                        "decode_center_size")
+        # decoding the encoding reproduces each target against each prior
+        got = np.asarray(dec.data)
+        for i in range(3):
+            for j in range(2):
+                np.testing.assert_allclose(got[i, j], targets[i],
+                                           rtol=1e-4, atol=1e-5)
+
+
+class TestPriorBox:
+    def test_shapes_and_centers(self):
+        feat = paddle.zeros([1, 8, 4, 4])
+        img = paddle.zeros([1, 3, 64, 64])
+        boxes, vars_ = prior_box(feat, img, min_sizes=[16.0],
+                                 aspect_ratios=[2.0], flip=True, clip=True)
+        # K = 1 (ar=1) + 2 (ar=2 flipped) = 3
+        assert tuple(boxes.shape) == (4, 4, 3, 4)
+        assert tuple(vars_.shape) == (4, 4, 3, 4)
+        b = np.asarray(boxes.data)
+        assert b.min() >= 0.0 and b.max() <= 1.0
+        # first cell's square prior centered at (8, 8)/64 = 0.125
+        cx = (b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2
+        np.testing.assert_allclose(cx, 0.125, atol=1e-5)
+
+
+class TestYoloBox:
+    def test_decodes_shapes_and_threshold(self):
+        rng = np.random.RandomState(0)
+        N, C, H, W = 1, 3, 4, 4
+        K = 2
+        x = rng.randn(N, K * (5 + C), H, W).astype(np.float32)
+        img = np.array([[32, 32]], np.int64)
+        boxes, scores = yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                                 anchors=[10, 13, 16, 30], class_num=C,
+                                 conf_thresh=0.5, downsample_ratio=8)
+        assert tuple(boxes.shape) == (N, K * H * W, 4)
+        assert tuple(scores.shape) == (N, K * H * W, C)
+        b = np.asarray(boxes.data)
+        assert b.min() >= 0.0 and b.max() <= 31.0 + 1e-6
+        # zeroed below-threshold entries exist (random logits ~50% pass)
+        s = np.asarray(scores.data)
+        assert (np.all(s == 0, axis=-1)).any()
+
+
+class TestIouSimilarity:
+    def test_pairwise_iou(self):
+        a = np.array([[0, 0, 2, 2]], np.float32)
+        b = np.array([[0, 0, 2, 2], [1, 1, 3, 3], [4, 4, 5, 5]], np.float32)
+        got = np.asarray(iou_similarity(paddle.to_tensor(a),
+                                        paddle.to_tensor(b)).data)
+        np.testing.assert_allclose(got[0, 0], 1.0, rtol=1e-6)
+        np.testing.assert_allclose(got[0, 1], 1.0 / 7.0, rtol=1e-5)
+        np.testing.assert_allclose(got[0, 2], 0.0, atol=1e-7)

@@ -17,6 +17,13 @@ tiled kernels. TPU-first design:
 - Additive masks are supported natively as a blocked operand (bool masks
   are converted to additive form in the wrapper); causal masking is
   computed inline from block indices with whole-block skipping.
+- Grid-step amortization: `nb` (batch·head) slices are processed per grid
+  step. At LLM-training shapes the per-step scalar-core/DMA overhead, not
+  the MXU, is the bottleneck (measured: b=32 h=16 s=1024 d=64 has only
+  ~4 MFLOP per 128x128 step); batching slices into one step cut the grid
+  from 32768 to 1024 steps and ~5x'd throughput on v5e.
+- lse/delta ride in 8-lane (not 128-lane) replicated layouts to bound the
+  HBM footprint of the softmax stats at large batch.
 """
 import functools
 import math
@@ -27,20 +34,15 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
-
-
-def _mask_index_map(group):
-    def im(b, i, kb):
-        return (b // group, i, kb)
-    return im
+ROW_LANES = 8  # lane replication for per-row stats (lse/delta) in HBM
 
 
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, *rest, bq, bk, nk, s_true, causal,
-                scale, has_mask):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, nb, bq, bk, nk, s_true, causal,
+                scale, has_mask, mask_per_slice):
     if has_mask:
         mask_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -59,31 +61,33 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, bq, bk, nk, s_true, causal,
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        logits = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * jnp.float32(scale)
-        if mask_ref is not None:
-            logits = logits + mask_ref[0].astype(jnp.float32)
         cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + k_start
         valid = cols < s_true  # key padding beyond the true sequence
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_start
             valid = valid & (rows >= cols)
-        logits = jnp.where(valid, logits, jnp.float32(NEG_INF))
+        for j in range(nb):
+            q = q_ref[j].astype(jnp.float32)
+            k = k_ref[j].astype(jnp.float32)
+            logits = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * jnp.float32(scale)
+            if mask_ref is not None:
+                mj = mask_ref[j] if mask_per_slice else mask_ref[0]
+                logits = logits + mj.astype(jnp.float32)
+            lg = jnp.where(valid, logits, jnp.float32(NEG_INF))
 
-        m_prev = m_scr[:, :1]
-        l_prev = l_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
-        p = jnp.exp(logits - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[...] = alpha * acc_scr[...] + jax.lax.dot_general(
-            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+            m_prev = m_scr[j][:, :1]
+            l_prev = l_scr[j][:, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(lg, axis=-1, keepdims=True))
+            p = jnp.exp(lg - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+            acc_scr[j] = alpha * acc_scr[j] + jax.lax.dot_general(
+                p, v_ref[j].astype(jnp.float32), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[j] = jnp.broadcast_to(m_new, m_scr.shape[1:])
+            l_scr[j] = jnp.broadcast_to(l_new, l_scr.shape[1:])
 
     if causal:
         # whole blocks above the diagonal are masked; skip their MXU work
@@ -93,13 +97,43 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, bq, bk, nk, s_true, causal,
 
     @pl.when(ki == nk - 1)
     def _emit():
-        m_fin = m_scr[:, :1]
-        l_fin = l_scr[:, :1]
-        o_ref[0] = (acc_scr[...] /
-                    jnp.maximum(l_fin, jnp.float32(1e-30))).astype(o_ref.dtype)
-        # logsumexp rows; padded/fully-masked rows have l == 0 -> lse = -inf
-        lse = m_fin + jnp.log(jnp.maximum(l_fin, jnp.float32(1e-30)))
-        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+        for j in range(nb):
+            m_fin = m_scr[j][:, :1]
+            l_fin = l_scr[j][:, :1]
+            o_ref[j] = (acc_scr[j] /
+                        jnp.maximum(l_fin, jnp.float32(1e-30))
+                        ).astype(o_ref.dtype)
+            # logsumexp rows; padded/fully-masked rows have l == 0 -> -inf
+            lse = m_fin + jnp.log(jnp.maximum(l_fin, jnp.float32(1e-30)))
+            lse_ref[j] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _pick_nb(bh, mask_group):
+    """Batch-head slices per grid step: largest power of two <= 8 dividing
+    bh, constrained so a mask block never spans a mask-group boundary."""
+    nb = 8
+    while nb > 1 and bh % nb:
+        nb //= 2
+    if mask_group is not None and mask_group > 1:
+        while nb > 1 and mask_group % nb:
+            nb //= 2
+    return nb
+
+
+def _mask_specs(mask, bh, nb, bq, bk, swap_qk=False):
+    """BlockSpec for a [B, s, s] additive mask under nb-blocking."""
+    group = bh // mask.shape[0]
+    per_slice = group == 1
+    if per_slice:
+        if swap_qk:
+            return pl.BlockSpec((nb, bq, bk), lambda b, kb, i: (b, i, kb)), True
+        return pl.BlockSpec((nb, bq, bk), lambda b, i, kb: (b, i, kb)), True
+    # one mask row shared by the whole block (nb divides group)
+    if swap_qk:
+        return pl.BlockSpec(
+            (1, bq, bk), lambda b, kb, i: (b * nb // group, i, kb)), False
+    return pl.BlockSpec(
+        (1, bq, bk), lambda b, i, kb: (b * nb // group, i, kb)), False
 
 
 def _flash_fwd(q, k, v, mask, causal, scale, bq, bk, s_true, interpret):
@@ -110,40 +144,43 @@ def _flash_fwd(q, k, v, mask, causal, scale, bq, bk, s_true, interpret):
     nq = s // bq
     nk = s // bk
     has_mask = mask is not None
+    nb = _pick_nb(bh, bh // mask.shape[0] if has_mask else None)
 
     in_specs = [
-        pl.BlockSpec((1, bq, d), lambda b, i, kb: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, i, kb: (b, kb, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, i, kb: (b, kb, 0)),
+        pl.BlockSpec((nb, bq, d), lambda b, i, kb: (b, i, 0)),
+        pl.BlockSpec((nb, bk, d), lambda b, i, kb: (b, kb, 0)),
+        pl.BlockSpec((nb, bk, d), lambda b, i, kb: (b, kb, 0)),
     ]
     args = [q, k, v]
+    mask_per_slice = False
     if has_mask:
-        group = bh // mask.shape[0]
-        in_specs.append(pl.BlockSpec((1, bq, bk), _mask_index_map(group)))
+        spec, mask_per_slice = _mask_specs(mask, bh, nb, bq, bk)
+        in_specs.append(spec)
         args.append(mask)
 
     kernel = functools.partial(
-        _fwd_kernel, bq=bq, bk=bk, nk=nk, s_true=s_true, causal=causal,
-        scale=scale, has_mask=has_mask)
+        _fwd_kernel, nb=nb, bq=bq, bk=bk, nk=nk, s_true=s_true,
+        causal=causal, scale=scale, has_mask=has_mask,
+        mask_per_slice=mask_per_slice)
     # x64 must be off while tracing the kernel/index maps: Mosaic rejects
     # i64 grid indices (the package enables x64 globally for API parity).
     with jax.enable_x64(False):
         out, lse = pl.pallas_call(
             kernel,
-            grid=(bh, nq, nk),
+            grid=(bh // nb, nq, nk),
             in_specs=in_specs,
             out_specs=[
-                pl.BlockSpec((1, bq, d), lambda b, i, kb: (b, i, 0)),
-                pl.BlockSpec((1, bq, 128), lambda b, i, kb: (b, i, 0)),
+                pl.BlockSpec((nb, bq, d), lambda b, i, kb: (b, i, 0)),
+                pl.BlockSpec((nb, bq, ROW_LANES), lambda b, i, kb: (b, i, 0)),
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-                jax.ShapeDtypeStruct((bh, s, 128), jnp.float32),
+                jax.ShapeDtypeStruct((bh, s, ROW_LANES), jnp.float32),
             ],
             scratch_shapes=[
-                pltpu.VMEM((bq, 128), jnp.float32),
-                pltpu.VMEM((bq, 128), jnp.float32),
-                pltpu.VMEM((bq, d), jnp.float32),
+                pltpu.VMEM((nb, bq, 128), jnp.float32),
+                pltpu.VMEM((nb, bq, 128), jnp.float32),
+                pltpu.VMEM((nb, bq, d), jnp.float32),
             ],
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -156,27 +193,25 @@ def _flash_fwd(q, k, v, mask, causal, scale, bq, bk, s_true, interpret):
 # backward: dQ kernel (grid b, q, k) and dK/dV kernel (grid b, k, q)
 # ---------------------------------------------------------------------------
 
-def _recompute_p(q_ref, k_ref, mask_ref, lse_ref, *, bq, bk, s_true,
-                 q_start, k_start, causal, scale):
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
+def _block_p(q, k, mask_val, lse_col, *, bq, bk, s_true, q_start, k_start,
+             causal, scale):
     logits = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * jnp.float32(scale)
-    if mask_ref is not None:
-        logits = logits + mask_ref[0].astype(jnp.float32)
+    if mask_val is not None:
+        logits = logits + mask_val
     cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + k_start
     valid = cols < s_true
     if causal:
         rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_start
         valid = valid & (rows >= cols)
     logits = jnp.where(valid, logits, jnp.float32(NEG_INF))
-    lse = lse_ref[0][:, :1]  # [bq, 1]
-    return jnp.exp(logits - lse)  # rows with lse=-inf produce 0 via exp(-inf-(-inf))? guarded by caller padding
+    return jnp.exp(logits - lse_col)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                   bq, bk, nk, s_true, causal, scale, has_mask):
+                   nb, bq, bk, nk, s_true, causal, scale, has_mask,
+                   mask_per_slice):
     if has_mask:
         mask_ref, dq_ref, dq_scr = rest
     else:
@@ -193,19 +228,26 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
     def _compute():
-        p = _recompute_p(q_ref, k_ref, mask_ref, lse_ref, bq=bq, bk=bk,
+        for j in range(nb):
+            mj = None
+            if mask_ref is not None:
+                mj = (mask_ref[j] if mask_per_slice
+                      else mask_ref[0]).astype(jnp.float32)
+            q = q_ref[j].astype(jnp.float32)
+            k = k_ref[j].astype(jnp.float32)
+            p = _block_p(q, k, mj, lse_ref[j][:, :1], bq=bq, bk=bk,
                          s_true=s_true, q_start=q_start, k_start=k_start,
                          causal=causal, scale=scale)
-        do = do_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bq, bk]
-        delta = delta_ref[0][:, :1]
-        ds = p * (dp - delta) * jnp.float32(scale)
-        dq_scr[...] += jax.lax.dot_general(
-            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            do = do_ref[j].astype(jnp.float32)
+            v = v_ref[j].astype(jnp.float32)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [bq, bk]
+            delta = delta_ref[j][:, :1]
+            ds = p * (dp - delta) * jnp.float32(scale)
+            dq_scr[j] += jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
 
     if causal:
         pl.when(k_start <= q_start + bq - 1)(_compute)
@@ -214,11 +256,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
     @pl.when(ki == nk - 1)
     def _emit():
-        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+        dq_ref[...] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                    bq, bk, nq, s_true, causal, scale, has_mask):
+                    nb, bq, bk, nq, s_true, causal, scale, has_mask,
+                    mask_per_slice):
     if has_mask:
         mask_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
     else:
@@ -236,22 +279,29 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
     def _compute():
-        p = _recompute_p(q_ref, k_ref, mask_ref, lse_ref, bq=bq, bk=bk,
+        for j in range(nb):
+            mj = None
+            if mask_ref is not None:
+                mj = (mask_ref[j] if mask_per_slice
+                      else mask_ref[0]).astype(jnp.float32)
+            q = q_ref[j].astype(jnp.float32)
+            k = k_ref[j].astype(jnp.float32)
+            p = _block_p(q, k, mj, lse_ref[j][:, :1], bq=bq, bk=bk,
                          s_true=s_true, q_start=q_start, k_start=k_start,
                          causal=causal, scale=scale)
-        do = do_ref[0].astype(jnp.float32)
-        dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)  # p^T @ do: [bk, d]
-        v = v_ref[0].astype(jnp.float32)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        delta = delta_ref[0][:, :1]
-        ds = p * (dp - delta) * jnp.float32(scale)  # [bq, bk]
-        dk_scr[...] += jax.lax.dot_general(
-            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)  # ds^T @ q: [bk, d]
+            do = do_ref[j].astype(jnp.float32)
+            dv_scr[j] += jax.lax.dot_general(
+                p, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # p^T @ do: [bk, d]
+            v = v_ref[j].astype(jnp.float32)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            delta = delta_ref[j][:, :1]
+            ds = p * (dp - delta) * jnp.float32(scale)  # [bq, bk]
+            dk_scr[j] += jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # ds^T @ q: [bk, d]
 
     if causal:
         pl.when(k_start <= q_start + bq - 1)(_compute)
@@ -260,8 +310,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
     @pl.when(qi == nq - 1)
     def _emit():
-        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
-        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(q, k, v, o, lse, do, mask, causal, scale, bq, bk, s_true,
@@ -271,72 +321,71 @@ def _flash_bwd(q, k, v, o, lse, do, mask, causal, scale, bq, bk, s_true,
     nq = s // bq
     nk = s // bk
     has_mask = mask is not None
+    nb = _pick_nb(bh, bh // mask.shape[0] if has_mask else None)
 
     # delta = rowsum(dO * O) — cheap elementwise, XLA fuses it.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
 
-    # lse/delta as [bh, s, 128]-lane-replicated? Cheaper: pass [bh, s] and
-    # block (1, bq) — but TPU wants last dim 128. Replicate into lanes.
-    lse_l = jnp.broadcast_to(lse[:, :, None], (bh, s, 128))
-    delta_l = jnp.broadcast_to(delta[:, :, None], (bh, s, 128))
+    lse_l = jnp.broadcast_to(lse[:, :, None], (bh, s, ROW_LANES))
+    delta_l = jnp.broadcast_to(delta[:, :, None], (bh, s, ROW_LANES))
 
-    q_spec = pl.BlockSpec((1, bq, d), lambda b, i, kb: (b, i, 0))
-    row_spec = pl.BlockSpec((1, bq, 128), lambda b, i, kb: (b, i, 0))
-    k_spec = pl.BlockSpec((1, bk, d), lambda b, i, kb: (b, kb, 0))
+    q_spec = pl.BlockSpec((nb, bq, d), lambda b, i, kb: (b, i, 0))
+    row_spec = pl.BlockSpec((nb, bq, ROW_LANES), lambda b, i, kb: (b, i, 0))
+    k_spec = pl.BlockSpec((nb, bk, d), lambda b, i, kb: (b, kb, 0))
 
     in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
     args = [q, k, v, do, lse_l, delta_l]
+    mask_per_slice = False
     if has_mask:
-        group = bh // mask.shape[0]
-        in_specs.append(pl.BlockSpec((1, bq, bk), _mask_index_map(group)))
+        spec, mask_per_slice = _mask_specs(mask, bh, nb, bq, bk)
+        in_specs.append(spec)
         args.append(mask)
 
     with jax.enable_x64(False):
         dq = pl.pallas_call(
-            functools.partial(_bwd_dq_kernel, bq=bq, bk=bk, nk=nk,
+            functools.partial(_bwd_dq_kernel, nb=nb, bq=bq, bk=bk, nk=nk,
                               s_true=s_true, causal=causal, scale=scale,
-                              has_mask=has_mask),
-            grid=(bh, nq, nk),
+                              has_mask=has_mask,
+                              mask_per_slice=mask_per_slice),
+            grid=(bh // nb, nq, nk),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((1, bq, d), lambda b, i, kb: (b, i, 0)),
+            out_specs=pl.BlockSpec((nb, bq, d), lambda b, i, kb: (b, i, 0)),
             out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+            scratch_shapes=[pltpu.VMEM((nb, bq, d), jnp.float32)],
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
             interpret=interpret,
         )(*args)
 
-    # dkv grid: (bh, nk, nq) — q innermost; index maps swap roles.
-    q_spec2 = pl.BlockSpec((1, bq, d), lambda b, kb, i: (b, i, 0))
-    row_spec2 = pl.BlockSpec((1, bq, 128), lambda b, kb, i: (b, i, 0))
-    k_spec2 = pl.BlockSpec((1, bk, d), lambda b, kb, i: (b, kb, 0))
+    # dkv grid: (bh/nb, nk, nq) — q innermost; index maps swap roles.
+    q_spec2 = pl.BlockSpec((nb, bq, d), lambda b, kb, i: (b, i, 0))
+    row_spec2 = pl.BlockSpec((nb, bq, ROW_LANES), lambda b, kb, i: (b, i, 0))
+    k_spec2 = pl.BlockSpec((nb, bk, d), lambda b, kb, i: (b, kb, 0))
     in_specs2 = [q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2]
     args2 = [q, k, v, do, lse_l, delta_l]
     if has_mask:
-        group = bh // mask.shape[0]
-
-        def mask_im2(b, kb, i):
-            return (b // group, i, kb)
-        in_specs2.append(pl.BlockSpec((1, bq, bk), mask_im2))
+        spec2, mask_per_slice = _mask_specs(mask, bh, nb, bq, bk, swap_qk=True)
+        in_specs2.append(spec2)
         args2.append(mask)
 
     with jax.enable_x64(False):
         dk, dv = pl.pallas_call(
-            functools.partial(_bwd_dkv_kernel, bq=bq, bk=bk, nq=nq,
+            functools.partial(_bwd_dkv_kernel, nb=nb, bq=bq, bk=bk, nq=nq,
                               s_true=s_true, causal=causal, scale=scale,
-                              has_mask=has_mask),
-            grid=(bh, nk, nq),
+                              has_mask=has_mask,
+                              mask_per_slice=mask_per_slice),
+            grid=(bh // nb, nk, nq),
             in_specs=in_specs2,
             out_specs=[
-                pl.BlockSpec((1, bk, d), lambda b, kb, i: (b, kb, 0)),
-                pl.BlockSpec((1, bk, d), lambda b, kb, i: (b, kb, 0)),
+                pl.BlockSpec((nb, bk, d), lambda b, kb, i: (b, kb, 0)),
+                pl.BlockSpec((nb, bk, d), lambda b, kb, i: (b, kb, 0)),
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((bh, s, d), k.dtype),
                 jax.ShapeDtypeStruct((bh, s, d), v.dtype),
             ],
-            scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
-                            pltpu.VMEM((bk, d), jnp.float32)],
+            scratch_shapes=[pltpu.VMEM((nb, bk, d), jnp.float32),
+                            pltpu.VMEM((nb, bk, d), jnp.float32)],
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
             interpret=interpret,
@@ -390,7 +439,7 @@ def _xla_ref(q, k, v, causal, scale, mask=None):
 # public API
 # ---------------------------------------------------------------------------
 
-def make_flash_attention(bq=128, bk=128, interpret=False):
+def make_flash_attention(bq=256, bk=256, interpret=False):
     """Build the custom-vjp flash attention for given block sizes.
 
     Signature: flash(q, k, v, causal, scale) with [b, s, h, d] inputs,

@@ -114,29 +114,17 @@ def _c_softmax_with_cross_entropy(logits, label, group=None,
         return loss
 
     def fn(lg):
-        n = lax.axis_size(axis)
-        idx = lax.axis_index(axis)
-        vocab_local = lg.shape[-1]
-        # global max for stability
-        local_max = jnp.max(lg, axis=-1, keepdims=True)
-        gmax = lax.pmax(jax.lax.stop_gradient(local_max), axis)
-        shifted = lg - gmax
-        exp = jnp.exp(shifted)
-        local_sum = jnp.sum(exp, axis=-1, keepdims=True)
-        gsum = lax.psum(local_sum, axis)
-        # pick the target logit if it lives in this shard
+        # shared shard-CE core (ops/fused_ce.py) — one implementation of
+        # the global-max/psum/picked-logit math for both this op and the
+        # trainer's fused chunked head+CE
+        from .....ops.fused_ce import vocab_parallel_ce_rows
         lab_ = lab
         if lab_.ndim == lg.ndim:
             lab_ = jnp.squeeze(lab_, -1)
-        local_lab = lab_ - idx * vocab_local
-        in_range = (local_lab >= 0) & (local_lab < vocab_local)
-        safe = jnp.clip(local_lab, 0, vocab_local - 1).astype(jnp.int32)
-        picked = jnp.take_along_axis(shifted, safe[..., None], axis=-1)
-        picked = jnp.where(in_range[..., None], picked, 0.0)
-        picked = lax.psum(picked, axis)
-        loss = jnp.log(gsum) - picked
-        sm = exp / gsum
-        return loss, sm
+        loss, shifted, gsum = vocab_parallel_ce_rows(
+            lg, lab_, axis=axis, ignore_index=ignore_index)
+        sm = jnp.exp(shifted) / gsum
+        return loss[..., None], sm
 
     loss, sm = apply(fn, logits, n_outputs=2, name="c_softmax_ce")
     if return_softmax:

@@ -74,12 +74,25 @@ def _local_shape(gshape, spec, mesh):
 
 
 class SpmdTrainer:
-    """Builds and runs the one-program hybrid step for a CausalLM model."""
+    """Builds and runs the one-program hybrid step for a CausalLM model.
+
+    Memory/speed knobs (defaults tuned for the flagship bench):
+    - recompute_policy="save_attn" pins the flash-attention o/lse residuals
+      (~(2d+4)·tokens bytes per layer) so backward never re-runs the
+      attention forward kernel. On memory-edge configs that only just fit
+      with full rematerialization, pass recompute_policy="full".
+    - fuse_head_ce=True computes lm_head+CE chunk-wise (never materializes
+      [N, vocab] logits); ce_chunk sets the row-chunk size.
+    - matmul_precision defaults by param dtype (bf16 -> "default" native
+      MXU passes, f32 -> "highest"); it does not affect the flash kernel,
+      whose precision follows its operand dtype (see ops/pallas/_prec).
+    """
 
     def __init__(self, model, mesh, lr=1e-3, betas=(0.9, 0.95), eps=1e-8,
                  weight_decay=0.01, micro_batch_size=None, recompute=False,
                  param_dtype=None, sharding_stage=2, pp_schedule="gpipe",
-                 virtual_pp_degree=1):
+                 virtual_pp_degree=1, fuse_head_ce=True, ce_chunk=4096,
+                 matmul_precision=None, recompute_policy="save_attn"):
         if sharding_stage not in (1, 2, 3):
             raise ValueError(f"sharding_stage must be 1/2/3, got "
                              f"{sharding_stage}")
@@ -101,6 +114,13 @@ class SpmdTrainer:
         self.sharding_stage = sharding_stage
         self.pp_schedule = pp_schedule
         self.v_pp = virtual_pp_degree
+        self.fuse_head_ce = fuse_head_ce
+        self.ce_chunk = ce_chunk
+        self.matmul_precision = matmul_precision
+        if recompute_policy not in ("full", "save_attn"):
+            raise ValueError(f"recompute_policy must be full/save_attn, got "
+                             f"{recompute_policy}")
+        self.recompute_policy = recompute_policy
 
         self.S_pipe = mesh.shape.get("pipe", 1)
         self.S_shard = mesh.shape.get("sharding", 1)
@@ -169,6 +189,13 @@ class SpmdTrainer:
             self._pdt = jnp.dtype(param_dtype)
         else:
             self._pdt = None
+        if self.matmul_precision is None:
+            # bf16/f16 params: native low-precision MXU passes. f32 params
+            # keep the package's f32-parity "highest" — "default" would
+            # silently run single-pass-bf16 matmuls on TPU.
+            low = self._pdt is not None and self._pdt in (
+                jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16))
+            self.matmul_precision = "default" if low else "highest"
         self._jitted = None
 
     # ---- specs -------------------------------------------------------------
@@ -342,22 +369,77 @@ class SpmdTrainer:
                     tape.no_grad():
                 return embed(Tensor(ids)).data
 
-        def apply_tail_loss(outer, h, labels):
-            with _Swap(outer_tensors, materialize_outer(outer)), \
-                    tape.no_grad():
-                out = h
-                for l in tail[:-1]:
-                    out = l(Tensor(out) if not isinstance(out, Tensor) else out)
-                logits = tail[-1](out)
-                _, _, _, ce = _model_parts(self.model)
-                loss = ce(logits, Tensor(labels))
-                return jnp.mean(loss.data)
+        # Fused chunked head+CE: when the tail is [norms..., Linear w/o
+        # bias] feeding a mean-over-tokens CE (both flagship families), the
+        # [N, V] logits are never materialized — the head matmul + CE run
+        # chunk-by-chunk in a checkpointed scan (ops/fused_ce.py). This is
+        # what makes no-recompute batches fit in HBM at vocab 32k.
+        lm_head = tail[-1]
+        fused_tail = (getattr(lm_head, "bias", None) is None
+                      and hasattr(lm_head, "weight")
+                      and self.fuse_head_ce)
+        mp_axis = "model" if "model" in mesh.axis_names else None
+
+        if fused_tail:
+            from ..ops.fused_ce import fused_linear_ce
+            from ..distributed.fleet.meta_parallel.parallel_layers.mp_ops \
+                import _identity_fn
+            _, _, _, ce_obj = _model_parts(self.model)
+            ignore_index = getattr(ce_obj, "ignore_index", -100)
+
+            def apply_tail_loss(outer, h, labels):
+                with _Swap(outer_tensors, materialize_outer(outer)), \
+                        tape.no_grad():
+                    out = Tensor(h) if not isinstance(h, Tensor) else h
+                    for l in tail[:-1]:
+                        out = l(out)
+                    hh = out.data
+                    if mp_axis is not None:
+                        # column-parallel input contract (mp_ops._c_identity):
+                        # identity fwd, psum-over-'model' bwd — dh must sum
+                        # each vocab shard's partial
+                        hh = _identity_fn(mp_axis)(hh)
+                    w = lm_head.weight.data      # [H, V_local]
+                    flat = hh.reshape(-1, hh.shape[-1])
+                    total, _ = fused_linear_ce(
+                        flat, w, labels.reshape(-1), axis=mp_axis,
+                        chunk=self.ce_chunk, ignore_index=ignore_index)
+                    # mean over ALL tokens (ignored rows contribute 0) —
+                    # the same normalization as the unfused
+                    # jnp.mean(criterion(...)) path
+                    return total / jnp.float32(flat.shape[0])
+        else:
+            def apply_tail_loss(outer, h, labels):
+                with _Swap(outer_tensors, materialize_outer(outer)), \
+                        tape.no_grad():
+                    out = h
+                    for l in tail[:-1]:
+                        out = l(Tensor(out) if not isinstance(out, Tensor) else out)
+                    logits = tail[-1](out)
+                    _, _, _, ce = _model_parts(self.model)
+                    loss = ce(logits, Tensor(labels))
+                    return jnp.mean(loss.data)
 
         if recompute or stage3:
             # stage 3 always remats the outer gathers so the full embedding
             # table is never saved for backward — only its chunks are.
             apply_embed = jax.checkpoint(apply_embed)
-            apply_tail_loss = jax.checkpoint(apply_tail_loss)
+            if stage3 or not fused_tail:
+                # fused tail already checkpoints per-chunk; the outer wrap
+                # is only needed when the gathered lm_head W itself must
+                # not be saved (stage 3's memory contract)
+                apply_tail_loss = jax.checkpoint(apply_tail_loss)
+
+
+        def _ckpt(fn):
+            """Layer-body checkpoint. "save_attn" pins the flash kernel's
+            named residuals (o/lse) so backward recompute re-runs only the
+            cheap projections/elementwise, never the attention kernel."""
+            if self.recompute_policy == "save_attn":
+                pol = jax.checkpoint_policies.save_only_these_names(
+                    "sdpa_res")
+                return jax.checkpoint(fn, policy=pol)
+            return jax.checkpoint(fn)
 
         def apply_stage(stacked_local, h):
             """Run this rank's `per` decoder layers over h.
@@ -377,7 +459,7 @@ class SpmdTrainer:
                 return out, None
 
             if recompute:
-                body = jax.checkpoint(body)
+                body = _ckpt(body)
             h, _ = lax.scan(body, h, stacked_local)
             return h
 
@@ -495,7 +577,7 @@ class SpmdTrainer:
                         out = template(Tensor(carry)).data
                     return out, None
                 if recompute:
-                    body = jax.checkpoint(body)
+                    body = _ckpt(body)
                 h, _ = lax.scan(body, h, chunk_list)
                 return h
 
@@ -532,6 +614,13 @@ class SpmdTrainer:
                 return jax.value_and_grad(loss_fn)(params, ids, labels, key)
 
         def step_fn(state, ids, labels, key, lr):
+            # the package's global matmul precision is "highest" (f32 API
+            # parity for eager ops); the compiled training step wants the
+            # native MXU rate for its dtype — bf16 passes for bf16 params
+            with jax.default_matmul_precision(self.matmul_precision):
+                return _step_fn(state, ids, labels, key, lr)
+
+        def _step_fn(state, ids, labels, key, lr):
             params = state["params"]
             step = state["step"] + 1
             loss, grads = loss_and_grads(params, ids, labels, key)

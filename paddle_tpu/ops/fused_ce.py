@@ -1,0 +1,102 @@
+"""Chunked fused lm-head + softmax cross-entropy.
+
+Never materializes the full [N, V] logit matrix. The head matmul and the
+CE are computed chunk-of-rows at a time inside a checkpointed lax.scan, so
+
+- forward peak HBM for the tail drops from O(N*V) to O(chunk*V)
+  (llama350m bs32 s1024: 4.2 GB f32 logits -> 0.5 GB), and
+- backward recomputes each chunk's logits and accumulates dW on the fly
+  (the scan transpose accumulates gradients of scan-invariant operands),
+  so dlogits is never resident either.
+
+This is the diagnosis+fix of round-2's bs16-no-recompute compile OOM: the
+O(N*V) f32 logits + softmax + dlogits of the naive tail were the HBM bomb,
+not the attention stats.
+
+Vocab parallelism (lm_head weight sharded on the vocab dim over the
+'model' axis) is handled exactly like the reference's
+c_softmax_with_cross_entropy (ref: paddle/fluid/operators/collective/
+c_softmax_with_cross_entropy_op.cu.h:1 — global max + sum via collectives,
+target logit picked by the owning shard), but with lax.pmax/psum over the
+mesh axis instead of NCCL. The per-shard math lives in ONE place —
+`vocab_parallel_ce_rows` — shared with mp_ops._c_softmax_with_cross_entropy.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def vocab_parallel_ce_rows(logits, labels, axis=None, ignore_index=-100):
+    """Per-row CE over (possibly vocab-sharded) logits.
+
+    logits: [..., V_local] f32; labels: [...] int (global vocab ids).
+    axis: mesh axis the vocab dim is sharded over (None/size-1 = no-op).
+    Returns (loss [...], shifted [..., V_local], gsum [..., 1]) — shifted
+    and gsum let callers form the softmax without recomputing.
+    Rows whose label == ignore_index get loss 0 (gradient 0 follows:
+    d loss/d logits is scaled by the same zero).
+    """
+    v_loc = logits.shape[-1]
+    if axis is not None:
+        v_start = lax.axis_index(axis) * v_loc
+    else:
+        v_start = 0
+    lmax = lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    if axis is not None:
+        lmax = lax.pmax(lmax, axis)
+    shifted = logits - lmax
+    gsum = jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True)
+    if axis is not None:
+        gsum = lax.psum(gsum, axis)
+    lse = jnp.log(gsum)[..., 0]
+    local = labels - v_start
+    in_range = (local >= 0) & (local < v_loc)
+    safe = jnp.clip(local, 0, v_loc - 1).astype(jnp.int32)
+    picked = jnp.take_along_axis(shifted, safe[..., None], axis=-1)
+    picked = jnp.where(in_range[..., None], picked, 0.0)
+    if axis is not None:
+        picked = lax.psum(picked, axis)
+    valid = labels != ignore_index
+    loss = jnp.where(valid, lse - picked[..., 0], 0.0)
+    return loss, shifted, gsum
+
+
+def fused_linear_ce(h, w, labels, axis=None, chunk=4096, ignore_index=-100,
+                    precision=None):
+    """Sum of per-token CE of softmax(h @ w) against labels.
+
+    h: [N, H] (bf16/f32); w: [H, V_local]; labels: [N] int.
+    axis: mesh axis name the vocab dim is sharded over (None = unsharded;
+      a size-1 axis is also fine — the collectives are no-ops).
+    Returns (total_loss f32 scalar, n_valid f32 scalar). Ignored and
+    padded rows contribute 0 loss and are excluded from n_valid.
+    """
+    N, H = h.shape
+    c = min(chunk, N)
+    pad = (-N) % c
+    if pad:
+        h = jnp.concatenate([h, jnp.zeros((pad, H), h.dtype)])
+        labels = jnp.concatenate(
+            [labels, jnp.full((pad,), ignore_index, labels.dtype)])
+    m = (N + pad) // c
+    hm = h.reshape(m, c, H)
+    lm = labels.reshape(m, c)
+
+    def body(carry, xs):
+        hc, lc = xs
+        logits = lax.dot_general(
+            hc, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision)                       # [c, V_local] f32
+        li, _, _ = vocab_parallel_ce_rows(
+            logits, lc, axis=axis, ignore_index=ignore_index)
+        valid = lc != ignore_index
+        tot, cnt = carry
+        return (tot + jnp.sum(li),
+                cnt + jnp.sum(valid.astype(jnp.float32))), None
+
+    body = jax.checkpoint(body)
+    (total, count), _ = lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (hm, lm))
+    return total, count

@@ -30,11 +30,27 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 ROW_LANES = 8  # lane replication for per-row stats (lse/delta) in HBM
+
+
+def _prec(dt):
+    """MXU precision by operand dtype: native passes for low precision,
+    "highest" for f32 (the package's f32 API-parity contract — DEFAULT
+    would silently truncate f32 attention to one bf16 pass on TPU).
+
+    Deliberately NOT overridable by jax.default_matmul_precision: like
+    cuDNN fused attention, the kernel's precision contract is a function
+    of the input dtype only — callers wanting f32-precision attention on
+    bf16 data should cast to f32 (or use the XLA sdpa fallback)."""
+    return (jax.lax.Precision.DEFAULT
+            if jnp.dtype(dt) in (jnp.dtype(jnp.bfloat16),
+                                 jnp.dtype(jnp.float16))
+            else jax.lax.Precision.HIGHEST)
 
 
 # ---------------------------------------------------------------------------
@@ -67,11 +83,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, nb, bq, bk, nk, s_true, causal,
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_start
             valid = valid & (rows >= cols)
         for j in range(nb):
-            q = q_ref[j].astype(jnp.float32)
-            k = k_ref[j].astype(jnp.float32)
+            # MXU matmuls run in the INPUT dtype (bf16 at training shapes —
+            # ~8x the f32 MXU rate) with f32 accumulation; only the softmax
+            # math is f32. Round-2 cast operands to f32 first, which put
+            # every pass on the slow f32 MXU path (measured 8.8 TFLOP/s).
+            q = q_ref[j]
+            k = k_ref[j]
             logits = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * jnp.float32(scale)
+                preferred_element_type=jnp.float32,
+                precision=_prec(q.dtype)) * jnp.float32(scale)
             if mask_ref is not None:
                 mj = mask_ref[j] if mask_per_slice else mask_ref[0]
                 logits = logits + mj.astype(jnp.float32)
@@ -84,8 +105,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, nb, bq, bk, nk, s_true, causal,
             alpha = jnp.exp(m_prev - m_new)
             l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
             acc_scr[j] = alpha * acc_scr[j] + jax.lax.dot_general(
-                p, v_ref[j].astype(jnp.float32), (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+                p.astype(v_ref.dtype), v_ref[j], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=_prec(q.dtype))
             m_scr[j] = jnp.broadcast_to(m_new, m_scr.shape[1:])
             l_scr[j] = jnp.broadcast_to(l_new, l_scr.shape[1:])
 
@@ -178,8 +200,10 @@ def _flash_fwd(q, k, v, mask, causal, scale, bq, bk, s_true, interpret):
                 jax.ShapeDtypeStruct((bh, s, ROW_LANES), jnp.float32),
             ],
             scratch_shapes=[
-                pltpu.VMEM((nb, bq, 128), jnp.float32),
-                pltpu.VMEM((nb, bq, 128), jnp.float32),
+                # running max / sum only need lane 0; ROW_LANES (8) lanes
+                # instead of 128 reclaims ~2MB VMEM toward bigger blocks
+                pltpu.VMEM((nb, bq, ROW_LANES), jnp.float32),
+                pltpu.VMEM((nb, bq, ROW_LANES), jnp.float32),
                 pltpu.VMEM((nb, bq, d), jnp.float32),
             ],
             compiler_params=pltpu.CompilerParams(
@@ -195,9 +219,11 @@ def _flash_fwd(q, k, v, mask, causal, scale, bq, bk, s_true, interpret):
 
 def _block_p(q, k, mask_val, lse_col, *, bq, bk, s_true, q_start, k_start,
              causal, scale):
+    # q/k arrive in input dtype (bf16 fast path); accumulate f32 on the MXU
     logits = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * jnp.float32(scale)
+        preferred_element_type=jnp.float32,
+                precision=_prec(q.dtype)) * jnp.float32(scale)
     if mask_val is not None:
         logits = logits + mask_val
     cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + k_start
@@ -233,21 +259,23 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             if mask_ref is not None:
                 mj = (mask_ref[j] if mask_per_slice
                       else mask_ref[0]).astype(jnp.float32)
-            q = q_ref[j].astype(jnp.float32)
-            k = k_ref[j].astype(jnp.float32)
+            q = q_ref[j]
+            k = k_ref[j]
             p = _block_p(q, k, mj, lse_ref[j][:, :1], bq=bq, bk=bk,
                          s_true=s_true, q_start=q_start, k_start=k_start,
                          causal=causal, scale=scale)
-            do = do_ref[j].astype(jnp.float32)
-            v = v_ref[j].astype(jnp.float32)
+            do = do_ref[j]
+            v = v_ref[j]
             dp = jax.lax.dot_general(
                 do, v, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)  # [bq, bk]
+                preferred_element_type=jnp.float32,
+                precision=_prec(q.dtype))  # [bq, bk]
             delta = delta_ref[j][:, :1]
             ds = p * (dp - delta) * jnp.float32(scale)
             dq_scr[j] += jax.lax.dot_general(
-                ds, k, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=_prec(q.dtype))
 
     if causal:
         pl.when(k_start <= q_start + bq - 1)(_compute)
@@ -284,24 +312,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             if mask_ref is not None:
                 mj = (mask_ref[j] if mask_per_slice
                       else mask_ref[0]).astype(jnp.float32)
-            q = q_ref[j].astype(jnp.float32)
-            k = k_ref[j].astype(jnp.float32)
+            q = q_ref[j]
+            k = k_ref[j]
             p = _block_p(q, k, mj, lse_ref[j][:, :1], bq=bq, bk=bk,
                          s_true=s_true, q_start=q_start, k_start=k_start,
                          causal=causal, scale=scale)
-            do = do_ref[j].astype(jnp.float32)
+            do = do_ref[j]
             dv_scr[j] += jax.lax.dot_general(
-                p, do, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)  # p^T @ do: [bk, d]
-            v = v_ref[j].astype(jnp.float32)
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=_prec(q.dtype))  # p^T @ do: [bk, d]
+            v = v_ref[j]
             dp = jax.lax.dot_general(
                 do, v, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
+                preferred_element_type=jnp.float32,
+                precision=_prec(q.dtype))
             delta = delta_ref[j][:, :1]
             ds = p * (dp - delta) * jnp.float32(scale)  # [bq, bk]
             dk_scr[j] += jax.lax.dot_general(
-                ds, q, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)  # ds^T @ q: [bk, d]
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=_prec(q.dtype))  # ds^T @ q: [bk, d]
 
     if causal:
         pl.when(k_start <= q_start + bq - 1)(_compute)
@@ -501,6 +532,12 @@ def make_flash_attention(bq=256, bk=256, interpret=False):
     def flash_fwd(q, k, v, causal, scale):
         o, lse, qp, kp, vp, mp, bhq, s_true = _fwd_impl(
             q, k, v, None, causal, scale)
+        # Name the kernel-produced residuals so a jax.checkpoint policy
+        # (save_only_these_names) can pin them: the backward then reuses
+        # o/lse instead of re-running the forward kernel under recompute
+        # (train_step recompute_policy="save_attn").
+        o = checkpoint_name(o, "sdpa_res")
+        lse = checkpoint_name(lse, "sdpa_res")
         return (_reshape_out(o[:, :s_true], bhq),
                 (qp, kp, vp, o, lse, bhq, s_true))
 
@@ -527,6 +564,8 @@ def make_flash_attention(bq=256, bk=256, interpret=False):
     def flash_masked_fwd(q, k, v, mask, causal, scale):
         o, lse, qp, kp, vp, mp, bhq, s_true = _fwd_impl(
             q, k, v, mask, causal, scale)
+        o = checkpoint_name(o, "sdpa_res")
+        lse = checkpoint_name(lse, "sdpa_res")
         return (_reshape_out(o[:, :s_true], bhq),
                 (qp, kp, vp, mp, o, lse, bhq, s_true, mask))
 

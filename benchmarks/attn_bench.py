@@ -52,6 +52,13 @@ def main():
     ap.add_argument("--dim", type=int, default=64)
     ap.add_argument("--bq", type=int, default=None)
     ap.add_argument("--bk", type=int, default=None)
+    def _pow2(v):
+        n = int(v)
+        if n < 1 or n & (n - 1):
+            raise argparse.ArgumentTypeError(f"--nb must be a positive "
+                                             f"power of two, got {v}")
+        return n
+    ap.add_argument("--nb", type=_pow2, default=8)
     ap.add_argument("--impls", nargs="+",
                     default=["pallas_fwd", "xla_fwd", "pallas_fwdbwd",
                              "xla_fwdbwd"])
@@ -60,8 +67,10 @@ def main():
     from paddle_tpu.ops.pallas import flash_attention as fa
     from paddle_tpu.nn.functional.attention import _sdpa_xla
 
-    if args.bq or args.bk:
-        flash = fa.make_flash_attention(bq=args.bq or 128, bk=args.bk or 128)
+    if args.bq or args.bk or args.nb != 8:
+        # partial overrides fall back to the kernel's real defaults (256)
+        flash = fa.make_flash_attention(bq=args.bq or 256, bk=args.bk or 256,
+                                        nb_max=args.nb)
     else:
         flash = fa.make_flash_attention()
 
@@ -112,7 +121,7 @@ def main():
                 dt = bench_chain(fn, q)
                 print(json.dumps({
                     "impl": name, "b": b, "s": s, "h": h, "d": d,
-                    "bq": args.bq, "bk": args.bk,
+                    "bq": args.bq, "bk": args.bk, "nb": args.nb,
                     "ms": round(dt * 1e3, 3),
                     "tflops": round(fl * mult / dt / 1e12, 2),
                 }), flush=True)

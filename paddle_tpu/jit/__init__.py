@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from ..autograd import tape
 from ..framework import random as rnd
 from ..tensor.tensor import Tensor
+from . import dy2static  # noqa: F401  (control-flow converters)
 
 # capture stacks consulted by ops.apply: touched tensors and op-produced
 # tensors (the difference = true leaves: params/buffers/constants).

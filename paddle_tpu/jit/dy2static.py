@@ -1,0 +1,161 @@
+"""dy2static control-flow conversion (converter-function tier).
+
+ref: python/paddle/jit/dy2static/convert_operators.py:1 (convert_ifelse,
+convert_while_loop, convert_logical_*). The reference rewrites Python AST
+to call converter functions; here the converters ARE the public API
+(paddle.static.nn.cond / while_loop style), implemented on lax.cond /
+lax.while_loop — the XLA-native way to compile tensor-dependent control
+flow. Both work transparently in eager mode (concrete values -> plain
+Python control flow), so the same model code runs eagerly and under
+jit.to_static.
+
+Static-shape contract (XLA): every branch/iteration must produce the same
+shapes/dtypes; a dynamic-stopping decode loop keeps a fixed-size token
+buffer and a scalar cursor (see tests/test_dy2static.py for the pattern).
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor.tensor import Tensor
+
+
+def _data(x):
+    return x.data if isinstance(x, Tensor) else x
+
+
+def _is_traced(*vals):
+    return any(isinstance(_data(v), jax.core.Tracer) for v in vals)
+
+
+def _flatten(tree):
+    leaves, treedef = jax.tree_util.tree_flatten(
+        tree, is_leaf=lambda x: isinstance(x, Tensor))
+    arrs = [_data(l) for l in leaves]
+    wrapped = [isinstance(l, Tensor) for l in leaves]
+    return arrs, wrapped, treedef
+
+
+def _rewrap(arrs, wrapped, treedef):
+    leaves = [Tensor(a) if w else a for a, w in zip(arrs, wrapped)]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def cond(pred, true_fn, false_fn=None, name=None):
+    """ref: python/paddle/static/nn/control_flow.py cond(). Tensor-valued
+    pred -> lax.cond (both branches traced, same output structure);
+    concrete pred -> plain Python dispatch."""
+    p = _data(pred)
+    if not isinstance(p, jax.core.Tracer):
+        if bool(jnp.reshape(p, ())) if hasattr(p, "shape") else bool(p):
+            return true_fn()
+        return false_fn() if false_fn is not None else None
+    if false_fn is None:
+        raise ValueError(
+            "cond over a traced predicate needs an explicit false_fn "
+            "returning the same structure as true_fn (XLA compiles both "
+            "branches)")
+
+    # branches run INSIDE lax.cond (traced, not executed eagerly): only
+    # the taken branch runs per step, and RNG/side-effect behavior matches
+    # eager single-branch execution
+    meta = {}
+
+    def _thunk(fn, key):
+        def run(_):
+            arrs, wrapped, treedef = _flatten(fn())
+            meta[key] = (wrapped, treedef)
+            return tuple(arrs)
+        return run
+
+    arrs = lax.cond(jnp.reshape(p, ()), _thunk(true_fn, "t"),
+                    _thunk(false_fn, "f"), 0)
+    if meta["t"][1] != meta["f"][1]:
+        raise ValueError(
+            f"cond branches returned different structures: {meta['t'][1]} "
+            f"vs {meta['f'][1]}")
+    return _rewrap(list(arrs), *meta["t"])
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """ref: python/paddle/static/nn/control_flow.py while_loop(). Traced
+    condition -> lax.while_loop over the flattened loop state (shapes must
+    stay fixed); concrete -> plain Python while."""
+    loop_vars = list(loop_vars)
+    first = cond_fn(*loop_vars)
+    if not _is_traced(first, *loop_vars):
+        while bool(jnp.reshape(_data(cond_fn(*loop_vars)), ())):
+            out = body_fn(*loop_vars)
+            loop_vars = list(out) if isinstance(out, (list, tuple)) else [out]
+        return loop_vars
+
+    arrs0, wrapped, treedef = _flatten(loop_vars)
+    shapes0 = [(a.shape, jnp.result_type(a)) for a in arrs0]
+
+    def c(arrs):
+        vars_ = _rewrap(list(arrs), wrapped, treedef)
+        return jnp.reshape(_data(cond_fn(*vars_)), ())
+
+    def b(arrs):
+        vars_ = _rewrap(list(arrs), wrapped, treedef)
+        out = body_fn(*vars_)
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        arrs_o, _, treedef_o = _flatten(out)
+        if treedef_o != treedef:
+            raise ValueError(
+                f"while_loop body returned a different structure: "
+                f"{treedef_o} vs {treedef}")
+        for i, (a, (sh, dt)) in enumerate(zip(arrs_o, shapes0)):
+            if a.shape != sh:
+                raise ValueError(
+                    f"while_loop body changed the shape of loop var {i}: "
+                    f"{sh} -> {a.shape} (XLA loops require fixed shapes; "
+                    f"keep a fixed-size buffer + cursor instead)")
+            if jnp.result_type(a) != dt:
+                arrs_o[i] = a.astype(dt)
+        return tuple(arrs_o)
+
+    out = lax.while_loop(c, b, tuple(arrs0))
+    return _rewrap(list(out), wrapped, treedef)
+
+
+# --- converter aliases (the names the reference's AST rewriter targets,
+#     usable directly in hand-converted code) ------------------------------
+
+def convert_ifelse(pred, true_fn, false_fn, *a, **kw):
+    return cond(pred, true_fn, false_fn)
+
+
+def convert_while_loop(cond_fn, body_fn, *loop_vars):
+    return while_loop(cond_fn, body_fn, loop_vars)
+
+
+def convert_logical_and(x_func, y_func):
+    x = x_func() if callable(x_func) else x_func
+    xd = _data(x)
+    if not isinstance(xd, jax.core.Tracer):
+        if not bool(jnp.reshape(xd, ())):
+            return x
+        return y_func() if callable(y_func) else y_func
+    y = y_func() if callable(y_func) else y_func
+    return Tensor(jnp.logical_and(jnp.reshape(xd, ()),
+                                  jnp.reshape(_data(y), ())))
+
+
+def convert_logical_or(x_func, y_func):
+    x = x_func() if callable(x_func) else x_func
+    xd = _data(x)
+    if not isinstance(xd, jax.core.Tracer):
+        if bool(jnp.reshape(xd, ())):
+            return x
+        return y_func() if callable(y_func) else y_func
+    y = y_func() if callable(y_func) else y_func
+    return Tensor(jnp.logical_or(jnp.reshape(xd, ()),
+                                 jnp.reshape(_data(y), ())))
+
+
+def convert_logical_not(x):
+    xd = _data(x)
+    if not isinstance(xd, jax.core.Tracer):
+        return not bool(jnp.reshape(xd, ()))
+    return Tensor(jnp.logical_not(jnp.reshape(xd, ())))

@@ -110,24 +110,47 @@ class LlamaAttention(Layer):
         self._cos, self._sin = cos, sin
 
     def forward(self, hidden_states):
+        from ..distributed.mesh import in_spmd_region
         b, s = hidden_states.shape[0], hidden_states.shape[1]
         q = self.q_proj(hidden_states)
         k = self.k_proj(hidden_states)
         v = self.v_proj(hidden_states)
         cos, sin = self._cos, self._sin
         hd = self.head_dim
+        # context parallelism: activations arrive sequence-sharded over
+        # 'sep'; rope positions are GLOBAL (rank offset), attention runs
+        # the KV-rotating ring (parallel_layers/ring_attention.py)
+        sp = in_spmd_region("sep")
 
         def rotary(qa, ka, va):
+            import jax.lax as lax
             nh = qa.shape[-1] // hd
             qa = qa.reshape(b, s, nh, hd)
             ka = ka.reshape(b, s, nh, hd)
             va = va.reshape(b, s, nh, hd)
-            qa = apply_rotary(qa, cos.astype(qa.dtype), sin.astype(qa.dtype))
-            ka = apply_rotary(ka, cos.astype(ka.dtype), sin.astype(ka.dtype))
+            if sp:
+                n_sep = lax.axis_size("sep")
+                if s * n_sep > cos.shape[0]:
+                    raise ValueError(
+                        f"global sequence {s * n_sep} (local {s} x sep "
+                        f"{n_sep}) exceeds max_position_embeddings "
+                        f"{cos.shape[0]} — dynamic_slice would silently "
+                        f"clamp rotary positions")
+                off = lax.axis_index("sep") * s
+                c = lax.dynamic_slice_in_dim(cos, off, s, axis=0)
+                sn = lax.dynamic_slice_in_dim(sin, off, s, axis=0)
+            else:
+                c, sn = cos[:s], sin[:s]
+            qa = apply_rotary(qa, c.astype(qa.dtype), sn.astype(qa.dtype))
+            ka = apply_rotary(ka, c.astype(ka.dtype), sn.astype(ka.dtype))
             return qa, ka, va
 
         q, k, v = apply(rotary, q, k, v, n_outputs=3, name="rotary_qkv")
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        # RingFlashAttention self-dispatches: KV-rotating ring when 'sep'
+        # is live, plain sdpa (Pallas flash on TPU) otherwise
+        from ..distributed.fleet.meta_parallel.parallel_layers \
+            .ring_attention import RingFlashAttention
+        out = RingFlashAttention("sep", causal=True)(q, k, v)
         out = M.reshape(out, [b, s, -1])
         return self.o_proj(out)
 

@@ -124,9 +124,14 @@ class SpmdTrainer:
 
         self.S_pipe = mesh.shape.get("pipe", 1)
         self.S_shard = mesh.shape.get("sharding", 1)
+        self.S_sep = mesh.shape.get("sep", 1)
         self.batch_axes = tuple(a for a in ("data", "sharding")
                                 if a in mesh.axis_names)
         self.data_axes = tuple(a for a in ("data",) if a in mesh.axis_names)
+        # context parallelism: 'sep' shards the SEQUENCE dim of activations
+        # and labels; for parameters it behaves like a data axis (replicated
+        # params, partial grads -> psum)
+        self.sep_axes = tuple(a for a in ("sep",) if a in mesh.axis_names)
         # mesh axes a stage-3 chunk varies over (model-sharded params differ
         # per model rank; every sharding rank owns a distinct chunk)
         self._chunk_axes = tuple(a for a in ("model", "sharding")
@@ -354,6 +359,7 @@ class SpmdTrainer:
         recompute = self.recompute
         batch_axes = self.batch_axes
         data_axes = self.data_axes
+        sep_axes = self.sep_axes
         mb = self.micro_batch_size
         b1, b2, eps, wd = self.b1, self.b2, self.eps, self.wd
         S_shard = self.S_shard
@@ -500,8 +506,8 @@ class SpmdTrainer:
                         jnp.arange(M + S - 1))
                     # average over microbatches; share from last stage
                     loss = lax.psum(acc / M, "pipe")
-                # batch-mean across data/sharding ranks
-                for ax in batch_axes:
+                # batch-mean across data/sharding (+ sequence) ranks
+                for ax in batch_axes + sep_axes:
                     loss = lax.pmean(loss, ax)
                 return loss
 
@@ -564,7 +570,7 @@ class SpmdTrainer:
             v = self.v_pp
             per_v = self.per_v
             n_batch = 1
-            for ax in batch_axes:
+            for ax in batch_axes + sep_axes:
                 n_batch *= mesh.shape[ax]
 
             def stage_fwd(chunk_list, h):
@@ -606,7 +612,7 @@ class SpmdTrainer:
                 inv = jnp.asarray(1.0 / (M * n_batch), jnp.float32)
                 with spmd_axes(axis_names), frnd.key_scope(key):
                     loss, grads = run(params, ids_m, lab_m, inv)
-                for ax in batch_axes:
+                for ax in batch_axes + sep_axes:
                     loss = lax.pmean(loss, ax)
                 return loss, grads
         else:
@@ -630,7 +636,7 @@ class SpmdTrainer:
             # psum_scatter (stage 1/2) or the AD-inserted reduce-scatter of
             # the gather-on-use (stage 3).
             def reduce_grad(g):
-                for ax in data_axes:
+                for ax in data_axes + sep_axes:
                     g = lax.psum(g, ax)
                 return g
 
@@ -651,7 +657,8 @@ class SpmdTrainer:
                     loss)
 
         state_specs = self._state_specs()
-        ids_spec = P(self.batch_axes if self.batch_axes else None)
+        ids_spec = P(self.batch_axes if self.batch_axes else None,
+                     "sep" if self.sep_axes else None)
 
         smapped = shard_map(
             step_fn, mesh=mesh,

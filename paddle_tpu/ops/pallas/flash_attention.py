@@ -130,10 +130,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, nb, bq, bk, nk, s_true, causal,
             lse_ref[j] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _pick_nb(bh, mask_group):
-    """Batch-head slices per grid step: largest power of two <= 8 dividing
-    bh, constrained so a mask block never spans a mask-group boundary."""
-    nb = 8
+def _pick_nb(bh, mask_group, nb_max=8):
+    """Batch-head slices per grid step: largest power of two <= nb_max
+    dividing bh, constrained so a mask block never spans a mask-group
+    boundary."""
+    nb = nb_max
     while nb > 1 and bh % nb:
         nb //= 2
     if mask_group is not None and mask_group > 1:
@@ -158,7 +159,8 @@ def _mask_specs(mask, bh, nb, bq, bk, swap_qk=False):
         (1, bq, bk), lambda b, i, kb: (b * nb // group, i, kb)), False
 
 
-def _flash_fwd(q, k, v, mask, causal, scale, bq, bk, s_true, interpret):
+def _flash_fwd(q, k, v, mask, causal, scale, bq, bk, s_true, interpret,
+               nb_max=8):
     """q,k,v: [bh, s, d] (padded to block multiples); mask: [Bm, s, s]|None;
     s_true = unpadded sequence length (keys beyond it are masked out).
     Returns (out [bh, s, d], lse [bh, s])."""
@@ -166,7 +168,7 @@ def _flash_fwd(q, k, v, mask, causal, scale, bq, bk, s_true, interpret):
     nq = s // bq
     nk = s // bk
     has_mask = mask is not None
-    nb = _pick_nb(bh, bh // mask.shape[0] if has_mask else None)
+    nb = _pick_nb(bh, bh // mask.shape[0] if has_mask else None, nb_max)
 
     in_specs = [
         pl.BlockSpec((nb, bq, d), lambda b, i, kb: (b, i, 0)),
@@ -217,20 +219,25 @@ def _flash_fwd(q, k, v, mask, causal, scale, bq, bk, s_true, interpret):
 # backward: dQ kernel (grid b, q, k) and dK/dV kernel (grid b, k, q)
 # ---------------------------------------------------------------------------
 
-def _block_p(q, k, mask_val, lse_col, *, bq, bk, s_true, q_start, k_start,
-             causal, scale):
-    # q/k arrive in input dtype (bf16 fast path); accumulate f32 on the MXU
-    logits = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-                precision=_prec(q.dtype)) * jnp.float32(scale)
-    if mask_val is not None:
-        logits = logits + mask_val
+def _block_valid(*, bq, bk, s_true, q_start, k_start, causal):
+    """Per-block validity mask — computed ONCE per grid step and shared by
+    all nb slices (the iota/compare VPU work is not per-slice)."""
     cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + k_start
     valid = cols < s_true
     if causal:
         rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_start
         valid = valid & (rows >= cols)
+    return valid
+
+
+def _block_p(q, k, mask_val, lse_col, valid, *, scale):
+    # q/k arrive in input dtype (bf16 fast path); accumulate f32 on the MXU
+    logits = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=_prec(q.dtype)) * jnp.float32(scale)
+    if mask_val is not None:
+        logits = logits + mask_val
     logits = jnp.where(valid, logits, jnp.float32(NEG_INF))
     return jnp.exp(logits - lse_col)
 
@@ -254,6 +261,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
     def _compute():
+        valid = _block_valid(bq=bq, bk=bk, s_true=s_true, q_start=q_start,
+                             k_start=k_start, causal=causal)
         for j in range(nb):
             mj = None
             if mask_ref is not None:
@@ -261,9 +270,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                       else mask_ref[0]).astype(jnp.float32)
             q = q_ref[j]
             k = k_ref[j]
-            p = _block_p(q, k, mj, lse_ref[j][:, :1], bq=bq, bk=bk,
-                         s_true=s_true, q_start=q_start, k_start=k_start,
-                         causal=causal, scale=scale)
+            p = _block_p(q, k, mj, lse_ref[j][:, :1], valid, scale=scale)
             do = do_ref[j]
             v = v_ref[j]
             dp = jax.lax.dot_general(
@@ -307,6 +314,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
     def _compute():
+        valid = _block_valid(bq=bq, bk=bk, s_true=s_true, q_start=q_start,
+                             k_start=k_start, causal=causal)
         for j in range(nb):
             mj = None
             if mask_ref is not None:
@@ -314,9 +323,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                       else mask_ref[0]).astype(jnp.float32)
             q = q_ref[j]
             k = k_ref[j]
-            p = _block_p(q, k, mj, lse_ref[j][:, :1], bq=bq, bk=bk,
-                         s_true=s_true, q_start=q_start, k_start=k_start,
-                         causal=causal, scale=scale)
+            p = _block_p(q, k, mj, lse_ref[j][:, :1], valid, scale=scale)
             do = do_ref[j]
             dv_scr[j] += jax.lax.dot_general(
                 p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -346,13 +353,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _flash_bwd(q, k, v, o, lse, do, mask, causal, scale, bq, bk, s_true,
-               interpret):
+               interpret, nb_max=8):
     """All [bh, s, d] (padded); lse [bh, s]. Returns dq, dk, dv."""
     bh, s, d = q.shape
     nq = s // bq
     nk = s // bk
     has_mask = mask is not None
-    nb = _pick_nb(bh, bh // mask.shape[0] if has_mask else None)
+    nb = _pick_nb(bh, bh // mask.shape[0] if has_mask else None, nb_max)
 
     # delta = rowsum(dO * O) — cheap elementwise, XLA fuses it.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -470,7 +477,7 @@ def _xla_ref(q, k, v, causal, scale, mask=None):
 # public API
 # ---------------------------------------------------------------------------
 
-def make_flash_attention(bq=256, bk=256, interpret=False):
+def make_flash_attention(bq=256, bk=256, interpret=False, nb_max=8):
     """Build the custom-vjp flash attention for given block sizes.
 
     Signature: flash(q, k, v, causal, scale) with [b, s, h, d] inputs,
@@ -520,7 +527,7 @@ def make_flash_attention(bq=256, bk=256, interpret=False):
         qp, kp, vp, mp, bhq, s_true = _prep(q, k, v, mask)
         o, lse = _flash_fwd(qp, kp, vp, mp, causal, scale,
                             min(bq, qp.shape[1]), min(bk, kp.shape[1]),
-                            s_true, interpret)
+                            s_true, interpret, nb_max)
         return o, lse, qp, kp, vp, mp, bhq, s_true
 
     @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -548,7 +555,7 @@ def make_flash_attention(bq=256, bk=256, interpret=False):
         gp = _pad_seq(gr, blk, 1)
         dq, dk, dv = _flash_bwd(qp, kp, vp, o, lse, gp, None, causal, scale,
                                 min(bq, qp.shape[1]), min(bk, kp.shape[1]),
-                                s_true, interpret)
+                                s_true, interpret, nb_max)
         return (_reshape_out(dq[:, :s_true], bhq),
                 _reshape_out(dk[:, :s_true], bhq),
                 _reshape_out(dv[:, :s_true], bhq))
@@ -576,7 +583,7 @@ def make_flash_attention(bq=256, bk=256, interpret=False):
         gp = _pad_seq(gr, blk, 1)
         dq, dk, dv = _flash_bwd(qp, kp, vp, o, lse, gp, mp, causal, scale,
                                 min(bq, qp.shape[1]), min(bk, kp.shape[1]),
-                                s_true, interpret)
+                                s_true, interpret, nb_max)
         return (_reshape_out(dq[:, :s_true], bhq),
                 _reshape_out(dk[:, :s_true], bhq),
                 _reshape_out(dv[:, :s_true], bhq),

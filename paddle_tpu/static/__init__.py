@@ -20,6 +20,7 @@ from ..jit import InputSpec, TracedFunction
 from ..tensor.tensor import Tensor
 from .program import Program, current_program, _recording_stack
 from . import passes  # noqa: F401  (registers the built-in passes)
+from . import nn  # noqa: F401  (control flow: cond/while_loop/case)
 
 _default_main = [None]
 _static_mode = [False]

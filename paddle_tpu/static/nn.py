@@ -1,0 +1,35 @@
+"""paddle.static.nn control-flow surface (ref: python/paddle/static/nn/
+control_flow.py) — backed by the dy2static converters (lax.cond /
+lax.while_loop), usable in eager and traced code alike."""
+from ..jit.dy2static import cond, while_loop  # noqa: F401
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """ref: control_flow.py case() — first matching predicate wins."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+
+    def build(pairs):
+        (pred, fn) = pairs[0]
+        if len(pairs) == 1:
+            if default is None:
+                return fn()
+            return cond(pred, fn, default)
+        return cond(pred, fn, lambda: build(pairs[1:]))
+
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """ref: control_flow.py switch_case()."""
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+
+    def build(keys):
+        k = keys[0]
+        if len(keys) == 1:
+            if default is None:
+                return fns[k]()
+            return cond(branch_index == k, fns[k], default)
+        return cond(branch_index == k, fns[k], lambda: build(keys[1:]))
+
+    return build(sorted(fns.keys()))

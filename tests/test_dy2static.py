@@ -1,0 +1,125 @@
+"""dy2static control-flow converters (jit/dy2static.py): tensor-dependent
+if/while compile under jit.to_static via lax.cond/while_loop and match the
+eager Python control flow. VERDICT r2 item 7; ref:
+python/paddle/jit/dy2static/convert_operators.py."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.jit.dy2static import cond, while_loop
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def branchy_loss(x):
+    # data-dependent branch: quadratic on positive mean, linear otherwise
+    return cond(x.mean() > 0,
+                lambda: (x * x).mean(),
+                lambda: (-x).mean())
+
+
+def test_cond_eager_and_static_agree():
+    f = paddle.jit.to_static(branchy_loss)
+    for sign in (+1.0, -1.0):
+        x = paddle.to_tensor(np.full((4, 4), sign, np.float32))
+        eager = branchy_loss(x)
+        traced = f(x)
+        np.testing.assert_allclose(float(eager), float(traced), rtol=1e-6)
+
+
+def test_cond_grad_through_static():
+    def loss(x):
+        return cond(x.sum() > 0, lambda: (x * x).sum(), lambda: x.sum())
+
+    def jax_loss(a):
+        return loss(Tensor(a)).data
+
+    for sign in (+1.0, -1.0):
+        a = jnp.full((3,), sign, jnp.float32)
+        g = jax.grad(jax_loss)(a)
+        expect = 2 * a if sign > 0 else jnp.ones_like(a)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(expect),
+                                   rtol=1e-6)
+
+
+def greedy_decode(start_id, max_len, stop_id, table):
+    """Dynamic-stopping decode: fixed [max_len] buffer + cursor (the XLA
+    static-shape pattern). 'Model' = lookup table next-token map."""
+    buf = paddle.to_tensor(np.zeros((max_len,), np.int64))
+    buf = Tensor(buf.data.at[0].set(start_id.data))
+
+    def cond_fn(buf, i, done):
+        return paddle.logical_and(i < max_len, paddle.logical_not(done))
+
+    def body_fn(buf, i, done):
+        cur = buf.data[i.data - 1]
+        nxt = table.data[cur]
+        buf2 = Tensor(buf.data.at[i.data].set(nxt))
+        return (buf2, i + 1, Tensor(nxt == stop_id))
+
+    i0 = paddle.to_tensor(np.int64(1))
+    done0 = paddle.to_tensor(False)
+    buf, n, _ = while_loop(cond_fn, body_fn, [buf, i0, done0])
+    return buf, n
+
+
+def test_while_loop_greedy_decode_matches_eager():
+    # next-token table: 0->3->5->7(stop), others walk +1 (mod 16)
+    table_np = (np.arange(16, dtype=np.int64) + 1) % 16
+    table_np[0], table_np[3], table_np[5] = 3, 5, 7
+    stop = 7
+
+    def run(start):
+        table = paddle.to_tensor(table_np)
+        sid = paddle.to_tensor(np.int64(start))
+        buf, n = greedy_decode(sid, 8, stop, table)
+        return np.asarray(buf.data), int(n)
+
+    # eager reference via plain python
+    def ref(start):
+        buf = [start]
+        while len(buf) < 8 and buf[-1] != stop:
+            buf.append(int(table_np[buf[-1]]))
+        out = np.zeros(8, np.int64)
+        out[:len(buf)] = buf
+        return out, len(buf)
+
+    # traced: wrap in to_static over the start id
+    f = paddle.jit.to_static(
+        lambda sid: greedy_decode(sid, 8, stop,
+                                  paddle.to_tensor(table_np)))
+    for start in (0, 2, 9):
+        buf_e, n_e = ref(start)
+        buf_t, n_t = f(paddle.to_tensor(np.int64(start)))
+        np.testing.assert_array_equal(np.asarray(buf_t.data), buf_e)
+        assert int(n_t) == n_e
+
+
+def test_static_nn_case_and_switch():
+    x = paddle.to_tensor(np.float32(3.0))
+    r = static.nn.case([(x > 5, lambda: x * 10), (x > 1, lambda: x + 1)],
+                       default=lambda: x)
+    np.testing.assert_allclose(float(r), 4.0)
+    idx = paddle.to_tensor(np.int64(1))
+    r2 = static.nn.switch_case(idx, {0: lambda: x * 0, 1: lambda: x * 2},
+                               default=lambda: x)
+    np.testing.assert_allclose(float(r2), 6.0)
+
+
+def test_while_loop_shape_change_rejected():
+    def cond_fn(v):
+        return v.sum() < 100
+
+    def body_fn(v):
+        return Tensor(jnp.concatenate([v.data, v.data]))
+
+    def traced(a):
+        (out,) = while_loop(cond_fn, body_fn, [Tensor(a)])
+        return out.data
+
+    try:
+        jax.jit(traced)(jnp.ones((2,)))
+        raise AssertionError("expected shape-change ValueError")
+    except ValueError as e:
+        assert "fixed shapes" in str(e)

@@ -1,0 +1,82 @@
+"""Context/sequence parallelism ('sep' axis) integrated in the flagship
+trainer (VERDICT r2 item 4): loss parity vs the dense single-device run at
+long sequence, composition with data parallel, and the per-device
+activation-memory drop."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.train_step import SpmdTrainer
+from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
+
+
+CFG = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+           num_hidden_layers=2, num_attention_heads=4,
+           max_position_embeddings=2048)
+
+
+def _traj(axes, seq=2048, steps=3, **kw):
+    cfg = LlamaConfig(**CFG)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (4, seq)).astype(np.int64)
+    labels = np.roll(ids, -1, axis=1)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    mesh = build_mesh(axes)
+    set_global_mesh(mesh)
+    tr = SpmdTrainer(model, mesh, lr=1e-2, **kw)
+    st = tr.init_state()
+    out = []
+    for i in range(steps):
+        st, loss = tr.step(st, ids, labels, key=jax.random.key(i))
+        out.append(float(loss))
+    return out, tr, st
+
+
+def test_sep2_matches_dense_long_seq():
+    base, _, _ = _traj({"data": 1, "pipe": 1, "sharding": 1, "model": 1})
+    sp, _, _ = _traj({"data": 1, "pipe": 1, "sharding": 1, "model": 1, "sep": 2})
+    np.testing.assert_allclose(sp, base, rtol=2e-3,
+                               err_msg=f"sep2 {sp} vs dense {base}")
+
+
+def test_sep2_dp2_matches_dense():
+    base, _, _ = _traj({"data": 1, "pipe": 1, "sharding": 1, "model": 1})
+    sp, _, _ = _traj({"data": 2, "pipe": 1, "sharding": 1, "model": 1, "sep": 2}, )
+    np.testing.assert_allclose(sp, base, rtol=2e-3,
+                               err_msg=f"dp2xsep2 {sp} vs dense {base}")
+
+
+def test_sep2_mp2_matches_dense():
+    base, _, _ = _traj({"data": 1, "pipe": 1, "sharding": 1, "model": 1})
+    sp, _, _ = _traj({"data": 1, "pipe": 1, "sharding": 1, "model": 2, "sep": 2})
+    np.testing.assert_allclose(sp, base, rtol=2e-3,
+                               err_msg=f"sep2xmp2 {sp} vs dense {base}")
+
+
+def test_sep_shards_activation_memory():
+    """Per-device temp bytes (activations dominate at seq 2048 with a tiny
+    model) must drop substantially when the sequence is sharded over sep."""
+    cfg = LlamaConfig(**CFG)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (4, 2048)).astype(np.int64)
+    labels = np.roll(ids, -1, axis=1)
+
+    def temp_bytes(axes):
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        mesh = build_mesh(axes)
+        set_global_mesh(mesh)
+        tr = SpmdTrainer(model, mesh, lr=1e-2)
+        st = tr.init_state()
+        ma = tr.memory_analysis(st, ids, labels)
+        return None if ma is None else ma["temp_size_in_bytes"]
+
+    dense = temp_bytes({"data": 1, "pipe": 1, "sharding": 1, "model": 1})
+    sharded = temp_bytes({"data": 1, "pipe": 1, "sharding": 1, "model": 1, "sep": 4})
+    if dense is None or sharded is None:
+        pytest.skip("memory_analysis unavailable on this backend")
+    assert sharded < 0.55 * dense, (dense, sharded)

@@ -184,13 +184,23 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False):
                 if inp.grad is None:
                     inp.grad = _wrap_grad(ct)
                 else:
-                    inp.grad = _wrap_grad(inp.grad.data + ct)
+                    from ..framework.selected_rows import SelectedRows
+                    prev = inp.grad.data
+                    if isinstance(ct, SelectedRows):
+                        inp.grad = _wrap_grad(ct + prev) \
+                            if not isinstance(prev, SelectedRows) \
+                            else _wrap_grad(prev + ct)
+                    else:
+                        inp.grad = _wrap_grad(prev + ct)
 
     for cb in list(_after_backward_callbacks):
         cb()
 
 
 def _wrap_grad(arr):
+    from ..framework.selected_rows import SelectedRows
+    if isinstance(arr, SelectedRows):
+        return arr  # sparse grads are their own Tensor-surface (.data=self)
     from ..tensor.tensor import Tensor
 
     t = Tensor(arr, stop_gradient=True)

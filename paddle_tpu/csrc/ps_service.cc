@@ -98,6 +98,8 @@ struct TableConfig {
 // One sparse row: header (show, click, g2sum) + w[dim] (+ adam m,v).
 struct SparseTableShard {
   std::unordered_map<uint64_t, std::vector<float>> rows;
+  // rows evicted to the spill file: key -> byte offset (fixed row size)
+  std::unordered_map<uint64_t, uint64_t> spill_idx;
   std::mutex mu;
 };
 
@@ -106,6 +108,15 @@ constexpr int kShards = 16;  // intra-table sharding for concurrent workers
 
 struct Table {
   TableConfig cfg;
+  // --- spill tier (ref: fluid/distributed/ps/table/ssd_sparse_table.h:
+  // RocksDB-backed cold rows under a memory budget; here an append-only
+  // row file with free-slot reuse — same contract: bounded resident rows,
+  // transparent fault-in on access, cold rows survive on disk) ----------
+  uint64_t max_mem_rows = 0;   // 0 = unbounded (no spill)
+  std::string spill_path;
+  FILE* spill_f = nullptr;
+  std::mutex spill_mu;
+  std::vector<uint64_t> free_slots;
   // sparse
   SparseTableShard shards[kShards];
   // dense
@@ -168,6 +179,70 @@ struct Table {
     for (uint32_t i = 0; i < d; ++i) {
       if (w[i] < cfg.min_bound) w[i] = cfg.min_bound;
       if (w[i] > cfg.max_bound) w[i] = cfg.max_bound;
+    }
+  }
+
+  ~Table() {
+    if (spill_f) {
+      std::fclose(spill_f);
+      std::remove(spill_path.c_str());
+    }
+  }
+
+  bool spill_enabled() const { return max_mem_rows > 0; }
+
+  size_t shard_budget() const {
+    size_t b = max_mem_rows / kShards;
+    return b ? b : 1;
+  }
+
+  // requires shard.mu held: fault a spilled row back into memory
+  bool load_spilled(SparseTableShard& sh, uint64_t k,
+                    std::vector<float>& out) {
+    auto it = sh.spill_idx.find(k);
+    if (it == sh.spill_idx.end()) return false;
+    std::lock_guard<std::mutex> lk(spill_mu);
+    if (!spill_f) return false;
+    std::fseek(spill_f, (long)it->second, SEEK_SET);
+    out.resize(row_floats());
+    if (std::fread(out.data(), 4, out.size(), spill_f) != out.size())
+      return false;
+    free_slots.push_back(it->second);
+    sh.spill_idx.erase(it);
+    return true;
+  }
+
+  // requires shard.mu held: push arbitrary victims (clock-style) to disk
+  // until the shard is back under budget; `keep` is never evicted
+  void maybe_evict(SparseTableShard& sh, uint64_t keep, uint32_t tid) {
+    if (!spill_enabled()) return;
+    size_t budget = shard_budget();
+    while (sh.rows.size() > budget) {
+      auto vit = sh.rows.begin();
+      if (vit->first == keep) {
+        ++vit;
+        if (vit == sh.rows.end()) break;
+      }
+      std::lock_guard<std::mutex> lk(spill_mu);
+      if (!spill_f) {
+        if (spill_path.empty())
+          spill_path = "/tmp/ps_spill_" + std::to_string(tid) + "_" +
+                       std::to_string((long)getpid()) + ".bin";
+        spill_f = std::fopen(spill_path.c_str(), "w+b");
+        if (!spill_f) return;  // no disk -> keep rows resident
+      }
+      uint64_t off;
+      if (!free_slots.empty()) {
+        off = free_slots.back();
+        free_slots.pop_back();
+      } else {
+        std::fseek(spill_f, 0, SEEK_END);
+        off = (uint64_t)std::ftell(spill_f);
+      }
+      std::fseek(spill_f, (long)off, SEEK_SET);
+      std::fwrite(vit->second.data(), 4, vit->second.size(), spill_f);
+      sh.spill_idx[vit->first] = off;
+      sh.rows.erase(vit);
     }
   }
 
@@ -241,16 +316,24 @@ void handle_client(Server* s, int fd) {
       case OP_CREATE: {
         uint32_t tid;
         TableConfig cfg;
+        uint64_t max_mem_rows = 0;
+        uint32_t splen = 0;
+        std::string spath;
         if (!read_full(fd, &tid, 4) || !read_full(fd, &cfg.is_dense, 1) ||
             !read_full(fd, &cfg.optimizer, 1) || !read_full(fd, &cfg.dim, 4) ||
-            !read_full(fd, &cfg.lr, 4) || !read_full(fd, &cfg.init_range, 4))
+            !read_full(fd, &cfg.lr, 4) || !read_full(fd, &cfg.init_range, 4) ||
+            !read_full(fd, &max_mem_rows, 8) || !read_full(fd, &splen, 4))
           goto done;
+        spath.resize(splen);
+        if (splen && !read_full(fd, spath.data(), splen)) goto done;
         {
           std::lock_guard<std::mutex> lk(s->tables_mu);
           auto it = s->tables.find(tid);
           if (it == s->tables.end()) {
             auto t = std::make_unique<Table>();
             t->cfg = cfg;
+            t->max_mem_rows = max_mem_rows;
+            t->spill_path = spath;
             t->rng.seed(1234 + tid);
             s->tables[tid] = std::move(t);
           } else if (it->second->cfg.dim != cfg.dim ||
@@ -284,13 +367,20 @@ void handle_client(Server* s, int fd) {
           std::lock_guard<std::mutex> lk(shard.mu);
           auto it = shard.rows.find(k);
           if (it == shard.rows.end()) {
-            if (!init_missing) continue;
             std::vector<float> row;
-            {
-              std::lock_guard<std::mutex> dlk(t->dense_mu);  // rng guard
-              t->init_row(row);
+            if (t->load_spilled(shard, k, row)) {
+              it = shard.rows.emplace(k, std::move(row)).first;
+            } else if (!init_missing) {
+              continue;
+            } else {
+              {
+                std::lock_guard<std::mutex> dlk(t->dense_mu);  // rng guard
+                t->init_row(row);
+              }
+              it = shard.rows.emplace(k, std::move(row)).first;
             }
-            it = shard.rows.emplace(k, std::move(row)).first;
+            t->maybe_evict(shard, k, tid);
+            it = shard.rows.find(k);
           }
           std::memcpy(vals.data() + (size_t)i * d, it->second.data() + 3,
                       4ull * d);
@@ -332,11 +422,13 @@ void handle_client(Server* s, int fd) {
           auto it = shard.rows.find(k);
           if (it == shard.rows.end()) {
             std::vector<float> row;
-            {
+            if (!t->load_spilled(shard, k, row)) {
               std::lock_guard<std::mutex> dlk(t->dense_mu);
               t->init_row(row);
             }
             it = shard.rows.emplace(k, std::move(row)).first;
+            t->maybe_evict(shard, k, tid);
+            it = shard.rows.find(k);
           }
           t->update_row(it->second, vals.data() + (size_t)i * d,
                         has_sc ? shows[i] : 1.f, has_sc ? clicks[i] : 0.f);
@@ -394,15 +486,26 @@ void handle_client(Server* s, int fd) {
           uint64_t nrows = 0;
           for (auto& sh : t->shards) {
             std::lock_guard<std::mutex> lk(sh.mu);
-            nrows += sh.rows.size();
+            nrows += sh.rows.size() + sh.spill_idx.size();
           }
           std::fwrite(&nrows, 8, 1, f);
           size_t rf = t->row_floats();
+          std::vector<float> tmp(rf);
           for (auto& sh : t->shards) {
             std::lock_guard<std::mutex> lk(sh.mu);
             for (auto& kv : sh.rows) {
               std::fwrite(&kv.first, 8, 1, f);
               std::fwrite(kv.second.data(), 4, rf, f);
+            }
+            // cold rows stream from the spill file (checkpoints must
+            // cover the full table, resident or not)
+            std::lock_guard<std::mutex> slk(t->spill_mu);
+            for (auto& kv : sh.spill_idx) {
+              if (!t->spill_f) break;
+              std::fseek(t->spill_f, (long)kv.second, SEEK_SET);
+              if (std::fread(tmp.data(), 4, rf, t->spill_f) != rf) continue;
+              std::fwrite(&kv.first, 8, 1, f);
+              std::fwrite(tmp.data(), 4, rf, f);
             }
           }
           {
@@ -427,6 +530,7 @@ void handle_client(Server* s, int fd) {
             auto& shard = t->shards[k % kShards];
             std::lock_guard<std::mutex> lk(shard.mu);
             shard.rows[k] = std::move(row);
+            t->maybe_evict(shard, k, tid);
           }
           uint64_t dn = 0;
           if (std::fread(&dn, 8, 1, f) == 1 && dn) {
@@ -472,11 +576,13 @@ void handle_client(Server* s, int fd) {
         Table* t = s->get_table(tid);
         uint64_t nrows = 0, nfloats = 0;
         if (t) {
+          uint64_t resident = 0;
           for (auto& sh : t->shards) {
             std::lock_guard<std::mutex> lk(sh.mu);
-            nrows += sh.rows.size();
+            resident += sh.rows.size();
+            nrows += sh.rows.size() + sh.spill_idx.size();
           }
-          nfloats = nrows * t->row_floats();
+          nfloats = resident * t->row_floats();
           std::lock_guard<std::mutex> lk(t->dense_mu);
           nfloats += t->dense.size();
         }
@@ -515,6 +621,15 @@ void handle_client(Server* s, int fd) {
           for (auto& sh : t->shards) {
             std::lock_guard<std::mutex> lk(sh.mu);
             sh.rows.clear();
+            sh.spill_idx.clear();
+          }
+          {
+            std::lock_guard<std::mutex> slk(t->spill_mu);
+            t->free_slots.clear();
+            if (t->spill_f) {
+              std::fclose(t->spill_f);
+              t->spill_f = std::fopen(t->spill_path.c_str(), "w+b");
+            }
           }
           std::lock_guard<std::mutex> lk(t->dense_mu);
           t->dense.clear();
@@ -622,12 +737,16 @@ int ps_client_connect(const char* host, int port) {
 void ps_client_close(int fd) { ::close(fd); }
 
 int ps_create_table(int fd, uint32_t tid, uint8_t is_dense, uint8_t opt,
-                    uint32_t dim, float lr, float init_range) {
+                    uint32_t dim, float lr, float init_range,
+                    uint64_t max_mem_rows, const char* spill_path) {
   uint8_t op = OP_CREATE;
+  uint32_t splen = spill_path ? (uint32_t)std::strlen(spill_path) : 0;
   if (!write_full(fd, &op, 1) || !write_full(fd, &tid, 4) ||
       !write_full(fd, &is_dense, 1) || !write_full(fd, &opt, 1) ||
       !write_full(fd, &dim, 4) || !write_full(fd, &lr, 4) ||
-      !write_full(fd, &init_range, 4))
+      !write_full(fd, &init_range, 4) ||
+      !write_full(fd, &max_mem_rows, 8) || !write_full(fd, &splen, 4) ||
+      (splen && !write_full(fd, spill_path, splen)))
     return -1;
   uint8_t st;
   return read_full(fd, &st, 1) ? st : -1;

@@ -94,9 +94,25 @@ def dtensor_from_fn(fn, process_mesh, placements, *args, **kwargs):
 
 
 def reshard(x, process_mesh, placements):
-    """ref: reshard.py Resharder — here one device_put; XLA emits the
-    collective traffic."""
-    return shard_tensor(x, process_mesh, placements)
+    """ref: reshard.py:1007 Resharder. Outside an SPMD region: one
+    device_put (XLA emits the collective traffic). INSIDE a shard_map
+    region (x holds the local shard and carries dist_attr): the explicit
+    collective chain from reshard.py — all_to_all for axis moves,
+    all_gather to unshard, a free slice to shard, psum/psum_scatter for
+    partials."""
+    from ..mesh import in_spmd_region
+    from .reshard import reshard_spec
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    dst = tuple(_spec_from_placements(process_mesh, placements, t.ndim))
+    src = getattr(t, "dist_attr", None)
+    live = any(in_spmd_region(a) for a in process_mesh.dim_names)
+    if live and src is not None:
+        from ...ops import apply
+        out = apply(lambda a: reshard_spec(a, src, dst), t, name="reshard")
+        out.dist_attr = dst
+        out.process_mesh = process_mesh
+        return out
+    return shard_tensor(t, process_mesh, placements)
 
 
 def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,

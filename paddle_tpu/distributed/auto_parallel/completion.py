@@ -35,6 +35,9 @@ class _SpecStore:
     def __init__(self):
         self.specs = {}   # id(var) -> tuple spec
         self.changed = False
+        # (shape, old_spec, new_spec) where propagation disagreed — the
+        # Resharder's input (reshard.plan_conflict picks the mover)
+        self.conflicts = []
 
     def get(self, v):
         if isinstance(v, jcore.Literal):
@@ -50,7 +53,24 @@ class _SpecStore:
         if len(spec) != ndim:
             return
         old = self.specs.get(id(v))
+        if old is not None:
+            for x, y in zip(old, spec):
+                if x is not None and y is not None and x != y:
+                    self.conflicts.append(
+                        (tuple(v.aval.shape), old, tuple(spec)))
+                    break
         new = _merge(old, spec) if old is not None else spec
+        # one mesh axis shards at most one dim: a merge that would reuse
+        # an axis on a second dim is a cross-operand conflict — keep the
+        # first-won spec and hand the disagreement to the Resharder
+        flat = []
+        for a in new:
+            if a is None:
+                continue
+            flat.extend(a if isinstance(a, tuple) else (a,))
+        if len(flat) != len(set(flat)):
+            self.conflicts.append((tuple(v.aval.shape), old, tuple(spec)))
+            return
         if new != old:
             self.specs[id(v)] = new
             self.changed = True
@@ -294,4 +314,5 @@ class Completer:
             _apply_rules(jaxpr, store)
             if not store.changed:
                 break
+        self.conflicts = list(store.conflicts)
         return [store.get(v) for v in flat_invars]

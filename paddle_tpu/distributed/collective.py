@@ -129,6 +129,62 @@ def _raw(t):
     return t.data if isinstance(t, Tensor) else jnp.asarray(t)
 
 
+# --- eager cross-process machinery ----------------------------------------
+# Array verbs ride jax.experimental.multihost_utils (host-level gather over
+# the jax.distributed runtime); object verbs and true p2p ride the world
+# TCPStore from init_parallel_env (ref: process_group_gloo.h:33 supports
+# the full verb set cross-process on CPU — this is the TPU-runtime analog).
+_eager_seq = [0]
+
+
+def _next_seq():
+    _eager_seq[0] += 1
+    return _eager_seq[0]
+
+
+def _world_store_or_raise(verb):
+    from .parallel_env import get_store
+    st = get_store()
+    if st is None:
+        raise RuntimeError(
+            f"paddle.distributed.{verb}: cross-process object/p2p "
+            f"collectives need the TCPStore rendezvous from "
+            f"init_parallel_env() (MASTER_ADDR/MASTER_PORT).")
+    return st
+
+
+def _group_ranks(group):
+    if group is None:
+        return list(range(_group_size(None)))
+    return list(group.ranks)
+
+
+def _my_group_rank(group):
+    if group is None:
+        return get_rank()
+    return group.rank
+
+
+def _process_gather(arr, group):
+    """[n_group, ...] stack of every group rank's arr (eager path).
+
+    Backed by multihost_utils.process_allgather — a WORLD collective: a
+    subgroup call would deadlock (non-members never enter), so it is
+    rejected loudly. ref gloo groups carve real sub-communicators; the
+    eager TPU-runtime tier supports the world group only."""
+    from .parallel_env import get_world_size
+    ranks = _group_ranks(group)
+    if group is not None and len(ranks) != get_world_size():
+        raise NotImplementedError(
+            f"eager cross-process collectives support the world group "
+            f"only (got subgroup {ranks} of world {get_world_size()}): "
+            f"a subgroup call over the world-level runtime would hang "
+            f"the non-members. Run inside a compiled shard_map region "
+            f"(axis-named groups) for subgroup collectives.")
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(np.asarray(arr))
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """ref: communication/all_reduce.py. In-place on `tensor`."""
     axis = _axis_of(group)
@@ -205,7 +261,17 @@ def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
     if _group_size(group) == 1:
         tensor.data = _raw(inp)
         return tensor
-    raise NotImplementedError("eager cross-process reduce_scatter")
+    _require_initialized_multiproc("reduce_scatter")
+    n = _group_size(group)
+    stacked = _process_gather(_raw(inp), group)  # [n, n*chunk, ...]
+    red = {ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max, ReduceOp.MIN: np.min,
+           ReduceOp.AVG: np.mean, ReduceOp.PROD: np.prod}[op]
+    full = red(stacked, axis=0)
+    chunk = full.shape[0] // n
+    my = _my_group_rank(group)
+    tensor.data = jnp.asarray(full[my * chunk:(my + 1) * chunk]).astype(
+        tensor.data.dtype)
+    return tensor
 
 
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
@@ -222,7 +288,13 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     if _group_size(group) == 1:
         out_tensor_list.extend(in_tensor_list)
         return out_tensor_list
-    raise NotImplementedError("eager cross-process alltoall")
+    _require_initialized_multiproc("alltoall")
+    my = _my_group_rank(group)
+    stacked_in = np.stack([np.asarray(_raw(t)) for t in in_tensor_list])
+    allin = _process_gather(stacked_in, group)  # [n_src, n_dst, ...]
+    for srci in range(allin.shape[0]):
+        out_tensor_list.append(Tensor(jnp.asarray(allin[srci][my])))
+    return out_tensor_list
 
 
 def all_to_all_single(output, input, out_split_sizes=None, in_split_sizes=None,
@@ -239,7 +311,34 @@ def all_to_all_single(output, input, out_split_sizes=None, in_split_sizes=None,
     if _group_size(group) == 1:
         output.data = _raw(input)
         return output
-    raise NotImplementedError
+    _require_initialized_multiproc("all_to_all_single")
+    n = _group_size(group)
+    my = _my_group_rank(group)
+    if in_split_sizes:
+        # slicing source s's buffer by MY offsets is only correct when
+        # every rank declares the SAME split table — verify that
+        splits = np.asarray(in_split_sizes, np.int64)
+        all_splits = _process_gather(splits, group)
+        if not np.all(all_splits == splits[None]):
+            raise NotImplementedError(
+                "eager cross-process all_to_all_single requires identical "
+                "in_split_sizes on every rank (heterogeneous splits need "
+                "the compiled lax.all_to_all path)")
+    allin = _process_gather(_raw(input), group)  # [n, rows, ...]
+    if in_split_sizes:
+        starts = np.concatenate([[0], np.cumsum(in_split_sizes)])
+        parts = [allin[s][starts[my]:starts[my + 1]] for s in range(n)]
+    else:
+        rows = allin.shape[1] // n
+        parts = [allin[s][my * rows:(my + 1) * rows] for s in range(n)]
+    got = np.concatenate(parts, axis=0)
+    if tuple(got.shape) != tuple(output.data.shape):
+        raise ValueError(
+            f"all_to_all_single output shape {tuple(output.data.shape)} "
+            f"does not match received {tuple(got.shape)} (check "
+            f"out_split_sizes)")
+    output.data = jnp.asarray(got).astype(output.data.dtype)
+    return output
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
@@ -258,6 +357,16 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         tensor.data, tensor._node, tensor.stop_gradient = \
             out.data, out._node, out.stop_gradient
         return tensor
+    if _group_size(group) == 1:
+        return tensor
+    _require_initialized_multiproc("broadcast")
+    stacked = _process_gather(_raw(tensor), group)
+    src_in_group = group.get_group_rank(src) if group is not None else src
+    if src_in_group < 0:
+        raise ValueError(f"broadcast src {src} is not in group "
+                         f"{group.ranks}")
+    tensor.data = jnp.asarray(stacked[src_in_group]).astype(
+        tensor.data.dtype)
     return tensor
 
 
@@ -284,26 +393,58 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         if tensor_list:
             tensor.data = _raw(tensor_list[0])
         return tensor
-    raise NotImplementedError
+    _require_initialized_multiproc("scatter")
+    my = _my_group_rank(group)
+    src_in_group = group.get_group_rank(src) if group is not None else src
+    if src_in_group < 0:
+        raise ValueError(f"scatter src {src} is not in group "
+                         f"{group.ranks}")
+    if tensor_list:
+        stacked_in = np.stack([np.asarray(_raw(t)) for t in tensor_list])
+    else:  # non-src ranks may pass nothing; supply placeholder slots
+        one = np.asarray(_raw(tensor))
+        stacked_in = np.stack([np.zeros_like(one)
+                               for _ in range(_group_size(group))])
+    allin = _process_gather(stacked_in, group)  # [n, n, ...]
+    tensor.data = jnp.asarray(allin[src_in_group][my]).astype(
+        tensor.data.dtype)
+    return tensor
+
+
+_p2p_seq = {}
+
+
+def _p2p_key(a, b):
+    k = (a, b)
+    _p2p_seq[k] = _p2p_seq.get(k, 0) + 1
+    return f"p2p/{a}->{b}/{_p2p_seq[k]}"
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    """p2p send (ref: communication/send.py). SPMD: use p2p_push via
-    ppermute in the pipeline scheduler instead; eager is single-controller
-    so p2p is a device_put (see fleet/meta_parallel/pp_utils)."""
+    """p2p send (ref: communication/send.py). SPMD: ppermute in the
+    pipeline scheduler. Eager cross-process: serialized over the world
+    TCPStore (matched by per-pair sequence numbers)."""
     if _group_size(group) == 1:
         return tensor
-    raise NotImplementedError(
-        "raw send/recv outside the pipeline scheduler: use "
-        "paddle_tpu.distributed.fleet.meta_parallel p2p helpers")
+    _require_initialized_multiproc("send")
+    import pickle
+    st = _world_store_or_raise("send")
+    st.set(_p2p_key(get_rank(), dst),
+           pickle.dumps(np.asarray(_raw(tensor))))
+    return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
     if _group_size(group) == 1:
         return tensor
-    raise NotImplementedError(
-        "raw send/recv outside the pipeline scheduler: use "
-        "paddle_tpu.distributed.fleet.meta_parallel p2p helpers")
+    _require_initialized_multiproc("recv")
+    import pickle
+    st = _world_store_or_raise("recv")
+    key = _p2p_key(src, get_rank())
+    raw = st.get(key, wait=True, timeout_ms=120000)
+    st.delete_key(key)  # consumed: the store must not grow with the run
+    tensor.data = jnp.asarray(pickle.loads(raw)).astype(tensor.data.dtype)
+    return tensor
 
 
 def isend(tensor, dst=0, group=None):
@@ -430,19 +571,61 @@ def batch_isend_irecv(p2p_op_list):
             r.tensor.stop_gradient = (src.stop_gradient
                                       if isinstance(src, Tensor) else True)
         return [_P2PTask([r.tensor for r in recvs])]
-    raise NotImplementedError("eager cross-process batch_isend_irecv")
+    # eager cross-process: sends first (store puts), then recvs (gets) —
+    # the store decouples the two sides so no pairing deadlock is possible
+    _require_initialized_multiproc("batch_isend_irecv")
+    for s in sends:
+        send(s.tensor, s.peer, group)
+    for r in recvs:
+        recv(r.tensor, r.peer, group)
+    return [_P2PTask([r.tensor for r in recvs])]
 
 
 # object collectives -------------------------------------------------------
 def all_gather_object(object_list, obj, group=None):
+    """ref: communication/all_gather.py all_gather_object — arbitrary
+    picklables via the world TCPStore."""
     n = _group_size(group)
     if n == 1:
         object_list.append(obj)
         return object_list
-    raise NotImplementedError
+    _require_initialized_multiproc("all_gather_object")
+    import pickle
+    st = _world_store_or_raise("all_gather_object")
+    gen = _next_seq()
+    ranks = _group_ranks(group)
+    st.set(f"obj_ag/{gen}/{get_rank()}", pickle.dumps(obj))
+    for r in ranks:
+        raw = st.get(f"obj_ag/{gen}/{r}", wait=True, timeout_ms=120000)
+        object_list.append(pickle.loads(raw))
+    # last reader (ack counter reaches world) sweeps this generation's keys
+    if st.add(f"obj_ag/{gen}/done", 1) == len(ranks):
+        for r in ranks:
+            st.delete_key(f"obj_ag/{gen}/{r}")
+        st.delete_key(f"obj_ag/{gen}/done")
+    return object_list
 
 
 def broadcast_object_list(object_list, src=0, group=None):
+    """ref: communication/broadcast.py broadcast_object_list — in-place:
+    non-src ranks' slots are REPLACED by src's objects (the round-2
+    silent-no-op is gone)."""
+    n = _group_size(group)
+    if n == 1:
+        return object_list
+    _require_initialized_multiproc("broadcast_object_list")
+    import pickle
+    st = _world_store_or_raise("broadcast_object_list")
+    gen = _next_seq()
+    if get_rank() == src:
+        st.set(f"obj_bc/{gen}", pickle.dumps(list(object_list)))
+        return object_list
+    raw = st.get(f"obj_bc/{gen}", wait=True, timeout_ms=120000)
+    got = pickle.loads(raw)
+    object_list[:] = got
+    if st.add(f"obj_bc/{gen}/done", 1) == n - 1:  # last reader sweeps
+        st.delete_key(f"obj_bc/{gen}")
+        st.delete_key(f"obj_bc/{gen}/done")
     return object_list
 
 
@@ -455,10 +638,22 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
     if n == 1:
         out_object_list.append(in_object_list[0] if in_object_list else None)
         return out_object_list
-    if in_object_list is None:
-        raise NotImplementedError(
-            "cross-process scatter_object_list (non-src rank passed None): "
-            "single-controller callers must pass src's full object list")
     my = group.rank if group is not None and group.rank >= 0 else get_rank()
-    out_object_list.append(in_object_list[my])
+    if in_object_list is not None and get_rank() != src:
+        # single-controller convenience: caller already has src's list
+        out_object_list.append(in_object_list[my])
+        return out_object_list
+    _require_initialized_multiproc("scatter_object_list")
+    import pickle
+    st = _world_store_or_raise("scatter_object_list")
+    gen = _next_seq()
+    if get_rank() == src:
+        for i, r in enumerate(_group_ranks(group)):
+            st.set(f"obj_sc/{gen}/{r}", pickle.dumps(in_object_list[i]))
+        out_object_list.append(in_object_list[my])
+        return out_object_list
+    key = f"obj_sc/{gen}/{get_rank()}"
+    raw = st.get(key, wait=True, timeout_ms=120000)
+    st.delete_key(key)  # single-consumer key
+    out_object_list.append(pickle.loads(raw))
     return out_object_list

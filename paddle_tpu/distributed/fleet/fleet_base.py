@@ -106,7 +106,14 @@ class Fleet:
         return DataParallel(model, group=self._hcg.get_data_parallel_group())
 
     def distributed_optimizer(self, optimizer, strategy=None):
-        """ref: fleet.py:1044 -> HybridParallelOptimizer."""
+        """ref: fleet.py:1044 -> HybridParallelOptimizer (dygraph) or the
+        program-pass tier (static mode, ref raw_program/sharding
+        meta-optimizers)."""
+        from ... import static
+        if static.in_static_mode() or static.current_program() is not None:
+            from .static_optimizer import StaticDistributedOptimizer
+            return StaticDistributedOptimizer(
+                optimizer, strategy or self._strategy)
         from .meta_optimizers import HybridParallelOptimizer
         if self._hcg is not None and self._hcg.get_parallel_mode() != \
                 "data_parallel":

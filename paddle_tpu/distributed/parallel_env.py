@@ -103,7 +103,7 @@ def init_parallel_env(strategy=None):
         if store is not None:
             # barrier: all ranks came up under the same world
             store.barrier("init_done", world)
-            env._store = store
+            _world_store[0] = store
     _initialized[0] = True
     # Build the default (data-only) global mesh.
     from .mesh import set_global_mesh, build_mesh
@@ -111,6 +111,15 @@ def init_parallel_env(strategy=None):
     set_global_mesh(build_mesh({"data": len(jax.devices())}))
     _ensure_world_group()
     return env
+
+
+_world_store = [None]
+
+
+def get_store():
+    """The world TCPStore from init_parallel_env (None if single-process
+    or rendezvous skipped) — backs object collectives and eager p2p."""
+    return _world_store[0]
 
 
 def is_initialized():

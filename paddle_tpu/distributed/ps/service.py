@@ -54,7 +54,8 @@ def _lib():
         lib.ps_create_table.restype = ctypes.c_int
         lib.ps_create_table.argtypes = [
             ctypes.c_int, ctypes.c_uint32, ctypes.c_uint8, ctypes.c_uint8,
-            ctypes.c_uint32, ctypes.c_float, ctypes.c_float]
+            ctypes.c_uint32, ctypes.c_float, ctypes.c_float,
+            ctypes.c_uint64, ctypes.c_char_p]
         lib.ps_pull_sparse.restype = ctypes.c_int
         lib.ps_pull_sparse.argtypes = [
             ctypes.c_int, ctypes.c_uint32, u64p, ctypes.c_uint32,
@@ -91,13 +92,19 @@ class SparseTableConfig:
     """Per-table config (ref: the_one_ps.py Table/Accessor protobuf config)."""
 
     def __init__(self, table_id, dim, optimizer="adagrad", lr=0.05,
-                 init_range=0.01, is_dense=False):
+                 init_range=0.01, is_dense=False, max_mem_rows=0,
+                 spill_path=None):
         self.table_id = int(table_id)
         self.dim = int(dim)
         self.optimizer = optimizer
         self.lr = float(lr)
         self.init_range = float(init_range)
         self.is_dense = bool(is_dense)
+        # durability tier (ref: ps/table/ssd_sparse_table.h): rows beyond
+        # max_mem_rows spill to disk and fault back in on access; 0 keeps
+        # the table fully resident
+        self.max_mem_rows = int(max_mem_rows)
+        self.spill_path = spill_path
 
 
 class PsServer:
@@ -140,7 +147,9 @@ class PsClient:
         with self._lock:
             st = _lib().ps_create_table(
                 self._fd, cfg.table_id, 1 if cfg.is_dense else 0,
-                OPTIMIZERS[cfg.optimizer], cfg.dim, cfg.lr, cfg.init_range)
+                OPTIMIZERS[cfg.optimizer], cfg.dim, cfg.lr, cfg.init_range,
+                cfg.max_mem_rows,
+                cfg.spill_path.encode() if cfg.spill_path else None)
         if st == 3:
             raise RuntimeError(
                 f"table {cfg.table_id} already exists on the server with a "

@@ -28,7 +28,15 @@ from .collective import all_reduce, ReduceOp
 class EagerReducer:
     def __init__(self, params, bucket_bytes=25 * 1024 * 1024, group=None):
         self.group = group
-        self.params = [p for p in params if not p.stop_gradient]
+        all_params = [p for p in params if not p.stop_gradient]
+        # sparse-grad params (Embedding(sparse=True)) are excluded from
+        # the dense buckets; their SelectedRows grads sync via rank-gather
+        # at sync() time (ref: reducer.cc is_sparse_gradient_ branch:
+        # sparse grads ride allgather, not the fused dense allreduce)
+        self.sparse_params = [p for p in all_params
+                              if getattr(p, "is_sparse_grad", False)]
+        self.params = [p for p in all_params
+                       if not getattr(p, "is_sparse_grad", False)]
         self.enabled = True
         # reverse order, size-capped buckets (ref: parallel.py:121)
         self.buckets = []
@@ -137,7 +145,42 @@ class EagerReducer:
         for bi in range(len(self.buckets)):
             if not self._flushed[bi] and self._ready[bi]:
                 self._flush_bucket(bi)
+        self._sync_sparse()
         self._reset()
+
+    def _sync_sparse(self):
+        """Cross-rank sync of SelectedRows grads: gather every rank's
+        (rows, values), concatenate, scale by 1/world (grad AVERAGE parity
+        with the dense buckets)."""
+        from ..framework.selected_rows import SelectedRows
+        from .parallel_env import get_world_size
+        from . import collective
+        world = (self.group.nranks if self.group is not None
+                 else get_world_size())
+        if world <= 1:
+            return
+        for p in self.sparse_params:
+            sr = getattr(p, "grad", None)
+            if sr is not None and isinstance(sr.data, SelectedRows):
+                sr = sr.data
+            else:
+                # this rank's batch never touched the embedding: gather an
+                # EMPTY SelectedRows — skipping would break collective
+                # symmetry (peers block) and desync the store sequence
+                sr = SelectedRows(
+                    jnp.zeros((0,), jnp.int64),
+                    jnp.zeros((0,) + tuple(p.shape[1:]), jnp.float32),
+                    int(p.shape[0]))
+            gathered = []
+            collective.all_gather_object(
+                gathered, (np.asarray(sr.rows), np.asarray(sr.values)),
+                group=self.group)
+            rows = np.concatenate([np.asarray(r) for r, _ in gathered])
+            vals = np.concatenate([np.asarray(v) for _, v in gathered])
+            if rows.size == 0:
+                continue  # no rank touched it this step: leave grad as-is
+            p.grad = SelectedRows(jnp.asarray(rows),
+                                  jnp.asarray(vals) / world, sr.height)
 
     def _reset(self):
         self._ready = [set() for _ in self.buckets]

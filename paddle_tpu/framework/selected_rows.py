@@ -1,0 +1,79 @@
+"""SelectedRows — sparse gradients for embedding-style parameters.
+
+ref: paddle/phi/core/selected_rows.h:27 (rows + value tensor + height) and
+the EagerReducer sparse branch (fluid/distributed/collective/reducer.cc).
+A SelectedRows is the cotangent an Embedding(sparse=True) lookup emits for
+its weight: only the touched rows and their gradient values, never the
+dense [vocab, dim] zeros. It duck-types the small Tensor surface the
+optimizer/reducer path needs (.data/.shape/.dtype), merges under `+` (the
+tape's accumulation operator), and converts to dense or to a
+deduplicated (unique-rows, segment-summed) form on demand.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+
+class SelectedRows:
+    def __init__(self, rows, values, height):
+        self.rows = jnp.asarray(rows).reshape(-1)
+        self.values = jnp.asarray(values)
+        if self.values.shape[0] != self.rows.shape[0]:
+            raise ValueError(
+                f"values rows {self.values.shape[0]} != index count "
+                f"{self.rows.shape[0]}")
+        self.height = int(height)
+
+    # Tensor-surface duck typing -------------------------------------------
+    @property
+    def data(self):
+        return self
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def is_selected_rows(self):
+        return True
+
+    def astype(self, dt):
+        return SelectedRows(self.rows, self.values.astype(dt), self.height)
+
+    # accumulation ----------------------------------------------------------
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            if other.height != self.height:
+                raise ValueError("SelectedRows height mismatch")
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]), self.height)
+        # dense + sparse -> dense
+        return self.to_dense() + jnp.asarray(other)
+
+    __radd__ = __add__
+
+    def merged(self):
+        """Unique rows with segment-summed values (the reference's
+        merge_selected_rows / scale_by_count step). Eager-only: row count
+        is data-dependent."""
+        from jax.ops import segment_sum
+        rows_np = np.asarray(self.rows)
+        uniq, inv = np.unique(rows_np, return_inverse=True)
+        vals = segment_sum(self.values, jnp.asarray(inv),
+                           num_segments=len(uniq))
+        return SelectedRows(jnp.asarray(uniq), vals, self.height)
+
+    def to_dense(self):
+        dense = jnp.zeros(self.shape, self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+    def scale(self, s):
+        return SelectedRows(self.rows, self.values * s, self.height)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, nnz_rows="
+                f"{self.rows.shape[0]}, dim={self.values.shape[1:]})")

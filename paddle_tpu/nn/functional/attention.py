@@ -35,6 +35,14 @@ def _sdpa_xla(q, k, v, *rest, causal=False, scale=None, dropout_p=0.0,
         else:
             logits = logits + mask
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p and dropout_p > 0.0:
+        # real attention-weight dropout (the old fallback silently
+        # ignored dropout_p) — inverted scaling, framework RNG stream
+        from ...framework import random as frnd
+        keep = jax.random.bernoulli(frnd.next_key(), 1.0 - dropout_p,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                          jnp.zeros((), probs.dtype))
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vT)
     return jnp.swapaxes(out, 1, 2)
 
@@ -43,6 +51,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
                                  name=None):
     """Inputs [batch, seq, num_heads, head_dim] (paddle convention)."""
+    if not training:
+        dropout_p = 0.0  # eval-mode attention is deterministic
     args = [query, key, value]
     mask_needs_grad = False
     if attn_mask is not None:

@@ -53,18 +53,47 @@ def _prec(dt):
             else jax.lax.Precision.HIGHEST)
 
 
+def _dropout_keep(seed_ref, sl, q_start, k_start, bq, bk, dropout_p):
+    """Deterministic keep mask from a counter-based integer hash of
+    (seed, slice, global row, global col) — recomputing the same tuple in
+    the forward and both backward kernels regenerates the identical mask,
+    so no mask tensor is ever stored. Pure VPU integer ops (xxhash-style
+    avalanche), bit-identical across real TPU and interpret mode (the
+    pltpu hardware PRNG is stubbed to zeros on the CPU interpreter).
+    Applied AFTER the softmax denominator accumulates (dropout scales the
+    normalized attention weights, ref fmha semantics), so lse stays the
+    pre-dropout logsumexp and the delta = rowsum(dO*O) trick still holds:
+    rowsum(da*a) = rowsum(do*o) because the keep mask re-pairs with p."""
+    u = jnp.uint32
+    rows = jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 0) + u(q_start)
+    cols = jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 1) + u(k_start)
+    h = (seed_ref[0].astype(jnp.uint32) * u(2654435761)
+         + jnp.uint32(sl) * u(0x9E3779B9))
+    h = h ^ (rows * u(0x85EBCA6B)) ^ (cols * u(0xC2B2AE35))
+    h = h ^ (h >> u(15))
+    h = h * u(0x2C1B3C6D)
+    h = h ^ (h >> u(12))
+    h = h * u(0x297A2D39)
+    h = h ^ (h >> u(15))
+    thresh = min(int(dropout_p * 4294967296.0), 4294967295)
+    return h >= u(thresh)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, nb, bq, bk, nk, s_true, causal,
-                scale, has_mask, mask_per_slice):
-    if has_mask:
-        mask_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
-    else:
-        mask_ref = None
-        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+                scale, has_mask, mask_per_slice, dropout_p=0.0):
+    idx = 0
+    mask_ref = rest[idx] if has_mask else None
+    idx += 1 if has_mask else 0
+    seed_ref = rest[idx] if dropout_p > 0.0 else None
+    idx += 1 if dropout_p > 0.0 else 0
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = rest[idx:]
 
+    bi = pl.program_id(0)  # hoisted: program_id inside a pl.when body
+    #                          is rejected by the interpreter lowering
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     q_start = qi * bq
@@ -104,6 +133,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, nb, bq, bk, nk, s_true, causal,
             p = jnp.exp(lg - m_new)
             alpha = jnp.exp(m_prev - m_new)
             l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+            if dropout_p > 0.0:
+                keep = _dropout_keep(seed_ref, bi * nb + j,
+                                     q_start, k_start, bq, bk, dropout_p)
+                p = jnp.where(keep,
+                              p * jnp.float32(1.0 / (1.0 - dropout_p)), 0.0)
             acc_scr[j] = alpha * acc_scr[j] + jax.lax.dot_general(
                 p.astype(v_ref.dtype), v_ref[j], (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -143,6 +177,42 @@ def _pick_nb(bh, mask_group, nb_max=8):
     return nb
 
 
+VMEM_BUDGET = 12 * 1024 * 1024  # leave ~4MB of the ~16MB v5e VMEM free
+
+
+def _step_vmem_bytes(nb, bq, bk, d, isz, has_mask, mask_per_slice):
+    """Worst-kernel (bwd dK/dV) per-grid-step VMEM bytes: double-buffered
+    operand blocks (q, k, v, do, lse, delta, mask), double-buffered
+    outputs, f32 accumulation scratch."""
+    db = 2  # Pallas double-buffers HBM<->VMEM block DMAs
+    ins = (2 * nb * bq * d + 2 * nb * bk * d) * isz + 2 * nb * bq * 8 * 4
+    if has_mask:
+        ins += (nb if mask_per_slice else 1) * bq * bk * 4
+    outs = 2 * nb * bk * d * isz
+    scratch = 2 * nb * bk * d * 4
+    return db * (ins + outs) + scratch
+
+
+def _fit_geometry(bh, d, itemsize, has_mask, mask_group, bq, bk, nb_max):
+    """Shrink (nb, then bk, then bq) until the worst kernel's per-step
+    VMEM fits the budget (ADVICE r2 medium: f32 inputs + d>=128 + a
+    per-slice mask at bq=bk=256/nb=8 exceed ~16MB and fail to compile)."""
+    per_slice = mask_group == 1 if has_mask else False
+    nb = _pick_nb(bh, mask_group if has_mask else None, nb_max)
+    while True:
+        if _step_vmem_bytes(nb, bq, bk, d, itemsize, has_mask,
+                            per_slice) <= VMEM_BUDGET:
+            return bq, bk, nb
+        if nb > 1:
+            nb //= 2
+        elif bk > 128:
+            bk //= 2
+        elif bq > 128:
+            bq //= 2
+        else:
+            return bq, bk, nb  # minimal geometry; let Mosaic report
+
+
 def _mask_specs(mask, bh, nb, bq, bk, swap_qk=False):
     """BlockSpec for a [B, s, s] additive mask under nb-blocking."""
     group = bh // mask.shape[0]
@@ -160,15 +230,17 @@ def _mask_specs(mask, bh, nb, bq, bk, swap_qk=False):
 
 
 def _flash_fwd(q, k, v, mask, causal, scale, bq, bk, s_true, interpret,
-               nb_max=8):
+               nb_max=8, dropout_p=0.0, seed=None):
     """q,k,v: [bh, s, d] (padded to block multiples); mask: [Bm, s, s]|None;
     s_true = unpadded sequence length (keys beyond it are masked out).
     Returns (out [bh, s, d], lse [bh, s])."""
     bh, s, d = q.shape
+    has_mask = mask is not None
+    mg = bh // mask.shape[0] if has_mask else None
+    bq, bk, nb = _fit_geometry(bh, d, q.dtype.itemsize, has_mask, mg,
+                               bq, bk, nb_max)
     nq = s // bq
     nk = s // bk
-    has_mask = mask is not None
-    nb = _pick_nb(bh, bh // mask.shape[0] if has_mask else None, nb_max)
 
     in_specs = [
         pl.BlockSpec((nb, bq, d), lambda b, i, kb: (b, i, 0)),
@@ -181,11 +253,14 @@ def _flash_fwd(q, k, v, mask, causal, scale, bq, bk, s_true, interpret,
         spec, mask_per_slice = _mask_specs(mask, bh, nb, bq, bk)
         in_specs.append(spec)
         args.append(mask)
+    if dropout_p > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(jnp.asarray(seed, jnp.int32).reshape(1))
 
     kernel = functools.partial(
         _fwd_kernel, nb=nb, bq=bq, bk=bk, nk=nk, s_true=s_true,
         causal=causal, scale=scale, has_mask=has_mask,
-        mask_per_slice=mask_per_slice)
+        mask_per_slice=mask_per_slice, dropout_p=dropout_p)
     # x64 must be off while tracing the kernel/index maps: Mosaic rejects
     # i64 grid indices (the package enables x64 globally for API parity).
     with jax.enable_x64(False):
@@ -244,13 +319,16 @@ def _block_p(q, k, mask_val, lse_col, valid, *, scale):
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                    nb, bq, bk, nk, s_true, causal, scale, has_mask,
-                   mask_per_slice):
-    if has_mask:
-        mask_ref, dq_ref, dq_scr = rest
-    else:
-        mask_ref = None
-        dq_ref, dq_scr = rest
+                   mask_per_slice, dropout_p=0.0):
+    idx = 0
+    mask_ref = rest[idx] if has_mask else None
+    idx += 1 if has_mask else 0
+    seed_ref = rest[idx] if dropout_p > 0.0 else None
+    idx += 1 if dropout_p > 0.0 else 0
+    dq_ref, dq_scr = rest[idx:]
 
+    bi = pl.program_id(0)  # hoisted: program_id inside a pl.when body
+    #                          is rejected by the interpreter lowering
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     q_start = qi * bq
@@ -277,6 +355,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                 do, v, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
                 precision=_prec(q.dtype))  # [bq, bk]
+            if dropout_p > 0.0:
+                keep = _dropout_keep(seed_ref, bi * nb + j,
+                                     q_start, k_start, bq, bk, dropout_p)
+                dp = jnp.where(keep,
+                               dp * jnp.float32(1.0 / (1.0 - dropout_p)),
+                               0.0)
             delta = delta_ref[j][:, :1]
             ds = p * (dp - delta) * jnp.float32(scale)
             dq_scr[j] += jax.lax.dot_general(
@@ -296,13 +380,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                     nb, bq, bk, nq, s_true, causal, scale, has_mask,
-                    mask_per_slice):
-    if has_mask:
-        mask_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
-    else:
-        mask_ref = None
-        dk_ref, dv_ref, dk_scr, dv_scr = rest
+                    mask_per_slice, dropout_p=0.0):
+    idx = 0
+    mask_ref = rest[idx] if has_mask else None
+    idx += 1 if has_mask else 0
+    seed_ref = rest[idx] if dropout_p > 0.0 else None
+    idx += 1 if dropout_p > 0.0 else 0
+    dk_ref, dv_ref, dk_scr, dv_scr = rest[idx:]
 
+    bi = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     q_start = qi * bq
@@ -325,8 +411,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             k = k_ref[j]
             p = _block_p(q, k, mj, lse_ref[j][:, :1], valid, scale=scale)
             do = do_ref[j]
+            if dropout_p > 0.0:
+                # global (row, col) hash — identical to fwd/dq kernels
+                keep = _dropout_keep(seed_ref, bi * nb + j,
+                                     q_start, k_start, bq, bk, dropout_p)
+                p_v = jnp.where(keep,
+                                p * jnp.float32(1.0 / (1.0 - dropout_p)),
+                                0.0)
+            else:
+                p_v = p
             dv_scr[j] += jax.lax.dot_general(
-                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                p_v.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
                 precision=_prec(q.dtype))  # p^T @ do: [bk, d]
             v = v_ref[j]
@@ -334,6 +429,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                 do, v, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
                 precision=_prec(q.dtype))
+            if dropout_p > 0.0:
+                dp = jnp.where(keep,
+                               dp * jnp.float32(1.0 / (1.0 - dropout_p)),
+                               0.0)
             delta = delta_ref[j][:, :1]
             ds = p * (dp - delta) * jnp.float32(scale)  # [bq, bk]
             dk_scr[j] += jax.lax.dot_general(
@@ -353,13 +452,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _flash_bwd(q, k, v, o, lse, do, mask, causal, scale, bq, bk, s_true,
-               interpret, nb_max=8):
+               interpret, nb_max=8, dropout_p=0.0, seed=None):
     """All [bh, s, d] (padded); lse [bh, s]. Returns dq, dk, dv."""
     bh, s, d = q.shape
+    has_mask = mask is not None
+    mg = bh // mask.shape[0] if has_mask else None
+    bq, bk, nb = _fit_geometry(bh, d, q.dtype.itemsize, has_mask, mg,
+                               bq, bk, nb_max)
     nq = s // bq
     nk = s // bk
-    has_mask = mask is not None
-    nb = _pick_nb(bh, bh // mask.shape[0] if has_mask else None, nb_max)
 
     # delta = rowsum(dO * O) — cheap elementwise, XLA fuses it.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -378,13 +479,17 @@ def _flash_bwd(q, k, v, o, lse, do, mask, causal, scale, bq, bk, s_true,
         spec, mask_per_slice = _mask_specs(mask, bh, nb, bq, bk)
         in_specs.append(spec)
         args.append(mask)
+    if dropout_p > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(jnp.asarray(seed, jnp.int32).reshape(1))
 
     with jax.enable_x64(False):
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_kernel, nb=nb, bq=bq, bk=bk, nk=nk,
                               s_true=s_true, causal=causal, scale=scale,
                               has_mask=has_mask,
-                              mask_per_slice=mask_per_slice),
+                              mask_per_slice=mask_per_slice,
+                              dropout_p=dropout_p),
             grid=(bh // nb, nq, nk),
             in_specs=in_specs,
             out_specs=pl.BlockSpec((nb, bq, d), lambda b, i, kb: (b, i, 0)),
@@ -405,13 +510,17 @@ def _flash_bwd(q, k, v, o, lse, do, mask, causal, scale, bq, bk, s_true,
         spec2, mask_per_slice = _mask_specs(mask, bh, nb, bq, bk, swap_qk=True)
         in_specs2.append(spec2)
         args2.append(mask)
+    if dropout_p > 0.0:
+        in_specs2.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args2.append(jnp.asarray(seed, jnp.int32).reshape(1))
 
     with jax.enable_x64(False):
         dk, dv = pl.pallas_call(
             functools.partial(_bwd_dkv_kernel, nb=nb, bq=bq, bk=bk, nq=nq,
                               s_true=s_true, causal=causal, scale=scale,
                               has_mask=has_mask,
-                              mask_per_slice=mask_per_slice),
+                              mask_per_slice=mask_per_slice,
+                              dropout_p=dropout_p),
             grid=(bh // nb, nk, nq),
             in_specs=in_specs2,
             out_specs=[
@@ -477,12 +586,18 @@ def _xla_ref(q, k, v, causal, scale, mask=None):
 # public API
 # ---------------------------------------------------------------------------
 
-def make_flash_attention(bq=256, bk=256, interpret=False, nb_max=8):
+def make_flash_attention(bq=256, bk=256, interpret=False, nb_max=8,
+                         dropout_p=0.0):
     """Build the custom-vjp flash attention for given block sizes.
 
     Signature: flash(q, k, v, causal, scale) with [b, s, h, d] inputs,
     and flash_masked(q, k, v, mask, causal, scale) where mask is additive
-    [b|1, h|1, sq, sk] (broadcastable).
+    [b|1, h|1, sq, sk] (broadcastable). With dropout_p > 0 the build
+    ADDITIONALLY exposes flash.dropout(q, k, v, seed, causal, scale) and
+    flash.masked_dropout(q, k, v, mask, seed, causal, scale):
+    attention-weight dropout runs NATIVELY in the kernels — the keep mask
+    is regenerated from (seed, slice, row, col) in the backward kernels,
+    never materialized. The plain entries stay deterministic.
     """
 
     def _prep(q, k, v, mask):
@@ -523,11 +638,15 @@ def make_flash_attention(bq=256, bk=256, interpret=False, nb_max=8):
             mp = m3
         return qp, kp, vp, mp, bhq, s_true
 
-    def _fwd_impl(q, k, v, mask, causal, scale):
+    def _fwd_impl(q, k, v, mask, causal, scale, seed=None):
+        # dropout applies only to the .dropout/.masked_dropout entries
+        # (seed provided); the plain entries on the same build stay
+        # deterministic
+        dp = dropout_p if seed is not None else 0.0
         qp, kp, vp, mp, bhq, s_true = _prep(q, k, v, mask)
         o, lse = _flash_fwd(qp, kp, vp, mp, causal, scale,
                             min(bq, qp.shape[1]), min(bk, kp.shape[1]),
-                            s_true, interpret, nb_max)
+                            s_true, interpret, nb_max, dp, seed)
         return o, lse, qp, kp, vp, mp, bhq, s_true
 
     @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -591,6 +710,72 @@ def make_flash_attention(bq=256, bk=256, interpret=False, nb_max=8):
 
     flash_masked.defvjp(flash_masked_fwd, flash_masked_bwd)
 
+    if dropout_p > 0.0:
+        import numpy as _np
+
+        @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+        def flash_do(q, k, v, seed, causal, scale):
+            o, lse, qp, kp, vp, mp, bhq, s_true = _fwd_impl(
+                q, k, v, None, causal, scale, seed)
+            return _reshape_out(o[:, :s_true], bhq)
+
+        def flash_do_fwd(q, k, v, seed, causal, scale):
+            o, lse, qp, kp, vp, mp, bhq, s_true = _fwd_impl(
+                q, k, v, None, causal, scale, seed)
+            o = checkpoint_name(o, "sdpa_res")
+            lse = checkpoint_name(lse, "sdpa_res")
+            return (_reshape_out(o[:, :s_true], bhq),
+                    (qp, kp, vp, o, lse, bhq, s_true, seed))
+
+        def flash_do_bwd(causal, scale, res, g):
+            qp, kp, vp, o, lse, bhq, s_true, seed = res
+            blk = max(bq, bk)
+            gr, _ = _reshape_in(g)
+            gp = _pad_seq(gr, blk, 1)
+            dq, dk, dv = _flash_bwd(
+                qp, kp, vp, o, lse, gp, None, causal, scale,
+                min(bq, qp.shape[1]), min(bk, kp.shape[1]),
+                s_true, interpret, nb_max, dropout_p, seed)
+            return (_reshape_out(dq[:, :s_true], bhq),
+                    _reshape_out(dk[:, :s_true], bhq),
+                    _reshape_out(dv[:, :s_true], bhq),
+                    _np.zeros((), jax.dtypes.float0))
+
+        flash_do.defvjp(flash_do_fwd, flash_do_bwd)
+        flash.dropout = flash_do
+
+        @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+        def flash_do_masked(q, k, v, mask, seed, causal, scale):
+            o, lse, qp, kp, vp, mp, bhq, s_true = _fwd_impl(
+                q, k, v, mask, causal, scale, seed)
+            return _reshape_out(o[:, :s_true], bhq)
+
+        def flash_do_masked_fwd(q, k, v, mask, seed, causal, scale):
+            o, lse, qp, kp, vp, mp, bhq, s_true = _fwd_impl(
+                q, k, v, mask, causal, scale, seed)
+            o = checkpoint_name(o, "sdpa_res")
+            lse = checkpoint_name(lse, "sdpa_res")
+            return (_reshape_out(o[:, :s_true], bhq),
+                    (qp, kp, vp, mp, o, lse, bhq, s_true, mask, seed))
+
+        def flash_do_masked_bwd(causal, scale, res, g):
+            qp, kp, vp, mp, o, lse, bhq, s_true, mask, seed = res
+            blk = max(bq, bk)
+            gr, _ = _reshape_in(g)
+            gp = _pad_seq(gr, blk, 1)
+            dq, dk, dv = _flash_bwd(
+                qp, kp, vp, o, lse, gp, mp, causal, scale,
+                min(bq, qp.shape[1]), min(bk, kp.shape[1]),
+                s_true, interpret, nb_max, dropout_p, seed)
+            return (_reshape_out(dq[:, :s_true], bhq),
+                    _reshape_out(dk[:, :s_true], bhq),
+                    _reshape_out(dv[:, :s_true], bhq),
+                    jnp.zeros_like(mask),
+                    _np.zeros((), jax.dtypes.float0))
+
+        flash_do_masked.defvjp(flash_do_masked_fwd, flash_do_masked_bwd)
+        flash.masked_dropout = flash_do_masked
+
     flash.masked = flash_masked
     return flash
 
@@ -598,24 +783,41 @@ def make_flash_attention(bq=256, bk=256, interpret=False, nb_max=8):
 _default_flash = None
 
 
+_dropout_flash_cache = {}
+
+
+def _norm_mask(m):
+    """bool -> additive, and pad leading dims to rank 4."""
+    if m.dtype == jnp.bool_:
+        m = jnp.where(m, jnp.float32(0.0), jnp.float32(NEG_INF))
+    while m.ndim < 4:
+        m = m[None]
+    return m
+
+
 def flash_attention_pallas(q, k, v, mask=None, causal=False, scale=None,
                            dropout_p=0.0):
-    """sdpa-compatible entry: [b, s, h, d] inputs (paddle layout)."""
+    """sdpa-compatible entry: [b, s, h, d] inputs (paddle layout).
+    Attention-weight dropout runs natively in the kernels (the round-2
+    XLA fallback is gone); the per-call seed comes from the framework RNG
+    stream, so eager steps differ and compiled steps follow the step key."""
     global _default_flash
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if dropout_p and dropout_p > 0.0:
-        # attention dropout falls back to XLA (rare in TPU training; bwd
-        # through dropout-p requires threading the mask through the kernel)
-        from ...nn.functional.attention import _sdpa_xla
-        return _sdpa_xla(q, k, v, mask, causal=causal, scale=scale,
-                         dropout_p=dropout_p)
+        dp = float(dropout_p)
+        fl = _dropout_flash_cache.get(dp)
+        if fl is None:
+            fl = make_flash_attention(dropout_p=dp)
+            _dropout_flash_cache[dp] = fl
+        from ...framework import random as frnd
+        seed = jax.random.randint(frnd.next_key(), (), 0, 2 ** 31 - 1,
+                                  jnp.int32)
+        if mask is not None:
+            return fl.masked_dropout(q, k, v, _norm_mask(mask), seed,
+                                     causal, s)
+        return fl.dropout(q, k, v, seed, causal, s)
     if _default_flash is None:
         _default_flash = make_flash_attention()
-    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if mask is not None:
-        m = mask
-        if m.dtype == jnp.bool_:
-            m = jnp.where(m, jnp.float32(0.0), jnp.float32(NEG_INF))
-        while m.ndim < 4:
-            m = m[None]
-        return _default_flash.masked(q, k, v, m, causal, s)
+        return _default_flash.masked(q, k, v, _norm_mask(mask), causal, s)
     return _default_flash(q, k, v, causal, s)

@@ -203,3 +203,18 @@ class TestFlashAttentionBackwardTiled:
         ref = _xla_ref(q, k, v, False, scale, mask=mask)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-3, atol=2e-3)
+
+
+def test_vmem_geometry_fitting():
+    """ADVICE r2 medium: geometry must shrink to fit the VMEM budget for
+    f32/d128/per-slice-mask shapes, and stay at full size for the bf16
+    training shapes."""
+    from paddle_tpu.ops.pallas.flash_attention import (
+        VMEM_BUDGET, _fit_geometry, _step_vmem_bytes)
+    # bf16 llama shape: full geometry retained
+    bq, bk, nb = _fit_geometry(512, 64, 2, False, None, 256, 256, 8)
+    assert (bq, bk, nb) == (256, 256, 8)
+    # f32 + d=128 + per-slice mask: must fit, and actually shrink
+    bq, bk, nb = _fit_geometry(8, 128, 4, True, 1, 256, 256, 8)
+    assert _step_vmem_bytes(nb, bq, bk, 128, 4, True, True) <= VMEM_BUDGET
+    assert nb < 8

@@ -1,4 +1,8 @@
-"""hapi callbacks (ref: python/paddle/hapi/callbacks.py)."""
+"""hapi callbacks (ref: python/paddle/hapi/callbacks.py: ProgBarLogger,
+ModelCheckpoint, LRScheduler, EarlyStopping, VisualDL, ReduceLROnPlateau,
+WandbCallback). The visualization backends (visualdl/wandb) are not in
+the image, so VisualDL here writes the same scalar stream to a JSONL
+file — the data contract, minus the dashboard."""
 
 
 class Callback:
@@ -28,6 +32,15 @@ class Callback:
         pass
 
     def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
         pass
 
 
@@ -76,7 +89,113 @@ class EarlyStopping(Callback):
 
 
 class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler per batch or per epoch
+    (ref: callbacks.py LRScheduler)."""
+
     def __init__(self, by_step=True, by_epoch=False):
         super().__init__()
         self.by_step = by_step
         self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        sched = getattr(opt, "_learning_rate", None)
+        return sched if hasattr(sched, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()
+
+
+class ReduceLROnPlateau(Callback):
+    """ref: callbacks.py ReduceLROnPlateau — scale the lr by `factor`
+    after `patience` epochs without improvement of `monitor`."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.mode = "min" if mode in ("auto", "min") else "max"
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _better(self, cur, best):
+        if self.mode == "min":
+            return cur < best - self.min_delta
+        return cur > best + self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not logs or self.monitor not in logs:
+            return
+        cur = float(logs[self.monitor])
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.best is None or self._better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None and not hasattr(opt._learning_rate, "step"):
+                new_lr = max(opt.get_lr() * self.factor, self.min_lr)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr -> {new_lr:.3e}")
+                opt.set_lr(new_lr)
+            self.cooldown_counter = self.cooldown
+            self.wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logger with the VisualDL callback's stream contract
+    (ref: callbacks.py VisualDL); writes JSONL because the visualdl
+    package is not in the image."""
+
+    def __init__(self, log_dir="vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._f = None
+        self._step = 0
+
+    def _write(self, tag, logs, step):
+        if not logs:
+            return
+        import json
+        import os
+        if self._f is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._f = open(os.path.join(self.log_dir, "scalars.jsonl"), "a")
+        for k, v in logs.items():
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                continue
+            self._f.write(json.dumps({"tag": f"{tag}/{k}", "step": step,
+                                      "value": v}) + "\n")
+        self._f.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self._write("train", logs, self._step)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs, self._step)
+
+    def on_train_end(self, logs=None):
+        if self._f is not None:
+            self._f.close()
+            self._f = None

@@ -129,3 +129,98 @@ class HDFSClient(FS):
 
     def mv(self, src, dst, overwrite=False):
         self._run("-mv", src, dst)
+
+
+class HDFSClient(FS):
+    """ref: fleet/utils/fs.py:424 HDFSClient — shells out to the
+    `hadoop fs` CLI the way the reference drives libhdfs through its
+    java_home/hadoop_home configuration. Every operation raises a clear
+    error when the CLI is absent (no silent no-ops); `cat`/`list_dirs`
+    mirror the reference helpers used by the fleet checkpoint paths."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        self._hadoop = (os.path.join(hadoop_home, "bin", "hadoop")
+                        if hadoop_home else "hadoop")
+        self._configs = configs or {}
+        self.time_out = time_out
+
+    def _cmd(self, *args):
+        import subprocess
+        cmd = [self._hadoop, "fs"]
+        for k, v in self._configs.items():
+            cmd += ["-D", f"{k}={v}"]
+        cmd += list(args)
+        try:
+            return subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=self.time_out / 1000.0)
+        except FileNotFoundError:
+            raise RuntimeError(
+                f"hadoop CLI not found at {self._hadoop!r} — HDFSClient "
+                f"needs a hadoop installation (pass hadoop_home=)")
+
+    def ls_dir(self, fs_path):
+        r = self._cmd("-ls", fs_path)
+        dirs, files = [], []
+        if r.returncode != 0:
+            return dirs, files
+        for line in r.stdout.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = parts[-1].rsplit("/", 1)[-1]
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def is_dir(self, fs_path):
+        return self._cmd("-test", "-d", fs_path).returncode == 0
+
+    def is_file(self, fs_path):
+        return self._cmd("-test", "-f", fs_path).returncode == 0
+
+    def is_exist(self, fs_path):
+        return self._cmd("-test", "-e", fs_path).returncode == 0
+
+    def upload(self, local_path, fs_path, multi_processes=1, overwrite=False):
+        args = ["-put"] + (["-f"] if overwrite else []) + [local_path,
+                                                           fs_path]
+        r = self._cmd(*args)
+        if r.returncode != 0:
+            raise RuntimeError(f"hdfs upload failed: {r.stderr}")
+
+    def download(self, fs_path, local_path, multi_processes=1,
+                 overwrite=False):
+        r = self._cmd("-get", fs_path, local_path)
+        if r.returncode != 0:
+            raise RuntimeError(f"hdfs download failed: {r.stderr}")
+
+    def mkdirs(self, fs_path):
+        r = self._cmd("-mkdir", "-p", fs_path)
+        if r.returncode != 0:
+            raise RuntimeError(f"hdfs mkdirs failed: {r.stderr}")
+
+    def delete(self, fs_path):
+        self._cmd("-rm", "-r", "-f", fs_path)
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(fs_src_path):
+            raise RuntimeError(f"hdfs mv: {fs_src_path} does not exist")
+        if overwrite and self.is_exist(fs_dst_path):
+            self.delete(fs_dst_path)
+        r = self._cmd("-mv", fs_src_path, fs_dst_path)
+        if r.returncode != 0:
+            raise RuntimeError(f"hdfs mv failed: {r.stderr}")
+
+    def cat(self, fs_path):
+        r = self._cmd("-cat", fs_path)
+        if r.returncode != 0:
+            raise RuntimeError(f"hdfs cat failed: {r.stderr}")
+        return r.stdout
+
+    def touch(self, fs_path, exist_ok=True):
+        r = self._cmd("-touchz", fs_path)
+        if r.returncode != 0 and not exist_ok:
+            raise RuntimeError(f"hdfs touch failed: {r.stderr}")

@@ -127,6 +127,10 @@ class Model:
             loader = DataLoader(eval_data, batch_size=batch_size)
         else:
             loader = eval_data
+        cbs = list(callbacks or [])
+        for cb in cbs:
+            cb.set_model(self)
+            cb.on_eval_begin()
         for m in self._metrics:
             m.reset()
         losses = []
@@ -135,11 +139,26 @@ class Model:
             res = self.eval_batch(data, label)
             loss_val = res[0][0] if isinstance(res, tuple) else res[0]
             losses.append(loss_val)
+            for cb in cbs:
+                cb.on_eval_batch_end(step, {"loss": loss_val})
             if num_iters is not None and step + 1 >= num_iters:
                 break
-        out = {"loss": [float(np.mean(losses))]}
+        mean_loss = float(np.mean(losses))
+        # cross-rank aggregation (ref: hapi/model.py _multi_gpu eval
+        # metric merge): in an initialized multi-process run, eval loss
+        # is averaged and metric states merged across data ranks
+        from ..distributed.parallel_env import get_world_size, is_initialized
+        if is_initialized() and get_world_size() > 1:
+            import paddle_tpu.distributed as dist
+            t = __import__("paddle_tpu").to_tensor(
+                np.asarray([mean_loss], np.float32))
+            dist.all_reduce(t, op=dist.ReduceOp.AVG)
+            mean_loss = float(np.asarray(t.data)[0])
+        out = {"loss": [mean_loss]}
         for m in self._metrics:
             out[m.name()] = m.accumulate()
+        for cb in cbs:
+            cb.on_eval_end(out)
         return out
 
     def predict(self, test_data, batch_size=1, num_workers=0,

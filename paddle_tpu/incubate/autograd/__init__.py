@@ -65,3 +65,118 @@ def _wrap_out(o):
     if hasattr(o, "shape"):
         return Tensor(o)
     return o
+
+
+# ---------------------------------------------------------------------------
+# primitive-op surface (ref: python/paddle/incubate/autograd/primx.py
+# orig2prim/prim2orig/linearize/transpose + primapi enable_prim).
+#
+# The reference lowers ProgramDesc ops to ~40 hand-written primitive ops
+# and differentiates those. On TPU the primitive IR already exists: the
+# jaxpr. orig2prim IS tracing to a jaxpr; prim2orig IS evaluating it;
+# linearize/transpose are jax.linearize / jax.linear_transpose. These
+# wrappers expose that machinery under the reference's API names, over
+# Tensors.
+# ---------------------------------------------------------------------------
+
+_prim_enabled = [True]  # jaxpr lowering is always primitive-based on TPU
+
+
+def enable_prim():
+    """ref: primapi.py enable_prim — on TPU the compiled path ALWAYS
+    lowers through the primitive IR (jaxprs), so this records intent and
+    prim_enabled() reports it; there is no non-primitive lowering to
+    switch back to."""
+    _prim_enabled[0] = True
+
+
+def disable_prim():
+    _prim_enabled[0] = False
+
+
+def prim_enabled():
+    return _prim_enabled[0]
+
+
+class PrimProgram:
+    """A traced primitive program (ClosedJaxpr) with the introspection the
+    reference's prim Program offers: iterate ops, count them, print."""
+
+    def __init__(self, closed_jaxpr, n_outputs):
+        self.closed_jaxpr = closed_jaxpr
+        self._n_outputs = n_outputs
+
+    @property
+    def ops(self):
+        return [e.primitive.name for e in self.closed_jaxpr.jaxpr.eqns]
+
+    def __len__(self):
+        return len(self.closed_jaxpr.jaxpr.eqns)
+
+    def __str__(self):
+        return str(self.closed_jaxpr)
+
+
+def orig2prim(func, *xs):
+    """Trace `func` (Tensor -> Tensor) into its primitive program."""
+    arrays = [x.data if isinstance(x, Tensor) else x for x in xs]
+    pure = _wrap_fn(func)
+    closed = jax.make_jaxpr(pure)(*arrays)
+    probe = jax.eval_shape(pure, *arrays)
+    n_out = len(probe) if isinstance(probe, (list, tuple)) else 1
+    return PrimProgram(closed, n_out)
+
+
+def prim2orig(prim_program):
+    """Rebuild a callable (over Tensors) from a primitive program."""
+    from jax import core as _core
+
+    closed = prim_program.closed_jaxpr
+
+    def fn(*xs):
+        arrays = [x.data if isinstance(x, Tensor) else x for x in xs]
+        outs = _core.eval_jaxpr(closed.jaxpr, closed.consts, *arrays)
+        outs = [Tensor(o) for o in outs]
+        if prim_program._n_outputs == 1 and len(outs) == 1:
+            return outs[0]
+        return tuple(outs)
+
+    return fn
+
+
+def linearize(func, *xs):
+    """ref: primx linearize — returns (outputs, jvp_fn) where jvp_fn maps
+    input tangents to output tangents of the traced linearization."""
+    arrays = [x.data if isinstance(x, Tensor) else x for x in xs]
+    out, lin = jax.linearize(_wrap_fn(func), *arrays)
+
+    def jvp_fn(*tangents):
+        tl = [t.data if isinstance(t, Tensor) else t for t in tangents]
+        return _wrap_out(lin(*tl))
+
+    return _wrap_out(out), jvp_fn
+
+
+def transpose(linear_func, *primals_like):
+    """ref: primx transpose — transpose a LINEAR Tensor function into its
+    cotangent map (the vjp of a linear map)."""
+    arrays = [x.data if isinstance(x, Tensor) else x for x in primals_like]
+    tfn = jax.linear_transpose(_wrap_fn(linear_func), *arrays)
+
+    def ct_fn(*cotangents):
+        cl = [c.data if isinstance(c, Tensor) else c for c in cotangents]
+        return _wrap_out(tfn(*cl))
+
+    return ct_fn
+
+
+def forward_grad(func, xs, v=None):
+    """ref: primapi.forward_grad — forward-mode dual of grad()."""
+    return jvp(func, xs, v)
+
+
+def grad(func, xs, v=None):
+    """Functional reverse-mode grad; composes with itself for higher
+    orders (the create_graph story: grad of grad just re-traces)."""
+    _, grads = vjp(func, xs, v)
+    return grads

@@ -1,0 +1,95 @@
+"""hapi callbacks zoo + Model.fit/evaluate integration (VERDICT r2 missing
+#8 / weak #8; ref: python/paddle/hapi/callbacks.py)."""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer
+from paddle_tpu.callbacks import (EarlyStopping, LRScheduler,
+                                  ReduceLROnPlateau, VisualDL)
+from paddle_tpu.hapi import Model
+from paddle_tpu.io import Dataset
+
+
+class _Toy(Dataset):
+    def __init__(self, n=16):
+        rng = np.random.RandomState(0)
+        self.x = rng.randn(n, 4).astype(np.float32)
+        self.y = (self.x.sum(-1, keepdims=True) > 0).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _model(lr=0.1):
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+    m = Model(net)
+    opt = optimizer.SGD(learning_rate=lr, parameters=net.parameters())
+    m.prepare(optimizer=opt, loss=nn.MSELoss())
+    return m, opt
+
+
+def test_visualdl_writes_scalar_stream(tmp_path):
+    m, _ = _model()
+    logdir = str(tmp_path / "vdl")
+    m.fit(_Toy(), batch_size=4, epochs=2, verbose=0,
+          callbacks=[VisualDL(log_dir=logdir)])
+    lines = [json.loads(l) for l in
+             open(os.path.join(logdir, "scalars.jsonl"))]
+    assert any(r["tag"] == "train/loss" for r in lines)
+    steps = [r["step"] for r in lines if r["tag"] == "train/loss"]
+    assert steps == sorted(steps) and len(steps) >= 8
+
+
+def test_reduce_lr_on_plateau_reduces():
+    m, opt = _model(lr=0.5)
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1, verbose=0)
+    cb.set_model(m)
+    m._optimizer = opt
+    cb.on_epoch_end(0, {"loss": 1.0})
+    cb.on_epoch_end(1, {"loss": 1.0})   # no improvement -> wait=1 >= patience
+    assert abs(opt.get_lr() - 0.25) < 1e-9
+
+
+def test_lr_scheduler_callback_steps_scheduler():
+    from paddle_tpu.optimizer import lr as lrmod
+    net = nn.Sequential(nn.Linear(4, 1))
+    sched = lrmod.StepDecay(learning_rate=0.1, step_size=1, gamma=0.5)
+    opt = optimizer.SGD(learning_rate=sched, parameters=net.parameters())
+    m = Model(net)
+    m.prepare(optimizer=opt, loss=nn.MSELoss())
+    cb = LRScheduler(by_step=False, by_epoch=True)
+    cb.set_model(m)
+    m._optimizer = opt
+    lr0 = opt.get_lr()
+    cb.on_epoch_end(0)
+    assert opt.get_lr() < lr0
+
+
+def test_early_stopping_stops_fit():
+    m, _ = _model(lr=0.0)  # lr 0: loss never improves
+    hist = m.fit(_Toy(), batch_size=4, epochs=10, verbose=0,
+                 callbacks=[EarlyStopping(monitor="loss", patience=1)])
+    assert len(hist) < 10
+
+
+def test_evaluate_runs_eval_callbacks():
+    m, _ = _model()
+    seen = {}
+
+    class Probe(VisualDL.__mro__[1]):  # plain Callback
+        def on_eval_begin(self, logs=None):
+            seen["begin"] = True
+
+        def on_eval_end(self, logs=None):
+            seen["end"] = logs
+
+    out = m.evaluate(_Toy(), batch_size=4, callbacks=[Probe()])
+    assert seen.get("begin") and "loss" in seen["end"]
+    assert "loss" in out

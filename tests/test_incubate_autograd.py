@@ -1,0 +1,65 @@
+"""incubate.autograd primitive surface + higher-order (VERDICT r2 weak #9;
+ref: python/paddle/incubate/autograd/primx.py, primapi.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import autograd as ag
+
+
+def f(x):
+    return (x * x * x).sum()
+
+
+def test_grad_of_grad_higher_order():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    (g,) = ag.grad(f, [x])               # 3x^2
+    np.testing.assert_allclose(np.asarray(g.data), [3.0, 12.0], rtol=1e-6)
+
+    def g_fn(x):  # grad composes with itself: d/dx 3x^2 = 6x
+        (gg,) = ag.grad(f, [x])
+        return gg.sum()
+
+    (h,) = ag.grad(g_fn, [x])
+    np.testing.assert_allclose(np.asarray(h.data), [6.0, 12.0], rtol=1e-6)
+
+
+def test_orig2prim_prim2orig_roundtrip():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    prog = ag.orig2prim(lambda t: t * 2.0 + 1.0, x)
+    assert len(prog) >= 2 and any("mul" in op for op in prog.ops)
+    rebuilt = ag.prim2orig(prog)
+    out = rebuilt(x)
+    np.testing.assert_allclose(np.asarray(out.data), [3.0, 5.0, 7.0])
+
+
+def test_linearize_matches_jvp():
+    x = paddle.to_tensor(np.array([0.5, -1.5], np.float32))
+    v = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    out, jvp_fn = ag.linearize(f, x)
+    tang = jvp_fn(v)
+    _, tang_ref = ag.jvp(f, [x], [v])
+    np.testing.assert_allclose(np.asarray(tang.data),
+                               np.asarray(tang_ref.data), rtol=1e-6)
+
+
+def test_transpose_of_linear_map():
+    import jax.numpy as jnp
+    w = np.random.RandomState(0).randn(3, 2).astype(np.float32)
+
+    def lin(x):
+        return paddle.to_tensor(jnp.asarray(w)) @ x
+
+    x_like = paddle.to_tensor(np.zeros(2, np.float32))
+    ct_fn = ag.transpose(lin, x_like)
+    ct = paddle.to_tensor(np.array([1.0, 0.0, 2.0], np.float32))
+    (back,) = ct_fn(ct)
+    np.testing.assert_allclose(np.asarray(back.data), w.T @ [1.0, 0.0, 2.0],
+                               rtol=1e-5)
+
+
+def test_prim_toggle():
+    assert ag.prim_enabled()
+    ag.disable_prim()
+    assert not ag.prim_enabled()
+    ag.enable_prim()
+    assert ag.prim_enabled()

@@ -24,8 +24,14 @@ NEG_INF = -1e30
 
 
 def _block_attn(q, k, v, scale, mask):
-    """q:[b,sq,h,d] k,v:[b,sk,h,d] mask:[sq,sk] bool or None.
+    """q:[b,sq,h,d] k,v:[b,sk,h_kv,d] (h_kv divides h — GQA expands
+    here, at compute time, so the RING rotates the small h_kv buffers);
+    mask:[sq,sk] bool or None.
     Returns (out_unnormalized [b,sq,h,d], m [b,sq,h,1], l [b,sq,h,1])."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if mask is not None:
         logits = jnp.where(mask[None, None], logits, NEG_INF)
@@ -129,6 +135,12 @@ class RingFlashAttention:
 
     def __call__(self, q, k, v):
         if in_spmd_region(self.axis_name):
+            # GQA: KV stays at h_kv heads ON THE WIRE (the ring's
+            # bandwidth saving); _block_attn expands at compute time
+            if q.shape[2] % k.shape[2]:
+                raise ValueError(
+                    f"query heads {q.shape[2]} must be a multiple of kv "
+                    f"heads {k.shape[2]}")
             return apply(functools.partial(ring_attention,
                                            axis_name=self.axis_name,
                                            causal=self.causal),

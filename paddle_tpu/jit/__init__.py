@@ -178,7 +178,9 @@ def save(layer, path, input_spec=None, **configs):
             "@to_static-decorated forward's spec)")
     program = export_program(layer, input_spec,
                              name=type(layer).__name__
-                             if isinstance(layer, Layer) else "function")
+                             if isinstance(layer, Layer) else "function",
+                             ir_optim=configs.get("ir_optim", True),
+                             precision=configs.get("precision"))
     program.save(path)
     if isinstance(layer, Layer):
         _save(layer.state_dict(), path + ".pdparams")

@@ -122,8 +122,24 @@ class ExportedProgram:
             f.write(header)
             f.write(blob)
         buf = io.BytesIO()
-        np.savez(buf, **{f"p{i:05d}": np.asarray(jax.device_get(p))
-                         for i, p in enumerate(self.params)})
+        arrs = {}
+        for i, p in enumerate(self.params):
+            a = np.asarray(jax.device_get(p))
+            # npz has no bf16/f16-extension codes: store ml_dtypes arrays
+            # as uint16 bit patterns + a dtype tag, restored on load
+            if a.dtype in (np.float32, np.float64, np.float16,
+                           np.int8, np.int16, np.int32, np.int64,
+                           np.uint8, np.uint16, np.uint32, np.uint64,
+                           np.bool_):
+                arrs[f"p{i:05d}"] = a
+            elif a.dtype.itemsize == 2:  # bfloat16-class ml_dtypes
+                arrs[f"p{i:05d}__dt_{a.dtype.name}"] = a.view(np.uint16)
+            else:
+                raise TypeError(
+                    f"cannot serialize param dtype {a.dtype} to the "
+                    f".pdiparams npz (only numpy-native dtypes and 2-byte "
+                    f"ml_dtypes like bfloat16 round-trip)")
+        np.savez(buf, **arrs)
         with open(path_prefix + ".pdiparams", "wb") as f:
             f.write(buf.getvalue())
         return path_prefix + ".pdmodel"
@@ -143,7 +159,14 @@ class ExportedProgram:
         exported = jexport.deserialize(blob)
         with open(params_path or (path_prefix + ".pdiparams"), "rb") as f:
             npz = np.load(io.BytesIO(f.read()))
-            params = [jnp.asarray(npz[k]) for k in sorted(npz.files)]
+            params = []
+            for k in sorted(npz.files):
+                a = npz[k]
+                if "__dt_" in k:
+                    import ml_dtypes
+                    dt = np.dtype(getattr(ml_dtypes, k.split("__dt_")[1]))
+                    a = a.view(dt)
+                params.append(jnp.asarray(a))
         return cls(exported, params, meta)
 
 
@@ -168,7 +191,8 @@ def _spec_to_aval(spec, sym_prefix):
     return jax.ShapeDtypeStruct(shape, spec.dtype), True
 
 
-def export_program(fn_or_layer, input_spec, name="forward"):
+def export_program(fn_or_layer, input_spec, name="forward", ir_optim=True,
+                   precision=None):
     """Trace + export to a weight-separated StableHLO ExportedProgram.
 
     `input_spec`: list of InputSpec (None dims → symbolic batch) or example
@@ -176,6 +200,16 @@ def export_program(fn_or_layer, input_spec, name="forward"):
     touches (params, buffers, constants) — the analog of the reference
     collecting persistables out of the traced program
     (ref: python/paddle/jit/api.py _build_load_path_and_config / save logic).
+
+    `ir_optim`/`precision` drive the ANALYSIS PASS PIPELINE (ref:
+    inference/analysis/analysis_passes + AnalysisConfig ir_optim /
+    mixed-precision knobs): export is the point where this build's IR
+    (the traced jaxpr) is transformable, so load-time AnalysisPredictor
+    passes run here — delete_unused_params, bf16 weight+boundary casts
+    (precision="bfloat16"/"float16"); applied passes are recorded in the
+    artifact meta. Cross-param constant folding is intentionally absent:
+    weights are separated arguments in the artifact (the contract), so
+    they are not foldable constants.
     """
     from . import InputSpec
     from ..nn import Layer
@@ -197,13 +231,76 @@ def export_program(fn_or_layer, input_spec, name="forward"):
     if was_training:
         fn_or_layer.eval()
     try:
-        return _export_eval(fn_or_layer, fn, specs, examples, name)
+        return _export_eval(fn_or_layer, fn, specs, examples, name,
+                            ir_optim=ir_optim, precision=precision)
     finally:
         if was_training:
             fn_or_layer.train()
 
 
-def _export_eval(fn_or_layer, fn, specs, examples, name):
+def _analysis_pipeline(pure, cap_arrays, examples, ir_optim, precision):
+    """Export-time analysis passes over (pure, captured params).
+    Returns (pure', cap_arrays', [applied pass names], kept_indices)."""
+    applied = []
+    kept = list(range(len(cap_arrays)))
+    if ir_optim:
+        # --- delete_unused_params_pass: captured tensors that do not
+        # reach any output are dropped from the artifact (zero-filled
+        # placeholders keep the signature; XLA DCEs them) ---
+        closed = jax.make_jaxpr(pure)(
+            [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in cap_arrays],
+            *examples)
+        jaxpr = closed.jaxpr
+        # backward liveness: only eqns whose results (transitively) reach
+        # an output keep their inputs alive — a computed-but-discarded
+        # branch does NOT keep its params
+        live = {id(v) for v in jaxpr.outvars}
+        for eqn in reversed(jaxpr.eqns):
+            if any(id(v) in live for v in eqn.outvars):
+                for v in eqn.invars:
+                    live.add(id(v))
+        # flatten order of the cap-list pytree arg = leading invars
+        cap_invars = jaxpr.invars[:len(cap_arrays)]
+        keep = [i for i, v in enumerate(cap_invars) if id(v) in live]
+        if len(keep) < len(cap_arrays):
+            shapes = [(a.shape, a.dtype) for a in cap_arrays]
+            inner = pure
+
+            def pure_dce(cap_sub, *input_arrays, _inner=inner,
+                         _shapes=shapes, _keep=frozenset(keep)):
+                full, it = [], iter(cap_sub)
+                for i, (sh, dt) in enumerate(_shapes):
+                    full.append(next(it) if i in _keep
+                                else jnp.zeros(sh, dt))
+                return _inner(full, *input_arrays)
+
+            applied.append(
+                f"delete_unused_params_pass({len(cap_arrays) - len(keep)}"
+                f" dropped)")
+            pure, cap_arrays, kept = pure_dce, [cap_arrays[i]
+                                               for i in keep], keep
+    if precision in ("bfloat16", "float16"):
+        dt = jnp.dtype(precision)
+        cast_caps = [a.astype(dt) if jnp.issubdtype(a.dtype, jnp.floating)
+                     else a for a in cap_arrays]
+        inner2 = pure
+
+        def pure_bf16(cap_arrays2, *input_arrays, _inner=inner2, _dt=dt):
+            ins = [a.astype(_dt) if jnp.issubdtype(a.dtype, jnp.floating)
+                   else a for a in input_arrays]
+            outs = _inner(cap_arrays2, *ins)
+            return tuple(o.astype(jnp.float32)
+                         if jnp.issubdtype(o.dtype, jnp.floating) else o
+                         for o in outs)
+
+        applied.append(f"mixed_precision_pass({precision} weights + "
+                       f"boundary casts)")
+        pure, cap_arrays = pure_bf16, cast_caps
+    return pure, cap_arrays, applied, kept
+
+
+def _export_eval(fn_or_layer, fn, specs, examples, name, ir_optim=True,
+                 precision=None):
     from . import _capture_run, _swapped_data
     from ..nn import Layer
 
@@ -233,8 +330,12 @@ def _export_eval(fn_or_layer, fn, specs, examples, name):
             o = fn(*[Tensor(a) for a in input_arrays])
             return tuple(_flatten_struct(o, []))
 
-    cap_avals = [jax.ShapeDtypeStruct(t.data.shape, t.data.dtype)
-                 for t in captured]
+    cap_arrays_v = [t.data for t in captured]
+    pure, cap_arrays_v, passes_applied, kept = _analysis_pipeline(
+        pure, cap_arrays_v, examples, ir_optim, precision)
+    param_names = [param_names[i] for i in kept]
+    cap_avals = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                 for a in cap_arrays_v]
     in_avals, any_sym = [], False
     for i, s in enumerate(specs):
         aval, sym = _spec_to_aval(s, f"d{i}")
@@ -275,8 +376,9 @@ def _export_eval(fn_or_layer, fn, specs, examples, name):
         "out_struct": out_struct,
         "polymorphic_batch": poly,
         "platforms": list(exported.platforms),
+        "passes": passes_applied,
     }
-    return ExportedProgram(exported, [t.data for t in captured], meta)
+    return ExportedProgram(exported, cap_arrays_v, meta)
 
 
 class TranslatedLayer:

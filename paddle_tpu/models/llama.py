@@ -96,13 +96,15 @@ class LlamaAttention(Layer):
         self.hidden_size = config.hidden_size
         self.num_heads = config.num_attention_heads
         self.head_dim = self.hidden_size // self.num_heads
+        # grouped-query attention: k/v project to num_key_value_heads
+        # (LLaMA-2-70B geometry); sdpa expands KV head-wise at dispatch
+        self.num_kv_heads = config.num_key_value_heads
+        kv_out = self.num_kv_heads * self.head_dim
         kw = dict(has_bias=False, gather_output=False)
         self.q_proj = ColumnParallelLinear(self.hidden_size, self.hidden_size,
                                            **kw)
-        self.k_proj = ColumnParallelLinear(self.hidden_size, self.hidden_size,
-                                           **kw)
-        self.v_proj = ColumnParallelLinear(self.hidden_size, self.hidden_size,
-                                           **kw)
+        self.k_proj = ColumnParallelLinear(self.hidden_size, kv_out, **kw)
+        self.v_proj = ColumnParallelLinear(self.hidden_size, kv_out, **kw)
         self.o_proj = RowParallelLinear(self.hidden_size, self.hidden_size,
                                         has_bias=False, input_is_parallel=True)
         cos, sin = _rope_cache(config.max_position_embeddings, self.head_dim,
@@ -124,10 +126,10 @@ class LlamaAttention(Layer):
 
         def rotary(qa, ka, va):
             import jax.lax as lax
-            nh = qa.shape[-1] // hd
-            qa = qa.reshape(b, s, nh, hd)
-            ka = ka.reshape(b, s, nh, hd)
-            va = va.reshape(b, s, nh, hd)
+            # per-tensor head counts: under GQA k/v carry fewer heads
+            qa = qa.reshape(b, s, qa.shape[-1] // hd, hd)
+            ka = ka.reshape(b, s, ka.shape[-1] // hd, hd)
+            va = va.reshape(b, s, va.shape[-1] // hd, hd)
             if sp:
                 n_sep = lax.axis_size("sep")
                 if s * n_sep > cos.shape[0]:

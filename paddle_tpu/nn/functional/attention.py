@@ -53,6 +53,22 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     """Inputs [batch, seq, num_heads, head_dim] (paddle convention)."""
     if not training:
         dropout_p = 0.0  # eval-mode attention is deterministic
+    # grouped-query attention (fewer KV heads than query heads): expand KV
+    # head-wise before dispatch so every backend (flash/XLA/ring) sees MHA
+    # (ref: the repeat_kv step of GQA inference kernels)
+    h_q = query.shape[2]
+    h_kv = key.shape[2]
+    if h_kv != h_q:
+        if h_q % h_kv:
+            raise ValueError(
+                f"query heads {h_q} must be a multiple of kv heads {h_kv}")
+        rep = h_q // h_kv
+        # through the op registry so the tape records it (its vjp sums
+        # group cotangents back onto the shared KV head)
+        key = apply(lambda a: jnp.repeat(a, rep, axis=2), key,
+                    name="repeat_kv")
+        value = apply(lambda a: jnp.repeat(a, rep, axis=2), value,
+                      name="repeat_kv")
     args = [query, key, value]
     mask_needs_grad = False
     if attn_mask is not None:

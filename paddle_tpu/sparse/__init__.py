@@ -119,6 +119,7 @@ expm1 = _unary("expm1", jnp.expm1)
 deg2rad = _unary("deg2rad", jnp.deg2rad)
 rad2deg = _unary("rad2deg", jnp.rad2deg)
 neg = _unary("neg", jnp.negative)
+relu = _unary("relu", lambda v: jnp.maximum(v, 0.0))
 
 
 def pow(x, factor, name=None):

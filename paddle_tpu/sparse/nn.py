@@ -1,0 +1,132 @@
+"""paddle.sparse.nn — layers over sparse tensors (ref:
+python/paddle/sparse/nn/). The activation/norm tier operates on the
+VALUES of COO/CSR tensors (zeros stay zero for zero-preserving fns); the
+3D sparse-conv stack (Conv3D/SubmConv3D/MaxPool3D, a point-cloud
+subsystem with rulebook gather/scatter) is explicitly out of scope this
+round — constructing one raises with this rationale rather than
+pretending."""
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from . import _with_values, relu as _relu
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return _relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return _with_values(x, lambda v: jnp.clip(v, 0.0, 6.0))
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        a = self.negative_slope
+        return _with_values(x, lambda v: jnp.where(v > 0, v, a * v))
+
+
+class Softmax(Layer):
+    """Softmax over the last dense axis of a CSR tensor's rows
+    (ref: sparse/nn/functional/activation.py softmax: per-row over the
+    stored values). Vectorized with segment reductions over a row-id map
+    built from the (static) crows structure; values flow through apply()
+    so gradients record."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        if axis != -1:
+            raise ValueError("sparse softmax supports the last axis only "
+                             "(per-CSR-row), matching the reference kernel")
+        self.axis = axis
+
+    def forward(self, x):
+        from . import SparseCsrTensor
+        from ..ops import apply
+        import numpy as np
+        if not isinstance(x, SparseCsrTensor):
+            raise ValueError("sparse softmax expects a CSR tensor "
+                             "(per-row normalization)")
+        crows = np.asarray(getattr(x.crows, "data", x.crows))
+        row_ids = jnp.asarray(np.repeat(np.arange(len(crows) - 1),
+                                        np.diff(crows)))
+        n_rows = len(crows) - 1
+
+        def fn(v):
+            from jax.ops import segment_max, segment_sum
+            m = segment_max(v, row_ids, num_segments=n_rows)
+            e = jnp.exp(v - m[row_ids])
+            s = segment_sum(e, row_ids, num_segments=n_rows)
+            return e / s[row_ids]
+
+        vals = apply(fn, x.values, name="sparse_softmax")
+        return SparseCsrTensor(x.crows, x.cols, vals, x.shape)
+
+
+class BatchNorm(Layer):
+    """ref: sparse/nn/layer/norm.py BatchNorm — normalizes the stored
+    values over the channel (last) axis."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = self.create_parameter([num_features], attr=None,
+                                            dtype=self._dtype)
+        self.bias = self.create_parameter([num_features], attr=None,
+                                          dtype=self._dtype, is_bias=True)
+        self.weight.data = jnp.ones((num_features,), self.weight.data.dtype)
+        self._mean = jnp.zeros((num_features,))
+        self._var = jnp.ones((num_features,))
+
+    def forward(self, x):
+        from . import SparseCooTensor, SparseCsrTensor
+        from ..ops import apply
+        raw = getattr(x.values, "data", x.values)
+        if self.training:
+            # batch stats computed on the concrete values OUTSIDE the
+            # differentiated closure (stop-gradient stats; running stats
+            # update stays an eager side effect, never a leaked tracer)
+            m = jnp.mean(raw, axis=0)
+            var = jnp.var(raw, axis=0)
+            self._mean = (self.momentum * self._mean
+                          + (1 - self.momentum) * m)
+            self._var = (self.momentum * self._var
+                         + (1 - self.momentum) * var)
+        else:
+            m, var = self._mean, self._var
+
+        def bn(v, w, b):
+            vhat = (v - m) / jnp.sqrt(var + self.epsilon)
+            return vhat * w + b
+
+        # weight/bias are apply() INPUTS so the affine params train
+        vals = apply(bn, x.values, self.weight, self.bias,
+                     name="sparse_batch_norm")
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(x.indices, vals, x.shape)
+        return SparseCsrTensor(x.crows, x.cols, vals, x.shape)
+
+
+def _conv_descope(name):
+    class _Absent(Layer):
+        def __init__(self, *a, **kw):
+            raise NotImplementedError(
+                f"sparse.nn.{name}: the 3D sparse-convolution stack "
+                f"(rulebook gather/scatter over voxel grids, ref "
+                f"paddle/phi/kernels/sparse/conv_kernel*) is a point-cloud "
+                f"subsystem not yet built in the TPU port — use dense "
+                f"conv3d or open the descope note in BASELINE.md")
+    _Absent.__name__ = name
+    return _Absent
+
+
+Conv3D = _conv_descope("Conv3D")
+SubmConv3D = _conv_descope("SubmConv3D")
+MaxPool3D = _conv_descope("MaxPool3D")

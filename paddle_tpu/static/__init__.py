@@ -242,7 +242,9 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
             "save_inference_model on TPU serializes a traced callable: pass "
             "program=<Layer or fn over Tensors>")
     feed = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
-    prog = export_program(target, feed)
+    prog = export_program(target, feed,
+                          ir_optim=kwargs.get("ir_optim", True),
+                          precision=kwargs.get("precision"))
     return prog.save(path_prefix)
 
 

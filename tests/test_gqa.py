@@ -1,0 +1,108 @@
+"""Grouped-query attention (num_key_value_heads < num_attention_heads,
+LLaMA-2-70B geometry): sdpa-level KV expansion parity, gradient flow onto
+the shared KV heads, and end-to-end training through SpmdTrainer."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def test_sdpa_gqa_matches_manual_repeat():
+    rng = np.random.RandomState(0)
+    b, s, h, hkv, d = 2, 32, 8, 2, 16
+    q = Tensor(jnp.asarray(rng.randn(b, s, h, d), jnp.float32))
+    k = Tensor(jnp.asarray(rng.randn(b, s, hkv, d), jnp.float32))
+    v = Tensor(jnp.asarray(rng.randn(b, s, hkv, d), jnp.float32))
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    kr = Tensor(jnp.repeat(k.data, h // hkv, axis=2))
+    vr = Tensor(jnp.repeat(v.data, h // hkv, axis=2))
+    ref = F.scaled_dot_product_attention(q, kr, vr, is_causal=True)
+    np.testing.assert_allclose(np.asarray(out.data), np.asarray(ref.data),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gqa_grads_sum_over_group():
+    rng = np.random.RandomState(1)
+    b, s, h, hkv, d = 1, 16, 4, 2, 8
+    qa = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    ka = jnp.asarray(rng.randn(b, s, hkv, d), jnp.float32)
+    va = jnp.asarray(rng.randn(b, s, hkv, d), jnp.float32)
+    q = Tensor(qa, stop_gradient=False)
+    k = Tensor(ka, stop_gradient=False)
+    v = Tensor(va, stop_gradient=False)
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=False)
+    (out * out).sum().backward()
+    assert k.grad is not None and tuple(k.grad.shape) == tuple(ka.shape)
+
+    # reference: jax grad over the expanded computation, summed per group
+    def loss(ka_, va_):
+        kr = jnp.repeat(ka_, h // hkv, axis=2)
+        vr = jnp.repeat(va_, h // hkv, axis=2)
+        o = F.scaled_dot_product_attention(
+            Tensor(qa), Tensor(kr), Tensor(vr), is_causal=False).data
+        return jnp.sum(o * o)
+
+    gk, gv = jax.grad(loss, argnums=(0, 1))(ka, va)
+    np.testing.assert_allclose(np.asarray(k.grad.data), np.asarray(gk),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(v.grad.data), np.asarray(gv),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_llama_gqa_trains():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.train_step import SpmdTrainer
+    from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
+
+    cfg = LlamaConfig.tiny(num_key_value_heads=2)  # 4 q heads, 2 kv heads
+    assert cfg.num_key_value_heads == 2
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    # kv projections are genuinely smaller
+    attn = model.llama.layers[0].self_attn
+    assert attn.k_proj.weight.shape[1] == 2 * attn.head_dim
+    mesh = build_mesh({"data": 1, "pipe": 1, "sharding": 1, "model": 1})
+    set_global_mesh(mesh)
+    tr = SpmdTrainer(model, mesh, lr=1e-2)
+    st = tr.init_state()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (4, 32)).astype(np.int64)
+    labels = np.roll(ids, -1, axis=1)
+    losses = []
+    for i in range(4):
+        st, loss = tr.step(st, ids, labels, key=jax.random.key(i))
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() if hasattr(np, "isfinite") else True
+    assert losses[-1] < losses[0], losses
+
+
+def test_llama_gqa_sep_parity():
+    """GQA composes with context parallelism (ring attention expansion)."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.train_step import SpmdTrainer
+    from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
+
+    cfg = LlamaConfig.tiny(num_key_value_heads=2)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (4, 64)).astype(np.int64)
+    labels = np.roll(ids, -1, axis=1)
+
+    def traj(axes):
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        mesh = build_mesh(axes)
+        set_global_mesh(mesh)
+        tr = SpmdTrainer(model, mesh, lr=1e-2)
+        st = tr.init_state()
+        out = []
+        for i in range(3):
+            st, loss = tr.step(st, ids, labels, key=jax.random.key(i))
+            out.append(float(loss))
+        return out
+
+    base = traj({"data": 1, "pipe": 1, "sharding": 1, "model": 1})
+    sp = traj({"data": 1, "pipe": 1, "sharding": 1, "model": 1, "sep": 2})
+    np.testing.assert_allclose(sp, base, rtol=2e-3)

@@ -1,0 +1,63 @@
+"""Export-time analysis pass pipeline (L7 gap; ref:
+inference/analysis/analysis_passes + AnalysisConfig mixed precision)."""
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import InputSpec
+from paddle_tpu.jit.export import export_program
+
+
+class NetWithDeadParam(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.used = nn.Linear(4, 4)
+        self.dead = nn.Linear(4, 4)
+
+    def forward(self, x):
+        _ = self.dead(x)   # computed but DISCARDED: captured yet unused
+        return self.used(x)
+
+
+def test_delete_unused_params_pass(tmp_path):
+    paddle.seed(0)
+    net = NetWithDeadParam()
+    prog = export_program(net, [InputSpec([2, 4], "float32")])
+    assert any("delete_unused_params" in p for p in prog.meta["passes"])
+    # only the used Linear's weight+bias survive in the artifact
+    assert len(prog.params) == 2, prog.meta["param_names"]
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    out = prog(jnp.asarray(x))[0]
+    ref = net(paddle.to_tensor(x)).data
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_bf16_mixed_precision_pass(tmp_path):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    prog = export_program(net, [InputSpec([2, 4], "float32")],
+                          precision="bfloat16")
+    assert any("mixed_precision" in p for p in prog.meta["passes"])
+    assert all(p.dtype == jnp.bfloat16 for p in prog.params
+               if jnp.issubdtype(p.dtype, jnp.floating))
+    x = np.random.RandomState(1).randn(2, 4).astype(np.float32)
+    out = prog(jnp.asarray(x))[0]
+    assert out.dtype == jnp.float32  # boundary cast back
+    ref = net(paddle.to_tensor(x)).data
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=0.05, atol=0.05)
+
+
+def test_predictor_accepts_bf16_artifact(tmp_path):
+    from paddle_tpu import inference
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 4))
+    prefix = str(tmp_path / "m")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([1, 4], "float32")],
+                    precision="bfloat16")
+    cfg = inference.Config(prefix)
+    cfg._precision = inference.PrecisionType.Bfloat16
+    pred = inference.create_predictor(cfg)  # must not raise
+    out = pred.run([np.zeros((1, 4), np.float32)])
+    assert np.isfinite(out[0]).all()

@@ -1,0 +1,46 @@
+"""paddle.sparse.nn layer tier (ref: python/paddle/sparse/nn/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+from paddle_tpu.sparse import nn as snn
+
+
+def _vals(x):
+    return np.asarray(getattr(x.values, "data", x.values))
+
+
+def test_activations_preserve_pattern():
+    coo = sparse.sparse_coo_tensor([[0, 1, 3]],
+                                   np.array([-1.0, 2.0, -3.0], np.float32),
+                                   [4])
+    np.testing.assert_allclose(_vals(snn.ReLU()(coo)), [0.0, 2.0, 0.0])
+    np.testing.assert_allclose(_vals(snn.ReLU6()(coo)), [0.0, 2.0, 0.0])
+    np.testing.assert_allclose(_vals(snn.LeakyReLU(0.1)(coo)),
+                               [-0.1, 2.0, -0.3], rtol=1e-6)
+
+
+def test_csr_softmax_rows_normalize():
+    csr = sparse.sparse_csr_tensor([0, 2, 3], [0, 2, 1],
+                                   np.array([1.0, 2.0, 3.0], np.float32),
+                                   [2, 3])
+    v = _vals(snn.Softmax()(csr))
+    np.testing.assert_allclose(v[:2].sum(), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(v[2], 1.0, rtol=1e-6)
+
+
+def test_batchnorm_normalizes_values():
+    rng = np.random.RandomState(0)
+    vals = rng.randn(64, 8).astype(np.float32) * 3 + 5
+    coo = sparse.sparse_coo_tensor([list(range(64))], vals, [64, 8])
+    bn = snn.BatchNorm(8)
+    bn.train()
+    out = _vals(bn(coo))
+    np.testing.assert_allclose(out.mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(out.std(0), 1.0, atol=1e-2)
+
+
+def test_sparse_conv_descope_is_loud():
+    with pytest.raises(NotImplementedError, match="rulebook"):
+        snn.Conv3D(4, 8, 3)

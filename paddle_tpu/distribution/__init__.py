@@ -217,4 +217,372 @@ def kl_divergence(p, q):
             return jnp.sum(pp * (jax.nn.log_softmax(lp, -1)
                                  - jax.nn.log_softmax(lq, -1)), -1)
         return apply(fn, p.logits, q.logits)
+    if isinstance(p, Laplace) and isinstance(q, Laplace):
+        def fn(l1, s1, l2, s2):
+            d = jnp.abs(l1 - l2)
+            return (jnp.log(s2 / s1) + d / s2
+                    + s1 / s2 * jnp.exp(-d / s1) - 1.0)
+        return apply(fn, p.loc, p.scale, q.loc, q.scale)
+    if isinstance(p, Exponential) and isinstance(q, Exponential):
+        return apply(lambda r1, r2: jnp.log(r1 / r2) + r2 / r1 - 1.0,
+                     p.rate, q.rate)
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        def fn(al, ah, bl, bh):
+            inside = (bl <= al) & (bh >= ah)
+            return jnp.where(inside, jnp.log((bh - bl) / (ah - al)),
+                             jnp.inf)
+        return apply(fn, p.low, p.high, q.low, q.high)
     raise NotImplementedError(f"kl({type(p).__name__}, {type(q).__name__})")
+
+
+class Laplace(Distribution):
+    """ref: python/paddle/distribution/laplace.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(rnd.next_key(),
+                               tuple(shape) + self._batch_shape,
+                               minval=-0.5 + 1e-7, maxval=0.5)
+        return apply(lambda l, s: l - s * jnp.sign(u)
+                     * jnp.log1p(-2.0 * jnp.abs(u)), self.loc, self.scale)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return apply(lambda v, l, s: -jnp.abs(v - l) / s
+                     - jnp.log(2.0 * s), _t(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply(lambda s: 1.0 + jnp.log(2.0 * s), self.scale)
+
+
+class Gumbel(Distribution):
+    """ref: python/paddle/distribution/gumbel.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    def sample(self, shape=()):
+        g = jax.random.gumbel(rnd.next_key(),
+                              tuple(shape) + self._batch_shape)
+        return apply(lambda l, s: l + s * g, self.loc, self.scale)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v, l, s):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return apply(fn, _t(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply(lambda s: jnp.log(s) + 1.0 + jnp.euler_gamma,
+                     self.scale)
+
+    @property
+    def mean(self):
+        return apply(lambda l, s: l + s * jnp.euler_gamma, self.loc,
+                     self.scale)
+
+
+class LogNormal(Distribution):
+    """ref: python/paddle/distribution/lognormal.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    def sample(self, shape=()):
+        z = jax.random.normal(rnd.next_key(),
+                              tuple(shape) + self._batch_shape)
+        return apply(lambda l, s: jnp.exp(l + s * z), self.loc, self.scale)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v, l, s):
+            lv = jnp.log(v)
+            return (-((lv - l) ** 2) / (2 * s * s) - lv
+                    - jnp.log(s * jnp.sqrt(2.0 * jnp.pi)))
+        return apply(fn, _t(value), self.loc, self.scale)
+
+
+class Geometric(Distribution):
+    """P(k) = (1-p)^k p, k in {0,1,...} (ref: distribution/geometric.py)."""
+
+    def __init__(self, probs):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(rnd.next_key(),
+                               tuple(shape) + tuple(self.probs.shape),
+                               minval=1e-7, maxval=1.0)
+        return apply(lambda p: jnp.floor(jnp.log(u) / jnp.log1p(-p)),
+                     self.probs)
+
+    def log_prob(self, value):
+        return apply(lambda v, p: v * jnp.log1p(-p) + jnp.log(p),
+                     _t(value), self.probs)
+
+    @property
+    def mean(self):
+        return apply(lambda p: (1 - p) / p, self.probs)
+
+
+class Cauchy(Distribution):
+    """ref: python/paddle/distribution/cauchy.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    def sample(self, shape=()):
+        c = jax.random.cauchy(rnd.next_key(),
+                              tuple(shape) + self._batch_shape)
+        return apply(lambda l, s: l + s * c, self.loc, self.scale)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v, l, s):
+            z = (v - l) / s
+            return -jnp.log(jnp.pi * s * (1 + z * z))
+        return apply(fn, _t(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply(lambda s: jnp.log(4.0 * jnp.pi * s), self.scale)
+
+
+class StudentT(Distribution):
+    """ref: python/paddle/distribution/student_t.py."""
+
+    def __init__(self, df, loc, scale):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.df.shape), tuple(self.loc.shape),
+            tuple(self.scale.shape))))
+
+    def sample(self, shape=()):
+        t = jax.random.t(rnd.next_key(), _raw(self.df),
+                         tuple(shape) + self._batch_shape)
+        return apply(lambda l, s: l + s * t, self.loc, self.scale)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        def fn(v, df, l, s):
+            z = (v - l) / s
+            return (gammaln((df + 1) / 2) - gammaln(df / 2)
+                    - 0.5 * jnp.log(df * jnp.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(z * z / df))
+        return apply(fn, _t(value), self.df, self.loc, self.scale)
+
+
+class Poisson(Distribution):
+    """ref: python/paddle/distribution/poisson.py."""
+
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    def sample(self, shape=()):
+        s = jax.random.poisson(rnd.next_key(), _raw(self.rate),
+                               tuple(shape) + tuple(self.rate.shape))
+        return Tensor(s.astype(jnp.float32))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        return apply(lambda v, r: v * jnp.log(r) - r - gammaln(v + 1),
+                     _t(value), self.rate)
+
+    @property
+    def mean(self):
+        return self.rate
+
+
+class Binomial(Distribution):
+    """ref: python/paddle/distribution/binomial.py."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = _t(total_count)
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    def sample(self, shape=()):
+        n = int(np_max_int(self.total_count))
+        u = jax.random.uniform(
+            rnd.next_key(),
+            (n,) + tuple(shape) + tuple(self.probs.shape))
+
+        def fn(p, tc):
+            trials = jnp.arange(n).reshape((n,) + (1,) * (u.ndim - 1))
+            active = (trials < tc).astype(jnp.float32)  # per-element count
+            return jnp.sum((u < p).astype(jnp.float32) * active, axis=0)
+
+        return apply(fn, self.probs, self.total_count)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        def fn(v, n, p):
+            return (gammaln(n + 1) - gammaln(v + 1) - gammaln(n - v + 1)
+                    + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+        return apply(fn, _t(value), self.total_count, self.probs)
+
+
+def np_max_int(t):
+    import numpy as _np
+    return _np.max(_np.asarray(_raw(t)))
+
+
+class Multinomial(Distribution):
+    """ref: python/paddle/distribution/multinomial.py."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape[:-1]),
+                         tuple(self.probs.shape[-1:]))
+
+    def sample(self, shape=()):
+        k = self.probs.shape[-1]
+        draws = jax.random.categorical(
+            rnd.next_key(), jnp.log(_raw(self.probs)),
+            shape=(self.total_count,) + tuple(shape)
+            + tuple(self.probs.shape[:-1]))
+        counts = jax.nn.one_hot(draws, k).sum(axis=0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        def fn(v, p):
+            return (gammaln(jnp.sum(v, -1) + 1)
+                    - jnp.sum(gammaln(v + 1), -1)
+                    + jnp.sum(v * jnp.log(p), -1))
+        return apply(fn, _t(value), self.probs)
+
+
+class Dirichlet(Distribution):
+    """ref: python/paddle/distribution/dirichlet.py."""
+
+    def __init__(self, concentration):
+        self.concentration = _t(concentration)
+        super().__init__(tuple(self.concentration.shape[:-1]),
+                         tuple(self.concentration.shape[-1:]))
+
+    def sample(self, shape=()):
+        s = jax.random.dirichlet(rnd.next_key(), _raw(self.concentration),
+                                 tuple(shape)
+                                 + tuple(self.concentration.shape[:-1]))
+        return Tensor(s)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        def fn(v, a):
+            return (jnp.sum((a - 1) * jnp.log(v), -1)
+                    + gammaln(jnp.sum(a, -1)) - jnp.sum(gammaln(a), -1))
+        return apply(fn, _t(value), self.concentration)
+
+
+# --- transformed distributions (ref: python/paddle/distribution/
+#     transformed_distribution.py + transform.py) --------------------------
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        return apply(lambda x_, l, s: l + s * x_, _t(x), self.loc,
+                     self.scale)
+
+    def inverse(self, y):
+        return apply(lambda y_, l, s: (y_ - l) / s, _t(y), self.loc,
+                     self.scale)
+
+    def forward_log_det_jacobian(self, x):
+        return apply(lambda _x, s: jnp.broadcast_to(jnp.log(jnp.abs(s)),
+                                                    _x.shape),
+                     _t(x), self.scale)
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return apply(jnp.exp, _t(x))
+
+    def inverse(self, y):
+        return apply(jnp.log, _t(y))
+
+    def forward_log_det_jacobian(self, x):
+        return _t(x)
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return apply(jax.nn.sigmoid, _t(x))
+
+    def inverse(self, y):
+        return apply(lambda y_: jnp.log(y_) - jnp.log1p(-y_), _t(y))
+
+    def forward_log_det_jacobian(self, x):
+        return apply(lambda x_: -jax.nn.softplus(-x_)
+                     - jax.nn.softplus(x_), _t(x))
+
+
+class TransformedDistribution(Distribution):
+    """base pushed through a chain of transforms; log_prob via the
+    change-of-variables formula."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(tuple(base.batch_shape))
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    rsample = sample
+
+    def log_prob(self, value):
+        y = _t(value)
+        log_det = None
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ld = t.forward_log_det_jacobian(x)
+            log_det = ld if log_det is None else log_det + ld
+            y = x
+        lp = self.base.log_prob(y)
+        return lp - log_det if log_det is not None else lp

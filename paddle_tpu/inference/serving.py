@@ -125,6 +125,9 @@ class LLMEngine:
         self.n_pages = max_batch * self.max_pages_per_seq
         self.nh = cfg.num_attention_heads
         self.hd = cfg.hidden_size // self.nh
+        # GQA checkpoints: k/v projections emit fewer heads; expanded to
+        # nh right after projection so the paged cache stays uniform
+        self.nh_kv = getattr(cfg, "num_key_value_heads", self.nh) or self.nh
         self.quant = quant
         # interpret Pallas kernels off-TPU so the engine runs in CI
         self.interpret = (use_pallas is False) or \
@@ -159,8 +162,14 @@ class LLMEngine:
         b, t, H = h.shape
         x = _rms(h, wset["ln1"], self.weights["eps"])
         q = _mm(x, wset["wq"], self.interpret).reshape(b, t, self.nh, self.hd)
-        k = _mm(x, wset["wk"], self.interpret).reshape(b, t, self.nh, self.hd)
-        v = _mm(x, wset["wv"], self.interpret).reshape(b, t, self.nh, self.hd)
+        k = _mm(x, wset["wk"], self.interpret).reshape(b, t, self.nh_kv,
+                                                       self.hd)
+        v = _mm(x, wset["wv"], self.interpret).reshape(b, t, self.nh_kv,
+                                                       self.hd)
+        if self.nh_kv != self.nh:
+            rep = self.nh // self.nh_kv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         c = cos[pos_ids][..., None, :].astype(q.dtype)
         s = sin[pos_ids][..., None, :].astype(q.dtype)
         d2 = self.hd // 2

@@ -54,11 +54,12 @@ def _decode_math(model, ids, caches, pos, max_len):
         q = (x @ attn.q_proj.weight.data)
         k = (x @ attn.k_proj.weight.data)
         v = (x @ attn.v_proj.weight.data)
-        nh = q.shape[-1] // attn.head_dim
         hd = attn.head_dim
+        nh = q.shape[-1] // hd
+        nh_kv = k.shape[-1] // hd   # GQA: k/v may carry fewer heads
         q = q.reshape(b, t, nh, hd)
-        k = k.reshape(b, t, nh, hd)
-        v = v.reshape(b, t, nh, hd)
+        k = k.reshape(b, t, nh_kv, hd)
+        v = v.reshape(b, t, nh_kv, hd)
         # rotary at absolute positions
         c = cos[pos_ids][None, :, None, :]
         s = sin[pos_ids][None, :, None, :]
@@ -69,6 +70,10 @@ def _decode_math(model, ids, caches, pos, max_len):
             return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], -1)
 
         q, k = rope(q), rope(k)
+        if k.shape[2] != nh:  # expand to query heads for the cache/attn
+            rep = nh // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         k_buf, v_buf = caches[li]
         k_buf = jax.lax.dynamic_update_slice_in_dim(k_buf, k.astype(
             k_buf.dtype), pos, axis=1)

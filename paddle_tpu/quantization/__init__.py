@@ -82,3 +82,114 @@ def quantize_model(model, bits=8):
         else:
             quantize_model(sub, bits)
     return model
+
+
+# --- QAT (quant-aware training) tier ---------------------------------------
+# ref: python/paddle/quantization/qat.py QAT + quanters/ FakeQuanterWithAbsMax
+# — fake-quant in the forward, straight-through estimator in the backward.
+
+def fake_quant(x, scale, bits=8):
+    """Simulated quantization q(x) = round(clip(x/s)) * s with an STE
+    gradient (d q/d x = 1 inside the clip range, 0 outside)."""
+    import jax
+    from ..ops import apply
+    qmax = 2 ** (bits - 1) - 1
+
+    @jax.custom_vjp
+    def fq(a, s):
+        q = jnp.clip(jnp.round(a / s), -qmax - 1, qmax)
+        return q * s
+
+    def fq_fwd(a, s):
+        return fq(a, s), (a, s)
+
+    def fq_bwd(res, g):
+        a, s = res
+        inside = (jnp.abs(a) <= (qmax + 0.5) * s).astype(g.dtype)
+        return g * inside, jnp.zeros_like(s)
+
+    fq.defvjp(fq_fwd, fq_bwd)
+    return apply(fq, x, scale, name="fake_quant")
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """ref: quanters/abs_max.py — running-absmax activation quanter with a
+    momentum-updated scale; STE backward."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__()
+        self.bits = quant_bits
+        self.moving_rate = moving_rate
+        self._scale = None
+
+    def forward(self, x):
+        arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        qmax = 2 ** (self.bits - 1) - 1
+        if self.training:
+            cur = float(jnp.max(jnp.abs(arr))) / qmax
+            if self._scale is None:
+                self._scale = max(cur, 1e-8)
+            else:
+                self._scale = (self.moving_rate * self._scale
+                               + (1 - self.moving_rate) * cur)
+        s = jnp.float32(self._scale if self._scale else 1.0)
+        return fake_quant(x, Tensor(s), self.bits)
+
+
+class QATLinear(Layer):
+    """Linear with fake-quantized weights + activations (training-time
+    int8 simulation; convert() to the deploy-time QuantizedLinear)."""
+
+    def __init__(self, linear, bits=8, moving_rate=0.9):
+        super().__init__()
+        self.inner = linear
+        self.bits = bits
+        self.act_quanter = FakeQuanterWithAbsMaxObserver(bits, moving_rate)
+
+    def forward(self, x):
+        from ..nn import functional as F
+        qmax = 2 ** (self.bits - 1) - 1
+        w = self.inner.weight
+        wscale = Tensor(jnp.max(jnp.abs(w.data)) / qmax)
+        wq = fake_quant(w, wscale, self.bits)
+        xq = self.act_quanter(x)
+        out = F.linear(xq, wq)
+        if self.inner.bias is not None:
+            out = out + self.inner.bias
+        return out
+
+
+class QAT:
+    """ref: qat.py QAT — quantize() swaps Linears for fake-quant wrappers;
+    convert() produces the int8 deploy model."""
+
+    def __init__(self, config=None, bits=8):
+        self.bits = (config or {}).get("bits", bits) \
+            if isinstance(config, dict) else bits
+
+    def _swap(self, model, factory, to_deploy):
+        from ..nn.layer.common import Linear
+        for name, sub in list(model._sub_layers.items()):
+            if isinstance(sub, QATLinear):
+                if to_deploy:  # convert(): unwrap the trained inner Linear
+                    model._sub_layers[name] = factory(sub.inner)
+                # quantize() is idempotent: an existing QATLinear keeps its
+                # calibrated activation scale
+            elif isinstance(sub, Linear):
+                model._sub_layers[name] = factory(sub)
+            else:
+                self._swap(sub, factory, to_deploy)
+        return model
+
+    def quantize(self, model, inplace=True):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        return self._swap(model, lambda l: QATLinear(l, self.bits), False)
+
+    def convert(self, model, inplace=True):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        return self._swap(model, lambda l: QuantizedLinear(l, self.bits),
+                          True)

@@ -82,8 +82,10 @@ class BatchNorm(Layer):
         self.bias = self.create_parameter([num_features], attr=None,
                                           dtype=self._dtype, is_bias=True)
         self.weight.data = jnp.ones((num_features,), self.weight.data.dtype)
-        self._mean = jnp.zeros((num_features,))
-        self._var = jnp.ones((num_features,))
+        # running stats as buffers: they must survive state_dict save/load
+        from ..tensor.tensor import Tensor as _T
+        self.register_buffer("_mean", _T(jnp.zeros((num_features,))))
+        self.register_buffer("_var", _T(jnp.ones((num_features,))))
 
     def forward(self, x):
         from . import SparseCooTensor, SparseCsrTensor
@@ -95,12 +97,12 @@ class BatchNorm(Layer):
             # update stays an eager side effect, never a leaked tracer)
             m = jnp.mean(raw, axis=0)
             var = jnp.var(raw, axis=0)
-            self._mean = (self.momentum * self._mean
-                          + (1 - self.momentum) * m)
-            self._var = (self.momentum * self._var
-                         + (1 - self.momentum) * var)
+            self._mean.data = (self.momentum * self._mean.data
+                               + (1 - self.momentum) * m)
+            self._var.data = (self.momentum * self._var.data
+                              + (1 - self.momentum) * var)
         else:
-            m, var = self._mean, self._var
+            m, var = self._mean.data, self._var.data
 
         def bn(v, w, b):
             vhat = (v - m) / jnp.sqrt(var + self.epsilon)

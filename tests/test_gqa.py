@@ -106,3 +106,18 @@ def test_llama_gqa_sep_parity():
     base = traj({"data": 1, "pipe": 1, "sharding": 1, "model": 1})
     sp = traj({"data": 1, "pipe": 1, "sharding": 1, "model": 1, "sep": 2})
     np.testing.assert_allclose(sp, base, rtol=2e-3)
+
+
+def test_gqa_generate_decode_path():
+    """GQA must work through the KV-cache decode loop (review regression:
+    generation.py reshaped K/V with the query head count)."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+
+    cfg = LlamaConfig.tiny(num_key_value_heads=2)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    ids = np.array([[1, 2, 3]], np.int64)
+    out = generate(model, paddle.to_tensor(ids), max_new_tokens=4)
+    arr = np.asarray(out.data if hasattr(out, "data") else out)
+    assert arr.shape[1] >= 4

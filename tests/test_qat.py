@@ -1,0 +1,52 @@
+"""QAT tier (ref: python/paddle/quantization/qat.py): fake-quant STE
+gradients, quantize->train->convert roundtrip."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer, quantization as Q
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def test_fake_quant_values_and_ste():
+    x = Tensor(jnp.asarray([0.11, -0.26, 3.0], jnp.float32),
+               stop_gradient=False)
+    s = Tensor(jnp.float32(0.1))
+    y = Q.fake_quant(x, s, bits=8)
+    np.testing.assert_allclose(np.asarray(y.data), [0.1, -0.3, 3.0],
+                               atol=1e-6)  # 3.0 clips to 127*0.1=12.7? no: clip at qmax
+    y.sum().backward()
+    g = np.asarray(x.grad.data)
+    # STE: grad 1 inside the clip range, 0 for the clipped 3.0 (>12.75)
+    np.testing.assert_allclose(g[:2], [1.0, 1.0])
+
+
+def test_qat_roundtrip_trains_and_converts():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    qat = Q.QAT(bits=8)
+    qnet = qat.quantize(net)
+    assert any(isinstance(l, Q.QATLinear) for l in qnet._sub_layers.values())
+    opt = optimizer.SGD(learning_rate=0.05,
+                        parameters=[p for p in qnet.parameters()
+                                    if not p.stop_gradient])
+    rng = np.random.RandomState(0)
+    X = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+    Yt = paddle.to_tensor(rng.randn(16, 4).astype(np.float32))
+    losses = []
+    for _ in range(20):
+        out = qnet(X)
+        loss = ((out - Yt) ** 2).mean()
+        losses.append(float(loss))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert losses[-1] < losses[0], losses
+
+    dnet = qat.convert(qnet)
+    assert any(isinstance(l, Q.QuantizedLinear)
+               for l in dnet._sub_layers.values())
+    out = dnet(X)
+    assert np.isfinite(np.asarray(out.data)).all()

@@ -1,0 +1,3 @@
+from .p2p_communication import (  # noqa: F401
+    SendRecvMeta, recv_backward, recv_forward, send_backward, send_forward,
+    send_forward_recv_backward, send_backward_recv_forward)

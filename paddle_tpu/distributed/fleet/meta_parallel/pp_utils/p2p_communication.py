@@ -1,0 +1,114 @@
+"""Pipeline-stage p2p verbs.
+
+ref: python/paddle/distributed/fleet/meta_parallel/pp_utils/
+p2p_communication.py:298 — the reference's NCCL send/recv pairs between
+adjacent pipeline stages, with a SendRecvMeta handshake describing
+shape/dtype before the payload.
+
+Two transports, selected by the runtime:
+- single-controller (one process drives all stages): a plain in-process
+  queue hand-off — the schedule semantics the host-driven
+  PipelineParallel uses;
+- multi-process eager (init_parallel_env world > 1): the world-TCPStore
+  send/recv from distributed.collective (the gloo-CPU analog). The meta
+  handshake travels as an object send so the receiver can allocate
+  without static shape agreement (the reference's SendRecvMeta contract).
+
+Compiled SPMD pipelines do NOT use these: lax.ppermute over the 'pipe'
+axis inside the one program (models/train_step.py) is the TPU-native
+fast path.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ....parallel_env import get_rank, get_world_size, is_initialized
+from .... import collective
+from .....tensor.tensor import Tensor
+
+
+class SendRecvMeta:
+    """Shape/dtype descriptor exchanged before payloads
+    (ref: p2p_communication.py SendRecvMeta)."""
+
+    def __init__(self, shape=None, dtype=None):
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = str(dtype) if dtype is not None else None
+
+    @classmethod
+    def of(cls, t):
+        a = t.data if isinstance(t, Tensor) else jnp.asarray(t)
+        return cls(a.shape, a.dtype)
+
+
+def _multiproc():
+    return is_initialized() and get_world_size() > 1
+
+
+# single-controller transport: per-(src,dst) FIFO queues
+_queues = {}
+
+
+def _q(src, dst):
+    return _queues.setdefault((src, dst), [])
+
+
+def send_forward(tensor, dst=None, group=None):
+    """Send activations to the next stage (ref: send_forward)."""
+    dst = dst if dst is not None else get_rank() + 1
+    if _multiproc():
+        collective.send(tensor, dst=dst, group=group)
+        return tensor
+    _q(get_rank(), dst).append(np.asarray(
+        tensor.data if isinstance(tensor, Tensor) else tensor))
+    return tensor
+
+
+def recv_forward(meta, src=None, group=None):
+    """Receive activations from the previous stage; `meta` is a
+    SendRecvMeta (or a template tensor) describing the buffer."""
+    src = src if src is not None else get_rank() - 1
+    if isinstance(meta, SendRecvMeta):
+        buf = Tensor(jnp.zeros(meta.shape, jnp.dtype(meta.dtype)))
+    else:
+        buf = Tensor(jnp.zeros_like(meta.data if isinstance(meta, Tensor)
+                                    else jnp.asarray(meta)))
+    if _multiproc():
+        collective.recv(buf, src=src, group=group)
+        return buf
+    q = _q(src, get_rank())
+    if not q:
+        raise RuntimeError(
+            f"recv_forward from stage {src}: nothing sent (single-"
+            f"controller transport is FIFO per (src, dst) pair)")
+    buf.data = jnp.asarray(q.pop(0))
+    return buf
+
+
+def send_backward(grad, dst=None, group=None):
+    """Send gradients to the previous stage (ref: send_backward)."""
+    dst = dst if dst is not None else get_rank() - 1
+    if _multiproc():
+        collective.send(grad, dst=dst, group=group)
+        return grad
+    _q(get_rank(), dst).append(np.asarray(
+        grad.data if isinstance(grad, Tensor) else grad))
+    return grad
+
+
+def recv_backward(meta, src=None, group=None):
+    """Receive gradients from the next stage (ref: recv_backward)."""
+    src = src if src is not None else get_rank() + 1
+    return recv_forward(meta, src=src, group=group)
+
+
+def send_forward_recv_backward(tensor, meta, peer=None, group=None):
+    """Steady-state 1F1B pair (ref: send_forward_recv_backward)."""
+    peer = peer if peer is not None else get_rank() + 1
+    send_forward(tensor, dst=peer, group=group)
+    return recv_backward(meta, src=peer, group=group)
+
+
+def send_backward_recv_forward(grad, meta, peer=None, group=None):
+    peer = peer if peer is not None else get_rank() - 1
+    send_backward(grad, dst=peer, group=group)
+    return recv_forward(meta, src=peer, group=group)

@@ -61,6 +61,11 @@ constexpr uint8_t OPT_SGD = 0;
 constexpr uint8_t OPT_ADAGRAD = 1;
 constexpr uint8_t OPT_ADAM = 2;
 
+// accessor kinds (ref: fluid/distributed/ps/table/ctr_accessor.h — the
+// zmxdream fork's CTR feature-value accessor)
+constexpr uint8_t ACC_DIRECT = 0;
+constexpr uint8_t ACC_CTR = 1;
+
 bool read_full(int fd, void* buf, size_t n) {
   char* p = static_cast<char*>(buf);
   while (n > 0) {
@@ -93,6 +98,19 @@ struct TableConfig {
   float max_bound = 10.f;
   float adagrad_init_g2 = 0.f;
   float beta1 = 0.9f, beta2 = 0.999f, eps = 1e-8f;
+  // CTR accessor (ref: ctr_accessor.h CtrCommonAccessor): dim is
+  // 1 (embed_w) + embedx_dim; the embedx block stays dormant (zeros on
+  // pull, updates skipped) until the show/click score crosses
+  // embedx_threshold. score = nonclk_coeff*(show-click)
+  //                         + click_coeff*click  (ShowClickScore).
+  uint8_t accessor = ACC_DIRECT;
+  float nonclk_coeff = 0.1f;
+  float click_coeff = 1.0f;
+  float embedx_threshold = 10.f;
+
+  float score(float show, float click) const {
+    return nonclk_coeff * (show - click) + click_coeff * click;
+  }
 };
 
 // One sparse row: header (show, click, g2sum) + w[dim] (+ adam m,v).
@@ -148,6 +166,10 @@ struct Table {
     row[1] += click_inc;
     float* w = row.data() + 3;
     uint32_t d = cfg.dim;
+    if (cfg.accessor == ACC_CTR &&
+        cfg.score(row[0], row[1]) < cfg.embedx_threshold) {
+      d = 1;  // embedx dormant: only embed_w (slot 0) learns
+    }
     switch (cfg.optimizer) {
       case OPT_SGD: {
         for (uint32_t i = 0; i < d; ++i) w[i] -= cfg.lr * g[i];
@@ -326,6 +348,12 @@ void handle_client(Server* s, int fd) {
           goto done;
         spath.resize(splen);
         if (splen && !read_full(fd, spath.data(), splen)) goto done;
+        // accessor block follows the path bytes (client write order)
+        if (!read_full(fd, &cfg.accessor, 1) ||
+            !read_full(fd, &cfg.nonclk_coeff, 4) ||
+            !read_full(fd, &cfg.click_coeff, 4) ||
+            !read_full(fd, &cfg.embedx_threshold, 4))
+          goto done;
         {
           std::lock_guard<std::mutex> lk(s->tables_mu);
           auto it = s->tables.find(tid);
@@ -384,6 +412,14 @@ void handle_client(Server* s, int fd) {
           }
           std::memcpy(vals.data() + (size_t)i * d, it->second.data() + 3,
                       4ull * d);
+          if (t->cfg.accessor == ACC_CTR &&
+              t->cfg.score(it->second[0], it->second[1]) <
+                  t->cfg.embedx_threshold) {
+            // dormant embedx reads as zeros (ref: ctr_accessor
+            // Select/need_extend semantics)
+            std::memset(vals.data() + (size_t)i * d + 1, 0,
+                        4ull * (d - 1));
+          }
         }
         write_full(fd, &ok, 1);
         write_full(fd, vals.data(), 4ull * vals.size());
@@ -557,7 +593,11 @@ void handle_client(Server* s, int fd) {
             std::lock_guard<std::mutex> lk(sh.mu);
             for (auto it = sh.rows.begin(); it != sh.rows.end();) {
               it->second[0] *= decay;
-              if (it->second[0] < threshold) {
+              it->second[1] *= decay;
+              float metric = t->cfg.accessor == ACC_CTR
+                                 ? t->cfg.score(it->second[0], it->second[1])
+                                 : it->second[0];
+              if (metric < threshold) {
                 it = sh.rows.erase(it);
                 ++dropped;
               } else {
@@ -738,7 +778,9 @@ void ps_client_close(int fd) { ::close(fd); }
 
 int ps_create_table(int fd, uint32_t tid, uint8_t is_dense, uint8_t opt,
                     uint32_t dim, float lr, float init_range,
-                    uint64_t max_mem_rows, const char* spill_path) {
+                    uint64_t max_mem_rows, const char* spill_path,
+                    uint8_t accessor, float nonclk_coeff, float click_coeff,
+                    float embedx_threshold) {
   uint8_t op = OP_CREATE;
   uint32_t splen = spill_path ? (uint32_t)std::strlen(spill_path) : 0;
   if (!write_full(fd, &op, 1) || !write_full(fd, &tid, 4) ||
@@ -746,7 +788,11 @@ int ps_create_table(int fd, uint32_t tid, uint8_t is_dense, uint8_t opt,
       !write_full(fd, &dim, 4) || !write_full(fd, &lr, 4) ||
       !write_full(fd, &init_range, 4) ||
       !write_full(fd, &max_mem_rows, 8) || !write_full(fd, &splen, 4) ||
-      (splen && !write_full(fd, spill_path, splen)))
+      (splen && !write_full(fd, spill_path, splen)) ||
+      !write_full(fd, &accessor, 1) ||
+      !write_full(fd, &nonclk_coeff, 4) ||
+      !write_full(fd, &click_coeff, 4) ||
+      !write_full(fd, &embedx_threshold, 4))
     return -1;
   uint8_t st;
   return read_full(fd, &st, 1) ? st : -1;

@@ -66,15 +66,17 @@ class DistributedEmbedding(Layer):
 
     def __init__(self, embedding_dim, cluster: PsCluster, table_id=0,
                  optimizer="adagrad", lr=0.05, init_range=0.01,
-                 with_show_click=False, name=None):
+                 with_show_click=False, name=None, accessor="direct",
+                 **accessor_kw):
         super().__init__(name)
         self.embedding_dim = embedding_dim
         self.cluster = cluster
         self.table_id = table_id
-        self.with_show_click = with_show_click
+        # the CTR accessor keys on show/click stats — feed them
+        self.with_show_click = with_show_click or accessor == "ctr"
         cluster.create_table(SparseTableConfig(
             table_id, embedding_dim, optimizer=optimizer, lr=lr,
-            init_range=init_range))
+            init_range=init_range, accessor=accessor, **accessor_kw))
         self._pass_cache = None
 
     def use_pass_cache(self, cache):

@@ -55,7 +55,8 @@ def _lib():
         lib.ps_create_table.argtypes = [
             ctypes.c_int, ctypes.c_uint32, ctypes.c_uint8, ctypes.c_uint8,
             ctypes.c_uint32, ctypes.c_float, ctypes.c_float,
-            ctypes.c_uint64, ctypes.c_char_p]
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint8,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float]
         lib.ps_pull_sparse.restype = ctypes.c_int
         lib.ps_pull_sparse.argtypes = [
             ctypes.c_int, ctypes.c_uint32, u64p, ctypes.c_uint32,
@@ -93,7 +94,8 @@ class SparseTableConfig:
 
     def __init__(self, table_id, dim, optimizer="adagrad", lr=0.05,
                  init_range=0.01, is_dense=False, max_mem_rows=0,
-                 spill_path=None):
+                 spill_path=None, accessor="direct", nonclk_coeff=0.1,
+                 click_coeff=1.0, embedx_threshold=10.0):
         self.table_id = int(table_id)
         self.dim = int(dim)
         self.optimizer = optimizer
@@ -105,6 +107,15 @@ class SparseTableConfig:
         # the table fully resident
         self.max_mem_rows = int(max_mem_rows)
         self.spill_path = spill_path
+        # CTR accessor (ref: ps/table/ctr_accessor.h, the fork's feature-
+        # value accessor): dim = 1 embed_w + embedx; embedx dormant until
+        # score(show, click) >= embedx_threshold
+        if accessor not in ("direct", "ctr"):
+            raise ValueError(f"accessor must be direct/ctr, got {accessor}")
+        self.accessor = accessor
+        self.nonclk_coeff = float(nonclk_coeff)
+        self.click_coeff = float(click_coeff)
+        self.embedx_threshold = float(embedx_threshold)
 
 
 class PsServer:
@@ -149,7 +160,9 @@ class PsClient:
                 self._fd, cfg.table_id, 1 if cfg.is_dense else 0,
                 OPTIMIZERS[cfg.optimizer], cfg.dim, cfg.lr, cfg.init_range,
                 cfg.max_mem_rows,
-                cfg.spill_path.encode() if cfg.spill_path else None)
+                cfg.spill_path.encode() if cfg.spill_path else None,
+                1 if cfg.accessor == "ctr" else 0, cfg.nonclk_coeff,
+                cfg.click_coeff, cfg.embedx_threshold)
         if st == 3:
             raise RuntimeError(
                 f"table {cfg.table_id} already exists on the server with a "

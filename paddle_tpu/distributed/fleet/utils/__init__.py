@@ -1,2 +1,4 @@
 from . import hybrid_parallel_util
 from .log_util import logger
+
+from . import sequence_parallel_utils  # noqa: F401

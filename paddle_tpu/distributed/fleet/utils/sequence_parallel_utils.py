@@ -1,0 +1,137 @@
+"""Megatron-style sequence parallelism utilities.
+
+ref: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
+(AllGatherOp/ReduceScatterOp, ColumnSequenceParallelLinear,
+RowSequenceParallelLinear, mark_as_sequence_parallel_parameter) — the
+OTHER half of SURVEY §5.7's SP plan, complementing ring attention (CP):
+between TP regions the activations live SEQUENCE-SHARDED over the
+'model' axis, so the norms/residual/dropout of every layer touch only
+s/mp tokens per device. The collective pair replacing the classic
+_c_identity/_mp_allreduce (mp_ops.py:27,219) is
+
+  entry (column-parallel in):  all_gather(seq)     [bwd: reduce_scatter]
+  exit  (row-parallel out):    reduce_scatter(seq) [bwd: all_gather]
+
+— the same total bytes as the allreduce it replaces, but the activation
+tensors BETWEEN the collectives shrink by 1/mp.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ....ops import apply
+from ...mesh import in_spmd_region
+
+
+@functools.lru_cache(maxsize=None)
+def _allgather_seq_fn(axis, seq_axis):
+    @jax.custom_vjp
+    def f(x):
+        return lax.all_gather(x, axis, axis=seq_axis, tiled=True)
+
+    def fwd(x):
+        return f(x), None
+
+    def bwd(_, g):
+        # transpose of tiled all_gather: reduce-scatter back to the shard
+        return (lax.psum_scatter(g, axis, scatter_dimension=seq_axis,
+                                 tiled=True),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _reduce_scatter_seq_fn(axis, seq_axis):
+    @jax.custom_vjp
+    def f(x):
+        return lax.psum_scatter(x, axis, scatter_dimension=seq_axis,
+                                tiled=True)
+
+    def fwd(x):
+        return f(x), None
+
+    def bwd(_, g):
+        return (lax.all_gather(g, axis, axis=seq_axis, tiled=True),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def all_gather_sp(x, axis_name="model", seq_axis=1):
+    """AllGatherOp: sequence-sharded -> full sequence (fwd), with the
+    reduce-scatter transpose in backward."""
+    if not in_spmd_region(axis_name):
+        return x
+    return apply(_allgather_seq_fn(axis_name, seq_axis), x,
+                 name="sp_allgather")
+
+
+def reduce_scatter_sp(x, axis_name="model", seq_axis=1):
+    """ReduceScatterOp: partial full-sequence -> reduced sequence shard."""
+    if not in_spmd_region(axis_name):
+        return x
+    return apply(_reduce_scatter_seq_fn(axis_name, seq_axis), x,
+                 name="sp_reduce_scatter")
+
+
+class ColumnSequenceParallelLinear:
+    """Mixin-style wrapper: a ColumnParallelLinear whose input arrives
+    sequence-sharded (ref: sequence_parallel_utils.py
+    ColumnSequenceParallelLinear). Implemented as a thin module over the
+    existing layer to keep one Linear implementation."""
+
+    def __new__(cls, in_features, out_features, **kw):
+        from ..meta_parallel import ColumnParallelLinear
+        from ..meta_parallel.parallel_layers import mp_ops
+
+        class _Col(ColumnParallelLinear):
+            def forward(self, x):
+                from ....nn import functional as F
+                from ....tensor.tensor import Tensor
+                if not isinstance(x, Tensor):
+                    x = Tensor(jnp.asarray(x))
+                # the gather's reduce-scatter transpose REPLACES
+                # _c_identity's psum — stacking both would overcount dh
+                # by the TP degree
+                full = all_gather_sp(x)
+                out = F.linear(full, self.weight, self.bias)
+                if self.gather_output:
+                    out = mp_ops._c_concat(out, group=self.group)
+                return out
+
+        kw.setdefault("gather_output", False)
+        return _Col(in_features, out_features, **kw)
+
+
+class RowSequenceParallelLinear:
+    """RowParallelLinear whose output is reduce-SCATTERED over the
+    sequence dim instead of allreduced (ref: RowSequenceParallelLinear)."""
+
+    def __new__(cls, in_features, out_features, **kw):
+        from ..meta_parallel import RowParallelLinear
+        from ..meta_parallel.parallel_layers import mp_ops
+
+        class _Row(RowParallelLinear):
+            def forward(self, x):
+                from ....nn import functional as F
+                if not self.input_is_parallel:
+                    x = mp_ops._c_split(x, group=self.group)
+                out = F.linear(x, self.weight)
+                out = reduce_scatter_sp(out)
+                if self.bias is not None:
+                    out = out + self.bias
+                return out
+
+        kw.setdefault("input_is_parallel", True)
+        return _Row(in_features, out_features, **kw)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """ref: mark_as_sequence_parallel_parameter — tags params whose grads
+    are partial over the TP group because they act on sequence shards
+    (norm weights between TP regions); hybrid grad sync psums them."""
+    param.sequence_parallel = True
+    return param

@@ -2,5 +2,9 @@
 from .tensor.linalg import (norm, dist, cross, matrix_power, inverse, pinv,
                             det, slogdet, solve, triangular_solve, cholesky,
                             cholesky_solve, qr, svd, eig, eigh, eigvals,
-                            eigvalsh, matrix_rank, lu, corrcoef, cov)
+                            eigvalsh, matrix_rank, lu, corrcoef, cov,
+                            cond, inv, vector_norm, matrix_norm, multi_dot,
+                            matrix_exp, lstsq, lu_unpack,
+                            householder_product, ormqr, svd_lowrank,
+                            pca_lowrank)
 from .tensor.math import matmul

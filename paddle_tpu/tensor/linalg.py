@@ -168,3 +168,149 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
     aw = aweights.data if aweights is not None else None
     return Tensor(jnp.cov(_t(x).data, rowvar=rowvar, ddof=1 if ddof else 0,
                           fweights=fw, aweights=aw))
+
+
+def cond(x, p=None, name=None):
+    """ref: python/paddle/tensor/linalg.py cond — condition number under
+    norm p (None/'fro'/2/-2/1/-1/inf/-inf/'nuc')."""
+    a = _t(x).data
+    if p is None or p == 2 or p == -2 or p == "nuc":
+        s = jnp.linalg.svd(a, compute_uv=False)
+        if p == "nuc":
+            si = jnp.linalg.svd(jnp.linalg.inv(a), compute_uv=False)
+            return Tensor(jnp.sum(s, -1) * jnp.sum(si, -1))
+        if p == -2:
+            return Tensor(s[..., -1] / s[..., 0])
+        return Tensor(s[..., 0] / s[..., -1])
+    if p == "fro":
+        return Tensor(jnp.linalg.norm(a, "fro", axis=(-2, -1))
+                      * jnp.linalg.norm(jnp.linalg.inv(a), "fro",
+                                        axis=(-2, -1)))
+    return Tensor(jnp.linalg.norm(a, p, axis=(-2, -1))
+                  * jnp.linalg.norm(jnp.linalg.inv(a), p, axis=(-2, -1)))
+
+
+def inv(x, name=None):
+    return inverse(x, name)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """ref: linalg.py vector_norm."""
+    a = _t(x).data
+    if axis is None:
+        a = a.reshape(-1)
+        axis = 0
+    return Tensor(jnp.linalg.norm(a, ord=p, axis=axis, keepdims=keepdim))
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """ref: linalg.py matrix_norm."""
+    return Tensor(jnp.linalg.norm(_t(x).data, ord=p, axis=tuple(axis),
+                                  keepdims=keepdim))
+
+
+def multi_dot(x, name=None):
+    """ref: linalg.py multi_dot — optimal-order chain matmul."""
+    return Tensor(jnp.linalg.multi_dot([_t(m).data for m in x]))
+
+
+def matrix_exp(x, name=None):
+    import jax.scipy.linalg as jsl
+    return Tensor(jsl.expm(_t(x).data))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    """ref: linalg.py lstsq — returns (solution, residuals, rank,
+    singular_values)."""
+    a = _t(x).data
+    b = _t(y).data
+    sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+    return (Tensor(sol), Tensor(res), Tensor(jnp.asarray(rank)),
+            Tensor(sv))
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """ref: linalg.py lu_unpack — (P, L, U) from lu()'s packed output."""
+    a = _t(lu_data).data
+    piv = _t(lu_pivots).data
+    m, n = a.shape[-2], a.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(a[..., :, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
+    U = jnp.triu(a[..., :k, :])
+    # pivots (LAPACK ipiv, 0-indexed rows swapped in order) -> permutation
+    perm = jnp.arange(m)
+    piv = piv.astype(jnp.int32)
+    def body(i, pm):
+        j = piv[i]
+        pi, pj = pm[i], pm[j]
+        pm = pm.at[i].set(pj)
+        return pm.at[j].set(pi)
+    import jax as _jax
+    perm = _jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+    P = jnp.eye(m, dtype=a.dtype)[perm].T
+    outs = []
+    outs.append(Tensor(P) if unpack_pivots else None)
+    outs.append(Tensor(L) if unpack_ludata else None)
+    outs.append(Tensor(U) if unpack_ludata else None)
+    return tuple(outs)
+
+
+def _householder_q(a, t):
+    """Full m x m Q = prod_i (I - tau_i v_i v_i^T) from geqrf packing."""
+    m = a.shape[-2]
+    q = jnp.eye(m, dtype=a.dtype)
+    for i in range(t.shape[-1]):
+        v = jnp.zeros((m,), a.dtype).at[i].set(1.0)
+        v = v.at[i + 1:].set(a[i + 1:, i])
+        h = jnp.eye(m, dtype=a.dtype) - t[i] * jnp.outer(v, v)
+        q = q @ h
+    return q
+
+
+def householder_product(x, tau, name=None):
+    """ref: linalg.py householder_product — assemble Q (first n columns)
+    from the Householder reflectors of a QR factorization (geqrf
+    layout)."""
+    a = _t(x).data
+    return Tensor(_householder_q(a, _t(tau).data)[:, :a.shape[-1]])
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """ref: linalg.py ormqr — multiply `other` by the FULL Q built from
+    the reflectors (never the column-truncated factor)."""
+    q = _householder_q(_t(x).data, _t(tau).data)
+    o = _t(other).data
+    qm = q.T if transpose else q
+    return Tensor(qm @ o if left else o @ qm)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """ref: linalg.py svd_lowrank — randomized low-rank SVD (Halko)."""
+    a = _t(x).data
+    if M is not None:
+        a = a - _t(M).data
+    m, n = a.shape[-2], a.shape[-1]
+    q = min(q, m, n)
+    import jax as _jax
+    key = _jax.random.key(0)  # deterministic sketch (paddle uses gaussian)
+    omega = _jax.random.normal(key, (n, q), a.dtype)
+    y = a @ omega
+    for _ in range(niter):
+        y = a @ (a.T @ y)
+    Q, _ = jnp.linalg.qr(y)
+    b = Q.T @ a
+    u_b, s, vT = jnp.linalg.svd(b, full_matrices=False)
+    return Tensor(Q @ u_b), Tensor(s), Tensor(vT.T)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """ref: linalg.py pca_lowrank."""
+    a = _t(x).data
+    m, n = a.shape[-2], a.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        a = a - jnp.mean(a, axis=-2, keepdims=True)
+    u, s, v = svd_lowrank(Tensor(a), q=q, niter=niter)
+    return u, s, v

@@ -332,7 +332,9 @@ class SpmdTrainer:
                                 check_vma=False)
             params, opt = jax.jit(smapped)(params12)
             return {"params": params, "opt": opt,
-                    "step": jnp.zeros((), jnp.int32)}
+                    "step": jax.device_put(
+                        jnp.zeros((), jnp.int32),
+                        NamedSharding(self.mesh, P()))}
 
         # stage 1/2: AdamW moments created INSIDE the SPMD region so chunk
         # sizes follow the LOCAL (model/pipe-sharded) param shapes; flat dim
@@ -352,7 +354,9 @@ class SpmdTrainer:
                             out_specs=self._opt_specs(), check_vma=False)
         opt = jax.jit(smapped)(params12)
         return {"params": params12, "opt": opt,
-                "step": jnp.zeros((), jnp.int32)}
+                "step": jax.device_put(
+                        jnp.zeros((), jnp.int32),
+                        NamedSharding(self.mesh, P()))}
 
     # ---- the step ---------------------------------------------------------
     def _build(self, ids_shape):

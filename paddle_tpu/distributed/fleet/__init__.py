@@ -13,3 +13,5 @@ from . import meta_parallel
 from .utils import hybrid_parallel_util
 from .recompute import recompute, recompute_sequential
 from .scaler import distributed_scaler
+
+from .dataset import DatasetBase, InMemoryDataset, QueueDataset  # noqa: F401,E501

@@ -1,0 +1,177 @@
+"""Slot-based training datasets for the PS/CTR pipeline.
+
+ref: python/paddle/distributed/fleet/dataset/dataset.py (DatasetBase /
+InMemoryDataset / QueueDataset) over the C++ MultiSlotDataFeed
+(paddle/fluid/framework/data_feed.cc) — the input pipeline of the fork's
+CTR workloads: files of text lines in the multi-slot format, optionally
+decompressed/transformed by a `pipe_command`, parsed into per-slot
+feasign lists, shuffled, and batched for sparse-table lookups.
+
+Line format (MultiSlotDataFeed's text protocol): for each slot IN ORDER,
+`<count> <v1> ... <vcount>`; e.g. with use_var ["click", "6", "7"]:
+
+    1 0 2 17 23 1 9
+
+is click=[0], slot6=[17, 23], slot7=[9]. Batches come out as
+{slot: (values uint64/float32, lod int32)} ragged pairs — the lookup
+shape DistributedEmbedding consumes.
+"""
+import os
+import random
+import subprocess
+
+import numpy as np
+
+
+class DatasetBase:
+    def __init__(self):
+        self.proto_desc = {"batch_size": 1, "thread_num": 1,
+                           "pipe_command": None, "input_type": 0}
+        self.filelist = []
+        self.use_var = []
+        self.float_slots = set()
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command=None, input_type=0, fs_name=None, fs_ugi=None,
+             **kwargs):
+        """ref: dataset.py DatasetBase.init."""
+        self.proto_desc.update(batch_size=int(batch_size),
+                               thread_num=int(thread_num),
+                               pipe_command=pipe_command,
+                               input_type=input_type)
+        if use_var is not None:
+            self.use_var = [getattr(v, "name", v) for v in use_var]
+        return self
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_use_var(self, use_var):
+        self.use_var = [getattr(v, "name", v) for v in use_var]
+
+    def set_batch_size(self, bs):
+        self.proto_desc["batch_size"] = int(bs)
+
+    def set_pipe_command(self, cmd):
+        self.proto_desc["pipe_command"] = cmd
+
+    def set_float_slots(self, names):
+        """Slots parsed as float32 (dense features) instead of uint64
+        feasigns (ref: MultiSlotDataFeed float_ slots)."""
+        self.float_slots = set(names)
+
+    # -- parsing ------------------------------------------------------------
+    def _read_file(self, path):
+        cmd = self.proto_desc["pipe_command"]
+        if cmd:
+            # ref: data_feed pipe_command — the file streams through a
+            # shell command (zcat/awk feature rewrites) before parsing
+            out = subprocess.run(f"{cmd} < {path}", shell=True,
+                                 capture_output=True, text=True)
+            if out.returncode != 0:
+                raise RuntimeError(
+                    f"pipe_command {cmd!r} failed on {path}: {out.stderr}")
+            return out.stdout.splitlines()
+        with open(path) as f:
+            return f.read().splitlines()
+
+    def _parse_line(self, line):
+        toks = line.split()
+        rec = {}
+        i = 0
+        for slot in self.use_var:
+            if i >= len(toks):
+                raise ValueError(
+                    f"line ran out of tokens at slot {slot!r}: {line!r}")
+            n = int(toks[i])
+            i += 1
+            vals = toks[i:i + n]
+            i += n
+            if slot in self.float_slots:
+                rec[slot] = np.asarray(vals, np.float32)
+            else:
+                rec[slot] = np.asarray(vals, np.uint64)
+        return rec
+
+    def _batches(self, records):
+        bs = self.proto_desc["batch_size"]
+        for lo in range(0, len(records) - len(records) % bs, bs):
+            chunk = records[lo:lo + bs]
+            out = {}
+            for slot in self.use_var:
+                vals = [r[slot] for r in chunk]
+                lod = np.zeros(len(vals) + 1, np.int32)
+                np.cumsum([len(v) for v in vals], out=lod[1:])
+                out[slot] = (np.concatenate(vals) if lod[-1] else
+                             np.zeros(0, vals[0].dtype), lod)
+            yield out
+
+
+class InMemoryDataset(DatasetBase):
+    """ref: dataset.py InMemoryDataset — load, shuffle in memory, iterate
+    many epochs; release explicitly."""
+
+    def __init__(self):
+        super().__init__()
+        self._records = None
+
+    def load_into_memory(self):
+        recs = []
+        for path in self.filelist:
+            for line in self._read_file(path):
+                if line.strip():
+                    recs.append(self._parse_line(line))
+        self._records = recs
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._records or [])
+
+    def local_shuffle(self):
+        if self._records is None:
+            raise RuntimeError("load_into_memory() first")
+        random.shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        """Cross-rank shuffle: every rank gathers all records' bytes and
+        keeps its interleaved share (small-data analog of the reference's
+        shuffle service; big data should pre-shard files per rank)."""
+        from ..parallel_env import get_rank, get_world_size, is_initialized
+        self.local_shuffle()
+        if not (is_initialized() and get_world_size() > 1):
+            return
+        from .. import collective
+        gathered = []
+        collective.all_gather_object(gathered, self._records)
+        world = get_world_size()
+        allrec = [r for rs in gathered for r in rs]
+        random.Random(1234).shuffle(allrec)  # same permutation on all ranks
+        self._records = allrec[get_rank()::world]
+
+    def release_memory(self):
+        self._records = None
+
+    def __iter__(self):
+        if self._records is None:
+            raise RuntimeError("load_into_memory() first")
+        return self._batches(self._records)
+
+
+class QueueDataset(DatasetBase):
+    """ref: dataset.py QueueDataset — single-pass streaming over the
+    filelist (no memory residency, no shuffle)."""
+
+    def __iter__(self):
+        def gen():
+            pending = []
+            bs = self.proto_desc["batch_size"]
+            for path in self.filelist:
+                for line in self._read_file(path):
+                    if not line.strip():
+                        continue
+                    pending.append(self._parse_line(line))
+                    if len(pending) == bs:
+                        yield from self._batches(pending)
+                        pending = []
+            if len(pending) >= bs:
+                yield from self._batches(pending)
+        return gen()

@@ -32,12 +32,27 @@ def broadcast_sep_parameters(model, hcg):
 
 
 def fused_allreduce_gradients(parameter_list, hcg):
-    """ref: :206 — allreduce grads over the data-parallel group."""
+    """ref: :206 — allreduce grads over the data-parallel group; params
+    tagged by mark_as_sequence_parallel_parameter additionally SUM over
+    the model axis (their op touched only a sequence shard, so per-rank
+    grads are partial — ref sequence_parallel_utils
+    register_sequence_parallel_allreduce_hooks)."""
     group = hcg.get_data_parallel_group() if hcg is not None else None
     if group is not None and group.nranks > 1 or in_spmd_region("data"):
         for p in parameter_list:
             if p.grad is not None:
                 all_reduce(p.grad, op=ReduceOp.AVG, group=group)
+    mp_group = hcg.get_model_parallel_group() if hcg is not None else None
+    for p in parameter_list:
+        if getattr(p, "sequence_parallel", False) and p.grad is not None \
+                and in_spmd_region("model"):
+            if mp_group is not None:
+                all_reduce(p.grad, op=ReduceOp.SUM, group=mp_group)
+            else:
+                from ....ops import apply as _apply
+                from jax import lax as _lax
+                g = _apply(lambda a: _lax.psum(a, "model"), p.grad)
+                p.grad.data = g.data
 
 
 def sharding_reduce_gradients(parameter_list, hcg):

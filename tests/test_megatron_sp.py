@@ -124,3 +124,29 @@ def test_mark_sequence_parallel_parameter():
     lin = nn.Linear(4, 4)
     mark_as_sequence_parallel_parameter(lin.weight)
     assert getattr(lin.weight, "sequence_parallel", False)
+
+
+def test_fused_allreduce_syncs_sequence_parallel_params():
+    """Params marked sequence-parallel (norms between TP regions) get
+    their partial grads SUMMED over 'model' by fused_allreduce_gradients
+    (ref: register_sequence_parallel_allreduce_hooks)."""
+    from paddle_tpu.distributed.fleet.utils.hybrid_parallel_util import (
+        fused_allreduce_gradients)
+
+    mesh = _mesh(2)
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    lin = nn.Linear(4, 4, bias_attr=False)
+    mark_as_sequence_parallel_parameter(lin.weight)
+
+    def f(gpart):
+        with spmd_axes(("model",)):
+            lin.weight.grad = Tensor(gpart[0])
+            fused_allreduce_gradients([lin.weight], None)
+            return lin.weight.grad.data
+
+    g = jnp.arange(2 * 4 * 4, dtype=jnp.float32).reshape(2, 4, 4)
+    out = shard_map(f, mesh=mesh, in_specs=(P("model", None, None),),
+                    out_specs=P(None, None), check_vma=False)(g)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(g[0] + g[1]))

@@ -37,21 +37,33 @@ def fused_allreduce_gradients(parameter_list, hcg):
     the model axis (their op touched only a sequence shard, so per-rank
     grads are partial — ref sequence_parallel_utils
     register_sequence_parallel_allreduce_hooks)."""
+    from ....ops import apply
+    from jax import lax
+
     group = hcg.get_data_parallel_group() if hcg is not None else None
-    if group is not None and group.nranks > 1 or in_spmd_region("data"):
+    if group is not None and group.nranks > 1:
         for p in parameter_list:
             if p.grad is not None:
                 all_reduce(p.grad, op=ReduceOp.AVG, group=group)
-    mp_group = hcg.get_model_parallel_group() if hcg is not None else None
-    for p in parameter_list:
-        if getattr(p, "sequence_parallel", False) and p.grad is not None \
-                and in_spmd_region("model"):
-            if mp_group is not None:
-                all_reduce(p.grad, op=ReduceOp.SUM, group=mp_group)
-            else:
-                from ....ops import apply as _apply
-                from jax import lax as _lax
-                g = _apply(lambda a: _lax.psum(a, "model"), p.grad)
+    elif in_spmd_region("data"):
+        # no group handle inside a bare shard_map region: pmean over the
+        # axis directly (all_reduce(group=None) resolves to the world
+        # group whose axis is None and would silently no-op)
+        for p in parameter_list:
+            if p.grad is not None:
+                g = apply(lambda a: lax.pmean(a, "data"), p.grad)
+                p.grad.data = g.data
+
+    if in_spmd_region("model"):
+        from ..meta_parallel.parallel_layers.mp_ops import _mp_allreduce
+        mp_group = (hcg.get_model_parallel_group()
+                    if hcg is not None else None)
+        for p in parameter_list:
+            if getattr(p, "sequence_parallel", False) \
+                    and p.grad is not None:
+                # one implementation of the model-axis psum (fwd
+                # allreduce / bwd identity) for hcg and bare-SPMD callers
+                g = _mp_allreduce(p.grad, group=mp_group)
                 p.grad.data = g.data
 
 

@@ -61,7 +61,9 @@ def main():
     ap.add_argument("--nb", type=_pow2, default=8)
     ap.add_argument("--impls", nargs="+",
                     default=["pallas_fwd", "xla_fwd", "pallas_fwdbwd",
-                             "xla_fwdbwd"])
+                             "xla_fwdbwd"],
+                    help="also available: pallas_dropout_fwdbwd (native "
+                         "in-kernel attention dropout)")
     args = ap.parse_args()
 
     from paddle_tpu.ops.pallas import flash_attention as fa
@@ -113,8 +115,24 @@ def main():
             dq, dk, dv = gx(x + i.astype(x.dtype) * 1e-6, k, v)
             return dq + 1e-6 * (dk + dv)
 
+        flash_do = fa.make_flash_attention(bq=args.bq or 256,
+                                           bk=args.bk or 256,
+                                           nb_max=args.nb, dropout_p=0.1)
+
+        def loss_do(q, k, v):
+            return jnp.sum(flash_do.dropout(
+                q, k, v, jnp.int32(7), True, scale).astype(jnp.float32))
+
+        gdo = jax.grad(loss_do, argnums=(0, 1, 2))
+
+        @jax.jit
+        def fb_dropout(x, i):
+            dq, dk, dv = gdo(x + i.astype(x.dtype) * 1e-6, k, v)
+            return dq + 1e-6 * (dk + dv)
+
         impls = {"pallas_fwd": (fwd_pallas, 1), "xla_fwd": (fwd_xla, 1),
-                 "pallas_fwdbwd": (fb_pallas, 3.5), "xla_fwdbwd": (fb_xla, 3.5)}
+                 "pallas_fwdbwd": (fb_pallas, 3.5), "xla_fwdbwd": (fb_xla, 3.5),
+                 "pallas_dropout_fwdbwd": (fb_dropout, 3.5)}
         for name in args.impls:
             fn, mult = impls[name]
             try:

@@ -1,3 +1,4 @@
+from .tokenizer import FasterTokenizer, lower, upper, str_len  # noqa: F401,E501
 """paddle.text analog (ref: python/paddle/text/ — dataset loaders).
 
 The reference's text datasets download corpora; this build is zero-egress,

@@ -1,0 +1,156 @@
+"""Tokenizer surface — the strings-kernel family, TPU-honest.
+
+ref: paddle/phi/kernels/strings/ (strings_lower/upper over pstring
+tensors) and the faster_tokenizer op ecosystem the fork ships for
+in-graph BERT tokenization. On TPU, tokenization is host work (XLA has
+no string type), so:
+
+- `lower`/`upper`/`str_len` operate on numpy object arrays (the pstring
+  tensor analog) with full unicode handling;
+- `FasterTokenizer` is a WordPiece tokenizer (greedy longest-match, the
+  BERT algorithm the CUDA faster_tokenizer implements) built from a
+  local vocab — no network, no external deps — emitting the
+  (input_ids, token_type_ids) int tensors models consume.
+"""
+import numpy as np
+
+from ..tensor.tensor import Tensor
+
+
+def _as_str_array(x):
+    if isinstance(x, np.ndarray) and x.dtype == object:
+        return x
+    if isinstance(x, (list, tuple)):
+        return np.asarray(list(x), dtype=object)
+    return np.asarray([x], dtype=object)
+
+
+def lower(x, use_utf8_encoding=True):
+    """ref: strings_lower_upper_kernel.cc StringsLower."""
+    a = _as_str_array(x)
+    return np.asarray([s.lower() for s in a.ravel()],
+                      dtype=object).reshape(a.shape)
+
+
+def upper(x, use_utf8_encoding=True):
+    a = _as_str_array(x)
+    return np.asarray([s.upper() for s in a.ravel()],
+                      dtype=object).reshape(a.shape)
+
+
+def str_len(x):
+    a = _as_str_array(x)
+    return Tensor(np.asarray([[len(s)] for s in a.ravel()],
+                             np.int64).reshape(a.shape + (1,))[..., 0])
+
+
+class FasterTokenizer:
+    """Greedy longest-match WordPiece (the BERT tokenizer the reference's
+    faster_tokenizer op runs in-graph on GPU; host-side here).
+
+    vocab: dict token->id or a path to a one-token-per-line vocab file.
+    Special tokens follow the BERT convention ([CLS]/[SEP]/[UNK]/[PAD]).
+    """
+
+    def __init__(self, vocab, do_lower_case=True, unk_token="[UNK]",
+                 cls_token="[CLS]", sep_token="[SEP]", pad_token="[PAD]",
+                 max_input_chars_per_word=100):
+        if isinstance(vocab, str):
+            with open(vocab) as f:
+                vocab = {line.rstrip("\n"): i
+                         for i, line in enumerate(f) if line.strip()}
+        self.vocab = dict(vocab)
+        self.do_lower_case = do_lower_case
+        self.unk = unk_token
+        self.cls = cls_token
+        self.sep = sep_token
+        self.pad = pad_token
+        self.max_chars = max_input_chars_per_word
+        for tok in (unk_token, cls_token, sep_token, pad_token):
+            if tok not in self.vocab:
+                raise ValueError(f"special token {tok!r} missing from vocab")
+
+    # -- wordpiece ----------------------------------------------------------
+    def _basic_split(self, text):
+        if self.do_lower_case:
+            text = text.lower()
+        out = []
+        for tok in text.split():
+            cur = ""
+            for ch in tok:  # split punctuation into single tokens
+                if not ch.isalnum():
+                    if cur:
+                        out.append(cur)
+                        cur = ""
+                    out.append(ch)
+                else:
+                    cur += ch
+            if cur:
+                out.append(cur)
+        return out
+
+    def _wordpiece(self, word):
+        if len(word) > self.max_chars:
+            return [self.unk]
+        pieces = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    piece = sub
+                    break
+                end -= 1
+            if piece is None:
+                return [self.unk]
+            pieces.append(piece)
+            start = end
+        return pieces
+
+    def tokenize(self, text):
+        toks = []
+        for w in self._basic_split(text):
+            toks.extend(self._wordpiece(w))
+        return toks
+
+    def __call__(self, text, text_pair=None, max_seq_len=128,
+                 pad_to_max_seq_len=False):
+        """Batch encode -> {'input_ids', 'token_type_ids'} int64 Tensors
+        (the faster_tokenizer op's output contract)."""
+        texts = [text] if isinstance(text, str) else list(text)
+        pairs = ([text_pair] if isinstance(text_pair, str)
+                 else list(text_pair) if text_pair is not None
+                 else [None] * len(texts))
+        ids_all, types_all = [], []
+        for t, p in zip(texts, pairs):
+            ids = [self.vocab[self.cls]]
+            types = [0]
+            for tok in self.tokenize(t):
+                ids.append(self.vocab.get(tok, self.vocab[self.unk]))
+                types.append(0)
+            ids.append(self.vocab[self.sep])
+            types.append(0)
+            if p is not None:
+                for tok in self.tokenize(p):
+                    ids.append(self.vocab.get(tok, self.vocab[self.unk]))
+                    types.append(1)
+                ids.append(self.vocab[self.sep])
+                types.append(1)
+            ids = ids[:max_seq_len]
+            types = types[:max_seq_len]
+            ids_all.append(ids)
+            types_all.append(types)
+        width = (max_seq_len if pad_to_max_seq_len
+                 else max(len(i) for i in ids_all))
+        pad_id = self.vocab[self.pad]
+        out_ids = np.full((len(ids_all), width), pad_id, np.int64)
+        out_types = np.zeros((len(ids_all), width), np.int64)
+        for r, (ids, types) in enumerate(zip(ids_all, types_all)):
+            out_ids[r, :len(ids)] = ids
+            out_types[r, :len(types)] = types
+        return {"input_ids": Tensor(out_ids),
+                "token_type_ids": Tensor(out_types)}

@@ -1,0 +1,52 @@
+"""Strings/tokenizer family (ref: phi/kernels/strings/ + the
+faster_tokenizer op): unicode case kernels and WordPiece encoding with
+the BERT output contract."""
+import numpy as np
+
+from paddle_tpu.text import FasterTokenizer, lower, str_len, upper
+
+VOCAB = {t: i for i, t in enumerate(
+    ["[PAD]", "[UNK]", "[CLS]", "[SEP]",
+     "the", "cat", "sat", "##s", "mat", "on", "un", "##seen", "!"])}
+
+
+def test_string_case_kernels():
+    a = np.asarray(["HeLLo", "WÖRLD"], dtype=object)
+    np.testing.assert_array_equal(lower(a), ["hello", "wörld"])
+    np.testing.assert_array_equal(upper(a), ["HELLO", "WÖRLD"])
+    np.testing.assert_array_equal(np.asarray(str_len(a).data), [5, 5])
+
+
+def test_wordpiece_greedy_longest_match():
+    tok = FasterTokenizer(VOCAB)
+    assert tok.tokenize("the cats sat") == ["the", "cat", "##s", "sat"]
+    assert tok.tokenize("unseen") == ["un", "##seen"]
+    assert tok.tokenize("xyzzy") == ["[UNK]"]
+
+
+def test_encode_contract_single_and_pair():
+    tok = FasterTokenizer(VOCAB)
+    out = tok(["the cat!", "the mats"])
+    ids = np.asarray(out["input_ids"].data)
+    assert ids.shape[0] == 2
+    # [CLS] the cat ! [SEP]
+    np.testing.assert_array_equal(
+        ids[0, :5], [VOCAB["[CLS]"], VOCAB["the"], VOCAB["cat"],
+                     VOCAB["!"], VOCAB["[SEP]"]])
+    # second row padded with [PAD]
+    assert ids[1, -1] in (VOCAB["[PAD]"], VOCAB["[SEP]"])
+
+    pair = tok("the cat", text_pair="sat on the mat")
+    tt = np.asarray(pair["token_type_ids"].data)[0]
+    ids = np.asarray(pair["input_ids"].data)[0]
+    sep = VOCAB["[SEP]"]
+    first_sep = int(np.where(ids == sep)[0][0])
+    assert tt[:first_sep + 1].max() == 0 and tt[first_sep + 1] == 1
+
+
+def test_pad_to_max_and_truncate():
+    tok = FasterTokenizer(VOCAB)
+    out = tok("the cat sat on the mat", max_seq_len=4,
+              pad_to_max_seq_len=True)
+    ids = np.asarray(out["input_ids"].data)
+    assert ids.shape == (1, 4)

@@ -45,6 +45,13 @@ def _axis_dim(spec, axis):
     return None
 
 
+def _entry_axes(e):
+    """Axes of one spec entry (None -> (), 'x' -> ('x',), tuple as-is)."""
+    if e is None:
+        return ()
+    return e if isinstance(e, tuple) else (e,)
+
+
 def _axes_of(spec):
     out = []
     if spec is None:
@@ -77,24 +84,77 @@ def reshard_spec(x, src, dst, partial_axes=(), record=None):
         if ddim is not None:
             x = lax.psum_scatter(x, axis, scatter_dimension=ddim, tiled=True)
             rec.op("psum_scatter", axis, dim=ddim)
-            src = tuple(axis if d == ddim else s
-                        for d, s in enumerate(src))
+            # merge into (not overwrite) the dim's existing sharding: the
+            # scatter tiles WITHIN each existing block, so `axis` lands as
+            # the innermost entry
+            lst = list(src)
+            prev = _entry_axes(lst[ddim])
+            lst[ddim] = axis if not prev else prev + (axis,)
+            src = tuple(lst)
         else:
             x = lax.psum(x, axis)
             rec.op("psum", axis)
 
-    # 2. axis moves between dims: all_to_all
-    for axis in _axes_of(src):
-        sdim = _axis_dim(src, axis)
-        ddim = _axis_dim(dst, axis)
-        if ddim is not None and ddim != sdim:
-            x = lax.all_to_all(x, axis, split_axis=ddim, concat_axis=sdim,
-                               tiled=True)
-            rec.op("all_to_all", axis, src_dim=sdim, dst_dim=ddim)
+    # Multi-axis tuple entries (a dim sharded by several mesh axes at
+    # once): the optimal move/gather chains below assume one axis per
+    # dim — partial moves out of a tuple entry reorder the nested tiling
+    # and corrupt both data and bookkeeping. Fall back to the always-
+    # correct canonical chain: gather every sharded dim (innermost axis
+    # first, preserving tile order), then re-slice to dst (outer axis
+    # first). Bandwidth-suboptimal, never wrong.
+    if any(isinstance(e, tuple) for e in src + dst):
+        for d, e in enumerate(src):
+            for axis in reversed(_entry_axes(e)):  # innermost first
+                x = lax.all_gather(x, axis, axis=d, tiled=True)
+                rec.op("all_gather", axis, dim=d)
+        src = (None,) * ndim
+        for d, e in enumerate(dst):
+            for axis in _entry_axes(e):  # outer first: nested block order
+                n = lax.axis_size(axis)
+                idx = lax.axis_index(axis)
+                sz = x.shape[d] // n
+                x = lax.dynamic_slice_in_dim(x, idx * sz, sz, axis=d)
+                rec.op("slice", axis, dim=d)
+        return x
+
+    # 2. axis moves between dims: all_to_all. A move may only execute when
+    # its destination dim is not still sharded by a DIFFERENT axis (else
+    # the spec bookkeeping would clobber that axis and emit a wrong
+    # chain). Moves are drained in any safe order; a cycle (e.g. the dim
+    # swap ('x','y') -> ('y','x')) has no safe order, so one blocking
+    # axis is all_gathered to break it — step 4 re-shards the gathered
+    # axis with a free local slice.
+    while True:
+        moves = []
+        for axis in _axes_of(src):
+            sdim = _axis_dim(src, axis)
+            ddim = _axis_dim(dst, axis)
+            if ddim is not None and ddim != sdim:
+                moves.append((axis, sdim, ddim))
+        if not moves:
+            break
+        safe = next(((a, s, d) for a, s, d in moves
+                     if src[d] is None or src[d] == a), None)
+        if safe is None:
+            # cycle: gather whatever shards the first move's destination
+            _, _, ddim = moves[0]
+            blockers = src[ddim]
+            for bx in (blockers if isinstance(blockers, tuple)
+                       else (blockers,)):
+                x = lax.all_gather(x, bx, axis=ddim, tiled=True)
+                rec.op("all_gather", bx, dim=ddim)
             lst = list(src)
-            lst[sdim] = None
-            lst[ddim] = axis
+            lst[ddim] = None
             src = tuple(lst)
+            continue
+        axis, sdim, ddim = safe
+        x = lax.all_to_all(x, axis, split_axis=ddim, concat_axis=sdim,
+                           tiled=True)
+        rec.op("all_to_all", axis, src_dim=sdim, dst_dim=ddim)
+        lst = list(src)
+        lst[sdim] = None
+        lst[ddim] = axis
+        src = tuple(lst)
 
     # 3. sharded -> unsharded: all_gather
     for axis in _axes_of(src):

@@ -582,17 +582,42 @@ def batch_isend_irecv(p2p_op_list):
 
 
 # object collectives -------------------------------------------------------
+def _object_entry(verb, group):
+    """Common preamble for every object collective: bump the per-process
+    generation counter unconditionally — BEFORE any early return — so the
+    counters stay in lockstep across processes even when ranks take
+    different call styles (ADVICE r3: a non-src rank early-returning
+    without the bump pairs later collectives with the wrong store keys)."""
+    del verb, group
+    return _next_seq()
+
+
+def _require_world_object_group(verb, group):
+    """Store-backed object-collective paths are world-only, the same way
+    _process_gather is (a subgroup call over the world store would pair
+    keys with non-members / hang them). Purely-local paths (size-1 groups,
+    the single-controller scatter convenience) keep accepting groups."""
+    from .parallel_env import get_world_size
+    ranks = _group_ranks(group)
+    if group is not None and len(ranks) != get_world_size():
+        raise NotImplementedError(
+            f"paddle.distributed.{verb}: eager cross-process object "
+            f"collectives support the world group only (got subgroup "
+            f"{ranks} of world {get_world_size()}).")
+
+
 def all_gather_object(object_list, obj, group=None):
     """ref: communication/all_gather.py all_gather_object — arbitrary
     picklables via the world TCPStore."""
+    gen = _object_entry("all_gather_object", group)
     n = _group_size(group)
     if n == 1:
         object_list.append(obj)
         return object_list
     _require_initialized_multiproc("all_gather_object")
+    _require_world_object_group("all_gather_object", group)
     import pickle
     st = _world_store_or_raise("all_gather_object")
-    gen = _next_seq()
     ranks = _group_ranks(group)
     st.set(f"obj_ag/{gen}/{get_rank()}", pickle.dumps(obj))
     for r in ranks:
@@ -610,13 +635,14 @@ def broadcast_object_list(object_list, src=0, group=None):
     """ref: communication/broadcast.py broadcast_object_list — in-place:
     non-src ranks' slots are REPLACED by src's objects (the round-2
     silent-no-op is gone)."""
+    gen = _object_entry("broadcast_object_list", group)
     n = _group_size(group)
     if n == 1:
         return object_list
     _require_initialized_multiproc("broadcast_object_list")
+    _require_world_object_group("broadcast_object_list", group)
     import pickle
     st = _world_store_or_raise("broadcast_object_list")
-    gen = _next_seq()
     if get_rank() == src:
         st.set(f"obj_bc/{gen}", pickle.dumps(list(object_list)))
         return object_list
@@ -634,6 +660,7 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
     """ref: communication/scatter.py scatter_object_list. Single-controller:
     every logical rank sees src's full list (there is one process), so rank r
     takes slot r; `src` only matters for the cross-process eager path."""
+    gen = _object_entry("scatter_object_list", group)
     n = _group_size(group)
     if n == 1:
         out_object_list.append(in_object_list[0] if in_object_list else None)
@@ -641,12 +668,14 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
     my = group.rank if group is not None and group.rank >= 0 else get_rank()
     if in_object_list is not None and get_rank() != src:
         # single-controller convenience: caller already has src's list
+        # (the generation counter was already bumped above, so this early
+        # return cannot desync later collectives across processes)
         out_object_list.append(in_object_list[my])
         return out_object_list
     _require_initialized_multiproc("scatter_object_list")
+    _require_world_object_group("scatter_object_list", group)
     import pickle
     st = _world_store_or_raise("scatter_object_list")
-    gen = _next_seq()
     if get_rank() == src:
         for i, r in enumerate(_group_ranks(group)):
             st.set(f"obj_sc/{gen}/{r}", pickle.dumps(in_object_list[i]))

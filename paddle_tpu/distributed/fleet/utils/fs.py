@@ -3,7 +3,6 @@ FS ABC + LocalFS + HDFSClient). Checkpoint targets on TPU jobs are
 local/NFS/GCS paths; HDFS kept as an optional shell-out like the reference."""
 import os
 import shutil
-import subprocess
 
 
 class FS:
@@ -80,55 +79,6 @@ class LocalFS(FS):
 
     def touch(self, fs_path, exist_ok=True):
         open(fs_path, "a").close()
-
-
-class HDFSClient(FS):
-    """Shell-out client (ref: fs.py:51 HDFSClient over `hadoop fs`)."""
-
-    def __init__(self, hadoop_home, configs=None, time_out=300000,
-                 sleep_inter=1000):
-        self._base = [os.path.join(hadoop_home, "bin", "hadoop"), "fs"]
-        for k, v in (configs or {}).items():
-            self._base += [f"-D{k}={v}"]
-
-    def _run(self, *args):
-        return subprocess.run(self._base + list(args), capture_output=True,
-                              text=True)
-
-    def is_exist(self, fs_path):
-        return self._run("-test", "-e", fs_path).returncode == 0
-
-    def is_dir(self, fs_path):
-        return self._run("-test", "-d", fs_path).returncode == 0
-
-    def is_file(self, fs_path):
-        return self.is_exist(fs_path) and not self.is_dir(fs_path)
-
-    def ls_dir(self, fs_path):
-        out = self._run("-ls", fs_path).stdout.splitlines()
-        dirs, files = [], []
-        for line in out:
-            parts = line.split()
-            if len(parts) < 8:
-                continue
-            name = os.path.basename(parts[-1])
-            (dirs if parts[0].startswith("d") else files).append(name)
-        return dirs, files
-
-    def mkdirs(self, fs_path):
-        self._run("-mkdir", "-p", fs_path)
-
-    def delete(self, fs_path):
-        self._run("-rm", "-r", fs_path)
-
-    def upload(self, local_path, fs_path):
-        self._run("-put", local_path, fs_path)
-
-    def download(self, fs_path, local_path):
-        self._run("-get", fs_path, local_path)
-
-    def mv(self, src, dst, overwrite=False):
-        self._run("-mv", src, dst)
 
 
 class HDFSClient(FS):

@@ -393,8 +393,20 @@ class SpmdTrainer:
         # chunk-by-chunk in a checkpointed scan (ops/fused_ce.py). This is
         # what makes no-recompute batches fit in HBM at vocab 32k.
         lm_head = tail[-1]
+        # The fused kernel computes exactly plain ignore-index mean CE; a
+        # criterion configured with soft labels / smoothing / class
+        # weights / a non-mean reduction has DIFFERENT semantics and must
+        # ride the unfused path (ADVICE r3).
+        _, _, _, ce_obj = _model_parts(self.model)
+        plain_ce = (getattr(ce_obj, "soft_label", False) is False
+                    and getattr(ce_obj, "label_smoothing", 0.0) == 0.0
+                    and getattr(ce_obj, "weight", None) is None
+                    and getattr(ce_obj, "reduction", "mean") == "mean"
+                    and getattr(ce_obj, "use_softmax", True) is True
+                    and getattr(ce_obj, "axis", -1) == -1)
         fused_tail = (getattr(lm_head, "bias", None) is None
                       and hasattr(lm_head, "weight")
+                      and plain_ce
                       and self.fuse_head_ce)
         mp_axis = "model" if "model" in mesh.axis_names else None
 
@@ -402,7 +414,6 @@ class SpmdTrainer:
             from ..ops.fused_ce import fused_linear_ce
             from ..distributed.fleet.meta_parallel.parallel_layers.mp_ops \
                 import _identity_fn
-            _, _, _, ce_obj = _model_parts(self.model)
             ignore_index = getattr(ce_obj, "ignore_index", -100)
 
             def apply_tail_loss(outer, h, labels):

@@ -127,19 +127,36 @@ class FasterTokenizer:
                  else [None] * len(texts))
         ids_all, types_all = [], []
         for t, p in zip(texts, pairs):
-            ids = [self.vocab[self.cls]]
-            types = [0]
-            for tok in self.tokenize(t):
-                ids.append(self.vocab.get(tok, self.vocab[self.unk]))
-                types.append(0)
-            ids.append(self.vocab[self.sep])
-            types.append(0)
-            if p is not None:
-                for tok in self.tokenize(p):
-                    ids.append(self.vocab.get(tok, self.vocab[self.unk]))
-                    types.append(1)
-                ids.append(self.vocab[self.sep])
-                types.append(1)
+            a = [self.vocab.get(tok, self.vocab[self.unk])
+                 for tok in self.tokenize(t)]
+            b = ([self.vocab.get(tok, self.vocab[self.unk])
+                  for tok in self.tokenize(p)] if p is not None else None)
+            # Truncate BEFORE appending special tokens (the reference
+            # faster_tokenizer contract: encodings always end with [SEP];
+            # longest-first trimming for pairs), reserving room for
+            # [CLS] + [SEP] (+ second [SEP] for pairs).
+            budget = max_seq_len - (3 if b is not None else 2)
+            budget = max(budget, 0)
+            if b is None:
+                a = a[:budget]
+            elif len(a) + len(b) > budget:
+                # closed-form longest-first trim (ties trim the first
+                # segment): O(1) instead of one-token-per-iteration
+                la, lb = len(a), len(b)
+                if lb <= budget // 2 and la >= budget - lb:
+                    la = budget - lb
+                elif la < budget - budget // 2:
+                    lb = budget - la
+                else:
+                    la, lb = budget // 2, budget - budget // 2
+                a, b = a[:la], b[:lb]
+            ids = [self.vocab[self.cls]] + a + [self.vocab[self.sep]]
+            types = [0] * len(ids)
+            if b is not None:
+                ids += b + [self.vocab[self.sep]]
+                types += [1] * (len(b) + 1)
+            # degenerate caps (max_seq_len < special-token count) still
+            # honor the width contract — a hard cap as the last resort
             ids = ids[:max_seq_len]
             types = types[:max_seq_len]
             ids_all.append(ids)

@@ -142,6 +142,71 @@ class TestPoolingCeilAndLayout:
                                    rtol=1e-5)
 
 
+class TestFusedHeadCeCriterionGate:
+    def test_non_plain_criterion_falls_back_to_unfused(self):
+        """ADVICE r3: fuse_head_ce must not silently replace a criterion
+        with soft labels / smoothing / weights / non-mean reduction by the
+        plain ignore-index CE. A label-smoothed criterion must produce the
+        SAME loss whether fuse_head_ce is left True (gate falls back) or
+        explicitly False."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.train_step import SpmdTrainer
+        from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
+
+        mesh = build_mesh({"data": 1, "pipe": 1, "sharding": 1, "model": 1})
+        set_global_mesh(mesh)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 128, (4, 16)).astype(np.int64)
+        labels = np.roll(ids, -1, axis=1)
+
+        losses = {}
+        for fuse in (True, False):
+            paddle.seed(11)
+            model = LlamaForCausalLM(LlamaConfig.tiny())
+            model.criterion.ce = nn.CrossEntropyLoss(label_smoothing=0.1)
+            tr = SpmdTrainer(model, mesh, lr=1e-2, fuse_head_ce=fuse)
+            state = tr.init_state()
+            _, loss = tr.step(state, ids, labels)
+            losses[fuse] = float(loss)
+        assert np.isfinite(losses[True])
+        np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
+
+    def test_plain_criterion_still_fuses(self):
+        """The default plain-CE flagship keeps the fused path (loss equal
+        either way, and the gate computes fused_tail=True)."""
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.train_step import SpmdTrainer
+        from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
+
+        mesh = build_mesh({"data": 1, "pipe": 1, "sharding": 1, "model": 1})
+        set_global_mesh(mesh)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 128, (4, 16)).astype(np.int64)
+        labels = np.roll(ids, -1, axis=1)
+        losses = {}
+        for fuse in (True, False):
+            paddle.seed(11)
+            model = LlamaForCausalLM(LlamaConfig.tiny())
+            tr = SpmdTrainer(model, mesh, lr=1e-2, fuse_head_ce=fuse)
+            _, loss = tr.step(tr.init_state(), ids, labels)
+            losses[fuse] = float(loss)
+        np.testing.assert_allclose(losses[True], losses[False], rtol=2e-4)
+
+
+class TestObjectCollectiveSeqLockstep:
+    def test_convenience_early_return_bumps_generation(self):
+        """ADVICE r3: every object-collective entry must advance the
+        per-process generation counter, including scatter_object_list's
+        single-controller convenience early-return."""
+        from paddle_tpu.distributed import collective as C
+        before = C._eager_seq[0]
+        out = []
+        C.scatter_object_list(out, [{"a": 1}], src=0)
+        assert out == [{"a": 1}]
+        assert C._eager_seq[0] == before + 1
+
+
 class TestRpcBindAddress:
     def test_agent_advertises_routable_ip(self, monkeypatch):
         monkeypatch.setenv("PADDLE_LOCAL_IP", "10.1.2.3")

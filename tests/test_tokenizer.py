@@ -50,3 +50,28 @@ def test_pad_to_max_and_truncate():
               pad_to_max_seq_len=True)
     ids = np.asarray(out["input_ids"].data)
     assert ids.shape == (1, 4)
+
+
+def test_truncation_always_ends_with_sep():
+    """ADVICE r3: truncate-then-append-special-tokens — an encoding must
+    never lose its trailing [SEP] to the length cap."""
+    tok = FasterTokenizer(VOCAB)
+    sep, cls = VOCAB["[SEP]"], VOCAB["[CLS]"]
+    out = tok("the cat sat on the mat", max_seq_len=4)
+    ids = np.asarray(out["input_ids"].data)[0]
+    assert ids.shape[0] == 4
+    assert ids[0] == cls and ids[-1] == sep, ids
+
+    # degenerate cap below the special-token count: width contract still
+    # holds (no broadcast crash with pad_to_max_seq_len)
+    tiny = tok("the cat", max_seq_len=1, pad_to_max_seq_len=True)
+    assert np.asarray(tiny["input_ids"].data).shape == (1, 1)
+
+    # pair: both segments keep their [SEP]; longest-first trimming
+    pair = tok("the cat sat on the mat", text_pair="the mats on the mat",
+               max_seq_len=9)
+    ids = np.asarray(pair["input_ids"].data)[0]
+    tt = np.asarray(pair["token_type_ids"].data)[0]
+    assert ids.shape[0] == 9
+    assert ids[-1] == sep and (ids == sep).sum() == 2, ids
+    assert tt[-1] == 1 and tt[0] == 0

@@ -23,10 +23,15 @@ from ....mesh import in_spmd_region
 NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, scale, mask):
+def _block_attn(q, k, v, scale, mask, dropout_p=0.0, drop_key=None):
     """q:[b,sq,h,d] k,v:[b,sk,h_kv,d] (h_kv divides h — GQA expands
     here, at compute time, so the RING rotates the small h_kv buffers);
     mask:[sq,sk] bool or None.
+
+    Attention dropout (drop_key set): drops NORMALIZED probabilities —
+    the accumulator `o` uses the dropped/inverted-scaled weights while
+    the normalizer `l` keeps the full softmax sum, exactly
+    dropout(softmax(logits)) @ v once the online merge divides by l.
     Returns (out_unnormalized [b,sq,h,d], m [b,sq,h,1], l [b,sq,h,1])."""
     if k.shape[2] != q.shape[2]:
         rep = q.shape[2] // k.shape[2]
@@ -38,16 +43,25 @@ def _block_attn(q, k, v, scale, mask):
     m = jnp.max(logits, axis=-1, keepdims=True)           # b h q 1
     p = jnp.exp(logits - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    if dropout_p and drop_key is not None:
+        keep = jax.random.bernoulli(drop_key, 1.0 - dropout_p, p.shape)
+        p_o = jnp.where(keep, p / (1.0 - dropout_p),
+                        jnp.zeros((), p.dtype))
+    else:
+        p_o = p
+    o = jnp.einsum("bhqk,bkhd->bqhd", p_o, v)
     # to b q h 1 layout
     m = jnp.transpose(m, (0, 2, 1, 3))
     l = jnp.transpose(l, (0, 2, 1, 3))
     return o, m, l
 
 
-def ring_attention(q, k, v, axis_name="sep", causal=True, scale=None):
+def ring_attention(q, k, v, axis_name="sep", causal=True, scale=None,
+                   dropout_p=0.0):
     """Sequence-sharded attention. q,k,v: local [b, s_loc, h, d] jnp arrays
-    inside an SPMD region with `axis_name` bound."""
+    inside an SPMD region with `axis_name` bound. dropout_p: in-ring
+    attention-probability dropout (framework RNG stream; each (rank,
+    chunk) pair draws an independent mask)."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     scale = jnp.float32(scale)
     n = lax.axis_size(axis_name)
@@ -56,6 +70,12 @@ def ring_attention(q, k, v, axis_name="sep", causal=True, scale=None):
 
     rows = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
+
+    if dropout_p:
+        from .....framework import random as frnd
+        base_key = jax.random.fold_in(frnd.next_key(), rank)
+    else:
+        base_key = None
 
     def step(carry, i):
         k_cur, v_cur, acc, m, l = carry
@@ -71,7 +91,10 @@ def ring_attention(q, k, v, axis_name="sep", causal=True, scale=None):
                                        diag_mask))
         else:
             mask = None
-        o_i, m_i, l_i = _block_attn(q, k_cur, v_cur, scale, mask)
+        dk = (jax.random.fold_in(base_key, i) if base_key is not None
+              else None)
+        o_i, m_i, l_i = _block_attn(q, k_cur, v_cur, scale, mask,
+                                    dropout_p=dropout_p, drop_key=dk)
         if causal:
             # fully-masked chunks produce m=-inf rows; guard merge
             m_i = jnp.where(l_i > 0, m_i, NEG_INF)
@@ -126,14 +149,22 @@ def sep_concat(x, axis_name="sep", seq_axis=1):
 
 class RingFlashAttention:
     """Module-style wrapper usable from Layer.forward: inputs [b, s_loc, h, d]
-    Tensors; dispatches to ring attention when 'sep' is live, plain sdpa
-    otherwise."""
+    Tensors. For the 'sep' axis this is a trivial delegate —
+    scaled_dot_product_attention is the SINGLE dispatch point (ring when
+    'sep' is live, plain sdpa/Pallas otherwise); other axis names keep a
+    direct ring path."""
 
-    def __init__(self, axis_name="sep", causal=True):
+    def __init__(self, axis_name="sep", causal=True, dropout_p=0.0):
         self.axis_name = axis_name
         self.causal = causal
+        self.dropout_p = dropout_p
 
     def __call__(self, q, k, v):
+        if self.axis_name == "sep":
+            from .....nn.functional.attention import (
+                scaled_dot_product_attention)
+            return scaled_dot_product_attention(
+                q, k, v, is_causal=self.causal, dropout_p=self.dropout_p)
         if in_spmd_region(self.axis_name):
             # GQA: KV stays at h_kv heads ON THE WIRE (the ring's
             # bandwidth saving); _block_attn expands at compute time
@@ -143,7 +174,9 @@ class RingFlashAttention:
                     f"heads {k.shape[2]}")
             return apply(functools.partial(ring_attention,
                                            axis_name=self.axis_name,
-                                           causal=self.causal),
+                                           causal=self.causal,
+                                           dropout_p=self.dropout_p),
                          q, k, v, name="ring_attention")
         from .....nn.functional.attention import scaled_dot_product_attention
-        return scaled_dot_product_attention(q, k, v, is_causal=self.causal)
+        return scaled_dot_product_attention(q, k, v, is_causal=self.causal,
+                                            dropout_p=self.dropout_p)

@@ -66,8 +66,23 @@ class GPTEmbeddings(Layer):
 
     def forward(self, input_ids):
         import paddle_tpu as paddle
+        from jax import lax
+        from ..distributed.mesh import in_spmd_region
         s = input_ids.shape[1]
         pos = paddle.arange(s, dtype="int64")
+        if in_spmd_region("sep"):
+            # context parallelism: this shard holds a contiguous SLICE of
+            # the global sequence — learned positions need the per-rank
+            # global offset (same contract as the LLaMA rope offsets)
+            n_sep = lax.axis_size("sep")
+            max_pos = self.position_embeddings.weight.shape[0]
+            if s * n_sep > max_pos:
+                raise ValueError(
+                    f"global sequence {s * n_sep} (local {s} x sep "
+                    f"{n_sep}) exceeds max_position_embeddings {max_pos}")
+            from ..ops import apply
+            pos = apply(lambda p: p + lax.axis_index("sep") * s, pos,
+                        name="sep_pos_offset")
         emb = self.word_embeddings(input_ids) + self.position_embeddings(pos)
         return self.dropout(emb)
 

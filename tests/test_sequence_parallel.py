@@ -80,3 +80,97 @@ def test_sep_shards_activation_memory():
     if dense is None or sharded is None:
         pytest.skip("memory_analysis unavailable on this backend")
     assert sharded < 0.55 * dense, (dense, sharded)
+
+
+# --- GPT under sep (VERDICT r3 weak #2: was silently block-diagonal) -----
+
+def _gpt_traj(axes, seq=64, steps=3):
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig.tiny(hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (4, seq)).astype(np.int64)
+    labels = np.roll(ids, -1, axis=1)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    mesh = build_mesh(axes)
+    set_global_mesh(mesh)
+    tr = SpmdTrainer(model, mesh, lr=1e-2)
+    st = tr.init_state()
+    out = []
+    for i in range(steps):
+        st, loss = tr.step(st, ids, labels, key=jax.random.key(i))
+        out.append(float(loss))
+    return out
+
+
+def test_gpt_sep2_matches_dense():
+    """GPT positions carry the per-rank global offset and its attention
+    rides the ring — the sep2 trajectory must pin to the dense one."""
+    base = _gpt_traj({"data": 1, "pipe": 1, "sharding": 1, "model": 1})
+    sp = _gpt_traj({"data": 1, "pipe": 1, "sharding": 1, "model": 1,
+                    "sep": 2})
+    np.testing.assert_allclose(sp, base, rtol=2e-3,
+                               err_msg=f"gpt sep2 {sp} vs dense {base}")
+
+
+def test_sdpa_under_sep_rejects_masks_and_non_causal():
+    """Unsupported sdpa configs under a live 'sep' axis must raise, not
+    silently compute block-diagonal attention."""
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed.mesh import spmd_axes
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("sep",))
+    q = jnp.zeros((1, 8, 2, 4), jnp.float32)
+    mask = jnp.zeros((1, 2, 8, 16), jnp.float32)
+
+    def masked(ql):
+        with spmd_axes(("sep",)):
+            return F.scaled_dot_product_attention(
+                paddle.to_tensor(ql), paddle.to_tensor(ql),
+                paddle.to_tensor(ql), attn_mask=paddle.to_tensor(mask),
+                is_causal=True).data
+
+    def non_causal(ql):
+        with spmd_axes(("sep",)):
+            return F.scaled_dot_product_attention(
+                paddle.to_tensor(ql), paddle.to_tensor(ql),
+                paddle.to_tensor(ql), is_causal=False).data
+
+    with pytest.raises(NotImplementedError, match="sep"):
+        shard_map(masked, mesh=mesh, in_specs=(P(None, "sep"),),
+                  out_specs=P(None, "sep"), check_vma=False)(q)
+    with pytest.raises(NotImplementedError, match="causal"):
+        shard_map(non_causal, mesh=mesh, in_specs=(P(None, "sep"),),
+                  out_specs=P(None, "sep"), check_vma=False)(q)
+
+
+def test_ring_attention_dropout_drops_and_is_deterministic_per_seed():
+    """In-ring attention dropout: nonzero p changes the output (vs p=0),
+    the same framework seed reproduces it, and outputs stay finite."""
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers \
+        .ring_attention import ring_attention
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("sep",))
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 16, 2, 8), jnp.float32)
+
+    def run(p):
+        paddle.seed(123)
+        f = shard_map(
+            lambda ql: ring_attention(ql, ql, ql, "sep", causal=True,
+                                      dropout_p=p),
+            mesh=mesh, in_specs=(P(None, "sep"),),
+            out_specs=P(None, "sep"), check_vma=False)
+        return np.asarray(f(q))
+
+    base = run(0.0)
+    dropped = run(0.5)
+    dropped2 = run(0.5)
+    assert np.all(np.isfinite(dropped))
+    assert not np.allclose(base, dropped)
+    np.testing.assert_allclose(dropped, dropped2)

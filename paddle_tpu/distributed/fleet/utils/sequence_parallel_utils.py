@@ -1,9 +1,10 @@
 """Megatron-style sequence parallelism utilities.
 
-ref: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
-(AllGatherOp/ReduceScatterOp, ColumnSequenceParallelLinear,
-RowSequenceParallelLinear, mark_as_sequence_parallel_parameter) — the
-OTHER half of SURVEY §5.7's SP plan, complementing ring attention (CP):
+Green-field per SURVEY §5.7 (SP is absent from the reference snapshot;
+the design follows the upstream-Paddle/Megatron AllGatherOp /
+ReduceScatterOp, ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+mark_as_sequence_parallel_parameter surface) — the OTHER half of §5.7's
+SP plan, complementing ring attention (CP):
 between TP regions the activations live SEQUENCE-SHARDED over the
 'model' axis, so the norms/residual/dropout of every layer touch only
 s/mp tokens per device. The collective pair replacing the classic
@@ -44,6 +45,53 @@ def _allgather_seq_fn(axis, seq_axis):
 
 
 @functools.lru_cache(maxsize=None)
+def _allgather_seq_slice_grad_fn(axis, seq_axis):
+    """all_gather whose TRANSPOSE is a plain slice: use when the gathered
+    tensor feeds REPLICATED computation (e.g. the pre-lm-head gather), so
+    every rank's cotangent is identical — a psum_scatter there would
+    overcount by the group size (Megatron's
+    gather_from_sequence_parallel_region(tensor_parallel_output_grad=
+    False))."""
+    @jax.custom_vjp
+    def f(x):
+        return lax.all_gather(x, axis, axis=seq_axis, tiled=True)
+
+    def fwd(x):
+        return f(x), None
+
+    def bwd(_, g):
+        n = lax.axis_size(axis)
+        idx = lax.axis_index(axis)
+        sz = g.shape[seq_axis] // n
+        return (lax.dynamic_slice_in_dim(g, idx * sz, sz, axis=seq_axis),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _scatter_seq_fn(axis, seq_axis):
+    """ScatterOp: replicated full sequence -> this rank's shard (fwd
+    slice); transpose all_gathers the per-rank shard cotangents (each
+    position's cotangent lives on exactly one rank)."""
+    @jax.custom_vjp
+    def f(x):
+        n = lax.axis_size(axis)
+        idx = lax.axis_index(axis)
+        sz = x.shape[seq_axis] // n
+        return lax.dynamic_slice_in_dim(x, idx * sz, sz, axis=seq_axis)
+
+    def fwd(x):
+        return f(x), None
+
+    def bwd(_, g):
+        return (lax.all_gather(g, axis, axis=seq_axis, tiled=True),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@functools.lru_cache(maxsize=None)
 def _reduce_scatter_seq_fn(axis, seq_axis):
     @jax.custom_vjp
     def f(x):
@@ -60,13 +108,27 @@ def _reduce_scatter_seq_fn(axis, seq_axis):
     return f
 
 
-def all_gather_sp(x, axis_name="model", seq_axis=1):
-    """AllGatherOp: sequence-sharded -> full sequence (fwd), with the
-    reduce-scatter transpose in backward."""
+def all_gather_sp(x, axis_name="model", seq_axis=1, grad_mode="reduce_scatter"):
+    """AllGatherOp: sequence-sharded -> full sequence (fwd).
+
+    grad_mode="reduce_scatter" (default): transpose sums every rank's
+    distinct cotangent — correct when downstream is tensor-parallel.
+    grad_mode="slice": transpose takes this rank's slice — correct when
+    downstream is replicated (identical cotangents per rank)."""
     if not in_spmd_region(axis_name):
         return x
-    return apply(_allgather_seq_fn(axis_name, seq_axis), x,
-                 name="sp_allgather")
+    fn = (_allgather_seq_fn(axis_name, seq_axis)
+          if grad_mode == "reduce_scatter"
+          else _allgather_seq_slice_grad_fn(axis_name, seq_axis))
+    return apply(fn, x, name="sp_allgather")
+
+
+def scatter_sp(x, axis_name="model", seq_axis=1):
+    """ScatterOp: replicated full sequence -> per-rank shard (fwd slice,
+    bwd all_gather)."""
+    if not in_spmd_region(axis_name):
+        return x
+    return apply(_scatter_seq_fn(axis_name, seq_axis), x, name="sp_scatter")
 
 
 def reduce_scatter_sp(x, axis_name="model", seq_axis=1):
@@ -79,11 +141,17 @@ def reduce_scatter_sp(x, axis_name="model", seq_axis=1):
 
 class ColumnSequenceParallelLinear:
     """Mixin-style wrapper: a ColumnParallelLinear whose input arrives
-    sequence-sharded (ref: sequence_parallel_utils.py
-    ColumnSequenceParallelLinear). Implemented as a thin module over the
-    existing layer to keep one Linear implementation."""
+    sequence-sharded (upstream-Paddle/Megatron
+    ColumnSequenceParallelLinear; SURVEY §5.7). Implemented as a thin
+    module over the existing layer to keep one Linear implementation.
 
-    def __new__(cls, in_features, out_features, **kw):
+    gather_input=False: the caller already all_gather_sp'd the sequence
+    (one shared gather per block feeds q/k/v or gate/up, so the backward
+    emits ONE reduce-scatter on the SUMMED cotangents instead of one per
+    linear — Megatron's fused-qkv collective volume with separate
+    weights)."""
+
+    def __new__(cls, in_features, out_features, gather_input=True, **kw):
         from ..meta_parallel import ColumnParallelLinear
         from ..meta_parallel.parallel_layers import mp_ops
 
@@ -96,19 +164,26 @@ class ColumnSequenceParallelLinear:
                 # the gather's reduce-scatter transpose REPLACES
                 # _c_identity's psum — stacking both would overcount dh
                 # by the TP degree
-                full = all_gather_sp(x)
+                full = all_gather_sp(x) if self._sp_gather_input else x
                 out = F.linear(full, self.weight, self.bias)
                 if self.gather_output:
                     out = mp_ops._c_concat(out, group=self.group)
                 return out
 
         kw.setdefault("gather_output", False)
-        return _Col(in_features, out_features, **kw)
+        inst = _Col(in_features, out_features, **kw)
+        inst._sp_gather_input = gather_input
+        if inst.bias is not None:
+            # column bias is output-sharded over 'model' (complete per
+            # rank) — no marking needed
+            pass
+        return inst
 
 
 class RowSequenceParallelLinear:
     """RowParallelLinear whose output is reduce-SCATTERED over the
-    sequence dim instead of allreduced (ref: RowSequenceParallelLinear)."""
+    sequence dim instead of allreduced (upstream-Paddle/Megatron
+    RowSequenceParallelLinear; SURVEY §5.7)."""
 
     def __new__(cls, in_features, out_features, **kw):
         from ..meta_parallel import RowParallelLinear
@@ -126,7 +201,13 @@ class RowSequenceParallelLinear:
                 return out
 
         kw.setdefault("input_is_parallel", True)
-        return _Row(in_features, out_features, **kw)
+        inst = _Row(in_features, out_features, **kw)
+        if inst.bias is not None:
+            # the bias is added AFTER the sequence reduce-scatter: it acts
+            # on this rank's s/mp tokens only, so its grad is partial over
+            # 'model' — tag it for the trainer/hybrid grad sync psum
+            mark_as_sequence_parallel_parameter(inst.bias)
+        return inst
 
 
 def mark_as_sequence_parallel_parameter(param):

@@ -32,7 +32,7 @@ class LlamaConfig:
                  num_attention_heads=32, num_key_value_heads=None,
                  max_position_embeddings=2048, rms_norm_eps=1e-6,
                  rope_theta=10000.0, dtype="float32", tie_word_embeddings=False,
-                 recompute=False):
+                 recompute=False, sequence_parallel=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -45,6 +45,10 @@ class LlamaConfig:
         self.dtype = dtype
         self.tie_word_embeddings = tie_word_embeddings
         self.recompute = recompute
+        # Megatron-SP (SURVEY §5.7): activations between TP regions live
+        # sequence-sharded over 'model'; the linears become the
+        # Column/RowSequenceParallelLinear pair
+        self.sequence_parallel = sequence_parallel
 
     @staticmethod
     def llama_7b(**kw):
@@ -84,6 +88,17 @@ def apply_rotary(x, cos, sin):
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
+def _linear_pair(config):
+    """Classic TP pair, or the sequence-parallel pair (input arrives
+    sequence-sharded over 'model'; Col all_gathers the sequence, Row
+    reduce-scatters it back) when config.sequence_parallel."""
+    if getattr(config, "sequence_parallel", False):
+        from ..distributed.fleet.utils.sequence_parallel_utils import (
+            ColumnSequenceParallelLinear, RowSequenceParallelLinear)
+        return ColumnSequenceParallelLinear, RowSequenceParallelLinear
+    return ColumnParallelLinear, RowParallelLinear
+
+
 class LlamaAttention(Layer):
     """Separate q/k/v column-parallel projections: each shards by whole
     heads on the 'model' axis, so the parallel math equals the dense math
@@ -100,23 +115,36 @@ class LlamaAttention(Layer):
         # (LLaMA-2-70B geometry); sdpa expands KV head-wise at dispatch
         self.num_kv_heads = config.num_key_value_heads
         kv_out = self.num_kv_heads * self.head_dim
+        self.sequence_parallel = getattr(config, "sequence_parallel", False)
+        Col, Row = _linear_pair(config)
         kw = dict(has_bias=False, gather_output=False)
-        self.q_proj = ColumnParallelLinear(self.hidden_size, self.hidden_size,
-                                           **kw)
-        self.k_proj = ColumnParallelLinear(self.hidden_size, kv_out, **kw)
-        self.v_proj = ColumnParallelLinear(self.hidden_size, kv_out, **kw)
-        self.o_proj = RowParallelLinear(self.hidden_size, self.hidden_size,
-                                        has_bias=False, input_is_parallel=True)
+        if self.sequence_parallel:
+            # ONE shared sequence gather in forward feeds q/k/v: backward
+            # emits a single reduce-scatter on the summed cotangents
+            kw["gather_input"] = False
+        self.q_proj = Col(self.hidden_size, self.hidden_size, **kw)
+        self.k_proj = Col(self.hidden_size, kv_out, **kw)
+        self.v_proj = Col(self.hidden_size, kv_out, **kw)
+        self.o_proj = Row(self.hidden_size, self.hidden_size,
+                          has_bias=False, input_is_parallel=True)
         cos, sin = _rope_cache(config.max_position_embeddings, self.head_dim,
                                config.rope_theta, jnp.float32)
         self._cos, self._sin = cos, sin
 
     def forward(self, hidden_states):
         from ..distributed.mesh import in_spmd_region
-        b, s = hidden_states.shape[0], hidden_states.shape[1]
+        b = hidden_states.shape[0]
+        if self.sequence_parallel:
+            from ..distributed.fleet.utils.sequence_parallel_utils import (
+                all_gather_sp)
+            hidden_states = all_gather_sp(hidden_states)
         q = self.q_proj(hidden_states)
         k = self.k_proj(hidden_states)
         v = self.v_proj(hidden_states)
+        # under Megatron-SP the projections GATHERED the sequence: q/k/v
+        # carry the full (sep-local) sequence even though hidden_states
+        # arrived sequence-sharded over 'model' — derive s from q
+        s = q.shape[1]
         cos, sin = self._cos, self._sin
         hd = self.head_dim
         # context parallelism: activations arrive sequence-sharded over
@@ -160,17 +188,24 @@ class LlamaAttention(Layer):
 class LlamaMLP(Layer):
     def __init__(self, config):
         super().__init__()
-        self.gate_proj = ColumnParallelLinear(
-            config.hidden_size, config.intermediate_size, has_bias=False,
-            gather_output=False)
-        self.up_proj = ColumnParallelLinear(
-            config.hidden_size, config.intermediate_size, has_bias=False,
-            gather_output=False)
-        self.down_proj = RowParallelLinear(
+        self.sequence_parallel = getattr(config, "sequence_parallel", False)
+        Col, Row = _linear_pair(config)
+        kw = dict(has_bias=False, gather_output=False)
+        if self.sequence_parallel:
+            kw["gather_input"] = False  # shared gather in forward
+        self.gate_proj = Col(config.hidden_size, config.intermediate_size,
+                             **kw)
+        self.up_proj = Col(config.hidden_size, config.intermediate_size,
+                           **kw)
+        self.down_proj = Row(
             config.intermediate_size, config.hidden_size, has_bias=False,
             input_is_parallel=True)
 
     def forward(self, x):
+        if self.sequence_parallel:
+            from ..distributed.fleet.utils.sequence_parallel_utils import (
+                all_gather_sp)
+            x = all_gather_sp(x)
         g = self.gate_proj(x)
         u = self.up_proj(x)
         act = apply(lambda ga, ua: ua * (ga * (1.0 / (1.0 + jnp.exp(-ga)))),
@@ -186,6 +221,14 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = RMSNorm(config.hidden_size,
                                                 config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
+        if getattr(config, "sequence_parallel", False):
+            # norm weights act on sequence SHARDS: their grads are partial
+            # over 'model' and the trainer psums them
+            from ..distributed.fleet.utils.sequence_parallel_utils import (
+                mark_as_sequence_parallel_parameter)
+            mark_as_sequence_parallel_parameter(self.input_layernorm.weight)
+            mark_as_sequence_parallel_parameter(
+                self.post_attention_layernorm.weight)
 
     def forward(self, hidden_states):
         residual = hidden_states

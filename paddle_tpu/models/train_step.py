@@ -179,6 +179,22 @@ class SpmdTrainer:
         # stacked decoder params
         self.layer_param_names = [n for n, _ in _named_params(self.template)]
         self.layer_param_tensors = [p for _, p in _named_params(self.template)]
+        # Megatron-SP (SURVEY §5.7): model built with the sequence-parallel
+        # linear pair tags its norm weights; their grads are PARTIAL over
+        # 'model' (each rank saw only its sequence shard) and get psum'd
+        self._sp_partial = [bool(getattr(p, "sequence_parallel", False))
+                            for p in self.layer_param_tensors]
+        self.sequence_parallel = any(self._sp_partial)
+        if self.sequence_parallel:
+            if self.sharding_stage == 3:
+                raise NotImplementedError(
+                    "sequence_parallel with sharding_stage=3 is not "
+                    "supported: stage-3 chunk transposes do not complete "
+                    "the 'model'-partial norm grads. Use stage 1/2.")
+            if self.S_pipe > 1:
+                raise NotImplementedError(
+                    "sequence_parallel with pipeline parallelism is not "
+                    "supported yet; use mp/dp/sharding/sep meshes.")
         self.stacked_specs = []
         for _, p in _named_params(self.template):
             base = param_spec(p)
@@ -376,6 +392,13 @@ class SpmdTrainer:
         mdt = self._mdt
         S_shard = self.S_shard
         stage3 = self.sharding_stage == 3
+        sp_active = self.sequence_parallel and "model" in mesh.axis_names
+        sp_flags = list(self._sp_partial)
+        if sp_active:
+            from ..distributed.fleet.utils.sequence_parallel_utils import (
+                _scatter_seq_fn, _allgather_seq_slice_grad_fn)
+            sp_scatter_raw = _scatter_seq_fn("model", 1)
+            sp_gather_raw = _allgather_seq_slice_grad_fn("model", 1)
 
         def materialize_outer(outer):
             if not stage3:
@@ -419,6 +442,10 @@ class SpmdTrainer:
             def apply_tail_loss(outer, h, labels):
                 with _Swap(outer_tensors, materialize_outer(outer)), \
                         tape.no_grad():
+                    if sp_active:
+                        # tail is replicated computation: gather the
+                        # sequence with the slice-transpose gather
+                        h = sp_gather_raw(h)
                     out = Tensor(h) if not isinstance(h, Tensor) else h
                     for l in tail[:-1]:
                         out = l(out)
@@ -441,6 +468,8 @@ class SpmdTrainer:
             def apply_tail_loss(outer, h, labels):
                 with _Swap(outer_tensors, materialize_outer(outer)), \
                         tape.no_grad():
+                    if sp_active:
+                        h = sp_gather_raw(h)
                     out = h
                     for l in tail[:-1]:
                         out = l(Tensor(out) if not isinstance(out, Tensor) else out)
@@ -497,6 +526,16 @@ class SpmdTrainer:
             stacked = params["stacked"]  # local: [per, ...] or [per, chunk]
             with spmd_axes(axis_names), frnd.key_scope(key):
                 emb = apply_embed(outer, ids)  # [B_loc, T, H]
+                if sp_active:
+                    # enter the sequence-parallel region: shard the
+                    # (replicated-over-'model') embeddings by sequence
+                    if emb.shape[1] % mesh.shape["model"]:
+                        raise ValueError(
+                            f"sequence_parallel needs the model-parallel "
+                            f"degree {mesh.shape['model']} to divide the "
+                            f"sequence length {emb.shape[1]} (pad the "
+                            f"sequence to a multiple of the degree)")
+                    emb = sp_scatter_raw(emb)
                 if S == 1:
                     h = apply_stage(stacked, emb)
                     loss = apply_tail_loss(outer, h, labels)
@@ -666,6 +705,12 @@ class SpmdTrainer:
                 return g
 
             grads = jax.tree_util.tree_map(reduce_grad, grads)
+            # Megatron-SP: norm weights saw only this rank's sequence
+            # shard — complete their grads across the TP group
+            if sp_active:
+                grads["stacked"] = [
+                    lax.psum(g, "model") if flag else g
+                    for g, flag in zip(grads["stacked"], sp_flags)]
             # pipe-replicated outer params: sum partials across stages
             if S > 1:
                 grads["outer"] = [lax.psum(g, "pipe")

@@ -138,9 +138,28 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     from ..nn import Layer
 
     def decorate(fn):
+        import warnings
+        from .ast_transform import Dy2StaticSyntaxError
+        from . import ast_transform
+
+        def convert_callable(f):
+            # unsupported constructs (break/continue/mixed returns) keep
+            # the OLD trace-only behavior: concrete control flow still
+            # traces fine; tensor-dependent flow fails at trace time with
+            # jax's concretization error — not a silent wrong answer
+            try:
+                return ast_transform.convert_callable(f)
+            except Dy2StaticSyntaxError as e:
+                warnings.warn(f"to_static AST conversion skipped: {e}")
+                return f
         if isinstance(fn, Layer):
             layer = fn
-            orig_forward = layer.forward
+            # AST tier (ref: jit/dy2static/ transformers): plain Python
+            # if/while/bool-ops over tensor values become converter calls;
+            # the converted forward serves BOTH eager and traced modes
+            # (converters degrade to Python control flow on concrete
+            # values, the reference's ProgramTranslator contract)
+            orig_forward = convert_callable(layer.forward)
             layer._orig_forward = orig_forward
             traced = TracedFunction(lambda *a, **k: orig_forward(*a, **k))
             layer._traced_forward = traced
@@ -152,7 +171,7 @@ def to_static(function=None, input_spec=None, build_strategy=None,
 
             layer.forward = fwd
             return layer
-        return functools.wraps(fn)(TracedFunction(fn))
+        return functools.wraps(fn)(TracedFunction(convert_callable(fn)))
 
     if function is not None:
         return decorate(function)

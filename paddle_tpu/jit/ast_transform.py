@@ -1,0 +1,452 @@
+"""dy2static AST auto-conversion (the missing top tier over the
+converter functions in jit/dy2static.py).
+
+ref design: python/paddle/jit/dy2static/ — the reference rewrites the
+decorated function's AST so plain Python control flow over tensor values
+(`if x.mean() > 0:`, `while not done:`, `a and b`) is converted into
+calls to converter functions (convert_ifelse / convert_while_loop /
+logical thunks). The converters degrade to plain Python control flow for
+concrete values, so ONE transformed function runs both eagerly and under
+jit.to_static tracing — the reference's ProgramTranslator contract.
+
+Supported rewrites (the core of the reference's 25+ transformers):
+  * if / elif / else        -> convert_ifelse over branch closures
+                               returning the union of escaping assigned
+                               names; read-then-write names are threaded
+                               as default-parameter captures
+  * tail `return` branches  -> return convert_ifelse(...)
+  * while                   -> convert_while_loop over (cond_fn, body_fn)
+                               threading the loop-carried names
+  * and / or / not          -> strict thunked logical converters (both
+                               operands wrapped in lambdas: a callable
+                               VALUE is never invoked by mistake)
+
+Ifs that cannot be converted (break/continue in a branch, mixed
+return/fall-through) are left as plain Python: concrete predicates work
+unchanged, traced predicates fail loudly with jax's concretization
+error. A `while` whose body contains break/continue/return raises
+Dy2StaticSyntaxError (the closure rewrite cannot represent them).
+
+Known limits (documented, loud): closure cell contents are snapshotted
+at conversion time; decorating a function then rebinding its closure
+cells is not reflected.
+"""
+import ast
+import functools
+import inspect
+import textwrap
+import types
+
+from . import dy2static as _jst
+
+_JST_NAME = "__dy2static_jst"
+_CONVERTED_FLAG = "__dy2static_converted__"
+_OUTER_NAME = "__dy2s_outer__"
+
+
+class Dy2StaticSyntaxError(Exception):
+    pass
+
+
+_COMP_NODES = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+
+
+def _assigned_names(stmts):
+    """Names bound (Store) at any depth of `stmts`, excluding bindings
+    inside nested function/class definitions AND comprehension scopes
+    (comprehension targets are scope-local in py3)."""
+    names = set()
+
+    def walk(node):
+        if isinstance(node, _COMP_NODES):
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            names.add(node.name)
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    for s in stmts:
+        walk(s)
+    return names
+
+
+def _loaded_names(node_or_stmts, skip_scopes=False):
+    """Names loaded under the nodes. AugAssign targets count as loads
+    (x += 1 reads x). Comprehension targets leak in as loads — a safe
+    over-approximation (they never appear in assigned-name sets).
+    skip_scopes: don't descend into nested function/class bodies (their
+    loads execute at CALL time, not at this statement's position — used
+    by the read-before-write ordering analysis)."""
+    names = set()
+    nodes = (node_or_stmts if isinstance(node_or_stmts, list)
+             else [node_or_stmts])
+
+    def walk(node):
+        if skip_scopes and isinstance(node, _SCOPE_NODES):
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            names.add(node.id)
+        if isinstance(node, ast.AugAssign) and isinstance(node.target,
+                                                          ast.Name):
+            names.add(node.target.id)
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    for n in nodes:
+        walk(n)
+    return names
+
+
+def _contains(stmts, kinds, *, stop_at_loops=False):
+    """Whether the statements contain a node of `kinds`, not descending
+    into nested function defs (optionally stopping at nested loops)."""
+    found = []
+
+    def walk(node, top):
+        if isinstance(node, kinds):
+            found.append(node)
+            return
+        if isinstance(node, _SCOPE_NODES):
+            return
+        if not top and stop_at_loops and isinstance(node,
+                                                    (ast.While, ast.For)):
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, False)
+
+    for s in stmts:
+        walk(s, True)
+    return bool(found)
+
+
+def _tail_return(stmts):
+    return bool(stmts) and isinstance(stmts[-1], ast.Return)
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _lambda(body):
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                           kwonlyargs=[], kw_defaults=[], kwarg=None,
+                           defaults=[]),
+        body=body)
+
+
+def _call_jst(attr, args):
+    return ast.Call(
+        func=ast.Attribute(value=_name(_JST_NAME), attr=attr,
+                           ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _loads_excluding(root, excluded):
+    """Names loaded anywhere under `root` except inside the `excluded`
+    subtree."""
+    names = set()
+
+    def walk(node):
+        if node is excluded:
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            names.add(node.id)
+        if isinstance(node, ast.AugAssign) and isinstance(node.target,
+                                                          ast.Name):
+            names.add(node.target.id)
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    walk(root)
+    return names
+
+
+def _read_before_write(stmts):
+    """Names loaded at (or before) the statement that first writes them —
+    loop-carried accumulators like `acc = acc + v` / `acc += v`."""
+    written = set()
+    carried = set()
+    for s in stmts:
+        carried |= _loaded_names([s], skip_scopes=True) - written
+        written |= _assigned_names([s])
+    return carried & _assigned_names(stmts)
+
+
+def _branch_fn(name, stmts, ret_value, capture_defaults):
+    """A nested branch/loop function. Names in `capture_defaults` become
+    default-valued parameters (`def f(y=y):`) so a branch that both reads
+    and writes an outer local sees the OUTER value instead of raising
+    UnboundLocalError (the reference threads them as fn args)."""
+    caps = sorted(capture_defaults)
+    return ast.FunctionDef(
+        name=name,
+        args=ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=c) for c in caps],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[_name(c) for c in caps]),
+        body=list(stmts) + ([ret_value] if ret_value is not None else []),
+        decorator_list=[], returns=None)
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self, root=None):
+        self._n = 0
+        self._root = root
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    def _observable(self, node, assigned):
+        """Assigned names that escape the construct: read anywhere outside
+        it (over-approximate: before OR after — a name defined before is
+        just a harmlessly-threaded extra)."""
+        if self._root is None:
+            return assigned
+        return assigned & _loads_excluding(self._root, node)
+
+    # --- boolean ops ------------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        op = ("logical_and_thunked" if isinstance(node.op, ast.And)
+              else "logical_or_thunked")
+        out = node.values[-1]
+        for val in reversed(node.values[:-1]):
+            # BOTH operands thunked: short-circuit preserved, and a
+            # callable VALUE is never invoked by mistake
+            out = _call_jst(op, [_lambda(val), _lambda(out)])
+        return ast.copy_location(out, node)
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.copy_location(
+                _call_jst("convert_logical_not", [node.operand]), node)
+        return node
+
+    # --- if ---------------------------------------------------------------
+    def visit_If(self, node):
+        if _contains(node.body + node.orelse, (ast.Break, ast.Continue),
+                     stop_at_loops=True):
+            # an if owning break/continue can't become closures; leave it
+            # as plain Python (concrete preds fine; traced preds fail
+            # loudly at trace time). Children may still convert.
+            self.generic_visit(node)
+            return node
+        self.generic_visit(node)
+        uid = self._uid()
+        body, orelse = node.body, node.orelse or [ast.Pass()]
+
+        has_ret = _contains(body, ast.Return) or _contains(orelse, ast.Return)
+        if has_ret:
+            only_tail_t = _tail_return(body) and not _contains(
+                body[:-1], ast.Return)
+            only_tail_f = _tail_return(orelse) and not _contains(
+                orelse[:-1], ast.Return)
+            if not (only_tail_t and only_tail_f):
+                # mixed return/fall-through: leave the if unconverted
+                return node
+            t_name, f_name = f"__dy2s_true_{uid}", f"__dy2s_false_{uid}"
+            t_fn = _branch_fn(t_name, body, None,
+                              _read_before_write(body))
+            f_fn = _branch_fn(f_name, orelse, None,
+                              _read_before_write(orelse))
+            ret = ast.Return(value=_call_jst(
+                "convert_ifelse",
+                [node.test, _name(t_name), _name(f_name)]))
+            out = [t_fn, f_fn, ret]
+            for s in out:
+                ast.copy_location(s, node)
+                ast.fix_missing_locations(s)
+            return out
+
+        assigned = sorted(self._observable(
+            node, _assigned_names(body) | _assigned_names(orelse)))
+        t_name, f_name = f"__dy2s_true_{uid}", f"__dy2s_false_{uid}"
+        ret_tuple = ast.Return(value=ast.Tuple(
+            elts=[_name(a) for a in assigned], ctx=ast.Load()))
+        t_fn = _branch_fn(t_name, body, ret_tuple, _read_before_write(body))
+        f_fn = _branch_fn(f_name, orelse, ret_tuple,
+                          _read_before_write(orelse))
+        call = _call_jst("convert_ifelse",
+                         [node.test, _name(t_name), _name(f_name)])
+        if assigned:
+            assign = ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[_name(a, ast.Store()) for a in assigned],
+                    ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        out = [t_fn, f_fn, assign]
+        for s in out:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return out
+
+    # --- while ------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            raise Dy2StaticSyntaxError(
+                "dy2static: while/else is not supported")
+        if _contains(node.body, (ast.Break, ast.Continue),
+                     stop_at_loops=True):
+            raise Dy2StaticSyntaxError(
+                "dy2static: break/continue inside a converted while "
+                "is not supported — fold the condition into the loop "
+                "predicate (XLA while_loop has a single exit test)")
+        if _contains(node.body, ast.Return):
+            raise Dy2StaticSyntaxError(
+                "dy2static: return inside a converted while body is not "
+                "supported — carry the value in a loop variable")
+        uid = self._uid()
+        # loop-carried state = names the body writes that are observable
+        # outside the loop (test / before / after) or read-before-write
+        # inside the body (accumulators). Purely body-local temps stay
+        # local to body_fn; read-only names resolve via closure.
+        assigned = _assigned_names(node.body)
+        loop_vars = sorted(
+            (assigned & _loaded_names(node.test))
+            | self._observable(node, assigned)
+            | _read_before_write(node.body))
+        c_name, b_name = f"__dy2s_cond_{uid}", f"__dy2s_body_{uid}"
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=a) for a in loop_vars],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        cond_fn = ast.FunctionDef(
+            name=c_name, args=args,
+            body=[ast.Return(value=node.test)],
+            decorator_list=[], returns=None)
+        ret_tuple = ast.Return(value=ast.Tuple(
+            elts=[_name(a) for a in loop_vars], ctx=ast.Load()))
+        body_fn = ast.FunctionDef(
+            name=b_name, args=args,
+            body=list(node.body) + [ret_tuple],
+            decorator_list=[], returns=None)
+        call = _call_jst("convert_while_loop",
+                         [_name(c_name), _name(b_name)]
+                         + [_name(a) for a in loop_vars])
+        if loop_vars:
+            assign = ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[_name(a, ast.Store()) for a in loop_vars],
+                    ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        out = [cond_fn, body_fn, assign]
+        for s in out:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return out
+
+
+# cache: original __code__ -> (module code object, fn name, freevars) or
+# None when the function needs no conversion
+_code_cache = {}
+
+
+def _transform_code(fn):
+    key = fn.__code__
+    if key in _code_cache:
+        return _code_cache[key]
+    try:
+        src = inspect.getsource(fn)
+    except (OSError, TypeError):
+        _code_cache[key] = None
+        return None
+    src = textwrap.dedent(src)
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        _code_cache[key] = None
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        _code_cache[key] = None
+        return None
+    fdef.decorator_list = []  # don't re-apply @to_static on exec
+    if not _contains(fdef.body, (ast.If, ast.While, ast.BoolOp)):
+        _code_cache[key] = None
+        return None
+
+    _ControlFlowTransformer(root=fdef).visit(tree)
+
+    freevars = fn.__code__.co_freevars
+    if freevars:
+        # synthetic enclosing factory whose parameters are the original
+        # free variables: the recompiled inner function closes over them
+        # properly instead of silently falling through to module globals
+        outer = ast.FunctionDef(
+            name=_OUTER_NAME,
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=v) for v in freevars],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=[fdef, ast.Return(value=_name(fdef.name))],
+            decorator_list=[], returns=None)
+        tree = ast.Module(body=[outer], type_ignores=[])
+    ast.fix_missing_locations(tree)
+    filename = f"<dy2static {fn.__module__}.{fn.__qualname__}>"
+    code = compile(tree, filename, "exec")
+    entry = (code, fdef.name, freevars)
+    _code_cache[key] = entry
+    return entry
+
+
+def convert_function(fn):
+    """AST-convert a plain function: plain `if`/`while`/bool ops over
+    tensor values become converter calls. Returns a new function bound to
+    THIS fn's defaults/closure (transformed code is cached per original
+    code object); functions with nothing to convert come back as-is."""
+    if getattr(fn, _CONVERTED_FLAG, False):
+        return fn
+    entry = _transform_code(fn)
+    if entry is None:
+        return fn
+    code, name, freevars = entry
+    # run against the LIVE module globals (late-bound helpers, monkey-
+    # patching); the single injected converter name is namespaced
+    g = fn.__globals__
+    g[_JST_NAME] = _jst
+    ns = {}
+    exec(code, g, ns)
+    if freevars:
+        cells = [c.cell_contents for c in (fn.__closure__ or ())]
+        if len(cells) != len(freevars):
+            return fn
+        new_fn = ns[_OUTER_NAME](*cells)
+    else:
+        new_fn = ns[name]
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = getattr(fn, "__kwdefaults__", None)
+    new_fn = functools.wraps(fn)(new_fn)
+    setattr(new_fn, _CONVERTED_FLAG, True)
+    return new_fn
+
+
+def convert_callable(fn):
+    """convert_function for functions AND bound methods (rebinds self)."""
+    if inspect.ismethod(fn):
+        conv = convert_function(fn.__func__)
+        if conv is fn.__func__:
+            return fn
+        return types.MethodType(conv, fn.__self__)
+    if inspect.isfunction(fn):
+        return convert_function(fn)
+    return fn

@@ -28,6 +28,16 @@ def _is_traced(*vals):
     return any(isinstance(_data(v), jax.core.Tracer) for v in vals)
 
 
+def _truthy(v):
+    """Python truthiness that also handles concrete arrays/Tensors (the
+    AST tier routes EVERY `and`/`or`/`not`/`if` through the converters,
+    including ones over plain Python values)."""
+    d = _data(v)
+    if hasattr(d, "shape") and not isinstance(d, (bool, int, float)):
+        return bool(jnp.reshape(d, ()))
+    return bool(d)
+
+
 def _flatten(tree):
     leaves, treedef = jax.tree_util.tree_flatten(
         tree, is_leaf=lambda x: isinstance(x, Tensor))
@@ -47,7 +57,7 @@ def cond(pred, true_fn, false_fn=None, name=None):
     concrete pred -> plain Python dispatch."""
     p = _data(pred)
     if not isinstance(p, jax.core.Tracer):
-        if bool(jnp.reshape(p, ())) if hasattr(p, "shape") else bool(p):
+        if _truthy(p):
             return true_fn()
         return false_fn() if false_fn is not None else None
     if false_fn is None:
@@ -134,7 +144,7 @@ def convert_logical_and(x_func, y_func):
     x = x_func() if callable(x_func) else x_func
     xd = _data(x)
     if not isinstance(xd, jax.core.Tracer):
-        if not bool(jnp.reshape(xd, ())):
+        if not _truthy(xd):
             return x
         return y_func() if callable(y_func) else y_func
     y = y_func() if callable(y_func) else y_func
@@ -146,7 +156,7 @@ def convert_logical_or(x_func, y_func):
     x = x_func() if callable(x_func) else x_func
     xd = _data(x)
     if not isinstance(xd, jax.core.Tracer):
-        if bool(jnp.reshape(xd, ())):
+        if _truthy(xd):
             return x
         return y_func() if callable(y_func) else y_func
     y = y_func() if callable(y_func) else y_func
@@ -154,8 +164,35 @@ def convert_logical_or(x_func, y_func):
                                  jnp.reshape(_data(y), ())))
 
 
+def logical_and_thunked(x_thunk, y_thunk):
+    """Strict-thunk variant for the AST tier: BOTH operands arrive as
+    zero-arg lambdas, so a callable VALUE (`fn = user_fn or default`) is
+    never invoked by mistake; short-circuit is preserved."""
+    x = x_thunk()
+    xd = _data(x)
+    if not isinstance(xd, jax.core.Tracer):
+        if not _truthy(xd):
+            return x
+        return y_thunk()
+    y = y_thunk()
+    return Tensor(jnp.logical_and(jnp.reshape(xd, ()),
+                                  jnp.reshape(_data(y), ())))
+
+
+def logical_or_thunked(x_thunk, y_thunk):
+    x = x_thunk()
+    xd = _data(x)
+    if not isinstance(xd, jax.core.Tracer):
+        if _truthy(xd):
+            return x
+        return y_thunk()
+    y = y_thunk()
+    return Tensor(jnp.logical_or(jnp.reshape(xd, ()),
+                                 jnp.reshape(_data(y), ())))
+
+
 def convert_logical_not(x):
     xd = _data(x)
     if not isinstance(xd, jax.core.Tracer):
-        return not bool(jnp.reshape(xd, ()))
+        return not _truthy(xd)
     return Tensor(jnp.logical_not(jnp.reshape(xd, ())))

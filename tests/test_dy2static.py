@@ -5,6 +5,7 @@ python/paddle/jit/dy2static/convert_operators.py."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import static
@@ -123,3 +124,270 @@ def test_while_loop_shape_change_rejected():
         raise AssertionError("expected shape-change ValueError")
     except ValueError as e:
         assert "fixed shapes" in str(e)
+
+
+# --- AST auto-conversion tier (VERDICT r3 next #4; ref: jit/dy2static/
+#     NodeTransformers): plain Python control flow over tensor values
+#     compiles via to_static with NO manual cond/while_loop calls. -------
+
+class TestAstAutoConversion:
+    def test_plain_if_over_tensor_compiles(self):
+        @paddle.jit.to_static
+        def f(x):
+            if x.mean() > 0:
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        pos = paddle.to_tensor(np.ones((3,), np.float32))
+        neg = paddle.to_tensor(-np.ones((3,), np.float32))
+        np.testing.assert_allclose(np.asarray(f(pos).data), 2 * np.ones(3))
+        np.testing.assert_allclose(np.asarray(f(neg).data), -2 * np.ones(3))
+
+    def test_tail_return_branches(self):
+        @paddle.jit.to_static
+        def f(x):
+            if x.sum() > 0:
+                return x * 3.0
+            else:
+                return -x
+
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor(np.float32([1, 2]))).data), [3, 6])
+        np.testing.assert_allclose(
+            np.asarray(f(paddle.to_tensor(np.float32([-1, -2]))).data), [1, 2])
+
+    def test_elif_chain_and_bool_ops(self):
+        @paddle.jit.to_static
+        def f(x):
+            m = x.mean()
+            if m > 1.0 and m < 3.0:
+                r = x + 10.0
+            elif not (m > -1.0):
+                r = x - 10.0
+            else:
+                r = x
+            return r
+
+        mk = lambda v: paddle.to_tensor(np.full((2,), v, np.float32))
+        np.testing.assert_allclose(np.asarray(f(mk(2.0)).data), [12, 12])
+        np.testing.assert_allclose(np.asarray(f(mk(-5.0)).data), [-15, -15])
+        np.testing.assert_allclose(np.asarray(f(mk(0.0)).data), [0, 0])
+
+    def test_dynamic_stop_decode_loop(self):
+        """Greedy-decode pattern: plain Python `while` with a tensor
+        condition, fixed-size buffer + cursor, no manual while_loop."""
+        @paddle.jit.to_static
+        def decode(logits_row, max_len):
+            buf = paddle.to_tensor(np.zeros((8,), np.float32))
+            i = paddle.to_tensor(np.int32(0))
+            cur = logits_row.sum()
+            while (i < max_len) and (cur < 100.0):
+                cur = cur * 2.0 + 1.0
+                buf = paddle.to_tensor(
+                    jnp.asarray(buf.data).at[jnp.asarray(i.data)].set(
+                        jnp.reshape(cur.data, ())))
+                i = i + 1
+            return buf, i
+
+        row = paddle.to_tensor(np.float32([1.0, 2.0]))
+        buf, n = decode(row, paddle.to_tensor(np.int32(8)))
+        # eager reference
+        cur, vals = 3.0, []
+        while len(vals) < 8 and cur < 100.0:
+            cur = cur * 2 + 1
+            vals.append(cur)
+        assert int(n.data) == len(vals)
+        np.testing.assert_allclose(np.asarray(buf.data)[:len(vals)], vals)
+
+    def test_accumulator_loop_carried(self):
+        @paddle.jit.to_static
+        def f(x, n):
+            acc = x * 0.0
+            i = paddle.to_tensor(np.int32(0))
+            while i < n:
+                t = x + 1.0          # body-local temp: NOT loop state
+                acc = acc + t
+                i = i + 1
+            return acc
+
+        x = paddle.to_tensor(np.float32([1.0, 2.0]))
+        out = f(x, paddle.to_tensor(np.int32(3)))
+        np.testing.assert_allclose(np.asarray(out.data), [6.0, 9.0])
+
+    def test_eager_mode_still_python(self):
+        """The converted function keeps plain-Python semantics for
+        concrete values (strings, short-circuit)."""
+        from paddle_tpu.jit.ast_transform import convert_function
+
+        def f(s, flag):
+            if flag:
+                out = s or "default"
+            else:
+                out = "off"
+            return out
+
+        g = convert_function(f)
+        assert g("hi", True) == "hi"
+        assert g("", True) == "default"
+        assert g("hi", False) == "off"
+
+    def test_break_raises_mixed_return_left_python(self):
+        from paddle_tpu.jit.ast_transform import (
+            convert_function, Dy2StaticSyntaxError)
+
+        def has_break(x):
+            while x.sum() < 10:
+                if x.mean() > 0:
+                    break
+                x = x + 1
+            return x
+
+        def mixed_return(x):
+            if x.sum() > 0:
+                return x
+            y = x + 1
+            return y
+
+        with pytest.raises(Dy2StaticSyntaxError, match="break"):
+            convert_function(has_break)
+        # mixed return/fall-through: the if stays plain Python — concrete
+        # preds keep working, traced preds fail loudly at trace time
+        g = convert_function(mixed_return)
+        np.testing.assert_allclose(
+            np.asarray(g(paddle.to_tensor(np.float32([2.0]))).data), [2.0])
+        np.testing.assert_allclose(
+            np.asarray(g(paddle.to_tensor(np.float32([-2.0]))).data), [-1.0])
+
+    def test_branch_read_then_write_and_augassign(self):
+        """`y = y + 1` / `y += 1` inside a converted branch reads the
+        OUTER value (default-parameter capture), both eagerly and traced."""
+        from paddle_tpu.jit.ast_transform import convert_function
+
+        def f(x, flag):
+            y = x + 1.0
+            if flag:
+                y = y + 1.0
+            else:
+                y += 10.0
+            return y
+
+        g = convert_function(f)
+        x = paddle.to_tensor(np.float32([1.0]))
+        np.testing.assert_allclose(np.asarray(g(x, True).data), [3.0])
+        np.testing.assert_allclose(np.asarray(g(x, False).data), [12.0])
+
+        @paddle.jit.to_static
+        def h(x):
+            y = x * 1.0
+            if x.mean() > 0:
+                y = y + 1.0
+            return y
+
+        np.testing.assert_allclose(
+            np.asarray(h(paddle.to_tensor(np.float32([2.0]))).data), [3.0])
+        np.testing.assert_allclose(
+            np.asarray(h(paddle.to_tensor(np.float32([-2.0]))).data), [-2.0])
+
+    def test_closure_binding_preserved(self):
+        from paddle_tpu.jit.ast_transform import convert_function
+
+        def make(k):
+            def f(x, flag):
+                if flag:
+                    r = x + k
+                else:
+                    r = x - k
+                return r
+            return f
+
+        g = convert_function(make(10.0))
+        x = paddle.to_tensor(np.float32([1.0]))
+        np.testing.assert_allclose(np.asarray(g(x, True).data), [11.0])
+        np.testing.assert_allclose(np.asarray(g(x, False).data), [-9.0])
+
+    def test_callable_operand_not_invoked(self):
+        from paddle_tpu.jit.ast_transform import convert_function
+
+        def f(handler):
+            h = handler or (lambda: "default")
+            return h
+
+        calls = []
+
+        def my_handler():
+            calls.append(1)
+            return "called"
+
+        g = convert_function(f)
+        assert g(my_handler) is my_handler
+        assert calls == []  # the or-operand must not be invoked
+        assert g(None)() == "default"
+
+    def test_comprehension_in_while_body(self):
+        from paddle_tpu.jit.ast_transform import convert_function
+
+        def f(x, n):
+            i = paddle.to_tensor(np.int32(0))
+            acc = x * 0.0
+            while i < n:
+                vals = [x * 2.0 for _t in range(2)]
+                acc = acc + vals[0]
+                i = i + 1
+            return acc
+
+        g = convert_function(f)
+        x = paddle.to_tensor(np.float32([1.0]))
+        out = g(x, paddle.to_tensor(np.int32(3)))
+        np.testing.assert_allclose(np.asarray(out.data), [6.0])
+
+    def test_for_loop_with_break_untouched(self):
+        """`for ...: if done: break` (concrete) must survive conversion
+        of the surrounding function unchanged."""
+        from paddle_tpu.jit.ast_transform import convert_function
+
+        def f(x):
+            if x.mean() > 0:
+                y = x * 2.0
+            else:
+                y = x
+            total = 0
+            for i in range(10):
+                if i >= 3:
+                    break
+                total += 1
+            return y, total
+
+        g = convert_function(f)
+        y, total = g(paddle.to_tensor(np.float32([1.0])))
+        np.testing.assert_allclose(np.asarray(y.data), [2.0])
+        assert total == 3
+
+    def test_layer_forward_auto_converted(self):
+        import paddle_tpu.nn as nn
+
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.lin(x)
+                if h.mean() > 0:
+                    out = h * 2.0
+                else:
+                    out = h * 0.5
+                return out
+
+        paddle.seed(0)
+        layer = Gate()
+        layer.eval()
+        x = paddle.to_tensor(np.float32(np.random.RandomState(0)
+                                        .randn(2, 4)))
+        eager = np.asarray(layer._orig_forward(x).data) \
+            if hasattr(layer, "_orig_forward") else None
+        st = paddle.jit.to_static(layer)
+        out = st(x)  # traced (eval mode)
+        ref = np.asarray(st._orig_forward(x).data)
+        np.testing.assert_allclose(np.asarray(out.data), ref, rtol=1e-6)

@@ -125,8 +125,18 @@ def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
 
 
 class Strategy:
+    """auto_mode:
+      "semi" — Completer places params, XLA GSPMD inserts collectives
+               (the default; collectives implicit).
+      "full" — Completer -> Planner (cluster-bandwidth cost rule) ->
+               Partitioner: the loss jaxpr is interpreted on LOCAL
+               shards inside shard_map with EXPLICIT reshard_spec
+               collective chains at every spec conflict
+               (ref: partitioner.py:38 + reshard.py:1007 + cost/)."""
+
     def __init__(self):
         self.auto_mode = "semi"
+        self.cluster = None  # Cluster instance for the planner cost rule
 
 
 class Engine:
@@ -150,6 +160,7 @@ class Engine:
         self._process_mesh = None
         self._input_placements = None
         self.completed_param_specs = None
+        self._completed_all_specs = None
 
     def prepare(self, *args, input_placements=None, process_mesh=None,
                 **kwargs):
@@ -179,6 +190,8 @@ class Engine:
         """Run the Completer over the traced loss and place params
         accordingly (ref: completion.py Completer +
         engine._initialize)."""
+        if self._params is None:
+            self._params = list(self._model.parameters())
         params = self._params
         mesh = self._process_mesh
         seeds = {}
@@ -206,10 +219,96 @@ class Engine:
 
         specs = Completer(mesh.jax_mesh).complete(flat, example, seeds)
         self.completed_param_specs = specs[:n]
+        self._completed_all_specs = list(specs)
+        if self._strategy.auto_mode == "full":
+            # explicit-partitioned path places shards inside shard_map —
+            # keep params replicated host-side
+            return
         for p, spec in zip(params, self.completed_param_specs):
             sharding = NamedSharding(
                 mesh.jax_mesh, P(*spec) if spec is not None else P())
             p.data = jax.device_put(p.data, sharding)
+
+    def _build_full(self, x, y):
+        """Planner+Partitioner path (strategy.auto_mode == "full"): the
+        once-annotated loss program is completed, planned against the
+        cluster bandwidth table, partitioned onto the mesh with explicit
+        reshard chains, and compiled as one shard_map step."""
+        from jax import shard_map
+        from .partitioner import Partitioner, _axes
+
+        if self._process_mesh is None:
+            raise ValueError(
+                "auto_mode='full' needs Engine.prepare(process_mesh=...) "
+                "before fit()")
+        if getattr(self, "_completed_all_specs", None) is None:
+            raise ValueError(
+                "auto_mode='full' needs at least one sharding seed — "
+                "annotate a parameter (param.dist_attr = spec / "
+                "shard_tensor) or pass input_placements to prepare() so "
+                "the Completer has something to propagate")
+        params = self._params
+        n = len(params)
+        mesh = self._process_mesh.jax_mesh
+        lr = self._optimizer.get_lr() if self._optimizer else 1e-3
+        specs = self._completed_all_specs
+        p_specs = [s if s is not None else (None,) * params[i].data.ndim
+                   for i, s in enumerate(specs[:n])]
+        xy_specs = [s for s in specs[n:]]
+        xy_specs = [
+            s if s is not None else (None,) * nd
+            for s, nd in zip(xy_specs, (np.ndim(x), np.ndim(y)))]
+        # mesh axes sharding the INPUTS: a param replicated over such an
+        # axis saw only that rank's batch slice — its grad is partial and
+        # gets psum'd; axes in the param's own spec hold distinct shards
+        input_axes = set()
+        for s in xy_specs:
+            for a in s:
+                if a is not None:
+                    input_axes.update(a if isinstance(a, tuple) else (a,))
+        grad_psum_axes = [
+            tuple(sorted(input_axes - set(_axes(sp)))) for sp in p_specs]
+
+        self.partitioner = Partitioner(mesh, self._strategy.cluster)
+        model, loss_fn = self._model, self._loss
+        saved = [p.data for p in params]
+
+        def flat(*argv):
+            # argv = param arrays..., x, y, rng key (key per STEP — a
+            # baked trace-time key would freeze dropout masks)
+            try:
+                for p, a in zip(params, argv[:n]):
+                    p.data = a
+                with tape.no_grad(), frnd.key_scope(argv[n + 2]):
+                    out = model(Tensor(argv[n]))
+                    return loss_fn(out, Tensor(argv[n + 1])).data
+            finally:
+                for p, s in zip(params, saved):
+                    p.data = s
+
+        example = [p.data for p in params] + [x, y, frnd.next_key()]
+        local_loss = self.partitioner.partition(
+            flat, example, p_specs + xy_specs + [()])
+
+        def step(parrs, xx, yy, key):
+            def loss_of(pa):
+                return local_loss(*pa, xx, yy, key)
+
+            lv, grads = jax.value_and_grad(loss_of)(list(parrs))
+            new = []
+            for a, g, axes in zip(parrs, grads, grad_psum_axes):
+                for ax in axes:
+                    g = jax.lax.psum(g, ax)
+                new.append(a - lr * g)
+            return new, lv
+
+        in_specs = ([P(*s) for s in p_specs],
+                    P(*xy_specs[0]), P(*xy_specs[1]), P())
+        out_specs = ([P(*s) for s in p_specs], P())
+        smapped = shard_map(
+            step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False)
+        return jax.jit(smapped)
 
     def _build(self):
         params = self._params or list(self._model.parameters())
@@ -251,16 +350,21 @@ class Engine:
             if isinstance(train_data, Dataset) else train_data
         params = self._params or list(self._model.parameters())
         first_epoch_iter = None
+        full = self._strategy.auto_mode == "full"
         if self._jitted is None:
+            # peek the first batch for tracing, then CHAIN it back so
+            # one-shot iterators don't silently lose it
+            import itertools
+            it = iter(loader)
+            first = next(it)
+            first_epoch_iter = itertools.chain([first], it)
             if self.completed_param_specs is None:
-                # peek the first batch for tracing, then CHAIN it back so
-                # one-shot iterators don't silently lose it
-                import itertools
-                it = iter(loader)
-                first = next(it)
                 self._complete_and_place(first[0].data, first[1].data)
-                first_epoch_iter = itertools.chain([first], it)
-            self._jitted = self._build()
+            if full:
+                self._jitted = self._build_full(first[0].data,
+                                                first[1].data)
+            else:
+                self._jitted = self._build()
         parrs = [p.data for p in params]
         history = []
         for epoch in range(epochs):
@@ -268,8 +372,12 @@ class Engine:
                           first_epoch_iter is not None else loader)
             for step_i, batch in enumerate(epoch_iter):
                 x, y = batch[0], batch[1]
-                parrs, lv = self._jitted(
-                    parrs, x.data, y.data, frnd.next_key())
+                if full:
+                    parrs, lv = self._jitted(parrs, x.data, y.data,
+                                             frnd.next_key())
+                else:
+                    parrs, lv = self._jitted(
+                        parrs, x.data, y.data, frnd.next_key())
                 if steps_per_epoch and step_i + 1 >= steps_per_epoch:
                     break
             history.append(float(jax.device_get(lv)))

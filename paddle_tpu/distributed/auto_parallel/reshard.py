@@ -19,11 +19,35 @@ decides the primitive:
 when two operands disagree, reshard the one that moves fewer bytes —
 "prefer keeping the larger operand in place".
 """
+import functools
+
 import numpy as np
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 PARTIAL = "__partial__"  # pseudo entry: spec[0] may carry ("partial", axis)
+
+
+@functools.lru_cache(maxsize=None)
+def _psum_untied_fn(axis):
+    """psum whose TRANSPOSE is identity: resolving a partial sum into a
+    replicated value whose downstream consumers are replicated. lax.psum
+    transposes to psum, which double-counts when the caller separately
+    completes parameter grads with an explicit psum (the auto-parallel
+    Partitioner's contract)."""
+    @jax.custom_vjp
+    def f(x):
+        return lax.psum(x, axis)
+
+    def fwd(x):
+        return f(x), None
+
+    def bwd(_, g):
+        return (g,)
+
+    f.defvjp(fwd, bwd)
+    return f
 
 
 class ReshardRecord(list):
@@ -64,10 +88,13 @@ def _axes_of(spec):
     return out
 
 
-def reshard_spec(x, src, dst, partial_axes=(), record=None):
+def reshard_spec(x, src, dst, partial_axes=(), record=None,
+                 untied_grad=False):
     """Convert array `x` (local shard, inside shard_map) from sharding
     `src` to `dst`. specs: tuple(axis-name-or-None per dim). partial_axes:
     mesh axes over which x is a PARTIAL sum (pending reduction).
+    untied_grad: resolve partials with the identity-transpose psum (see
+    _psum_untied_fn — for callers that complete param grads themselves).
     Returns the resharded local array."""
     rec = record if record is not None else ReshardRecord()
     ndim = x.ndim
@@ -92,7 +119,8 @@ def reshard_spec(x, src, dst, partial_axes=(), record=None):
             lst[ddim] = axis if not prev else prev + (axis,)
             src = tuple(lst)
         else:
-            x = lax.psum(x, axis)
+            x = (_psum_untied_fn(axis)(x) if untied_grad
+                 else lax.psum(x, axis))
             rec.op("psum", axis)
 
     # Multi-axis tuple entries (a dim sharded by several mesh axes at

@@ -182,6 +182,24 @@ def _read_before_write(stmts):
     return carried & _assigned_names(stmts)
 
 
+def _undef_guard(nm):
+    """try: nm / except NameError: nm = _jst.Undefined('nm')"""
+    return ast.Try(
+        body=[ast.Expr(value=_name(nm))],
+        handlers=[ast.ExceptHandler(
+            type=ast.Tuple(elts=[_name("NameError"),
+                                 _name("UnboundLocalError")],
+                           ctx=ast.Load()),
+            name=None,
+            body=[ast.Assign(
+                targets=[_name(nm, ast.Store())],
+                value=ast.Call(
+                    func=ast.Attribute(value=_name(_JST_NAME),
+                                       attr="Undefined", ctx=ast.Load()),
+                    args=[ast.Constant(value=nm)], keywords=[]))])],
+        orelse=[], finalbody=[])
+
+
 def _branch_fn(name, stmts, ret_value, capture_defaults):
     """A nested branch/loop function. Names in `capture_defaults` become
     default-valued parameters (`def f(y=y):`) so a branch that both reads
@@ -276,9 +294,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         t_name, f_name = f"__dy2s_true_{uid}", f"__dy2s_false_{uid}"
         ret_tuple = ast.Return(value=ast.Tuple(
             elts=[_name(a) for a in assigned], ctx=ast.Load()))
-        t_fn = _branch_fn(t_name, body, ret_tuple, _read_before_write(body))
-        f_fn = _branch_fn(f_name, orelse, ret_tuple,
-                          _read_before_write(orelse))
+        caps_t = _read_before_write(body)
+        caps_f = _read_before_write(orelse)
+        t_fn = _branch_fn(t_name, body, ret_tuple, caps_t)
+        f_fn = _branch_fn(f_name, orelse, ret_tuple, caps_f)
         call = _call_jst("convert_ifelse",
                          [node.test, _name(t_name), _name(f_name)])
         if assigned:
@@ -289,7 +308,13 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 value=call)
         else:
             assign = ast.Expr(value=call)
-        out = [t_fn, f_fn, assign]
+        # a name assigned in only ONE branch may be unbound here: seed it
+        # with an Undefined sentinel (the reference's UndefinedVar) so the
+        # other branch can still return it; USING the sentinel later
+        # raises a clear UnboundLocalError
+        guards = [_undef_guard(nm)
+                  for nm in sorted(set(assigned) | caps_t | caps_f)]
+        out = guards + [t_fn, f_fn, assign]
         for s in out:
             ast.copy_location(s, node)
             ast.fix_missing_locations(s)

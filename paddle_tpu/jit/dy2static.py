@@ -38,6 +38,36 @@ def _truthy(v):
     return bool(d)
 
 
+class Undefined:
+    """Sentinel for a name not yet assigned when a converted `if` runs
+    (the reference's UndefinedVar). Any use raises a clear error; merely
+    carrying it through the branch machinery is fine."""
+
+    def __init__(self, name):
+        self._name = name
+
+    def _raise(self, *a, **k):
+        raise UnboundLocalError(
+            f"local variable {self._name!r} referenced before assignment "
+            f"(it is only assigned in one branch of a converted `if`)")
+
+    __call__ = __add__ = __radd__ = __sub__ = __mul__ = __truediv__ = \
+        __getattr__ = __getitem__ = __iter__ = __bool__ = _raise
+
+    def __eq__(self, other):
+        return isinstance(other, Undefined) and other._name == self._name
+
+    def __hash__(self):
+        return hash(("__dy2s_undefined__", self._name))
+
+    def __repr__(self):
+        return f"<undefined {self._name}>"
+
+
+def _is_jax_leaf(a):
+    return hasattr(a, "shape") or isinstance(a, (int, float, bool, complex))
+
+
 def _flatten(tree):
     leaves, treedef = jax.tree_util.tree_flatten(
         tree, is_leaf=lambda x: isinstance(x, Tensor))
@@ -68,23 +98,38 @@ def cond(pred, true_fn, false_fn=None, name=None):
 
     # branches run INSIDE lax.cond (traced, not executed eagerly): only
     # the taken branch runs per step, and RNG/side-effect behavior matches
-    # eager single-branch execution
+    # eager single-branch execution. Non-array leaves (strings, Undefined
+    # sentinels, ...) cannot flow through lax.cond — they must be EQUAL
+    # across branches and are carried statically.
     meta = {}
 
     def _thunk(fn, key):
         def run(_):
             arrs, wrapped, treedef = _flatten(fn())
-            meta[key] = (wrapped, treedef)
-            return tuple(arrs)
+            mask = [_is_jax_leaf(a) for a in arrs]
+            static = [a for a, m in zip(arrs, mask) if not m]
+            meta[key] = (wrapped, treedef, mask, static)
+            return tuple(a for a, m in zip(arrs, mask) if m)
         return run
 
-    arrs = lax.cond(jnp.reshape(p, ()), _thunk(true_fn, "t"),
-                    _thunk(false_fn, "f"), 0)
-    if meta["t"][1] != meta["f"][1]:
+    dyn = lax.cond(jnp.reshape(p, ()), _thunk(true_fn, "t"),
+                   _thunk(false_fn, "f"), 0)
+    wrapped, treedef, mask, static_t = meta["t"]
+    _, treedef_f, mask_f, static_f = meta["f"]
+    if treedef != treedef_f or mask != mask_f:
         raise ValueError(
-            f"cond branches returned different structures: {meta['t'][1]} "
-            f"vs {meta['f'][1]}")
-    return _rewrap(list(arrs), *meta["t"])
+            f"cond branches returned different structures: {treedef} "
+            f"vs {treedef_f}")
+    for a, b in zip(static_t, static_f):
+        if not (a == b or a is b):
+            raise ValueError(
+                f"cond branches returned different static (non-tensor) "
+                f"values: {a!r} vs {b!r} — only tensor outputs may differ "
+                f"between compiled branches")
+    dyn = list(dyn)
+    static = list(static_t)
+    arrs = [dyn.pop(0) if m else static.pop(0) for m in mask]
+    return _rewrap(arrs, wrapped, treedef)
 
 
 def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):

@@ -391,3 +391,33 @@ class TestAstAutoConversion:
         out = st(x)  # traced (eval mode)
         ref = np.asarray(st._orig_forward(x).data)
         np.testing.assert_allclose(np.asarray(out.data), ref, rtol=1e-6)
+
+    def test_one_branch_only_assignment(self):
+        """A name assigned in only one branch (valid plain Python when the
+        other path never reads it) keeps working after conversion; using
+        it when undefined raises a clear UnboundLocalError."""
+        from paddle_tpu.jit.ast_transform import convert_function
+
+        def f(x, flag):
+            if flag:
+                extra = x * 2.0
+            y = x + 1.0
+            if flag:
+                return extra
+            return y
+
+        g = convert_function(f)
+        x = paddle.to_tensor(np.float32([3.0]))
+        np.testing.assert_allclose(np.asarray(g(x, True).data), [6.0])
+        np.testing.assert_allclose(np.asarray(g(x, False).data), [4.0])
+
+        def uses_undefined(x, flag):
+            if flag:
+                extra = x * 2.0
+            return extra + 1.0
+
+        h = convert_function(uses_undefined)
+        np.testing.assert_allclose(
+            np.asarray(h(x, True).data), [7.0])
+        with pytest.raises(UnboundLocalError, match="extra"):
+            h(x, False)

@@ -49,6 +49,19 @@ class Config:
         self._memory_optim = True
         self._ir_optim = True
         self._cpu_threads = 1
+        self._batch_buckets = None
+
+    def enable_shape_bucketing(self, batch_buckets=(1, 2, 4, 8, 16)):
+        """Serve varying batch sizes without per-shape recompiles: run()
+        pads dim0 of every input up to the nearest bucket and slices the
+        outputs back — one AOT compile per bucket (ref:
+        analysis_predictor.h dynamic-shape serving; TensorRT profile
+        ranges). Requires a batch-polymorphic artifact (InputSpec with a
+        None batch dim at export) and a row-independent program (standard
+        eval-mode nets: no cross-row reductions)."""
+        self._batch_buckets = tuple(sorted(set(int(b)
+                                               for b in batch_buckets)))
+        return self
 
     # -- device selection ---------------------------------------------------
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
@@ -163,6 +176,14 @@ class Predictor:
                 "supported int8 path on TPU.")
         self._inputs = {n: _IOHandle(n) for n in self._program.input_names}
         self._outputs = {n: _IOHandle(n) for n in self._program.output_names}
+        # which outputs carry the symbolic (polymorphic) batch dim —
+        # drives bucket un-padding
+        try:
+            self._out_batch_dims = [
+                bool(av.shape) and not isinstance(av.shape[0], int)
+                for av in self._program.exported.out_avals]
+        except Exception:
+            self._out_batch_dims = []
 
     def get_input_names(self):
         return list(self._inputs)
@@ -191,7 +212,36 @@ class Predictor:
                 raise ValueError(f"input '{n}' not set; call "
                                  "get_input_handle(name).copy_from_cpu(...)")
             arrays.append(jnp.asarray(h._array))
+        buckets = self._config._batch_buckets
+        n_rows = None
+        tgt = None
+        if buckets:
+            if not self._program.meta.get("polymorphic_batch"):
+                raise ValueError(
+                    "shape bucketing needs a batch-polymorphic artifact: "
+                    "export with InputSpec([None, ...]) so the program "
+                    "accepts any batch (this artifact was exported with "
+                    "concrete shapes)")
+            n_rows = int(arrays[0].shape[0])
+            if any(int(a.shape[0]) != n_rows for a in arrays):
+                raise ValueError("shape bucketing pads dim0: all inputs "
+                                 "must share the batch dim")
+            tgt = next((b for b in buckets if b >= n_rows), None)
+            if tgt is None:
+                raise ValueError(
+                    f"batch {n_rows} exceeds the largest bucket "
+                    f"{max(buckets)}; raise enable_shape_bucketing()")
+            if tgt != n_rows:
+                arrays = [jnp.concatenate(
+                    [a, jnp.zeros((tgt - n_rows,) + a.shape[1:], a.dtype)])
+                    for a in arrays]
         outs = self._program(*arrays)
+        if tgt is not None and tgt != n_rows:
+            # un-pad exactly the outputs that CARRY the symbolic batch dim
+            # (from the export avals) — a fixed-size output whose leading
+            # dim merely equals the bucket is left alone
+            outs = [o[:n_rows] if carries else o
+                    for o, carries in zip(outs, self._out_batch_dims)]
         for n, o in zip(self._program.output_names, outs):
             self._outputs[n]._array = o
         if inputs is not None:

@@ -111,7 +111,7 @@ class LLMEngine:
     """
 
     def __init__(self, model, max_len=1024, page_size=128, max_batch=8,
-                 quant=None, use_pallas=None):
+                 quant=None, use_pallas=None, batch_buckets=None):
         assert isinstance(model, LlamaForCausalLM), "LLaMA family only"
         if quant not in (None, "int8"):
             raise ValueError(f"unsupported quant {quant!r}")
@@ -144,6 +144,15 @@ class LLMEngine:
         self.allocator = PageAllocator(self.n_pages)
         self._step_fn = None
         self._prefill_fns = {}
+        # batch buckets (OPT-IN): generate() pads the request batch up to
+        # the nearest bucket so varying batch sizes reuse a handful of
+        # compiled prefill/step programs instead of one per size. Off by
+        # default: padding changes the shape jax.random draws over, so
+        # sampled generations would differ from the unpadded run for the
+        # same seed (greedy decoding is batch-size invariant).
+        self._batch_buckets = (tuple(sorted(set(
+            min(int(x), max_batch) for x in batch_buckets)))
+            if batch_buckets is not None else None)
         cos, sin = _rope_cache(max_len, self.hd, cfg.rope_theta, jnp.float32)
         self.rope = (cos, sin)
 
@@ -281,9 +290,18 @@ class LLMEngine:
         from ..models.generation import _sample
         ids = np.asarray(input_ids.numpy() if isinstance(input_ids, Tensor)
                          else input_ids)
-        b, t0 = ids.shape
-        assert b <= self.max_batch
+        b_real, t0 = ids.shape
+        assert b_real <= self.max_batch
         assert t0 + max_new_tokens <= self.max_len
+        # pad the batch up to the nearest bucket (compile reuse); padded
+        # rows replay row 0 and are dropped before returning
+        b = b_real
+        if self._batch_buckets:
+            b = next((x for x in self._batch_buckets if x >= b_real),
+                     self.max_batch)
+            if b != b_real:
+                ids = np.concatenate(
+                    [ids, np.repeat(ids[:1], b - b_real, axis=0)], axis=0)
 
         # allocate pages for each sequence (padded-prefill garbage slots
         # included, so allocate through the padded length)
@@ -324,7 +342,7 @@ class LLMEngine:
                 lens = lens + 1
                 out.append(np.asarray(tok)[:, None])
                 if eos_token_id is not None and np.all(
-                        out[-1] == eos_token_id):
+                        out[-1][:b_real] == eos_token_id):
                     break
             ok = True
         finally:
@@ -335,4 +353,4 @@ class LLMEngine:
             else:
                 # donated buffers may be gone mid-flight: rebuild the pool
                 self._reset_kv()
-        return np.concatenate([ids] + out, axis=1)
+        return np.concatenate([ids] + out, axis=1)[:b_real]

@@ -199,7 +199,8 @@ def save(layer, path, input_spec=None, **configs):
                              name=type(layer).__name__
                              if isinstance(layer, Layer) else "function",
                              ir_optim=configs.get("ir_optim", True),
-                             precision=configs.get("precision"))
+                             precision=configs.get("precision"),
+                             target=configs.get("target"))
     program.save(path)
     if isinstance(layer, Layer):
         _save(layer.state_dict(), path + ".pdparams")

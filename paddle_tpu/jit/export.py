@@ -192,7 +192,7 @@ def _spec_to_aval(spec, sym_prefix):
 
 
 def export_program(fn_or_layer, input_spec, name="forward", ir_optim=True,
-                   precision=None):
+                   precision=None, target=None):
     """Trace + export to a weight-separated StableHLO ExportedProgram.
 
     `input_spec`: list of InputSpec (None dims → symbolic batch) or example
@@ -232,7 +232,8 @@ def export_program(fn_or_layer, input_spec, name="forward", ir_optim=True,
         fn_or_layer.eval()
     try:
         return _export_eval(fn_or_layer, fn, specs, examples, name,
-                            ir_optim=ir_optim, precision=precision)
+                            ir_optim=ir_optim, precision=precision,
+                            target=target)
     finally:
         if was_training:
             fn_or_layer.train()
@@ -300,9 +301,22 @@ def _analysis_pipeline(pure, cap_arrays, examples, ir_optim, precision):
 
 
 def _export_eval(fn_or_layer, fn, specs, examples, name, ir_optim=True,
-                 precision=None):
+                 precision=None, target=None):
     from . import _capture_run, _swapped_data
     from ..nn import Layer
+    import contextlib
+
+    # kernel-swap pass (target="tpu"): re-dispatch registry ops to their
+    # Pallas implementations during trace/lowering — sdpa subgraphs become
+    # flash-attention custom calls in the saved artifact, compiled cross-
+    # platform from this host (ref: framework/ir/
+    # trt_flash_multihead_matmul_fuse_pass.cc kernel-substitution tier)
+    swap_log = []
+    if target == "tpu":
+        from ..ops import force_backend
+        swap_ctx = lambda: force_backend("pallas", swap_log)  # noqa: E731
+    else:
+        swap_ctx = contextlib.nullcontext
 
     # Pass 1: eager capture run — discover touched Tensors + out structure.
     in_tensors = [Tensor(a) for a in examples]
@@ -326,7 +340,8 @@ def _export_eval(fn_or_layer, fn, specs, examples, name, ir_optim=True,
 
     def pure(cap_arrays, *input_arrays):
         with _swapped_data(captured, cap_arrays), \
-                tape.no_grad(), rnd.key_scope(jax.random.key(0)):
+                tape.no_grad(), rnd.key_scope(jax.random.key(0)), \
+                swap_ctx():
             o = fn(*[Tensor(a) for a in input_arrays])
             return tuple(_flatten_struct(o, []))
 
@@ -352,10 +367,14 @@ def _export_eval(fn_or_layer, fn, specs, examples, name, ir_optim=True,
     # symbolic dims can be rejected by ops with static blocking — degrade
     # through (portable, symbolic) → (current, symbolic) → (current, concrete).
     concrete = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in examples]
-    attempts = [(in_avals, ["cpu", "tpu"], any_sym),
-                (concrete, ["cpu", "tpu"], False),
-                (in_avals, None, any_sym),
-                (concrete, None, False)]
+    if target == "tpu":
+        attempts = [(in_avals, ["tpu"], any_sym),
+                    (concrete, ["tpu"], False)]
+    else:
+        attempts = [(in_avals, ["cpu", "tpu"], any_sym),
+                    (concrete, ["cpu", "tpu"], False),
+                    (in_avals, None, any_sym),
+                    (concrete, None, False)]
     last_err = None
     for avals, platforms, poly in attempts:
         try:
@@ -365,6 +384,10 @@ def _export_eval(fn_or_layer, fn, specs, examples, name, ir_optim=True,
             last_err = e
     else:
         raise last_err
+
+    if target == "tpu":
+        swapped = ",".join(sorted(set(swap_log))) if swap_log else "none"
+        passes_applied = passes_applied + [f"kernel_swap_pallas:{swapped}"]
 
     meta = {
         "name": name,

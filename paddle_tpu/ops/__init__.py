@@ -11,6 +11,8 @@ is a pure jax function; when autograd is live we capture its vjp via
 registry lets named ops be overridden per backend (e.g. a Pallas kernel on
 TPU replacing the XLA-lowered default).
 """
+import contextlib
+
 import jax
 import jax.numpy as jnp
 
@@ -36,12 +38,36 @@ def enable_pallas(flag=True):
     _pallas_enabled[0] = bool(flag)
 
 
+_backend_force = [None]  # None | ("pallas", swap_log_list)
+
+
+@contextlib.contextmanager
+def force_backend(backend, swapped_log=None):
+    """Override platform-based kernel selection (the export-time
+    kernel-swap pass targets TPU artifacts from a CPU host — ref:
+    framework/ir/*_fuse_pass kernel substitution tier). Records each op
+    that actually swapped into `swapped_log`."""
+    prev = _backend_force[0]
+    _backend_force[0] = (backend, swapped_log)
+    try:
+        yield
+    finally:
+        _backend_force[0] = prev
+
+
 def select_kernel(name):
     """Analog of KernelFactory::SelectKernelOrThrowError
     (ref: phi/core/kernel_factory.h:324)."""
     impls = _KERNELS.get(name)
     if impls is None:
         raise KeyError(f"No kernel registered for op '{name}'")
+    if _backend_force[0] is not None:
+        backend, log = _backend_force[0]
+        if backend in impls:
+            if log is not None and backend != "xla":
+                log.append(name)
+            return impls[backend]
+        return impls["xla"]
     if (
         _pallas_enabled[0]
         and "pallas" in impls

@@ -1,6 +1,7 @@
 """Export-time analysis pass pipeline (L7 gap; ref:
 inference/analysis/analysis_passes + AnalysisConfig mixed precision)."""
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 import paddle_tpu as paddle
@@ -61,3 +62,104 @@ def test_predictor_accepts_bf16_artifact(tmp_path):
     pred = inference.create_predictor(cfg)  # must not raise
     out = pred.run([np.zeros((1, 4), np.float32)])
     assert np.isfinite(out[0]).all()
+
+
+# --- inference tier 2 (VERDICT r3 next #6): bucketed dynamic shapes +
+#     export-time kernel-swap pass ----------------------------------------
+
+def test_predictor_shape_bucketing(tmp_path):
+    """Varying batch sizes ride a handful of bucket compiles: pad to
+    bucket, slice back, outputs exact, compile cache bounded by the
+    bucket count."""
+    import paddle_tpu.inference as infer
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    net.eval()
+    prefix = str(tmp_path / "bucketed")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([None, 4], "float32")])
+    cfg = infer.Config(prefix)
+    cfg.enable_shape_bucketing((2, 4))
+    pred = infer.create_predictor(cfg)
+    rng = np.random.RandomState(0)
+    for b in (1, 2, 3, 4):
+        x = rng.randn(b, 4).astype(np.float32)
+        (out,) = pred.run([x])
+        ref = np.asarray(net(paddle.to_tensor(x)).data)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        assert out.shape == (b, 3)
+    # one compile per bucket, not per batch size
+    assert pred._program._jitted._cache_size() <= 2
+
+    with pytest.raises(ValueError, match="bucket"):
+        pred.run([rng.randn(5, 4).astype(np.float32)])
+
+
+def test_predictor_bucketing_requires_polymorphic(tmp_path):
+    import paddle_tpu.inference as infer
+    paddle.seed(0)
+    net = nn.Linear(4, 3)
+    net.eval()
+    prefix = str(tmp_path / "concrete")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([2, 4], "float32")])
+    cfg = infer.Config(prefix)
+    cfg.enable_shape_bucketing((2, 4))
+    pred = infer.create_predictor(cfg)
+    with pytest.raises(ValueError, match="polymorphic"):
+        pred.run([np.zeros((2, 4), np.float32)])
+
+
+def test_kernel_swap_pass_produces_tpu_flash_artifact(tmp_path):
+    """export(target='tpu') re-dispatches sdpa to the Pallas flash kernel:
+    the saved StableHLO carries the Mosaic custom call and the pass is
+    recorded in the artifact meta (ref:
+    framework/ir/trt_flash_multihead_matmul_fuse_pass.cc)."""
+    import paddle_tpu.nn.functional as F
+
+    class TinyAttn(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.qkv = nn.Linear(32, 3 * 32, bias_attr=False)
+
+        def forward(self, x):
+            b, s = x.shape[0], x.shape[1]
+            qkv = self.qkv(x)
+            q, k, v = paddle.split(qkv, 3, axis=-1)
+            rs = lambda t: paddle.reshape(t, [b, s, 2, 16])
+            out = F.scaled_dot_product_attention(
+                rs(q), rs(k), rs(v), is_causal=True)
+            return paddle.reshape(out, [b, s, 32])
+
+    paddle.seed(0)
+    net = TinyAttn()
+    net.eval()
+    prog = export_program(net, [InputSpec([2, 128, 32], "float32")],
+                          target="tpu")
+    swap = [p for p in prog.meta["passes"]
+            if p.startswith("kernel_swap_pallas")]
+    assert swap and "sdpa" in swap[0], prog.meta["passes"]
+    assert prog.meta["platforms"] == ["tpu"], prog.meta["platforms"]
+    txt = prog.exported.mlir_module()
+    assert "tpu_custom_call" in txt or "mosaic" in txt.lower()
+
+
+def test_llm_engine_batch_bucketing():
+    """generate() pads the request batch to the nearest bucket; padded
+    rows are dropped and results equal the unbucketed run."""
+    from paddle_tpu.inference.serving import LLMEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(max_position_embeddings=256)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (3, 8)).astype(np.int64)
+
+    bucketed = LLMEngine(model, max_len=64, page_size=16, max_batch=4,
+                         batch_buckets=(1, 2, 4))  # 3 pads to 4
+    out = bucketed.generate(ids, max_new_tokens=4)
+    assert out.shape == (3, 12)
+
+    exact = LLMEngine(model, max_len=64, page_size=16, max_batch=4)
+    out2 = exact.generate(ids, max_new_tokens=4)
+    np.testing.assert_array_equal(out, out2)

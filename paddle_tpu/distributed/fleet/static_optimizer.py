@@ -45,12 +45,28 @@ class StaticDistributedOptimizer:
                 if deg > 1 and (sd == 1 or axis == "data"):
                     new_pass("data_parallel_gradient_sync",
                              axis=axis).apply(prog)
+        sc = getattr(self.strategy, "sharding_configs", {}) or {}
         if sd > 1:
-            stage = int(hc.get("sharding_stage", 2))
+            stage = hc.get("sharding_stage")
+            if stage is None and getattr(self.strategy, "sharding", False):
+                stage = sc.get("stage")  # user-enabled sharding_configs
+            stage = int(stage or 2)
             new_pass("zero_sharding", axis="sharding",
                      stage=stage).apply(prog)
+        # k-step gradient accumulation (ref: sharding_optimizer grad-merge)
+        if getattr(self.strategy, "gradient_merge", False):
+            gm = getattr(self.strategy, "gradient_merge_configs", {}) or {}
+            new_pass("gradient_merge", k_steps=int(gm.get("k_steps", 1)),
+                     avg=bool(gm.get("avg", True))).apply(prog)
+        # host-parked optimizer state (ref: sharding offload). Same gate
+        # as the stage knob: sharding_configs take effect only with
+        # strategy.sharding = True (the reference's activation contract).
+        if getattr(self.strategy, "sharding", False) and sc.get("offload"):
+            new_pass("optimizer_state_offload").apply(prog)
         prog._train = {"optimizer": self.inner, "shard_degree": sd,
-                       "dp_degree": dp}
+                       "dp_degree": dp,
+                       "offload": bool(getattr(prog, "_offload_opt_state",
+                                               False))}
         return [], list(prog._params_marked)
 
 
@@ -70,11 +86,29 @@ def run_train_step(exe, prog, feed, fetch_ids, fetch_slots):
 
     key = (id(prog), prog._version, tuple(fetch_ids))
     cache = exe._cache.setdefault("__train__", {})
+    stage3 = (sd > 1 and prog._shard_spec is not None
+              and prog._shard_spec["stage"] == 3)
+    param_ids = {id(p) for p, _ in prog._params_marked}
+
+    def _gather_leaves(leaf_ids):
+        """Step inputs per leaf. Under stage 3 the per-rank CHUNKS own the
+        parameters (gathered on use inside the step), so param positions
+        feed a tiny dummy instead of the full replicated array — external
+        writes into prog.vars between steps are not observed."""
+        out = []
+        for vid in leaf_ids:
+            t = prog.vars[vid].tensor
+            if stage3 and vid in param_ids:
+                out.append(jnp.zeros((1,), t.data.dtype))
+            else:
+                out.append(t.data)
+        return out
+
     if key not in cache:
         step, init_state, chunked = build_train_callable(
             prog, opt, fetch_ids, shard_degree=sd)
         leaf_ids = prog.leaf_ids()
-        leaves = [prog.vars[vid].tensor.data for vid in leaf_ids]
+        leaves = _gather_leaves(leaf_ids)
         states = init_state()
         t0 = jnp.asarray(1, jnp.int32)
         if dist:
@@ -95,7 +129,10 @@ def run_train_step(exe, prog, feed, fetch_ids, fetch_slots):
 
             feed_spec = P(batch_axes if batch_axes else None)
             st_spec = P("sharding") if chunked else P()
-            st_specs = [{k: st_spec for k in s} for s in states]
+            # grad-merge accumulators hold data-SYNCED (replicated) grads
+            # — they stay P() even when the optimizer state is chunked
+            st_specs = [{k: (P() if k == "__gm_acc" else st_spec)
+                         for k in s} for s in states]
             fn = shard_map(
                 wrapped, mesh=mesh,
                 in_specs=([feed_spec] * len(prog.feed_order),
@@ -110,11 +147,17 @@ def run_train_step(exe, prog, feed, fetch_ids, fetch_slots):
     ent = cache[key]
 
     leaf_ids = ent["leaf_ids"]
-    leaves = [prog.vars[vid].tensor.data for vid in leaf_ids]
+    leaves = _gather_leaves(leaf_ids)
     feeds = [jnp.asarray(feed[prog.vars[vid].name])
              for vid in prog.feed_order]
     fetches, new_leaves, new_states, new_t = ent["fn"](
         feeds, leaves, ent["states"], ent["t"])
+    if info.get("offload"):
+        # park the optimizer state on the HOST between steps (ref:
+        # sharding_optimizer OffloadHelper): device HBM holds it only
+        # while the step runs; the next call re-places it
+        new_states = jax.tree_util.tree_map(
+            lambda a: np.asarray(jax.device_get(a)), new_states)
     ent["states"] = new_states
     ent["t"] = new_t
     # write updated params back into the recorded tensors (the static

@@ -183,7 +183,8 @@ class Predictor:
                 bool(av.shape) and not isinstance(av.shape[0], int)
                 for av in self._program.exported.out_avals]
         except Exception:
-            self._out_batch_dims = []
+            self._out_batch_dims = None  # un-padding unavailable: raise
+            # loudly at run() rather than zip-truncating outputs
 
     def get_input_names(self):
         return list(self._inputs)
@@ -240,6 +241,12 @@ class Predictor:
             # un-pad exactly the outputs that CARRY the symbolic batch dim
             # (from the export avals) — a fixed-size output whose leading
             # dim merely equals the bucket is left alone
+            if self._out_batch_dims is None or \
+                    len(self._out_batch_dims) != len(outs):
+                raise RuntimeError(
+                    "shape bucketing cannot un-pad: the artifact's output "
+                    "avals were unavailable at load; re-export the model "
+                    "or run with exact bucket-sized batches")
             outs = [o[:n_rows] if carries else o
                     for o, carries in zip(outs, self._out_batch_dims)]
         for n, o in zip(self._program.output_names, outs):

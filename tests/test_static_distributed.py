@@ -113,3 +113,154 @@ def test_sharding2_stage1_matches_eager():
          "opt : sharded over 'sharding' (stage 1)"])
     np.testing.assert_allclose(got, ref, rtol=2e-5,
                                err_msg=f"static zero1 {got} vs eager {ref}")
+
+
+# --- VERDICT r3 next #7: offload + gradient-merge + stage 3 --------------
+
+def _eager_reference_update_every(k):
+    """Eager Momentum trajectory where the optimizer applies the k-step
+    grad MEAN only at boundaries (same data every ministep, so the mean
+    equals the per-step grad and params freeze between boundaries)."""
+    X, Y = _data()
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = optimizer.Momentum(LR, momentum=0.9, parameters=net.parameters())
+    losses = []
+    for t in range(1, STEPS + 1):
+        out = net(paddle.to_tensor(X))
+        loss = paddle.mean((out - paddle.to_tensor(Y)) ** 2)
+        losses.append(float(loss))
+        if t % k == 0:
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+    return losses
+
+
+def test_gradient_merge_k2_matches_eager():
+    ref = _eager_reference_update_every(2)
+    strategy_extra = {"gradient_merge": True,
+                      "gradient_merge_configs": {"k_steps": 2, "avg": True}}
+    got = _static_dist_extra(
+        {"data": 2, "pipe": 1, "sharding": 1, "model": 1},
+        {"dp_degree": 2, "mp_degree": 1, "pp_degree": 1,
+         "sharding_degree": 1},
+        ["c_allreduce_avg(axis=data)", "gradient_merge(k=2)"],
+        strategy_extra)
+    np.testing.assert_allclose(got, ref, rtol=2e-5,
+                               err_msg=f"grad-merge {got} vs eager {ref}")
+
+
+def test_gradient_merge_with_sharding_matches_eager():
+    ref = _eager_reference_update_every(2)
+    strategy_extra = {"gradient_merge": True,
+                      "gradient_merge_configs": {"k_steps": 2, "avg": True}}
+    got = _static_dist_extra(
+        {"data": 2, "pipe": 1, "sharding": 2, "model": 1},
+        {"dp_degree": 2, "mp_degree": 1, "pp_degree": 1,
+         "sharding_degree": 2},
+        ["gradient_merge(k=2)", "c_reducescatter(axis=sharding)"],
+        strategy_extra)
+    np.testing.assert_allclose(got, ref, rtol=2e-5,
+                               err_msg=f"gm x zero2 {got} vs eager {ref}")
+
+
+def test_stage3_param_chunks_match_eager():
+    ref = _eager_reference()
+    got = _static_dist_extra(
+        {"data": 1, "pipe": 1, "sharding": 2, "model": 1},
+        {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+         "sharding_degree": 2, "sharding_stage": 3},
+        ["c_reducescatter(axis=sharding)",
+         "param_chunk_gather_on_use(axis=sharding)", "stage 3"],
+        {})
+    np.testing.assert_allclose(got, ref, rtol=2e-5,
+                               err_msg=f"static stage3 {got} vs eager {ref}")
+
+
+def test_offload_matches_eager_and_parks_state_on_host():
+    ref = _eager_reference()
+    X, Y = _data()
+    mesh = build_mesh({"data": 1, "pipe": 1, "sharding": 2, "model": 1})
+    set_global_mesh(mesh)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 2}
+    strategy.sharding = True  # sharding_configs activation contract
+    strategy.sharding_configs = {"sharding_degree": 2, "stage": 2,
+                                 "offload": True, "accumulate_steps": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    prog, net, loss = _build_program()
+    opt = optimizer.Momentum(LR, momentum=0.9,
+                             parameters=prog.all_parameters())
+    with static.program_guard(prog):
+        fleet.distributed_optimizer(opt, strategy).minimize(loss,
+                                                            program=prog)
+    assert "optimizer_state_offload" in str(prog)
+    exe = static.Executor()
+    losses = []
+    for _ in range(STEPS):
+        (lv,) = exe.run(prog, feed={"x": X, "y": Y}, fetch_list=[loss])
+        losses.append(float(lv))
+    np.testing.assert_allclose(losses, ref, rtol=2e-5)
+    # state parked on the host between steps
+    ent = next(iter(exe._cache["__train__"].values()))
+    host_leaves = [v for st in ent["states"] for v in st.values()]
+    assert host_leaves and all(isinstance(v, np.ndarray)
+                               for v in host_leaves)
+
+
+def _static_dist_extra(axes, hybrid, expect_pipeline, strategy_extra):
+    X, Y = _data()
+    mesh = build_mesh(axes)
+    set_global_mesh(mesh)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = hybrid
+    for k, v in strategy_extra.items():
+        setattr(strategy, k, v)
+    fleet.init(is_collective=True, strategy=strategy)
+    prog, net, loss = _build_program()
+    opt = optimizer.Momentum(LR, momentum=0.9,
+                             parameters=prog.all_parameters())
+    with static.program_guard(prog):
+        fleet.distributed_optimizer(opt, strategy).minimize(loss,
+                                                            program=prog)
+    text = str(prog)
+    for frag in expect_pipeline:
+        assert frag in text, f"{frag!r} not in program:\n{text}"
+    exe = static.Executor()
+    losses = []
+    for _ in range(STEPS):
+        (lv,) = exe.run(prog, feed={"x": X, "y": Y}, fetch_list=[loss])
+        losses.append(float(lv))
+    return losses
+
+
+def test_gradient_merge_offload_sharding_compose():
+    """The review scenario: grad-merge accumulator must survive host
+    offload under sharding (it is fully synced, hence truly replicated)."""
+    ref = _eager_reference_update_every(2)
+    X, Y = _data()
+    mesh = build_mesh({"data": 2, "pipe": 1, "sharding": 2, "model": 1})
+    set_global_mesh(mesh)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 2}
+    strategy.sharding = True
+    strategy.sharding_configs = {"stage": 2, "offload": True}
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    fleet.init(is_collective=True, strategy=strategy)
+    prog, net, loss = _build_program()
+    opt = optimizer.Momentum(LR, momentum=0.9,
+                             parameters=prog.all_parameters())
+    with static.program_guard(prog):
+        fleet.distributed_optimizer(opt, strategy).minimize(loss,
+                                                            program=prog)
+    exe = static.Executor()
+    losses = []
+    for _ in range(STEPS):
+        (lv,) = exe.run(prog, feed={"x": X, "y": Y}, fetch_list=[loss])
+        losses.append(float(lv))
+    np.testing.assert_allclose(losses, ref, rtol=2e-5,
+                               err_msg=f"gm+offload+zero2 {losses} vs {ref}")

@@ -134,12 +134,23 @@ def _raw(t):
 # the jax.distributed runtime); object verbs and true p2p ride the world
 # TCPStore from init_parallel_env (ref: process_group_gloo.h:33 supports
 # the full verb set cross-process on CPU — this is the TPU-runtime analog).
-_eager_seq = [0]
+_eager_seq = {}
 
 
-def _next_seq():
-    _eager_seq[0] += 1
-    return _eager_seq[0]
+def _group_key(group):
+    """Stable store-key prefix per group ('world' or the member ranks)."""
+    if group is None:
+        return "world"
+    return "g" + "_".join(str(r) for r in group.ranks)
+
+
+def _next_seq(group=None):
+    """Per-GROUP generation counter: members of a group advance their
+    counter together (independently of other groups/world), so store keys
+    pair correctly even when different subsets run different verbs."""
+    k = _group_key(group)
+    _eager_seq[k] = _eager_seq.get(k, 0) + 1
+    return _eager_seq[k]
 
 
 def _world_store_or_raise(verb):
@@ -168,21 +179,50 @@ def _my_group_rank(group):
 def _process_gather(arr, group):
     """[n_group, ...] stack of every group rank's arr (eager path).
 
-    Backed by multihost_utils.process_allgather — a WORLD collective: a
-    subgroup call would deadlock (non-members never enter), so it is
-    rejected loudly. ref gloo groups carve real sub-communicators; the
-    eager TPU-runtime tier supports the world group only."""
+    World group: multihost_utils.process_allgather (jax.distributed).
+    SUBGROUPS: a store-backed gather among the members only — each member
+    publishes under a group-scoped generation key and reads the others;
+    non-members never enter, so nothing hangs (the analog of the
+    reference's gloo sub-communicators, carried by the TCPStore)."""
     from .parallel_env import get_world_size
     ranks = _group_ranks(group)
     if group is not None and len(ranks) != get_world_size():
-        raise NotImplementedError(
-            f"eager cross-process collectives support the world group "
-            f"only (got subgroup {ranks} of world {get_world_size()}): "
-            f"a subgroup call over the world-level runtime would hang "
-            f"the non-members. Run inside a compiled shard_map region "
-            f"(axis-named groups) for subgroup collectives.")
+        return _subgroup_gather(np.asarray(arr), group)
     from jax.experimental import multihost_utils
     return multihost_utils.process_allgather(np.asarray(arr))
+
+
+def _require_member(verb, group):
+    me = get_rank()
+    if group is not None and me not in list(group.ranks):
+        raise ValueError(
+            f"paddle.distributed.{verb}: rank {me} is not a member of "
+            f"group {list(group.ranks)} — collectives must only be "
+            f"called by group members")
+
+
+def _subgroup_gather(arr, group):
+    """Store-backed allgather among a subgroup's members (same-shape
+    arrays). Returns [n_group, ...] stacked in group-rank order."""
+    import pickle
+    _require_member("subgroup collective", group)
+    _require_initialized_multiproc("subgroup collective")
+    st = _world_store_or_raise("subgroup collective")
+    ranks = _group_ranks(group)
+    gkey = _group_key(group)
+    gen = _next_seq(group)
+    me = get_rank()
+    st.set(f"sgc/{gkey}/{gen}/{me}", pickle.dumps(np.asarray(arr)))
+    out = []
+    for r in ranks:
+        raw = st.get(f"sgc/{gkey}/{gen}/{r}", wait=True, timeout_ms=120000)
+        out.append(pickle.loads(raw))
+    # last reader sweeps this generation's keys
+    if st.add(f"sgc/{gkey}/{gen}/done", 1) == len(ranks):
+        for r in ranks:
+            st.delete_key(f"sgc/{gkey}/{gen}/{r}")
+        st.delete_key(f"sgc/{gkey}/{gen}/done")
+    return np.stack(out)
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -200,10 +240,10 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         return tensor
     if _group_size(group) == 1:
         return tensor
-    # Eager cross-process path (multi-controller): host-level allreduce.
+    # Eager cross-process path (multi-controller): host-level allreduce
+    # (_process_gather routes subgroups through the store transport).
     _require_initialized_multiproc("all_reduce")
-    from jax.experimental import multihost_utils
-    summed = multihost_utils.process_allgather(_raw(tensor))
+    summed = _process_gather(_raw(tensor), group)
     red = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max, ReduceOp.MIN: jnp.min,
            ReduceOp.AVG: jnp.mean, ReduceOp.PROD: jnp.prod}[op]
     tensor.data = red(summed, axis=0).astype(tensor.data.dtype)
@@ -225,10 +265,9 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         tensor_list.append(tensor)
         return tensor_list
     _require_initialized_multiproc("all_gather")
-    from jax.experimental import multihost_utils
-    stacked = multihost_utils.process_allgather(_raw(tensor))
+    stacked = _process_gather(_raw(tensor), group)
     for i in range(stacked.shape[0]):
-        tensor_list.append(Tensor(stacked[i]))
+        tensor_list.append(Tensor(jnp.asarray(stacked[i])))
     return tensor_list
 
 
@@ -315,20 +354,27 @@ def all_to_all_single(output, input, out_split_sizes=None, in_split_sizes=None,
     n = _group_size(group)
     my = _my_group_rank(group)
     if in_split_sizes:
-        # slicing source s's buffer by MY offsets is only correct when
-        # every rank declares the SAME split table — verify that
+        # HETEROGENEOUS split tables supported: every rank publishes its
+        # own table; source s's buffer is cut by s's offsets and this
+        # rank takes chunk `my` of each. Ragged buffer lengths are padded
+        # to the global max before the host gather, then sliced exactly.
         splits = np.asarray(in_split_sizes, np.int64)
-        all_splits = _process_gather(splits, group)
-        if not np.all(all_splits == splits[None]):
-            raise NotImplementedError(
-                "eager cross-process all_to_all_single requires identical "
-                "in_split_sizes on every rank (heterogeneous splits need "
-                "the compiled lax.all_to_all path)")
-    allin = _process_gather(_raw(input), group)  # [n, rows, ...]
-    if in_split_sizes:
-        starts = np.concatenate([[0], np.cumsum(in_split_sizes)])
-        parts = [allin[s][starts[my]:starts[my + 1]] for s in range(n)]
+        all_splits = _process_gather(splits, group)  # [n, n]
+        arr = np.asarray(_raw(input))
+        # each source's row count is its split table's sum — no extra
+        # synchronization round for the buffer lengths
+        max_rows = int(np.asarray(all_splits).sum(axis=1).max())
+        if arr.shape[0] < max_rows:
+            pad = np.zeros((max_rows - arr.shape[0],) + arr.shape[1:],
+                           arr.dtype)
+            arr = np.concatenate([arr, pad], axis=0)
+        allin = _process_gather(arr, group)  # [n, max_rows, ...]
+        parts = []
+        for s in range(n):
+            starts = np.concatenate([[0], np.cumsum(all_splits[s])])
+            parts.append(allin[s][starts[my]:starts[my + 1]])
     else:
+        allin = _process_gather(_raw(input), group)  # [n, rows, ...]
         rows = allin.shape[1] // n
         parts = [allin[s][my * rows:(my + 1) * rows] for s in range(n)]
     got = np.concatenate(parts, axis=0)
@@ -371,7 +417,10 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    # In SPMD, reduce == allreduce (every shard computes it; dst is moot).
+    """ref: communication/reduce.py. COST NOTE: in SPMD one program runs
+    on every shard, so a dst-only reduction has no cheaper lowering —
+    reduce pays the full allreduce (XLA would emit the same collective);
+    `dst` only affects which rank's copy callers consider canonical."""
     return all_reduce(tensor, op, group, sync_op)
 
 
@@ -584,26 +633,17 @@ def batch_isend_irecv(p2p_op_list):
 # object collectives -------------------------------------------------------
 def _object_entry(verb, group):
     """Common preamble for every object collective: bump the per-process
-    generation counter unconditionally — BEFORE any early return — so the
-    counters stay in lockstep across processes even when ranks take
-    different call styles (ADVICE r3: a non-src rank early-returning
-    without the bump pairs later collectives with the wrong store keys)."""
-    del verb, group
-    return _next_seq()
+    PER-GROUP generation counter unconditionally — BEFORE any early
+    return — so the counters stay in lockstep across the group's members
+    even when ranks take different call styles (ADVICE r3: a non-src rank
+    early-returning without the bump pairs later collectives with the
+    wrong store keys). Subgroups are fully supported: keys are scoped by
+    group, so only members participate."""
+    del verb
+    return _next_seq(group)
 
 
-def _require_world_object_group(verb, group):
-    """Store-backed object-collective paths are world-only, the same way
-    _process_gather is (a subgroup call over the world store would pair
-    keys with non-members / hang them). Purely-local paths (size-1 groups,
-    the single-controller scatter convenience) keep accepting groups."""
-    from .parallel_env import get_world_size
-    ranks = _group_ranks(group)
-    if group is not None and len(ranks) != get_world_size():
-        raise NotImplementedError(
-            f"paddle.distributed.{verb}: eager cross-process object "
-            f"collectives support the world group only (got subgroup "
-            f"{ranks} of world {get_world_size()}).")
+
 
 
 def all_gather_object(object_list, obj, group=None):
@@ -615,19 +655,20 @@ def all_gather_object(object_list, obj, group=None):
         object_list.append(obj)
         return object_list
     _require_initialized_multiproc("all_gather_object")
-    _require_world_object_group("all_gather_object", group)
+    _require_member("all_gather_object", group)
     import pickle
     st = _world_store_or_raise("all_gather_object")
     ranks = _group_ranks(group)
-    st.set(f"obj_ag/{gen}/{get_rank()}", pickle.dumps(obj))
+    gk = _group_key(group)
+    st.set(f"obj_ag/{gk}/{gen}/{get_rank()}", pickle.dumps(obj))
     for r in ranks:
-        raw = st.get(f"obj_ag/{gen}/{r}", wait=True, timeout_ms=120000)
+        raw = st.get(f"obj_ag/{gk}/{gen}/{r}", wait=True, timeout_ms=120000)
         object_list.append(pickle.loads(raw))
     # last reader (ack counter reaches world) sweeps this generation's keys
-    if st.add(f"obj_ag/{gen}/done", 1) == len(ranks):
+    if st.add(f"obj_ag/{gk}/{gen}/done", 1) == len(ranks):
         for r in ranks:
-            st.delete_key(f"obj_ag/{gen}/{r}")
-        st.delete_key(f"obj_ag/{gen}/done")
+            st.delete_key(f"obj_ag/{gk}/{gen}/{r}")
+        st.delete_key(f"obj_ag/{gk}/{gen}/done")
     return object_list
 
 
@@ -640,18 +681,24 @@ def broadcast_object_list(object_list, src=0, group=None):
     if n == 1:
         return object_list
     _require_initialized_multiproc("broadcast_object_list")
-    _require_world_object_group("broadcast_object_list", group)
+    _require_member("broadcast_object_list", group)
+    if group is not None and src not in list(group.ranks):
+        raise ValueError(
+            f"broadcast_object_list src {src} is not in group "
+            f"{list(group.ranks)}")
     import pickle
     st = _world_store_or_raise("broadcast_object_list")
     if get_rank() == src:
-        st.set(f"obj_bc/{gen}", pickle.dumps(list(object_list)))
+        gk = _group_key(group)
+        st.set(f"obj_bc/{gk}/{gen}", pickle.dumps(list(object_list)))
         return object_list
-    raw = st.get(f"obj_bc/{gen}", wait=True, timeout_ms=120000)
+    gk = _group_key(group)
+    raw = st.get(f"obj_bc/{gk}/{gen}", wait=True, timeout_ms=120000)
     got = pickle.loads(raw)
     object_list[:] = got
-    if st.add(f"obj_bc/{gen}/done", 1) == n - 1:  # last reader sweeps
-        st.delete_key(f"obj_bc/{gen}")
-        st.delete_key(f"obj_bc/{gen}/done")
+    if st.add(f"obj_bc/{gk}/{gen}/done", 1) == n - 1:  # last reader sweeps
+        st.delete_key(f"obj_bc/{gk}/{gen}")
+        st.delete_key(f"obj_bc/{gk}/{gen}/done")
     return object_list
 
 
@@ -673,15 +720,22 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
         out_object_list.append(in_object_list[my])
         return out_object_list
     _require_initialized_multiproc("scatter_object_list")
-    _require_world_object_group("scatter_object_list", group)
+    _require_member("scatter_object_list", group)
+    if group is not None and src not in list(group.ranks):
+        raise ValueError(
+            f"scatter_object_list src {src} is not in group "
+            f"{list(group.ranks)}")
     import pickle
     st = _world_store_or_raise("scatter_object_list")
     if get_rank() == src:
+        gk = _group_key(group)
         for i, r in enumerate(_group_ranks(group)):
-            st.set(f"obj_sc/{gen}/{r}", pickle.dumps(in_object_list[i]))
+            if r == get_rank():
+                continue  # src takes its slot directly; never set/leaked
+            st.set(f"obj_sc/{gk}/{gen}/{r}", pickle.dumps(in_object_list[i]))
         out_object_list.append(in_object_list[my])
         return out_object_list
-    key = f"obj_sc/{gen}/{get_rank()}"
+    key = f"obj_sc/{_group_key(group)}/{gen}/{get_rank()}"
     raw = st.get(key, wait=True, timeout_ms=120000)
     st.delete_key(key)  # single-consumer key
     out_object_list.append(pickle.loads(raw))

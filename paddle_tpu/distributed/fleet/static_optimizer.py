@@ -53,11 +53,17 @@ class StaticDistributedOptimizer:
             stage = int(stage or 2)
             new_pass("zero_sharding", axis="sharding",
                      stage=stage).apply(prog)
-        # k-step gradient accumulation (ref: sharding_optimizer grad-merge)
+        # k-step gradient accumulation (ref: sharding_optimizer grad-merge;
+        # sharding_configs.accumulate_steps is the same knob spelled the
+        # sharding way — honored when no explicit gradient_merge is set)
         if getattr(self.strategy, "gradient_merge", False):
             gm = getattr(self.strategy, "gradient_merge_configs", {}) or {}
             new_pass("gradient_merge", k_steps=int(gm.get("k_steps", 1)),
                      avg=bool(gm.get("avg", True))).apply(prog)
+        elif (getattr(self.strategy, "sharding", False)
+                and int(sc.get("accumulate_steps", 1) or 1) > 1):
+            new_pass("gradient_merge",
+                     k_steps=int(sc["accumulate_steps"])).apply(prog)
         # host-parked optimizer state (ref: sharding offload). Same gate
         # as the stage knob: sharding_configs take effect only with
         # strategy.sharding = True (the reference's activation contract).

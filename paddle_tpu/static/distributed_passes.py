@@ -250,8 +250,12 @@ def build_train_callable(program, optimizer, fetch_ids, shard_degree=1):
 
                 def do_update(acc_in, _pos=pos, _p=p, _st=st):
                     g_eff = (acc_in / scale).astype(g.dtype)
+                    # the inner optimizer advances once per MERGED step
+                    # (Adam bias correction counts applied updates, not
+                    # ministeps — GradientMergeOptimizer contract)
                     nw, nst = update_param(_pos, _p, new_leaves, g_eff,
-                                           _st, t, lr, sync_dp=False)
+                                           _st, t // k_merge, lr,
+                                           sync_dp=False)
                     nst["__gm_acc"] = jnp.zeros_like(acc_in)
                     return nw, nst
 

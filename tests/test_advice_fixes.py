@@ -200,11 +200,11 @@ class TestObjectCollectiveSeqLockstep:
         per-process generation counter, including scatter_object_list's
         single-controller convenience early-return."""
         from paddle_tpu.distributed import collective as C
-        before = C._eager_seq[0]
+        before = C._eager_seq.get("world", 0)
         out = []
         C.scatter_object_list(out, [{"a": 1}], src=0)
         assert out == [{"a": 1}]
-        assert C._eager_seq[0] == before + 1
+        assert C._eager_seq.get("world", 0) == before + 1
 
 
 class TestRpcBindAddress:

@@ -46,3 +46,36 @@ def test_all_verbs_two_processes():
     for rank, (p, o) in enumerate(zip(procs, logs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{o}"
         assert "all eager cross-process verbs OK" in o, o
+
+
+def test_subgroup_and_heterogeneous_three_processes():
+    """VERDICT r3 next #10: subgroup eager collectives ({0,2} of world 3)
+    over the store transport + heterogeneous all_to_all_single splits."""
+    port = _free_port()
+    procs = []
+    for rank in range(3):
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("PADDLE_", "FLAGS_", "JAX_"))
+               and k not in ("TRAINING_ROLE", "POD_IP")}
+        env.update({
+            "PADDLE_TRAINERS_NUM": "3",
+            "PADDLE_TRAINER_ID": str(rank),
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(os.path.dirname(__file__),
+                                          "subgroup_worker.py")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd="/root/repo"))
+    logs = []
+    for p in procs:
+        try:
+            o, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            o, _ = p.communicate()
+        logs.append(o)
+    for rank, (p, o) in enumerate(zip(procs, logs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{o}"
+        assert "subgroup + heterogeneous verbs OK" in o, o

@@ -264,3 +264,42 @@ def test_gradient_merge_offload_sharding_compose():
         losses.append(float(lv))
     np.testing.assert_allclose(losses, ref, rtol=2e-5,
                                err_msg=f"gm+offload+zero2 {losses} vs {ref}")
+
+
+def test_gradient_merge_adam_bias_correction():
+    """The inner optimizer advances once per MERGED step: Adam's bias
+    correction must see t=1,2,... (applied updates), not ministeps."""
+    X, Y = _data()
+    paddle.seed(0)
+    net_ref = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt_ref = optimizer.Adam(LR, parameters=net_ref.parameters())
+    ref = []
+    for t in range(1, STEPS + 1):
+        out = net_ref(paddle.to_tensor(X))
+        loss = paddle.mean((out - paddle.to_tensor(Y)) ** 2)
+        ref.append(float(loss))
+        if t % 2 == 0:
+            loss.backward()
+            opt_ref.step()
+            opt_ref.clear_grad()
+
+    mesh = build_mesh({"data": 2, "pipe": 1, "sharding": 1, "model": 1})
+    set_global_mesh(mesh)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1}
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    fleet.init(is_collective=True, strategy=strategy)
+    prog, net, loss = _build_program()
+    opt = optimizer.Adam(LR, parameters=prog.all_parameters())
+    with static.program_guard(prog):
+        fleet.distributed_optimizer(opt, strategy).minimize(loss,
+                                                            program=prog)
+    exe = static.Executor()
+    got = []
+    for _ in range(STEPS):
+        (lv,) = exe.run(prog, feed={"x": X, "y": Y}, fetch_list=[loss])
+        got.append(float(lv))
+    np.testing.assert_allclose(got, ref, rtol=2e-5,
+                               err_msg=f"adam gm {got} vs eager {ref}")

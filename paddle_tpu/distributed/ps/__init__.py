@@ -15,3 +15,9 @@ __all__ = [
     "DistributedEmbedding", "PsPassCache",
     "PaddleCloudRoleMaker", "TheOnePsRuntime", "Role", "local_cluster",
 ]
+
+from .graph import DistGraphTable  # noqa: E402,F401
+__all__.append("DistGraphTable")
+
+from .heter import HeterTrainer  # noqa: E402,F401
+__all__.append("HeterTrainer")

@@ -1,0 +1,119 @@
+"""Distributed graph store — the PGLBox multi-node tier.
+
+ref: paddle/fluid/framework/fleet/heter_ps/graph_gpu_ps_table.h (nodes
+hashed across table shards on different machines; cross-machine neighbor
+sample RPCs) + fluid/distributed/ps/table/common_graph_table.h (the
+CPU-side graph table the brpc PS serves).
+
+TPU-native shape: workers host GraphTable shards (geometric/graph.py);
+clients hash nodes to their owner worker and fan sampling requests out
+over paddle.distributed.rpc, reassembling fixed-shape [n, k] neighbor
+blocks. The same worker processes typically also run the dense trainers
+— sampling rides the host network while the chips run the math.
+"""
+import numpy as np
+
+from ...geometric.graph import GraphTable
+
+# worker-resident shard holders, keyed by table name (rpc target fns are
+# module-level so they pickle by reference)
+_tables = {}
+
+
+def _init_table(name, shard_num):
+    _tables[name] = GraphTable(shard_num)
+    return True
+
+
+def _add_edges(name, src, dst):
+    _tables[name].add_edges(np.asarray(src), np.asarray(dst))
+    return True
+
+
+def _sample(name, nodes, k, replace, seed):
+    out, mask = _tables[name].sample_neighbors(
+        np.asarray(nodes), k, replace=replace, seed=seed)
+    return out, mask
+
+
+def _degree(name, nodes):
+    return _tables[name].degree(np.asarray(nodes))
+
+
+class DistGraphTable:
+    """Client view of a graph sharded across rpc workers by node hash.
+
+    Usage (after paddle.distributed.rpc.init_rpc on every worker):
+        g = DistGraphTable("g0", workers=["worker0", "worker1"])
+        g.build(src, dst)            # partitions edges by owner
+        nbrs, mask = g.sample_neighbors(nodes, 5)
+    """
+
+    def __init__(self, name, workers, shard_num=8):
+        from .. import rpc
+        self.name = name
+        self.workers = list(workers)
+        self._rpc = rpc
+        for w in self.workers:
+            rpc.rpc_sync(w, _init_table, args=(name, shard_num))
+
+    def _owner_idx(self, nodes):
+        """THE ownership rule (single source): worker index per node."""
+        return np.asarray(nodes, np.int64) % len(self.workers)
+
+    def build(self, src, dst, bidirectional=False):
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        if bidirectional:
+            src, dst = (np.concatenate([src, dst]),
+                        np.concatenate([dst, src]))
+        owners = self._owner_idx(src)
+        for wi, w in enumerate(self.workers):
+            m = owners == wi
+            if m.any():
+                self._rpc.rpc_sync(w, _add_edges,
+                                   args=(self.name, src[m], dst[m]))
+        return self
+
+    def _fan_out(self, nodes, fn, *extra):
+        """Group nodes by owner, rpc each owner once, reassemble in the
+        caller's order."""
+        nodes = np.asarray(nodes, np.int64).reshape(-1)
+        owners = self._owner_idx(nodes)
+        futures, slots = [], []
+        for wi, w in enumerate(self.workers):
+            m = owners == wi
+            if not m.any():
+                continue
+            futures.append(self._rpc.rpc_async(
+                w, fn, args=(self.name, nodes[m]) + extra))
+            slots.append(np.nonzero(m)[0])
+        return futures, slots, nodes
+
+    def sample_neighbors(self, nodes, sample_size, replace=False, seed=None):
+        futures, slots, nodes = self._fan_out(
+            nodes, _sample, int(sample_size), bool(replace), seed)
+        out = np.full((len(nodes), int(sample_size)), -1, np.int64)
+        for fut, idx in zip(futures, slots):
+            part, _mask = fut.wait()
+            out[idx] = part
+        return out, out >= 0
+
+    def degree(self, nodes):
+        futures, slots, nodes = self._fan_out(nodes, _degree)
+        out = np.zeros(len(nodes), np.int64)
+        for fut, idx in zip(futures, slots):
+            out[idx] = fut.wait()
+        return out
+
+    def random_walk(self, start_nodes, walk_len, seed=None):
+        cur = np.asarray(start_nodes, np.int64).reshape(-1)
+        walks = [cur.copy()]
+        for step in range(int(walk_len)):
+            nbrs, mask = self.sample_neighbors(
+                cur, 1, replace=True,
+                seed=None if seed is None else seed + step)
+            nxt = np.where(mask[:, 0], nbrs[:, 0], cur)
+            walks.append(nxt.copy())
+            cur = nxt
+        return np.stack(walks, axis=1)

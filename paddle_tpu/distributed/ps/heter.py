@@ -1,0 +1,120 @@
+"""Heterogeneous PS trainer orchestration (HeterPS).
+
+ref: paddle/fluid/framework/trainer.h:182 (HeterXpuTrainer),
+paddle/fluid/distributed/ps/service/heter_client.h + heter_server.h —
+the fork's heterogeneous pipeline: CPU trainers own data ingest and the
+SPARSE half (pull/push against the parameter server), accelerator
+workers own the DENSE half; the two halves exchange the cut-layer
+activations and their gradients over an RPC channel.
+
+TPU-native shape: the dense worker is an rpc-hosted closure over a
+jitted value_and_grad step (params + Adam state resident at the
+accelerator process); the CPU trainer pulls embeddings from the durable
+PS (csrc/ps_service.cc), ships the concatenated slot activations through
+paddle.distributed.rpc, receives d(loss)/d(activations) back, and pushes
+the per-key sparse grads. The RPC plays the HeterClient/HeterServer
+channel; the PS plays the brpc sparse tables.
+"""
+import numpy as np
+
+# --- dense-side (accelerator process): module-level so rpc can address
+#     the functions by reference ------------------------------------------
+_dense_workers = {}
+
+
+def _init_dense(name, in_dim, hidden, out_dim, lr=1e-2, seed=0):
+    """Build the dense half (2-layer MLP head) on the hosting worker."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed)
+    params = {
+        "w1": jnp.asarray(rng.randn(in_dim, hidden).astype(np.float32)
+                          / np.sqrt(in_dim)),
+        "b1": jnp.zeros((hidden,), jnp.float32),
+        "w2": jnp.asarray(rng.randn(hidden, out_dim).astype(np.float32)
+                          / np.sqrt(hidden)),
+        "b2": jnp.zeros((out_dim,), jnp.float32),
+    }
+    opt = jax.tree_util.tree_map(
+        lambda a: {"m": jnp.zeros_like(a), "v": jnp.zeros_like(a)}, params)
+
+    def loss_fn(p, x, y):
+        h = jnp.maximum(x @ p["w1"] + p["b1"], 0.0)
+        out = h @ p["w2"] + p["b2"]
+        return jnp.mean((out - y) ** 2)
+
+    # one jitted pass: loss + param grads + input grads, then Adam
+    @jax.jit
+    def fused_step(p, o, t, x, y):
+        def wrt_all(pp, xx):
+            return loss_fn(pp, xx, y)
+
+        lv, (gp, gx) = jax.value_and_grad(wrt_all, argnums=(0, 1))(p, x)
+        b1, b2, eps = 0.9, 0.999, 1e-8
+
+        def adam(a, g, st):
+            m = b1 * st["m"] + (1 - b1) * g
+            v = b2 * st["v"] + (1 - b2) * g * g
+            mh = m / (1 - b1 ** t)
+            vh = v / (1 - b2 ** t)
+            return a - lr * mh / (jnp.sqrt(vh) + eps), {"m": m, "v": v}
+
+        new_p, new_o = {}, {}
+        for k in p:
+            new_p[k], new_o[k] = adam(p[k], gp[k], o[k])
+        return lv, gx, new_p, new_o
+
+    _dense_workers[name] = {"params": params, "opt": opt, "t": 0,
+                            "fn": fused_step}
+    return True
+
+
+def _dense_forward_backward(name, x, y):
+    """One dense fwd+bwd+update; returns (loss, d loss/d x) — the heter
+    channel payload (ref: heter_client.h SendAndRecvAsync)."""
+    import jax.numpy as jnp
+    w = _dense_workers[name]
+    w["t"] += 1
+    lv, gx, new_p, new_o = w["fn"](w["params"], w["opt"],
+                                   float(w["t"]), jnp.asarray(x),
+                                   jnp.asarray(y))
+    w["params"], w["opt"] = new_p, new_o
+    return float(lv), np.asarray(gx)
+
+
+class HeterTrainer:
+    """CPU-side ingest trainer: sparse half on the PS, dense half via rpc
+    (ref: HeterXpuTrainer's trainer loop split)."""
+
+    def __init__(self, ps_client, table_cfg, n_slots, dense_worker,
+                 name="heter0", hidden=32, out_dim=1, lr=1e-2, seed=0):
+        from .. import rpc
+        self._rpc = rpc
+        self.ps = ps_client
+        self.cfg = table_cfg
+        self.n_slots = int(n_slots)
+        self.dense_worker = dense_worker
+        self.name = name
+        self.ps.create_table(table_cfg)
+        in_dim = self.n_slots * table_cfg.dim
+        rpc.rpc_sync(dense_worker, _init_dense,
+                     args=(name, in_dim, hidden, out_dim, lr, seed))
+
+    def train_step(self, slot_ids, labels):
+        """slot_ids: [b, n_slots] uint64 feature ids; labels: [b, out]."""
+        ids = np.asarray(slot_ids, np.uint64)
+        b = ids.shape[0]
+        dim = self.cfg.dim
+        uniq, inv = np.unique(ids.reshape(-1), return_inverse=True)
+        rows = self.ps.pull_sparse(self.cfg.table_id, uniq, dim)
+        x = rows[inv].reshape(b, self.n_slots * dim)
+        loss, dx = self._rpc.rpc_sync(
+            self.dense_worker, _dense_forward_backward,
+            args=(self.name, x, np.asarray(labels, np.float32)))
+        # scatter the activation grads back onto the unique keys
+        g = np.asarray(dx, np.float32).reshape(b * self.n_slots, dim)
+        gu = np.zeros((uniq.size, dim), np.float32)
+        np.add.at(gu, inv, g)
+        self.ps.push_sparse(self.cfg.table_id, uniq, gu)
+        return loss

@@ -86,3 +86,7 @@ def segment_min(data, segment_ids, name=None):
         else jnp.asarray(segment_ids)
     n = int(jax.device_get(ids.max())) + 1 if ids.size else 0
     return apply(lambda a: jax.ops.segment_min(a, ids, n), _t(data))
+
+
+from .graph import (GraphTable, sample_subgraph,  # noqa: E402,F401
+                    graph_khop_sampler)

@@ -1,0 +1,174 @@
+"""Graph store + neighbor sampling — the PGLBox slice.
+
+ref: paddle/fluid/framework/fleet/heter_ps/graph_gpu_ps_table.h (GpuPsGraphTable:
+node adjacency sharded across accelerator-resident tables, graph_neighbor_sample
+/ graph_neighbor_sample_v2), gpu_graph_node.h (GpuPsCommGraph CSR layout),
+graph_gpu_wrapper.cu (random walks feeding the fleet trainers).
+
+TPU-native shape: sampling/walks are HOST-side index work (the reference
+keeps them on GPU because its trainer lives there; on TPU the chip's job
+is the dense math, and XLA gathers handle the device side). The store is
+CSR over hashed shards like the reference's `shard_num` partitioning;
+sampling emits FIXED-SHAPE [n, k] neighbor blocks (-1 padded, with mask)
+— the static geometry XLA wants — which feed geometric.send_u_recv
+message passing directly.
+"""
+import numpy as np
+
+from ..tensor.tensor import Tensor
+
+
+class GraphTable:
+    """Sharded CSR adjacency (ref: GpuPsGraphTable over `shard_num`
+    shards; single-process here — DistGraphTable in distributed/ps/graph.py
+    spreads the same shards over rpc workers)."""
+
+    def __init__(self, shard_num=8):
+        self.shard_num = int(shard_num)
+        self._adj = [{} for _ in range(self.shard_num)]  # node -> list
+
+    def _shard(self, node):
+        return int(node) % self.shard_num
+
+    # -- build -------------------------------------------------------------
+    def add_edges(self, src, dst, bidirectional=False):
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        for s, d in zip(src, dst):
+            self._adj[self._shard(s)].setdefault(int(s), []).append(int(d))
+        if bidirectional:
+            self.add_edges(dst, src, bidirectional=False)
+        return self
+
+    @property
+    def n_edges(self):
+        return sum(len(v) for sh in self._adj for v in sh.values())
+
+    def nodes(self):
+        out = []
+        for sh in self._adj:
+            out.extend(sh.keys())
+        return np.asarray(sorted(out), np.int64)
+
+    def neighbors(self, node):
+        return np.asarray(self._adj[self._shard(node)].get(int(node), []),
+                          np.int64)
+
+    def degree(self, nodes):
+        nodes = np.asarray(nodes, np.int64).reshape(-1)
+        return np.asarray([len(self._adj[self._shard(n)].get(int(n), []))
+                           for n in nodes], np.int64)
+
+    # -- sampling (ref: graph_neighbor_sample_v2) ---------------------------
+    def sample_neighbors(self, nodes, sample_size, replace=False, seed=None):
+        """Uniform neighbor sampling -> ([n, k] int64 padded with -1,
+        [n, k] bool mask). Nodes with <= k neighbors return them all
+        (the reference's 'compress' behavior) unless replace=True."""
+        rng = np.random.RandomState(seed)
+        nodes = np.asarray(nodes, np.int64).reshape(-1)
+        k = int(sample_size)
+        out = np.full((len(nodes), k), -1, np.int64)
+        for i, nd in enumerate(nodes):
+            nbrs = self._adj[self._shard(nd)].get(int(nd), [])
+            if not nbrs:
+                continue
+            if replace:
+                pick = rng.randint(0, len(nbrs), size=k)
+                out[i] = np.asarray(nbrs, np.int64)[pick]
+            elif len(nbrs) <= k:
+                out[i, :len(nbrs)] = nbrs
+            else:
+                pick = rng.choice(len(nbrs), size=k, replace=False)
+                out[i] = np.asarray(nbrs, np.int64)[pick]
+        return out, out >= 0
+
+    def random_walk(self, start_nodes, walk_len, seed=None):
+        """[n, walk_len+1] uniform random walks (ref: graph_gpu_wrapper
+        walk generation feeding the trainers); dead ends repeat."""
+        rng = np.random.RandomState(seed)
+        cur = np.asarray(start_nodes, np.int64).reshape(-1)
+        walks = [cur.copy()]
+        for _ in range(int(walk_len)):
+            nxt = cur.copy()
+            for i, nd in enumerate(cur):
+                nbrs = self._adj[self._shard(nd)].get(int(nd), [])
+                if nbrs:
+                    nxt[i] = nbrs[rng.randint(len(nbrs))]
+            walks.append(nxt.copy())
+            cur = nxt
+        return np.stack(walks, axis=1)
+
+
+def sample_subgraph(graph, nodes, fanouts, seed=None):
+    """Layered GraphSAGE-style sampling: for each fanout k, sample
+    neighbors of the current frontier, reindex everything into a compact
+    id space, and emit static-shape edge lists.
+
+    Returns dict:
+      n_id        : [N] int64 UNIQUE original ids (first occurrences of
+                    the seeds lead)
+      seed_index  : [len(nodes)] int64 — compact row of each input seed
+                    (duplicates map to the same row); read aggregations
+                    as out[seed_index]
+      edges_src   : [E] int64 COMPACT indices (message sources)
+      edges_dst   : [E] int64 compact indices (message destinations)
+    -1-padded samples are dropped. Feeds geometric.send_u_recv(x[n_id],
+    edges_src, edges_dst) directly."""
+    nodes = np.asarray(nodes, np.int64).reshape(-1)
+    id_map = {}
+    n_id = []
+    for n in nodes:  # dedupe, preserving first-occurrence order
+        if int(n) not in id_map:
+            id_map[int(n)] = len(n_id)
+            n_id.append(int(n))
+    seed_index = np.asarray([id_map[int(n)] for n in nodes], np.int64)
+    es, ed = [], []
+    frontier = np.asarray(n_id, np.int64)
+    for layer, k in enumerate(fanouts):
+        nbrs, mask = graph.sample_neighbors(
+            frontier, k, seed=None if seed is None else seed + layer)
+        new_frontier = []
+        for i, nd in enumerate(frontier):
+            for j in range(nbrs.shape[1]):
+                if not mask[i, j]:
+                    continue
+                nb = int(nbrs[i, j])
+                if nb not in id_map:
+                    id_map[nb] = len(n_id)
+                    n_id.append(nb)
+                    new_frontier.append(nb)
+                # message flows neighbor -> node
+                es.append(id_map[nb])
+                ed.append(id_map[int(nd)])
+        frontier = np.asarray(new_frontier, np.int64)
+        if frontier.size == 0:
+            break
+    return {"n_id": np.asarray(n_id, np.int64),
+            "seed_index": seed_index,
+            "edges_src": np.asarray(es, np.int64),
+            "edges_dst": np.asarray(ed, np.int64)}
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """paddle.incubate.graph_khop_sampler-compatible entry over CSC
+    arrays (ref: python/paddle/incubate/operators/graph_khop_sampler.py:
+    returns (edge_src, edge_dst, sample_index, reindex_nodes))."""
+    if return_eids:
+        raise NotImplementedError(
+            "graph_khop_sampler(return_eids=True): edge ids are not "
+            "tracked by this sampler — call with return_eids=False")
+    row = np.asarray(row.data if isinstance(row, Tensor) else row,
+                     np.int64)
+    colptr = np.asarray(colptr.data if isinstance(colptr, Tensor)
+                        else colptr, np.int64)
+    seeds = np.asarray(input_nodes.data if isinstance(input_nodes, Tensor)
+                       else input_nodes, np.int64).reshape(-1)
+    g = GraphTable()
+    dsts = np.repeat(np.arange(len(colptr) - 1, dtype=np.int64),
+                     np.diff(colptr))
+    if dsts.size:
+        g.add_edges(dsts, row)
+    sub = sample_subgraph(g, seeds, list(sample_sizes))
+    return (Tensor(sub["edges_src"]), Tensor(sub["edges_dst"]),
+            Tensor(sub["n_id"]), Tensor(sub["seed_index"]))

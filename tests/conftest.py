@@ -16,3 +16,8 @@ def _seed():
     import paddle_tpu as paddle
     paddle.seed(2024)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-running tests")

@@ -1,0 +1,91 @@
+"""Heterogeneous PS trainer orchestration (VERDICT r3 next #9; ref:
+fluid/framework/trainer.h:182 HeterXpuTrainer +
+fluid/distributed/ps/service/heter_client.h): CPU ingest + sparse half
+on the durable PS, dense half on an rpc-hosted accelerator worker,
+activations/grads over the heter channel."""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import (HeterTrainer, PsServer, PsClient,
+                                       SparseTableConfig)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _toy_batch(rng, b=16, n_slots=3, vocab=50):
+    ids = rng.randint(0, vocab, (b, n_slots)).astype(np.uint64)
+    # learnable target: depends on the ids through a fixed random table
+    w = np.sin(np.arange(vocab))[..., None]
+    y = sum(w[ids[:, j].astype(np.int64)] for j in range(n_slots))
+    return ids, y.astype(np.float32)
+
+
+def _run_trainer(dense_worker_name):
+    srv = PsServer(0)
+    try:
+        ps = PsClient("127.0.0.1", srv.port)
+        cfg = SparseTableConfig(table_id=31, dim=8, optimizer="adagrad",
+                                lr=0.1)
+        tr = HeterTrainer(ps, cfg, n_slots=3,
+                          dense_worker=dense_worker_name,
+                          name="heter_t", hidden=32, lr=1e-2, seed=0)
+        rng = np.random.RandomState(0)
+        losses = []
+        for _ in range(40):
+            ids, y = _toy_batch(rng)
+            losses.append(tr.train_step(ids, y))
+        return losses
+    finally:
+        srv.stop()
+
+
+def test_heter_trainer_single_process():
+    """World-of-1 rpc: the full channel (pull -> rpc dense fwd/bwd ->
+    push) in one process; loss must fall substantially."""
+    from paddle_tpu.distributed import rpc
+    rpc.init_rpc("worker0", rank=0, world_size=1)
+    try:
+        losses = _run_trainer("worker0")
+    finally:
+        rpc.shutdown()
+    assert np.mean(losses[-5:]) < 0.35 * np.mean(losses[:5]), losses
+
+
+CHILD = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import time
+from paddle_tpu.distributed import rpc
+rpc.init_rpc("dense0", rank=1, world_size=2, master_endpoint="{ep}")
+time.sleep(180)
+"""
+
+
+@pytest.mark.slow
+def test_heter_trainer_two_processes():
+    """The real split: dense half lives in ANOTHER process (the
+    accelerator worker); sparse half + ingest stay here."""
+    from paddle_tpu.distributed import rpc
+    ep = f"127.0.0.1:{_free_port()}"
+    child = subprocess.Popen(
+        [sys.executable, "-c", CHILD.format(ep=ep)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd="/root/repo")
+    try:
+        rpc.init_rpc("cpu0", rank=0, world_size=2, master_endpoint=ep)
+        losses = _run_trainer("dense0")
+        assert np.mean(losses[-5:]) < 0.35 * np.mean(losses[:5]), losses
+    finally:
+        rpc.shutdown()
+        child.kill()
+        child.wait()

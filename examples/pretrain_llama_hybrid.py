@@ -20,6 +20,53 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+# North-star hybrid recipes (BASELINE.md workloads 3/4; per-axis comm
+# accounting in BASELINE.md "Round-5 engineering notes"). The v5p-128
+# 13B recipe lists ONE dp replica group's mesh — per-device memory is
+# dp-invariant, so an 8-device AOT compile certifies the 128-chip
+# placement (dp16 x mp2 x pp2 x sharding2).
+RECIPES = {
+    "7b": dict(
+        cfg=dict(vocab_size=32000, hidden_size=4096,
+                 intermediate_size=11008, num_hidden_layers=32,
+                 num_attention_heads=32, max_position_embeddings=2048),
+        mesh={"data": 1, "pipe": 1, "sharding": 8, "model": 1},
+        trainer=dict(param_dtype="bfloat16", moment_dtype="float32",
+                     recompute=True, sharding_stage=2),
+        batch=(8, 2048), target="v5p-8 (95 GB HBM/chip)"),
+    "13b": dict(
+        cfg=dict(vocab_size=32000, hidden_size=5120,
+                 intermediate_size=13824, num_hidden_layers=40,
+                 num_attention_heads=40, max_position_embeddings=2048),
+        mesh={"data": 1, "pipe": 2, "sharding": 2, "model": 2},
+        trainer=dict(param_dtype="bfloat16", moment_dtype="float32",
+                     recompute=True, sharding_stage=2,
+                     micro_batch_size=2, pp_schedule="1f1b"),
+        batch=(8, 2048), target="v5p-128 = dp16 x this replica group"),
+}
+
+
+def aot_memory_report(name):
+    """AOT per-device memory accounting of a north-star recipe — built
+    under LazyGuard (meta init), so no parameter is ever materialized:
+    runs on any small host. Returns the memory_analysis dict."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.train_step import SpmdTrainer
+
+    r = RECIPES[name]
+    mesh = build_mesh(r["mesh"])
+    set_global_mesh(mesh)
+    with paddle.LazyGuard():
+        model = LlamaForCausalLM(LlamaConfig(**r["cfg"]))
+    trainer = SpmdTrainer(model, mesh, lr=1e-4, **r["trainer"])
+    bs, seq = r["batch"]
+    ids = jax.ShapeDtypeStruct((bs, seq), np.int64)
+    return trainer.memory_analysis(trainer.abstract_state(), ids, ids)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--devices", type=int, default=8)
@@ -27,7 +74,22 @@ def main():
     ap.add_argument("--seq", type=int, default=64)
     ap.add_argument("--cpu", action="store_true",
                     help="run on N virtual CPU devices")
+    ap.add_argument("--aot_memory", choices=sorted(RECIPES),
+                    help="AOT-compile a north-star recipe (7b/13b) and "
+                         "print its per-device memory accounting instead "
+                         "of training")
     args = ap.parse_args()
+
+    if args.aot_memory:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.devices)
+        ma = aot_memory_report(args.aot_memory)
+        r = RECIPES[args.aot_memory]
+        print(f"{args.aot_memory} on {r['target']}: mesh={r['mesh']}")
+        for k, v in ma.items():
+            print(f"  {k}: {v / 1e9:.2f} GB")
+        return
 
     import jax
     if args.cpu:

@@ -80,3 +80,176 @@ class features:
         def __call__(self, x):
             m = super().__call__(x)
             return Tensor(10.0 * jnp.log10(jnp.maximum(m.data, 1e-10)))
+
+    class MFCC:
+        """Mel-frequency cepstral coefficients: DCT-II over the log-mel
+        bands (ref: python/paddle/audio/features/layers.py:310 MFCC —
+        log-mel -> create_dct projection)."""
+
+        def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                     n_mels=64, f_min=50.0, f_max=None, top_db=80.0, **kw):
+            if n_mfcc > n_mels:
+                raise ValueError(
+                    f"n_mfcc ({n_mfcc}) must be <= n_mels ({n_mels})")
+            self.logmel = features.LogMelSpectrogram(
+                sr, n_fft, hop_length, n_mels, f_min, f_max)
+            self.dct_matrix = create_dct(n_mfcc, n_mels)
+            self.top_db = top_db
+
+        def __call__(self, x):
+            lm = self.logmel(x).data          # [..., n_mels, t]
+            if self.top_db is not None:
+                lm = jnp.maximum(lm, lm.max() - self.top_db)
+            return Tensor(jnp.einsum("cm,...mt->...ct",
+                                     self.dct_matrix.data, lm))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II basis (ref:
+    python/paddle/audio/functional/functional.py create_dct)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(dct.astype(dtype).T)  # [n_mfcc, n_mels]
+
+
+def power_to_db(magnitude, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """10*log10(x/ref) with floor + dynamic-range clamp (ref:
+    functional.py power_to_db)."""
+    x = magnitude.data if isinstance(magnitude, Tensor) else jnp.asarray(
+        magnitude)
+    db = 10.0 * jnp.log10(jnp.maximum(x, amin))
+    db -= 10.0 * jnp.log10(jnp.maximum(jnp.asarray(ref_value), amin))
+    if top_db is not None:
+        db = jnp.maximum(db, db.max() - top_db)
+    return Tensor(db)
+
+
+class functional:
+    """paddle.audio.functional namespace parity."""
+    hz_to_mel = staticmethod(hz_to_mel)
+    mel_to_hz = staticmethod(mel_to_hz)
+    compute_fbank_matrix = staticmethod(compute_fbank_matrix)
+    create_dct = staticmethod(create_dct)
+    power_to_db = staticmethod(power_to_db)
+
+    @staticmethod
+    def get_window(window, win_length, fftbins=True):
+        """Hann/Hamming/Blackman/rect windows (ref: functional/window.py)."""
+        n = win_length
+        i = np.arange(n, dtype=np.float64)
+        denom = n if fftbins else max(n - 1, 1)
+        if window in ("hann", "hanning"):
+            w = 0.5 - 0.5 * np.cos(2 * np.pi * i / denom)
+        elif window == "hamming":
+            w = 0.54 - 0.46 * np.cos(2 * np.pi * i / denom)
+        elif window == "blackman":
+            w = (0.42 - 0.5 * np.cos(2 * np.pi * i / denom)
+                 + 0.08 * np.cos(4 * np.pi * i / denom))
+        elif window in ("rect", "rectangular", "boxcar"):
+            w = np.ones(n)
+        else:
+            raise ValueError(f"unsupported window {window!r}")
+        return Tensor(w.astype(np.float32))
+
+
+class datasets:
+    """paddle.audio.datasets analog (ref: python/paddle/audio/datasets/
+    {tess,esc50}.py). The image has no network egress, so these read an
+    ALREADY-DOWNLOADED archive directory instead of fetching — pass its
+    path; a missing path raises loudly (descope ledger: BASELINE.md)."""
+
+    class _FolderWavDataset:
+        _GLOB = "**/*.wav"
+
+        def __init__(self, root, mode="train", split_ratio=0.8,
+                     sample_rate=None, feat_type="raw", **feat_kw):
+            import glob as _glob
+            import os as _os
+            if root is None or not _os.path.isdir(root):
+                raise RuntimeError(
+                    f"{type(self).__name__}: dataset root {root!r} not "
+                    "found. This environment has no network egress — "
+                    "download the archive elsewhere and pass "
+                    "root=<extracted dir> (see BASELINE.md descope "
+                    "ledger).")
+            files = sorted(_glob.glob(_os.path.join(root, self._GLOB),
+                                      recursive=True))
+            if not files:
+                raise RuntimeError(f"no .wav files under {root!r}")
+            cut = int(len(files) * split_ratio)
+            self.files = files[:cut] if mode == "train" else files[cut:]
+            self.feat_type = feat_type
+            self.feat_kw = feat_kw
+
+        def _label(self, path):
+            raise NotImplementedError
+
+        def __len__(self):
+            return len(self.files)
+
+        def __getitem__(self, idx):
+            import wave
+            path = self.files[idx]
+            with wave.open(path, "rb") as f:
+                if f.getsampwidth() != 2 or f.getnchannels() != 1:
+                    raise RuntimeError(
+                        f"{path}: only 16-bit mono PCM wav is supported "
+                        f"(got sampwidth={f.getsampwidth()}, "
+                        f"channels={f.getnchannels()}); re-encode the "
+                        "archive (descope ledger: BASELINE.md, no "
+                        "soundfile wheel in the image)")
+                n = f.getnframes()
+                raw = np.frombuffer(f.readframes(n), dtype=np.int16)
+                sr = f.getframerate()
+            x = (raw.astype(np.float32) / 32768.0)
+            if self.feat_type == "raw":
+                feat = x
+            else:
+                feat = np.asarray(
+                    self._extractor(sr)(Tensor(x[None])).data)[0]
+            return feat, self._label(path)
+
+        def _extractor(self, sr):
+            """Per-sample-rate cache: the mel filterbank / DCT basis are
+            built once, not per __getitem__ (code-review r5)."""
+            cache = getattr(self, "_extractors", None)
+            if cache is None:
+                cache = self._extractors = {}
+            key = (self.feat_type, sr)
+            if key not in cache:
+                if self.feat_type == "mfcc":
+                    cache[key] = features.MFCC(sr=sr, **self.feat_kw)
+                elif self.feat_type == "melspectrogram":
+                    cache[key] = features.MelSpectrogram(sr=sr,
+                                                         **self.feat_kw)
+                else:
+                    raise ValueError(f"feat_type {self.feat_type!r}")
+            return cache[key]
+
+    class TESS(_FolderWavDataset):
+        """Toronto emotional speech set: label = emotion token in the
+        file name (ref: datasets/tess.py)."""
+        EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral",
+                    "ps", "sad"]
+
+        def _label(self, path):
+            import os as _os
+            name = _os.path.basename(path).lower()
+            stem = name.rsplit(".", 1)[0]
+            emo = stem.split("_")[-1]
+            return np.int64(self.EMOTIONS.index(emo))
+
+    class ESC50(_FolderWavDataset):
+        """ESC-50: label = target field of the canonical file name
+        {fold}-{id}-{take}-{target}.wav (ref: datasets/esc50.py)."""
+
+        def _label(self, path):
+            import os as _os
+            stem = _os.path.basename(path).rsplit(".", 1)[0]
+            return np.int64(int(stem.split("-")[-1]))

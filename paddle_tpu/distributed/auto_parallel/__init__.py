@@ -372,12 +372,8 @@ class Engine:
                           first_epoch_iter is not None else loader)
             for step_i, batch in enumerate(epoch_iter):
                 x, y = batch[0], batch[1]
-                if full:
-                    parrs, lv = self._jitted(parrs, x.data, y.data,
-                                             frnd.next_key())
-                else:
-                    parrs, lv = self._jitted(
-                        parrs, x.data, y.data, frnd.next_key())
+                parrs, lv = self._jitted(parrs, x.data, y.data,
+                                         frnd.next_key())
                 if steps_per_epoch and step_i + 1 >= steps_per_epoch:
                     break
             history.append(float(jax.device_get(lv)))

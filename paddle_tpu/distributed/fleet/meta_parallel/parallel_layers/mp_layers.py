@@ -67,7 +67,14 @@ class VocabParallelEmbedding(Layer):
                 safe = jnp.clip(local, 0, local_vocab - 1)
                 out = jnp.take(w, safe, axis=0)
                 out = jnp.where(in_range[..., None], out, 0.0)
-                return lax.psum(out, "model")
+                # completion of DISJOINT per-rank partials (each rank
+                # contributes only its vocab rows): the identity-transpose
+                # allreduce pair. A tied lax.psum here transposed to an
+                # extra x(tp degree) on the table's cotangent — invisible
+                # to scale-invariant AdamW, but it broke the
+                # mesh-independent canonical moment contract (round-5
+                # cross-mesh checkpoint tests).
+                return mp_ops._allreduce_fn("model")(out)
             return jnp.take(w, ids, axis=0)
 
         return apply(fn, self.weight, name="vocab_parallel_embedding")

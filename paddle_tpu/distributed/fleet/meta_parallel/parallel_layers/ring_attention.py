@@ -112,9 +112,10 @@ def ring_attention(q, k, v, axis_name="sep", causal=True, scale=None,
     m0 = jnp.full((b, s_loc, h, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, s_loc, h, 1), jnp.float32)
     try:  # mark device-varying for VMA-checked shard_map regions
-        acc0 = lax.pvary(acc0, (axis_name,))
-        m0 = lax.pvary(m0, (axis_name,))
-        l0 = lax.pvary(l0, (axis_name,))
+        # (pcast(..., to='varying') — lax.pvary is deprecated)
+        acc0 = lax.pcast(acc0, (axis_name,), to="varying")
+        m0 = lax.pcast(m0, (axis_name,), to="varying")
+        l0 = lax.pcast(l0, (axis_name,), to="varying")
     except Exception:
         pass
     (k_f, v_f, acc, m, l), _ = lax.scan(

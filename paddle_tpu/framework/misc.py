@@ -89,8 +89,12 @@ def check_shape(shape):
 
 class LazyGuard:
     """ref: python/paddle/fluid/lazy_init.py LazyGuard — defer parameter
-    materialization. Under jax, arrays are cheap until used, so the guard
-    only marks the scope; layers initialize as usual."""
+    materialization (meta init). Under the guard, Layer.create_parameter
+    stores a jax.ShapeDtypeStruct instead of running the initializer:
+    shape/dtype metadata flows (SpmdTrainer.abstract_state /
+    memory_analysis can AOT-compile 7B/13B-scale recipes on a small
+    host), while any attempt to COMPUTE with a lazy parameter fails
+    loudly until it is materialized."""
 
     _active = [False]
 

@@ -6,14 +6,70 @@ backed by paddle/fluid/operators/fused/fused_multi_transformer_op.cu.h in the
 reference. Here each layer is a thin orchestration over dispatch ops
 (sdpa/rms_norm/linear) so the Pallas fused kernels apply on TPU; XLA fusion
 covers the rest of the epilogues.
+
+The decode step (cache + time_step) routes through the registry op
+`fused_mha_decode`: ONE launch doing the inline KV write + masked MHA
+over the filled prefix — on TPU it lowers to the Pallas paged-decode
+kernel over the dense cache (identity page table), the analog of the
+reference's fused_multi_transformer masked-MHA core
+(fused_multi_transformer_op.cu.h:13). The projections/norms/FFN stay
+XLA GEMMs: at decode the layer is HBM-bound on cache+weight streaming,
+and XLA already fuses the epilogues into them — see BASELINE.md
+"Fused decoder-layer roofline" for the accounting.
 """
+import jax
 import jax.numpy as jnp
 
+from ....ops import dispatch, register_kernel
 from ....nn.layer.layers import Layer
 from ....nn.layer.common import Linear, Dropout
 from ....nn.layer.norm import LayerNorm
 from ....nn import functional as F
 from ....tensor import manipulation as M
+
+
+def _decode_attn_xla_impl(qa, ka, va, kb, vb, *, t, scale):
+    """Inline KV write + causal MHA over the filled prefix (XLA path)."""
+    s = qa.shape[1]
+    max_len = kb.shape[1]
+    kb = jax.lax.dynamic_update_slice_in_dim(
+        kb, ka.astype(kb.dtype), t, axis=1)
+    vb = jax.lax.dynamic_update_slice_in_dim(
+        vb, va.astype(vb.dtype), t, axis=1)
+    # causal over the filled prefix: query i (absolute pos t+i) sees keys
+    # <= t+i; the unfilled tail is masked out
+    kpos = jnp.arange(max_len)[None, :]
+    qpos = (t + jnp.arange(s))[:, None]
+    valid = kpos <= qpos                     # [s, max_len]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qa, kb) * jnp.asarray(
+        scale, qa.dtype)
+    logits = jnp.where(valid[None, None], logits,
+                       jnp.asarray(-1e30, logits.dtype))
+    w = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(qa.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", w, vb)
+    return out, kb, vb
+
+
+register_kernel("fused_mha_decode", "xla")(_decode_attn_xla_impl)
+
+
+@register_kernel("fused_mha_decode", "pallas")
+def _decode_attn_pallas(qa, ka, va, kb, vb, *, t, scale):
+    """Single-token decode as ONE Pallas launch: the dense cache is
+    viewed as identity-tabled pages and fed to the paged-decode kernel
+    (online softmax over cache blocks, per-head MXU dots) after the
+    1-token inline write. Multi-token chunks (chunked prefill with a
+    cache) keep the XLA composition."""
+    s = qa.shape[1]
+    if s != 1:
+        return _decode_attn_xla_impl(qa, ka, va, kb, vb, t=t, scale=scale)
+    from ....ops.pallas.paged_attention import paged_attention_dense
+    kb = jax.lax.dynamic_update_slice_in_dim(
+        kb, ka.astype(kb.dtype), t, axis=1)
+    vb = jax.lax.dynamic_update_slice_in_dim(
+        vb, va.astype(vb.dtype), t, axis=1)
+    out = paged_attention_dense(qa[:, 0], kb, vb, t + 1, scale=scale)
+    return out[:, None].astype(qa.dtype), kb, vb
 
 
 class FusedMultiHeadAttention(Layer):
@@ -56,34 +112,19 @@ class FusedMultiHeadAttention(Layer):
         if cache is not None:
             if time_step is None:
                 raise ValueError("cache given without time_step")
-            from ....ops import apply
             k_buf, v_buf = cache
-            max_len = k_buf.shape[1]
             t = int(time_step)
-
-            def decode_attn(qa, ka, va, kb, vb):
-                import jax
-                kb = jax.lax.dynamic_update_slice_in_dim(
-                    kb, ka.astype(kb.dtype), t, axis=1)
-                vb = jax.lax.dynamic_update_slice_in_dim(
-                    vb, va.astype(vb.dtype), t, axis=1)
-                # causal over the filled prefix: query i (absolute pos t+i)
-                # sees keys <= t+i; the unfilled tail is masked out
-                kpos = jnp.arange(max_len)[None, :]
-                qpos = (t + jnp.arange(s))[:, None]
-                valid = kpos <= qpos                     # [s, max_len]
-                logits = jnp.einsum("bqhd,bkhd->bhqk", qa, kb) \
-                    / jnp.sqrt(jnp.asarray(self.head_dim, jnp.float32)
-                               ).astype(qa.dtype)
-                logits = jnp.where(valid[None, None], logits,
-                                   jnp.asarray(-1e30, logits.dtype))
-                w = jax.nn.softmax(logits.astype(jnp.float32),
-                                   -1).astype(qa.dtype)
-                out = jnp.einsum("bhqk,bkhd->bqhd", w, vb)
-                return out, kb, vb
-
-            out, nk, nv = apply(decode_attn, q, k, v, k_buf, v_buf,
-                                n_outputs=3, name="fused_mha_decode")
+            # registry op: inline KV write + masked MHA over the filled
+            # prefix in ONE launch (Pallas paged-decode on TPU, XLA
+            # composition elsewhere). Forward-only like the reference op
+            # (fused_multi_transformer has no grad kernel) — and the
+            # Pallas AD rule cannot differentiate scalar-prefetch
+            # kernels anyway.
+            from ....autograd import tape
+            with tape.no_grad():
+                out, nk, nv = dispatch(
+                    "fused_mha_decode", q, k, v, k_buf, v_buf, n_outputs=3,
+                    t=t, scale=1.0 / float(self.head_dim) ** 0.5)
             new_cache = (nk, nv)
         else:
             out = F.scaled_dot_product_attention(

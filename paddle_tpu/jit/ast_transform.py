@@ -373,7 +373,15 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 value=call)
         else:
             assign = ast.Expr(value=call)
-        out = [cond_fn, body_fn, assign]
+        # a loop var first assigned INSIDE the body (and read only after
+        # the loop) is unbound at the convert_while_loop call site: seed
+        # it with the Undefined sentinel, as visit_If does — the Python
+        # (untraced) loop path then runs exactly like plain Python when
+        # the body is guaranteed to execute; using the sentinel in a
+        # TRACED loop still raises the clear UnboundLocalError
+        # (ADVICE r4 low)
+        guards = [_undef_guard(nm) for nm in loop_vars]
+        out = guards + [cond_fn, body_fn, assign]
         for s in out:
             ast.copy_location(s, node)
             ast.fix_missing_locations(s)

@@ -31,10 +31,19 @@ def _is_traced(*vals):
 def _truthy(v):
     """Python truthiness that also handles concrete arrays/Tensors (the
     AST tier routes EVERY `and`/`or`/`not`/`if` through the converters,
-    including ones over plain Python values)."""
+    including ones over plain Python values).
+
+    Concrete values must NOT round-trip through jnp ops: inside an
+    active trace (to_static's eval_shape/jit) jnp stages even constant
+    inputs, so `bool(jnp.reshape(True, ()))` raises
+    TracerBoolConversionError for a value that was never data-dependent
+    (round-5 verification catch). numpy keeps concrete concrete."""
     d = _data(v)
+    if isinstance(d, jax.core.Tracer):
+        return bool(d)  # raises jax's TracerBoolConversionError
     if hasattr(d, "shape") and not isinstance(d, (bool, int, float)):
-        return bool(jnp.reshape(d, ()))
+        import numpy as _np
+        return bool(_np.asarray(d).reshape(()))
     return bool(d)
 
 
@@ -139,7 +148,10 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
     loop_vars = list(loop_vars)
     first = cond_fn(*loop_vars)
     if not _is_traced(first, *loop_vars):
-        while bool(jnp.reshape(_data(cond_fn(*loop_vars)), ())):
+        # concrete loop: plain Python iteration. _truthy (not jnp) — a
+        # jnp op here would stage the concrete condition into any
+        # ambient trace and crash on bool() (round-5 verification catch)
+        while _truthy(cond_fn(*loop_vars)):
             out = body_fn(*loop_vars)
             loop_vars = list(out) if isinstance(out, (list, tuple)) else [out]
         return loop_vars

@@ -117,12 +117,31 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
             for i, (lo, hi) in enumerate(pad_spec):
                 k = (w.shape[2 + i] - 1) * dilations[i] + 1
                 lax_pad.append((k - 1 - lo, k - 1 - hi + opad[i]))
-        out = jax.lax.conv_transpose(
-            a, w, strides=strides, padding=lax_pad, rhs_dilation=dilations,
-            dimension_numbers=dn, transpose_kernel=False,
-        )
+
+        def one(a_, w_):
+            return jax.lax.conv_transpose(
+                a_, w_, strides=strides, padding=lax_pad,
+                rhs_dilation=dilations, dimension_numbers=dn,
+                transpose_kernel=False)
+
         if groups > 1:
-            raise NotImplementedError("grouped conv_transpose: use groups=1")
+            # lax.conv_transpose has no feature_group_count: run one
+            # transpose conv per channel group and concat (static unroll —
+            # groups is small; XLA fuses the concat).
+            # ref weight layout [in_c, out_c/groups, *k]: group g owns
+            # input channels [g*in_c/groups, ...) and its weight rows.
+            c_axis = a.ndim - 1 if channel_last else 1
+            in_per = a.shape[c_axis] // groups
+            w_per = w.shape[0] // groups
+            outs = [
+                one(jax.lax.slice_in_dim(a, g * in_per, (g + 1) * in_per,
+                                         axis=c_axis),
+                    jax.lax.slice_in_dim(w, g * w_per, (g + 1) * w_per,
+                                         axis=0))
+                for g in range(groups)]
+            out = jax.numpy.concatenate(outs, axis=c_axis)
+        else:
+            out = one(a, w)
         if b:
             bias_shape = [1] * out.ndim
             c_axis = out.ndim - 1 if channel_last else 1

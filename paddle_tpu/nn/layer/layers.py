@@ -79,7 +79,14 @@ class Layer:
             initfn = default_initializer
         if initfn is None:
             initfn = init.Constant(0.0) if is_bias else init.XavierUniform()
-        data = initfn(shape, dtype)
+        from ...framework.misc import LazyGuard
+        if LazyGuard._active[0]:
+            # meta init: metadata only, nothing materialized (ref:
+            # fluid/lazy_init.py) — AOT recipes build 7B/13B models this way
+            data = jax.ShapeDtypeStruct(
+                tuple(int(s) for s in shape), jnp.dtype(dtype))
+        else:
+            data = initfn(shape, dtype)
         p = Parameter(data, trainable=trainable, name=name)
         p.optimize_attr = {"learning_rate": lr}
         p.regularizer = regularizer

@@ -203,7 +203,62 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
+    """Spectral normalization: weight / sigma_max(weight), with the
+    leading singular value estimated by persistent-buffer power
+    iteration (ref: python/paddle/nn/layer/norm.py SpectralNorm /
+    paddle/phi/kernels/impl/spectral_norm_kernel_impl.h): `dim` rotates
+    to the front, the rest flattens to [h, w]; u/v are carried across
+    forwards so one iteration per step converges."""
+
     def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
                  name=None):
         super().__init__()
-        raise NotImplementedError("SpectralNorm: planned")
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        self._shape = list(weight_shape)
+        h = int(weight_shape[dim])
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= int(s)
+        from ...nn.initializer import Normal
+        # u/v power-iteration buffers (trainable=False in the reference);
+        # initialized through create_parameter so LazyGuard meta init
+        # stays metadata-only (code-review r5)
+        self.weight_u = self.create_parameter(
+            [h], default_initializer=Normal(0.0, 1.0))
+        self.weight_v = self.create_parameter(
+            [w], default_initializer=Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        import jax.numpy as jnp
+        from ...ops import apply
+        dim, iters, eps = self.dim, self.power_iters, self.eps
+
+        def fn(wt, u, v):
+            perm = [dim] + [i for i in range(wt.ndim) if i != dim]
+            m = jnp.transpose(wt, perm).reshape(wt.shape[dim], -1)  # [h, w]
+            for _ in range(iters):
+                v = m.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = m @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            # sigma via the CURRENT u/v (no grad through the iteration —
+            # the buffers are constants of this step, matching ref)
+            u = jax.lax.stop_gradient(u)
+            v = jax.lax.stop_gradient(v)
+            sigma = u @ (m @ v)
+            return wt / sigma, u, v
+
+        import jax
+        out, nu, nv = apply(fn, weight, self.weight_u, self.weight_v,
+                            n_outputs=3, name="spectral_norm")
+        # persistent power-iteration state (buffers, not trained)
+        self.weight_u.data = jax.lax.stop_gradient(
+            nu.data if hasattr(nu, "data") else nu)
+        self.weight_v.data = jax.lax.stop_gradient(
+            nv.data if hasattr(nv, "data") else nv)
+        return out

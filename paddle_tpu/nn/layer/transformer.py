@@ -124,24 +124,27 @@ class TransformerEncoderLayer(Layer):
         self.activation = getattr(F, activation)
 
     def forward(self, src, src_mask=None, cache=None):
-        residual = src
-        if self.normalize_before:
-            src = self.norm1(src)
-        if cache is None:
-            src = self.self_attn(src, src, src, src_mask)
-        else:
-            src, incremental_cache = self.self_attn(src, src, src, src_mask,
-                                                    cache)
-        src = residual + self.dropout1(src)
-        if not self.normalize_before:
-            src = self.norm1(src)
-        residual = src
-        if self.normalize_before:
-            src = self.norm2(src)
-        src = self.linear2(self.dropout(self.activation(self.linear1(src))))
-        src = residual + self.dropout2(src)
-        if not self.normalize_before:
-            src = self.norm2(src)
+        # two pre/post-norm sublayers: attention, then the FFN. Each runs
+        # norm -> sublayer -> dropout -> residual (pre-norm) or
+        # sublayer -> dropout -> residual -> norm (post-norm).
+        def sublayer(x, norm, fn, drop):
+            y = fn(norm(x) if self.normalize_before else x)
+            extra = None
+            if isinstance(y, tuple):
+                y, extra = y
+            y = x + drop(y)
+            return (norm(y) if not self.normalize_before else y), extra
+
+        src, incremental_cache = sublayer(
+            src, self.norm1,
+            lambda h: (self.self_attn(h, h, h, src_mask) if cache is None
+                       else self.self_attn(h, h, h, src_mask, cache)),
+            self.dropout1)
+        src, _ = sublayer(
+            src, self.norm2,
+            lambda h: self.linear2(self.dropout(
+                self.activation(self.linear1(h)))),
+            self.dropout2)
         return src if cache is None else (src, incremental_cache)
 
     def gen_cache(self, src):

@@ -52,11 +52,18 @@ def _decode_kernel(page_table_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # [h, d]
         k = k_ref[0].astype(jnp.float32)                       # [p, h, d]
         v = v_ref[0].astype(jnp.float32)
-        # [h, p] logits: per-head contraction over d (batch dim h)
+        # [h, p] logits: per-head contraction over d. Unrolled 2-D dots
+        # over the head dim — Mosaic's dot lowering rejects BATCHED
+        # dot_general dimension numbers (caught by the round-5 TPU
+        # lowering sweep, tests/test_mosaic_lowering.py); h is small and
+        # static at decode, so the unroll is free.
         kt = jnp.swapaxes(k, 0, 1)                             # [h, p, d]
-        logits = jax.lax.dot_general(
-            q, kt, (((1,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)                # [h, p]
+        h_heads = q.shape[0]
+        logits = jnp.concatenate([
+            jax.lax.dot_general(
+                q[i:i + 1], kt[i], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)            # [1, p]
+            for i in range(h_heads)], axis=0)                  # [h, p]
         # mask positions past seq_len within this page
         pos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) + page_start
         logits = jnp.where(pos < seq_len, logits, jnp.float32(NEG_INF))
@@ -79,12 +86,15 @@ def _decode_kernel(page_table_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def wv_diag(w, v, d):
-    """sum_p w[h,p] * v[p,h,d] -> [h,d] without the cross-head product."""
-    # v: [p, h, d] -> [h, p, d]; batched matmul over h: [1,p] @ [p,d]
+    """sum_p w[h,p] * v[p,h,d] -> [h,d] without the cross-head product.
+    Unrolled 2-D dots per head (Mosaic rejects batched dot_general —
+    see _decode_kernel)."""
     vt = jnp.swapaxes(v, 0, 1)                      # [h, p, d]
-    return jax.lax.dot_general(
-        w, vt, (((1,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32)         # [h, d]
+    return jnp.concatenate([
+        jax.lax.dot_general(
+            w[i:i + 1], vt[i], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)     # [1, d]
+        for i in range(w.shape[0])], axis=0)        # [h, d]
 
 
 def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
@@ -150,3 +160,30 @@ def paged_attention_reference(q, k_pages, v_pages, page_table, seq_lens,
         w = jax.nn.softmax(logits, axis=-1)
         outs.append(jnp.einsum("hk,khd->hd", w, vs.astype(jnp.float32)))
     return jnp.stack(outs).astype(q.dtype)
+
+
+def paged_attention_dense(q, k_cache, v_cache, seq_len, scale=None,
+                          page_size=None, interpret=None):
+    """Decode attention over a DENSE per-sequence cache in one launch:
+    the [b, L, h, d] cache is VIEWED as identity-tabled pages (a free
+    reshape) and run through the paged kernel — inline-KV masked MHA as
+    a single kernel, the TPU analog of the reference's
+    fused_multi_transformer masked-MHA core
+    (ref: fused_multi_transformer_op.cu.h:13 — one launch per layer).
+
+    q: [b, h, d]; caches: [b, L, h, d]; seq_len: scalar or [b] filled
+    length (keys < seq_len attend). Returns [b, h, d]."""
+    b, L, h, d = k_cache.shape
+    if page_size is None:
+        page_size = 128
+        while L % page_size:
+            page_size //= 2
+    p = page_size
+    kp = k_cache.reshape(b * (L // p), p, h, d)
+    vp = v_cache.reshape(b * (L // p), p, h, d)
+    table = jnp.arange(b * (L // p), dtype=jnp.int32).reshape(b, L // p)
+    lens = jnp.broadcast_to(jnp.asarray(seq_len, jnp.int32), (b,))
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    return paged_attention(q, kp, vp, table, lens, scale=scale,
+                           interpret=interpret)

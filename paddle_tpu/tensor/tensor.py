@@ -22,6 +22,12 @@ from ..autograd import tape
 def _to_jax(data, dtype=None, place=None):
     if isinstance(data, Tensor):
         data = data.data
+    if isinstance(data, jax.ShapeDtypeStruct):
+        # lazy (meta-init) parameter payload — metadata only
+        # (framework.misc.LazyGuard); computing with it fails loudly
+        if dtype is not None and data.dtype != jnp.dtype(dtype):
+            return jax.ShapeDtypeStruct(data.shape, jnp.dtype(dtype))
+        return data
     if isinstance(data, (jax.Array,)) or hasattr(data, "aval"):
         arr = data
         if dtype is not None and arr.dtype != jnp.dtype(dtype):

@@ -1,0 +1,45 @@
+"""North-star-scale recipes on paper (VERDICT r4 next #7): the LLaMA-7B
+and 13B hybrid configs AOT-compile under LazyGuard (meta init — zero
+parameters materialized) and their per-device memory accounting fits the
+target v5p HBM. Per-device bytes are dp-invariant, so the 8-device
+compile certifies the v5p-128 dp16 placement too.
+ref: BASELINE.json graded configs 3/4; fluid/memory/stats.cc analog."""
+import os
+import sys
+
+import numpy as np
+import pytest
+import jax
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples"))
+
+V5P_HBM = 95e9
+
+
+@pytest.mark.parametrize("name", ["7b", "13b"])
+def test_recipe_fits_target_hbm(name):
+    from pretrain_llama_hybrid import RECIPES, aot_memory_report
+    ma = aot_memory_report(name)
+    assert ma is not None
+    peak = (ma["argument_size_in_bytes"] + ma["temp_size_in_bytes"]
+            + ma["output_size_in_bytes"] - ma["alias_size_in_bytes"])
+    assert peak < V5P_HBM, (
+        f"{name}: {peak / 1e9:.1f} GB exceeds v5p HBM "
+        f"({RECIPES[name]['target']}) — {ma}")
+    # sanity: the recipe is genuinely model-scale (params alone >= 10 GB
+    # of arguments per device once sharded)
+    assert ma["argument_size_in_bytes"] > 10e9, ma
+
+
+def test_lazy_guard_materializes_nothing():
+    """Meta-init parameters carry metadata only; computing with them
+    fails loudly rather than silently allocating."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    with paddle.LazyGuard():
+        lin = nn.Linear(256, 256)
+    assert isinstance(lin.weight.data, jax.ShapeDtypeStruct)
+    assert tuple(lin.weight.shape) == (256, 256)
+    with pytest.raises(Exception):
+        _ = lin(paddle.to_tensor(np.zeros((1, 256), np.float32)))

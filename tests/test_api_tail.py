@@ -1,0 +1,119 @@
+"""Round-5 API-tail closures (VERDICT r4 missing #4/#5): SpectralNorm,
+grouped conv_transpose, audio MFCC/functional/datasets."""
+import math
+import os
+import wave
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class TestSpectralNorm:
+    def test_normalizes_to_unit_sigma(self):
+        """After a few forwards, the normalized weight's top singular
+        value converges to ~1 (ref: nn/layer/norm.py SpectralNorm)."""
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        w = Tensor(jnp.asarray(rng.randn(6, 4) * 3.0, jnp.float32))
+        sn = nn.SpectralNorm([6, 4], dim=0, power_iters=2)
+        for _ in range(8):  # persistent u/v: iterations accumulate
+            out = sn(w)
+        s = np.linalg.svd(np.asarray(out.data), compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+        # direction preserved: out is w / sigma
+        ratio = np.asarray(out.data) / np.asarray(w.data)
+        assert np.allclose(ratio, ratio.flat[0], rtol=1e-3)
+
+    def test_dim_rotation(self):
+        paddle.seed(1)
+        rng = np.random.RandomState(1)
+        w = Tensor(jnp.asarray(rng.randn(3, 8, 2) * 2.0, jnp.float32))
+        sn = nn.SpectralNorm([3, 8, 2], dim=1, power_iters=3)
+        for _ in range(8):
+            out = sn(w)
+        m = np.transpose(np.asarray(out.data), (1, 0, 2)).reshape(8, -1)
+        s = np.linalg.svd(m, compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+
+class TestGroupedConvTranspose:
+    @pytest.mark.parametrize("groups", [2, 4])
+    def test_matches_per_group_composition(self, groups):
+        rng = np.random.RandomState(2)
+        b, cin, L = 2, 8, 16
+        cout_per = 3
+        x = jnp.asarray(rng.randn(b, cin, L), jnp.float32)
+        # ref layout [in_c, out_c/groups, k]
+        w = jnp.asarray(rng.randn(cin, cout_per, 5), jnp.float32)
+        got = F.conv1d_transpose(Tensor(x), Tensor(w), stride=2, padding=1,
+                                 groups=groups)
+        # composition of per-group single convs
+        inp = cin // groups
+        outs = []
+        for g in range(groups):
+            outs.append(np.asarray(F.conv1d_transpose(
+                Tensor(x[:, g * inp:(g + 1) * inp]),
+                Tensor(w[g * inp:(g + 1) * inp]),
+                stride=2, padding=1, groups=1).data))
+        ref = np.concatenate(outs, axis=1)
+        np.testing.assert_allclose(np.asarray(got.data), ref,
+                                   rtol=1e-5, atol=1e-5)
+        assert got.shape[1] == cout_per * groups
+
+    def test_conv2d_transpose_grouped_shape(self):
+        rng = np.random.RandomState(3)
+        x = Tensor(jnp.asarray(rng.randn(1, 4, 8, 8), jnp.float32))
+        w = Tensor(jnp.asarray(rng.randn(4, 2, 3, 3), jnp.float32))
+        out = F.conv2d_transpose(x, w, stride=2, groups=2)
+        assert tuple(out.shape)[:2] == (1, 4)
+
+
+class TestAudio:
+    def test_mfcc_shape_and_dct_orthonormal(self):
+        from paddle_tpu import audio
+        d = np.asarray(audio.create_dct(13, 40).data)  # [13, 40]
+        # DCT-II ortho rows are orthonormal
+        np.testing.assert_allclose(d @ d.T, np.eye(13), atol=1e-6)
+        rng = np.random.RandomState(4)
+        x = Tensor(jnp.asarray(rng.randn(1, 4000) * 0.1, jnp.float32))
+        mf = audio.features.MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=40)
+        out = mf(x)
+        assert out.shape[1] == 13 and np.isfinite(np.asarray(out.data)).all()
+
+    def test_power_to_db_and_windows(self):
+        from paddle_tpu import audio
+        db = audio.power_to_db(Tensor(jnp.asarray([1.0, 10.0, 100.0])),
+                               top_db=None)
+        np.testing.assert_allclose(np.asarray(db.data), [0.0, 10.0, 20.0],
+                                   atol=1e-5)
+        w = audio.functional.get_window("hann", 8)
+        assert abs(float(w.data[0])) < 1e-6 and w.shape[0] == 8
+
+    def test_datasets_read_local_wavs(self, tmp_path):
+        from paddle_tpu import audio
+        # synthesize a tiny TESS-style folder
+        for i, emo in enumerate(["angry", "happy", "sad", "neutral"]):
+            p = tmp_path / f"OAF_word_{emo}.wav"
+            with wave.open(str(p), "wb") as f:
+                f.setnchannels(1)
+                f.setsampwidth(2)
+                f.setframerate(8000)
+                f.writeframes((np.sin(np.arange(800) * 0.1 * (i + 1))
+                               * 20000).astype(np.int16).tobytes())
+        ds = audio.datasets.TESS(root=str(tmp_path), mode="train",
+                                 split_ratio=1.0)
+        assert len(ds) == 4
+        x, y = ds[0]
+        assert x.dtype == np.float32 and 0 <= int(y) < 7
+
+    def test_datasets_missing_root_raises_loudly(self):
+        from paddle_tpu import audio
+        with pytest.raises(RuntimeError, match="no network egress"):
+            audio.datasets.ESC50(root="/nonexistent/esc50")

@@ -421,3 +421,23 @@ class TestAstAutoConversion:
             np.asarray(h(x, True).data), [7.0])
         with pytest.raises(UnboundLocalError, match="extra"):
             h(x, False)
+
+
+def test_concrete_while_inside_to_static_trace():
+    """A converted while over CONCRETE Python values must run as plain
+    Python even inside to_static's trace: jnp ops stage constants into
+    the ambient trace, so the old bool(jnp.reshape(cond)) crashed with
+    TracerBoolConversionError for a loop that was never data-dependent
+    (round-5 verification catch). Also covers the ADVICE r4 fix: `acc`
+    is first assigned inside the body."""
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def count(n):
+        i = 0
+        while i < n:
+            acc = i * 3
+            i = i + 1
+        return acc
+
+    assert int(count(4)) == 9

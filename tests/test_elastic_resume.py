@@ -1,0 +1,159 @@
+"""Elastic failure -> RESTART -> resume-from-checkpoint, end to end with
+REAL processes (VERDICT r4 next #4): a 2-rank DP job checkpoints, one
+worker is SIGKILLed, the observer's watch() detects the lease expiry and
+flips to RESTART, the job relaunches with REWRITTEN endpoints (world 1)
+and resumes from the checkpoint — the full loss trajectory matches an
+uninterrupted single-process run exactly (DP equivalence + exact
+restore).
+ref: python/paddle/distributed/fleet/elastic/manager.py:126,243."""
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+WORKER = os.path.join(os.path.dirname(__file__), "elastic_resume_worker.py")
+
+
+def _spawn(rank, world, phase, store_port, master_port, tmp, job):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PADDLE_", "FLAGS_", "JAX_"))
+           and k not in ("TRAINING_ROLE", "POD_IP")}
+    env.update({
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_TRAINER_ID": str(rank),
+        "MASTER_ADDR": "127.0.0.1",
+        "MASTER_PORT": str(master_port),
+        "ELASTIC_STORE_PORT": str(store_port),
+        "ELASTIC_JOB": job,
+        "ELASTIC_PHASE": phase,
+        "ELASTIC_CKPT": os.path.join(str(tmp), "ck"),
+        "ELASTIC_OUT": os.path.join(str(tmp), "out"),
+        "ELASTIC_WAIT_DIR": str(tmp),
+    })
+    return subprocess.Popen(
+        [sys.executable, WORKER], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, cwd="/root/repo")
+
+
+def _wait_file(path, timeout, procs=()):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(path):
+            return True
+        for p in procs:
+            if p.poll() not in (None, 0):
+                out = p.stdout.read() if p.stdout else ""
+                raise AssertionError(
+                    f"worker died rc={p.returncode}:\n{out[-3000:]}")
+        time.sleep(0.2)
+    return False
+
+
+def test_kill_watch_restart_resume(tmp_path):
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticStatus)
+    from paddle_tpu.distributed.fleet.elastic.tcp_store_backend import (
+        TCPStoreElasticStore)
+
+    job = "elastic-resume-test"
+    store = TCPStoreElasticStore("127.0.0.1", 0, is_master=True,
+                                 world_size=1, poll_interval=0.3)
+    store_port = store._store.port
+    observer = ElasticManager("observer", job_id=job, np=2, min_np=1,
+                              store=store, heartbeat_interval=0.5,
+                              lease_ttl=2)
+    # observe only — never registered, so hosts() tracks the workers
+    master_port = _free_port()
+    procs = [_spawn(r, 2, "1", store_port, master_port, tmp_path, job)
+             for r in range(2)]
+    try:
+        assert _wait_file(str(tmp_path / "done1.0"), 600, procs)
+        assert _wait_file(str(tmp_path / "done1.1"), 600, procs)
+        assert sorted(observer.hosts()) == ["127.0.0.1:9000",
+                                            "127.0.0.1:9001"]
+        # drain join events so the next change is the failure
+        while observer.watch(timeout=1.0) == ElasticStatus.RESTART:
+            pass
+
+        procs[1].send_signal(signal.SIGKILL)
+        status = None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            status = observer.watch(timeout=2.0)
+            if (status == ElasticStatus.RESTART
+                    and len(observer.hosts()) == 1):
+                break
+        assert status == ElasticStatus.RESTART, status
+        env2 = observer.endpoints_env()
+        assert env2["PADDLE_TRAINERS_NUM"] == "1"
+        assert env2["PADDLE_TRAINER_ENDPOINTS"] == "127.0.0.1:9000"
+
+        # elastic restart: the whole job goes down — rank 0 either exits
+        # via the release file or is torn down by the jax.distributed
+        # coordination service's peer-death heartbeat timeout (both are
+        # the reference's semantics: a failed worker takes the job, the
+        # manager restarts it; launch/main.py:162)
+        open(tmp_path / "exit_ok", "w").write("go")
+        procs[0].wait(timeout=120)
+
+        p2 = _spawn(0, int(env2["PADDLE_TRAINERS_NUM"]), "2", store_port,
+                    _free_port(), tmp_path, job)
+        procs.append(p2)
+        assert _wait_file(str(tmp_path / "out.ok.npz"), 600, (p2,))
+        p2.wait(timeout=60)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        store.close()
+
+    data = np.load(tmp_path / "out.ok.npz")
+    got = list(data["phase1"]) + list(data["phase2"])
+
+    # uninterrupted single-process reference (same seeds, full batch).
+    # Phase-1 workers log their RANK-0 SHARD's loss (rank-local metric,
+    # params still follow the full-batch trajectory via the grad
+    # allreduce); mirror that here: log the shard-0 loss, update on the
+    # full batch.
+    sys.path.insert(0, os.path.dirname(__file__))
+    from elastic_resume_worker import build_model, batch
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import optimizer
+    from paddle_tpu.autograd import tape
+    X, Y = batch()
+    model = build_model()
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    ref = []
+    xs, ys = paddle.to_tensor(X), paddle.to_tensor(Y)
+    x0, y0 = paddle.to_tensor(X[0::2]), paddle.to_tensor(Y[0::2])
+    for i in range(6):
+        if i < 3:  # the dp2 phase logged rank 0's shard loss
+            with tape.no_grad():
+                ref.append(float(np.asarray(
+                    F.mse_loss(model(x0), y0).data)))
+            loss = F.mse_loss(model(xs), ys)
+        else:      # the world-1 phase logs the full-batch loss
+            loss = F.mse_loss(model(xs), ys)
+            ref.append(float(np.asarray(loss.data)))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+    np.testing.assert_allclose(got, ref, rtol=1e-5,
+                               err_msg=f"elastic {got} vs straight {ref}")

@@ -1,0 +1,94 @@
+"""fused_mha_decode: the decode step of a layer as ONE kernel launch
+(VERDICT r4 missing #2 / next #5). The Pallas path must match the XLA
+composition exactly, and the generation loop through
+FusedMultiTransformer must be backend-independent.
+ref: paddle/fluid/operators/fused/fused_multi_transformer_op.cu.h:13
+(masked_multihead_attention with inline KV cache)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import force_backend
+from paddle_tpu.tensor.tensor import Tensor
+
+
+def _decode_args(b=2, h=4, d=32, L=64, t=13, s=1, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(b, s, h, d) * 0.3, jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d) * 0.3, jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d) * 0.3, jnp.float32)
+    kb = jnp.asarray(rng.randn(b, L, h, d) * 0.3, jnp.float32)
+    vb = jnp.asarray(rng.randn(b, L, h, d) * 0.3, jnp.float32)
+    return q, k, v, kb, vb, t
+
+
+def test_pallas_path_matches_xla():
+    from paddle_tpu.incubate.nn.layer.fused_transformer import (
+        _decode_attn_pallas, _decode_attn_xla_impl)
+    q, k, v, kb, vb, t = _decode_args()
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    ox, kx, vx = _decode_attn_xla_impl(q, k, v, kb, vb, t=t, scale=scale)
+    op, kp, vp = _decode_attn_pallas(q, k, v, kb, vb, t=t, scale=scale)
+    np.testing.assert_allclose(np.asarray(op), np.asarray(ox),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_array_equal(np.asarray(kp), np.asarray(kx))
+    np.testing.assert_array_equal(np.asarray(vp), np.asarray(vx))
+
+
+def test_multi_token_chunk_falls_back():
+    from paddle_tpu.incubate.nn.layer.fused_transformer import (
+        _decode_attn_pallas, _decode_attn_xla_impl)
+    q, k, v, kb, vb, t = _decode_args(s=4)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    ox, _, _ = _decode_attn_xla_impl(q, k, v, kb, vb, t=t, scale=scale)
+    op, _, _ = _decode_attn_pallas(q, k, v, kb, vb, t=t, scale=scale)
+    np.testing.assert_allclose(np.asarray(op), np.asarray(ox),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_generation_loop_backend_parity():
+    """Greedy-decode 6 tokens through FusedMultiTransformer with the
+    XLA path and with the forced-Pallas path: identical hidden states."""
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+    def run(backend):
+        paddle.seed(3)
+        m = FusedMultiTransformer(embed_dim=64, num_heads=2,
+                                  dim_feedforward=128, num_layers=2)
+        m.eval()
+        caches = m.gen_cache(batch_size=2, max_len=32)
+        rng = np.random.RandomState(5)
+        x = Tensor(jnp.asarray(rng.randn(2, 1, 64) * 0.3, jnp.float32))
+        outs = []
+        ctx = force_backend(backend) if backend else _null()
+        with ctx:
+            for step in range(6):
+                x, caches = m(x, caches=caches, time_step=step)
+                outs.append(np.asarray(x.data))
+        return outs
+
+    import contextlib
+
+    def _null():
+        return contextlib.nullcontext()
+
+    ref = run(None)        # platform default (xla on cpu)
+    pal = run("pallas")    # forced fused kernel (interpret on cpu)
+    for a, b in zip(ref, pal):
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-3)
+
+
+def test_dense_paged_entry_mosaic_lowers():
+    """The identity-table dense view must pass real Mosaic lowering."""
+    from jax import export as jexport
+    from paddle_tpu.ops.pallas.paged_attention import paged_attention_dense
+    b, h, d, L = 2, 8, 128, 256
+    q = jax.ShapeDtypeStruct((b, h, d), jnp.bfloat16)
+    c = jax.ShapeDtypeStruct((b, L, h, d), jnp.bfloat16)
+
+    def f(q_, kc, vc):
+        return paged_attention_dense(q_, kc, vc, 37, interpret=False)
+
+    jexport.export(jax.jit(f), platforms=["tpu"])(q, c, c)

@@ -46,6 +46,25 @@ class IoBoundDataset(ArrayDataset):
         return super().__getitem__(i)
 
 
+class StampedIoDataset(Dataset):
+    """IO-bound fetch that records (start, end, pid) per item so the test
+    can assert concurrency structurally instead of by wall clock."""
+
+    def __init__(self, n=32):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        import os
+        t0 = time.time()
+        time.sleep(0.05)
+        return (np.zeros(4, np.float32),
+                np.asarray([t0, time.time(), float(os.getpid())],
+                           np.float64))
+
+
 class BadDataset(Dataset):
     def __len__(self):
         return 8
@@ -91,29 +110,28 @@ class TestMultiprocessLoader:
         with pytest.raises(RuntimeError, match="died|picklable"):
             list(DataLoader(Local(), batch_size=2, num_workers=2))
 
-    def test_throughput_beats_single_thread_iobound(self):
+    def test_workers_overlap_iobound_fetches(self):
         """IO-bound items (sleep = disk/network fetch): worker processes
-        overlap the waits, >= 1.5x with 4 workers even on one core."""
-        ds = IoBoundDataset(n=128)
-
-        def run(workers):
-            t0 = time.perf_counter()
-            n = 0
-            for x, y in DataLoader(ds, batch_size=4, num_workers=workers):
-                n += int(x.shape[0])
-            assert n == 128
-            return time.perf_counter() - t0
-
-        run(2)  # warm the forkserver (one-time preload cost)
-        # wall-clock assertion on a 1-core box: retry under transient
-        # machine load (observed: passes alone, fails when a full suite
-        # + background jobs contend) before declaring a real regression
-        for attempt in range(3):
-            t1 = run(0)
-            t4 = run(4)
-            if t4 < t1 / 1.5:
-                return
-        assert t4 < t1 / 1.5, (t1, t4)
+        must overlap the waits. Asserted as a STRUCTURAL property — items
+        fetched by >= 2 distinct worker processes, with at least one pair
+        of fetch windows overlapping in time — not as a wall-clock
+        speedup ratio, which flakes under load on the shared 1-core box
+        (VERDICT r4 weak #7)."""
+        ds = StampedIoDataset(n=32)
+        spans = []
+        n = 0
+        for x, stamp in DataLoader(ds, batch_size=4, num_workers=4):
+            n += int(x.shape[0])
+            spans.extend(np.asarray(stamp).reshape(-1, 3).tolist())
+        assert n == 32
+        pids = {int(p) for _, _, p in spans}
+        assert len(pids) >= 2, f"all items fetched by one process: {pids}"
+        # liveness/overlap: some two fetches from DIFFERENT processes ran
+        # concurrently (start_i < end_j and start_j < end_i)
+        overlap = any(
+            a[2] != b[2] and a[0] < b[1] and b[0] < a[1]
+            for i, a in enumerate(spans) for b in spans[i + 1:])
+        assert overlap, f"no concurrent fetches across workers: {spans[:6]}"
 
     @pytest.mark.skipif((__import__("os").cpu_count() or 1) < 3,
                         reason="CPU-bound speedup needs >=3 cores; this "

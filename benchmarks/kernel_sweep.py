@@ -155,6 +155,21 @@ def main():
         check(out, ref, 5e-2)
     case("paged_attention_decode", paged_case)
 
+    def paged_gqa_case():
+        b, h, h_kv, d, p, n_pages, max_pages = 4, 32, 4, 128, 16, 64, 8
+        q = jnp.asarray(rng.randn(b, h, d) * 0.3, jnp.bfloat16)
+        kp = jnp.asarray(rng.randn(n_pages, p, h_kv, d) * 0.3, jnp.bfloat16)
+        vp = jnp.asarray(rng.randn(n_pages, p, h_kv, d) * 0.3, jnp.bfloat16)
+        table = jnp.asarray(
+            rng.permutation(n_pages)[:b * max_pages].reshape(b, max_pages),
+            jnp.int32)
+        lens = jnp.asarray([120, 77, 33, 128], jnp.int32)
+        out = jax.jit(lambda *a: paged_attention(
+            *a, interpret=interpret))(q, kp, vp, table, lens)
+        ref = paged_attention_reference(q, kp, vp, table, lens)
+        check(out, ref, 5e-2)
+    case("paged_attention_gqa_native_cache", paged_gqa_case)
+
     def paged_dense_case():
         b, L, h, d = 2, 256, 8, 128
         q = jnp.asarray(rng.randn(b, h, d) * 0.3, jnp.bfloat16)

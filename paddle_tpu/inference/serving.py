@@ -28,7 +28,8 @@ import jax.numpy as jnp
 from ..tensor.tensor import Tensor
 from ..autograd import tape
 from ..models.llama import LlamaForCausalLM, _rope_cache
-from ..ops.pallas.paged_attention import (paged_attention,
+from ..ops.pallas.paged_attention import (expand_kv_heads,
+                                          paged_attention,
                                           paged_attention_reference)
 from ..ops.pallas.quantized_matmul import quantized_matmul, quantize_weights
 
@@ -125,9 +126,12 @@ class LLMEngine:
         self.n_pages = max_batch * self.max_pages_per_seq
         self.nh = cfg.num_attention_heads
         self.hd = cfg.hidden_size // self.nh
-        # GQA checkpoints: k/v projections emit fewer heads; expanded to
-        # nh right after projection so the paged cache stays uniform
+        # GQA checkpoints: the paged cache keeps the kv head count
         self.nh_kv = getattr(cfg, "num_key_value_heads", self.nh) or self.nh
+        if self.nh % self.nh_kv:
+            raise ValueError(
+                f"num_attention_heads ({self.nh}) must be a multiple of "
+                f"num_key_value_heads ({self.nh_kv})")
         self.quant = quant
         # interpret Pallas kernels off-TPU so the engine runs in CI
         self.interpret = (use_pallas is False) or \
@@ -137,9 +141,9 @@ class LLMEngine:
                  else jnp.float32)
         self.kv_dtype = dtype
         L = cfg.num_hidden_layers
-        self.k_pages = [jnp.zeros((self.n_pages, page_size, self.nh, self.hd),
+        self.k_pages = [jnp.zeros((self.n_pages, page_size, self.nh_kv, self.hd),
                                   dtype) for _ in range(L)]
-        self.v_pages = [jnp.zeros((self.n_pages, page_size, self.nh, self.hd),
+        self.v_pages = [jnp.zeros((self.n_pages, page_size, self.nh_kv, self.hd),
                                   dtype) for _ in range(L)]
         self.allocator = PageAllocator(self.n_pages)
         self._step_fn = None
@@ -158,7 +162,11 @@ class LLMEngine:
 
     # -- math ---------------------------------------------------------------
     def _attn_dense(self, q, k, v):
-        """Prefill attention (causal, dense over the prompt)."""
+        """Prefill attention (causal, dense over the prompt). GQA kv
+        arrives at nh_kv heads; the expansion here is TRANSIENT (prefill
+        activations only) — the cache itself stays at nh_kv."""
+        k = expand_kv_heads(k, q.shape[2])
+        v = expand_kv_heads(v, q.shape[2])
         s = q.shape[1]
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(self.hd)
         tri = jnp.tril(jnp.ones((s, s), bool))
@@ -175,10 +183,9 @@ class LLMEngine:
                                                        self.hd)
         v = _mm(x, wset["wv"], self.interpret).reshape(b, t, self.nh_kv,
                                                        self.hd)
-        if self.nh_kv != self.nh:
-            rep = self.nh // self.nh_kv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        # GQA: k/v STAY at nh_kv heads — the paged cache stores the
+        # checkpoint's kv width (1/rep the HBM of an expanded cache) and
+        # the decode kernel maps q head i -> kv head i // rep natively
         c = cos[pos_ids][..., None, :].astype(q.dtype)
         s = sin[pos_ids][..., None, :].astype(q.dtype)
         d2 = self.hd // 2
@@ -224,14 +231,14 @@ class LLMEngine:
                 slots = (tables[jnp.arange(b)[:, None],
                                 pos // self.page_size]
                          * self.page_size + pos % self.page_size)  # [b,t]
-                kp = k_pages_all[li].reshape(-1, self.nh, self.hd)
-                vp = v_pages_all[li].reshape(-1, self.nh, self.hd)
+                kp = k_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+                vp = v_pages_all[li].reshape(-1, self.nh_kv, self.hd)
                 kp = kp.at[slots].set(k.astype(self.kv_dtype))
                 vp = vp.at[slots].set(v.astype(self.kv_dtype))
                 new_k.append(kp.reshape(self.n_pages, self.page_size,
-                                        self.nh, self.hd))
+                                        self.nh_kv, self.hd))
                 new_v.append(vp.reshape(self.n_pages, self.page_size,
-                                        self.nh, self.hd))
+                                        self.nh_kv, self.hd))
             h = _rms(h, W["norm"], W["eps"])
             h_last = jax.lax.dynamic_index_in_dim(h, t0 - 1, axis=1)
             logits = _mm(h_last, W["head"], self.interpret)
@@ -255,12 +262,12 @@ class LLMEngine:
                 q, k, v = self._layer_qkv(wset, h, pos_ids)
                 # write this token's kv at each sequence's slot
                 slots = (tables[jnp.arange(b), lens // p] * p + lens % p)
-                kp = k_pages_all[li].reshape(-1, self.nh, self.hd)
-                vp = v_pages_all[li].reshape(-1, self.nh, self.hd)
+                kp = k_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+                vp = v_pages_all[li].reshape(-1, self.nh_kv, self.hd)
                 kp = kp.at[slots].set(k[:, 0].astype(self.kv_dtype))
                 vp = vp.at[slots].set(v[:, 0].astype(self.kv_dtype))
-                kp = kp.reshape(self.n_pages, p, self.nh, self.hd)
-                vp = vp.reshape(self.n_pages, p, self.nh, self.hd)
+                kp = kp.reshape(self.n_pages, p, self.nh_kv, self.hd)
+                vp = vp.reshape(self.n_pages, p, self.nh_kv, self.hd)
                 new_k.append(kp)
                 new_v.append(vp)
                 attn = paged_attention(q[:, 0], kp, vp, tables, lens + 1,
@@ -276,7 +283,7 @@ class LLMEngine:
         """Fresh pools + allocator — a failed call's donated buffers are
         gone, and so is every in-flight sequence's cache."""
         L = self.cfg.num_hidden_layers
-        shape = (self.n_pages, self.page_size, self.nh, self.hd)
+        shape = (self.n_pages, self.page_size, self.nh_kv, self.hd)
         self.k_pages = [jnp.zeros(shape, self.kv_dtype) for _ in range(L)]
         self.v_pages = [jnp.zeros(shape, self.kv_dtype) for _ in range(L)]
         self.allocator = PageAllocator(self.n_pages)

@@ -70,10 +70,11 @@ def _decode_math(model, ids, caches, pos, max_len):
             return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], -1)
 
         q, k = rope(q), rope(k)
-        if k.shape[2] != nh:  # expand to query heads for the cache/attn
-            rep = nh // k.shape[2]
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        # expand to query heads for the dense cache/attn (shared GQA
+        # convention — ops/pallas/paged_attention.expand_kv_heads)
+        from ..ops.pallas.paged_attention import expand_kv_heads
+        k = expand_kv_heads(k, nh)
+        v = expand_kv_heads(v, nh)
         k_buf, v_buf = caches[li]
         k_buf = jax.lax.dynamic_update_slice_in_dim(k_buf, k.astype(
             k_buf.dtype), pos, axis=1)

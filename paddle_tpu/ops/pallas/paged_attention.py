@@ -7,8 +7,12 @@ masked_multihead_attention; PAPERS.md ragged paged attention).
 
 Layout:
   q          : [b, h, d]            (one decode token per sequence)
-  k_pages    : [n_pages, p, h, d]   (p = page_size tokens per page)
-  v_pages    : [n_pages, p, h, d]
+  k_pages    : [n_pages, p, h_kv, d]  (p = page_size tokens per page;
+                                       h_kv <= h for GQA — the cache is
+                                       stored at the checkpoint's kv
+                                       head count, q head i attends kv
+                                       head i // (h // h_kv))
+  v_pages    : [n_pages, p, h_kv, d]
   page_table : [b, max_pages] int32 (physical page id per logical page;
                                      entries past the sequence are ignored)
   seq_lens   : [b] int32            (tokens filled per sequence)
@@ -31,7 +35,8 @@ NEG_INF = -1e30
 
 
 def _decode_kernel(page_table_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, p, d, n_pages_max, scale):
+                   m_scr, l_scr, acc_scr, *, p, d, n_pages_max, scale,
+                   rep=1):
     b = pl.program_id(0)
     pi = pl.program_id(1)
 
@@ -57,13 +62,18 @@ def _decode_kernel(page_table_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
         # dot_general dimension numbers (caught by the round-5 TPU
         # lowering sweep, tests/test_mosaic_lowering.py); h is small and
         # static at decode, so the unroll is free.
-        kt = jnp.swapaxes(k, 0, 1)                             # [h, p, d]
-        h_heads = q.shape[0]
+        # GQA-native: q heads [g*rep, (g+1)*rep) attend kv head g — the
+        # cache stays at h_kv heads (1/rep the HBM of an expanded cache)
+        # and the rep heads of a group share ONE [rep, d] x [d, p] dot
+        # (single-row dots would waste MXU rows, code-review r5)
+        kt = jnp.swapaxes(k, 0, 1)                             # [h_kv, p, d]
+        h_kv = kt.shape[0]
         logits = jnp.concatenate([
             jax.lax.dot_general(
-                q[i:i + 1], kt[i], (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)            # [1, p]
-            for i in range(h_heads)], axis=0)                  # [h, p]
+                q[g * rep:(g + 1) * rep], kt[g],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)            # [rep, p]
+            for g in range(h_kv)], axis=0)                     # [h, p]
         # mask positions past seq_len within this page
         pos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) + page_start
         logits = jnp.where(pos < seq_len, logits, jnp.float32(NEG_INF))
@@ -75,8 +85,8 @@ def _decode_kernel(page_table_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
         alpha = jnp.exp(m_prev - m_new)
         l_scr[...] = jnp.broadcast_to(
             alpha * l_prev + jnp.sum(w, axis=-1, keepdims=True), l_scr.shape)
-        # [h, d] accumulation: sum_p w[h, p] * v[p, h, d]
-        acc_scr[...] = alpha * acc_scr[...] + wv_diag(w, v, d)
+        # [h, d] accumulation: sum_p w[h, p] * v[p, h_kv, d]
+        acc_scr[...] = alpha * acc_scr[...] + wv_diag(w, v, d, rep=rep)
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
 
     @pl.when(pi == n_pages_max - 1)
@@ -85,25 +95,42 @@ def _decode_kernel(page_table_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_scr[...] / l_fin).astype(o_ref.dtype)
 
 
-def wv_diag(w, v, d):
-    """sum_p w[h,p] * v[p,h,d] -> [h,d] without the cross-head product.
-    Unrolled 2-D dots per head (Mosaic rejects batched dot_general —
-    see _decode_kernel)."""
-    vt = jnp.swapaxes(v, 0, 1)                      # [h, p, d]
+def wv_diag(w, v, d, rep=1):
+    """sum_p w[h,p] * v[p,h_kv,d] -> [h,d] without the cross-head
+    product; q heads [g*rep, (g+1)*rep) read kv head g (GQA), one
+    [rep, p] x [p, d] dot per kv head. Unrolled 2-D dots (Mosaic
+    rejects batched dot_general — see _decode_kernel)."""
+    vt = jnp.swapaxes(v, 0, 1)                      # [h_kv, p, d]
     return jnp.concatenate([
         jax.lax.dot_general(
-            w[i:i + 1], vt[i], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)     # [1, d]
-        for i in range(w.shape[0])], axis=0)        # [h, d]
+            w[g * rep:(g + 1) * rep], vt[g], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)     # [rep, d]
+        for g in range(vt.shape[0])], axis=0)       # [h, d]
+
+
+def expand_kv_heads(x, h_q):
+    """[..., h_kv, d] -> [..., h_q, d] by repeating each kv head over its
+    query group (jnp.repeat semantics — THE head-grouping convention all
+    GQA paths share: this kernel's i // rep mapping, the engine's dense
+    prefill, models/generation.py). Identity when heads already match."""
+    h_kv = x.shape[-2]
+    if h_kv == h_q:
+        return x
+    assert h_q % h_kv == 0, (x.shape, h_q)
+    return jnp.repeat(x, h_q // h_kv, axis=-2)
 
 
 def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
                     interpret=False):
-    """q: [b, h, d]; pages: [n_pages, p, h, d]; page_table: [b, max_pages]
-    int32; seq_lens: [b] int32. Returns [b, h, d]."""
+    """q: [b, h, d]; pages: [n_pages, p, h_kv, d] with h % h_kv == 0
+    (GQA: q head i attends kv head i // (h // h_kv) — the cache is kept
+    at the CHECKPOINT's kv head count, ref GQA repeat_kv removed);
+    page_table: [b, max_pages] int32; seq_lens: [b] int32.
+    Returns [b, h, d]."""
     b, h, d = q.shape
-    n_pages, p, hh, dd = k_pages.shape
-    assert (hh, dd) == (h, d)
+    n_pages, p, h_kv, dd = k_pages.shape
+    assert dd == d and h % h_kv == 0, (q.shape, k_pages.shape)
+    rep = h // h_kv
     max_pages = page_table.shape[1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
 
@@ -112,15 +139,15 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
     lens = seq_lens.astype(jnp.int32)
 
     kernel = functools.partial(_decode_kernel, p=p, d=d,
-                               n_pages_max=max_pages, scale=s)
+                               n_pages_max=max_pages, scale=s, rep=rep)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, max_pages),
         in_specs=[
             pl.BlockSpec((1, h, d), lambda bb, pi, tbl, ln: (bb, 0, 0)),
-            pl.BlockSpec((1, p, h, d),
+            pl.BlockSpec((1, p, h_kv, d),
                          lambda bb, pi, tbl, ln: (tbl[bb, pi], 0, 0, 0)),
-            pl.BlockSpec((1, p, h, d),
+            pl.BlockSpec((1, p, h_kv, d),
                          lambda bb, pi, tbl, ln: (tbl[bb, pi], 0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, h, d), lambda bb, pi, tbl, ln: (bb, 0, 0)),
@@ -144,15 +171,19 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
 
 def paged_attention_reference(q, k_pages, v_pages, page_table, seq_lens,
                               scale=None):
-    """XLA reference for tests: gather pages then plain softmax attention."""
+    """XLA reference for tests: gather pages then plain softmax attention
+    (GQA: kv heads repeated up to the q head count)."""
     b, h, d = q.shape
-    n_pages, p, _, _ = k_pages.shape
+    n_pages, p, h_kv, _ = k_pages.shape
     max_pages = page_table.shape[1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     outs = []
     for i in range(b):
-        ks = k_pages[page_table[i]].reshape(max_pages * p, h, d)
-        vs = v_pages[page_table[i]].reshape(max_pages * p, h, d)
+        ks = k_pages[page_table[i]].reshape(max_pages * p, h_kv, d)
+        vs = v_pages[page_table[i]].reshape(max_pages * p, h_kv, d)
+        if h_kv != h:
+            ks = jnp.repeat(ks, h // h_kv, axis=1)
+            vs = jnp.repeat(vs, h // h_kv, axis=1)
         L = int(seq_lens[i])
         ks, vs = ks[:L], vs[:L]
         logits = jnp.einsum("hd,khd->hk", q[i].astype(jnp.float32),

@@ -90,3 +90,23 @@ class TestQuantizedMatmul:
                @ (wq.astype(jnp.float32) * sc[None, :]))
         np.testing.assert_allclose(np.asarray(out, np.float32),
                                    np.asarray(ref), rtol=5e-2, atol=5e-1)
+
+
+def test_paged_attention_gqa_native():
+    """q heads attend their kv group without cache expansion."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.paged_attention import (
+        paged_attention, paged_attention_reference)
+    rng = np.random.RandomState(7)
+    b, h, h_kv, d, p, n_pages, max_pages = 2, 8, 2, 32, 8, 16, 4
+    q = jnp.asarray(rng.randn(b, h, d) * 0.3, jnp.float32)
+    kp = jnp.asarray(rng.randn(n_pages, p, h_kv, d) * 0.3, jnp.float32)
+    vp = jnp.asarray(rng.randn(n_pages, p, h_kv, d) * 0.3, jnp.float32)
+    table = jnp.asarray(rng.permutation(n_pages)[:b * max_pages]
+                        .reshape(b, max_pages), jnp.int32)
+    lens = jnp.asarray([29, 17], jnp.int32)
+    out = paged_attention(q, kp, vp, table, lens, interpret=True)
+    ref = paged_attention_reference(q, kp, vp, table, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)

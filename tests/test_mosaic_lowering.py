@@ -146,3 +146,13 @@ class TestOtherKernelsLowering:
         s = _sds((1024,), jnp.float32)
 
         _lower_tpu(quantized_matmul, x, w, s)
+
+    def test_paged_attention_gqa_decode(self):
+        """GQA-native cache (h_kv < h_q) must lower for TPU too."""
+        from paddle_tpu.ops.pallas.paged_attention import paged_attention
+        b, h, h_kv, d, p, n_pages, max_pages = 4, 32, 4, 128, 16, 32, 8
+        q = _sds((b, h, d), jnp.bfloat16)
+        pages = _sds((n_pages, p, h_kv, d), jnp.bfloat16)
+        table = _sds((b, max_pages), jnp.int32)
+        lens = _sds((b,), jnp.int32)
+        _lower_tpu(paged_attention, q, pages, pages, table, lens)

@@ -62,3 +62,30 @@ class TestLLMEngine:
         # least start identically (same argmax under ~1% weight error)
         assert np.array_equal(got[:, :ids.shape[1] + 2],
                               ref[:, :ids.shape[1] + 2]), (got, ref)
+
+
+class TestGQANativeCache:
+    """GQA serving keeps the KV cache at the CHECKPOINT's kv head count
+    (round 5 — the former engine expanded K/V to nh before caching,
+    rep x the HBM; ref: the repeat_kv-free GQA decode kernels)."""
+
+    def _gqa_model(self):
+        paddle.seed(4)
+        cfg = LlamaConfig.tiny()
+        cfg.num_key_value_heads = max(1, cfg.num_attention_heads // 2)
+        return LlamaForCausalLM(cfg), cfg
+
+    def test_cache_stored_at_kv_head_count(self):
+        model, cfg = self._gqa_model()
+        eng = LLMEngine(model, max_len=64, page_size=16, max_batch=2)
+        assert eng.k_pages[0].shape[2] == cfg.num_key_value_heads
+        assert eng.k_pages[0].shape[2] < cfg.num_attention_heads
+
+    def test_gqa_paged_decode_matches_dense_generate(self):
+        model, cfg = self._gqa_model()
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, cfg.vocab_size, (2, 12)).astype(np.int64)
+        ref = generate(model, ids, max_new_tokens=8)
+        eng = LLMEngine(model, max_len=64, page_size=16, max_batch=2)
+        got = eng.generate(ids, max_new_tokens=8)
+        np.testing.assert_array_equal(got, ref)

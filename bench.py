@@ -66,7 +66,7 @@ def _init_backend_with_retry(retries=5, base_delay=5.0, probe_timeout=120.0):
 
 
 def _measure(cfg, bs, seq, steps, warmup, dtype, recompute, on_tpu,
-             moment_dtype="float32", **trainer_kw):
+             moment_dtype="float32", lazy=False, **trainer_kw):
     import jax
     import numpy as np
     import paddle_tpu as paddle
@@ -77,7 +77,16 @@ def _measure(cfg, bs, seq, steps, warmup, dtype, recompute, on_tpu,
     mesh = build_mesh({"data": 1, "pipe": 1, "sharding": 1, "model": 1})
     set_global_mesh(mesh)
     paddle.seed(0)
-    model = LlamaForCausalLM(cfg)
+    if lazy:
+        # meta init: init_state materializes leaves straight to bf16 in
+        # place — an eager f32 1.3B model (5.4 GB) alongside the bf16
+        # state + moments (7.5 GB) + step temps (6.8 GB) is exactly the
+        # r5 RESOURCE_EXHAUSTED; LazyGuard keeps peak at the step's own
+        # 14.4 GB AOT accounting.
+        with paddle.LazyGuard():
+            model = LlamaForCausalLM(cfg)
+    else:
+        model = LlamaForCausalLM(cfg)
     trainer = SpmdTrainer(model, mesh, lr=1e-4, param_dtype=dtype,
                           recompute=recompute, moment_dtype=moment_dtype,
                           **trainer_kw)
@@ -107,17 +116,11 @@ def _measure(cfg, bs, seq, steps, warmup, dtype, recompute, on_tpu,
     flops_per_token = 6 * n_params + attn
     peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak; nominal for cpu
     mfu = tokens_per_sec * flops_per_token / peak
-    # drop this model's device state BEFORE the next (bigger) config
-    # compiles: donated buffers die with `state`, compiled executables
-    # with the cache clear — the 1.3B config only fits a fresh chip
-    del state, trainer, model, loss
-    import gc
-    gc.collect()
-    jax.clear_caches()
     return tokens_per_sec, mfu, n_params
 
 
-def _run():
+def _run_config(which):
+    """Run ONE config in THIS process and print its raw result JSON."""
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig
     from paddle_tpu.distributed import fleet
@@ -130,60 +133,107 @@ def _run():
                                "pp_degree": 1, "sharding_degree": 1}
     fleet.init(is_collective=True, strategy=strategy)
 
-    if on_tpu:
-        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
-                          intermediate_size=2816, num_hidden_layers=16,
+    if which == "llama350m":
+        if on_tpu:
+            cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                              intermediate_size=2816, num_hidden_layers=16,
+                              num_attention_heads=16,
+                              max_position_embeddings=1024)
+            bs, rc = 32, True
+            tok, mfu, n = _measure(cfg, bs, 1024, 20, 3, "bfloat16",
+                                   rc, on_tpu)
+        else:  # smoke mode for CI/dev boxes
+            cfg = LlamaConfig.tiny()
+            bs, rc = 4, False
+            tok, mfu, n = _measure(cfg, bs, 64, 5, 2, "float32",
+                                   rc, on_tpu)
+    elif which == "llama1p3b":
+        # GPT-3-1.3B geometry (h2048 L24 d=128 — MXU-friendly head dim),
+        # bf16 params + bf16 adam moments (f32 update math) + full
+        # recompute — the single-16G-chip configuration (BASELINE.json
+        # graded config 3 class). LazyGuard meta init: the step's own
+        # 14.4 GB AOT footprint is the whole footprint.
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=24,
                           num_attention_heads=16,
                           max_position_embeddings=1024)
-        bs350, rc350 = 32, True
-        tok350, mfu350, _ = _measure(cfg, bs350, 1024, 20, 3, "bfloat16",
-                                     rc350, on_tpu)
-    else:  # smoke mode for CI/dev boxes
-        cfg = LlamaConfig.tiny()
-        bs350, rc350 = 4, False
-        tok350, mfu350, _ = _measure(cfg, bs350, 64, 5, 2, "float32",
-                                     rc350, on_tpu)
+        bs, rc = 8, True
+        tok, mfu, n = _measure(cfg, bs, 1024, 10, 2, "bfloat16", rc,
+                               on_tpu, moment_dtype="bfloat16",
+                               recompute_policy="full", ce_chunk=2048,
+                               lazy=True)
+    else:
+        raise ValueError(f"unknown config {which!r}")
+    _emit({"config": which, "tokens_per_sec": round(tok, 2),
+           "mfu": round(mfu, 4), "batch_size": bs, "recompute": rc,
+           "n_params": n, "backend": devs[0].platform})
 
-    # HEADLINE metric (round-5): GPT-3-1.3B geometry (h2048 L24 d=128 —
-    # MXU-friendly head dim), bf16 params + bf16 adam moments (f32 update
-    # math) + recompute — the single-16G-chip configuration
-    # (BASELINE.json graded config 3 class). llama350m rides along as the
-    # cross-round comparison point.
-    extra = {"llama350m_tokens_per_sec_per_chip": round(tok350, 2),
-             "llama350m_mfu": round(mfu350, 4),
-             "llama350m_batch_size": bs350}
-    headline = ("llama350m_tokens_per_sec_per_chip", tok350, mfu350)
-    if on_tpu:
+
+def _run_config_subprocess(which, timeout=1800):
+    """Each config gets a FRESH process (and thus a fresh chip): the axon
+    tunnel overcommits HBM instead of failing allocation, so residue from
+    a previous config silently pages the next one to host memory (r5:
+    in-process 1.3B measured 13% MFU vs 52% fresh — 4x off, same code)."""
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--config", which],
+        capture_output=True, text=True, timeout=timeout)
+    for line in reversed(proc.stdout.strip().splitlines()):
         try:
-            cfg13 = LlamaConfig(vocab_size=32000, hidden_size=2048,
-                                intermediate_size=5504,
-                                num_hidden_layers=24,
-                                num_attention_heads=16,
-                                max_position_embeddings=1024)
-            tok13, mfu13, n13 = _measure(cfg13, 8, 1024, 10, 2,
-                                         "bfloat16", True, on_tpu,
-                                         moment_dtype="bfloat16",
-                                         recompute_policy="full",
-                                         ce_chunk=2048)
-            extra["llama1p3b_params"] = n13
-            headline = ("llama1p3b_tokens_per_sec_per_chip", tok13, mfu13)
-        except Exception as e:  # noqa: BLE001 — report, don't fail the bench
-            extra["llama1p3b_error"] = f"{type(e).__name__}: {e}"[:200]
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if d.get("config") == which:
+            if "error" in d:
+                raise RuntimeError(f"config {which}: {d['error']}"[:400])
+            return d
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-12:]
+    raise RuntimeError(f"config {which} produced no result "
+                       f"(rc={proc.returncode}): {' | '.join(tail)}"[:400])
 
-    name, tok, mfu = headline
+
+def _run():
+    r350 = _run_config_subprocess("llama350m")
+    extra = {"llama350m_tokens_per_sec_per_chip": r350["tokens_per_sec"],
+             "llama350m_mfu": r350["mfu"],
+             "llama350m_batch_size": r350["batch_size"]}
+    headline = ("llama350m_tokens_per_sec_per_chip",
+                r350["tokens_per_sec"], r350["mfu"], r350["recompute"])
+
+    # HEADLINE metric (round-5): the 1.3B d=128 config, TPU only.
+    if r350["backend"] not in ("cpu",):
+        try:
+            r13 = _run_config_subprocess("llama1p3b")
+            extra["llama1p3b_params"] = r13["n_params"]
+            headline = ("llama1p3b_tokens_per_sec_per_chip",
+                        r13["tokens_per_sec"], r13["mfu"],
+                        r13["recompute"])
+        except Exception as e:  # noqa: BLE001 — report, don't fail the bench
+            extra["llama1p3b_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    name, tok, mfu, rc = headline
     _emit({
         "metric": name,
-        "value": round(tok, 2),
+        "value": tok,
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4),
-        "mfu": round(mfu, 4),
-        "recompute": (True if name.startswith("llama1p3b") else rc350),
-        "backend": devs[0].platform,
+        "mfu": mfu,
+        "recompute": rc,
+        "backend": r350["backend"],
         **extra,
     })
 
 
 def main():
+    if "--config" in sys.argv:
+        which = sys.argv[sys.argv.index("--config") + 1]
+        try:
+            _run_config(which)
+        except Exception as e:
+            traceback.print_exc()
+            _emit({"config": which, "error": f"{type(e).__name__}: {e}"})
+            os._exit(1)
+        os._exit(0)  # non-daemon backend threads must not block exit
     try:
         _run()
     except Exception as e:

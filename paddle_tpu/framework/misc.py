@@ -107,6 +107,30 @@ class LazyGuard:
         return False
 
 
+def materialize_lazy(param):
+    """Run the initializer a LazyGuard parameter recorded, returning the
+    real array the eager path would have produced (same RNG key, replayed
+    verbatim). Transient: the module keeps its meta placeholder — callers
+    (SpmdTrainer._init_params12) cast/shard the result and drop it, so a
+    13B model never holds a second full-precision copy in HBM."""
+    import jax
+    if not isinstance(getattr(param, "data", None), jax.ShapeDtypeStruct):
+        return param.data
+    lazy = getattr(param, "_lazy_init", None)
+    if lazy is None:
+        raise RuntimeError(
+            f"parameter {getattr(param, 'name', None)!r} is lazy (meta "
+            f"init) but recorded no initializer; construct the model "
+            f"under framework.LazyGuard to make it materializable")
+    initfn, key = lazy
+    sds = param.data
+    if key is None:
+        return initfn(sds.shape, sds.dtype)
+    from . import random as rnd
+    with rnd.replay_key(key):
+        return initfn(sds.shape, sds.dtype)
+
+
 def batch(reader, batch_size, drop_last=False):
     """ref: python/paddle/batch.py — legacy reader combinator."""
 

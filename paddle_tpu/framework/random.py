@@ -85,8 +85,29 @@ def key_scope(key):
         _key_stack.pop()
 
 
+# Keys recorded at LazyGuard construction time, handed back verbatim when
+# the deferred initializer finally runs — lazy materialization draws the
+# EXACT key the eager path would have, so lazy == eager parameter-for-
+# parameter no matter when/in what order materialization happens.
+_replay_stack = []
+
+
+@contextlib.contextmanager
+def replay_key(key):
+    """Make the next next_key() call return `key` itself."""
+    _replay_stack.append(key)
+    try:
+        yield
+    finally:
+        if _replay_stack and _replay_stack[-1] is key:
+            _replay_stack.pop()
+
+
 def next_key():
-    """Key for one random draw: trace-scope key if bound, else global split."""
+    """Key for one random draw: replayed lazy-init key if armed, else
+    trace-scope key if bound, else global split."""
+    if _replay_stack:
+        return _replay_stack.pop()
     if _key_stack:
         box = _key_stack[-1]
         box[1] += 1

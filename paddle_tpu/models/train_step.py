@@ -312,16 +312,26 @@ class SpmdTrainer:
 
     # ---- state ------------------------------------------------------------
     def _init_params12(self):
+        from ..framework.misc import materialize_lazy
         cast = (lambda a: a.astype(self._pdt)
                 if self._pdt is not None and jnp.issubdtype(a.dtype, jnp.floating)
                 else a)
-        outer = [cast(p.data) for p in self.outer_tensors]
+
+        def fetch(p):
+            # LazyGuard models materialize HERE, one leaf at a time, cast
+            # straight to param_dtype: peak extra HBM = one f32 leaf, not
+            # a full second model copy (the 1.3B bench OOM of r5).
+            if isinstance(p.data, jax.ShapeDtypeStruct):
+                return cast(materialize_lazy(p))
+            return cast(p.data)
+
+        outer = [fetch(p) for p in self.outer_tensors]
         stacked = []
         for pi, name in enumerate(self.layer_param_names):
             arrs = []
             for li in self.phys_order:  # physical (chunk-major) order
-                arrs.append(cast(
-                    dict(_named_params(self.decoders[li]))[name].data))
+                arrs.append(fetch(
+                    dict(_named_params(self.decoders[li]))[name]))
             stacked.append(jnp.stack(arrs, axis=0))  # [L, ...]
         params = {"outer": outer, "stacked": stacked}
         return jax.tree_util.tree_map(

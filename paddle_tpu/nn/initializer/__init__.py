@@ -25,11 +25,21 @@ def _fan_in_out(shape):
 
 
 class Initializer:
+    # Whether __call__ consumes rnd.next_key() draws — and if True, the
+    # contract is EXACTLY ONE draw per call: LazyGuard construction
+    # pre-draws that single key so deferred materialization reproduces
+    # the eager parameter exactly (framework/misc.py materialize_lazy).
+    # A subclass drawing more than one key must set uses_rng = False and
+    # manage its own determinism.
+    uses_rng = True
+
     def __call__(self, shape, dtype):
         raise NotImplementedError
 
 
 class Constant(Initializer):
+    uses_rng = False
+
     def __init__(self, value=0.0):
         self.value = value
 
@@ -116,6 +126,8 @@ class KaimingNormal(Initializer):
 
 
 class Assign(Initializer):
+    uses_rng = False
+
     def __init__(self, value, name=None):
         self.value = value
 
@@ -138,6 +150,8 @@ class Orthogonal(Initializer):
 
 
 class Dirac(Initializer):
+    uses_rng = False
+
     def __init__(self, groups=1, name=None):
         self.groups = groups
 

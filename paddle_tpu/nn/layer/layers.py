@@ -80,14 +80,28 @@ class Layer:
         if initfn is None:
             initfn = init.Constant(0.0) if is_bias else init.XavierUniform()
         from ...framework.misc import LazyGuard
+        lazy_init = None
         if LazyGuard._active[0]:
             # meta init: metadata only, nothing materialized (ref:
-            # fluid/lazy_init.py) — AOT recipes build 7B/13B models this way
+            # fluid/lazy_init.py) — AOT recipes build 7B/13B models this
+            # way. For in-tree Initializers (which declare uses_rng and
+            # draw exactly one key), the key the eager path would draw is
+            # consumed NOW (16 bytes) and recorded, so materialization
+            # (SpmdTrainer.init_state) reproduces the eager parameters
+            # exactly, in any order. A plain callable with no uses_rng
+            # declaration gets NO pre-draw — it materializes against the
+            # live stream, with no cross-order parity promise.
+            from ...framework import random as rnd
+            lazy_key = (rnd.next_key()
+                        if getattr(initfn, "uses_rng", None) else None)
             data = jax.ShapeDtypeStruct(
                 tuple(int(s) for s in shape), jnp.dtype(dtype))
+            lazy_init = (initfn, lazy_key)
         else:
             data = initfn(shape, dtype)
         p = Parameter(data, trainable=trainable, name=name)
+        if lazy_init is not None:
+            p._lazy_init = lazy_init
         p.optimize_attr = {"learning_rate": lr}
         p.regularizer = regularizer
         return p

@@ -9,10 +9,15 @@ Runs on the real chip under the default (axon) platform; CPU smoke with
 tiny shapes otherwise. (The driver-facing training bench stays bench.py.)
 """
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+# runnable from anywhere: the script dir (benchmarks/) is what lands on
+# sys.path, not the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():

@@ -158,7 +158,10 @@ class LLMEngine:
             min(int(x), max_batch) for x in batch_buckets)))
             if batch_buckets is not None else None)
         cos, sin = _rope_cache(max_len, self.hd, cfg.rope_theta, jnp.float32)
-        self.rope = (cos, sin)
+        # rope tables ride inside the weight pytree so the jitted
+        # prefill/step never closure-capture arrays (HLO-constant bloat)
+        self.weights["cos"] = cos
+        self.weights["sin"] = sin
 
     # -- math ---------------------------------------------------------------
     def _attn_dense(self, q, k, v):
@@ -174,8 +177,8 @@ class LLMEngine:
         w = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", w, v)
 
-    def _layer_qkv(self, wset, h, pos_ids):
-        cos, sin = self.rope
+    def _layer_qkv(self, W, wset, h, pos_ids):
+        cos, sin = W["cos"], W["sin"]
         b, t, H = h.shape
         x = _rms(h, wset["ln1"], self.weights["eps"])
         q = _mm(x, wset["wq"], self.interpret).reshape(b, t, self.nh, self.hd)
@@ -212,18 +215,24 @@ class LLMEngine:
         page_size, so at most max_len/page_size variants ever compile).
         Padded positions write garbage KV into slots past t0 — harmless:
         paged attention masks by lens, and each decode step overwrites its
-        slot before reading it."""
-        W = self.weights
+        slot before reading it.
 
-        def prefill(ids, k_pages_all, v_pages_all, tables, t0):
-            """ids [b, t_pad]; t0 = true prompt length (dynamic)."""
+        Weights ride as an ARGUMENT pytree, never a closure capture:
+        captured arrays lower to constants embedded in the HLO proto, and
+        a whole-model constant blob makes compiles pathological (measured
+        80s for a single 64 MB captured matmul vs 0.9s as an argument on
+        the tunneled v5e — a full snapshot never finished at all)."""
+
+        def prefill(W, ids, k_pages_all, v_pages_all, tables, t0):
+            """W: weight pytree; ids [b, t_pad]; t0 = true prompt length
+            (dynamic)."""
             b = ids.shape[0]
             h = jnp.take(W["emb"], ids, axis=0).astype(self.kv_dtype)
             pos_ids = jnp.broadcast_to(jnp.arange(t_pad)[None, :],
                                        (b, t_pad))
             new_k, new_v = [], []
             for li, wset in enumerate(W["layers"]):
-                q, k, v = self._layer_qkv(wset, h, pos_ids)
+                q, k, v = self._layer_qkv(W, wset, h, pos_ids)
                 attn = self._attn_dense(q, k, v)
                 h = self._layer_tail(wset, h, attn)
                 # scatter every sequence's kv into its pages at once
@@ -244,22 +253,23 @@ class LLMEngine:
             logits = _mm(h_last, W["head"], self.interpret)
             return logits[:, 0], new_k, new_v
 
-        return jax.jit(prefill, donate_argnums=(1, 2))
+        return jax.jit(prefill, donate_argnums=(2, 3))
 
     # -- decode step ----------------------------------------------------------
     def _build_step(self):
-        W = self.weights
         p = self.page_size
 
-        def step(tok, k_pages_all, v_pages_all, tables, lens):
-            """tok [b]; lens [b] = tokens already in cache (position of this
-            token). One token for EVERY slot; masked by caller."""
+        def step(W, tok, k_pages_all, v_pages_all, tables, lens):
+            """W: weight pytree (argument, not capture — see
+            _build_prefill); tok [b]; lens [b] = tokens already in cache
+            (position of this token). One token for EVERY slot; masked by
+            caller."""
             b = tok.shape[0]
             h = jnp.take(W["emb"], tok[:, None], axis=0).astype(self.kv_dtype)
             pos_ids = lens[:, None]                      # ragged positions
             new_k, new_v = [], []
             for li, wset in enumerate(W["layers"]):
-                q, k, v = self._layer_qkv(wset, h, pos_ids)
+                q, k, v = self._layer_qkv(W, wset, h, pos_ids)
                 # write this token's kv at each sequence's slot
                 slots = (tables[jnp.arange(b), lens // p] * p + lens % p)
                 kp = k_pages_all[li].reshape(-1, self.nh_kv, self.hd)
@@ -277,7 +287,7 @@ class LLMEngine:
             logits = _mm(h, W["head"], self.interpret)
             return logits[:, 0], new_k, new_v
 
-        return jax.jit(step, donate_argnums=(1, 2))
+        return jax.jit(step, donate_argnums=(2, 3))
 
     def _reset_kv(self):
         """Fresh pools + allocator — a failed call's donated buffers are
@@ -335,14 +345,15 @@ class LLMEngine:
         ok = False
         try:
             logits, k_pages, v_pages = prefill(
-                jnp.asarray(ids_pad), self.k_pages, self.v_pages, tables, t0)
+                self.weights, jnp.asarray(ids_pad), self.k_pages,
+                self.v_pages, tables, t0)
             key, sub = jax.random.split(key)
             tok = _sample(logits, sub, do_sample, temperature, top_k, top_p)
             lens = jnp.full((b,), t0, jnp.int32)
             out = [np.asarray(tok)[:, None]]
             for _ in range(max_new_tokens - 1):
                 logits, k_pages, v_pages = self._step_fn(
-                    tok, k_pages, v_pages, tables, lens)
+                    self.weights, tok, k_pages, v_pages, tables, lens)
                 key, sub = jax.random.split(key)
                 tok = _sample(logits, sub, do_sample, temperature, top_k,
                               top_p)

@@ -47,29 +47,42 @@ def main():
 
     for quant in quants:
         for b in batches:
-            eng = LLMEngine(model, max_len=max_len, page_size=64,
-                            max_batch=b, quant=quant)
-            ids = rng.randint(0, cfg.vocab_size, (b, t0)).astype(np.int64)
-            eng.generate(ids, max_new_tokens=4)      # warmup/compile
-            # decode-only rate: subtract a prefill+1-token run so the
-            # metric isn't polluted by prompt processing
-            t_start = time.perf_counter()
-            eng.generate(ids, max_new_tokens=1)
-            t_prefill = time.perf_counter() - t_start
-            t_start = time.perf_counter()
-            out = eng.generate(ids, max_new_tokens=new)
-            dt = (time.perf_counter() - t_start) - t_prefill
-            toks = (out.shape[1] - t0 - 1) * b
-            print(json.dumps({
-                "metric": "decode_tokens_per_sec",
-                "batch": b,
-                "quant": quant or "none",
-                "value": round(toks / max(dt, 1e-9), 2),
-                "prefill_sec": round(t_prefill, 4),
-                "unit": "tokens/s",
-                "backend": jax.default_backend(),
-            }))
-            sys.stdout.flush()
+            for device_loop in (False, True):
+                # host loop = one jit call per token (latency-bound
+                # through a tunnel); device loop = one lax.scan dispatch
+                # for the whole budget (the chip-rate measurement)
+                eng = LLMEngine(model, max_len=max_len, page_size=64,
+                                max_batch=b, quant=quant)
+                ids = rng.randint(0, cfg.vocab_size,
+                                  (b, t0)).astype(np.int64)
+                # warmup/compile: the device loop must compile at the
+                # full budget (one scan per bucketed length); the host
+                # loop only needs prefill+step compiled — a few tokens,
+                # not `new` round trips
+                eng.generate(ids, max_new_tokens=(new if device_loop
+                                                  else 4),
+                             device_loop=device_loop)
+                # decode-only rate: subtract a prefill+1-token run so the
+                # metric isn't polluted by prompt processing
+                t_start = time.perf_counter()
+                eng.generate(ids, max_new_tokens=1)
+                t_prefill = time.perf_counter() - t_start
+                t_start = time.perf_counter()
+                out = eng.generate(ids, max_new_tokens=new,
+                                   device_loop=device_loop)
+                dt = (time.perf_counter() - t_start) - t_prefill
+                toks = (out.shape[1] - t0 - 1) * b
+                print(json.dumps({
+                    "metric": "decode_tokens_per_sec",
+                    "batch": b,
+                    "quant": quant or "none",
+                    "loop": "device" if device_loop else "host",
+                    "value": round(toks / max(dt, 1e-9), 2),
+                    "prefill_sec": round(t_prefill, 4),
+                    "unit": "tokens/s",
+                    "backend": jax.default_backend(),
+                }))
+                sys.stdout.flush()
 
 
 if __name__ == "__main__":

@@ -148,6 +148,7 @@ class LLMEngine:
         self.allocator = PageAllocator(self.n_pages)
         self._step_fn = None
         self._prefill_fns = {}
+        self._loop_fns = {}
         # batch buckets (OPT-IN): generate() pads the request batch up to
         # the nearest bucket so varying batch sizes reuse a handful of
         # compiled prefill/step programs instead of one per size. Off by
@@ -180,7 +181,7 @@ class LLMEngine:
     def _layer_qkv(self, W, wset, h, pos_ids):
         cos, sin = W["cos"], W["sin"]
         b, t, H = h.shape
-        x = _rms(h, wset["ln1"], self.weights["eps"])
+        x = _rms(h, wset["ln1"], W["eps"])
         q = _mm(x, wset["wq"], self.interpret).reshape(b, t, self.nh, self.hd)
         k = _mm(x, wset["wk"], self.interpret).reshape(b, t, self.nh_kv,
                                                        self.hd)
@@ -199,11 +200,11 @@ class LLMEngine:
 
         return rope(q), rope(k), v
 
-    def _layer_tail(self, wset, h, attn_out):
+    def _layer_tail(self, W, wset, h, attn_out):
         b, t = attn_out.shape[:2]
         o = _mm(attn_out.reshape(b, t, -1), wset["wo"], self.interpret)
         h = h + o
-        x = _rms(h, wset["ln2"], self.weights["eps"])
+        x = _rms(h, wset["ln2"], W["eps"])
         g = _mm(x, wset["wg"], self.interpret)
         u = _mm(x, wset["wu"], self.interpret)
         act = jax.nn.silu(g.astype(jnp.float32)).astype(g.dtype) * u
@@ -234,7 +235,7 @@ class LLMEngine:
             for li, wset in enumerate(W["layers"]):
                 q, k, v = self._layer_qkv(W, wset, h, pos_ids)
                 attn = self._attn_dense(q, k, v)
-                h = self._layer_tail(wset, h, attn)
+                h = self._layer_tail(W, wset, h, attn)
                 # scatter every sequence's kv into its pages at once
                 pos = jnp.arange(t_pad)[None, :]
                 slots = (tables[jnp.arange(b)[:, None],
@@ -256,38 +257,70 @@ class LLMEngine:
         return jax.jit(prefill, donate_argnums=(2, 3))
 
     # -- decode step ----------------------------------------------------------
-    def _build_step(self):
+    def _step_math(self, W, tok, k_pages_all, v_pages_all, tables, lens):
+        """One decode step, fully traceable (shared by the per-token jit
+        and the device-side lax.scan loop). W: weight pytree (argument,
+        not capture — see _build_prefill); tok [b]; lens [b] = tokens
+        already in cache (position of this token). One token for EVERY
+        slot; masked by caller."""
         p = self.page_size
+        b = tok.shape[0]
+        h = jnp.take(W["emb"], tok[:, None], axis=0).astype(self.kv_dtype)
+        pos_ids = lens[:, None]                      # ragged positions
+        new_k, new_v = [], []
+        for li, wset in enumerate(W["layers"]):
+            q, k, v = self._layer_qkv(W, wset, h, pos_ids)
+            # write this token's kv at each sequence's slot
+            slots = (tables[jnp.arange(b), lens // p] * p + lens % p)
+            kp = k_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+            vp = v_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+            kp = kp.at[slots].set(k[:, 0].astype(self.kv_dtype))
+            vp = vp.at[slots].set(v[:, 0].astype(self.kv_dtype))
+            kp = kp.reshape(self.n_pages, p, self.nh_kv, self.hd)
+            vp = vp.reshape(self.n_pages, p, self.nh_kv, self.hd)
+            new_k.append(kp)
+            new_v.append(vp)
+            attn = paged_attention(q[:, 0], kp, vp, tables, lens + 1,
+                                   interpret=self.interpret)
+            h = self._layer_tail(W, wset, h, attn[:, None])
+        h = _rms(h, W["norm"], W["eps"])
+        logits = _mm(h, W["head"], self.interpret)
+        return logits[:, 0], new_k, new_v
 
+    def _build_step(self):
         def step(W, tok, k_pages_all, v_pages_all, tables, lens):
-            """W: weight pytree (argument, not capture — see
-            _build_prefill); tok [b]; lens [b] = tokens already in cache
-            (position of this token). One token for EVERY slot; masked by
-            caller."""
-            b = tok.shape[0]
-            h = jnp.take(W["emb"], tok[:, None], axis=0).astype(self.kv_dtype)
-            pos_ids = lens[:, None]                      # ragged positions
-            new_k, new_v = [], []
-            for li, wset in enumerate(W["layers"]):
-                q, k, v = self._layer_qkv(W, wset, h, pos_ids)
-                # write this token's kv at each sequence's slot
-                slots = (tables[jnp.arange(b), lens // p] * p + lens % p)
-                kp = k_pages_all[li].reshape(-1, self.nh_kv, self.hd)
-                vp = v_pages_all[li].reshape(-1, self.nh_kv, self.hd)
-                kp = kp.at[slots].set(k[:, 0].astype(self.kv_dtype))
-                vp = vp.at[slots].set(v[:, 0].astype(self.kv_dtype))
-                kp = kp.reshape(self.n_pages, p, self.nh_kv, self.hd)
-                vp = vp.reshape(self.n_pages, p, self.nh_kv, self.hd)
-                new_k.append(kp)
-                new_v.append(vp)
-                attn = paged_attention(q[:, 0], kp, vp, tables, lens + 1,
-                                       interpret=self.interpret)
-                h = self._layer_tail(wset, h, attn[:, None])
-            h = _rms(h, W["norm"], W["eps"])
-            logits = _mm(h, W["head"], self.interpret)
-            return logits[:, 0], new_k, new_v
+            return self._step_math(W, tok, k_pages_all, v_pages_all,
+                                   tables, lens)
 
         return jax.jit(step, donate_argnums=(2, 3))
+
+    def _build_decode_loop(self, n, do_sample, temperature, top_k, top_p):
+        """Device-side decode: n steps as ONE dispatch (lax.scan over
+        _step_math + sampling). Kills the per-token host→device round
+        trip that dominates small-batch decode off-chip — the TPU analog
+        of the reference's fused decode loop
+        (ref: fused_multi_transformer_op.cu.h decode path, which exists
+        to amortize per-token launch overhead on GPU). Runs all n steps
+        (no early EOS exit inside the scan); generate() trims trailing
+        post-EOS columns so greedy output matches the host loop."""
+        from ..models.generation import _sample
+
+        def loop(W, tok0, k_pages_all, v_pages_all, tables, lens0, key0):
+            def body(carry, _):
+                tok, kp, vp, lens, key = carry
+                logits, kp, vp = self._step_math(W, tok, kp, vp, tables,
+                                                 lens)
+                key, sub = jax.random.split(key)
+                nxt = _sample(logits, sub, do_sample, temperature, top_k,
+                              top_p)
+                return (nxt, kp, vp, lens + 1, key), nxt
+
+            carry0 = (tok0, k_pages_all, v_pages_all, lens0, key0)
+            (_, kp, vp, _, _), toks = jax.lax.scan(body, carry0, None,
+                                                   length=n)
+            return jnp.swapaxes(toks, 0, 1), kp, vp   # [b, n]
+
+        return jax.jit(loop, donate_argnums=(2, 3))
 
     def _reset_kv(self):
         """Fresh pools + allocator — a failed call's donated buffers are
@@ -301,9 +334,16 @@ class LLMEngine:
     # -- public -------------------------------------------------------------
     def generate(self, input_ids, max_new_tokens=32, eos_token_id=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                 seed=0):
+                 seed=0, device_loop=False):
         """Decode with greedy or top-k/top-p sampling. input_ids: [b, t0]
-        equal-length prompts. Returns [b, t0+n]."""
+        equal-length prompts. Returns [b, t0+n].
+
+        device_loop=True runs the whole decode as ONE compiled lax.scan
+        dispatch (_build_decode_loop) instead of one jit call per token —
+        the throughput mode when host→device latency is non-trivial. All
+        max_new_tokens steps execute (EOS trims the OUTPUT, it cannot
+        stop the scan early), so the host loop remains the better mode
+        when generations usually terminate long before the budget."""
         from ..models.generation import _sample
         ids = np.asarray(input_ids.numpy() if isinstance(input_ids, Tensor)
                          else input_ids)
@@ -323,7 +363,17 @@ class LLMEngine:
         # allocate pages for each sequence (padded-prefill garbage slots
         # included, so allocate through the padded length)
         t_pad = min(-(-t0 // self.page_size) * self.page_size, self.max_len)
-        need = -(-max(t_pad, t0 + max_new_tokens) // self.page_size)
+        n_rest = max_new_tokens - 1
+        # device loop: bucket the scan length to the next multiple of 32
+        # so varying budgets reuse a handful of compiled loops (same idea
+        # as batch_buckets); padded steps run and write KV past the real
+        # budget, so pages are allocated through the BUCKETED length and
+        # the output is trimmed back to n_rest
+        n_loop = 0
+        if device_loop and n_rest > 0:
+            n_loop = min(-(-n_rest // 32) * 32, self.max_len - t0 - 1)
+        need = -(-max(t_pad, t0 + 1 + max(n_rest, n_loop))
+                 // self.page_size)
         tables_np = np.zeros((b, self.max_pages_per_seq), np.int32)
         seq_pages = []
         for i in range(b):
@@ -351,17 +401,35 @@ class LLMEngine:
             tok = _sample(logits, sub, do_sample, temperature, top_k, top_p)
             lens = jnp.full((b,), t0, jnp.int32)
             out = [np.asarray(tok)[:, None]]
-            for _ in range(max_new_tokens - 1):
-                logits, k_pages, v_pages = self._step_fn(
-                    self.weights, tok, k_pages, v_pages, tables, lens)
-                key, sub = jax.random.split(key)
-                tok = _sample(logits, sub, do_sample, temperature, top_k,
-                              top_p)
-                lens = lens + 1
-                out.append(np.asarray(tok)[:, None])
-                if eos_token_id is not None and np.all(
-                        out[-1][:b_real] == eos_token_id):
-                    break
+            if device_loop and n_rest > 0:
+                lkey = (n_loop, do_sample, float(temperature), int(top_k),
+                        float(top_p))
+                loop = self._loop_fns.get(lkey)
+                if loop is None:
+                    loop = self._build_decode_loop(*lkey)
+                    self._loop_fns[lkey] = loop
+                toks, k_pages, v_pages = loop(
+                    self.weights, tok, k_pages, v_pages, tables, lens, key)
+                toks = np.asarray(toks)[:, :n_rest]      # drop bucket pad
+                if eos_token_id is not None:
+                    # match the host loop: keep columns up to and
+                    # including the first all-EOS column
+                    hit = np.all(toks[:b_real] == eos_token_id, axis=0)
+                    if hit.any():
+                        toks = toks[:, :int(np.argmax(hit)) + 1]
+                out.extend(toks[:, i:i + 1] for i in range(toks.shape[1]))
+            else:
+                for _ in range(n_rest):
+                    logits, k_pages, v_pages = self._step_fn(
+                        self.weights, tok, k_pages, v_pages, tables, lens)
+                    key, sub = jax.random.split(key)
+                    tok = _sample(logits, sub, do_sample, temperature,
+                                  top_k, top_p)
+                    lens = lens + 1
+                    out.append(np.asarray(tok)[:, None])
+                    if eos_token_id is not None and np.all(
+                            out[-1][:b_real] == eos_token_id):
+                        break
             ok = True
         finally:
             if ok:

@@ -55,35 +55,54 @@ class PageAllocator:
         return len(self._free)
 
 
-def _snapshot_llama(model, quant):
+def _snapshot_llama(model, quant, weight_dtype=None):
     """Pull per-layer weights out of the Layer tree into plain arrays.
     quant='int8' replaces the six projection weights of every layer (and
-    the lm_head) with (int8, scales) pairs."""
-    cfg = model.config
-    emb = model.llama.embed_tokens.weight.data
+    the lm_head) with (int8, scales) pairs.
 
-    def maybe_q(w):
+    Lazy-aware: a model built under framework.LazyGuard (meta init) is
+    materialized HERE, one leaf at a time, straight to `weight_dtype` —
+    the serving analog of SpmdTrainer.init_state. A 7B checkpoint-scale
+    model therefore reaches the chip as 13.5 GB of bf16 (or 6.7 GB int8)
+    without ever holding the 27 GB eager-f32 tree that cannot fit the
+    16 GB v5e (same RESOURCE_EXHAUSTED the r5 training bench hit —
+    BASELINE.md round-5 notes)."""
+    from ..framework.misc import materialize_lazy
+    cfg = model.config
+    wdt = weight_dtype  # validated jnp.dtype (or None) from LLMEngine
+
+    def take(param):
+        w = materialize_lazy(param)  # no-op for eagerly-built params
+        if wdt is not None and jnp.issubdtype(w.dtype, jnp.floating):
+            w = w.astype(wdt)
+        return w
+
+    def maybe_q(param):
+        # int8 quantizes from the natively-materialized values (NOT from a
+        # weight_dtype-rounded copy: scales should see full init precision)
         if quant == "int8":
+            w = materialize_lazy(param)
             wq, sc = quantize_weights(w.astype(jnp.float32))
             return (wq, sc)
-        return w
+        return take(param)
 
     layers = []
     for layer in model.llama.layers:
         a = layer.self_attn
         layers.append(dict(
-            ln1=layer.input_layernorm.weight.data,
-            ln2=layer.post_attention_layernorm.weight.data,
-            wq=maybe_q(a.q_proj.weight.data),
-            wk=maybe_q(a.k_proj.weight.data),
-            wv=maybe_q(a.v_proj.weight.data),
-            wo=maybe_q(a.o_proj.weight.data),
-            wg=maybe_q(layer.mlp.gate_proj.weight.data),
-            wu=maybe_q(layer.mlp.up_proj.weight.data),
-            wd=maybe_q(layer.mlp.down_proj.weight.data),
+            ln1=take(layer.input_layernorm.weight),
+            ln2=take(layer.post_attention_layernorm.weight),
+            wq=maybe_q(a.q_proj.weight),
+            wk=maybe_q(a.k_proj.weight),
+            wv=maybe_q(a.v_proj.weight),
+            wo=maybe_q(a.o_proj.weight),
+            wg=maybe_q(layer.mlp.gate_proj.weight),
+            wu=maybe_q(layer.mlp.up_proj.weight),
+            wd=maybe_q(layer.mlp.down_proj.weight),
         ))
-    return dict(emb=emb, norm=model.llama.norm.weight.data,
-                head=maybe_q(model.lm_head.weight.data), layers=layers,
+    return dict(emb=take(model.llama.embed_tokens.weight),
+                norm=take(model.llama.norm.weight),
+                head=maybe_q(model.lm_head.weight), layers=layers,
                 eps=cfg.rms_norm_eps)
 
 
@@ -112,10 +131,23 @@ class LLMEngine:
     """
 
     def __init__(self, model, max_len=1024, page_size=128, max_batch=8,
-                 quant=None, use_pallas=None, batch_buckets=None):
+                 quant=None, use_pallas=None, batch_buckets=None,
+                 weight_dtype=None):
         assert isinstance(model, LlamaForCausalLM), "LLaMA family only"
         if quant not in (None, "int8"):
             raise ValueError(f"unsupported quant {quant!r}")
+        if weight_dtype is not None:
+            asked = weight_dtype
+            try:
+                weight_dtype = jnp.dtype(weight_dtype)
+            except TypeError:
+                weight_dtype = None  # unparseable ("fp16") fails the same way
+            if weight_dtype not in (jnp.dtype(jnp.bfloat16),
+                                    jnp.dtype(jnp.float32),
+                                    jnp.dtype(jnp.float16)):
+                raise ValueError(
+                    f"unsupported weight_dtype {asked!r}; expected "
+                    f"bfloat16/float16/float32")
         model.eval()
         cfg = model.config
         self.cfg = cfg
@@ -136,7 +168,7 @@ class LLMEngine:
         # interpret Pallas kernels off-TPU so the engine runs in CI
         self.interpret = (use_pallas is False) or \
             (jax.default_backend() == "cpu")
-        self.weights = _snapshot_llama(model, quant)
+        self.weights = _snapshot_llama(model, quant, weight_dtype)
         dtype = (jnp.bfloat16 if jax.default_backend() != "cpu"
                  else jnp.float32)
         self.kv_dtype = dtype

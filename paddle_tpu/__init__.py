@@ -72,7 +72,8 @@ from .tensor.manipulation import (cast, reshape, reshape_, flatten, transpose,
                                   strided_slice, gather, gather_nd,
                                   take_along_axis, put_along_axis, scatter,
                                   scatter_nd, scatter_nd_add, index_select,
-                                  index_sample, index_add, repeat_interleave,
+                                  index_sample, index_add, index_add_,
+                                  repeat_interleave,
                                   masked_select, masked_fill, where, nonzero,
                                   unique, unbind, crop, as_complex, as_real,
                                   tensordot, atleast_1d, atleast_2d,

@@ -290,11 +290,22 @@ def index_add(x, index, axis, value, name=None):
     idx = index.data if isinstance(index, Tensor) else jnp.asarray(index)
 
     def fn(a, v):
+        # value mirrors x's layout with len(index) along `axis` — move the
+        # SAME axis to front on both sides (r5: v was left unmoved, which
+        # transposed the added block for axis != 0)
         am = jnp.moveaxis(a, axis, 0)
-        am = am.at[idx].add(v.astype(a.dtype))
+        vm = jnp.moveaxis(v, axis, 0)
+        am = am.at[idx].add(vm.astype(a.dtype))
         return jnp.moveaxis(am, 0, axis)
 
     return apply(fn, _t(x), _t(value), name="index_add")
+
+
+def index_add_(x, index, axis, value, name=None):
+    """In-place index_add (ref: inplace variant index_add_)."""
+    out = index_add(x, index, axis, value)
+    x.data, x._node, x.stop_gradient = out.data, out._node, out.stop_gradient
+    return x
 
 
 def repeat_interleave(x, repeats, axis=None, name=None):

@@ -3,3 +3,58 @@ from . import models
 from . import transforms
 from . import datasets
 from . import ops
+
+_image_backend = "numpy"
+
+
+def set_image_backend(backend):
+    """ref: vision/image.py set_image_backend. This build decodes with
+    numpy (raw arrays / .npy); 'pil'/'cv2' are accepted names only when
+    the matching module is importable."""
+    if backend not in ("numpy", "pil", "cv2"):
+        raise ValueError(
+            f"unsupported image backend {backend!r}; expected "
+            f"'numpy', 'pil' or 'cv2'")
+    if backend == "pil":
+        import importlib.util
+        if importlib.util.find_spec("PIL") is None:
+            raise ValueError("PIL is not available in this environment")
+    if backend == "cv2":
+        import importlib.util
+        if importlib.util.find_spec("cv2") is None:
+            raise ValueError("cv2 is not available in this environment")
+    global _image_backend
+    _image_backend = backend
+
+
+def get_image_backend():
+    """ref: vision/image.py get_image_backend."""
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """ref: vision/image.py image_load — load an image file as an HWC
+    array (numpy backend: .npy/.npz raw arrays; PIL when selected and
+    installed)."""
+    b = backend or _image_backend
+    if b not in ("numpy", "pil", "cv2"):
+        raise ValueError(
+            f"unsupported image backend {b!r}; expected 'numpy', 'pil' or "
+            f"'cv2'")
+    if b == "pil":
+        from PIL import Image
+        return Image.open(path)
+    if b == "cv2":
+        import cv2
+        return cv2.imread(str(path))
+    import numpy as np
+    import os
+    ext = os.path.splitext(str(path))[1].lower()
+    if ext == ".npy":
+        return np.load(path)
+    if ext == ".npz":
+        z = np.load(path)
+        return z[list(z.files)[0]]
+    raise ValueError(
+        f"numpy image backend reads .npy/.npz arrays; got {path!r}. "
+        f"Install/select the 'pil' backend for encoded images.")

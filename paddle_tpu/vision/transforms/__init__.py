@@ -5,6 +5,10 @@ import numbers
 import numpy as np
 
 from ...tensor.tensor import Tensor
+from .functional import (hflip, vflip, crop, center_crop, pad, affine,
+                         rotate, perspective, to_grayscale,
+                         adjust_brightness, adjust_contrast,
+                         adjust_saturation, adjust_hue, erase)
 
 
 class Compose:
@@ -102,8 +106,8 @@ class RandomHorizontalFlip(BaseTransform):
 
     def _apply_image(self, img):
         if np.random.rand() < self.prob:
-            arr = np.asarray(img)
-            return arr[..., ::-1].copy() if arr.ndim == 3 else arr[:, ::-1].copy()
+            return hflip(img)  # width flip (r5: arr[..., ::-1] reversed
+            #                    the CHANNEL axis on HWC input)
         return img
 
 
@@ -141,6 +145,299 @@ class CenterCrop(BaseTransform):
         return arr[i:i + th, j:j + tw]
 
 
+class RandomVerticalFlip(BaseTransform):
+    """ref: transforms.py RandomVerticalFlip."""
+
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return vflip(img)
+        return img
+
+
+class Transpose(BaseTransform):
+    """ref: transforms.py Transpose — HWC ndarray/Tensor -> `order`."""
+
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = tuple(order)
+
+    def _apply_image(self, img):
+        arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        out = arr.transpose(self.order)
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+class Pad(BaseTransform):
+    """ref: transforms.py Pad."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomResizedCrop(BaseTransform):
+    """ref: transforms.py RandomResizedCrop — random area/aspect crop,
+    resized to `size`. Falls back to a center crop when 10 samples miss."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4, 4. / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _sample(self, h, w):
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            log_r = np.random.uniform(np.log(self.ratio[0]),
+                                      np.log(self.ratio[1]))
+            aspect = np.exp(log_r)
+            cw = int(round(np.sqrt(target * aspect)))
+            ch = int(round(np.sqrt(target / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                return i, j, ch, cw
+        ch, cw = min(h, w), min(h, w)
+        return (h - ch) // 2, (w - cw) // 2, ch, cw
+
+    def _apply_image(self, img):
+        arr = np.asarray(img.numpy() if isinstance(img, Tensor) else img)
+        i, j, ch, cw = self._sample(arr.shape[0], arr.shape[1])
+        cropped = arr[i:i + ch, j:j + cw]
+        out = Resize(self.size, self.interpolation)._apply_image(cropped)
+        if arr.dtype == np.uint8:  # keep the input dtype (Resize upcasts)
+            out = np.clip(np.round(out), 0, 255).astype(np.uint8)
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+class BrightnessTransform(BaseTransform):
+    """ref: transforms.py BrightnessTransform — factor ~ U[1-v, 1+v]."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value <= 0:
+            return img
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    """ref: transforms.py ContrastTransform."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value <= 0:
+            return img
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    """ref: transforms.py SaturationTransform."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value <= 0:
+            return img
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    """ref: transforms.py HueTransform — shift ~ U[-v, v], v <= 0.5."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value <= 0:
+            return img
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """ref: transforms.py ColorJitter — the four color transforms applied
+    in a random order each call."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self._ts = [BrightnessTransform(brightness),
+                    ContrastTransform(contrast),
+                    SaturationTransform(saturation), HueTransform(hue)]
+
+    def _apply_image(self, img):
+        for k in np.random.permutation(len(self._ts)):
+            img = self._ts[int(k)]._apply_image(img)
+        return img
+
+
+class RandomAffine(BaseTransform):
+    """ref: transforms.py RandomAffine."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (degrees if isinstance(degrees, (list, tuple))
+                        else (-abs(degrees), abs(degrees)))
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        arr = np.asarray(img.numpy() if isinstance(img, Tensor) else img)
+        h, w = arr.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = (np.random.uniform(*self.scale) if self.scale is not None
+              else 1.0)
+        sh = 0.0
+        if self.shear is not None:
+            s = (tuple(self.shear) if isinstance(self.shear, (list, tuple))
+                 else (-abs(self.shear), abs(self.shear)))
+            if len(s) == 2:       # (min_x, max_x)
+                sh = np.random.uniform(s[0], s[1])
+            elif len(s) == 4:     # (min_x, max_x, min_y, max_y)
+                sh = (np.random.uniform(s[0], s[1]),
+                      np.random.uniform(s[2], s[3]))
+            else:
+                raise ValueError(
+                    f"shear must be a number, a (min, max) pair or a "
+                    f"(min_x, max_x, min_y, max_y) 4-tuple, got {self.shear!r}")
+        return affine(img, angle, (tx, ty), sc, sh, self.interpolation,
+                      self.fill, self.center)
+
+
+class RandomRotation(BaseTransform):
+    """ref: transforms.py RandomRotation."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = (degrees if isinstance(degrees, (list, tuple))
+                        else (-abs(degrees), abs(degrees)))
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class RandomPerspective(BaseTransform):
+    """ref: transforms.py RandomPerspective — random corner displacement
+    of up to distortion_scale/2 of the image extent."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.asarray(img.numpy() if isinstance(img, Tensor) else img)
+        h, w = arr.shape[:2]
+        dx = int(self.distortion_scale * w / 2)
+        dy = int(self.distortion_scale * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, dx + 1), np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1)),
+               (np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1))]
+        return perspective(img, start, end, self.interpolation, self.fill)
+
+
+class Grayscale(BaseTransform):
+    """ref: transforms.py Grayscale."""
+
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomErasing(BaseTransform):
+    """ref: transforms.py RandomErasing — erase a random region (HWC)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.asarray(img.numpy() if isinstance(img, Tensor) else img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            log_r = np.random.uniform(np.log(self.ratio[0]),
+                                      np.log(self.ratio[1]))
+            aspect = np.exp(log_r)
+            eh = int(round(np.sqrt(target / aspect)))
+            ew = int(round(np.sqrt(target * aspect)))
+            if eh < h and ew < w and eh > 0 and ew > 0:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                v = self.value
+                if v == "random":
+                    hi = 256 if arr.dtype == np.uint8 else 1.0
+                    v = np.random.uniform(
+                        0, hi, size=(eh, ew) + arr.shape[2:]
+                    ).astype(arr.dtype)
+                return erase(img, i, j, eh, ew, v, self.inplace)
+        return img
+
+
 def to_tensor(pic, data_format="CHW"):
     return ToTensor(data_format)(pic)
 
@@ -151,8 +448,3 @@ def normalize(img, mean, std, data_format="CHW", to_rgb=False):
 
 def resize(img, size, interpolation="bilinear"):
     return Resize(size, interpolation)(img)
-
-
-def hflip(img):
-    arr = np.asarray(img)
-    return arr[..., ::-1].copy()

@@ -1,0 +1,109 @@
+"""Round-5 vision.transforms completion (ref: python/paddle/vision/
+transforms/transforms.py) — every class transform runs on HWC uint8,
+randomized ones are seed-deterministic, functional re-exports resolve."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision import (set_image_backend, get_image_backend,
+                               image_load)
+
+
+IMG = np.random.RandomState(0).randint(0, 256, (32, 48, 3)).astype(np.uint8)
+
+
+@pytest.mark.parametrize("t,expect_shape", [
+    (T.RandomVerticalFlip(prob=1.0), (32, 48, 3)),
+    (T.Pad(4), (40, 56, 3)),
+    (T.RandomResizedCrop(16), (16, 16, 3)),
+    (T.BrightnessTransform(0.4), (32, 48, 3)),
+    (T.ContrastTransform(0.4), (32, 48, 3)),
+    (T.SaturationTransform(0.4), (32, 48, 3)),
+    (T.HueTransform(0.2), (32, 48, 3)),
+    (T.ColorJitter(0.2, 0.2, 0.2, 0.1), (32, 48, 3)),
+    (T.RandomAffine(15, translate=(0.1, 0.1), scale=(0.9, 1.1), shear=5),
+     (32, 48, 3)),
+    (T.RandomRotation(30), (32, 48, 3)),
+    (T.RandomPerspective(prob=1.0), (32, 48, 3)),
+    (T.Grayscale(3), (32, 48, 3)),
+    (T.Grayscale(1), (32, 48, 1)),
+    (T.RandomErasing(prob=1.0), (32, 48, 3)),
+])
+def test_class_transform_shapes(t, expect_shape):
+    np.random.seed(3)
+    out = t(IMG)
+    assert np.asarray(out).shape == expect_shape
+    assert np.asarray(out).dtype == np.uint8
+
+
+def test_transpose_and_compose():
+    out = T.Compose([T.Transpose()])(IMG)
+    assert out.shape == (3, 32, 48)
+
+
+def test_vflip_is_vertical():
+    out = T.RandomVerticalFlip(prob=1.0)(IMG)
+    np.testing.assert_array_equal(np.asarray(out), IMG[::-1])
+
+
+def test_hflip_flips_width_not_channels():
+    # regression: the old namespace hflip reversed the LAST axis, which
+    # on HWC input flipped channels
+    out = T.hflip(IMG)
+    np.testing.assert_array_equal(np.asarray(out), IMG[:, ::-1])
+
+
+def test_random_transforms_seed_deterministic():
+    np.random.seed(7)
+    a = T.ColorJitter(0.3, 0.3, 0.3, 0.2)(IMG)
+    np.random.seed(7)
+    b = T.ColorJitter(0.3, 0.3, 0.3, 0.2)(IMG)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_random_resized_crop_covers_scale():
+    np.random.seed(1)
+    for _ in range(5):
+        out = T.RandomResizedCrop((8, 12))(IMG)
+        assert np.asarray(out).shape == (8, 12, 3)
+
+
+def test_random_hflip_flips_width():
+    out = T.RandomHorizontalFlip(prob=1.0)(IMG)
+    np.testing.assert_array_equal(np.asarray(out), IMG[:, ::-1])
+
+
+def test_random_erasing_random_value_is_random_on_uint8():
+    np.random.seed(5)
+    out = np.asarray(T.RandomErasing(prob=1.0, value="random",
+                                     scale=(0.2, 0.3))(IMG))
+    changed = out != IMG
+    assert changed.any()
+    assert out[changed].std() > 0, "erased region must not be constant"
+
+
+def test_random_affine_four_tuple_shear():
+    np.random.seed(6)
+    out = T.RandomAffine(0, shear=(-5, 5, -10, 10))(IMG)
+    assert np.asarray(out).shape == IMG.shape
+    with pytest.raises(ValueError):
+        T.RandomAffine(0, shear=(1, 2, 3))(IMG)
+
+
+def test_image_load_rejects_unknown_backend(tmp_path):
+    p = tmp_path / "img.npy"
+    np.save(p, IMG)
+    with pytest.raises(ValueError):
+        image_load(p, backend="PIL")  # case-sensitive names, loud error
+
+
+def test_image_backend_registry(tmp_path):
+    assert get_image_backend() == "numpy"
+    with pytest.raises(ValueError):
+        set_image_backend("magic")
+    p = tmp_path / "img.npy"
+    np.save(p, IMG)
+    np.testing.assert_array_equal(image_load(p), IMG)
+    with pytest.raises(ValueError):
+        image_load(tmp_path / "img.jpg")

@@ -123,6 +123,7 @@ from . import regularizer
 from . import text
 from . import audio
 from . import geometric
+from . import quantization
 from . import onnx
 from . import inference
 

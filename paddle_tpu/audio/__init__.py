@@ -253,3 +253,7 @@ class datasets:
             import os as _os
             stem = _os.path.basename(path).rsplit(".", 1)[0]
             return np.int64(int(stem.split("-")[-1]))
+
+
+from . import backends  # noqa: E402
+from .backends import load, info, save  # noqa: E402,F401

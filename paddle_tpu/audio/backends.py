@@ -1,0 +1,103 @@
+"""paddle.audio.backends (ref: python/paddle/audio/backends/) — wave IO.
+
+The reference routes through soundfile/wave backends; this build ships the
+stdlib `wave` backend (PCM WAV read/write — no external codec wheels in
+the image) with the same load/info/save surface.
+"""
+import wave as _wave
+
+import numpy as np
+
+from ..tensor.tensor import Tensor
+
+
+class AudioInfo:
+    """ref: backends/backend.py AudioInfo."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_samples={self.num_samples}, "
+                f"num_channels={self.num_channels}, "
+                f"bits_per_sample={self.bits_per_sample})")
+
+
+def list_available_backends():
+    return ["wave"]
+
+
+def get_current_backend():
+    return "wave"
+
+
+def set_backend(backend_name):
+    if backend_name != "wave":
+        raise ValueError(
+            f"only the stdlib 'wave' backend is available in this build, "
+            f"got {backend_name!r}")
+
+
+_WIDTH_DTYPE = {1: np.uint8, 2: np.int16, 4: np.int32}
+
+
+def info(filepath):
+    """ref: backends/wave_backend.py info."""
+    with _wave.open(str(filepath), "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """ref: backends/wave_backend.py load — returns (waveform Tensor,
+    sample_rate). normalize=True scales PCM to [-1, 1] float32;
+    channels_first gives [C, T]."""
+    with _wave.open(str(filepath), "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(min(frame_offset, f.getnframes()))
+        n = f.getnframes() - f.tell() if num_frames < 0 else num_frames
+        raw = f.readframes(max(n, 0))
+    dt = _WIDTH_DTYPE.get(width)
+    if dt is None:
+        raise ValueError(f"unsupported sample width {width} bytes")
+    data = np.frombuffer(raw, dtype=dt).reshape(-1, nch)
+    if width == 1:  # unsigned 8-bit PCM centers at 128
+        data = data.astype(np.int16) - 128
+    if normalize:
+        data = data.astype(np.float32) / float(2 ** (8 * min(width, 2) - 1)
+                                               if width != 4 else 2 ** 31)
+    arr = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(arr)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_S", bits_per_sample=16):
+    """ref: backends/wave_backend.py save — float input in [-1, 1] is
+    scaled to PCM16 (the only encoding the stdlib backend writes)."""
+    if bits_per_sample != 16 or encoding != "PCM_S":
+        raise ValueError(
+            "the wave backend writes 16-bit signed PCM only")
+    arr = src.numpy() if isinstance(src, Tensor) else np.asarray(src)
+    if arr.ndim == 1:
+        arr = arr[None] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T  # -> [T, C]
+    if np.issubdtype(arr.dtype, np.floating):
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * 32767.0).round().astype(np.int16)
+    else:
+        arr = arr.astype(np.int16)
+    with _wave.open(str(filepath), "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(arr.tobytes())

@@ -24,17 +24,52 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                          retain_graph, create_graph, allow_unused)
 
 
+_saved_tensors_hooks = []
+
+
+class saved_tensors_hooks:
+    """ref: python/paddle/autograd/saved_tensors_hooks.py:20 — pack/unpack
+    hooks around tensors saved for backward. They apply to the
+    user-visible saved-tensor channel (PyLayerContext.save_for_backward /
+    saved_tensor); residuals of built-in ops are jax.vjp closures managed
+    by XLA — the TPU-native control over those is jax.checkpoint /
+    SpmdTrainer recompute policies, not per-tensor hooks."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        if not callable(pack_hook) or not callable(unpack_hook):
+            raise TypeError("pack_hook and unpack_hook must be callables")
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _saved_tensors_hooks.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _saved_tensors_hooks.pop()
+        return False
+
+
 class PyLayerContext:
     """ref: python/paddle/autograd/py_layer.py:29 PyLayerContext."""
 
     def __init__(self):
         self._saved = []
+        self._unpack = None
         self.not_inplace_tensors = ()
 
     def save_for_backward(self, *tensors):
-        self._saved = list(tensors)
+        if _saved_tensors_hooks:
+            pack, unpack = _saved_tensors_hooks[-1]
+            self._saved = [pack(t) for t in tensors]
+            self._unpack = unpack
+        else:
+            self._saved = list(tensors)
+            self._unpack = None
 
     def saved_tensor(self):
+        if self._unpack is not None:
+            return tuple(self._unpack(t) for t in self._saved)
         return tuple(self._saved)
 
 

@@ -15,8 +15,18 @@ from .collective import (new_group, get_group, Group, all_reduce, all_gather,
                          alltoall as all_to_all, isend, irecv, P2POp,
                          batch_isend_irecv, all_gather_object,
                          broadcast_object_list, scatter_object_list,
-                         all_to_all_single)
-from .topology import CommunicateTopology, HybridCommunicateGroup
+                         all_to_all_single,
+                         all_to_all_single as alltoall_single,
+                         is_available, destroy_process_group)
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       ParallelMode)
+from .split_api import split
+from .entry_attr import (ProbabilityEntry, CountFilterEntry, ShowClickEntry)
+from .parallel_with_gloo import (gloo_init_parallel_env, gloo_barrier,
+                                 gloo_release)
+from .fleet.dataset import InMemoryDataset, QueueDataset
+from . import io
+from . import launch
 from .mesh import (global_mesh, set_global_mesh, build_mesh, mesh_axis_size,
                    in_spmd_region, current_axis_name)
 from .parallel import DataParallel

@@ -95,6 +95,25 @@ def get_group(id=0):
     return _groups.get(id)
 
 
+def is_available():
+    """ref: collective.py is_available — the comm package is always built
+    into this framework (XLA collectives need no extra linkage)."""
+    return True
+
+
+def destroy_process_group(group=None):
+    """ref: collective.py destroy_process_group — drop one group (or all,
+    including the world group, when group is None)."""
+    if group is None:
+        _groups.clear()
+        _world_group.pop(0, None)
+        _next_gid[0] = 0
+        return
+    _groups.pop(group.id, None)
+    if group.id == 0:
+        _world_group.pop(0, None)
+
+
 def _axis_of(group):
     if group is None:
         g = _ensure_world_group()

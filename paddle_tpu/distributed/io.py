@@ -1,0 +1,64 @@
+"""paddle.distributed.io (ref: python/paddle/distributed/io.py) —
+persistable save/load for distributed programs.
+
+The reference walks a static Program and routes persistable vars to
+per-PS/trainer files; here persistables are the state_dict of a Layer (or
+an explicit dict), saved rank-0-only with the framework serializer — the
+sharded/async tier lives in distributed.checkpoint."""
+import os
+
+from ..framework import io as fio
+from .parallel_env import get_rank
+
+
+def is_persistable(var):
+    """ref: io.py:190 — parameters and buffers persist; activations do
+    not. For this framework's Tensors that is `persistable` when present,
+    else True for anything exposing trainable state."""
+    p = getattr(var, "persistable", None)
+    if p is not None:
+        return bool(p)
+    return hasattr(var, "stop_gradient")
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """ref: io.py:221 — save the persistable state. `main_program` may be
+    a Layer, a state dict, or a recorded static Program (its parameter
+    state is pulled from the bound scope)."""
+    state = _state_of(main_program)
+    if state is None:
+        raise ValueError(
+            "save_persistables needs a Layer / state dict / Program as "
+            "main_program")
+    if get_rank() != 0:
+        return
+    os.makedirs(dirname, exist_ok=True)
+    fio.save(state, os.path.join(dirname, filename or "__persistables__"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """ref: io.py load counterpart — returns the loaded state dict and,
+    when main_program is a Layer, restores it in place."""
+    path = os.path.join(dirname, filename or "__persistables__")
+    state = fio.load(path)
+    if main_program is not None and hasattr(main_program, "set_state_dict"):
+        main_program.set_state_dict(state)
+    return state
+
+
+def _state_of(obj):
+    if obj is None:
+        return None
+    if hasattr(obj, "state_dict"):
+        return obj.state_dict()
+    if isinstance(obj, dict):
+        return obj
+    return None
+
+
+def load_inference_model_distributed(path_prefix, executor, **kw):
+    """ref: io.py:293 — route to the inference loader (StableHLO export
+    tier); distributed sharding of inference programs is not split across
+    files in this framework."""
+    from ..jit import load as jit_load
+    return jit_load(path_prefix)

@@ -1,0 +1,43 @@
+"""paddle.distributed.split (ref: python/paddle/distributed/fleet/layers/
+mpu/mp_ops.py:653) — build-and-apply a model-parallel linear/embedding.
+
+The reference restricts this API to static-graph builds (dygraph users are
+pointed to the Parallel* layers); here the same advice applies — each call
+constructs a fresh parallel layer, so in eager code prefer
+ColumnParallelLinear / RowParallelLinear / VocabParallelEmbedding — but the
+call executes instead of raising: under SPMD the layer build is cheap and
+the semantics are identical."""
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    from .fleet.meta_parallel.parallel_layers.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+
+    if operation not in ("linear", "embedding"):
+        raise ValueError(
+            f"operation must be 'linear' or 'embedding', got {operation!r}")
+    if len(size) != 2:
+        raise ValueError(f"size must be (in, out), got {size!r}")
+
+    if operation == "embedding":
+        if axis != 0:
+            raise ValueError(
+                "embedding only splits the vocabulary axis (axis=0)")
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr, name=name)
+        return layer(x)
+
+    if axis == 0:
+        # weight row-split: the INPUT features are partitioned
+        layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                  has_bias=bias_attr is not False,
+                                  input_is_parallel=False, name=name)
+        return layer(x)
+    if axis == 1:
+        layer = ColumnParallelLinear(size[0], size[1],
+                                     weight_attr=weight_attr,
+                                     has_bias=bias_attr is not False,
+                                     gather_output=gather_out, name=name)
+        return layer(x)
+    raise ValueError(f"axis must be 0 or 1 for linear, got {axis}")

@@ -14,6 +14,15 @@ from .collective import new_group
 from .parallel_env import get_rank, get_world_size
 
 
+class ParallelMode:
+    """ref: topology.py:28 — the hybrid-parallel mode ids."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
 class CommunicateTopology:
     """ref: topology.py:53."""
 

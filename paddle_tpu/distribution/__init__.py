@@ -208,7 +208,37 @@ class Gamma(Distribution):
                      self.rate)
 
 
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a pairwise KL implementation consulted by
+    kl_divergence before the built-ins (ref: distribution/kl.py
+    register_kl; most-specific (sub)class pair wins)."""
+
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return deco
+
+
+def _registered_kl(p, q):
+    best = None
+    best_score = None
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            score = (len(type(p).__mro__) - len(cp.__mro__),
+                     len(type(q).__mro__) - len(cq.__mro__))
+            if best_score is None or score < best_score:
+                best, best_score = fn, score
+    return best
+
+
 def kl_divergence(p, q):
+    fn = _registered_kl(p, q)
+    if fn is not None:
+        return fn(p, q)
     if isinstance(p, Normal) and isinstance(q, Normal):
         return p.kl_divergence(q)
     if isinstance(p, Categorical) and isinstance(q, Categorical):
@@ -586,3 +616,73 @@ class TransformedDistribution(Distribution):
             y = x
         lp = self.base.log_prob(y)
         return lp - log_det if log_det is not None else lp
+
+
+class ExponentialFamily(Distribution):
+    """Exponential-family base (ref: distribution/exponential_family.py):
+    subclasses expose natural parameters and the log normalizer A(eta);
+    entropy comes from the Bregman identity
+    H = A(eta) - sum_i eta_i * dA/deta_i + E[-log h(x)]  — the gradient
+    computed by jax.grad instead of the reference's static-graph
+    append_backward."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nats = [jnp.asarray(_raw(p), jnp.float32)
+                for p in self._natural_parameters]
+
+        def fn(*ps):
+            a = self._log_normalizer(*ps)
+            grads = jax.grad(
+                lambda *qs: jnp.sum(self._log_normalizer(*qs)),
+                argnums=tuple(range(len(ps))))(*ps)
+            ent = a - sum(e * g for e, g in zip(ps, grads))
+            return ent + self._mean_carrier_measure
+
+        return Tensor(fn(*nats))
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost `reinterpreted_batch_rank` batch dims of
+    `base` as event dims (ref: distribution/independent.py): log_prob and
+    entropy sum over them; sampling is unchanged."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        k = int(reinterpreted_batch_rank)
+        bs = tuple(base.batch_shape)
+        if not 0 < k <= len(bs):
+            raise ValueError(
+                f"reinterpreted_batch_rank must be in [1, {len(bs)}], "
+                f"got {k}")
+        self.base = base
+        self.reinterpreted_batch_rank = k
+        super().__init__(bs[:len(bs) - k],
+                         bs[len(bs) - k:] + tuple(base.event_shape))
+
+    def _sum_rightmost(self, x):
+        def fn(v):
+            for _ in range(self.reinterpreted_batch_rank):
+                v = jnp.sum(v, axis=-1)
+            return v
+        return apply(fn, _t(x))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return self._sum_rightmost(self.base.log_prob(value))
+
+    def entropy(self):
+        return self._sum_rightmost(self.base.entropy())

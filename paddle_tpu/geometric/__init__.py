@@ -88,5 +88,129 @@ def segment_min(data, segment_ids, name=None):
     return apply(lambda a: jax.ops.segment_min(a, ids, n), _t(data))
 
 
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-EDGE messages: out[i] = x[src[i]] op y[dst[i]] — the edge-level
+    companion of send_u_recv (ref: geometric/message_passing/send_uv)."""
+    src = src_index.data if isinstance(src_index, Tensor) \
+        else jnp.asarray(src_index)
+    dst = dst_index.data if isinstance(dst_index, Tensor) \
+        else jnp.asarray(dst_index)
+    if message_op not in ("add", "sub", "mul", "div"):
+        raise ValueError(
+            f"message_op must be add/sub/mul/div, got {message_op!r}")
+
+    def fn(a, b):
+        xs = jnp.take(a, src, axis=0)
+        yd = jnp.take(b, dst, axis=0)
+        return {"add": xs + yd, "sub": xs - yd,
+                "mul": xs * yd, "div": xs / yd}[message_op]
+
+    return apply(fn, _t(x), _t(y), name="send_uv")
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling over a CSC graph (ref: geometric/
+    sampling/neighbors.py). Host-side index work by design — see
+    geometric/graph.py module docstring. Returns (out_neighbors [E],
+    out_count [N]) (+ out_eids when return_eids)."""
+    import numpy as np
+    rw = np.asarray(row.data if isinstance(row, Tensor) else row, np.int64)
+    cp = np.asarray(colptr.data if isinstance(colptr, Tensor) else colptr,
+                    np.int64)
+    seeds = np.asarray(input_nodes.data if isinstance(input_nodes, Tensor)
+                       else input_nodes, np.int64).reshape(-1)
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True requires eids")
+    ev = None
+    if eids is not None:
+        ev = np.asarray(eids.data if isinstance(eids, Tensor) else eids,
+                        np.int64)
+    out_n, out_c, out_e = [], [], []
+    for nd in seeds:
+        lo, hi = int(cp[nd]), int(cp[nd + 1])
+        idx = np.arange(lo, hi)
+        if sample_size >= 0 and idx.size > sample_size:
+            idx = np.random.choice(idx, size=sample_size, replace=False)
+        out_n.extend(rw[idx].tolist())
+        out_c.append(idx.size)
+        if ev is not None:
+            out_e.extend(ev[idx].tolist())
+    res = (Tensor(np.asarray(out_n, np.int64)),
+           Tensor(np.asarray(out_c, np.int64)))
+    if return_eids:
+        res = res + (Tensor(np.asarray(out_e, np.int64)),)
+    return res
+
+
+def _reindex(x_nodes, neighbor_sets):
+    """Shared reindex core: compact ids with the input nodes first, then
+    new neighbors in order of appearance. neighbor_sets: list of
+    (neighbors [Ei], count [Ni]) pairs with sum(count) == Ei."""
+    import numpy as np
+    id_map = {}
+    order = []
+    for n in x_nodes:
+        if int(n) not in id_map:
+            id_map[int(n)] = len(order)
+            order.append(int(n))
+    srcs, dsts = [], []
+    for nbrs, cnt in neighbor_sets:
+        pos = 0
+        for xi, c in enumerate(cnt):
+            for _ in range(int(c)):
+                nb = int(nbrs[pos])
+                pos += 1
+                if nb not in id_map:
+                    id_map[nb] = len(order)
+                    order.append(nb)
+                srcs.append(id_map[nb])
+                dsts.append(id_map[int(x_nodes[xi])])
+        if pos != len(nbrs):
+            raise ValueError("count does not sum to len(neighbors)")
+    return (np.asarray(srcs, np.int64), np.asarray(dsts, np.int64),
+            np.asarray(order, np.int64))
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact a sampled neighborhood to local ids (ref: geometric/
+    reindex.py reindex_graph): out_nodes = x ++ first-seen new neighbors;
+    reindex_src = neighbors in local ids; reindex_dst = each x node
+    repeated count times."""
+    import numpy as np
+    xs = np.asarray(x.data if isinstance(x, Tensor) else x,
+                    np.int64).reshape(-1)
+    nb = np.asarray(neighbors.data if isinstance(neighbors, Tensor)
+                    else neighbors, np.int64).reshape(-1)
+    ct = np.asarray(count.data if isinstance(count, Tensor) else count,
+                    np.int64).reshape(-1)
+    if len(ct) != len(xs):
+        raise ValueError(f"count has {len(ct)} entries for {len(xs)} nodes")
+    src, dst, nodes = _reindex(xs, [(nb, ct)])
+    return Tensor(src), Tensor(dst), Tensor(nodes)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """reindex_graph over per-edge-type neighbor lists sharing one id
+    space (ref: geometric/reindex.py reindex_heter_graph)."""
+    import numpy as np
+    xs = np.asarray(x.data if isinstance(x, Tensor) else x,
+                    np.int64).reshape(-1)
+    sets = []
+    for nb, ct in zip(neighbors, count):
+        nbv = np.asarray(nb.data if isinstance(nb, Tensor) else nb,
+                         np.int64).reshape(-1)
+        ctv = np.asarray(ct.data if isinstance(ct, Tensor) else ct,
+                         np.int64).reshape(-1)
+        if len(ctv) != len(xs):
+            raise ValueError(
+                f"count has {len(ctv)} entries for {len(xs)} nodes")
+        sets.append((nbv, ctv))
+    src, dst, nodes = _reindex(xs, sets)
+    return Tensor(src), Tensor(dst), Tensor(nodes)
+
+
 from .graph import (GraphTable, sample_subgraph,  # noqa: E402,F401
                     graph_khop_sampler)

@@ -2,3 +2,9 @@
 from . import autograd
 from . import checkpoint
 from . import nn
+from .optimizer import LookAhead, ModelAverage
+from .ops import (softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
+                  identity_loss, graph_send_recv, graph_sample_neighbors,
+                  graph_reindex)
+from ..geometric import segment_sum, segment_mean, segment_max, segment_min
+from ..geometric.graph import graph_khop_sampler

@@ -1,0 +1,82 @@
+"""Incubate functional ops (ref: python/paddle/incubate/operators/):
+fused-softmax masks, identity_loss, and the graph op aliases."""
+import jax
+import jax.numpy as jnp
+
+from ..ops import apply
+from ..tensor.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fused pass (ref: incubate/operators/
+    softmax_mask_fuse.py — the CUDA fusion exists to avoid materializing
+    x + mask; XLA fuses the add into the softmax on TPU, so the semantics
+    ARE the fusion here)."""
+
+    def fn(a, m):
+        return jax.nn.softmax((a + m).astype(jnp.float32),
+                              axis=-1).astype(a.dtype)
+
+    return apply(fn, _t(x), _t(mask), name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked softmax over the last two dims (ref: incubate/
+    operators/softmax_mask_fuse_upper_triangle.py): positions ABOVE the
+    diagonal are masked out."""
+
+    def fn(a):
+        s_q, s_k = a.shape[-2], a.shape[-1]
+        tri = jnp.tril(jnp.ones((s_q, s_k), bool), s_k - s_q)
+        logits = jnp.where(tri, a.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(logits, axis=-1).astype(a.dtype)
+
+    return apply(fn, _t(x), name="softmax_mask_fuse_upper_triangle")
+
+
+def identity_loss(x, reduction="none"):
+    """ref: incubate/operators/identity_loss.py — mark x as the loss with
+    a reduction; accepts the reference's int codes (0=sum, 1=mean,
+    2=none) or their names."""
+    codes = {0: "sum", 1: "mean", 2: "none"}
+    red = codes.get(reduction, reduction)
+    if red == "sum":
+        return apply(jnp.sum, _t(x), name="identity_loss")
+    if red == "mean":
+        return apply(jnp.mean, _t(x), name="identity_loss")
+    if red == "none":
+        return _t(x)
+    raise ValueError(f"unsupported reduction {reduction!r}")
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Legacy alias of geometric.send_u_recv (ref: incubate/operators/
+    graph_send_recv.py; pool_type is the old name of reduce_op)."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Legacy alias of geometric.sample_neighbors (ref: incubate/
+    operators/graph_sample_neighbors.py)."""
+    from ..geometric import sample_neighbors
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, eids=eids,
+                            return_eids=return_eids)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Legacy alias of geometric.reindex_graph (ref: incubate/operators/
+    graph_reindex.py)."""
+    from ..geometric import reindex_graph
+    return reindex_graph(x, neighbors, count)

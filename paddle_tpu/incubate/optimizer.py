@@ -1,0 +1,127 @@
+"""Incubate optimizers (ref: python/paddle/incubate/optimizer/lookahead.py,
+modelaverage.py) — slow/averaged weight tiers over any inner optimizer."""
+import numpy as np
+import jax.numpy as jnp
+
+
+class LookAhead:
+    """k-step lookahead (ref: lookahead.py LookAhead): the inner optimizer
+    runs every step; every k steps the slow weights move
+    slow += alpha * (fast - slow) and the fast weights are reset to them."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        self._slow = None  # lazily captured at the first step
+
+    def _params(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        if self._slow is None:
+            self._slow = [jnp.asarray(p.data) for p in self._params()]
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p, s in zip(self._params(), self._slow):
+                new_slow = s + self.alpha * (p.data - s)
+                p.data = new_slow
+            self._slow = [jnp.asarray(p.data) for p in self._params()]
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        sd = dict(self.inner_optimizer.state_dict())
+        sd["@lookahead_step"] = self._step_count
+        if self._slow is not None:
+            for i, s in enumerate(self._slow):
+                sd[f"@lookahead_slow_{i}"] = np.asarray(s)
+        return sd
+
+    def set_state_dict(self, sd):
+        sd = dict(sd)
+        self._step_count = int(sd.pop("@lookahead_step", 0))
+        slow = []
+        i = 0
+        while f"@lookahead_slow_{i}" in sd:
+            slow.append(jnp.asarray(sd.pop(f"@lookahead_slow_{i}")))
+            i += 1
+        self._slow = slow or None
+        self.inner_optimizer.set_state_dict(sd)
+
+
+class ModelAverage:
+    """Running parameter average with apply()/restore() swapping
+    (ref: modelaverage.py ModelAverage). The reference's windowed
+    accumulator triple (num_updates/num_accumulates/old_num_accumulates)
+    collapses on a single controller to one running sum bounded by
+    max_average_window."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("ModelAverage requires `parameters`")
+        self.rate = float(average_window_rate)
+        self.min_w = int(min_average_window)
+        self.max_w = int(max_average_window)
+        self._params = list(parameters)
+        self._sum = [jnp.zeros_like(jnp.asarray(p.data))
+                     for p in self._params]
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current weights; restart the window when it
+        exceeds max(min_average_window, num_updates * rate) the way the
+        reference rolls old accumulators out."""
+        window = max(self.min_w, int((self._count + 1) * self.rate))
+        window = min(window, self.max_w)
+        if self._count >= window:
+            self._sum = [jnp.zeros_like(s) for s in self._sum]
+            self._count = 0
+        self._sum = [s + jnp.asarray(p.data)
+                     for s, p in zip(self._sum, self._params)]
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Context manager: swap the averaged weights in."""
+        outer = self
+
+        class _Ctx:
+            def __enter__(self_ctx):
+                if outer._count == 0:
+                    raise RuntimeError(
+                        "ModelAverage.apply before any step()")
+                outer._backup = [jnp.asarray(p.data)
+                                 for p in outer._params]
+                for p, s in zip(outer._params, outer._sum):
+                    p.data = (s / outer._count).astype(s.dtype)
+                return outer
+
+            def __exit__(self_ctx, *exc):
+                if need_restore:
+                    outer.restore()
+                return False
+
+        return _Ctx()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p.data = b
+        self._backup = None

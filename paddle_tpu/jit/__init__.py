@@ -18,6 +18,7 @@ from ..autograd import tape
 from ..framework import random as rnd
 from ..tensor.tensor import Tensor
 from . import dy2static  # noqa: F401  (control-flow converters)
+from .export import TranslatedLayer  # noqa: F401
 
 # capture stacks consulted by ops.apply: touched tensors and op-produced
 # tensors (the difference = true leaves: params/buffers/constants).
@@ -132,6 +133,31 @@ class TracedFunction:
         return jitted, captured, out_tree_box[0]
 
 
+_to_static_enabled = [True]
+_verbosity = [0]
+_code_level = [0]
+
+
+def enable_to_static(enable_to_static_bool):
+    """ref: jit/api.py enable_to_static (ProgramTranslator.enable): a
+    global off-switch — with False, @to_static-decorated callables run
+    their ORIGINAL eager bodies (applied at call time, so already-
+    decorated layers/functions honor it too)."""
+    _to_static_enabled[0] = bool(enable_to_static_bool)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """ref: jit/dy2static/logging_utils.py set_verbosity — dy2static
+    transform logging level (transforms log via warnings at level>0)."""
+    _verbosity[0] = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """ref: logging_utils.py set_code_level — print the converted source
+    of the next `level` transformed callables."""
+    _code_level[0] = int(level)
+
+
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, **kwargs):
     """ref: python/paddle/jit/api.py:221."""
@@ -154,6 +180,8 @@ def to_static(function=None, input_spec=None, build_strategy=None,
                 return f
         if isinstance(fn, Layer):
             layer = fn
+            raw_forward = layer.forward  # pre-conversion, for the
+            #                              enable_to_static(False) switch
             # AST tier (ref: jit/dy2static/ transformers): plain Python
             # if/while/bool-ops over tensor values become converter calls;
             # the converted forward serves BOTH eager and traced modes
@@ -165,13 +193,29 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             layer._traced_forward = traced
 
             def fwd(*a, **k):
+                if not _to_static_enabled[0]:
+                    return raw_forward(*a, **k)
                 if layer.training:
                     return orig_forward(*a, **k)
                 return traced(*a, **k)
 
             layer.forward = fwd
             return layer
-        return functools.wraps(fn)(TracedFunction(convert_callable(fn)))
+        traced_fn = TracedFunction(convert_callable(fn))
+
+        @functools.wraps(fn)
+        def dispatch(*a, **k):
+            if not _to_static_enabled[0]:
+                return fn(*a, **k)
+            return traced_fn(*a, **k)
+
+        # export._resolve_forward unwraps to_static results via `_fn` so
+        # jit.save traces the raw converted function, not the runtime
+        # TracedFunction machinery (whose rnd.next_key() would bake a
+        # fixed RNG key into the exported StableHLO)
+        dispatch._fn = traced_fn._fn
+        dispatch._traced = traced_fn
+        return dispatch
 
     if function is not None:
         return decorate(function)
@@ -210,7 +254,7 @@ def save(layer, path, input_spec=None, **configs):
 def load(path, **configs):
     """Load a saved program as an inference-only TranslatedLayer
     (ref: python/paddle/jit/translated_layer.py)."""
-    from .export import ExportedProgram, TranslatedLayer
+    from .export import ExportedProgram
     return TranslatedLayer(ExportedProgram.load(path))
 
 

@@ -437,7 +437,7 @@ def _transform_code(fn):
     ast.fix_missing_locations(tree)
     filename = f"<dy2static {fn.__module__}.{fn.__qualname__}>"
     code = compile(tree, filename, "exec")
-    entry = (code, fdef.name, freevars)
+    entry = (code, fdef.name, freevars, ast.unparse(tree))
     _code_cache[key] = entry
     return entry
 
@@ -452,7 +452,17 @@ def convert_function(fn):
     entry = _transform_code(fn)
     if entry is None:
         return fn
-    code, name, freevars = entry
+    code, name, freevars, src_text = entry
+    from . import _code_level, _verbosity
+    if _verbosity[0] > 0:
+        import warnings
+        warnings.warn(
+            f"dy2static: converted {fn.__qualname__} "
+            f"(free variables: {list(fn.__code__.co_freevars) or 'none'})")
+    if _code_level[0] > 0:
+        _code_level[0] -= 1
+        print(f"# dy2static transformed source of {fn.__qualname__}:\n"
+              f"{src_text}")
     # run against the LIVE module globals (late-bound helpers, monkey-
     # patching); the single injected converter name is namespaced
     g = fn.__globals__

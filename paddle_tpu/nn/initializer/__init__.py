@@ -165,6 +165,36 @@ class Dirac(Initializer):
         return jnp.asarray(out, dtype)
 
 
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convolutions
+    (ref: python/paddle/nn/initializer/Bilinear): weight [c_out, c_in,
+    k, k] gets the separable triangle filter so a stride-s
+    conv_transpose starts as bilinear interpolation."""
+
+    uses_rng = False
+
+    def __init__(self, name=None):
+        pass
+
+    def __call__(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError(
+                f"Bilinear initializer needs a 4-D conv weight, got "
+                f"shape {tuple(shape)}")
+        kh, kw = shape[2], shape[3]
+
+        def tri(k):
+            f = (k + 1) // 2
+            c = f - 1 if k % 2 == 1 else f - 0.5
+            return 1 - np.abs(np.arange(k) - c) / f
+
+        kern = np.outer(tri(kh), tri(kw)).astype(np.float32)
+        out = np.zeros(shape, np.float32)
+        for i in range(shape[0]):
+            out[i, i % shape[1]] = kern
+        return jnp.asarray(out, dtype)
+
+
 def calculate_gain(nonlinearity, param=None):
     if nonlinearity == "tanh":
         return 5.0 / 3

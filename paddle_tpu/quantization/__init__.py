@@ -51,18 +51,28 @@ def dequantize_weight(q, scale, axis=None):
 
 class QuantizedLinear(Layer):
     """int8-weight Linear: weights stored int8 + per-out-channel scales,
-    dequantized into the matmul (XLA fuses; true int8 matmul next round)."""
+    dequantized into the matmul (XLA fuses; true int8 matmul next round).
+    With a calibrated `act_scale` (PTQ) the input is also snapped to the
+    int8 grid, so deployment numerics match the int8 activation path."""
 
-    def __init__(self, linear, bits=8):
+    def __init__(self, linear, bits=8, act_scale=None):
         super().__init__()
         q, s = quantize_weight(linear.weight, bits, axis=0)
         self.register_buffer("qweight", q)
         self.register_buffer("scales", s)
         self.bias = linear.bias
+        self.bits = bits
+        self.act_scale = float(act_scale) if act_scale else None
 
     def forward(self, x):
         from ..ops import apply
+        qmax = 2 ** (self.bits - 1) - 1
+        act_scale = self.act_scale
+
         def fn(a, qw, sc, *b):
+            if act_scale is not None:
+                a = jnp.clip(jnp.round(a / a.dtype.type(act_scale)),
+                             -qmax - 1, qmax) * a.dtype.type(act_scale)
             w = qw.astype(a.dtype) * sc[None, :].astype(a.dtype)
             out = a @ w
             if b:
@@ -193,3 +203,170 @@ class QAT:
             model = copy.deepcopy(model)
         return self._swap(model, lambda l: QuantizedLinear(l, self.bits),
                           True)
+
+
+# --- quantization 2.0 API (ref: python/paddle/quantization/{config,base_
+# observer,base_quanter,factory,ptq}.py) ------------------------------------
+
+class BaseObserver(Layer):
+    """ref: base_observer.py — a Layer that watches the tensors flowing
+    through it (forward returns its input) and reports quant params."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+
+    def forward(self, x):
+        self._observe(x)
+        return x
+
+    def _observe(self, x):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return 0.0
+
+
+class BaseQuanter(BaseObserver):
+    """ref: base_quanter.py — an observer whose forward may also
+    (fake-)quantize; the QAT tier's FakeQuanterWithAbsMaxObserver is the
+    canonical concrete quanter."""
+
+
+def quanter(name):
+    """ref: factory.py quanter — class decorator turning an observer/
+    quanter class into a FACTORY: `MyQuanter(bits=4)` returns a factory
+    whose `_instance(layer)` builds the real quanter per wrapped layer."""
+
+    def deco(cls):
+        class _Factory:
+            def __init__(self, *args, **kwargs):
+                self._args = args
+                self._kwargs = kwargs
+
+            def _instance(self, layer=None):
+                return cls(*self._args, **self._kwargs)
+
+        _Factory.__name__ = name
+        _Factory._quanter_cls = cls
+        return _Factory
+
+    return deco
+
+
+@quanter("AbsmaxObserverFactory")
+class _AbsmaxActObserver(BaseObserver):
+    """Default PTQ activation observer: running absmax."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._impl = AbsmaxObserver(quant_bits)
+
+    def _observe(self, x):
+        self._impl.observe(x)
+
+    @property
+    def observed(self):
+        return self._impl._absmax > 0
+
+    def scales(self):
+        return self._impl.scale()
+
+
+class QuantConfig:
+    """ref: config.py QuantConfig — which quanter/observer wraps which
+    layer. Per-layer beats per-type beats the global default."""
+
+    def __init__(self, activation=None, weight=None):
+        self.default_activation = activation
+        self.default_weight = weight
+        self._layer_cfg = {}
+        self._type_cfg = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_cfg[id(l)] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = (layer_type if isinstance(layer_type, (list, tuple))
+                 else [layer_type])
+        for t in types:
+            self._type_cfg[t] = (activation, weight)
+
+    def _config_for(self, layer):
+        if id(layer) in self._layer_cfg:
+            return self._layer_cfg[id(layer)]
+        for t, cfg in self._type_cfg.items():
+            if isinstance(layer, t):
+                return cfg
+        return (self.default_activation, self.default_weight)
+
+
+class _ObservedLinear(Layer):
+    """Calibration wrapper: observe activations, run the fp Linear."""
+
+    def __init__(self, linear, act_observer):
+        super().__init__()
+        self.inner = linear
+        self.act_observer = act_observer
+
+    def forward(self, x):
+        if self.act_observer is not None:
+            x = self.act_observer(x)
+        return self.inner(x)
+
+
+class PTQ:
+    """ref: ptq.py PTQ — post-training quantization: quantize() inserts
+    observers, the user runs calibration batches, convert() emits the
+    int8 deploy model (QuantizedLinear: int8 weights + per-channel
+    scales)."""
+
+    def __init__(self, config=None):
+        self.config = config or QuantConfig(
+            activation=_AbsmaxActObserver(), weight=None)
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        from ..nn.layer.common import Linear
+
+        def swap(m):
+            for name, sub in list(m._sub_layers.items()):
+                if isinstance(sub, Linear):
+                    act, _w = self.config._config_for(sub)
+                    obs = act._instance(sub) if act is not None else None
+                    m._sub_layers[name] = _ObservedLinear(sub, obs)
+                else:
+                    swap(sub)
+
+        swap(model)
+        return model
+
+    def convert(self, model, inplace=False):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def swap(m):
+            for name, sub in list(m._sub_layers.items()):
+                if isinstance(sub, _ObservedLinear):
+                    # the calibrated activation scale feeds the deploy
+                    # model — calibration MUST change the converted
+                    # numerics (r5 code review: it was dropped); an
+                    # observer that saw no data contributes no act quant
+                    obs = sub.act_observer
+                    scale = (obs.scales() if obs is not None
+                             and getattr(obs, "observed", True) else None)
+                    m._sub_layers[name] = QuantizedLinear(
+                        sub.inner, act_scale=scale)
+                else:
+                    swap(sub)
+
+        swap(model)
+        return model

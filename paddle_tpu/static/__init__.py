@@ -19,6 +19,19 @@ import numpy as np
 from ..jit import InputSpec, TracedFunction
 from ..tensor.tensor import Tensor
 from .program import Program, current_program, _recording_stack
+from .compat import (global_scope, scope_guard, Scope, name_scope,  # noqa: F401
+                     device_guard, cpu_places, cuda_places, xpu_places,
+                     npu_places, mlu_places, BuildStrategy,
+                     ExecutionStrategy, CompiledProgram, ParallelExecutor,
+                     ipu_shard_guard, set_ipu_shard, IpuStrategy,
+                     IpuCompiledProgram, Variable, create_global_var,
+                     create_parameter, WeightNormParamAttr, Print, py_func,
+                     ExponentialMovingAverage, serialize_program,
+                     serialize_persistables, save_to_file, load_from_file,
+                     deserialize_program, deserialize_persistables,
+                     normalize_program, load_program_state,
+                     set_program_state, accuracy, auc, ctr_metric_bundle,
+                     exponential_decay)
 from . import passes  # noqa: F401  (registers the built-in passes)
 from . import distributed_passes  # noqa: F401  (DP/ZeRO program passes)
 from . import nn  # noqa: F401  (control flow: cond/while_loop/case)
@@ -110,7 +123,11 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None, **kwargs):
         from ..jit.export import ExportedProgram
+        from .compat import CompiledProgram
         import jax as _jax
+
+        if isinstance(program, CompiledProgram):
+            program = program.program  # strategy knobs are XLA's job
 
         # deployment artifacts (load_inference_model) still run directly
         if isinstance(program, ExportedProgram):

@@ -1,0 +1,511 @@
+"""Static-graph compat tier (ref: python/paddle/static/__init__.py tail):
+scopes, places, strategies, serialization helpers, EMA, py_func, metric
+ops. Real where the concept maps to this framework (scopes, EMA, py_func,
+metrics, serialization over the StableHLO export); honest loud errors
+where it cannot (IPU tier)."""
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from ..nn.param_attr import ParamAttr
+
+
+# --- scopes ----------------------------------------------------------------
+
+class _ScopeVar:
+    def __init__(self, tensor):
+        self._t = tensor
+
+    def get_tensor(self):
+        return self._t
+
+
+class Scope:
+    """Name -> value table (ref: the C++ Scope; here a plain dict — XLA
+    owns real variable storage)."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        self._vars.setdefault(name, _ScopeVar(None))
+        return self._vars[name]
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def set(self, name, tensor):
+        self._vars[name] = _ScopeVar(tensor)
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    """ref: static/__init__.py global_scope."""
+    return _scope_stack[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """ref: executor.py scope_guard."""
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """ref: framework.py name_scope — a REAL jax.named_scope: the prefix
+    lands in HLO op metadata, so it shows up in XLA profiles the way the
+    reference's scopes show in its timeline."""
+    if prefix:
+        with jax.named_scope(str(prefix)):
+            yield
+    else:
+        yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """ref: framework.py device_guard — pin ops to 'cpu'/'gpu:0'-style
+    devices; maps to jax.default_device."""
+    if device is None:
+        yield
+        return
+    kind = str(device).split(":")[0]
+    pool = {"cpu": "cpu", "gpu": None, "npu": None, "xpu": None}.get(kind, kind)
+    if pool == "cpu":
+        with jax.default_device(jax.devices("cpu")[0]):
+            yield
+    else:
+        # non-CPU guards are placement hints the XLA scheduler owns
+        yield
+
+
+# --- places ----------------------------------------------------------------
+
+def cpu_places(device_count=None):
+    """ref: framework.py cpu_places."""
+    from ..framework.place import CPUPlace
+    n = device_count or len(jax.devices("cpu")) if _has_cpu() else 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def _has_cpu():
+    try:
+        return bool(jax.devices("cpu"))
+    except RuntimeError:
+        return False
+
+
+def _no_vendor_places(kind):
+    raise RuntimeError(
+        f"{kind}_places() is not available in a TPU/XLA build; TPU devices "
+        f"come from jax.devices()")
+
+
+def cuda_places(device_ids=None):
+    _no_vendor_places("cuda")
+
+
+def xpu_places(device_ids=None):
+    _no_vendor_places("xpu")
+
+
+def npu_places(device_ids=None):
+    _no_vendor_places("npu")
+
+
+def mlu_places(device_ids=None):
+    _no_vendor_places("mlu")
+
+
+# --- strategies / compiled program -----------------------------------------
+
+class _AttrBag:
+    """Accepts the reference's tuning attributes; XLA owns the decisions
+    they used to make, so they are recorded and readable but have no
+    execution effect."""
+
+    def __init__(self):
+        object.__setattr__(self, "_attrs", {})
+
+    def __setattr__(self, k, v):
+        self._attrs[k] = v
+
+    def __getattr__(self, k):
+        try:
+            return object.__getattribute__(self, "_attrs")[k]
+        except KeyError:
+            return None
+
+
+class BuildStrategy(_AttrBag):
+    """ref: BuildStrategy — fusion/memory-reuse knobs; XLA's pipeline
+    performs these (BASELINE.md descope ledger: no second graph
+    compiler)."""
+
+
+class ExecutionStrategy(_AttrBag):
+    """ref: ExecutionStrategy — thread/scope-reuse knobs for the PE."""
+
+
+class CompiledProgram:
+    """ref: compiler.py CompiledProgram — wraps a Program with a build
+    strategy; Executor.run unwraps it (compilation happens at jit time)."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, *a, **k):
+        return self
+
+
+class ParallelExecutor:
+    """ref: parallel_executor.py (deprecated there, compat here) — SPMD
+    compilation replaces the multi-stream PE; delegates to Executor."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 build_strategy=None, exec_strategy=None, scope=None,
+                 share_vars_from=None):
+        from . import Executor
+        self._exe = Executor()
+        self._program = main_program
+
+    def run(self, fetch_list=None, feed=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=fetch_list)
+
+
+# --- IPU tier: loud errors --------------------------------------------------
+
+def _no_ipu(*a, **k):
+    raise RuntimeError("the IPU tier is not available in a TPU/XLA build")
+
+
+ipu_shard_guard = _no_ipu
+set_ipu_shard = _no_ipu
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        _no_ipu()
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        _no_ipu()
+
+
+# --- vars / params ----------------------------------------------------------
+
+Variable = Tensor  # the static-graph variable IS a Tensor here
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """ref: tensor/creation.py create_global_var."""
+    t = Tensor(jnp.full(tuple(shape), value, jnp.dtype(dtype)))
+    t.persistable = persistable
+    if name:
+        t.name = name
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """ref: tensor/creation.py create_parameter — a trainable leaf."""
+    from ..nn import initializer as I
+    init = default_initializer or (I.Constant(0.0) if is_bias
+                                   else I.XavierUniform())
+    t = Tensor(init(tuple(shape), jnp.dtype(dtype)), stop_gradient=False)
+    t.persistable = True
+    if name:
+        t.name = name
+    return t
+
+
+class WeightNormParamAttr(ParamAttr):
+    """ref: nn/utils/weight_norm_hook.py WeightNormParamAttr — marks a
+    parameter for weight-norm reparameterization along `dim`; layers
+    honor it by routing through nn.utils.weight_norm."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         regularizer=regularizer, trainable=trainable)
+        self.dim = dim
+
+
+# --- debug / callbacks ------------------------------------------------------
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """ref: static/nn/control_flow.py Print — debug-print the tensor and
+    pass it through. Works inside jit via jax.debug.print (the TPU analog
+    of the reference's print op running on the stream)."""
+    from ..ops import apply
+
+    msg = message or getattr(input, "name", "var")
+
+    def fn(a):
+        jax.debug.print(msg + ": {}", a)
+        return a
+
+    return apply(fn, input, name="print")
+
+
+def py_func(func, x, out=None, backward_func=None, skip_vars_in_backward_input=None):
+    """ref: static/nn/common.py py_func — run a host Python function as an
+    op, with an optional hand-written backward. Eager-first: forward runs
+    the function on host arrays; backward_func (if given) defines the vjp
+    through a PyLayer."""
+    from ..autograd import PyLayer
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+
+    if backward_func is None:
+        outs = func(*xs)
+        return outs
+
+    class _PyFunc(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            ctx.save_for_backward(*args)
+            return func(*args)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            saved = ctx.saved_tensor()
+            return backward_func(*saved, *grads)
+
+    return _PyFunc.apply(*xs)
+
+
+# --- EMA -------------------------------------------------------------------
+
+class ExponentialMovingAverage:
+    """ref: static/ema.py ExponentialMovingAverage — shadow = decay *
+    shadow + (1 - decay) * param, with the reference's optional
+    thres_steps-free bias correction, and apply()/restore() swapping."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._shadow = None
+        self._params = None
+        self._backup = None
+        self._step = 0
+
+    def _bind(self, parameters):
+        self._params = list(parameters)
+        # zero-seeded accumulator: the 1/(1 - decay^t) bias correction in
+        # apply() is only valid against a zero start (r5 code review: a
+        # value-seeded shadow plus that correction INFLATES weights ~500x
+        # at decay=0.999)
+        self._shadow = [jnp.zeros_like(jnp.asarray(p.data))
+                        for p in self._params]
+
+    def update(self, parameters=None):
+        if self._params is None:
+            if parameters is None:
+                raise ValueError(
+                    "first update() needs `parameters` to track")
+            self._bind(parameters)
+        d = self._decay
+        self._shadow = [d * s + (1.0 - d) * jnp.asarray(p.data)
+                        for s, p in zip(self._shadow, self._params)]
+        self._step += 1
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        if self._params is None:
+            raise RuntimeError("EMA.apply before any update()")
+        self._backup = [jnp.asarray(p.data) for p in self._params]
+        corr = 1.0 - self._decay ** max(self._step, 1)
+        for p, s in zip(self._params, self._shadow):
+            p.data = (s / corr).astype(s.dtype)
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p.data = b
+        self._backup = None
+
+
+# --- serialization ----------------------------------------------------------
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """ref: static/io.py serialize_program — the deployable program as
+    bytes (here: the .pdmodel StableHLO artifact payload)."""
+    import os
+    import tempfile
+    from . import save_inference_model
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "prog")
+        save_inference_model(prefix, feed_vars, fetch_vars,
+                             program=program, **kwargs)
+        with open(prefix + ".pdmodel", "rb") as f:
+            return f.read()
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    """ref: static/io.py serialize_persistables — the parameter payload
+    bytes (.pdiparams)."""
+    import os
+    import tempfile
+    from . import save_inference_model
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "prog")
+        save_inference_model(prefix, feed_vars, fetch_vars,
+                             program=program, **kwargs)
+        with open(prefix + ".pdiparams", "rb") as f:
+            return f.read()
+
+
+def save_to_file(path, content):
+    """ref: static/io.py save_to_file."""
+    if not isinstance(content, bytes):
+        raise TypeError("save_to_file writes bytes")
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    """ref: static/io.py load_from_file."""
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    """ref: static/io.py deserialize_program — bytes (serialize_program)
+    back to an executable ExportedProgram."""
+    import os
+    import tempfile
+    raise_hint = ("deserialize_program needs BOTH artifacts; pass the "
+                  "persistables bytes too")
+    if isinstance(data, tuple):
+        prog_bytes, params_bytes = data
+    else:
+        prog_bytes, params_bytes = data, None
+    if params_bytes is None:
+        raise ValueError(raise_hint)
+    from ..jit.export import ExportedProgram
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "prog")
+        with open(prefix + ".pdmodel", "wb") as f:
+            f.write(prog_bytes)
+        with open(prefix + ".pdiparams", "wb") as f:
+            f.write(params_bytes)
+        return ExportedProgram.load(prefix)
+
+
+def deserialize_persistables(program, data, executor=None):
+    """ref: static/io.py deserialize_persistables — combined with
+    deserialize_program via the (program, params) tuple form."""
+    return deserialize_program((program, data))
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """ref: static/io.py normalize_program — prune to the inference
+    slice. XLA dead-code-eliminates at compile, so the recorded program
+    is returned unchanged (validated)."""
+    return program
+
+
+def load_program_state(model_path, var_list=None):
+    """ref: static/io.py load_program_state."""
+    from ..framework.io import load as _load
+    path = model_path if model_path.endswith(".pdparams") \
+        else model_path + ".pdparams"
+    return _load(path)
+
+
+def set_program_state(program, state_dict):
+    """ref: static/io.py set_program_state — write values into the
+    program's leaf tensors by name."""
+    from .program import Program
+    if isinstance(program, Program):
+        by_name = {program.vars[vid].name: program.vars[vid].tensor
+                   for vid in program.leaf_ids()}
+        for name, value in state_dict.items():
+            if name in by_name:
+                by_name[name].set_value(value)
+        return
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(state_dict)
+
+
+# --- metric ops -------------------------------------------------------------
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """ref: static/nn/metric.py accuracy — top-k accuracy as a Tensor."""
+    from ..ops import apply
+
+    def fn(p, y):
+        topk = jnp.argsort(p, axis=-1)[..., -k:]
+        hit = jnp.any(topk == y.reshape(-1, 1), axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply(fn, input, label, name="accuracy")
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """ref: static/nn/metric.py auc — returns (auc_value, batch_auc,
+    [stat tensors]) like the reference's 3-output contract."""
+    from ..metric import Auc as _Auc
+    m = _Auc(num_thresholds=num_thresholds)
+    pred = np.asarray(input.numpy() if isinstance(input, Tensor) else input)
+    lab = np.asarray(label.numpy() if isinstance(label, Tensor) else label)
+    if pred.ndim == 1:
+        pred = np.stack([1 - pred, pred], axis=1)
+    m.update(pred, lab)
+    val = np.float32(m.accumulate())
+    t = Tensor(jnp.asarray(val))
+    return t, t, []
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """ref: static/nn/metric.py ctr_metric_bundle — (auc, batch_auc,
+    prediction mean, label mean) for CTR monitoring."""
+    a, b, _ = auc(input, label)
+    from ..ops import apply
+
+    pm = apply(lambda p: jnp.mean(p.astype(jnp.float32)), input,
+               name="ctr_pred_mean")
+    lm = apply(lambda y: jnp.mean(y.astype(jnp.float32)), label,
+               name="ctr_label_mean")
+    return a, b, pm, lm
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """ref: fluid layers exponential_decay — returns the LRScheduler
+    analog (gamma applied per decay_steps window)."""
+    from ..optimizer.lr import ExponentialDecay as _Exp
+
+    class _SteppedExp(_Exp):
+        def get_lr(self):
+            k = self.last_epoch // decay_steps if staircase \
+                else self.last_epoch / decay_steps
+            return self.base_lr * (decay_rate ** k)
+
+    return _SteppedExp(learning_rate, gamma=decay_rate)

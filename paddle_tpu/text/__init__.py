@@ -42,6 +42,120 @@ class Imdb(Dataset):
         return len(self.docs)
 
 
+class Conll05st(Dataset):
+    """ref: text/datasets/conll05.py — 9-field SRL tuples (word, the five
+    ctx_n2..ctx_p2 predicate-context windows, predicate, mark, label);
+    synthesized per the module's zero-egress convention."""
+
+    WORD_DICT, PRED_DICT, LABEL_DICT = 5000, 300, 67
+
+    def __init__(self, mode="train", **kw):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self._items = []
+        for _ in range(256):
+            n = rng.randint(5, 40)
+            words = rng.randint(0, self.WORD_DICT, n).astype(np.int64)
+            ctx = [np.roll(words, s) for s in (-2, -1, 0, 1, 2)]
+            pred = np.full(n, rng.randint(0, self.PRED_DICT), np.int64)
+            mark = (rng.rand(n) < 0.2).astype(np.int64)
+            label = rng.randint(0, self.LABEL_DICT, n).astype(np.int64)
+            self._items.append((words, *ctx, pred, mark, label))
+
+    def __getitem__(self, i):
+        return self._items[i]
+
+    def __len__(self):
+        return len(self._items)
+
+    def get_dict(self):
+        return ({f"w{i}": i for i in range(self.WORD_DICT)},
+                {f"p{i}": i for i in range(self.PRED_DICT)},
+                {f"l{i}": i for i in range(self.LABEL_DICT)})
+
+
+class Imikolov(Dataset):
+    """ref: text/datasets/imikolov.py — PTB-style n-grams."""
+
+    VOCAB = 2000
+
+    def __init__(self, mode="train", data_type="NGRAM", window_size=5, **kw):
+        if data_type not in ("NGRAM", "SEQ"):
+            raise ValueError("data_type must be NGRAM or SEQ")
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        stream = rng.randint(1, self.VOCAB, 4096).astype(np.int64)
+        if data_type == "NGRAM":
+            self._items = [stream[i:i + window_size]
+                           for i in range(len(stream) - window_size)]
+        else:
+            self._items = [stream[i * 32:(i + 1) * 32]
+                           for i in range(len(stream) // 32)]
+
+    def __getitem__(self, i):
+        return tuple(self._items[i])
+
+    def __len__(self):
+        return len(self._items)
+
+
+class Movielens(Dataset):
+    """ref: text/datasets/movielens.py — (user, gender, age, job, movie,
+    categories, title, rating) tuples."""
+
+    def __init__(self, mode="train", **kw):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = 512
+        self._rows = [(rng.randint(0, 6040), rng.randint(0, 2),
+                       rng.randint(0, 7), rng.randint(0, 21),
+                       rng.randint(0, 3883),
+                       rng.randint(0, 18, rng.randint(1, 4)).astype(np.int64),
+                       rng.randint(0, 5000, rng.randint(2, 8)).astype(np.int64),
+                       np.float32(rng.randint(1, 6)))
+                      for _ in range(n)]
+
+    def __getitem__(self, i):
+        return self._rows[i]
+
+    def __len__(self):
+        return len(self._rows)
+
+
+class _WMT(Dataset):
+    """Shared WMT translation-pair synthesis: (src, trg, trg_next)."""
+
+    def __init__(self, mode, dict_size, seed):
+        rng = np.random.RandomState(seed)
+        self.dict_size = dict_size
+        self._pairs = []
+        for _ in range(256):
+            ns, nt = rng.randint(4, 30), rng.randint(4, 30)
+            src = rng.randint(3, dict_size, ns).astype(np.int64)
+            trg = np.concatenate([[0], rng.randint(3, dict_size,
+                                                   nt).astype(np.int64)])
+            trg_next = np.concatenate([trg[1:], [1]])  # shift + <e>
+            self._pairs.append((src, trg, trg_next))
+
+    def __getitem__(self, i):
+        return self._pairs[i]
+
+    def __len__(self):
+        return len(self._pairs)
+
+
+class WMT14(_WMT):
+    """ref: text/datasets/wmt14.py."""
+
+    def __init__(self, mode="train", dict_size=30000, **kw):
+        super().__init__(mode, dict_size, 0 if mode == "train" else 1)
+
+
+class WMT16(_WMT):
+    """ref: text/datasets/wmt16.py."""
+
+    def __init__(self, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", **kw):
+        super().__init__(mode, src_dict_size, 2 if mode == "train" else 3)
+
+
 def viterbi_decode(potentials, transition_params, lengths=None,
                    include_bos_eos_tag=True, name=None):
     """ref: python/paddle/text/viterbi_decode.py — CRF decoding."""

@@ -1,0 +1,424 @@
+"""Round-5 API-surface completion: every name in the reference's public
+__all__ across the major modules resolves, and the new tiers behave
+(static compat, jit knobs, device streams, audio WAV IO, text datasets,
+quantization 2.0 PTQ, saved_tensors_hooks, Bilinear init, distributed
+names). Ref: the per-module __init__.py __all__ lists."""
+import os
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+
+# --- the audit itself, pinned as a test -------------------------------------
+
+REF = "/root/reference/python/paddle"
+MODULES = ["", "nn", "nn.functional", "nn.initializer", "linalg", "fft",
+           "signal", "optimizer", "metric", "io", "amp", "static",
+           "distributed", "vision", "vision.transforms", "vision.ops",
+           "sparse", "distribution", "geometric", "incubate", "audio",
+           "text", "jit", "quantization", "autograd", "device"]
+
+
+def _ref_all(path):
+    import ast
+    try:
+        tree = ast.parse(open(path).read())
+    except (FileNotFoundError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "__all__":
+                    try:
+                        return [ast.literal_eval(e) for e in node.value.elts]
+                    except Exception:
+                        return None
+    return None
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not present")
+@pytest.mark.parametrize("mod", MODULES)
+def test_public_all_resolves(mod):
+    import importlib
+    sub = (mod.replace(".", "/") + "/") if mod else ""
+    names = _ref_all(f"{REF}/{sub}__init__.py")
+    if names is None:
+        pytest.skip("no __all__ literal in the reference module")
+    ours = importlib.import_module("paddle_tpu" + (f".{mod}" if mod else ""))
+    missing = [n for n in names if not hasattr(ours, n)]
+    assert not missing, f"paddle.{mod or '<top>'} missing: {missing}"
+
+
+# --- static compat tier ------------------------------------------------------
+
+def test_static_scope_and_name_scope():
+    from paddle_tpu import static
+    s = static.Scope()
+    with static.scope_guard(s):
+        assert static.global_scope() is s
+        s.set("w", np.ones(3))
+    assert static.global_scope() is not s
+    with static.name_scope("blockA"):
+        pass  # named_scope must nest cleanly outside jit
+
+
+def test_static_ema():
+    from paddle_tpu import static
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    net = nn.Linear(3, 3, bias_attr=False)
+    ema = static.ExponentialMovingAverage(0.5)
+    net.weight.data = jnp.ones((3, 3), jnp.float32)
+    ema.update(net.parameters())
+    net.weight.data = jnp.full((3, 3), 3.0, jnp.float32)
+    ema.update()
+    live = np.asarray(net.weight.data).copy()
+    with ema.apply():
+        # zero-seeded: shadow = .5*(.5*0+.5*1) + .5*3 = 1.75;
+        # bias correction 1 - .5^2 = .75 -> 7/3
+        np.testing.assert_allclose(np.asarray(net.weight.data),
+                                   np.full((3, 3), 1.75 / 0.75), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(net.weight.data), live)
+    # constant weights debias exactly to themselves
+    ema2 = static.ExponentialMovingAverage(0.999)
+    ema2.update(net.parameters())
+    ema2.update()
+    with ema2.apply():
+        np.testing.assert_allclose(np.asarray(net.weight.data), live,
+                                   rtol=1e-5)
+
+
+def test_static_metric_ops():
+    from paddle_tpu import static
+    pred = paddle.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8]], np.float32))
+    label = paddle.to_tensor(np.array([[0], [1]], np.int64))
+    acc = static.accuracy(pred, label)
+    np.testing.assert_allclose(acc.numpy(), 1.0)
+    a, b, stats = static.auc(pred[:, 1], label)
+    assert 0.0 <= float(a.numpy()) <= 1.0
+    vals = static.ctr_metric_bundle(pred[:, 1], label)
+    assert len(vals) == 4
+
+
+def test_static_serialization_roundtrip(tmp_path):
+    from paddle_tpu import static
+    import paddle_tpu.nn as nn
+    paddle.seed(1)
+    net = nn.Linear(4, 2)
+    net.eval()
+    spec = static.InputSpec([1, 4], "float32")
+    prog_b = static.serialize_program([spec], None, program=net)
+    params_b = static.serialize_persistables([spec], None, program=net)
+    static.save_to_file(str(tmp_path / "m.bin"), prog_b)
+    assert static.load_from_file(str(tmp_path / "m.bin")) == prog_b
+    prog = static.deserialize_program((prog_b, params_b))
+    x = np.ones((1, 4), np.float32)
+    got = prog(x)
+    if isinstance(got, (list, tuple)):
+        got = got[0]
+    want = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+def test_compiled_program_and_places():
+    from paddle_tpu import static
+    cp = static.CompiledProgram(None)
+    assert cp.with_data_parallel() is cp
+    bs = static.BuildStrategy()
+    bs.fuse_elewise_add_act_ops = True  # knob recorded, not rejected
+    assert bs.fuse_elewise_add_act_ops is True
+    assert len(static.cpu_places(2)) == 2
+    with pytest.raises(RuntimeError):
+        static.cuda_places()
+    with pytest.raises(RuntimeError):
+        static.IpuStrategy()
+
+
+def test_py_func_with_backward():
+    from paddle_tpu import static
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                         stop_gradient=False)
+
+    def fwd(a):
+        return paddle.to_tensor(a.numpy() ** 2)
+
+    def bwd(a, g):
+        return paddle.to_tensor(2.0 * a.numpy() * g.numpy())
+
+    y = static.py_func(fwd, x, backward_func=bwd)
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+# --- jit knobs ---------------------------------------------------------------
+
+def test_enable_to_static_switch():
+    calls = []
+
+    @paddle.jit.to_static
+    def f(a):
+        calls.append("x")
+        return a * 2
+
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    paddle.jit.enable_to_static(False)
+    try:
+        f(x)
+        n_eager = len(calls)
+        f(x)
+        assert len(calls) == n_eager + 1  # eager body runs every call
+    finally:
+        paddle.jit.enable_to_static(True)
+    np.testing.assert_allclose(f(x).numpy(), 2.0)
+
+
+def test_set_code_level_prints(capsys):
+    paddle.jit.set_code_level(1)
+
+    def branchy(a):
+        if paddle.mean(a) > 0:
+            return a + 1
+        return a - 1
+
+    f = paddle.jit.to_static(branchy)
+    out = capsys.readouterr().out
+    assert "dy2static transformed source" in out
+    # budget consumed: converting another callable prints nothing
+    f2 = paddle.jit.to_static(lambda: None)
+    paddle.jit.set_verbosity(0)
+
+
+# --- device tier -------------------------------------------------------------
+
+def test_device_predicates_and_streams():
+    import paddle_tpu.device as device
+    assert device.get_cudnn_version() is None
+    assert not device.is_compiled_with_rocm()
+    assert not device.is_compiled_with_xpu()
+    with pytest.raises(RuntimeError):
+        device.XPUPlace(0)
+    s = device.current_stream()
+    e = s.record_event()
+    assert e.query()
+    with device.stream_guard(device.Stream()):
+        assert device.current_stream() is not s
+    assert device.current_stream() is s
+
+
+# --- audio IO ---------------------------------------------------------------
+
+def test_audio_wav_roundtrip(tmp_path):
+    import paddle_tpu.audio as audio
+    sr = 16000
+    t = np.linspace(0, 1, sr, endpoint=False)
+    wav = (0.5 * np.sin(2 * np.pi * 440 * t)).astype(np.float32)[None]
+    p = tmp_path / "tone.wav"
+    audio.save(str(p), wav, sr)
+    meta = audio.info(str(p))
+    assert (meta.sample_rate, meta.num_channels,
+            meta.bits_per_sample) == (sr, 1, 16)
+    back, sr2 = audio.load(str(p))
+    assert sr2 == sr
+    np.testing.assert_allclose(back.numpy(), wav, atol=2e-4)
+    assert audio.backends.list_available_backends() == ["wave"]
+    with pytest.raises(ValueError):
+        audio.backends.set_backend("soundfile")
+
+
+# --- text datasets -----------------------------------------------------------
+
+def test_text_datasets_shapes():
+    import paddle_tpu.text as text
+    c = text.Conll05st()
+    item = c[0]  # the reference's 9-slot contract: word, 5 ctx, pred,
+    #              mark, label
+    assert len(item) == 9 and len({len(a) for a in item}) == 1
+    ng = text.Imikolov(data_type="NGRAM", window_size=5)
+    assert len(ng[0]) == 5
+    ml = text.Movielens()
+    assert len(ml[3]) == 8
+    for ds_cls in (text.WMT14, text.WMT16):
+        src, trg, nxt = ds_cls()[0]
+        assert len(trg) == len(nxt)
+        np.testing.assert_array_equal(trg[1:], nxt[:-1])
+
+
+# --- quantization 2.0 --------------------------------------------------------
+
+def test_ptq_flow():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.quantization import PTQ, QuantConfig, QuantizedLinear
+    paddle.seed(2)
+    net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+    ptq = PTQ()
+    observed = ptq.quantize(net, inplace=False)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype(np.float32))
+    observed(x)  # calibration batch
+    deploy = ptq.convert(observed, inplace=False)
+    kinds = [type(l).__name__ for l in deploy._sub_layers.values()]
+    assert kinds.count("QuantizedLinear") == 2
+    out = deploy(x)
+    ref = net(x)
+    # int8 weights: coarse agreement is the contract
+    assert np.mean(np.abs(out.numpy() - ref.numpy())) < 0.1
+
+
+def test_ptq_calibration_affects_deploy():
+    """r5 review regression: the calibrated activation scale must reach
+    the converted model (convert used to drop it)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.quantization import PTQ
+    paddle.seed(4)
+    net = nn.Sequential(nn.Linear(8, 4))
+    ptq = PTQ()
+    calibrated = ptq.quantize(net, inplace=False)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(16, 8)
+                         .astype(np.float32))
+    calibrated(x)
+    with_cal = ptq.convert(calibrated, inplace=False)
+    uncal = ptq.convert(ptq.quantize(net, inplace=False), inplace=False)
+    q = list(with_cal._sub_layers.values())[0]
+    assert q.act_scale is not None and q.act_scale > 0
+    assert list(uncal._sub_layers.values())[0].act_scale is None
+    a = with_cal(x).numpy()
+    b = uncal(x).numpy()
+    assert not np.array_equal(a, b), "calibration had no effect"
+    # and the act-quantized output still tracks the fp model closely
+    assert np.mean(np.abs(a - net(x).numpy())) < 0.1
+
+
+def test_jit_save_unwraps_to_static_function(tmp_path):
+    """r5 review regression: jit.save on a to_static function must trace
+    the raw converted fn (dispatch wrapper exposes _fn)."""
+    @paddle.jit.to_static
+    def f(a):
+        return a * 3.0
+
+    assert hasattr(f, "_fn")
+    p = str(tmp_path / "fn")
+    paddle.jit.save(f, p,
+                    input_spec=[paddle.static.InputSpec([2], "float32")])
+    loaded = paddle.jit.load(p)
+    out = loaded(np.ones(2, np.float32))
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    np.testing.assert_allclose(np.asarray(out).ravel(), [3.0, 3.0])
+
+
+def test_set_verbosity_warns():
+    import warnings as w
+    paddle.jit.set_verbosity(1)
+    try:
+        with w.catch_warnings(record=True) as rec:
+            w.simplefilter("always")
+
+            def g(a):
+                if paddle.mean(a) > 0:
+                    out = a + 1
+                else:
+                    out = a - 1
+                return out
+
+            paddle.jit.to_static(g)
+        assert any("dy2static: converted" in str(x.message) for x in rec)
+    finally:
+        paddle.jit.set_verbosity(0)
+
+
+def test_quanter_decorator():
+    from paddle_tpu.quantization import quanter, BaseQuanter
+
+    @quanter("MyQ")
+    class _Q(BaseQuanter):
+        def __init__(self, quant_bits=8):
+            super().__init__(quant_bits)
+
+        def _observe(self, x):
+            pass
+
+        def scales(self):
+            return 1.0
+
+    factory = _Q(quant_bits=4)
+    inst = factory._instance()
+    assert isinstance(inst, BaseQuanter) and inst.quant_bits == 4
+
+
+# --- autograd hooks ----------------------------------------------------------
+
+def test_saved_tensors_hooks_pack_unpack():
+    from paddle_tpu.autograd import PyLayer, saved_tensors_hooks
+    seen = {"packed": 0, "unpacked": 0}
+
+    def pack(t):
+        seen["packed"] += 1
+        return np.asarray(t.numpy())  # e.g. offload to host
+
+    def unpack(a):
+        seen["unpacked"] += 1
+        return paddle.to_tensor(a)
+
+    class Square(PyLayer):
+        @staticmethod
+        def forward(ctx, a):
+            ctx.save_for_backward(a)
+            return paddle.to_tensor(a.numpy() ** 2)
+
+        @staticmethod
+        def backward(ctx, g):
+            (a,) = ctx.saved_tensor()
+            return paddle.to_tensor(2 * a.numpy() * g.numpy())
+
+    x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    with saved_tensors_hooks(pack, unpack):
+        y = Square.apply(x)
+    y.backward()
+    assert seen["packed"] == 1 and seen["unpacked"] == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+# --- Bilinear init -----------------------------------------------------------
+
+def test_bilinear_initializer_interpolates():
+    from paddle_tpu.nn.initializer import Bilinear
+    w = np.asarray(Bilinear()((1, 1, 4, 4), jnp.float32))
+    assert w.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(w[0, 0, 1, 1], w[0, 0, 2, 2], rtol=1e-6)
+    assert w[0, 0].max() == w[0, 0, 1, 1]  # peak off-center for even k
+    with pytest.raises(ValueError):
+        Bilinear()((4, 4), jnp.float32)
+
+
+# --- distributed names -------------------------------------------------------
+
+def test_distributed_entry_attrs_and_parallel_mode():
+    import paddle_tpu.distributed as dist
+    assert dist.ParallelMode.DATA_PARALLEL == 0
+    assert dist.ParallelMode.SHARDING_PARALLEL == 3
+    assert dist.ProbabilityEntry(0.5)._to_attr() == "probability_entry:0.5"
+    assert dist.CountFilterEntry(10)._to_attr() == "count_filter_entry:10"
+    assert dist.ShowClickEntry("show", "click")._to_attr() == \
+        "show_click_entry:show:click"
+    with pytest.raises(ValueError):
+        dist.ProbabilityEntry(2.0)
+    with pytest.raises(ValueError):
+        dist.CountFilterEntry(0.5)
+    assert dist.is_available()
+
+
+def test_distributed_split_column_parallel():
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(3)
+    x = paddle.to_tensor(np.ones((2, 8), np.float32))
+    out = dist.split(x, (8, 4), "linear", axis=1, num_partitions=1)
+    assert tuple(out.shape) == (2, 4)
+    with pytest.raises(ValueError):
+        dist.split(x, (8, 4), "conv")

@@ -1,0 +1,76 @@
+"""Round-5 distribution completion: register_kl, Independent,
+ExponentialFamily (ref: python/paddle/distribution/{kl,independent,
+exponential_family}.py)."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (Normal, Beta, Independent,
+                                     ExponentialFamily, kl_divergence,
+                                     register_kl)
+
+
+def test_register_kl_wins_over_builtin():
+    @register_kl(Beta, Beta)
+    def _kl_beta(p, q):
+        return paddle.to_tensor(np.float32(42.0))
+
+    try:
+        out = kl_divergence(Beta(2.0, 3.0), Beta(4.0, 5.0))
+        assert float(out.numpy()) == 42.0
+    finally:
+        from paddle_tpu import distribution as D
+        D._KL_REGISTRY.pop((Beta, Beta))
+
+
+def test_register_kl_unregistered_still_raises():
+    class Odd(paddle.distribution.Distribution):
+        pass
+
+    with pytest.raises(NotImplementedError):
+        kl_divergence(Odd(), Odd())
+
+
+def test_independent_sums_log_prob():
+    base = Normal(np.zeros(3, np.float32), np.ones(3, np.float32))
+    ind = Independent(base, 1)
+    assert ind.batch_shape == []
+    assert ind.event_shape == [3]
+    v = np.array([0.5, -0.2, 1.0], np.float32)
+    np.testing.assert_allclose(ind.log_prob(paddle.to_tensor(v)).numpy(),
+                               base.log_prob(paddle.to_tensor(v))
+                               .numpy().sum(), rtol=1e-6)
+    np.testing.assert_allclose(ind.entropy().numpy(),
+                               base.entropy().numpy().sum(), rtol=1e-6)
+    with pytest.raises(ValueError):
+        Independent(base, 2)
+
+
+def test_exponential_family_entropy_normal():
+    class NormalEF(ExponentialFamily):
+        """Unit test vehicle: N(mu, sigma) in natural parameterization
+        eta = (mu/s^2, -1/(2 s^2)); A = -eta1^2/(4 eta2)
+        - log(-2 eta2)/2; carrier -log h = log(2 pi)/2."""
+
+        def __init__(self, loc, scale):
+            self.loc = np.float32(loc)
+            self.scale = np.float32(scale)
+            super().__init__(())
+
+        @property
+        def _natural_parameters(self):
+            s2 = self.scale ** 2
+            return (self.loc / s2, -0.5 / s2)
+
+        def _log_normalizer(self, e1, e2):
+            return -e1 ** 2 / (4 * e2) - 0.5 * jnp.log(-2.0 * e2)
+
+        @property
+        def _mean_carrier_measure(self):
+            return 0.5 * np.log(2 * np.pi)
+
+    for mu, s in [(0.0, 1.0), (2.0, 0.5)]:
+        got = float(NormalEF(mu, s).entropy().numpy())
+        want = 0.5 * np.log(2 * np.pi * np.e * s ** 2)
+        np.testing.assert_allclose(got, want, rtol=1e-5)

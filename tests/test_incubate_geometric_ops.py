@@ -1,0 +1,160 @@
+"""Round-5 incubate/geometric completion (ref: python/paddle/incubate/
+operators/, python/paddle/geometric/): send_uv, CSC neighbor sampling,
+graph reindexing, fused-softmax masks, identity_loss, LookAhead,
+ModelAverage."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric, incubate
+
+
+def test_send_uv_ops():
+    x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+    y = paddle.to_tensor(np.array([[10.0], [20.0], [30.0]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2]))
+    dst = paddle.to_tensor(np.array([1, 2, 0]))
+    np.testing.assert_allclose(
+        geometric.send_uv(x, y, src, dst, "add").numpy().ravel(),
+        [21, 32, 13])
+    np.testing.assert_allclose(
+        geometric.send_uv(x, y, src, dst, "mul").numpy().ravel(),
+        [20, 60, 30])
+    with pytest.raises(ValueError):
+        geometric.send_uv(x, y, src, dst, "pow")
+
+
+def _csc():
+    """Graph: 0<-{1,2}, 1<-{2}, 2<-{} as CSC (row=srcs, colptr per dst)."""
+    row = np.array([1, 2, 2], np.int64)
+    colptr = np.array([0, 2, 3, 3], np.int64)
+    return row, colptr
+
+
+def test_sample_neighbors_full_and_capped():
+    row, colptr = _csc()
+    nbrs, cnt = geometric.sample_neighbors(row, colptr,
+                                           np.array([0, 1, 2]), -1)
+    np.testing.assert_array_equal(cnt.numpy(), [2, 1, 0])
+    np.testing.assert_array_equal(np.sort(nbrs.numpy()[:2]), [1, 2])
+    np.random.seed(0)
+    nbrs, cnt = geometric.sample_neighbors(row, colptr, np.array([0]), 1)
+    assert cnt.numpy().tolist() == [1]
+    assert nbrs.numpy()[0] in (1, 2)
+
+
+def test_sample_neighbors_eids():
+    row, colptr = _csc()
+    nbrs, cnt, eids = geometric.sample_neighbors(
+        row, colptr, np.array([0, 1]), -1, eids=np.array([10, 11, 12]),
+        return_eids=True)
+    np.testing.assert_array_equal(eids.numpy(), [10, 11, 12])
+    with pytest.raises(ValueError):
+        geometric.sample_neighbors(row, colptr, np.array([0]),
+                                   return_eids=True)
+
+
+def test_reindex_graph():
+    x = np.array([10, 20], np.int64)
+    neighbors = np.array([30, 20, 40], np.int64)
+    count = np.array([2, 1], np.int64)
+    src, dst, nodes = geometric.reindex_graph(x, neighbors, count)
+    np.testing.assert_array_equal(nodes.numpy(), [10, 20, 30, 40])
+    np.testing.assert_array_equal(src.numpy(), [2, 1, 3])
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1])
+
+
+def test_reindex_heter_graph():
+    x = np.array([5, 6], np.int64)
+    src, dst, nodes = geometric.reindex_heter_graph(
+        x, [np.array([7], np.int64), np.array([6, 8], np.int64)],
+        [np.array([1, 0], np.int64), np.array([0, 2], np.int64)])
+    np.testing.assert_array_equal(nodes.numpy(), [5, 6, 7, 8])
+    np.testing.assert_array_equal(src.numpy(), [2, 1, 3])
+    np.testing.assert_array_equal(dst.numpy(), [0, 1, 1])
+
+
+def test_softmax_mask_fuse():
+    x = paddle.to_tensor(np.random.RandomState(0).randn(
+        2, 2, 4, 4).astype(np.float32))
+    mask = paddle.to_tensor(np.zeros((2, 1, 4, 4), np.float32))
+    out = incubate.softmax_mask_fuse(x, mask).numpy()
+    ref = np.exp(x.numpy()) / np.exp(x.numpy()).sum(-1, keepdims=True)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_softmax_mask_fuse_upper_triangle():
+    x = paddle.to_tensor(np.random.RandomState(1).randn(
+        1, 1, 4, 4).astype(np.float32))
+    out = incubate.softmax_mask_fuse_upper_triangle(x).numpy()[0, 0]
+    assert np.allclose(np.triu(out, 1), 0.0, atol=1e-7)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_identity_loss():
+    x = paddle.to_tensor(np.array([1.0, 3.0], np.float32))
+    assert incubate.identity_loss(x, 0).numpy() == 4.0      # sum
+    assert incubate.identity_loss(x, 1).numpy() == 2.0      # mean
+    np.testing.assert_array_equal(
+        incubate.identity_loss(x, "none").numpy(), [1.0, 3.0])
+    with pytest.raises(ValueError):
+        incubate.identity_loss(x, "prod")
+
+
+def test_graph_aliases_resolve():
+    row, colptr = _csc()
+    nbrs, cnt = incubate.graph_sample_neighbors(row, colptr, np.array([0]))
+    assert cnt.numpy().tolist() == [2]
+    src, dst, nodes = incubate.graph_reindex(
+        np.array([0], np.int64), nbrs, cnt)
+    assert len(nodes.numpy()) == 3
+    x = paddle.to_tensor(np.eye(3, dtype=np.float32))
+    out = incubate.graph_send_recv(x, np.array([0, 1]), np.array([2, 2]),
+                                   "sum")
+    np.testing.assert_allclose(out.numpy()[2], [1, 1, 0])
+    assert incubate.segment_sum is geometric.segment_sum
+
+
+def test_lookahead_slow_weights():
+    paddle.seed(0)
+    import paddle_tpu.nn as nn
+    net = nn.Linear(4, 4, bias_attr=False)
+    w0 = net.weight.numpy().copy()
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters())
+    opt = incubate.LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    for i in range(2):
+        loss = paddle.mean(net(x))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # after k=2 steps: fast took 2 sgd steps from w0, slow = w0 + 0.5*
+    # (fast - w0), and fast was reset to slow
+    g = np.ones((4, 4), np.float32) * (2 / 8.0)  # d(mean(x@W))/dW, x=1,b=2
+    fast = w0 - 0.1 * g * 2
+    np.testing.assert_allclose(net.weight.numpy(),
+                               w0 + 0.5 * (fast - w0), rtol=1e-5)
+    with pytest.raises(ValueError):
+        incubate.LookAhead(inner, alpha=2.0)
+
+
+def test_model_average_apply_restore():
+    import paddle_tpu.nn as nn
+    paddle.seed(1)
+    net = nn.Linear(3, 3, bias_attr=False)
+    ma = incubate.ModelAverage(0.15, parameters=net.parameters(),
+                               min_average_window=2, max_average_window=10)
+    snaps = []
+    for v in (1.0, 3.0):
+        net.weight.data = np.full((3, 3), v, np.float32)
+        snaps.append(v)
+        ma.step()
+    live = net.weight.numpy().copy()
+    with ma.apply():
+        np.testing.assert_allclose(net.weight.numpy(),
+                                   np.mean(snaps) * np.ones((3, 3)),
+                                   rtol=1e-6)
+    np.testing.assert_allclose(net.weight.numpy(), live)
+    with pytest.raises(ValueError):
+        incubate.ModelAverage(0.1)

@@ -160,29 +160,12 @@ def get_available_custom_device():
         return []
 
 
-class _VendorPlace:
-    """Vendor places exist for API compat; constructing one on a TPU
-    build fails loudly rather than silently mapping to the wrong device
-    (VERDICT r1 weak #7 convention)."""
-
-    _vendor = "vendor"
-
-    def __init__(self, dev_id=0):
-        raise RuntimeError(
-            f"{type(self).__name__} is not available in a TPU/XLA build; "
-            f"use paddle.TPUPlace()/CPUPlace()")
-
-
-class XPUPlace(_VendorPlace):
-    _vendor = "xpu"
-
-
-class IPUPlace(_VendorPlace):
-    _vendor = "ipu"
-
-
-class MLUPlace(_VendorPlace):
-    _vendor = "mlu"
+# Vendor places alias the accelerator place, matching the top-level
+# paddle.XPUPlace/MLUPlace/IPUPlace aliases (framework/place.py:68-72):
+# "the accelerator" on this build is the TPU, and a script that places on
+# its vendor device must get the same object from either import path.
+from ..framework.place import (XPUPlace, IPUPlace,  # noqa: E402,F401
+                               MLUPlace)
 
 
 class Stream:

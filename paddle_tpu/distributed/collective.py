@@ -106,12 +106,12 @@ def destroy_process_group(group=None):
     including the world group, when group is None)."""
     if group is None:
         _groups.clear()
-        _world_group.pop(0, None)
+        _world_group[0] = None
         _next_gid[0] = 0
         return
     _groups.pop(group.id, None)
     if group.id == 0:
-        _world_group.pop(0, None)
+        _world_group[0] = None
 
 
 def _axis_of(group):

@@ -13,12 +13,10 @@ from .parallel_env import get_rank
 
 def is_persistable(var):
     """ref: io.py:190 — parameters and buffers persist; activations do
-    not. For this framework's Tensors that is `persistable` when present,
-    else True for anything exposing trainable state."""
-    p = getattr(var, "persistable", None)
-    if p is not None:
-        return bool(p)
-    return hasattr(var, "stop_gradient")
+    not. Every framework Tensor carries `persistable` (Parameters and
+    registered buffers set it True); objects without the attribute are
+    not framework state and do not persist."""
+    return bool(getattr(var, "persistable", False))
 
 
 def save_persistables(executor, dirname, main_program=None, filename=None):

@@ -21,11 +21,17 @@ Supported rewrites (the core of the reference's 25+ transformers):
                                operands wrapped in lambdas: a callable
                                VALUE is never invoked by mistake)
 
-Ifs that cannot be converted (break/continue in a branch, mixed
-return/fall-through) are left as plain Python: concrete predicates work
-unchanged, traced predicates fail loudly with jax's concretization
-error. A `while` whose body contains break/continue/return raises
-Dy2StaticSyntaxError (the closure rewrite cannot represent them).
+Early returns (`if c: return x` + fall-through, guard chains, returns in
+nested ifs) are normalized first by `_absorb_returns` — the reference's
+ReturnTransformer analog — which moves the continuation into the
+falling-through branch at function-exit level, so they reach visit_If in
+the convertible tail-return shape. Ifs that still cannot be converted
+(break/continue in a branch; early returns inside LOOP bodies, whose
+fall-through does not exit the function) are left as plain Python:
+concrete predicates work unchanged, traced predicates fail loudly with
+jax's concretization error. A `while` whose body contains
+break/continue/return raises Dy2StaticSyntaxError (the closure rewrite
+cannot represent them).
 
 Known limits (documented, loud): closure cell contents are snapshotted
 at conversion time; decorating a function then rebinding its closure
@@ -215,6 +221,80 @@ def _branch_fn(name, stmts, ret_value, capture_defaults):
             defaults=[_name(c) for c in caps]),
         body=list(stmts) + ([ret_value] if ret_value is not None else []),
         decorator_list=[], returns=None)
+
+
+def _block_tail_returns(stmts):
+    """The block always exits the function at its tail: a direct Return,
+    or an If whose branches both terminate (after absorption such an If
+    converts to `return convert_ifelse(...)`)."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return (_block_tail_returns(last.body)
+                and _block_tail_returns(last.orelse))
+    return False
+
+
+def _absorb_returns(stmts):
+    """Early-return normalization (the reference's ReturnTransformer
+    analog, dy2static/transformers/return_transformer.py): at a
+    statement list whose fall-through exits the function, an `if` with a
+    return on one side absorbs the trailing statements into the side
+    that falls through, so every convertible `if` reaches visit_If in
+    the tail-return-both-sides shape:
+
+        if c:                 if c:
+            return a + 1  ->      return a + 1
+        return a - 1          else:
+                                  return a - 1
+
+    Only applied at function-exit level (recursively into absorbed
+    branches — which become exit-level once nothing follows the if);
+    loop bodies keep their fall-through semantics and are untouched."""
+    import copy as _copy
+    out = list(stmts)
+    i = 0
+    while i < len(out):
+        st = out[i]
+        if isinstance(st, ast.If) and not _contains(
+                [st], (ast.Break, ast.Continue), stop_at_loops=True):
+            has_ret = _contains(st.body, ast.Return) or (
+                bool(st.orelse) and _contains(st.orelse, ast.Return))
+            b_ret = _block_tail_returns(st.body)
+            o_ret = _block_tail_returns(st.orelse)
+            rest = out[i + 1:]
+            if has_ret and b_ret and o_ret:
+                # both sides terminate: nothing to absorb here, but inner
+                # guard chains still need normalizing — each branch is
+                # exit-level in its own right (r5 code review)
+                st.body = _absorb_returns(st.body)
+                st.orelse = _absorb_returns(st.orelse)
+                ast.fix_missing_locations(st)
+            elif has_ret:
+                if b_ret:
+                    st.orelse = (st.orelse or []) + rest
+                elif o_ret:
+                    st.body = st.body + rest
+                else:
+                    # returns only in nested constructs on either side:
+                    # both branches fall through into the continuation —
+                    # it must follow BOTH (one copy each)
+                    st.body = st.body + _copy.deepcopy(rest)
+                    st.orelse = (st.orelse or []) + rest
+                del out[i + 1:]
+                for attr in ("body", "orelse"):
+                    blk = getattr(st, attr)
+                    if not _block_tail_returns(blk):
+                        blk = (blk or []) + [ast.Return(
+                            value=ast.Constant(value=None))]
+                    setattr(st, attr, _absorb_returns(blk))
+                ast.fix_missing_locations(st)
+                return out
+        i += 1
+    return out
 
 
 class _ControlFlowTransformer(ast.NodeTransformer):
@@ -417,6 +497,7 @@ def _transform_code(fn):
         _code_cache[key] = None
         return None
 
+    fdef.body = _absorb_returns(fdef.body)
     _ControlFlowTransformer(root=fdef).visit(tree)
 
     freevars = fn.__code__.co_freevars

@@ -352,9 +352,8 @@ class ExponentialMovingAverage:
 
 # --- serialization ----------------------------------------------------------
 
-def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
-    """ref: static/io.py serialize_program — the deployable program as
-    bytes (here: the .pdmodel StableHLO artifact payload)."""
+def _serialize_artifacts(feed_vars, fetch_vars, program=None, **kwargs):
+    """One export, both payloads: (pdmodel_bytes, pdiparams_bytes)."""
     import os
     import tempfile
     from . import save_inference_model
@@ -363,21 +362,28 @@ def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
         save_inference_model(prefix, feed_vars, fetch_vars,
                              program=program, **kwargs)
         with open(prefix + ".pdmodel", "rb") as f:
-            return f.read()
+            prog_b = f.read()
+        with open(prefix + ".pdiparams", "rb") as f:
+            params_b = f.read()
+    return prog_b, params_b
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """ref: static/io.py serialize_program — the deployable program as
+    bytes (here: the .pdmodel StableHLO artifact payload). Needing BOTH
+    payloads? `_serialize_artifacts` (or save_inference_model directly)
+    exports once; calling this and serialize_persistables separately
+    traces the program twice."""
+    return _serialize_artifacts(feed_vars, fetch_vars, program,
+                                **kwargs)[0]
 
 
 def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
     """ref: static/io.py serialize_persistables — the parameter payload
-    bytes (.pdiparams)."""
-    import os
-    import tempfile
-    from . import save_inference_model
-    with tempfile.TemporaryDirectory() as td:
-        prefix = os.path.join(td, "prog")
-        save_inference_model(prefix, feed_vars, fetch_vars,
-                             program=program, **kwargs)
-        with open(prefix + ".pdiparams", "rb") as f:
-            return f.read()
+    bytes (.pdiparams). See serialize_program on avoiding a double
+    export."""
+    return _serialize_artifacts(feed_vars, fetch_vars, program,
+                                **kwargs)[1]
 
 
 def save_to_file(path, content):
@@ -418,9 +424,30 @@ def deserialize_program(data):
 
 
 def deserialize_persistables(program, data, executor=None):
-    """ref: static/io.py deserialize_persistables — combined with
-    deserialize_program via the (program, params) tuple form."""
-    return deserialize_program((program, data))
+    """ref: static/io.py deserialize_persistables — load serialized
+    parameter bytes. `program` may be the serialized program BYTES
+    (returns a runnable ExportedProgram) or a recorded static Program
+    (its leaf tensors are filled in place from the npz payload)."""
+    if isinstance(program, (bytes, bytearray)):
+        return deserialize_program((program, data))
+    from .program import Program
+    if isinstance(program, Program):
+        import io as _io
+        npz = np.load(_io.BytesIO(data))
+        state = {}
+        for k in npz.files:
+            a = npz[k]
+            if "__dt_" in k:
+                import ml_dtypes
+                dt = np.dtype(getattr(ml_dtypes, k.split("__dt_")[1]))
+                a = a.view(dt)
+                k = k.split("__dt_")[0]
+            state[k] = a
+        set_program_state(program, state)
+        return program
+    raise TypeError(
+        "deserialize_persistables takes the serialized program bytes or a "
+        f"recorded static Program, got {type(program).__name__}")
 
 
 def normalize_program(program, feed_vars, fetch_vars, **kwargs):

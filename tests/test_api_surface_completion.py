@@ -198,8 +198,9 @@ def test_device_predicates_and_streams():
     assert device.get_cudnn_version() is None
     assert not device.is_compiled_with_rocm()
     assert not device.is_compiled_with_xpu()
-    with pytest.raises(RuntimeError):
-        device.XPUPlace(0)
+    # vendor places alias the accelerator place from EITHER import path
+    assert device.XPUPlace is paddle.XPUPlace
+    assert device.MLUPlace is paddle.MLUPlace
     s = device.current_stream()
     e = s.record_event()
     assert e.query()
@@ -422,3 +423,48 @@ def test_distributed_split_column_parallel():
     assert tuple(out.shape) == (2, 4)
     with pytest.raises(ValueError):
         dist.split(x, (8, 4), "conv")
+
+
+def test_destroy_process_group_and_reinit():
+    """r5 review regression: destroy_process_group crashed on the
+    world-group list; after destroy, collectives must re-bootstrap."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.collective import _ensure_world_group
+    g = _ensure_world_group()
+    assert g.id == 0
+    dist.destroy_process_group()
+    g2 = _ensure_world_group()  # fresh world group reconstructs
+    assert g2.id == 0 and g2 is not g
+    sub = dist.new_group([0])
+    dist.destroy_process_group(sub)
+    assert dist.get_group(sub.id) is None
+
+
+def test_deserialize_persistables_into_program_bytes():
+    import paddle_tpu.nn as nn
+    from paddle_tpu import static
+    paddle.seed(9)
+    net = nn.Linear(4, 2)
+    net.eval()
+    spec = static.InputSpec([1, 4], "float32")
+    pb, qb = (static.serialize_program([spec], None, program=net),
+              static.serialize_persistables([spec], None, program=net))
+    prog = static.deserialize_persistables(pb, qb)
+    out = prog(np.ones((1, 4), np.float32))
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    np.testing.assert_allclose(np.asarray(out),
+                               net(paddle.to_tensor(
+                                   np.ones((1, 4), np.float32))).numpy(),
+                               rtol=1e-5)
+    with pytest.raises(TypeError):
+        static.deserialize_persistables(3.14, qb)
+
+
+def test_is_persistable_distinguishes_params():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.io import is_persistable
+    net = nn.Linear(2, 2)
+    assert is_persistable(net.weight)
+    act = net(paddle.ones([1, 2]))
+    assert not is_persistable(act)
+    assert not is_persistable(object())

@@ -441,3 +441,89 @@ def test_concrete_while_inside_to_static_trace():
         return acc
 
     assert int(count(4)) == 9
+
+
+# --- early-return normalization (r5: _absorb_returns, the reference's
+# ReturnTransformer analog) --------------------------------------------------
+
+def _early_return(a):
+    if paddle.mean(a) > 0:
+        return a + 1
+    return a - 1
+
+
+def _guard_chain(a):
+    if paddle.mean(a) > 2:
+        return a * 10
+    b = a + 1
+    if paddle.mean(b) > 1:
+        return b
+    return -b
+
+
+def _nested_mixed(a):
+    if paddle.mean(a) > 0:
+        out = a * 2
+    else:
+        if paddle.max(a) > -1:
+            return a
+        out = a * -1
+    return out
+
+
+def test_early_return_if_converts():
+    f = paddle.jit.to_static(_early_return)
+    for v, want in ((1.0, 2.0), (-1.0, -2.0)):
+        x = paddle.full([2], v)
+        np.testing.assert_allclose(f(x).numpy(), np.full(2, want, np.float32))
+        np.testing.assert_allclose(_early_return(x).numpy(),
+                                   np.full(2, want, np.float32))
+
+
+def test_early_return_guard_chain():
+    g = paddle.jit.to_static(_guard_chain)
+    for v, want in ((3.0, 30.0), (0.5, 1.5), (-2.0, 1.0)):
+        np.testing.assert_allclose(g(paddle.full([2], v)).numpy(),
+                                   np.full(2, want, np.float32))
+
+
+def test_early_return_nested_mixed():
+    h = paddle.jit.to_static(_nested_mixed)
+    for v, want in ((1.0, 2.0), (-0.5, -0.5), (-3.0, 3.0)):
+        np.testing.assert_allclose(h(paddle.full([2], v)).numpy(),
+                                   np.full(2, want, np.float32))
+
+
+def test_early_return_inside_loop_body_untouched():
+    """Absorption applies only at function-exit level: a fall-through
+    `if` inside a for body keeps loop semantics."""
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(n):
+        total = 0
+        for i in range(n):
+            if i == 1:
+                total = total + 10
+            total = total + 1
+        return total
+
+    assert int(f(3)) == 13
+
+
+def _nested_guard_in_terminating_if(a):
+    # r5 review regression: both outer branches terminate, inner guard
+    # chain still needs absorption
+    if paddle.mean(a) > 0:
+        if paddle.max(a) > 2:
+            return a * 10
+        return a + 1
+    else:
+        return a - 1
+
+
+def test_guard_chain_inside_terminating_if():
+    f = paddle.jit.to_static(_nested_guard_in_terminating_if)
+    for v, want in ((3.0, 30.0), (1.0, 2.0), (-1.0, -2.0)):
+        np.testing.assert_allclose(f(paddle.full([2], v)).numpy(),
+                                   np.full(2, want, np.float32))

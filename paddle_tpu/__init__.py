@@ -160,3 +160,54 @@ def get_flags(flags):
 def set_flags(flags):
     from .framework import flags as _flags
     return _flags.set_flags(flags)
+
+
+def _bind_tensor_methods():
+    """Bind the reference's Tensor-method surface (ref: python/paddle/
+    tensor/__init__.py tensor_method_func): every listed API is callable
+    both as paddle.foo(x, ...) and x.foo(...). The per-module _inject
+    binders cover the core; this manifest closes the tail. All bound
+    functions take the tensor as their first argument, so the free
+    function IS the method. (create_parameter / create_tensor /
+    broadcast_shape are not tensor-first and stay functions-only.)"""
+    from .tensor.tensor import Tensor
+
+    manifest = [
+        "cov", "corrcoef", "norm", "cond", "lstsq", "dist", "t", "cross",
+        "cholesky", "histogram", "bincount", "mv", "matrix_power", "qr",
+        "eigvals", "eigvalsh", "acos", "asin", "atan", "ceil_", "cosh",
+        "logcumsumexp", "logit", "exp_", "floor_", "increment",
+        "multiplex", "reciprocal_", "round_", "rsqrt_", "sinh", "sqrt_",
+        "stanh", "nan_to_num", "nansum", "nanmean", "count_nonzero",
+        "tanh_", "add_n", "amax", "amin", "fmax", "fmin", "floor_divide",
+        "remainder", "remainder_", "floor_mod", "inverse", "addmm",
+        "kron", "kthvalue", "lgamma", "is_empty", "is_tensor", "concat",
+        "flatten_", "reverse", "scatter_", "scatter_nd_add", "scatter_nd",
+        "shard_index", "slice", "vsplit", "tensordot", "squeeze_",
+        "stack", "strided_slice", "unique_consecutive", "unstack",
+        "rot90", "argmax", "argmin", "argsort", "topk", "where", "sort",
+        "index_sample", "nanmedian", "nanquantile", "is_complex",
+        "is_integer", "rank", "is_floating_point", "digamma", "diagonal",
+        "frac", "broadcast_tensors", "eig", "multi_dot", "solve",
+        "cholesky_solve", "triangular_solve", "asinh", "atanh", "acosh",
+        "lu", "lu_unpack", "as_complex", "as_real", "rad2deg", "deg2rad",
+        "gcd", "lcm", "diff", "mode", "lerp_", "erfinv", "erfinv_",
+        "angle", "put_along_axis_", "exponential_", "heaviside",
+        "index_add", "index_add_", "take", "bucketize", "sgn", "frexp",
+    ]
+    from . import linalg as _linalg
+    from .tensor import math as _tm, manipulation as _tmp, random as _trnd
+
+    namespaces = (globals(), vars(_linalg), vars(_tm), vars(_tmp),
+                  vars(_trnd))
+    for nm in manifest:
+        if hasattr(Tensor, nm):
+            continue
+        for ns in namespaces:
+            fn = ns.get(nm)
+            if callable(fn):
+                setattr(Tensor, nm, fn)
+                break
+
+
+_bind_tensor_methods()

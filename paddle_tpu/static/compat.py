@@ -425,29 +425,19 @@ def deserialize_program(data):
 
 def deserialize_persistables(program, data, executor=None):
     """ref: static/io.py deserialize_persistables — load serialized
-    parameter bytes. `program` may be the serialized program BYTES
-    (returns a runnable ExportedProgram) or a recorded static Program
-    (its leaf tensors are filled in place from the npz payload)."""
+    parameter bytes. `program` is the serialized program BYTES
+    (serialize_program's output): the .pdiparams payload stores
+    parameters POSITIONALLY against that exported program, so it cannot
+    be rebound to a recorded static Program by name — for name-keyed
+    Program state use static.load / load_program_state +
+    set_program_state (.pdparams artifacts)."""
     if isinstance(program, (bytes, bytearray)):
         return deserialize_program((program, data))
-    from .program import Program
-    if isinstance(program, Program):
-        import io as _io
-        npz = np.load(_io.BytesIO(data))
-        state = {}
-        for k in npz.files:
-            a = npz[k]
-            if "__dt_" in k:
-                import ml_dtypes
-                dt = np.dtype(getattr(ml_dtypes, k.split("__dt_")[1]))
-                a = a.view(dt)
-                k = k.split("__dt_")[0]
-            state[k] = a
-        set_program_state(program, state)
-        return program
     raise TypeError(
-        "deserialize_persistables takes the serialized program bytes or a "
-        f"recorded static Program, got {type(program).__name__}")
+        "deserialize_persistables takes the serialized program bytes "
+        f"(serialize_program output), got {type(program).__name__}; "
+        "name-keyed Program state loads via static.load / "
+        "load_program_state + set_program_state")
 
 
 def normalize_program(program, feed_vars, fetch_vars, **kwargs):

@@ -527,3 +527,18 @@ def _inject():
 
 
 _inject()
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    """In-place flatten (ref: inplace variant flatten_)."""
+    out = flatten(x, start_axis, stop_axis)
+    x.data, x._node, x.stop_gradient = out.data, out._node, out.stop_gradient
+    return x
+
+
+def put_along_axis_(arr, indices, values, axis, reduce="assign", name=None):
+    """In-place put_along_axis (ref: inplace variant put_along_axis_)."""
+    out = put_along_axis(arr, indices, values, axis, reduce)
+    arr.data, arr._node, arr.stop_gradient = (out.data, out._node,
+                                              out.stop_gradient)
+    return arr

@@ -441,6 +441,33 @@ def tanh_(x, name=None):
     return x
 
 
+def _inplace(base, opname):
+    """In-place variant: run the out-of-place op, rebind the input's
+    storage/grad-node (the established tanh_/scatter_ pattern)."""
+
+    def op(x, *args, **kwargs):
+        out = base(x, *args, **kwargs)
+        x.data, x._node, x.stop_gradient = (out.data, out._node,
+                                            out.stop_gradient)
+        return x
+
+    op.__name__ = opname
+    op.__doc__ = f"In-place {base.__name__} (ref: inplace variant {opname})."
+    return op
+
+
+ceil_ = _inplace(ceil, "ceil_")
+exp_ = _inplace(exp, "exp_")
+floor_ = _inplace(floor, "floor_")
+reciprocal_ = _inplace(reciprocal, "reciprocal_")
+round_ = _inplace(round, "round_")
+rsqrt_ = _inplace(rsqrt, "rsqrt_")
+sqrt_ = _inplace(sqrt, "sqrt_")
+remainder_ = _inplace(remainder, "remainder_")
+lerp_ = _inplace(lerp, "lerp_")
+erfinv_ = _inplace(erfinv, "erfinv_")
+
+
 def broadcast_shape(x_shape, y_shape):
     """ref: tensor/math.py broadcast_shape — pure shape math."""
     import numpy as _np

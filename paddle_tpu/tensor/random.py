@@ -96,6 +96,9 @@ def poisson(x, name=None):
 
 
 def exponential_(x, lam=1.0, name=None):
+    """In-place exponential fill (ref: inplace variant exponential_ —
+    x ~ Exponential(lam), replacing x's values; gradient state is left
+    untouched, matching the in-place convention)."""
     x.data = jax.random.exponential(rnd.next_key(), x.data.shape,
                                     x.data.dtype) / lam
     return x

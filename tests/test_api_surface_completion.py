@@ -458,6 +458,10 @@ def test_deserialize_persistables_into_program_bytes():
                                rtol=1e-5)
     with pytest.raises(TypeError):
         static.deserialize_persistables(3.14, qb)
+    # a recorded Program cannot consume positional .pdiparams bytes —
+    # loud error, not a silent no-op load (r5 review)
+    with pytest.raises(TypeError):
+        static.deserialize_persistables(static.Program(), qb)
 
 
 def test_is_persistable_distinguishes_params():
@@ -468,3 +472,42 @@ def test_is_persistable_distinguishes_params():
     act = net(paddle.ones([1, 2]))
     assert not is_persistable(act)
     assert not is_persistable(object())
+
+
+def test_tensor_method_surface_resolves():
+    """Every name in the reference's tensor_method_func manifest is
+    callable as a Tensor METHOD (ref: python/paddle/tensor/__init__.py
+    tensor_method_func + magic_method_func patching)."""
+    import ast
+    path = f"{REF}/tensor/__init__.py"
+    if not os.path.exists(path):
+        pytest.skip("reference not present")
+    tree = ast.parse(open(path).read())
+    methods = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "tensor_method_func":
+                    methods = [ast.literal_eval(e) for e in node.value.elts]
+    assert methods
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    # not tensor-first in the reference either; functions-only here
+    skip = {"create_parameter", "create_tensor", "broadcast_shape"}
+    missing = [m for m in methods if m not in skip and not hasattr(x, m)]
+    assert not missing, f"Tensor methods missing: {missing}"
+    for m in ("__and__", "__or__", "__xor__", "__invert__"):
+        assert hasattr(type(x), m)
+
+
+def test_inplace_variants_mutate_in_place():
+    a = paddle.to_tensor(np.array([4.0, 16.0], np.float32))
+    r = a.sqrt_()
+    assert r is a
+    np.testing.assert_allclose(a.numpy(), [2.0, 4.0])
+    b = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    b.flatten_()
+    assert tuple(b.shape) == (4,)
+    c = paddle.zeros([64])
+    out = c.exponential_(1.5)
+    assert out is c and float(c.numpy().min()) >= 0.0
+    assert c.numpy().std() > 0.0

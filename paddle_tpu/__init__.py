@@ -6,7 +6,15 @@ jax/XLA is the compiler+executor, Pallas provides hand-tuned kernels,
 jax.sharding meshes provide the distributed fabric. The public API mirrors
 `paddle.*` so reference users can switch with minimal changes.
 """
-__version__ = "0.1.0"
+from .version import full_version as __version__
+
+
+def __getattr__(name):
+    if name == "__git_commit__":  # lazy: resolving it spawns git once
+        from .version import commit
+        return commit
+    raise AttributeError(name)
+
 
 import jax as _jax
 
@@ -125,6 +133,10 @@ from . import audio
 from . import geometric
 from . import quantization
 from . import onnx
+from . import utils
+from . import version
+from . import sysconfig
+from . import hub
 from . import inference
 
 # paddle.Model (hapi)

@@ -1,0 +1,103 @@
+"""paddle.audio.datasets (ref: python/paddle/audio/datasets/{tess,
+esc50}.py). The image has no network egress, so these read an
+ALREADY-DOWNLOADED archive directory instead of fetching — pass its
+path; a missing path raises loudly (descope ledger: BASELINE.md)."""
+import numpy as np
+
+from ..tensor.tensor import Tensor
+from . import features as _features
+
+__all__ = ["TESS", "ESC50"]
+
+
+class _FolderWavDataset:
+    _GLOB = "**/*.wav"
+
+    def __init__(self, root, mode="train", split_ratio=0.8,
+                 sample_rate=None, feat_type="raw", **feat_kw):
+        import glob as _glob
+        import os as _os
+        if root is None or not _os.path.isdir(root):
+            raise RuntimeError(
+                f"{type(self).__name__}: dataset root {root!r} not "
+                "found. This environment has no network egress — "
+                "download the archive elsewhere and pass "
+                "root=<extracted dir> (see BASELINE.md descope "
+                "ledger).")
+        files = sorted(_glob.glob(_os.path.join(root, self._GLOB),
+                                  recursive=True))
+        if not files:
+            raise RuntimeError(f"no .wav files under {root!r}")
+        cut = int(len(files) * split_ratio)
+        self.files = files[:cut] if mode == "train" else files[cut:]
+        self.feat_type = feat_type
+        self.feat_kw = feat_kw
+
+    def _label(self, path):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, idx):
+        import wave
+        path = self.files[idx]
+        with wave.open(path, "rb") as f:
+            if f.getsampwidth() != 2 or f.getnchannels() != 1:
+                raise RuntimeError(
+                    f"{path}: only 16-bit mono PCM wav is supported "
+                    f"(got sampwidth={f.getsampwidth()}, "
+                    f"channels={f.getnchannels()}); re-encode the "
+                    "archive (descope ledger: BASELINE.md, no "
+                    "soundfile wheel in the image)")
+            n = f.getnframes()
+            raw = np.frombuffer(f.readframes(n), dtype=np.int16)
+            sr = f.getframerate()
+        x = (raw.astype(np.float32) / 32768.0)
+        if self.feat_type == "raw":
+            feat = x
+        else:
+            feat = np.asarray(
+                self._extractor(sr)(Tensor(x[None])).data)[0]
+        return feat, self._label(path)
+
+    def _extractor(self, sr):
+        """Per-sample-rate cache: the mel filterbank / DCT basis are
+        built once, not per __getitem__ (code-review r5)."""
+        cache = getattr(self, "_extractors", None)
+        if cache is None:
+            cache = self._extractors = {}
+        key = (self.feat_type, sr)
+        if key not in cache:
+            if self.feat_type == "mfcc":
+                cache[key] = _features.MFCC(sr=sr, **self.feat_kw)
+            elif self.feat_type == "melspectrogram":
+                cache[key] = _features.MelSpectrogram(sr=sr,
+                                                      **self.feat_kw)
+            else:
+                raise ValueError(f"feat_type {self.feat_type!r}")
+        return cache[key]
+
+
+class TESS(_FolderWavDataset):
+    """Toronto emotional speech set: label = emotion token in the
+    file name (ref: datasets/tess.py)."""
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral",
+                "ps", "sad"]
+
+    def _label(self, path):
+        import os as _os
+        name = _os.path.basename(path).lower()
+        stem = name.rsplit(".", 1)[0]
+        emo = stem.split("_")[-1]
+        return np.int64(self.EMOTIONS.index(emo))
+
+
+class ESC50(_FolderWavDataset):
+    """ESC-50: label = target field of the canonical file name
+    {fold}-{id}-{take}-{target}.wav (ref: datasets/esc50.py)."""
+
+    def _label(self, path):
+        import os as _os
+        stem = _os.path.basename(path).rsplit(".", 1)[0]
+        return np.int64(int(stem.split("-")[-1]))

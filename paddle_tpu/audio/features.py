@@ -1,0 +1,64 @@
+"""paddle.audio.features (ref: python/paddle/audio/features/layers.py) —
+Spectrogram / MelSpectrogram / LogMelSpectrogram / MFCC extractors over
+the fft/signal stack."""
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from .. import signal as _signal
+from .functional import compute_fbank_matrix, create_dct
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram:
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True,
+                 pad_mode="reflect", dtype="float32"):
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.power = power
+
+    def __call__(self, x):
+        spec = _signal.stft(x, self.n_fft, self.hop_length)
+        return Tensor(jnp.abs(spec.data) ** self.power)
+
+
+class MelSpectrogram:
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, n_mels=64,
+                 f_min=50.0, f_max=None, **kw):
+        self.spect = Spectrogram(n_fft, hop_length)
+        self.fbank = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max)
+
+    def __call__(self, x):
+        s = self.spect(x)
+        return Tensor(jnp.einsum("mf,...ft->...mt", self.fbank.data,
+                                 s.data))
+
+
+class LogMelSpectrogram(MelSpectrogram):
+    def __call__(self, x):
+        m = super().__call__(x)
+        return Tensor(10.0 * jnp.log10(jnp.maximum(m.data, 1e-10)))
+
+
+class MFCC:
+    """Mel-frequency cepstral coefficients: DCT-II over the log-mel
+    bands (ref: python/paddle/audio/features/layers.py:310 MFCC —
+    log-mel -> create_dct projection)."""
+
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 n_mels=64, f_min=50.0, f_max=None, top_db=80.0, **kw):
+        if n_mfcc > n_mels:
+            raise ValueError(
+                f"n_mfcc ({n_mfcc}) must be <= n_mels ({n_mels})")
+        self.logmel = LogMelSpectrogram(
+            sr, n_fft, hop_length, n_mels, f_min, f_max)
+        self.dct_matrix = create_dct(n_mfcc, n_mels)
+        self.top_db = top_db
+
+    def __call__(self, x):
+        lm = self.logmel(x).data          # [..., n_mels, t]
+        if self.top_db is not None:
+            lm = jnp.maximum(lm, lm.max() - self.top_db)
+        return Tensor(jnp.einsum("cm,...mt->...ct",
+                                 self.dct_matrix.data, lm))

@@ -1,0 +1,111 @@
+"""paddle.audio.functional (ref: python/paddle/audio/functional/) —
+mel-scale math, filterbanks, DCT basis, dB conversion, windows."""
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "create_dct", "power_to_db",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk=False):
+    if htk:
+        return 2595.0 * np.log10(1.0 + np.asarray(freq) / 700.0)
+    f = np.asarray(freq, dtype=np.float64)
+    mel = 3.0 * f / 200.0
+    min_log_hz = 1000.0
+    min_log_mel = 15.0
+    logstep = np.log(6.4) / 27.0
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(f / min_log_hz) / logstep, mel)
+
+
+def mel_to_hz(mel, htk=False):
+    if htk:
+        return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+    m = np.asarray(mel, dtype=np.float64)
+    f = 200.0 * m / 3.0
+    min_log_mel = 15.0
+    logstep = np.log(6.4) / 27.0
+    return np.where(m >= min_log_mel,
+                    1000.0 * np.exp(logstep * (m - min_log_mel)), f)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """ref: functional.py mel_frequencies."""
+    pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return Tensor(mel_to_hz(pts, htk).astype(dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """ref: functional.py fft_frequencies."""
+    return Tensor(np.linspace(0, sr / 2, n_fft // 2 + 1).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    f_max = f_max or sr / 2.0
+    n_freqs = n_fft // 2 + 1
+    freqs = np.linspace(0, sr / 2, n_freqs)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    fb = np.zeros((n_mels, n_freqs))
+    for i in range(n_mels):
+        lo, ctr, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+        up = (freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - freqs) / max(hi - ctr, 1e-10)
+        fb[i] = np.maximum(0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fb *= enorm[:, None]
+    return Tensor(fb.astype(dtype))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mfcc, n_mels] DCT-II basis (ref: functional.py create_dct)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(dct.astype(dtype).T)  # [n_mfcc, n_mels]
+
+
+def power_to_db(magnitude, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """10*log10(x/ref) with floor + dynamic-range clamp (ref:
+    functional.py power_to_db)."""
+    x = magnitude.data if isinstance(magnitude, Tensor) else jnp.asarray(
+        magnitude)
+    db = 10.0 * jnp.log10(jnp.maximum(x, amin))
+    db -= 10.0 * jnp.log10(jnp.maximum(jnp.asarray(ref_value), amin))
+    if top_db is not None:
+        db = jnp.maximum(db, db.max() - top_db)
+    return Tensor(db)
+
+
+def get_window(window, win_length, fftbins=True):
+    """Hann/Hamming/Blackman/rect windows (ref: functional/window.py)."""
+    n = win_length
+    i = np.arange(n, dtype=np.float64)
+    denom = n if fftbins else max(n - 1, 1)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * i / denom)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * i / denom)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * i / denom)
+             + 0.08 * np.cos(4 * np.pi * i / denom))
+    elif window in ("rect", "rectangular", "boxcar"):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(w.astype(np.float32))

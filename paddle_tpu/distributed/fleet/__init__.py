@@ -15,3 +15,11 @@ from .recompute import recompute, recompute_sequential
 from .scaler import distributed_scaler
 
 from .dataset import DatasetBase, InMemoryDataset, QueueDataset  # noqa: F401,E501
+from ..topology import (CommunicateTopology,  # noqa: F401
+                        HybridCommunicateGroup)
+from .util import UtilBase  # noqa: F401
+from .data_generator import (DataGenerator,  # noqa: F401
+                             MultiSlotDataGenerator,
+                             MultiSlotStringDataGenerator)
+from ..ps.the_one_ps import (Role, PaddleCloudRoleMaker,  # noqa: F401
+                             UserDefinedRoleMaker)

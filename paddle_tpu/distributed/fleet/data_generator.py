@@ -1,0 +1,98 @@
+"""Data generators for the multi-slot text protocol (ref: python/paddle/
+distributed/fleet/data_generator/data_generator.py) — user subclasses
+override generate_sample(); the runner turns each yielded
+[(slot, [values...]), ...] sample into the `<count> <v...>` line format
+InMemoryDataset/QueueDataset (fleet/dataset.py) parse."""
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self.batch_size_ = 1
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = int(batch_size)
+
+    # -- user overrides ------------------------------------------------------
+    def generate_sample(self, line):
+        """Return a generator yielding ONE parsed sample per input line:
+        [(slot_name, [value, ...]), ...]."""
+        raise NotImplementedError(
+            "subclasses must implement generate_sample(line)")
+
+    def generate_batch(self, samples):
+        """Optional batch-level hook (ref: same); default passthrough."""
+
+        def local_iter():
+            for s in samples:
+                yield s
+
+        return local_iter
+
+    # -- formatting (subclass-specific) --------------------------------------
+    def _gen_str(self, line):
+        raise NotImplementedError
+
+    # -- runners -------------------------------------------------------------
+    def run_from_stdin(self):
+        """ref: run_from_stdin — stream stdin lines through
+        generate_sample and print protocol lines (the pipe_command
+        contract)."""
+        self._run(sys.stdin, sys.stdout)
+
+    def run_from_files(self, files, output):
+        """Convenience runner over file paths into an output stream or
+        path (the TPU build's test-friendly entry)."""
+        close = False
+        if isinstance(output, str):
+            output = open(output, "w")
+            close = True
+        try:
+            for path in files:
+                with open(path) as f:
+                    self._run(f, output)
+        finally:
+            if close:
+                output.close()
+
+    def _run(self, lines_in, out):
+        batch = []
+        for line in lines_in:
+            for sample in self.generate_sample(line)():
+                if sample is None:
+                    continue
+                batch.append(sample)
+                if len(batch) >= self.batch_size_:
+                    self._flush(batch, out)
+                    batch = []
+        if batch:
+            self._flush(batch, out)
+
+    def _flush(self, batch, out):
+        for sample in self.generate_batch(batch)():
+            out.write(self._gen_str(sample))
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """ref: :285 — numeric feasigns: `<count> <v1> ... <vN>` per slot."""
+
+    def _gen_str(self, line):
+        if isinstance(line, zip):
+            line = list(line)
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                f"generate_sample must yield [(name, values), ...], got "
+                f"{type(line).__name__}")
+        parts = []
+        for _name, values in line:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """ref: :240 — values arrive pre-stringified; the protocol framing
+    (and validation) is the numeric generator's str() passthrough."""

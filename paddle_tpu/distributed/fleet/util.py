@@ -1,0 +1,66 @@
+"""fleet.UtilBase (ref: python/paddle/distributed/fleet/base/
+util_factory.py:47) — cross-worker utility verbs over the collective
+tier; exposed as `fleet.util` after fleet.init (fleet_base wires it)."""
+import numpy as np
+
+
+class UtilBase:
+    def __init__(self):
+        self.role_maker = None
+        self.dist_strategy = None
+
+    def _set_strategy(self, dist_strategy):
+        self.dist_strategy = dist_strategy
+
+    def _set_role_maker(self, role_maker):
+        self.role_maker = role_maker
+
+    def _set_file_system(self, fs_client):
+        raise NotImplementedError(
+            "hadoop/afs file-system clients are descoped in the TPU build "
+            "(BASELINE.md descope ledger); use local paths")
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        """ref: util_factory.py all_reduce — numpy in, numpy out."""
+        from .. import collective as C
+        from ...tensor.tensor import Tensor
+        arr = np.asarray(input)
+        t = Tensor(arr)
+        op = {"sum": C.ReduceOp.SUM, "min": C.ReduceOp.MIN,
+              "max": C.ReduceOp.MAX}.get(mode)
+        if op is None:
+            raise ValueError(f"mode must be sum/min/max, got {mode!r}")
+        C.all_reduce(t, op=op)
+        return np.asarray(t.numpy())
+
+    def barrier(self, comm_world="worker"):
+        from .. import collective as C
+        C.barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        """ref: all_gather — python object gather over the store
+        transport."""
+        from .. import collective as C
+        from ..parallel_env import get_world_size
+        out = [None] * get_world_size()
+        C.all_gather_object(out, input)
+        return out
+
+    def get_file_shard(self, files):
+        """ref: get_file_shard — split a filelist evenly over workers
+        (remainder spread over the leading workers)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file need to be read.")
+        rm = self.role_maker
+        trainer_id = rm.worker_index() if rm else 0
+        trainers = rm.worker_num() if rm else 1
+        base, extra = divmod(len(files), trainers)
+        begin = trainer_id * base + min(trainer_id, extra)
+        count = base + (1 if trainer_id < extra else 0)
+        return files[begin:begin + count]
+
+    def print_on_rank(self, message, rank_id):
+        rm = self.role_maker
+        me = rm.worker_index() if rm else 0
+        if me == rank_id:
+            print(message)

@@ -1,0 +1,68 @@
+"""paddle.distributed.passes (ref: python/paddle/distributed/passes/
+pass_base.py) — new_pass/PassManager/PassContext over the SAME registry
+as static.passes: the distributed program passes (DP grad sync, ZeRO
+sharding, gradient merge, optimizer-state offload) registered in
+static/distributed_passes.py are addressable through either namespace."""
+from ...static.passes import _PASSES, PassBase, register_pass  # noqa: F401
+from ...static import distributed_passes as _dp  # noqa: F401  (registers)
+
+__all__ = ["new_pass", "PassManager", "PassContext", "PassBase",
+           "register_pass"]
+
+
+def new_pass(name, pass_attrs=None):
+    """ref: pass_base.py:133 new_pass — attrs are CONSTRUCTOR kwargs
+    (r5 review: post-construction setattr silently missed attrs the
+    constructor maps to other field names, e.g. gradient_merge k_steps)."""
+    cls = _PASSES.get(name)
+    if cls is None:
+        raise ValueError(
+            f"Pass {name!r} is not registered; available: "
+            f"{sorted(_PASSES)}")
+    return cls(**(pass_attrs or {}))
+
+
+class PassContext:
+    """ref: pass_base.py PassContext — attrs shared across a manager's
+    passes."""
+
+    def __init__(self):
+        self._attrs = {}
+
+    def set_attr(self, k, v):
+        self._attrs[k] = v
+
+    def get_attr(self, k, default=None):
+        return self._attrs.get(k, default)
+
+
+class PassManager:
+    """ref: pass_base.py PassManager — apply a pass list in order."""
+
+    def __init__(self, passes=None, context=None, auto_solve_conflict=True):
+        self._passes = list(passes or [])
+        self._context = context or PassContext()
+
+    @property
+    def context(self):
+        return self._context
+
+    @property
+    def names(self):
+        return [getattr(p, "name", type(p).__name__) for p in self._passes]
+
+    def append(self, p):
+        self._passes.append(p)
+
+    def apply(self, main_programs, startup_programs=None):
+        """Apply every pass to every program; returns the programs (the
+        recorded-Program passes rewrite in place and return the
+        program)."""
+        progs = (main_programs if isinstance(main_programs, (list, tuple))
+                 else [main_programs])
+        outs = []
+        for prog in progs:
+            for p in self._passes:
+                prog = p.apply(prog) or prog
+            outs.append(prog)
+        return outs if isinstance(main_programs, (list, tuple)) else outs[0]

@@ -60,6 +60,26 @@ class PaddleCloudRoleMaker:
         return list(self._server_endpoints)
 
 
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """ref: fleet/base/role_maker.py:1183 — role/endpoints from kwargs
+    instead of environment variables."""
+
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._server_endpoints = list(kwargs.get("server_endpoints") or [])
+        worker_eps = list(kwargs.get("worker_endpoints") or [])
+        self._worker_num = int(kwargs.get("worker_num", 0) or
+                               len(worker_eps) or 1)
+        role = kwargs.get("role", Role.WORKER)
+        self._role = role
+        self._worker_index = int(kwargs.get("current_id", 0))
+        if self._role == Role.WORKER and worker_eps:
+            self._cur_endpoint = worker_eps[self._worker_index]
+        elif self._role == Role.SERVER and self._server_endpoints:
+            self._cur_endpoint = \
+                self._server_endpoints[self._worker_index]
+
+
 class TheOnePsRuntime:
     """Server/worker lifecycle (ref: the_one_ps.py TheOnePSRuntime:
     _init_server/_run_server/_init_worker/_stop_worker)."""

@@ -1,0 +1,53 @@
+"""ref: python/paddle/hub.py — load models from a hubconf.py.
+
+Zero-egress build: `source='local'` (a directory containing hubconf.py)
+is fully supported; 'github'/'gitee' sources raise loudly instead of
+attempting a download."""
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source not in ("local",):
+        raise ValueError(
+            f"zero-egress build: hub source must be 'local' (a directory "
+            f"with hubconf.py), got {source!r}")
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """ref: hub.list — entrypoint names exported by the hubconf."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n, f in vars(mod).items()
+            if callable(f) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A002
+    """ref: hub.help — the entrypoint's docstring."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """ref: hub.load — call the entrypoint."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise RuntimeError(f"hubconf has no entrypoint {model!r}; "
+                           f"available: {list(repo_dir)}")
+    return getattr(mod, model)(**kwargs)

@@ -2,7 +2,8 @@
 from . import autograd
 from . import checkpoint
 from . import nn
-from .optimizer import LookAhead, ModelAverage
+from . import optimizer
+from .optimizer import LookAhead, ModelAverage, LBFGS
 from .ops import (softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
                   identity_loss, graph_send_recv, graph_sample_neighbors,
                   graph_reindex)

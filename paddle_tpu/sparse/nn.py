@@ -132,3 +132,24 @@ def _conv_descope(name):
 Conv3D = _conv_descope("Conv3D")
 SubmConv3D = _conv_descope("SubmConv3D")
 MaxPool3D = _conv_descope("MaxPool3D")
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica sparse BN (ref: sparse/nn/layer/norm.py
+    SyncBatchNorm). Same contract as the dense nn.SyncBatchNorm: under
+    SPMD compilation the batch axis is already global (data sharding +
+    XLA own the cross-replica reduction), so the statistics computed here
+    ARE the synced statistics; eager single-process degrades to local BN."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        out = layer
+        if isinstance(layer, BatchNorm) and not isinstance(layer, cls):
+            out = cls(layer.num_features, layer.momentum, layer.epsilon)
+            out.weight.set_value(layer.weight)
+            out.bias.set_value(layer.bias)
+            out._mean.set_value(layer._mean)
+            out._var.set_value(layer._var)
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return out

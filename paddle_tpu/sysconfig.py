@@ -1,0 +1,15 @@
+"""ref: python/paddle/sysconfig.py — header/library paths for native
+extensions (the csrc/ C ABI convention here)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory of C sources/headers shipped with the package."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+
+
+def get_lib():
+    """Directory where the package's shared libraries are built."""
+    return get_include()

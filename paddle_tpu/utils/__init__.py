@@ -1,0 +1,80 @@
+"""paddle.utils analog (ref: python/paddle/utils/)."""
+import functools
+import importlib
+import warnings
+
+from . import unique_name  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import download  # noqa: F401
+from . import cpp_extension  # noqa: F401
+
+__all__ = ["deprecated", "run_check", "require_version", "try_import"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """ref: utils/deprecated.py — decorator warning on use and annotating
+    the docstring. level 0/1 warn; level 2 raises."""
+
+    def deco(fn):
+        msg = f"API \"{fn.__module__}.{fn.__name__}\" is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", please use \"{update_to}\" instead"
+        if reason:
+            msg += f"; reason: {reason}"
+        fn.__doc__ = f"(Deprecated) {msg}\n\n{fn.__doc__ or ''}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def run_check():
+    """ref: utils/install_check.py run_check — verify the framework can
+    reach its compute device and run a compiled op."""
+    import jax
+    import jax.numpy as jnp
+    devs = jax.devices()
+    out = jax.jit(lambda a: (a @ a).sum())(jnp.eye(8))
+    assert float(out) == 8.0
+    print(f"PaddlePaddle(TPU) works! devices: "
+          f"{[str(d) for d in devs]}")
+
+
+def require_version(min_version, max_version=None):
+    """ref: utils/__init__.py require_version — this build versions
+    itself via paddle.version (see version.py)."""
+    from .. import version as _v
+
+    def key(s):
+        # strip any local suffix ('2.4.0+tpu.5' -> '2.4.0'), then pad to
+        # 3 numeric components so '2.4' == '2.4.0'
+        base = str(s).split("+")[0]
+        parts = [int(p) for p in base.split(".") if p.isdigit()][:3]
+        return tuple(parts + [0] * (3 - len(parts)))
+
+    cur = key(_v.full_version)
+    if key(min_version) > cur:
+        raise Exception(
+            f"installed version {_v.full_version} < required {min_version}")
+    if max_version is not None and key(max_version) < cur:
+        raise Exception(
+            f"installed version {_v.full_version} > allowed {max_version}")
+
+
+def try_import(module_name, err_msg=None):
+    """ref: utils/lazy_import.py try_import."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or
+                          f"{module_name} is required but not installed: "
+                          f"{e}") from e

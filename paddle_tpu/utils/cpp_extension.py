@@ -1,0 +1,39 @@
+"""ref: python/paddle/utils/cpp_extension/ — custom C++ op builds.
+
+TPU-native shape: custom ops are ctypes-loaded C ABI libraries (the
+csrc/ convention: tcp_store.cc, ps_service.cc build via g++ on first
+import) or Pallas kernels; the reference's CUDAExtension tier does not
+apply. load() compiles a .cc into a shared library and returns the
+ctypes handle."""
+import os
+import subprocess
+
+__all__ = ["load", "get_build_directory"]
+
+
+def get_build_directory():
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.join(os.path.expanduser("~"), ".cache",
+                                    "paddle_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_include_paths=None,
+         build_directory=None, verbose=False, **kw):
+    """Build `sources` (C++ only) into lib<name>.so and ctypes-load it —
+    the same pipeline paddle_tpu's own csrc/ uses."""
+    import ctypes
+    bdir = build_directory or get_build_directory()
+    out = os.path.join(bdir, f"lib{name}.so")
+    srcs = [str(s) for s in sources]
+    newest = max((os.path.getmtime(s) for s in srcs), default=0.0)
+    if not os.path.exists(out) or os.path.getmtime(out) < newest:
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", out]
+        for inc in (extra_include_paths or []):
+            cmd += ["-I", str(inc)]
+        cmd += (extra_cxx_cflags or []) + srcs + ["-lpthread"]
+        if verbose:
+            print("cpp_extension:", " ".join(cmd))
+        subprocess.run(cmd, check=True)
+    return ctypes.CDLL(out)

@@ -1,0 +1,28 @@
+"""ref: python/paddle/utils/dlpack.py — zero-copy tensor exchange via the
+DLPack protocol. Modern protocol shape: to_dlpack returns a carrier
+object implementing __dlpack__/__dlpack_device__ (the jax array itself),
+and from_dlpack consumes any such carrier (torch/cupy/numpy arrays
+included) — the capsule round-trips inside the protocol rather than as a
+bare PyCapsule, which current jax/torch both require."""
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    data = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    return data  # implements __dlpack__ / __dlpack_device__
+
+
+def from_dlpack(dlpack):
+    if isinstance(dlpack, Tensor):
+        dlpack = dlpack.data
+    if not hasattr(dlpack, "__dlpack__"):
+        raise TypeError(
+            "from_dlpack takes an object implementing the DLPack protocol "
+            "(__dlpack__/__dlpack_device__) — e.g. a paddle/torch/numpy "
+            f"array; got {type(dlpack).__name__}")
+    return Tensor(jax.dlpack.from_dlpack(dlpack))

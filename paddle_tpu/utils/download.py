@@ -1,0 +1,26 @@
+"""ref: python/paddle/utils/download.py — weight-path resolution.
+
+This build runs zero-egress: URLs resolve ONLY through the local cache
+(~/.cache/paddle/hapi/weights or PADDLE_WEIGHTS_HOME); a missing file is
+a loud error telling the user where to place it, never a silent network
+attempt."""
+import os
+
+__all__ = ["get_weights_path_from_url"]
+
+
+def _weights_home():
+    return os.environ.get(
+        "PADDLE_WEIGHTS_HOME",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle", "hapi",
+                     "weights"))
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    fname = os.path.basename(str(url))
+    path = os.path.join(_weights_home(), fname)
+    if os.path.exists(path):
+        return path
+    raise FileNotFoundError(
+        f"zero-egress build: cannot download {url!r}. Place the file at "
+        f"{path} (or set PADDLE_WEIGHTS_HOME) and retry.")

@@ -1,0 +1,40 @@
+"""ref: python/paddle/utils/unique_name.py — namespaced unique names for
+layers/parameters (generate/guard/switch over a generator stack)."""
+import contextlib
+
+__all__ = ["generate", "switch", "guard"]
+
+
+class _Generator:
+    def __init__(self, prefix=""):
+        self.prefix = prefix
+        self.ids = {}
+
+    def __call__(self, key):
+        n = self.ids.get(key, 0)
+        self.ids[key] = n + 1
+        return f"{self.prefix}{key}_{n}"
+
+
+_stack = [_Generator()]
+
+
+def generate(key):
+    return _stack[-1](key)
+
+
+def switch(new_generator=None):
+    old = _stack[-1]
+    _stack[-1] = new_generator or _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    if isinstance(new_generator, str):
+        new_generator = _Generator(new_generator)
+    _stack.append(new_generator or _Generator())
+    try:
+        yield
+    finally:
+        _stack.pop()

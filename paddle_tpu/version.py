@@ -1,12 +1,52 @@
-"""paddle.version analog."""
-full_version = "0.1.0"
-major = "0"
-minor = "1"
+"""ref: python/paddle/version (generated there at build time) — version
+metadata for require_version and user introspection."""
+
+full_version = "2.4.0+tpu.5"   # reference API line tracked + tpu round
+major = "2"
+minor = "4"
 patch = "0"
 rc = "0"
-commit = "tpu-native-round1"
 istaged = False
+with_mkl = "OFF"
+cuda_version = "False"   # ref prints 'False' for CPU builds
+cudnn_version = "False"
+
+_commit_cache = []
+
+
+def _commit():
+    """Resolved lazily (r5 review: a git subprocess at import time made
+    every `import paddle_tpu` pay a blocking process spawn)."""
+    if not _commit_cache:
+        import subprocess
+        try:
+            out = subprocess.run(
+                ["git", "-C", __file__.rsplit("/", 2)[0], "rev-parse",
+                 "HEAD"], capture_output=True, text=True,
+                timeout=5).stdout.strip()
+        except Exception:  # noqa: BLE001 — metadata must never fail
+            out = ""
+        _commit_cache.append(out or "unknown")
+    return _commit_cache[0]
+
+
+def __getattr__(name):
+    if name == "commit":
+        return _commit()
+    raise AttributeError(name)
 
 
 def show():
-    print(f"paddle_tpu {full_version} (commit {commit})")
+    """ref: version.show() — print the build metadata."""
+    print(f"full_version: {full_version}")
+    print(f"commit: {_commit()}")
+    print(f"cuda: {cuda_version}")
+    print(f"cudnn: {cudnn_version}")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

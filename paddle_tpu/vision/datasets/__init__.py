@@ -51,3 +51,127 @@ class Cifar100(FakeData):
         super().__init__(size=50000 if mode == "train" else 10000,
                          image_shape=(3, 32, 32), num_classes=100,
                          transform=transform)
+
+
+class FashionMNIST(FakeData):
+    """ref: vision/datasets/mnist.py FashionMNIST — MNIST geometry."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        super().__init__(size=60000 if mode == "train" else 10000,
+                         image_shape=(1, 28, 28), num_classes=10,
+                         transform=transform)
+
+
+class Flowers(FakeData):
+    """ref: vision/datasets/flowers.py — 102-class flower images."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend=None):
+        super().__init__(size=6149 if mode == "train" else 1020,
+                         image_shape=(3, 224, 224), num_classes=102,
+                         transform=transform)
+
+
+class VOC2012(FakeData):
+    """ref: vision/datasets/voc2012.py — segmentation pairs: __getitem__
+    returns (image, label MAP) instead of a class id."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        super().__init__(size=2913, image_shape=(3, 224, 224),
+                         num_classes=21, transform=transform)
+
+    def __getitem__(self, idx):
+        import numpy as _np
+        rng = _np.random.RandomState(idx)
+        img = rng.rand(*self.image_shape).astype(_np.float32)
+        label = rng.randint(0, self.num_classes,
+                            self.image_shape[1:]).astype(_np.int64)
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+
+class DatasetFolder(Dataset):
+    """REAL local-directory loader (ref: vision/datasets/folder.py
+    DatasetFolder): root/<class_x>/<file>.npy — classes from subdir
+    names, samples loaded by the vision image backend (numpy: .npy/.npz
+    arrays; PIL images when that backend is selected)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = str(root)
+        self.transform = transform
+        exts = tuple(extensions) if extensions else (".npy", ".npz")
+        if loader is None:
+            from .. import image_load
+            loader = image_load
+        self.loader = loader
+        classes = sorted(d for d in os.listdir(self.root)
+                         if os.path.isdir(os.path.join(self.root, d)))
+        if not classes:
+            raise RuntimeError(f"no class subdirectories under "
+                               f"{self.root!r}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(self.root, c)
+            for fn in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fn)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fn.lower().endswith(exts))
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(
+                f"no samples with extensions {exts} under {self.root!r}")
+
+    def __getitem__(self, idx):
+        import numpy as _np
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform:
+            img = self.transform(img)
+        return img, _np.asarray(target, _np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """ref: vision/datasets/folder.py ImageFolder — unlabeled flat/nested
+    folder of images (inference input); items are [image]."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = str(root)
+        self.transform = transform
+        exts = tuple(extensions) if extensions else (".npy", ".npz")
+        if loader is None:
+            from .. import image_load
+            loader = image_load
+        self.loader = loader
+        self.samples = []
+        for dirpath, _dirs, files in sorted(os.walk(self.root)):
+            for fn in sorted(files):
+                path = os.path.join(dirpath, fn)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fn.lower().endswith(exts))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(
+                f"no samples with extensions {exts} under {self.root!r}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

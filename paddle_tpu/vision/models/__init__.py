@@ -9,11 +9,12 @@ from .mobilenetv1 import MobileNetV1, mobilenet_v1
 from .mobilenetv2 import MobileNetV2, mobilenet_v2
 from .alexnet import AlexNet, alexnet
 from .densenet import (DenseNet, densenet121, densenet161, densenet169,
-                       densenet201)
+                       densenet201, densenet264)
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
-from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_5,
+from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_25,
+                           shufflenet_v2_x0_33, shufflenet_v2_x0_5,
                            shufflenet_v2_x1_0, shufflenet_v2_x1_5,
-                           shufflenet_v2_x2_0)
+                           shufflenet_v2_x2_0, shufflenet_v2_swish)
 from .mobilenetv3 import (MobileNetV3, MobileNetV3Small, MobileNetV3Large,
                           mobilenet_v3_small, mobilenet_v3_large)
 from .googlenet import GoogLeNet, googlenet

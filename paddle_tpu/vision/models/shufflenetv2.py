@@ -1,8 +1,16 @@
 """ShuffleNetV2 (ref: python/paddle/vision/models/shufflenetv2.py)."""
-from ...nn import (Layer, Conv2D, BatchNorm2D, ReLU, MaxPool2D,
+from ...nn import (Layer, Conv2D, BatchNorm2D, ReLU, Swish, MaxPool2D,
                    AdaptiveAvgPool2D, Linear, Sequential)
 from ...nn import functional as F
 from ...tensor import manipulation as M
+
+
+def _act(name):
+    if name == "relu":
+        return ReLU()
+    if name == "swish":
+        return Swish()
+    raise ValueError(f"unsupported act {name!r}; use 'relu' or 'swish'")
 
 
 def channel_shuffle(x, groups):
@@ -10,7 +18,7 @@ def channel_shuffle(x, groups):
 
 
 class InvertedResidual(Layer):
-    def __init__(self, inp, oup, stride):
+    def __init__(self, inp, oup, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch_features = oup // 2
@@ -20,18 +28,18 @@ class InvertedResidual(Layer):
                        bias_attr=False),
                 BatchNorm2D(inp),
                 Conv2D(inp, branch_features, 1, bias_attr=False),
-                BatchNorm2D(branch_features), ReLU())
+                BatchNorm2D(branch_features), _act(act))
         else:
             self.branch1 = None
         in2 = inp if stride > 1 else branch_features
         self.branch2 = Sequential(
             Conv2D(in2, branch_features, 1, bias_attr=False),
-            BatchNorm2D(branch_features), ReLU(),
+            BatchNorm2D(branch_features), _act(act),
             Conv2D(branch_features, branch_features, 3, stride=stride,
                    padding=1, groups=branch_features, bias_attr=False),
             BatchNorm2D(branch_features),
             Conv2D(branch_features, branch_features, 1, bias_attr=False),
-            BatchNorm2D(branch_features), ReLU())
+            BatchNorm2D(branch_features), _act(act))
 
     def forward(self, x):
         if self.stride == 1:
@@ -47,26 +55,28 @@ class ShuffleNetV2(Layer):
                  with_pool=True):
         super().__init__()
         stage_repeats = [4, 8, 4]
-        channels = {0.5: [24, 48, 96, 192, 1024],
+        channels = {0.25: [24, 24, 48, 96, 512],
+                    0.33: [24, 32, 64, 128, 512],
+                    0.5: [24, 48, 96, 192, 1024],
                     1.0: [24, 116, 232, 464, 1024],
                     1.5: [24, 176, 352, 704, 1024],
                     2.0: [24, 244, 488, 976, 2048]}[scale]
         self.conv1 = Sequential(
             Conv2D(3, channels[0], 3, stride=2, padding=1, bias_attr=False),
-            BatchNorm2D(channels[0]), ReLU())
+            BatchNorm2D(channels[0]), _act(act))
         self.maxpool = MaxPool2D(3, 2, padding=1)
         stages = []
         in_ch = channels[0]
         for i, reps in enumerate(stage_repeats):
             out_ch = channels[i + 1]
-            stages.append(InvertedResidual(in_ch, out_ch, 2))
+            stages.append(InvertedResidual(in_ch, out_ch, 2, act))
             for _ in range(reps - 1):
-                stages.append(InvertedResidual(out_ch, out_ch, 1))
+                stages.append(InvertedResidual(out_ch, out_ch, 1, act))
             in_ch = out_ch
         self.stages = Sequential(*stages)
         self.conv5 = Sequential(
             Conv2D(in_ch, channels[-1], 1, bias_attr=False),
-            BatchNorm2D(channels[-1]), ReLU())
+            BatchNorm2D(channels[-1]), _act(act))
         self.with_pool = with_pool
         self.num_classes = num_classes
         if with_pool:
@@ -100,3 +110,15 @@ def shufflenet_v2_x1_5(pretrained=False, **kwargs):
 
 def shufflenet_v2_x2_0(pretrained=False, **kwargs):
     return ShuffleNetV2(2.0, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.33, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.0, act="swish", **kwargs)

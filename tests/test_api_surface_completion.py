@@ -19,7 +19,12 @@ MODULES = ["", "nn", "nn.functional", "nn.initializer", "linalg", "fft",
            "signal", "optimizer", "metric", "io", "amp", "static",
            "distributed", "vision", "vision.transforms", "vision.ops",
            "sparse", "distribution", "geometric", "incubate", "audio",
-           "text", "jit", "quantization", "autograd", "device"]
+           "text", "jit", "quantization", "autograd", "device",
+           "utils", "utils.unique_name", "utils.dlpack", "hub",
+           "distributed.fleet", "incubate.nn", "incubate.autograd",
+           "incubate.optimizer", "incubate.nn.functional",
+           "vision.datasets", "vision.models", "audio.features",
+           "audio.functional", "sparse.nn", "profiler"]
 
 
 def _ref_all(path):

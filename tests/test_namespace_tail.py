@@ -1,0 +1,326 @@
+"""Round-5 deep-namespace completion: utils/version/sysconfig/hub, fleet
+role makers + data generators + UtilBase, distributed.passes, incubate
+fused layers/functional + LBFGS + to_prim, vision folder datasets + model
+variants, audio submodules, profiler enums, sparse SyncBatchNorm.
+Ref: the per-module reference __all__ lists audited in
+test_api_surface_completion.py (module list extended here)."""
+import io
+import os
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+
+# --- utils ------------------------------------------------------------------
+
+def test_unique_name_guard():
+    from paddle_tpu.utils import unique_name
+    a = unique_name.generate("fc")
+    b = unique_name.generate("fc")
+    assert a != b
+    with unique_name.guard("blk_"):
+        c = unique_name.generate("fc")
+        assert c.startswith("blk_fc_")
+    d = unique_name.generate("fc")
+    assert d != a and not d.startswith("blk_")
+
+
+def test_dlpack_roundtrip():
+    from paddle_tpu.utils.dlpack import to_dlpack, from_dlpack
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    cap = to_dlpack(x)
+    y = from_dlpack(cap)
+    np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+
+def test_deprecated_and_versions():
+    import warnings
+
+    @paddle.utils.deprecated(update_to="paddle.newer", since="2.0")
+    def oldfn():
+        return 42
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert oldfn() == 42
+    assert any("deprecated" in str(w.message) for w in rec)
+    paddle.utils.require_version("2.0")
+    with pytest.raises(Exception):
+        paddle.utils.require_version("99.0")
+    assert paddle.__version__ == paddle.version.full_version
+
+
+def test_download_is_zero_egress(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_WEIGHTS_HOME", str(tmp_path))
+    from paddle_tpu.utils.download import get_weights_path_from_url
+    with pytest.raises(FileNotFoundError):
+        get_weights_path_from_url("https://x/w.pdparams")
+    (tmp_path / "w.pdparams").write_bytes(b"ok")
+    assert get_weights_path_from_url("https://x/w.pdparams") == \
+        str(tmp_path / "w.pdparams")
+
+
+def test_hub_local_source(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_model(scale=1):\n"
+        "    'a tiny model entrypoint'\n"
+        "    return ('model', scale)\n")
+    assert paddle.hub.list(str(tmp_path)) == ["tiny_model"]
+    assert "tiny" in paddle.hub.help(str(tmp_path), "tiny_model")
+    assert paddle.hub.load(str(tmp_path), "tiny_model",
+                           scale=3) == ("model", 3)
+    with pytest.raises(ValueError):
+        paddle.hub.load("user/repo", "m", source="github")
+
+
+def test_sysconfig_paths():
+    assert os.path.isdir(paddle.sysconfig.get_include())
+
+
+# --- fleet tail -------------------------------------------------------------
+
+def test_user_defined_role_maker():
+    from paddle_tpu.distributed import fleet
+    rm = fleet.UserDefinedRoleMaker(
+        server_endpoints=["127.0.0.1:1"], worker_endpoints=["127.0.0.1:2"],
+        role=fleet.Role.SERVER, current_id=0)
+    assert rm.is_server() and not rm.is_worker()
+    assert rm.server_num() == 1
+
+
+def test_data_generator_protocol():
+    from paddle_tpu.distributed import fleet
+
+    class G(fleet.MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def gen():
+                toks = [int(t) for t in line.split()]
+                yield [("words", toks), ("label", [toks[0] % 2])]
+            return gen
+
+    g = G()
+    out = io.StringIO()
+    g._run(io.StringIO("3 4 5\n"), out)
+    assert out.getvalue() == "3 3 4 5 1 1\n"
+
+
+def test_util_base_file_shard():
+    from paddle_tpu.distributed import fleet
+    u = fleet.UtilBase()
+    files = [f"f{i}" for i in range(5)]
+    assert u.get_file_shard(files) == files  # single worker: all files
+    with pytest.raises(TypeError):
+        u.get_file_shard("not-a-list")
+
+
+def test_distributed_passes_manager():
+    from paddle_tpu.distributed import passes
+    p = passes.new_pass("dead_code_elimination")
+    pm = passes.PassManager([p])
+    assert pm.names == ["dead_code_elimination"]
+    with pytest.raises(ValueError):
+        passes.new_pass("not_a_pass")
+
+
+# --- incubate tail ----------------------------------------------------------
+
+def test_fused_layers_forward():
+    from paddle_tpu.incubate import nn as inn
+    paddle.seed(0)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 8)
+                         .astype(np.float32))
+    fl = inn.FusedLinear(8, 4)
+    assert tuple(fl(x).shape) == (2, 4)
+    bl = inn.FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+    out = bl(x, x)
+    np.testing.assert_allclose(out.numpy().mean(axis=-1), 0.0, atol=1e-5)
+    moe = inn.FusedEcMoe(8, 16, 4)
+    x3 = paddle.to_tensor(np.random.RandomState(1).randn(2, 3, 8)
+                          .astype(np.float32))
+    gate = paddle.to_tensor(np.random.RandomState(2).randn(2, 3, 4)
+                            .astype(np.float32))
+    assert tuple(moe(x3, gate).shape) == (2, 3, 8)
+
+
+def test_fused_multi_transformer_functional():
+    import paddle_tpu.incubate.nn.functional as FF
+    paddle.seed(1)
+    rng = np.random.RandomState(0)
+    d, nh, hd, L = 8, 2, 4, 2
+    mk = lambda *s: paddle.to_tensor(  # noqa: E731
+        (rng.randn(*s) * 0.1).astype(np.float32))
+    x = mk(2, 3, d)
+    out = FF.fused_multi_transformer(
+        x,
+        [mk(d) + 1.0 for _ in range(L)], [mk(d) for _ in range(L)],
+        [mk(3, nh, hd, d) for _ in range(L)],
+        [mk(3 * nh * hd) for _ in range(L)],
+        [mk(d, d) for _ in range(L)], [mk(d) for _ in range(L)],
+        [mk(d) + 1.0 for _ in range(L)], [mk(d) for _ in range(L)],
+        [mk(d, 16) for _ in range(L)], [mk(16) for _ in range(L)],
+        [mk(16, d) for _ in range(L)], [mk(d) for _ in range(L)],
+        dropout_rate=0.0)
+    assert tuple(out.shape) == (2, 3, d)
+    assert np.isfinite(out.numpy()).all()
+    with pytest.raises(NotImplementedError):
+        FF.fused_multi_transformer(x, [], [], [], [], [], [], [], [], [],
+                                   [], [], [], time_step=1)
+
+
+def test_lbfgs_converges_on_quadratic():
+    from paddle_tpu.incubate import LBFGS
+    import paddle_tpu.nn as nn
+    paddle.seed(2)
+    net = nn.Linear(3, 1, bias_attr=False)
+    target = np.array([[1.0], [2.0], [3.0]], np.float32)
+    x = paddle.to_tensor(np.eye(3, dtype=np.float32))
+    y = paddle.to_tensor(target.T.repeat(3, 0) * np.eye(3, dtype=np.float32)
+                         @ np.ones((3, 1), np.float32))
+    opt = LBFGS(learning_rate=1.0, max_iter=25,
+                line_search_fn="strong_wolfe",
+                parameters=net.parameters())
+
+    def closure():
+        opt.clear_grad()
+        loss = paddle.mean((net(x) - paddle.to_tensor(target)) ** 2)
+        loss.backward()
+        return loss
+
+    final = opt.step(closure)
+    assert float(final) < 1e-5, float(final)
+    np.testing.assert_allclose(net.weight.numpy().ravel(),
+                               target.ravel(), atol=1e-2)
+
+
+def test_to_prim_contract():
+    from paddle_tpu.incubate import autograd as iag
+    assert iag.to_prim(None) is None
+    obj = object()
+    assert iag.to_prim(obj) is obj
+
+
+# --- vision tail ------------------------------------------------------------
+
+def test_dataset_folder(tmp_path):
+    from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+    for cls, n in (("cat", 2), ("dog", 3)):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(n):
+            np.save(d / f"{i}.npy",
+                    np.zeros((4, 4, 3), np.uint8))
+    ds = DatasetFolder(tmp_path)
+    assert ds.classes == ["cat", "dog"] and len(ds) == 5
+    img, label = ds[4]
+    assert label == 1 and np.asarray(img).shape == (4, 4, 3)
+    flat = ImageFolder(tmp_path)
+    assert len(flat) == 5 and np.asarray(flat[0][0]).shape == (4, 4, 3)
+    with pytest.raises(RuntimeError):
+        DatasetFolder(tmp_path / "cat")  # no class subdirs
+
+
+def test_vision_dataset_families():
+    from paddle_tpu.vision.datasets import FashionMNIST, Flowers, VOC2012
+    fm = FashionMNIST(mode="test")
+    img, label = fm[0]
+    assert img.shape == (1, 28, 28) and 0 <= int(label) < 10
+    fl = Flowers(mode="test")
+    assert fl[1][0].shape == (3, 224, 224)
+    seg_img, seg_map = VOC2012()[2]
+    assert seg_map.shape == (224, 224) and seg_map.dtype == np.int64
+
+
+def test_model_variant_factories():
+    from paddle_tpu.vision import models as M
+    paddle.seed(3)
+    net = M.shufflenet_v2_x0_25(num_classes=10)
+    x = paddle.to_tensor(np.random.RandomState(0).rand(1, 3, 64, 64)
+                         .astype(np.float32))
+    assert tuple(net(x).shape) == (1, 10)
+    sw = M.shufflenet_v2_swish(num_classes=4)
+    assert tuple(sw(x).shape) == (1, 4)
+    assert M.densenet264(num_classes=2) is not None
+    with pytest.raises(ValueError):
+        M.ShuffleNetV2(1.0, act="tanh")
+
+
+# --- audio submodules -------------------------------------------------------
+
+def test_audio_real_submodules():
+    import importlib
+    feats = importlib.import_module("paddle_tpu.audio.features")
+    func = importlib.import_module("paddle_tpu.audio.functional")
+    ds = importlib.import_module("paddle_tpu.audio.datasets")
+    assert paddle.audio.features is feats
+    assert paddle.audio.functional is func
+    assert paddle.audio.datasets is ds
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 2048)
+                         .astype(np.float32))
+    out = feats.MFCC(sr=8000, n_mfcc=8, n_mels=16)(x)
+    assert out.shape[1] == 8
+    w = func.get_window("hamming", 16)
+    assert w.shape == [16]
+    with pytest.raises(RuntimeError):
+        ds.TESS(root="/nonexistent")
+
+
+# --- profiler + sparse ------------------------------------------------------
+
+def test_profiler_enums_and_protobuf_export(tmp_path):
+    import paddle_tpu.profiler as profiler
+    assert profiler.SortedKeys.CPUTotal.value == 0
+    assert profiler.SummaryView.KernelView.name == "KernelView"
+    handler = profiler.export_protobuf(str(tmp_path), worker_name="w0")
+    with profiler.Profiler(on_trace_ready=handler) as p:
+        _ = paddle.ones([4]) + 1
+        p.step()
+    out_dir = tmp_path / "w0"
+    assert out_dir.is_dir() and any(out_dir.iterdir())
+
+
+def test_sparse_sync_batch_norm_converts():
+    import paddle_tpu.sparse.nn as snn
+    import paddle_tpu.nn as nn
+    paddle.seed(4)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn = snn.BatchNorm(4)
+
+    net = Net()
+    out = snn.SyncBatchNorm.convert_sync_batchnorm(net)
+    assert isinstance(out.bn, snn.SyncBatchNorm)
+
+
+def test_require_version_exact_patch():
+    """r5 review regression: the local +tpu suffix must not make exact
+    3-component requirements fail."""
+    paddle.utils.require_version("2.4.0")
+    paddle.utils.require_version("2.4")
+    paddle.utils.require_version("2.0.1", "2.4.0")
+    with pytest.raises(Exception):
+        paddle.utils.require_version("2.4.1")
+
+
+def test_new_pass_attrs_reach_constructor():
+    """r5 review regression: pass_attrs are constructor kwargs."""
+    from paddle_tpu.distributed import passes
+    p = passes.new_pass("gradient_merge", {"k_steps": 4})
+    assert getattr(p, "k", None) == 4
+
+
+def test_string_data_generator_validates():
+    from paddle_tpu.distributed import fleet
+
+    class G(fleet.MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def g():
+                yield "not-a-slot-list"
+            return g
+
+    with pytest.raises(ValueError):
+        G()._run(io.StringIO("x\n"), io.StringIO())

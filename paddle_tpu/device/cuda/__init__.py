@@ -1,0 +1,87 @@
+"""paddle.device.cuda as a real submodule (ref: python/paddle/device/
+cuda/__init__.py) — on this build "the accelerator" is the TPU, so the
+memory/synchronize verbs read the TPU device like the class-attr shim
+(paddle.device.cuda) always did; both import paths resolve to the same
+functions."""
+from .. import cuda as _shim
+
+device_count = _shim.device_count
+synchronize = _shim.synchronize
+empty_cache = _shim.empty_cache
+max_memory_allocated = _shim.max_memory_allocated
+memory_allocated = _shim.memory_allocated
+
+
+def max_memory_reserved(device=None):
+    from .. import max_memory_reserved as f
+    return f(device)
+
+
+def memory_reserved(device=None):
+    from .. import memory_reserved as f
+    return f(device)
+
+
+def get_device_properties(device=None):
+    """ref: cuda/__init__.py get_device_properties — device metadata."""
+    import jax
+
+    class _Props:
+        def __init__(self, d):
+            self.name = str(d)
+            try:
+                self.total_memory = d.memory_stats().get("bytes_limit", 0)
+            except Exception:
+                self.total_memory = 0
+            self.major, self.minor = 0, 0
+            self.multi_processor_count = 1
+
+        def __repr__(self):
+            return (f"_gpuDeviceProperties(name='{self.name}', "
+                    f"total_memory={self.total_memory})")
+
+    return _Props(jax.devices()[0])
+
+
+def get_device_name(device=None):
+    import jax
+    return str(jax.devices()[0])
+
+
+def get_device_capability(device=None):
+    return (0, 0)
+
+
+class Stream:
+    def __init__(self, device=None, priority=None):
+        from .. import Stream as _S
+        self._s = _S(device)
+
+    def synchronize(self):
+        self._s.synchronize()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False,
+                 interprocess=False):
+        from .. import Event as _E
+        self._e = _E(enable_timing=enable_timing)
+
+    def record(self, stream=None):
+        self._e.record()
+
+    def query(self):
+        return self._e.query()
+
+    def synchronize(self):
+        self._e.synchronize()
+
+
+def current_stream(device=None):
+    from .. import current_stream as f
+    return f(device)
+
+
+def stream_guard(stream):
+    from .. import stream_guard as f
+    return f(getattr(stream, "_s", stream))

@@ -1,0 +1,12 @@
+"""paddle.device.xpu (ref: python/paddle/device/xpu/__init__.py) — on
+this build the accelerator is the TPU; the synchronize verb blocks the
+TPU stream like device.cuda's."""
+from .. import synchronize  # noqa: F401
+
+
+def get_xpu_device_count():
+    return 0
+
+
+def set_debug_level(level=1):
+    pass

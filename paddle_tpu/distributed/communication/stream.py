@@ -3,4 +3,5 @@ stream/). XLA owns stream scheduling on TPU; sync_op/use_calc_stream are
 accepted and ignored."""
 from ..collective import (all_reduce, all_gather, alltoall, reduce_scatter,
                           broadcast, reduce, scatter, send, recv,
-                          all_to_all_single)
+                          all_to_all_single,
+                          all_to_all_single as alltoall_single)

@@ -2,3 +2,32 @@ from . import hybrid_parallel_util
 from .log_util import logger
 
 from . import sequence_parallel_utils  # noqa: F401
+
+
+from .fs import LocalFS, HDFSClient  # noqa: E402,F401
+from ..recompute import recompute  # noqa: E402,F401
+
+
+class DistributedInfer:
+    """ref: fleet/utils/__init__.py DistributedInfer — run inference
+    against the PS sparse tables: init_distributed_infer_env brings the
+    worker connection up (and loads saved tables from `dirname`),
+    get_dist_infer_program returns the program (the recorded Program is
+    already the full one on TPU)."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        from .. import fleet_base as _fb
+        fleet = _fb.fleet_instance
+        if getattr(fleet, "_ps_runtime", None) is None:
+            return  # no PS runtime (collective / single-process job)
+        fleet.init_worker()  # a bring-up failure must surface HERE,
+        #                      not as empty tables mid-inference
+        if dirname:
+            fleet.ps_runtime.load_persistables(dirname)
+
+    def get_dist_infer_program(self):
+        return self._main

@@ -36,18 +36,21 @@ def list(repo_dir, source="local", force_reload=False):  # noqa: A001
             if callable(f) and not n.startswith("_")]
 
 
+def _entrypoint(repo_dir, model):
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise RuntimeError(f"hubconf has no entrypoint {model!r}; "
+                           f"available: {list(repo_dir)}")
+    return getattr(mod, model)
+
+
 def help(repo_dir, model, source="local", force_reload=False):  # noqa: A002
     """ref: hub.help — the entrypoint's docstring."""
     _check_source(source)
-    mod = _load_hubconf(repo_dir)
-    return getattr(mod, model).__doc__
+    return _entrypoint(repo_dir, model).__doc__
 
 
 def load(repo_dir, model, source="local", force_reload=False, **kwargs):
     """ref: hub.load — call the entrypoint."""
     _check_source(source)
-    mod = _load_hubconf(repo_dir)
-    if not hasattr(mod, model):
-        raise RuntimeError(f"hubconf has no entrypoint {model!r}; "
-                           f"available: {list(repo_dir)}")
-    return getattr(mod, model)(**kwargs)
+    return _entrypoint(repo_dir, model)(**kwargs)

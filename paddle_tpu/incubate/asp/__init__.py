@@ -64,3 +64,22 @@ def reset_excluded_layers(*a, **k):
 
 def set_excluded_layers(*a, **k):
     pass
+
+
+def calculate_density(x):
+    """ref: asp/utils.py calculate_density — fraction of nonzeros."""
+    import numpy as np
+    arr = np.asarray(getattr(x, "numpy", lambda: x)())
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+_supported_layers = set()
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """ref: asp/supported_layer_list.py add_supported_layer — register a
+    layer type/name whose weights the pruner should mask."""
+    name = layer if isinstance(layer, str) else getattr(
+        layer, "__name__", str(layer))
+    _supported_layers.add(name)
+    return name

@@ -1,0 +1,6 @@
+"""incubate.distributed.fleet (ref: python/paddle/incubate/distributed/
+fleet/__init__.py) — the recompute entries shared with the fleet tier."""
+from ....distributed.fleet.recompute import (recompute_sequential,  # noqa: F401,E501
+                                             recompute_hybrid)
+
+__all__ = ["recompute_sequential", "recompute_hybrid"]

@@ -151,6 +151,7 @@ class LBFGS:
                 f"{line_search_fn!r}")
         self.line_search_fn = line_search_fn
         self._s, self._y = [], []
+        self._rejects = 0
         self._prev_flat_grad = None
 
     def _flat(self, arrs):
@@ -199,6 +200,8 @@ class LBFGS:
             if float(jnp.max(jnp.abs(g))) <= self.tol_grad:
                 break
             d = self._direction(g)
+            if float(jnp.dot(g, d)) >= 0:  # stale history: d uphill
+                d = -g
             x0 = self._get_params()
             t = self.lr
             f0 = float(loss)
@@ -222,9 +225,15 @@ class LBFGS:
             if float(jnp.dot(s, y)) > 1e-10:
                 self._s.append(s)
                 self._y.append(y)
+                self._rejects = 0
                 if len(self._s) > self.history_size:
                     self._s.pop(0)
                     self._y.pop(0)
+            else:
+                # stale-history stall guard (see functional.minimize_lbfgs)
+                self._rejects += 1
+                if self._rejects >= 3:
+                    self._s, self._y, self._rejects = [], [], 0
             if float(jnp.max(jnp.abs(s))) <= self.tol_change:
                 g = g_new
                 break
@@ -242,3 +251,6 @@ class LBFGS:
     def set_state_dict(self, sd):
         self._s = [jnp.asarray(v) for v in sd.get("s", [])]
         self._y = [jnp.asarray(v) for v in sd.get("y", [])]
+
+
+from . import functional  # noqa: E402,F401

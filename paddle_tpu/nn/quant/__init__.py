@@ -1,0 +1,18 @@
+"""nn.quant (ref: python/paddle/nn/quant/) — quantization stubs that mark
+where activation observers attach in QAT/PTQ graphs."""
+from ..layer.layers import Layer
+
+__all__ = ["Stub"]
+
+
+class Stub(Layer):
+    """ref: nn/quant/stub.py Stub — identity marker; the quantization
+    framework (quantization.QAT/PTQ) replaces it with the configured
+    observer/quanter at quantize() time."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        return x

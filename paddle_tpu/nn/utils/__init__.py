@@ -24,3 +24,71 @@ def weight_norm(layer, name="weight", dim=0):
 
 def remove_weight_norm(layer, name="weight"):
     return layer
+
+
+def spectral_norm_value(weight, dim=0, power_iters=1, eps=1e-12):
+    """Power-iteration sigma-normalized weight (shared by the functional
+    spectral_norm and static.nn.spectral_norm)."""
+    from ...ops import apply
+
+    def fn(w):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((wm.shape[0],), w.dtype)
+        v = jnp.ones((wm.shape[1],), w.dtype)
+        for _ in range(max(1, power_iters)):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ wm @ v
+        return w / (sigma + eps)
+
+    return apply(fn, weight, name="spectral_norm")
+
+
+def spectral_norm(layer, name="weight", dim=None, power_iters=1, eps=1e-12):
+    """ref: nn/utils/spectral_norm_hook.py spectral_norm — wrap a layer
+    so `name` is sigma-normalized on every forward."""
+    if dim is None:
+        dim = 0
+    param = getattr(layer, name)
+    orig_forward = layer.forward
+
+    def fwd(*args, **kwargs):
+        normed = spectral_norm_value(param, dim=dim,
+                                     power_iters=power_iters, eps=eps)
+        raw = param.data
+        param.data = normed.data
+        try:
+            return orig_forward(*args, **kwargs)
+        finally:
+            param.data = raw
+
+    layer.forward = fwd
+    return layer
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """ref: nn/utils/clip_grad_norm_.py — in-place global-norm clip of
+    .grad; returns the total norm."""
+    params = [p for p in ([parameters] if not isinstance(
+        parameters, (list, tuple)) else parameters) if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    norm_type = float(norm_type)
+    grads = [jnp.asarray(p.grad.data, jnp.float32) for p in params]
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g) ** norm_type) for g in grads])
+        ) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"the total norm of gradients is non-finite ({float(total)}); "
+            "disable error_if_nonfinite to clip anyway")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p, g in zip(params, grads):
+        p.grad.data = (g * scale).astype(p.grad.data.dtype)
+    return Tensor(total)

@@ -1,0 +1,5 @@
+"""quantization.quanters (ref: python/paddle/quantization/quanters/) —
+the quanter factories."""
+from . import FakeQuanterWithAbsMaxObserver, BaseQuanter, quanter
+
+__all__ = ["FakeQuanterWithAbsMaxObserver", "BaseQuanter", "quanter"]

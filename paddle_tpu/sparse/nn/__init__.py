@@ -7,8 +7,8 @@ round — constructing one raises with this rationale rather than
 pretending."""
 import jax.numpy as jnp
 
-from ..nn.layer.layers import Layer
-from . import _with_values, relu as _relu
+from ...nn.layer.layers import Layer
+from .. import _with_values, relu as _relu
 
 
 class ReLU(Layer):
@@ -46,8 +46,8 @@ class Softmax(Layer):
         self.axis = axis
 
     def forward(self, x):
-        from . import SparseCsrTensor
-        from ..ops import apply
+        from .. import SparseCsrTensor
+        from ...ops import apply
         import numpy as np
         if not isinstance(x, SparseCsrTensor):
             raise ValueError("sparse softmax expects a CSR tensor "
@@ -83,13 +83,13 @@ class BatchNorm(Layer):
                                           dtype=self._dtype, is_bias=True)
         self.weight.data = jnp.ones((num_features,), self.weight.data.dtype)
         # running stats as buffers: they must survive state_dict save/load
-        from ..tensor.tensor import Tensor as _T
+        from ...tensor.tensor import Tensor as _T
         self.register_buffer("_mean", _T(jnp.zeros((num_features,))))
         self.register_buffer("_var", _T(jnp.ones((num_features,))))
 
     def forward(self, x):
-        from . import SparseCooTensor, SparseCsrTensor
-        from ..ops import apply
+        from .. import SparseCooTensor, SparseCsrTensor
+        from ...ops import apply
         raw = getattr(x.values, "data", x.values)
         if self.training:
             # batch stats computed on the concrete values OUTSIDE the
@@ -153,3 +153,6 @@ class SyncBatchNorm(BatchNorm):
         for name, sub in list(layer._sub_layers.items()):
             layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
         return out
+
+
+from . import functional  # noqa: E402,F401

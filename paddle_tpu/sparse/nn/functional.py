@@ -1,0 +1,58 @@
+"""sparse.nn.functional (ref: python/paddle/sparse/nn/functional/) —
+value-wise activations over sparse tensors; the 3D conv/pool tier shares
+the layer classes' descope (BASELINE.md ledger)."""
+import jax
+import jax.numpy as jnp
+
+from .. import _with_values
+
+__all__ = ["conv3d", "subm_conv3d", "max_pool3d", "relu", "relu6",
+           "leaky_relu", "softmax", "attention"]
+
+
+def relu(x, name=None):
+    return _with_values(x, jax.nn.relu)
+
+
+def relu6(x, name=None):
+    return _with_values(x, lambda v: jnp.clip(v, 0.0, 6.0))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _with_values(x, lambda v: jnp.where(v >= 0, v,
+                                               negative_slope * v))
+
+
+def softmax(x, axis=-1, name=None):
+    """ref: functional/activation.py softmax — softmax over each CSR
+    row's stored values (the only axis sparse softmax defines)."""
+    from .. import SparseCsrTensor
+    if not isinstance(x, SparseCsrTensor):
+        raise ValueError("sparse softmax takes a SparseCsrTensor (per-row "
+                         "normalization needs the CSR row layout)")
+    import numpy as np
+    crows = np.asarray(getattr(x.crows, "data", x.crows))
+    vals = getattr(x.values, "data", x.values)
+    out = vals
+    for r in range(len(crows) - 1):
+        lo, hi = int(crows[r]), int(crows[r + 1])
+        if hi > lo:
+            seg = vals[lo:hi]
+            out = out.at[lo:hi].set(jax.nn.softmax(seg))
+    return SparseCsrTensor(x.crows, x.cols, out, x.shape)
+
+
+def _descope(name):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"sparse.nn.functional.{name}: the 3D sparse kernel tier "
+            f"(rulebook gather/scatter) is descoped — BASELINE.md ledger; "
+            f"dense conv3d/max_pool3d are available in paddle.nn")
+    fn.__name__ = name
+    return fn
+
+
+conv3d = _descope("conv3d")
+subm_conv3d = _descope("subm_conv3d")
+max_pool3d = _descope("max_pool3d")
+attention = _descope("attention")

@@ -33,3 +33,339 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
         return cond(branch_index == k, fns[k], lambda: build(keys[1:]))
 
     return build(sorted(fns.keys()))
+
+
+# ---------------------------------------------------------------------------
+# static.nn layer functions (ref: python/paddle/static/nn/common.py) — the
+# legacy build-a-layer-by-function surface. Each creates the matching
+# nn.Layer (parameters included) and applies it, which is exactly what the
+# reference's functions do at graph-build time; in eager code prefer the
+# Layer classes directly.
+# ---------------------------------------------------------------------------
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """ref: common.py fc."""
+    from .. import nn as _nn
+    from ..tensor.manipulation import reshape
+    in_dim = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_dim *= int(d)
+    flat = reshape(x, list(x.shape[:num_flatten_dims]) + [in_dim])
+    out = _nn.Linear(in_dim, size, weight_attr=weight_attr,
+                     bias_attr=bias_attr)(flat)
+    if activation:
+        from ..nn import functional as F
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """ref: common.py embedding."""
+    from .. import nn as _nn
+    return _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                         weight_attr=param_attr)(input)
+
+
+def _conv(cls, x, num_filters, filter_size, stride, padding, dilation,
+          groups, param_attr, bias_attr, in_axis=1, **extra):
+    in_ch = int(x.shape[in_axis])
+    layer = cls(in_ch, num_filters, filter_size, stride=stride,
+                padding=padding, dilation=dilation, groups=groups or 1,
+                weight_attr=param_attr, bias_attr=bias_attr, **extra)
+    return layer(x)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCHW"):
+    """ref: common.py conv2d."""
+    from .. import nn as _nn
+    out = _conv(_nn.Conv2D, input, num_filters, filter_size, stride,
+                padding, dilation, groups, param_attr, bias_attr)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    """ref: common.py conv3d."""
+    from .. import nn as _nn
+    out = _conv(_nn.Conv3D, input, num_filters, filter_size, stride,
+                padding, dilation, groups, param_attr, bias_attr)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    """ref: common.py conv2d_transpose."""
+    from .. import nn as _nn
+    out = _conv(_nn.Conv2DTranspose, input, num_filters, filter_size,
+                stride, padding, dilation, groups, param_attr, bias_attr)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    """ref: common.py conv3d_transpose."""
+    from .. import nn as _nn
+    out = _conv(_nn.Conv3DTranspose, input, num_filters, filter_size,
+                stride, padding, dilation, groups, param_attr, bias_attr)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    """ref: common.py batch_norm."""
+    from .. import nn as _nn
+    ch = int(input.shape[1])
+    out = _nn.BatchNorm2D(ch, momentum=momentum, epsilon=epsilon)(input) \
+        if input.ndim == 4 else _nn.BatchNorm1D(ch, momentum=momentum,
+                                                epsilon=epsilon)(input)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """ref: common.py layer_norm."""
+    from ..nn import functional as F
+    shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    ln = F.layer_norm(input, shape, epsilon=epsilon)
+    return getattr(F, act)(ln) if act else ln
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    """ref: common.py group_norm."""
+    from .. import nn as _nn
+    out = _nn.GroupNorm(groups, int(input.shape[1]), epsilon=epsilon)(input)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    """ref: common.py instance_norm."""
+    from .. import nn as _nn
+    return _nn.InstanceNorm2D(int(input.shape[1]), epsilon=epsilon)(input)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              enable_scale_and_shift=False, name=None, **kw):
+    """ref: common.py data_norm — normalization by accumulated batch
+    statistics; single-pass analog normalizes by the current batch."""
+    from ..ops import apply
+    import jax.numpy as _jnp
+
+    def fn(a):
+        m = _jnp.mean(a, axis=0, keepdims=True)
+        v = _jnp.var(a, axis=0, keepdims=True)
+        return (a - m) / _jnp.sqrt(v + epsilon)
+
+    return apply(fn, input, name="data_norm")
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """ref: common.py spectral_norm — functional power iteration."""
+    from ..nn.utils import spectral_norm_value
+    return spectral_norm_value(weight, dim=dim, power_iters=power_iters,
+                               eps=eps)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    """ref: common.py prelu."""
+    from .. import nn as _nn
+    num = 1
+    if mode == "channel":
+        num = int(x.shape[1])
+    elif mode == "element":
+        num = 1
+        for d in x.shape[1:]:
+            num *= int(d)
+    return _nn.PReLU(num_parameters=num, weight_attr=param_attr)(x)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """ref: common.py bilinear_tensor_product."""
+    from .. import nn as _nn
+    out = _nn.Bilinear(int(x.shape[-1]), int(y.shape[-1]), size,
+                       weight_attr=param_attr, bias_attr=bias_attr)(x, y)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  name=None):
+    """ref: common.py deform_conv2d — deformable convolution: the kernel
+    samples at learned offset positions (bilinear). The gather-heavy
+    sampling tier is not built in the TPU port (same class of work as
+    the 3D sparse conv rulebook — BASELINE.md descope ledger); loud
+    error by convention."""
+    raise NotImplementedError(
+        "deform_conv2d: the deformable-sampling kernel tier is not built "
+        "in the TPU port (see BASELINE.md descope ledger); use conv2d or "
+        "implement offsets via nn.functional.grid_sample")
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """ref: common.py nce — noise-contrastive estimation loss. Sampled
+    softmax analog: negatives drawn uniformly; returns per-example loss."""
+    from ..ops import apply
+    from ..framework import random as frnd
+    import jax
+    import jax.numpy as _jnp
+    num_neg = num_neg_samples or 10
+    d = int(input.shape[-1])
+    from .. import nn as _nn
+    emb = _nn.Embedding(num_total_classes, d)
+    bias = _nn.Embedding(num_total_classes, 1)
+    key = frnd.next_key()
+
+    def fn(a, yid, wtab, btab):
+        b = a.shape[0]
+        neg = jax.random.randint(key, (b, num_neg), 0, num_total_classes)
+        ids = _jnp.concatenate([yid.reshape(b, 1), neg], axis=1)
+        w = wtab[ids]                       # [b, 1+neg, d]
+        logit = _jnp.einsum("bd,bkd->bk", a, w) + btab[ids, 0]
+        lab = _jnp.zeros_like(logit).at[:, 0].set(1.0)
+        return _jnp.mean(
+            _jnp.maximum(logit, 0) - logit * lab
+            + _jnp.log1p(_jnp.exp(-_jnp.abs(logit))), axis=1,
+            keepdims=True)
+
+    return apply(fn, input, label, emb.weight, bias.weight, name="nce")
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """ref: common.py row_conv — lookahead row convolution over [b, t, d]."""
+    from ..ops import apply
+    from ..nn.layer.layers import Layer
+    import jax.numpy as _jnp
+
+    class _RowConv(Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter(
+                [future_context_size + 1, int(input.shape[-1])], attr=param_attr,
+                dtype=self._dtype)
+
+    lay = _RowConv()
+
+    def fn(a, w):
+        t = a.shape[1]
+        out = _jnp.zeros_like(a)
+        for k in range(future_context_size + 1):
+            seg = a[:, k:, :] if k else a
+            pad = _jnp.pad(seg, ((0, 0), (0, k), (0, 0)))[:, :t]
+            out = out + pad * w[k]
+        return out
+
+    out = apply(fn, input, lay.weight, name="row_conv")
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """ref: common.py sparse_embedding — the PS-backed embedding; in this
+    framework that tier is distributed.ps.DistributedEmbedding. Single-
+    process fallback: a dense Embedding of the same shape."""
+    from .. import nn as _nn
+    return _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                         weight_attr=param_attr)(input)
+
+
+class StaticRNN:
+    """ref: control_flow.py StaticRNN — explicit-unroll RNN builder. The
+    TPU answer is lax.scan via nn.RNN/jit; this builder exists for API
+    parity and unrolls eagerly."""
+
+    def __init__(self, name=None):
+        self._inputs = []
+        self._pre_states = []
+        self._outputs = []
+        self._built = False
+
+    def step(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            yield self
+
+        return ctx()
+
+    def step_input(self, x):
+        self._inputs.append(x)
+        return x
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0):
+        if init is None:
+            raise ValueError("StaticRNN.memory needs `init` in this build")
+        self._pre_states.append(init)
+        return init
+
+    def update_memory(self, mem, new):
+        self._updates = getattr(self, "_updates", [])
+        self._updates.append((mem, new))
+
+    def step_output(self, o):
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self):
+        raise NotImplementedError(
+            "StaticRNN full replay is not wired; use paddle.nn.RNN/GRU/"
+            "LSTM (lax.scan-compiled) — the TPU-native loop")
+
+
+# sequence_* family: the text.sequence implementations ARE the static.nn
+# surface (ref: static/nn/__init__.py re-exports from sequence_lod)
+from ..text.sequence import (sequence_pad, sequence_unpad,  # noqa: E402,F401
+                             sequence_mask, sequence_reverse,
+                             sequence_softmax, sequence_expand,
+                             sequence_pool, sequence_first_step,
+                             sequence_last_step, sequence_concat,
+                             sequence_slice, sequence_expand_as,
+                             sequence_reshape, sequence_scatter,
+                             sequence_enumerate, sequence_conv)
+from .compat import py_func  # noqa: E402,F401

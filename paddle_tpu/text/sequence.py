@@ -162,3 +162,166 @@ def sequence_pool(x, lengths, pool_type="sum"):
         return s / jnp.sqrt(denom)
 
     return apply(fn, xt, lt, name="sequence_pool")
+
+
+def sequence_concat(xs, lengths_list=None, name=None):
+    """ref: sequence_lod.py sequence_concat — per-row concat of padded
+    sequences by their true lengths. xs: list of [b, t, ...]; lengths:
+    matching list of [b] (None = full length)."""
+    parts = [_t(x) for x in xs]
+    b = parts[0].shape[0]
+    if lengths_list is None:
+        lengths_list = [None] * len(parts)
+    lens = []
+    for x, ln in zip(parts, lengths_list):
+        if ln is None:
+            lens.append(jnp.full((b,), x.shape[1], jnp.int32))
+        else:
+            lens.append(ln.data if isinstance(ln, Tensor)
+                        else jnp.asarray(ln, jnp.int32))
+    total = sum(int(x.shape[1]) for x in parts)
+
+    def fn(*arrs):
+        out = jnp.zeros((b, total) + arrs[0].shape[2:], arrs[0].dtype)
+        # scatter each sequence after the cumulated true lengths
+        offs = jnp.zeros((b,), jnp.int32)
+        for a, ln in zip(arrs, lens):
+            t = a.shape[1]
+            pos = offs[:, None] + jnp.arange(t)[None, :]
+            keep = jnp.arange(t)[None, :] < ln[:, None]
+            rows = jnp.arange(b)[:, None].repeat(t, 1)
+            out = out.at[rows, jnp.where(keep, pos, total - 1)].add(
+                jnp.where(keep.reshape(keep.shape + (1,) * (a.ndim - 2)),
+                          a, 0))
+            offs = offs + ln
+        return out
+
+    return apply(fn, *parts, name="sequence_concat")
+
+
+def sequence_slice(x, offset, length, name=None):
+    """ref: sequence_lod.py sequence_slice — per-row [offset, offset+len)
+    windows gathered into a [b, max_len, ...] padded block."""
+    xv = _t(x)
+    off = offset.data if isinstance(offset, Tensor) else jnp.asarray(offset)
+    ln = length.data if isinstance(length, Tensor) else jnp.asarray(length)
+    off = off.reshape(-1).astype(jnp.int32)
+    ln = ln.reshape(-1).astype(jnp.int32)
+    max_len = int(jax.device_get(ln.max())) if ln.size else 0
+
+    def fn(a):
+        b = a.shape[0]
+        pos = off[:, None] + jnp.arange(max_len)[None, :]
+        pos = jnp.clip(pos, 0, a.shape[1] - 1)
+        rows = jnp.arange(b)[:, None].repeat(max_len, 1)
+        out = a[rows, pos]
+        keep = jnp.arange(max_len)[None, :] < ln[:, None]
+        return jnp.where(keep.reshape(keep.shape + (1,) * (a.ndim - 2)),
+                         out, 0)
+
+    return apply(fn, xv, name="sequence_slice")
+
+
+def sequence_expand_as(x, y, y_lengths=None, name=None):
+    """ref: sequence_lod.py sequence_expand_as — expand each row of x to
+    y's per-row length (x rows are length-1 sequences here)."""
+    xv = _t(x)
+    t = int(_t(y).shape[1])
+    return apply(lambda a: jnp.repeat(a[:, :1], t, axis=1)
+                 if a.ndim > 1 else jnp.repeat(a[:, None], t, axis=1),
+                 xv, name="sequence_expand_as")
+
+
+def sequence_reshape(x, new_dim, name=None):
+    """ref: sequence_lod.py sequence_reshape — re-chunk the feature axis:
+    [b, t, d] -> [b, t*d//new_dim, new_dim]."""
+    xv = _t(x)
+    b, t, d = (int(s) for s in xv.shape)
+    if (t * d) % new_dim:
+        raise ValueError(
+            f"sequence_reshape: t*d = {t * d} not divisible by new_dim "
+            f"{new_dim}")
+    return apply(lambda a: a.reshape(b, (t * d) // new_dim, new_dim), xv,
+                 name="sequence_reshape")
+
+
+def sequence_scatter(x, index, updates, name=None):
+    """ref: sequence_lod.py sequence_scatter — add updates at per-row
+    time positions. x [b, t, ...]; index [b, k]; updates [b, k, ...]."""
+    xv = _t(x)
+    idx = index.data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(a, u):
+        b, k = idx.shape
+        rows = jnp.arange(b)[:, None].repeat(k, 1)
+        return a.at[rows, idx].add(u.astype(a.dtype))
+
+    return apply(fn, xv, _t(updates), name="sequence_scatter")
+
+
+def sequence_enumerate(x, win_size, pad_value=0, name=None):
+    """ref: sequence_lod.py sequence_enumerate — sliding windows of ids:
+    [b, t] -> [b, t, win_size], padded past the end."""
+    xv = _t(x)
+
+    def fn(a):
+        t = a.shape[1]
+        pos = jnp.arange(t)[:, None] + jnp.arange(win_size)[None, :]
+        valid = pos < t
+        pos = jnp.clip(pos, 0, t - 1)
+        win = a[:, pos]
+        return jnp.where(valid[None], win, pad_value)
+
+    return apply(fn, xv, name="sequence_enumerate")
+
+
+def sequence_conv(x, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, param_attr=None,
+                  bias_attr=None, act=None, name=None):
+    """ref: sequence_lod.py sequence_conv — context-window projection:
+    each step's window of `filter_size` rows (centered, zero-padded) is
+    flattened and linearly projected. Parameters live on a Layer so they
+    train like the reference's."""
+    from ..nn.layer.layers import Layer
+
+    xv = _t(x)
+    d = int(xv.shape[-1])
+
+    class _SeqConv(Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter(
+                [filter_size * d, num_filters], attr=param_attr,
+                dtype=self._dtype)
+            self.bias = None
+            if bias_attr is not False:
+                self.bias = self.create_parameter(
+                    [num_filters], attr=None, dtype=self._dtype,
+                    is_bias=True)
+
+    lay = _SeqConv()
+    start = (-(filter_size // 2) if padding_start is None
+             else padding_start)
+
+    def fn(a, w, *bb):
+        b, t = a.shape[0], a.shape[1]
+        cols = []
+        for k in range(filter_size):
+            shift = start + k
+            pos = jnp.arange(t) + shift
+            valid = (pos >= 0) & (pos < t)
+            pos = jnp.clip(pos, 0, t - 1)
+            seg = a[:, pos]
+            cols.append(jnp.where(valid[None, :, None], seg, 0.0))
+        ctx = jnp.concatenate(cols, axis=-1)          # [b, t, fs*d]
+        out = ctx @ w
+        if bb:
+            out = out + bb[0]
+        return out
+
+    args = [xv, lay.weight] + ([lay.bias] if lay.bias is not None else [])
+    out = apply(fn, *args, name="sequence_conv")
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out

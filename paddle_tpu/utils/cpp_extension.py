@@ -37,3 +37,37 @@ def load(name, sources, extra_cxx_cflags=None, extra_include_paths=None,
             print("cpp_extension:", " ".join(cmd))
         subprocess.run(cmd, check=True)
     return ctypes.CDLL(out)
+
+
+def CppExtension(sources, *args, **kwargs):
+    """ref: cpp_extension.py CppExtension — a setuptools.Extension
+    configured for paddle C++ ops; here a config dict consumed by
+    setup()/load() (the csrc g++ pipeline)."""
+    return {"sources": [str(s) for s in sources],
+            "include_dirs": kwargs.get("include_dirs", []),
+            "extra_compile_args": kwargs.get("extra_compile_args", []),
+            "kind": "cpp"}
+
+
+def CUDAExtension(*args, **kwargs):
+    raise RuntimeError(
+        "CUDAExtension is not available in a TPU/XLA build; write TPU "
+        "kernels in Pallas (paddle_tpu/ops/pallas) and host-side native "
+        "code as CppExtension")
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """ref: cpp_extension.py setup — build the extensions in place via
+    the same g++ pipeline as load(); returns the built library handles."""
+    exts = ext_modules if isinstance(ext_modules, (list, tuple)) \
+        else [ext_modules]
+    handles = []
+    for i, ext in enumerate(exts):
+        if ext is None:
+            continue
+        if not isinstance(ext, dict) or ext.get("kind") != "cpp":
+            raise TypeError("setup takes CppExtension(...) modules")
+        handles.append(load(f"{name or 'paddle_ext'}_{i}", ext["sources"],
+                            extra_cxx_cflags=ext["extra_compile_args"],
+                            extra_include_paths=ext["include_dirs"]))
+    return handles

@@ -93,6 +93,23 @@ class VOC2012(FakeData):
         return img, label
 
 
+
+def _discover(root, extensions, is_valid_file, loader):
+    """Shared DatasetFolder/ImageFolder discovery: default loader +
+    extension/validity filter (one copy — r5 review)."""
+    import os
+    exts = tuple(extensions) if extensions else (".npy", ".npz")
+    if loader is None:
+        from .. import image_load
+        loader = image_load
+
+    def ok(path):
+        return (is_valid_file(path) if is_valid_file
+                else path.lower().endswith(exts))
+
+    return exts, loader, ok
+
+
 class DatasetFolder(Dataset):
     """REAL local-directory loader (ref: vision/datasets/folder.py
     DatasetFolder): root/<class_x>/<file>.npy — classes from subdir
@@ -104,11 +121,8 @@ class DatasetFolder(Dataset):
         import os
         self.root = str(root)
         self.transform = transform
-        exts = tuple(extensions) if extensions else (".npy", ".npz")
-        if loader is None:
-            from .. import image_load
-            loader = image_load
-        self.loader = loader
+        exts, self.loader, ok = _discover(root, extensions, is_valid_file,
+                                          loader)
         classes = sorted(d for d in os.listdir(self.root)
                          if os.path.isdir(os.path.join(self.root, d)))
         if not classes:
@@ -121,9 +135,7 @@ class DatasetFolder(Dataset):
             cdir = os.path.join(self.root, c)
             for fn in sorted(os.listdir(cdir)):
                 path = os.path.join(cdir, fn)
-                ok = (is_valid_file(path) if is_valid_file
-                      else fn.lower().endswith(exts))
-                if ok:
+                if ok(path):
                     self.samples.append((path, self.class_to_idx[c]))
         if not self.samples:
             raise RuntimeError(
@@ -150,18 +162,13 @@ class ImageFolder(Dataset):
         import os
         self.root = str(root)
         self.transform = transform
-        exts = tuple(extensions) if extensions else (".npy", ".npz")
-        if loader is None:
-            from .. import image_load
-            loader = image_load
-        self.loader = loader
+        exts, self.loader, ok = _discover(root, extensions, is_valid_file,
+                                          loader)
         self.samples = []
         for dirpath, _dirs, files in sorted(os.walk(self.root)):
             for fn in sorted(files):
                 path = os.path.join(dirpath, fn)
-                ok = (is_valid_file(path) if is_valid_file
-                      else fn.lower().endswith(exts))
-                if ok:
+                if ok(path):
                     self.samples.append(path)
         if not self.samples:
             raise RuntimeError(

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Serve a LLaMA-family model: the deployment user journey.
+
+Covers the three serving tiers end to end:
+  1. paged-KV generation through LLMEngine (device-side decode loop:
+     the WHOLE generation is one compiled dispatch — BASELINE.md measured
+     30-38x over per-token dispatch on a real v5e);
+  2. int8 weight-only serving (the win arrives at 7B+, where decode is
+     weight-streaming-bound; at 350M it is ~8-15% slower — BASELINE.md);
+  3. checkpoint-scale loading: a LazyGuard (meta-init) model materializes
+     leaf-by-leaf straight to the serving dtype at engine construction,
+     so a 7B reaches a 16 GB chip as 13.5 GB bf16 / 6.7 GB int8 without
+     the 27 GB eager-f32 tree ever existing.
+
+Run anywhere (CPU smoke):  python examples/serve_llama.py
+On a TPU host the same code runs unchanged on the chip.
+
+ref journey: Paddle's inference deployment (AnalysisPredictor +
+fused_multi_transformer serving); the paged-KV engine is this
+framework's fused-decode tier.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=["tiny", "350m", "7b"],
+                    default="tiny", help="geometry (tiny = CPU smoke)")
+    ap.add_argument("--quant", choices=["none", "int8"], default="none")
+    ap.add_argument("--max_new_tokens", type=int, default=16)
+    args = ap.parse_args()
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference.serving import LLMEngine
+
+    geometries = {
+        "tiny": dict(cfg=LlamaConfig.tiny(), max_len=64, page=16, bs=2),
+        "350m": dict(cfg=LlamaConfig(vocab_size=32000, hidden_size=1024,
+                                     intermediate_size=2816,
+                                     num_hidden_layers=16,
+                                     num_attention_heads=16,
+                                     max_position_embeddings=2048),
+                     max_len=512, page=64, bs=4),
+        "7b": dict(cfg=LlamaConfig.llama_7b(), max_len=256, page=64, bs=1),
+    }
+    g = geometries[args.model]
+
+    paddle.seed(0)
+    if args.model == "7b":
+        # checkpoint scale: NEVER build eagerly — meta init + lazy
+        # materialization straight to the serving dtype
+        with paddle.LazyGuard():
+            model = LlamaForCausalLM(g["cfg"])
+        weight_dtype = "bfloat16"
+    else:
+        model = LlamaForCausalLM(g["cfg"])
+        weight_dtype = None
+
+    engine = LLMEngine(model, max_len=g["max_len"], page_size=g["page"],
+                       max_batch=g["bs"],
+                       quant=None if args.quant == "none" else args.quant,
+                       weight_dtype=weight_dtype)
+
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(0, g["cfg"].vocab_size,
+                          (g["bs"], 12)).astype(np.int64)
+    # device_loop=True: one lax.scan dispatch for the whole generation —
+    # the per-token host round trip (the latency killer through any
+    # networked accelerator) is paid ONCE per generation
+    out = engine.generate(prompts, max_new_tokens=args.max_new_tokens,
+                          device_loop=True)
+    print(f"model={args.model} quant={args.quant} "
+          f"prompt={prompts.shape} -> generated={out.shape}")
+    print("first sequence tail:", out[0, -args.max_new_tokens:].tolist())
+
+
+if __name__ == "__main__":
+    main()

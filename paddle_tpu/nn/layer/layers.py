@@ -136,6 +136,11 @@ class Layer:
             for d in (layers, buffers):
                 if d is not None:
                     d.pop(name, None)
+            # a prior plain assignment (e.g. `self.bias = None` before the
+            # conditional create_parameter) lives in __dict__ and would
+            # SHADOW the registry — __getattr__ only fires on lookup
+            # misses (r5: DeformConv2D's bias silently read back as None)
+            self.__dict__.pop(name, None)
             params[name] = value
         elif isinstance(value, Layer):
             if layers is None:
@@ -143,6 +148,7 @@ class Layer:
             for d in (params, buffers):
                 if d is not None:
                     d.pop(name, None)
+            self.__dict__.pop(name, None)
             layers[name] = value
         elif buffers is not None and name in buffers:
             buffers[name] = value

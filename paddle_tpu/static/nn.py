@@ -227,15 +227,15 @@ def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
                   padding=0, dilation=1, groups=1, deformable_groups=1,
                   im2col_step=1, param_attr=None, bias_attr=None,
                   name=None):
-    """ref: common.py deform_conv2d — deformable convolution: the kernel
-    samples at learned offset positions (bilinear). The gather-heavy
-    sampling tier is not built in the TPU port (same class of work as
-    the 3D sparse conv rulebook — BASELINE.md descope ledger); loud
-    error by convention."""
-    raise NotImplementedError(
-        "deform_conv2d: the deformable-sampling kernel tier is not built "
-        "in the TPU port (see BASELINE.md descope ledger); use conv2d or "
-        "implement offsets via nn.functional.grid_sample")
+    """ref: common.py deform_conv2d — builds a DeformConv2D layer (the
+    real bilinear-sampling implementation in vision/ops.py) and applies
+    it; mask=None gives the v1 (unmodulated) form."""
+    from ..vision.ops import DeformConv2D
+    layer = DeformConv2D(int(x.shape[1]), num_filters, filter_size,
+                         stride=stride, padding=padding, dilation=dilation,
+                         groups=groups, deformable_groups=deformable_groups,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+    return layer(x, offset, mask)
 
 
 def nce(input, label, num_total_classes, sample_weight=None,

@@ -1,0 +1,162 @@
+"""deform_conv2d (ref: python/paddle/vision/ops.py:741 + the CUDA
+deformable_conv kernels): bilinear-sampled taps vs a naive loop oracle;
+zero offsets + unit mask degenerate to plain conv; gradients flow through
+the offsets."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.ops import deform_conv2d, DeformConv2D
+
+
+def _oracle(x, off, w, b, sh, sw, ph, pw, dh, dw, dg, g, m=None):
+    N, Cin, H, W = x.shape
+    Cout, Cin_g, kh, kw = w.shape
+    K = kh * kw
+    Hout = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wout = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    out = np.zeros((N, Cout, Hout, Wout), np.float64)
+
+    def sample(n, c, y, x_):
+        y0, x0 = int(np.floor(y)), int(np.floor(x_))
+        fy, fx = y - y0, x_ - x0
+        v = 0.0
+        for (yy, xx, wt) in ((y0, x0, (1 - fy) * (1 - fx)),
+                             (y0, x0 + 1, (1 - fy) * fx),
+                             (y0 + 1, x0, fy * (1 - fx)),
+                             (y0 + 1, x0 + 1, fy * fx)):
+            if 0 <= yy < H and 0 <= xx < W:
+                v += x[n, c, yy, xx] * wt
+        return v
+
+    for n in range(N):
+        for o in range(Cout):
+            gi = o // (Cout // g)
+            for i in range(Hout):
+                for j in range(Wout):
+                    acc = 0.0
+                    for ci in range(Cin_g):
+                        c = gi * Cin_g + ci
+                        d = c // (Cin // dg)
+                        for u in range(kh):
+                            for v_ in range(kw):
+                                k = u * kw + v_
+                                oy = off[n, d * 2 * K + 2 * k, i, j]
+                                ox = off[n, d * 2 * K + 2 * k + 1, i, j]
+                                y = i * sh - ph + u * dh + oy
+                                x_ = j * sw - pw + v_ * dw + ox
+                                s = sample(n, c, y, x_)
+                                if m is not None:
+                                    s *= m[n, d * K + k, i, j]
+                                acc += s * w[o, ci, u, v_]
+                    out[n, o, i, j] = acc + (b[o] if b is not None else 0.0)
+    return out
+
+
+def _data(N=1, Cin=2, H=5, W=6, Cout=3, kh=3, kw=3, dg=1, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(N, Cin, H, W).astype(np.float32)
+    w = (rng.randn(Cout, Cin, kh, kw) * 0.2).astype(np.float32)
+    b = rng.randn(Cout).astype(np.float32)
+    return rng, x, w, b
+
+
+def test_matches_naive_oracle_v2():
+    rng, x, w, b = _data()
+    Hout = Wout = None
+    off = (rng.randn(1, 2 * 9, 3, 4) * 0.7).astype(np.float32)
+    m = rng.rand(1, 9, 3, 4).astype(np.float32)
+    out = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                        paddle.to_tensor(w), paddle.to_tensor(b),
+                        stride=1, padding=0, mask=paddle.to_tensor(m))
+    want = _oracle(x, off, w, b, 1, 1, 0, 0, 1, 1, 1, 1, m)
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-4)
+
+
+def test_matches_oracle_stride_pad_dilation():
+    rng, x, w, b = _data(H=7, W=7)
+    Hout = (7 + 2 * 1 - (2 * 2 + 1)) // 2 + 1
+    off = (rng.randn(1, 18, Hout, Hout) * 0.5).astype(np.float32)
+    out = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                        paddle.to_tensor(w), paddle.to_tensor(b),
+                        stride=2, padding=1, dilation=2)
+    want = _oracle(x, off, w, b, 2, 2, 1, 1, 2, 2, 1, 1)
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-4)
+
+
+def test_zero_offsets_equal_plain_conv():
+    import paddle_tpu.nn.functional as F
+    rng, x, w, b = _data(H=6, W=6)
+    off = np.zeros((1, 18, 4, 4), np.float32)
+    got = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                        paddle.to_tensor(w), paddle.to_tensor(b))
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                   paddle.to_tensor(b))
+    np.testing.assert_allclose(got.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_gradient_flows_through_offsets():
+    rng, x, w, b = _data(H=5, W=5)
+    off = paddle.to_tensor((rng.randn(1, 18, 3, 3) * 0.3)
+                           .astype(np.float32), stop_gradient=False)
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    out = deform_conv2d(xt, off, paddle.to_tensor(w), mask=None)
+    paddle.sum(out * out).backward()
+    assert off.grad is not None and np.abs(off.grad.numpy()).max() > 0
+    assert xt.grad is not None and np.abs(xt.grad.numpy()).max() > 0
+
+
+def test_layer_and_static_nn_entry():
+    paddle.seed(0)
+    layer = DeformConv2D(2, 4, 3, padding=1)
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(1, 2, 5, 5).astype(np.float32))
+    off = paddle.zeros([1, 18, 5, 5])
+    out = layer(x, off)
+    assert tuple(out.shape) == (1, 4, 5, 5)
+    m = paddle.ones([1, 9, 5, 5])
+    out2 = paddle.static.nn.deform_conv2d(x, off, m, 4, 3, padding=1)
+    assert tuple(out2.shape) == (1, 4, 5, 5)
+
+
+def test_deformable_groups_two():
+    rng, x, w, b = _data(Cin=4, seed=3)
+    w = (rng.randn(2, 4, 3, 3) * 0.2).astype(np.float32)
+    off = (rng.randn(1, 2 * 2 * 9, 3, 4) * 0.4).astype(np.float32)
+    out = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                        paddle.to_tensor(w), None, deformable_groups=2)
+    want = _oracle(x, off, w, None, 1, 1, 0, 0, 1, 1, 2, 1)
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-4)
+
+
+def test_bias_attr_honored():
+    """r5 review regression: bias_attr must reach create_parameter."""
+    from paddle_tpu.nn import ParamAttr
+    from paddle_tpu.nn.initializer import Constant
+    paddle.seed(1)
+    layer = DeformConv2D(2, 4, 3, bias_attr=ParamAttr(
+        initializer=Constant(1.5)))
+    np.testing.assert_allclose(np.asarray(layer.bias.data),
+                               np.full(4, 1.5, np.float32))
+    assert DeformConv2D(2, 4, 3, bias_attr=False).bias is None
+
+
+def test_layer_setattr_none_then_parameter():
+    """r5 root-cause regression: `self.attr = None` then assigning a
+    Parameter/sub-Layer must not leave the None shadowing the registry."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.nn.layer.layers import Layer
+
+    class L(Layer):
+        def __init__(self):
+            super().__init__()
+            self.bias = None
+            self.bias = self.create_parameter([3], is_bias=True)
+            self.sub = None
+            self.sub = nn.Linear(2, 2)
+
+    l = L()
+    assert l.bias is not None and tuple(l.bias.shape) == (3,)
+    assert "bias" in dict(l.named_parameters())
+    assert l.sub is not None and isinstance(l.sub, nn.Linear)

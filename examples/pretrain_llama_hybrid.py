@@ -81,9 +81,8 @@ def main():
     args = ap.parse_args()
 
     if args.aot_memory:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.devices)
+        from paddle_tpu.jax_compat import set_cpu_device_count
+        set_cpu_device_count(args.devices)
         ma = aot_memory_report(args.aot_memory)
         r = RECIPES[args.aot_memory]
         print(f"{args.aot_memory} on {r['target']}: mesh={r['mesh']}")
@@ -94,9 +93,10 @@ def main():
     import jax
     if args.cpu:
         # pin BEFORE any backend query (a dead TPU tunnel makes
-        # jax.default_backend() hang, not error)
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.devices)
+        # jax.default_backend() hang, not error); jax_compat handles the
+        # 0.4.x stack where jax_num_cpu_devices doesn't exist
+        from paddle_tpu.jax_compat import set_cpu_device_count
+        set_cpu_device_count(args.devices)
 
     import paddle_tpu as paddle
     from paddle_tpu.distributed import fleet

@@ -344,3 +344,20 @@ def _get_phi_kernel_name(op_name):
     """ref: inference/_get_phi_kernel_name — op -> kernel name mapping;
     the registry IS name-keyed here."""
     return op_name
+
+
+# serving engines are exported lazily: paddle_tpu.inference is importable
+# without pulling the LLaMA stack until an engine is actually requested
+_SERVING_EXPORTS = {
+    "LLMEngine": "serving", "PageAllocator": "serving",
+    "EngineFullError": "serving",
+    "ContinuousBatchingEngine": "scheduler", "PrefixCache": "scheduler",
+}
+
+
+def __getattr__(name):
+    mod = _SERVING_EXPORTS.get(name)
+    if mod is not None:
+        import importlib
+        return getattr(importlib.import_module(f".{mod}", __name__), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

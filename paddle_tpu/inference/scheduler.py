@@ -1,0 +1,647 @@
+"""Continuous-batching scheduler over the paged-KV serving engine.
+
+LLMEngine.generate() is a static-batch API: equal-length prompts, the
+batch frozen for the whole call, a sequence that hits EOS squatting on
+its slot and pages until every other sequence finishes. This module adds
+the scheduling layer the north star needs (PAPERS.md ragged paged
+attention supplies the kernel substrate; MPK attacks the same gap from
+the compiler side): request-at-a-time serving over the same pools.
+
+  ContinuousBatchingEngine(model, ...).add_request(ids, ...) -> uid
+  .step()          one engine iteration (admit / prefill chunk / decode)
+  .drain()         run until idle, return {uid: output}
+  .generate_many() submit-and-drain convenience (greedy outputs are
+                   byte-identical to one-at-a-time LLMEngine.generate())
+
+Scheduling model:
+  - max_batch SLOTS. A request is admitted into the lowest free slot
+    once its KV pages fit, prefills its prompt in fixed-size CHUNKS
+    (long prompts interleave with in-flight decodes instead of stalling
+    them), then joins the decode batch. Each sequence retires at ITS OWN
+    EOS/budget and its slot + pages free immediately for the queue.
+  - the decode step stays a handful of compiled programs: one per SLOT
+    BUCKET (power-of-two widths), each taking a slot-active mask that
+    the paged-attention kernel uses to skip retired slots' compute and
+    page DMA. Chunked prefill is ONE more compiled program.
+  - prefix cache: full prompt pages are content-addressed (a chain hash
+    of page-sized token chunks); a new request sharing a cached prefix
+    takes refcounted read-only references instead of re-prefilling, and
+    a cached page covering the request's divergence point is shared too
+    and COPY-ON-WRITten at the first divergent write. Cache-held pages
+    evict LRU under pool pressure.
+
+Numerics: chunk-prefill attention gathers the sequence's pages and
+masks causally, so a chunk attends exactly the same values a dense
+prefill would (on CPU/f32 bitwise so — the greedy-equivalence tests
+assert byte identity with generate()).
+"""
+import collections
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops.pallas.paged_attention import expand_kv_heads, paged_attention
+from .serving import LLMEngine, EngineFullError, _rms, _mm
+
+QUEUED, PREFILL, DECODE, DONE, FAILED = \
+    "queued", "prefill", "decode", "done", "failed"
+
+
+class Request:
+    """One in-flight generation request (host-side bookkeeping only)."""
+
+    __slots__ = ("uid", "ids", "t0", "max_new_tokens", "eos_token_id",
+                 "state", "slot", "pages", "shared_idx", "cow_reserve",
+                 "filled", "resume", "tok", "out", "result",
+                 "pages_shared")
+
+    def __init__(self, uid, ids, max_new_tokens, eos_token_id):
+        self.uid = uid
+        self.ids = ids                  # np.int64 [t0]
+        self.t0 = int(ids.size)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.state = QUEUED
+        self.slot = None
+        self.pages = []                 # page ids, one per table index
+        self.shared_idx = set()         # table indices that are READ-ONLY
+        self.cow_reserve = None         # page reserved for the one
+        #                                 possible copy-on-write
+        self.filled = 0                 # prompt tokens already in cache
+        self.resume = 0                 # first position prefill processes
+        self.tok = None                 # next token id to feed
+        self.out = []                   # generated token ids
+        self.result = None              # np.int64 [t0 + n_generated]
+        self.pages_shared = 0
+
+
+class PrefixCache:
+    """Content-addressed read-only KV pages, LRU-evicted under pressure.
+
+    Full prompt pages are keyed by a CHAIN key — nested tuples
+    (parent_key, page_tokens) — so a page only matches when its entire
+    prompt prefix matches, never just the page's own tokens. A secondary
+    index maps every strict prefix of a cached page's tokens to that
+    page, which lets a request whose prompt DIVERGES MID-PAGE share the
+    page read-only (the engine copy-on-writes it at the first divergent
+    write). The cache holds its own allocator reference per page
+    (refcount), so cached pages survive their creator's retirement and
+    free only on eviction.
+    """
+
+    def __init__(self, page_size):
+        self.p = page_size
+        self._entries = collections.OrderedDict()   # chain_key -> page
+        self._children = {}      # chain_key -> {page: tokens tuple}
+        self._by_page = {}       # page -> chain_key
+        self.hits = 0            # pages served from cache (counted by
+        self.misses = 0          # the scheduler at ADMISSION, so failed
+        #                          admission retries don't inflate them)
+
+    def __len__(self):
+        return len(self._entries)
+
+    def match(self, ids):
+        """Longest cached cover of a prefix of `ids` (1-D np array).
+        Returns (pages, covered): `pages` to install at table indices
+        0..len-1, `covered` counted in tokens. The LAST page may cover
+        tokens through the end of the prompt even when the prompt ends
+        mid-page (partial-index hit) — the scheduler re-runs the final
+        token and copy-on-writes that page before any write."""
+        p = self.p
+        key = ()
+        pages = []
+        j = 0
+        while (j + 1) * p <= ids.size:
+            k2 = (key, tuple(int(t) for t in ids[j * p:(j + 1) * p]))
+            page = self._entries.get(k2)
+            if page is None:
+                break
+            self._entries.move_to_end(k2)
+            pages.append(page)
+            key = k2
+            j += 1
+        covered = j * p
+        rem = tuple(int(t) for t in ids[j * p:])
+        if rem and len(rem) < p:
+            # mid-page divergence: any cached child page whose token
+            # chunk STARTS WITH the remaining prompt can be shared (and
+            # will be copy-on-written). Children of a chain node are the
+            # observed continuations — typically a handful.
+            for page, tokens in self._children.get(key, {}).items():
+                if tokens[:len(rem)] == rem:
+                    owner = self._by_page.get(page)
+                    if owner is not None:
+                        self._entries.move_to_end(owner)
+                    pages.append(page)
+                    covered = ids.size
+                    break
+        return pages, covered
+
+    def insert(self, parent_key, tokens, page, allocator):
+        """Register `page` as the cached KV for `tokens` under
+        `parent_key`; the cache takes its own allocator reference.
+        Returns the page's chain key (parent for the next page). No-op
+        (returning the key) when an entry already exists."""
+        toks = tuple(int(t) for t in tokens)
+        key = (parent_key, toks)
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return key
+        allocator.share(page)
+        self._entries[key] = page
+        self._children.setdefault(parent_key, {})[page] = toks
+        self._by_page[page] = key
+        return key
+
+    def chain_key(self, parent_key, tokens):
+        return (parent_key, tuple(int(t) for t in tokens))
+
+    def evict(self, n_pages, allocator, protect=()):
+        """Free up to `n_pages` cache-only pages (refcount 1), oldest
+        first, skipping `protect`. Returns the number freed."""
+        freed = 0
+        for key in list(self._entries):
+            if freed >= n_pages:
+                break
+            page = self._entries[key]
+            if page in protect or allocator.refcount(page) != 1:
+                continue
+            self._drop(key, page)
+            allocator.free([page])
+            freed += 1
+        return freed
+
+    def clear(self, allocator=None):
+        if allocator is not None:
+            for key, page in list(self._entries.items()):
+                if allocator.refcount(page) > 0:
+                    allocator.free([page])
+        self._entries.clear()
+        self._children.clear()
+        self._by_page.clear()
+
+    def _drop(self, key, page):
+        del self._entries[key]
+        self._by_page.pop(page, None)
+        kids = self._children.get(key[0])
+        if kids is not None:
+            kids.pop(page, None)
+            if not kids:
+                del self._children[key[0]]
+
+
+class ContinuousBatchingEngine(LLMEngine):
+    """Request-at-a-time serving over the paged-KV engine.
+
+    Extra knobs on top of LLMEngine:
+      prefill_chunk: prompt tokens processed per prefill step (default
+        page_size). Long prompts spread over several steps, interleaved
+        with decode steps so in-flight decodes never stall for a whole
+        prompt.
+      slot_buckets: compiled decode widths (default powers of two up to
+        max_batch). A step runs at the smallest bucket covering the
+        highest live slot.
+      prefix_cache: enable content-addressed prompt-page sharing.
+      do_sample/temperature/top_k/top_p/seed: engine-level sampling for
+        step(); greedy (default) is deterministic per request and
+        byte-equivalent to LLMEngine.generate(). Sampled mode draws from
+        one engine-wide stream, so tokens depend on scheduling order.
+    """
+
+    def __init__(self, model, max_len=1024, page_size=128, max_batch=8,
+                 prefill_chunk=None, slot_buckets=None, prefix_cache=True,
+                 do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+                 seed=0, **kw):
+        super().__init__(model, max_len=max_len, page_size=page_size,
+                         max_batch=max_batch, **kw)
+        self.prefill_chunk = int(prefill_chunk or page_size)
+        if slot_buckets is None:
+            slot_buckets = []
+            w = 1
+            while w < max_batch:
+                slot_buckets.append(w)
+                w *= 2
+        self._slot_buckets = tuple(sorted(
+            {min(int(w), max_batch) for w in slot_buckets} | {max_batch}))
+        self._sampling = (bool(do_sample), float(temperature), int(top_k),
+                          float(top_p))
+        self._key = jax.random.key(seed)
+        self._prefix = PrefixCache(page_size) if prefix_cache else None
+
+        self._queue = collections.deque()
+        self._requests = {}
+        self._slots = [None] * max_batch
+        self._tables_np = np.zeros((max_batch, self.max_pages_per_seq),
+                                   np.int32)
+        self._lens_np = np.zeros(max_batch, np.int32)
+        self._tok_np = np.zeros(max_batch, np.int64)
+        self._next_uid = 0
+        self._prefer_decode = False
+        self._cb_step_fns = {}
+        self._cb_prefill_fn = None
+        self._copy_fn = None
+
+        # observability (tests + the serving bench assert on these)
+        self.steps = 0
+        self.decode_steps = 0
+        self.prefill_steps = 0
+        self.admissions = 0
+        self.slot_reuses = 0
+        self.cow_copies = 0
+        self._slot_used = [False] * max_batch
+
+    # -- public ------------------------------------------------------------
+    def add_request(self, ids, max_new_tokens=32, eos_token_id=None):
+        """Queue one prompt (1-D int sequence). Returns a request uid."""
+        ids = np.asarray(ids, np.int64).ravel()
+        if ids.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if ids.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt length {ids.size} + max_new_tokens "
+                f"{max_new_tokens} = {ids.size + max_new_tokens} exceeds "
+                f"this engine's max_len={self.max_len}")
+        r = Request(self._next_uid, ids, max_new_tokens, eos_token_id)
+        self._next_uid += 1
+        self._requests[r.uid] = r
+        self._queue.append(r)
+        return r.uid
+
+    def step(self):
+        """One engine iteration: admit what fits, then run ONE compiled
+        program — a prefill chunk or a decode step (alternating when
+        both have work, so long prompts don't stall live decodes).
+        Returns False when there is nothing to do."""
+        self._admit()
+        prefills = [r for r in self._slots if r and r.state == PREFILL]
+        decodes = [r for r in self._slots if r and r.state == DECODE]
+        if not prefills and not decodes:
+            if self._queue:
+                # nothing admitted AND nothing running: the queue head
+                # cannot fit even with every slot idle — a real capacity
+                # bug, not back-pressure
+                raise EngineFullError(
+                    f"request {self._queue[0].uid} cannot be admitted "
+                    "into an idle engine (page pool pinned?)")
+            return False
+        self.steps += 1
+        try:
+            if prefills and (not decodes or not self._prefer_decode):
+                self._prefill_step(prefills[0])
+                self.prefill_steps += 1
+                self._prefer_decode = True
+            else:
+                self._decode_step(decodes)
+                self.decode_steps += 1
+                self._prefer_decode = False
+        except Exception:
+            self._abort_in_flight()
+            raise
+        return True
+
+    def drain(self):
+        """Run until every queued/in-flight request retires. Returns
+        {uid: output} for requests completed by this call."""
+        finished = {}
+        before = {u for u, r in self._requests.items() if r.state == DONE}
+        while self.step():
+            pass
+        for uid, r in self._requests.items():
+            if r.state == DONE and uid not in before:
+                finished[uid] = r.result
+        return finished
+
+    def result(self, uid):
+        """Output array for a finished request: [prompt + generated],
+        trimmed at the request's own EOS (inclusive)."""
+        r = self._requests[uid]
+        if r.state != DONE:
+            raise RuntimeError(f"request {uid} is {r.state}, not done")
+        return r.result
+
+    def generate_many(self, prompts, max_new_tokens=32, eos_token_id=None):
+        """Submit a list of (ragged) prompts and drain. Returns a list of
+        1-D arrays in submission order. Greedy outputs are byte-identical
+        to one-at-a-time LLMEngine.generate() calls."""
+        if not isinstance(max_new_tokens, (list, tuple)):
+            max_new_tokens = [max_new_tokens] * len(prompts)
+        if len(max_new_tokens) != len(prompts):
+            raise ValueError(
+                f"max_new_tokens list has {len(max_new_tokens)} entries "
+                f"for {len(prompts)} prompts")
+        uids = [self.add_request(p, n, eos_token_id)
+                for p, n in zip(prompts, max_new_tokens)]
+        self.drain()
+        return [self.result(u) for u in uids]
+
+    # -- admission ---------------------------------------------------------
+    def _pages_needed(self, t0, max_new_tokens):
+        # cache high-water: positions 0..t0+mnt-2 written, attention at
+        # the last step reads lens+1 = t0+mnt-1 positions
+        return -(-max(t0, t0 + max_new_tokens - 1) // self.page_size)
+
+    def _admit(self):
+        while self._queue:
+            slot = next((i for i, s in enumerate(self._slots) if s is None),
+                        None)
+            if slot is None:
+                return
+            r = self._queue[0]
+            shared, covered = ([], 0) if self._prefix is None else \
+                self._prefix.match(r.ids)
+            resume = min(covered, r.t0 - 1)
+            need = self._pages_needed(r.t0, r.max_new_tokens)
+            n_shared = len(shared)
+            cow = 1 if n_shared and resume // self.page_size < n_shared \
+                else 0
+            fresh = need - n_shared + cow
+            if fresh > self.allocator.available and self._prefix:
+                self._prefix.evict(fresh - self.allocator.available,
+                                   self.allocator, protect=set(shared))
+            if fresh > self.allocator.available and shared:
+                # sharing can cost MORE than a cold prefill in a tight
+                # pool (the CoW reserve, plus matched pages protected
+                # from eviction) — fall back to an unshared admission
+                # before concluding the request doesn't fit
+                shared, covered, resume, cow = [], 0, 0, 0
+                n_shared = 0
+                fresh = need
+                if fresh > self.allocator.available and self._prefix:
+                    self._prefix.evict(fresh - self.allocator.available,
+                                       self.allocator)
+            if fresh > self.allocator.available:
+                return                       # wait for retirements (FIFO)
+            self._queue.popleft()
+            if self._prefix is not None:
+                if shared:
+                    self._prefix.hits += len(shared)
+                else:
+                    self._prefix.misses += 1
+            pages = [self.allocator.share(pg) for pg in shared]
+            pages += [self.allocator.alloc()
+                      for _ in range(need - n_shared)]
+            r.cow_reserve = self.allocator.alloc() if cow else None
+            r.pages = pages
+            r.shared_idx = set(range(n_shared))
+            r.pages_shared = n_shared
+            r.slot = slot
+            r.resume = r.filled = resume
+            r.state = PREFILL
+            self._slots[slot] = r
+            self._tables_np[slot] = 0
+            self._tables_np[slot, :len(pages)] = pages
+            self._lens_np[slot] = 0
+            self.admissions += 1
+            if self._slot_used[slot]:
+                self.slot_reuses += 1
+            self._slot_used[slot] = True
+
+    def _reclaim_pages(self, n):
+        """generate()'s pool-pressure hook: idle prefix-cache pages are
+        reclaimable."""
+        if self._prefix is None:
+            return 0
+        return self._prefix.evict(n, self.allocator)
+
+    # -- copy-on-write -----------------------------------------------------
+    def _build_copy(self):
+        def copy(kps, vps, src, dst):
+            return ([k.at[dst].set(k[src]) for k in kps],
+                    [v.at[dst].set(v[src]) for v in vps])
+
+        return jax.jit(copy, donate_argnums=(0, 1))
+
+    def _cow(self, r, idx):
+        """First divergent write into a shared page: copy its KV into
+        the request's reserved page and swap the table entry; the shared
+        original stays read-only for its other holders."""
+        old = int(self._tables_np[r.slot, idx])
+        new = r.cow_reserve
+        assert new is not None, "copy-on-write without a reserved page"
+        r.cow_reserve = None
+        if self._copy_fn is None:
+            self._copy_fn = self._build_copy()
+        self.k_pages, self.v_pages = self._copy_fn(
+            self.k_pages, self.v_pages, jnp.int32(old), jnp.int32(new))
+        self._tables_np[r.slot, idx] = new
+        r.pages[idx] = new
+        r.shared_idx.discard(idx)
+        self.allocator.free([old])           # drop r's reference only
+        self.cow_copies += 1
+
+    def _make_writable(self, r, lo_pos, hi_pos):
+        """Copy-on-write every shared page overlapping write positions
+        [lo_pos, hi_pos)."""
+        p = self.page_size
+        for idx in range(lo_pos // p, (hi_pos - 1) // p + 1):
+            if idx in r.shared_idx:
+                self._cow(r, idx)
+
+    # -- prefill -----------------------------------------------------------
+    def _build_cb_prefill(self, chunk):
+        """One prompt chunk of ONE sequence: write its KV into the
+        sequence's pages, then attend over the sequence's whole gathered
+        context (shared prefix pages included) with causal masking.
+        Static shape: [1, chunk]; t_start/t_end ride as traced scalars
+        so every chunk of every prompt reuses ONE compiled program."""
+        p = self.page_size
+        mp = self.max_pages_per_seq
+
+        def prefill(W, ids, k_pages_all, v_pages_all, table, t_start,
+                    t_end):
+            h = jnp.take(W["emb"], ids, axis=0).astype(self.kv_dtype)
+            pos = t_start + jnp.arange(chunk, dtype=jnp.int32)
+            pos_ids = pos[None, :]
+            oob = jnp.int32(self.n_pages * p)
+            new_k, new_v = [], []
+            for li, wset in enumerate(W["layers"]):
+                q, k, v = self._layer_qkv(W, wset, h, pos_ids)
+                slots = table[0, pos // p] * p + pos % p
+                # padded tail positions (>= the true prompt end) write
+                # NOTHING — scatter-drop, so cached pages stay garbage-
+                # free and shared pages are never touched
+                slots = jnp.where(pos < t_end, slots, oob)
+                kp = k_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+                vp = v_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+                kp = kp.at[slots].set(k[0].astype(self.kv_dtype),
+                                      mode="drop")
+                vp = vp.at[slots].set(v[0].astype(self.kv_dtype),
+                                      mode="drop")
+                kp = kp.reshape(self.n_pages, p, self.nh_kv, self.hd)
+                vp = vp.reshape(self.n_pages, p, self.nh_kv, self.hd)
+                new_k.append(kp)
+                new_v.append(vp)
+                # gather this sequence's full context back out of the
+                # pool: [mp*p, h_kv, d]; keys past the causal horizon
+                # carry finite garbage and mask to exact zero weight
+                ck = kp[table[0]].reshape(mp * p, self.nh_kv, self.hd)
+                cv = vp[table[0]].reshape(mp * p, self.nh_kv, self.hd)
+                ck = expand_kv_heads(ck, self.nh)
+                cv = expand_kv_heads(cv, self.nh)
+                logits = jnp.einsum("qhd,khd->hqk", q[0], ck) \
+                    / math.sqrt(self.hd)
+                kpos = jnp.arange(mp * p)[None, None, :]
+                qpos = pos[None, :, None]
+                logits = jnp.where(kpos <= qpos, logits, -1e30)
+                w = jax.nn.softmax(logits.astype(jnp.float32),
+                                   -1).astype(q.dtype)
+                attn = jnp.einsum("hqk,khd->qhd", w, cv)[None]
+                h = self._layer_tail(W, wset, h, attn)
+            h = _rms(h, W["norm"], W["eps"])
+            last = jnp.clip(t_end - 1 - t_start, 0, chunk - 1)
+            h_last = jax.lax.dynamic_index_in_dim(h, last, axis=1)
+            logits = _mm(h_last, W["head"], self.interpret)
+            return logits[:, 0], new_k, new_v
+
+        return jax.jit(prefill, donate_argnums=(2, 3))
+
+    def _prefill_step(self, r):
+        chunk = self.prefill_chunk
+        start = r.filled
+        end = min(start + chunk, r.t0)
+        self._make_writable(r, start, end)
+        ids_chunk = np.zeros((1, chunk), np.int64)
+        ids_chunk[0, :end - start] = r.ids[start:end]
+        if self._cb_prefill_fn is None:
+            self._cb_prefill_fn = self._build_cb_prefill(chunk)
+        logits, self.k_pages, self.v_pages = self._cb_prefill_fn(
+            self.weights, jnp.asarray(ids_chunk), self.k_pages,
+            self.v_pages, jnp.asarray(self._tables_np[r.slot:r.slot + 1]),
+            jnp.int32(start), jnp.int32(r.t0))
+        r.filled = end
+        if end < r.t0:
+            return
+        # prompt complete: publish full prompt pages to the prefix cache
+        # (before the first decode write, so concurrent requests share),
+        # then sample the first token from the final chunk's logits
+        if self._prefix is not None:
+            key = ()
+            p = self.page_size
+            for j in range(r.t0 // p):
+                key = self._prefix.insert(key, r.ids[j * p:(j + 1) * p],
+                                          r.pages[j], self.allocator)
+        tok = self._sample_tokens(logits)[0]
+        self._lens_np[r.slot] = r.t0
+        r.state = DECODE
+        self._push_token(r, tok)
+
+    # -- decode ------------------------------------------------------------
+    def _build_cb_step(self, w):
+        """Decode step at slot-bucket width w: one token for every slot,
+        inactive slots write nothing (scatter-drop) and skip attention
+        compute/DMA via the kernel's active mask."""
+        p = self.page_size
+
+        def step(W, tok, k_pages_all, v_pages_all, tables, lens, active):
+            h = jnp.take(W["emb"], tok[:, None], axis=0).astype(
+                self.kv_dtype)
+            pos_ids = lens[:, None]
+            oob = jnp.int32(self.n_pages * p)
+            new_k, new_v = [], []
+            for li, wset in enumerate(W["layers"]):
+                q, k, v = self._layer_qkv(W, wset, h, pos_ids)
+                slots = (tables[jnp.arange(w), lens // p] * p + lens % p)
+                slots = jnp.where(active, slots, oob)
+                kp = k_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+                vp = v_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+                kp = kp.at[slots].set(k[:, 0].astype(self.kv_dtype),
+                                      mode="drop")
+                vp = vp.at[slots].set(v[:, 0].astype(self.kv_dtype),
+                                      mode="drop")
+                kp = kp.reshape(self.n_pages, p, self.nh_kv, self.hd)
+                vp = vp.reshape(self.n_pages, p, self.nh_kv, self.hd)
+                new_k.append(kp)
+                new_v.append(vp)
+                attn = paged_attention(
+                    q[:, 0], kp, vp, tables,
+                    jnp.where(active, lens + 1, 0),
+                    interpret=self.interpret,
+                    active=active.astype(jnp.int32))
+                h = self._layer_tail(W, wset, h, attn[:, None])
+            h = _rms(h, W["norm"], W["eps"])
+            logits = _mm(h, W["head"], self.interpret)
+            return logits[:, 0], new_k, new_v
+
+        return jax.jit(step, donate_argnums=(2, 3))
+
+    def _decode_step(self, decodes):
+        p = self.page_size
+        for r in decodes:
+            # the token fed this step writes KV at position lens
+            pos = int(self._lens_np[r.slot])
+            self._make_writable(r, pos, pos + 1)
+            self._tok_np[r.slot] = r.tok
+        w = next(b for b in self._slot_buckets
+                 if b > max(r.slot for r in decodes))
+        active = np.zeros(w, bool)
+        for r in decodes:
+            if r.slot < w:
+                active[r.slot] = True
+        fn = self._cb_step_fns.get(w)
+        if fn is None:
+            fn = self._build_cb_step(w)
+            self._cb_step_fns[w] = fn
+        logits, self.k_pages, self.v_pages = fn(
+            self.weights, jnp.asarray(self._tok_np[:w]), self.k_pages,
+            self.v_pages, jnp.asarray(self._tables_np[:w]),
+            jnp.asarray(self._lens_np[:w]), jnp.asarray(active))
+        toks = self._sample_tokens(logits)
+        for r in decodes:
+            self._lens_np[r.slot] += 1
+            self._push_token(r, toks[r.slot])
+
+    def _sample_tokens(self, logits):
+        from ..models.generation import _sample
+        do_sample, temperature, top_k, top_p = self._sampling
+        self._key, sub = jax.random.split(self._key)
+        return np.asarray(_sample(logits, sub, do_sample, temperature,
+                                  top_k, top_p))
+
+    def _push_token(self, r, tok):
+        tok = int(tok)
+        r.out.append(tok)
+        r.tok = tok
+        if (r.eos_token_id is not None and tok == r.eos_token_id) or \
+                len(r.out) >= r.max_new_tokens:
+            self._retire(r)
+
+    # -- retirement / failure ----------------------------------------------
+    def _retire(self, r):
+        r.result = np.concatenate([r.ids,
+                                   np.asarray(r.out, np.int64)])
+        r.state = DONE
+        self._slots[r.slot] = None
+        self.allocator.free(r.pages)
+        if r.cow_reserve is not None:
+            self.allocator.free([r.cow_reserve])
+            r.cow_reserve = None
+        r.pages = []
+        r.shared_idx = set()
+        r.slot = None
+
+    def _abort_in_flight(self):
+        """A donated-buffer call died mid-flight: the pools are gone and
+        with them every in-flight sequence's KV and the prefix cache.
+        Rebuild empty; queued (not yet admitted) requests survive."""
+        self._reset_kv()
+
+    def _reset_kv(self):
+        """Any pool rebuild (including one triggered by an inherited
+        generate() call failing) invalidates every in-flight sequence's
+        KV AND the content-addressed cache — the fresh allocator will
+        re-issue the cached page ids, so stale entries would alias other
+        requests' pages."""
+        for i, r in enumerate(getattr(self, "_slots", [])):
+            if r is not None:
+                r.state = FAILED
+                self._slots[i] = None
+        prefix = getattr(self, "_prefix", None)
+        if prefix is not None:
+            prefix.clear()                   # allocator is reset below
+        super()._reset_kv()

@@ -34,21 +34,64 @@ from ..ops.pallas.paged_attention import (expand_kv_heads,
 from ..ops.pallas.quantized_matmul import quantized_matmul, quantize_weights
 
 
+class EngineFullError(RuntimeError):
+    """A request cannot be served right now: the KV page pool (or the
+    slot budget) is exhausted. Callers that hold a queue (the
+    continuous-batching scheduler) treat this as "wait for retirements";
+    a direct generate() call surfaces it with the sizes that collided."""
+
+
 class PageAllocator:
-    """Free-list page allocator (the serving engine's KV memory manager)."""
+    """Free-list page allocator with refcounts (the serving engine's KV
+    memory manager).
+
+    Refcounts exist for prefix caching: a page holding a shared prompt
+    prefix is referenced by several sequences at once (plus the prefix
+    cache itself) and must return to the free list only when the LAST
+    reference drops. alloc() hands out a page at refcount 1; share()
+    takes an extra reference; free() drops one reference per page and
+    recycles at zero. Double-frees and shares of free pages raise
+    instead of corrupting the free list.
+    """
 
     def __init__(self, n_pages):
         self.n_pages = n_pages
         self._free = list(range(n_pages - 1, -1, -1))
+        self._ref = [0] * n_pages
+        self.total_allocs = 0   # fresh pages handed out (prefix-cache
+        #                         tests assert shared prefixes shrink it)
 
     def alloc(self):
         if not self._free:
-            raise RuntimeError("KV page pool exhausted")
-        return self._free.pop()
+            raise EngineFullError(
+                f"KV page pool exhausted: all {self.n_pages} pages are "
+                "in use (retire sequences or build the engine with a "
+                "larger max_batch*max_len budget)")
+        p = self._free.pop()
+        self._ref[p] = 1
+        self.total_allocs += 1
+        return p
+
+    def share(self, page):
+        """Take an additional reference on an ALLOCATED page (prefix
+        sharing). Returns the page id for chaining."""
+        if self._ref[page] <= 0:
+            raise RuntimeError(f"share() of free page {page}")
+        self._ref[page] += 1
+        return page
+
+    def refcount(self, page):
+        return self._ref[page]
 
     def free(self, pages):
+        """Drop one reference per listed page; pages reaching zero
+        return to the free list."""
         for p in pages:
-            self._free.append(p)
+            if self._ref[p] <= 0:
+                raise RuntimeError(f"double free of page {p}")
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                self._free.append(p)
 
     @property
     def available(self):
@@ -376,6 +419,33 @@ class LLMEngine:
 
         return jax.jit(loop, donate_argnums=(2, 3))
 
+    def _reclaim_pages(self, n):
+        """Hook: free up to n idle pages (no-op here; the continuous-
+        batching engine overrides it to evict prefix-cache pages)."""
+        return 0
+
+    @staticmethod
+    def _finish_eos(full, t0, eos_token_id):
+        """Per-row EOS finishing: each row keeps its generated tokens up
+        to and including ITS OWN first EOS; later columns are masked to
+        eos_token_id, and the array is trimmed to the longest surviving
+        row (a row that never emits EOS keeps its full budget). Shared by
+        the host loop and the device (lax.scan) loop so both modes agree
+        token-for-token."""
+        if eos_token_id is None:
+            return full
+        gen = full[:, t0:]
+        n = gen.shape[1]
+        if n == 0:
+            return full
+        keep = []
+        for row in gen:
+            hit = np.flatnonzero(row == eos_token_id)
+            keep.append(int(hit[0]) + 1 if hit.size else n)
+        for i, k in enumerate(keep):
+            gen[i, k:] = eos_token_id
+        return full[:, :t0 + max(keep)]
+
     def _reset_kv(self):
         """Fresh pools + allocator — a failed call's donated buffers are
         gone, and so is every in-flight sequence's cache."""
@@ -402,8 +472,16 @@ class LLMEngine:
         ids = np.asarray(input_ids.numpy() if isinstance(input_ids, Tensor)
                          else input_ids)
         b_real, t0 = ids.shape
-        assert b_real <= self.max_batch
-        assert t0 + max_new_tokens <= self.max_len
+        if b_real > self.max_batch:
+            raise ValueError(
+                f"batch of {b_real} prompts exceeds this engine's "
+                f"max_batch={self.max_batch}; split the batch or build "
+                "the engine with a larger max_batch")
+        if t0 + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt length {t0} + max_new_tokens {max_new_tokens} "
+                f"= {t0 + max_new_tokens} exceeds this engine's "
+                f"max_len={self.max_len}")
         # pad the batch up to the nearest bucket (compile reuse); padded
         # rows replay row 0 and are dropped before returning
         b = b_real
@@ -428,6 +506,19 @@ class LLMEngine:
             n_loop = min(-(-n_rest // 32) * 32, self.max_len - t0 - 1)
         need = -(-max(t_pad, t0 + 1 + max(n_rest, n_loop))
                  // self.page_size)
+        if need * b > self.allocator.available:
+            # idle cache-held pages (continuous-batching engines) are
+            # reclaimable — try before declaring the pool full
+            self._reclaim_pages(need * b - self.allocator.available)
+        if need * b > self.allocator.available:
+            # checked UP FRONT so a too-large request fails whole — not
+            # halfway through the per-sequence alloc loop with pages
+            # already claimed and an opaque pool error mid-flight
+            raise EngineFullError(
+                f"engine full: this call needs {need * b} KV pages "
+                f"({b} sequences x {need} pages) but only "
+                f"{self.allocator.available} of {self.allocator.n_pages} "
+                "are free; finish or retire in-flight sequences first")
         tables_np = np.zeros((b, self.max_pages_per_seq), np.int32)
         seq_pages = []
         for i in range(b):
@@ -465,15 +556,20 @@ class LLMEngine:
                 toks, k_pages, v_pages = loop(
                     self.weights, tok, k_pages, v_pages, tables, lens, key)
                 toks = np.asarray(toks)[:, :n_rest]      # drop bucket pad
-                if eos_token_id is not None:
-                    # match the host loop: keep columns up to and
-                    # including the first all-EOS column
-                    hit = np.all(toks[:b_real] == eos_token_id, axis=0)
-                    if hit.any():
-                        toks = toks[:, :int(np.argmax(hit)) + 1]
+                # per-row EOS is applied by _finish_eos on the assembled
+                # array below — the scan itself always runs every step
                 out.extend(toks[:, i:i + 1] for i in range(toks.shape[1]))
             else:
+                # per-row done mask: a row that hits ITS OWN EOS is
+                # finished even while other rows keep decoding (the old
+                # loop only stopped on an all-rows-same-column EOS, so
+                # one live row kept every finished row stepping)
+                done = np.zeros(b_real, bool)
+                if eos_token_id is not None:
+                    done |= np.asarray(tok)[:b_real] == eos_token_id
                 for _ in range(n_rest):
+                    if eos_token_id is not None and done.all():
+                        break
                     logits, k_pages, v_pages = self._step_fn(
                         self.weights, tok, k_pages, v_pages, tables, lens)
                     key, sub = jax.random.split(key)
@@ -481,9 +577,8 @@ class LLMEngine:
                                   top_k, top_p)
                     lens = lens + 1
                     out.append(np.asarray(tok)[:, None])
-                    if eos_token_id is not None and np.all(
-                            out[-1][:b_real] == eos_token_id):
-                        break
+                    if eos_token_id is not None:
+                        done |= out[-1][:b_real, 0] == eos_token_id
             ok = True
         finally:
             if ok:
@@ -493,4 +588,6 @@ class LLMEngine:
             else:
                 # donated buffers may be gone mid-flight: rebuild the pool
                 self._reset_kv()
-        return np.concatenate([ids] + out, axis=1)[:b_real]
+        full = np.concatenate([ids] + out, axis=1)[:b_real]
+        # trim each row at its own EOS (post-EOS columns -> eos token)
+        return self._finish_eos(full, t0, eos_token_id)

@@ -1,0 +1,77 @@
+"""Version-compat shims over the installed jax.
+
+The codebase is written against the current jax API; the baked toolchain
+may lag behind it (the shipped stack carries 0.4.x). Every API whose
+location or spelling moved between those versions resolves HERE, once,
+so kernel and SPMD modules stay on the modern spelling:
+
+  - shard_map:        jax.shard_map         <- jax.experimental.shard_map
+  - enable_x64 ctx:   jax.enable_x64        <- jax.experimental.enable_x64
+  - CompilerParams:   pltpu.CompilerParams  <- pltpu.TPUCompilerParams
+  - n-CPU platform:   jax_num_cpu_devices   <- XLA_FLAGS
+                      --xla_force_host_platform_device_count
+
+Import from this module instead of feature-testing at each call site.
+"""
+import inspect
+import os
+
+import jax
+
+try:                                     # jax >= 0.6 re-exports at top level
+    from jax import shard_map as _shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    _SM_PARAMS = set(inspect.signature(_shard_map).parameters)
+except (TypeError, ValueError):          # not introspectable: pass through
+    _SM_PARAMS = None
+
+
+def shard_map(*args, **kw):
+    """jax.shard_map with the replication-check kwarg translated between
+    its spellings (new jax: check_vma; 0.4.x: check_rep)."""
+    if _SM_PARAMS is not None:
+        if "check_vma" in kw and "check_vma" not in _SM_PARAMS:
+            kw["check_rep"] = kw.pop("check_vma")
+        elif "check_rep" in kw and "check_rep" not in _SM_PARAMS:
+            kw["check_vma"] = kw.pop("check_rep")
+    return _shard_map(*args, **kw)
+
+try:                                     # context-manager form (new jax)
+    enable_x64 = jax.enable_x64
+except AttributeError:
+    from jax.experimental import enable_x64          # noqa: F401
+
+
+def tpu_compiler_params(**kw):
+    """pltpu.CompilerParams(**kw) under its current or legacy name."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
+def set_cpu_device_count(n, platform="cpu"):
+    """Force an n-device CPU platform for tests/multi-process workers.
+
+    Must run before the jax backend initializes. New jax exposes the
+    jax_num_cpu_devices config key; older stacks only honor the
+    XLA_FLAGS form, which is read at backend init — so callers that can
+    should invoke this before their first jax computation (importing jax
+    is fine).
+    """
+    try:
+        jax.config.update("jax_platforms", platform)
+    except Exception:
+        os.environ["JAX_PLATFORMS"] = platform
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+    except Exception:
+        import re
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       os.environ.get("XLA_FLAGS", ""))
+        os.environ["XLA_FLAGS"] = (
+            flags.strip()
+            + f" --xla_force_host_platform_device_count={int(n)}").strip()

@@ -31,12 +31,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...jax_compat import enable_x64, tpu_compiler_params
+
 NEG_INF = -1e30
 
 
-def _decode_kernel(page_table_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, p, d, n_pages_max, scale,
-                   rep=1):
+def _decode_kernel(page_table_ref, seq_lens_ref, active_ref, q_ref, k_ref,
+                   v_ref, o_ref, m_scr, l_scr, acc_scr, *, p, d, n_pages_max,
+                   scale, rep=1):
     b = pl.program_id(0)
     pi = pl.program_id(1)
 
@@ -48,9 +50,11 @@ def _decode_kernel(page_table_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
 
     seq_len = seq_lens_ref[b]
     page_start = pi * p
-    # whole page beyond the sequence? skip its compute (its DMA still
-    # happened — the table clamps to a valid page id)
-    run = page_start < seq_len
+    # whole page beyond the sequence — or a retired slot in a continuous-
+    # batching step (active == 0)? skip its compute (its DMA still
+    # happened — the table clamps to a valid page id, and an inactive
+    # slot's index map pins every page fetch to block 0)
+    run = jnp.logical_and(active_ref[b] > 0, page_start < seq_len)
 
     @pl.when(run)
     def _compute():
@@ -65,12 +69,14 @@ def _decode_kernel(page_table_ref, seq_lens_ref, q_ref, k_ref, v_ref, o_ref,
         # GQA-native: q heads [g*rep, (g+1)*rep) attend kv head g — the
         # cache stays at h_kv heads (1/rep the HBM of an expanded cache)
         # and the rep heads of a group share ONE [rep, d] x [d, p] dot
-        # (single-row dots would waste MXU rows, code-review r5)
-        kt = jnp.swapaxes(k, 0, 1)                             # [h_kv, p, d]
-        h_kv = kt.shape[0]
+        # (single-row dots would waste MXU rows, code-review r5).
+        # Per-head SLICES (k[:, g]) rather than a swapaxes of the whole
+        # block: Mosaic's transpose lowering rejects the 3-D permutation
+        # on older toolchains, the slice lowers everywhere.
+        h_kv = k.shape[1]
         logits = jnp.concatenate([
             jax.lax.dot_general(
-                q[g * rep:(g + 1) * rep], kt[g],
+                q[g * rep:(g + 1) * rep], k[:, g, :],
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)            # [rep, p]
             for g in range(h_kv)], axis=0)                     # [h, p]
@@ -99,13 +105,13 @@ def wv_diag(w, v, d, rep=1):
     """sum_p w[h,p] * v[p,h_kv,d] -> [h,d] without the cross-head
     product; q heads [g*rep, (g+1)*rep) read kv head g (GQA), one
     [rep, p] x [p, d] dot per kv head. Unrolled 2-D dots (Mosaic
-    rejects batched dot_general — see _decode_kernel)."""
-    vt = jnp.swapaxes(v, 0, 1)                      # [h_kv, p, d]
+    rejects batched dot_general — see _decode_kernel), per-head slices
+    (Mosaic also rejects the 3-D transpose on older toolchains)."""
     return jnp.concatenate([
         jax.lax.dot_general(
-            w[g * rep:(g + 1) * rep], vt[g], (((1,), (0,)), ((), ())),
+            w[g * rep:(g + 1) * rep], v[:, g, :], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)     # [rep, d]
-        for g in range(vt.shape[0])], axis=0)       # [h, d]
+        for g in range(v.shape[1])], axis=0)        # [h, d]
 
 
 def expand_kv_heads(x, h_q):
@@ -121,11 +127,18 @@ def expand_kv_heads(x, h_q):
 
 
 def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
-                    interpret=False):
+                    interpret=False, active=None):
     """q: [b, h, d]; pages: [n_pages, p, h_kv, d] with h % h_kv == 0
     (GQA: q head i attends kv head i // (h // h_kv) — the cache is kept
     at the CHECKPOINT's kv head count, ref GQA repeat_kv removed);
     page_table: [b, max_pages] int32; seq_lens: [b] int32.
+
+    active: optional [b] mask (bool/int) for continuous batching — slots
+    whose request has retired stay in the batch shape but skip every
+    page's compute AND every page fetch (the index map pins their DMA to
+    block 0), so a mostly-drained decode batch costs roughly its live
+    rows. None means all slots live. Inactive rows emit zeros.
+
     Returns [b, h, d]."""
     b, h, d = q.shape
     n_pages, p, h_kv, dd = k_pages.shape
@@ -137,35 +150,43 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
     # clamp table entries so skipped pages still index a real page
     table = jnp.clip(page_table.astype(jnp.int32), 0, n_pages - 1)
     lens = seq_lens.astype(jnp.int32)
+    if active is None:
+        act = jnp.ones((b,), jnp.int32)
+    else:
+        act = active.astype(jnp.int32)
 
     kernel = functools.partial(_decode_kernel, p=p, d=d,
                                n_pages_max=max_pages, scale=s, rep=rep)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(b, max_pages),
         in_specs=[
-            pl.BlockSpec((1, h, d), lambda bb, pi, tbl, ln: (bb, 0, 0)),
+            pl.BlockSpec((1, h, d),
+                         lambda bb, pi, tbl, ln, ac: (bb, 0, 0)),
             pl.BlockSpec((1, p, h_kv, d),
-                         lambda bb, pi, tbl, ln: (tbl[bb, pi], 0, 0, 0)),
+                         lambda bb, pi, tbl, ln, ac:
+                         (tbl[bb, pi] * ac[bb], 0, 0, 0)),
             pl.BlockSpec((1, p, h_kv, d),
-                         lambda bb, pi, tbl, ln: (tbl[bb, pi], 0, 0, 0)),
+                         lambda bb, pi, tbl, ln, ac:
+                         (tbl[bb, pi] * ac[bb], 0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, h, d), lambda bb, pi, tbl, ln: (bb, 0, 0)),
+        out_specs=pl.BlockSpec((1, h, d),
+                               lambda bb, pi, tbl, ln, ac: (bb, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((h, 128), jnp.float32),
             pltpu.VMEM((h, 128), jnp.float32),
             pltpu.VMEM((h, d), jnp.float32),
         ],
     )
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 dimension_semantics=("parallel", "arbitrary")),
             interpret=interpret,
-        )(table, lens, q, k_pages, v_pages)
+        )(table, lens, act, q, k_pages, v_pages)
     return out
 
 

@@ -18,6 +18,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...jax_compat import enable_x64, tpu_compiler_params
+
 
 def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_scr, *, nk):
     ki = pl.program_id(2)
@@ -66,7 +68,7 @@ def quantized_matmul(x, w_int8, scales, out_dtype=None, bm=256, bn=256,
     _, np_ = wp.shape
     nk = kp // bk
 
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(
             functools.partial(_qmm_kernel, nk=nk),
             grid=(mp // bm, np_ // bn, nk),
@@ -78,7 +80,7 @@ def quantized_matmul(x, w_int8, scales, out_dtype=None, bm=256, bn=256,
             out_specs=pl.BlockSpec((bm, bn), lambda i, j, kb: (i, j)),
             out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype),
             scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
             interpret=interpret,
         )(xp, wp, sp.reshape(1, -1))

@@ -11,6 +11,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...jax_compat import enable_x64, tpu_compiler_params
+
 
 def _rms_fwd_kernel(x_ref, w_ref, o_ref, *, eps):
     x = x_ref[:].astype(jnp.float32)
@@ -22,7 +24,7 @@ def _rms_fwd_kernel(x_ref, w_ref, o_ref, *, eps):
 def _rms_fwd(x2d, w, eps, rows, interpret):
     n, d = x2d.shape
     br = min(rows, n)
-    with jax.enable_x64(False):
+    with enable_x64(False):
         return pl.pallas_call(
         functools.partial(_rms_fwd_kernel, eps=eps),
         grid=(pl.cdiv(n, br),),

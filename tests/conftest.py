@@ -1,12 +1,30 @@
 """Test configuration: force an 8-device virtual CPU mesh BEFORE any jax
 computation (SURVEY §4: the TPU analog of the reference's gloo/multi-process
 CPU tests). The environment pins JAX_PLATFORMS=axon, so we override via
-config (which beats the env var) right after importing jax.
+config (which beats the env var) right after importing jax. On the 0.4.x
+stack the jax_num_cpu_devices config key does not exist yet; the XLA_FLAGS
+spelling goes into the environment BEFORE importing jax so either toolchain
+ends up with 8 host devices (paddle_tpu.jax_compat documents the mapping —
+not imported here to keep conftest free of package import side effects).
 """
-import jax
+import os
+import re
+
+# REWRITE any inherited device-count flag rather than skipping when one
+# exists: a shell-level --xla_force_host_platform_device_count=1 would
+# otherwise silently shrink the 8-device mesh the suite depends on
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    _flags.strip() + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # pre-jax_num_cpu_devices stack: the XLA_FLAGS above covers it
 
 import pytest  # noqa: E402
 

@@ -5,7 +5,9 @@ Completer, and the keep-the-larger-operand-in-place cost rule."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+from paddle_tpu.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from paddle_tpu.distributed.auto_parallel.reshard import (

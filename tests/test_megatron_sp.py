@@ -5,7 +5,9 @@ while inter-block activations stay sequence-sharded."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+from paddle_tpu.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 import paddle_tpu as paddle

@@ -193,6 +193,50 @@ def main():
     }))
     sys.stdout.flush()
 
+    # -- degraded mode: the SAME stream under injected faults -------------
+    # Robustness has a throughput number too: seeded probabilistic decode
+    # faults + occasional allocation failures, completed tokens only.
+    # The interesting spread is cb_degraded vs cb: how much of the
+    # engine's capacity survives when requests are dying under it
+    # (page reclamation + slot reuse doing their job).
+    from paddle_tpu import failsafe
+
+    eng = None
+    eng = ContinuousBatchingEngine(model, **cb_kw)
+    eng.generate_many(warm_prompts, max_new_tokens=4)   # compile buckets
+    warm_uids = set(eng._requests)
+    n_failed = 0
+    with failsafe.inject("cb.decode", p=0.02, seed=13, times=None), \
+            failsafe.inject("page.alloc", p=0.01, seed=29, times=None):
+        t_start = time.perf_counter()
+        pending = list(reqs)
+        tick = 0
+        while pending or any(eng._slots) or eng._queue:
+            while pending and pending[0][0] <= tick:
+                eng.add_request(pending.pop(0)[1], max_new_tokens=new_cb)
+            if not eng.step() and pending:
+                tick = pending[0][0]
+            else:
+                tick += 1
+        dt = time.perf_counter() - t_start
+    toks = sum(r.result.size - r.ids.size
+               for uid, r in eng._requests.items()
+               if r.result is not None and uid not in warm_uids)
+    n_failed = sum(1 for uid, r in eng._requests.items()
+                   if r.error is not None and uid not in warm_uids)
+    print(json.dumps({
+        "metric": "cb_degraded_tokens_per_sec",
+        "model": "llama7b" if seven_b else "llama350m",
+        "batch": cb_kw["max_batch"],
+        "quant": cb_kw.get("quant") or "none",
+        "requests": n_req,
+        "failed_requests": n_failed,
+        "value": round(toks / max(dt, 1e-9), 2),
+        "unit": "tokens/s",
+        "backend": jax.default_backend(),
+    }))
+    sys.stdout.flush()
+
 
 if __name__ == "__main__":
     main()

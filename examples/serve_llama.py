@@ -41,6 +41,15 @@ def main():
                     help="serve a ragged request stream through the "
                          "continuous-batching scheduler instead of one "
                          "static generate() batch")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request wall-clock deadline; an expired "
+                         "request retires with a DeadlineExceededError "
+                         "record instead of squatting on its slot "
+                         "(scheduler mode)")
+    ap.add_argument("--queue-limit", type=int, default=None,
+                    help="bounded admission queue: add_request past this "
+                         "depth raises EngineBusyError backpressure "
+                         "(scheduler mode)")
     args = ap.parse_args()
 
     import paddle_tpu as paddle
@@ -73,10 +82,14 @@ def main():
 
     quant = None if args.quant == "none" else args.quant
     if args.scheduler:
+        from paddle_tpu.inference.scheduler import (EngineBusyError,
+                                                    RequestFailedError)
         engine = ContinuousBatchingEngine(
             model, max_len=g["max_len"], page_size=g["page"],
             max_batch=max(2, g["bs"]), quant=quant,
-            weight_dtype=weight_dtype)
+            weight_dtype=weight_dtype,
+            queue_limit=args.queue_limit,
+            default_deadline_ms=args.deadline_ms)
         rng = np.random.RandomState(0)
         # ragged prompts; 1 shares 0's prefix (once 0 finishes prefill,
         # the cache turns the shared pages into refcounted read-only
@@ -85,23 +98,38 @@ def main():
         prompts = [base, base[:9],
                    rng.randint(0, g["cfg"].vocab_size, (5,))
                    .astype(np.int64)]
-        uids = [engine.add_request(prompts[0],
-                                   max_new_tokens=args.max_new_tokens)]
-        while engine._requests[uids[0]].state in ("queued", "prefill"):
+        submitted = [(0, engine.add_request(
+            prompts[0], max_new_tokens=args.max_new_tokens))]
+        while engine._requests[submitted[0][1]].state in ("queued",
+                                                          "prefill"):
             engine.step()            # request 0 publishes its pages
-        uids += [engine.add_request(p, max_new_tokens=args.max_new_tokens)
-                 for p in prompts[1:]]
+        for i, p in enumerate(prompts[1:], start=1):
+            try:
+                submitted.append((i, engine.add_request(
+                    p, max_new_tokens=args.max_new_tokens)))
+            except EngineBusyError as e:
+                # bounded queue: backpressure is a client-visible signal,
+                # not an engine crash
+                print(f"  request {i} shed by backpressure: {e}")
         engine.drain()
-        outs = [engine.result(u) for u in uids]
         print(f"model={args.model} quant={args.quant} scheduler: "
-              f"{len(prompts)} ragged requests in "
+              f"{len(submitted)} ragged requests in "
               f"{engine.steps} steps ({engine.prefill_steps} prefill / "
               f"{engine.decode_steps} decode), "
               f"{engine._prefix.hits} prefix-page hits, "
               f"{engine.cow_copies} copy-on-writes")
-        for i, o in enumerate(outs):
-            print(f"  request {i}: {prompts[i].size} -> {o.size} tokens,"
-                  f" tail {o[-4:].tolist()}")
+        for i, u in submitted:
+            try:
+                o = engine.result(u)
+                print(f"  request {i}: {prompts[i].size} -> {o.size} "
+                      f"tokens, tail {o[-4:].tolist()}")
+            except RequestFailedError as e:
+                # deadline expiry (and any per-request fault) is a typed
+                # record on THAT request; the others completed normally
+                print(f"  request {i}: failed — {e.failure}")
+        h = engine.health()
+        print(f"  health: {h['done']} done / {h['failed']} failed, "
+              f"{h['pages_free']}/{h['pages_total']} pages free")
         return
 
     engine = LLMEngine(model, max_len=g["max_len"], page_size=g["page"],

@@ -1,4 +1,4 @@
-"""Sharded (+async) checkpointing.
+"""Sharded (+async) checkpointing, crash-safe.
 
 ref: SURVEY §5.4 — the reference saves per-rank shards
 (hybrid_parallel_pp_save_load.py) through paddle.save pickle; the TPU-native
@@ -7,15 +7,43 @@ restored to the same (or a resharded) mesh placement. A background thread
 makes `save_state_async` overlap serialization with the next train step
 (device->host copy happens synchronously; disk IO is async).
 
-Uses orbax-checkpoint when importable; falls back to a self-contained
-npz-per-leaf layout with a JSON index.
+Atomicity (the part preemption actually tests): every save writes into a
+sibling `<path>.tmp-*` directory, leaf by leaf, then a `manifest.json`
+carrying per-leaf CRC32 checksums, then COMMITS with a directory rename —
+the only atomic step. A crash anywhere before the rename leaves a torn
+temp dir and an intact previous checkpoint; a crash after it leaves a
+complete new one. There is no in-between state a reader can observe.
+`load_state` verifies checksums (CheckpointCorruptError on mismatch);
+`load_latest` walks a run directory's step checkpoints newest-first and
+returns the first VALID one, skipping torn temp dirs and corrupt commits.
+
+Fault points (paddle_tpu.failsafe): `ckpt.write_leaf` (per leaf, inside
+the temp write) and `ckpt.commit` (between temp-write and rename — the
+torn-save window). `install_preemption_hook` flushes pending async saves
+(plus an optional final sync save) on SIGTERM, the TPU-pod preemption
+signal.
 """
+import glob
 import json
 import os
+import shutil
+import signal
 import threading
+import uuid
+import zlib
 
 import numpy as np
 import jax
+
+from ..failsafe import fault_point
+
+MANIFEST = "manifest.json"
+_LEGACY_INDEX = "index.json"      # pre-atomic saves: no checksums
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint directory that cannot be trusted: missing manifest,
+    missing leaves, or checksum mismatch (torn/bit-rotted write)."""
 
 
 def _flatten(state):
@@ -23,35 +51,81 @@ def _flatten(state):
     return leaves, treedef
 
 
+def _checksum(arr):
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def _atomic_write(host_leaves, treedef, path, step):
+    """Write leaves + manifest into a temp sibling, then rename-commit."""
+    path = os.path.abspath(path)
+    parent = os.path.dirname(path) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    os.makedirs(tmp)
+    try:
+        checksums = []
+        for i, arr in enumerate(host_leaves):
+            fault_point("ckpt.write_leaf", detail=f"leaf {i}")
+            np.save(os.path.join(tmp, f"leaf_{i}.npy"), arr)
+            checksums.append(_checksum(arr))
+        manifest = {"format": 1, "n_leaves": len(host_leaves),
+                    "step": step, "treedef": str(treedef),
+                    "checksums": checksums}
+        mpath = os.path.join(tmp, MANIFEST)
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        # the torn-save window: temp dir complete, final name not yet
+        # committed — a crash here must leave the previous save intact
+        fault_point("ckpt.commit")
+        if os.path.exists(path):
+            # directory replace cannot be one atomic rename on POSIX;
+            # the previous save survives the window as `<path>.old-*`,
+            # which _resolve_dir/available_steps treat as the committed
+            # checkpoint until the swap completes
+            old = f"{path}.old-{uuid.uuid4().hex[:8]}"
+            os.rename(path, old)
+            try:
+                os.rename(tmp, path)
+            except BaseException:
+                os.rename(old, path)     # restore the previous save
+                raise
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(tmp, path)
+    except BaseException:
+        # the temp dir is garbage on ANY failure — a later load_latest
+        # must not even have to look at it (it also skips *.tmp-* names)
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
 def save_state(state, path, step=None):
-    """Synchronous sharded save of an arbitrary array pytree."""
-    os.makedirs(path, exist_ok=True)
+    """Synchronous sharded save of an arbitrary array pytree. Atomic:
+    readers see the previous checkpoint or the new one, never a torn
+    mix."""
     leaves, treedef = _flatten(state)
-    index = {"n_leaves": len(leaves), "step": step,
-             "treedef": str(treedef)}
-    for i, leaf in enumerate(leaves):
-        arr = np.asarray(jax.device_get(leaf))
-        np.save(os.path.join(path, f"leaf_{i}.npy"), arr)
-    with open(os.path.join(path, "index.json"), "w") as f:
-        json.dump(index, f)
+    host = [np.asarray(jax.device_get(leaf)) for leaf in leaves]
+    _atomic_write(host, treedef, path, step)
 
 
 _pending = []
+_async_errors = []
 
 
 def save_state_async(state, path, step=None):
-    """Device->host copy now; disk write in a background thread
-    (the orbax async pattern)."""
+    """Device->host copy now; atomic disk write in a background thread
+    (the orbax async pattern). Writer failures are queued and re-raised
+    by wait_until_finished() — an async save error must not be silent."""
     leaves, treedef = _flatten(state)
-    host_leaves = [np.asarray(jax.device_get(l)) for l in leaves]
-    index = {"n_leaves": len(leaves), "step": step, "treedef": str(treedef)}
+    host_leaves = [np.asarray(jax.device_get(leaf)) for leaf in leaves]
 
     def writer():
-        os.makedirs(path, exist_ok=True)
-        for i, arr in enumerate(host_leaves):
-            np.save(os.path.join(path, f"leaf_{i}.npy"), arr)
-        with open(os.path.join(path, "index.json"), "w") as f:
-            json.dump(index, f)
+        try:
+            _atomic_write(host_leaves, treedef, path, step)
+        except BaseException as e:   # noqa: BLE001 — carried to waiters
+            _async_errors.append(e)
 
     t = threading.Thread(target=writer, daemon=True)
     t.start()
@@ -60,23 +134,79 @@ def save_state_async(state, path, step=None):
 
 
 def wait_until_finished():
+    """Join every pending async save; re-raise the first writer error
+    (all pending state is cleared either way)."""
     for t in _pending:
         t.join()
     _pending.clear()
+    if _async_errors:
+        err = _async_errors[0]
+        _async_errors.clear()
+        raise err
 
 
-def load_state(path, like=None):
-    """Restore a pytree saved by save_state. `like` (optional) provides the
-    treedef and target shardings — arrays are device_put to match."""
-    with open(os.path.join(path, "index.json")) as f:
-        index = json.load(f)
-    leaves = [np.load(os.path.join(path, f"leaf_{i}.npy"))
-              for i in range(index["n_leaves"])]
+def _resolve_dir(path):
+    """A hard crash inside the replace-existing swap can leave the
+    committed save parked at `<path>.old-*` with `path` itself gone;
+    readers fall back to the newest such survivor."""
+    if os.path.isdir(path):
+        return path
+    survivors = glob.glob(path + ".old-*")
+    if survivors:
+        return max(survivors, key=os.path.getmtime)
+    return path
+
+
+def _read_manifest(path):
+    mpath = os.path.join(path, MANIFEST)
+    legacy = os.path.join(path, _LEGACY_INDEX)
+    try:
+        if os.path.exists(mpath):
+            with open(mpath) as f:
+                return json.load(f)
+        with open(legacy) as f:        # pre-atomic layout: no checksums
+            return json.load(f)
+    except FileNotFoundError:
+        raise CheckpointCorruptError(
+            f"no {MANIFEST} (or legacy {_LEGACY_INDEX}) under {path!r} — "
+            "not a committed checkpoint")
+    except (json.JSONDecodeError, OSError) as e:
+        raise CheckpointCorruptError(
+            f"unreadable checkpoint manifest under {path!r}: {e}")
+
+
+def load_state(path, like=None, verify=True):
+    """Restore a pytree saved by save_state. `like` (optional) provides
+    the treedef and target shardings — arrays are device_put to match.
+    verify=True (default) checks every leaf against the manifest's CRC32
+    and raises CheckpointCorruptError on torn/corrupt data."""
+    path = _resolve_dir(path)
+    index = _read_manifest(path)
+    checksums = index.get("checksums")
+    leaves = []
+    for i in range(index["n_leaves"]):
+        leaf_path = os.path.join(path, f"leaf_{i}.npy")
+        try:
+            arr = np.load(leaf_path)
+        except (FileNotFoundError, OSError, ValueError) as e:
+            raise CheckpointCorruptError(
+                f"checkpoint {path!r} is torn: leaf {i} of "
+                f"{index['n_leaves']} unreadable ({e})")
+        if verify and checksums is not None:
+            got = _checksum(arr)
+            if got != checksums[i]:
+                raise CheckpointCorruptError(
+                    f"checkpoint {path!r} leaf {i} checksum mismatch: "
+                    f"manifest {checksums[i]:#010x}, file {got:#010x} "
+                    "(torn or bit-rotted write)")
+        leaves.append(arr)
     if like is None:
         return leaves, index
     like_leaves, treedef = _flatten(like)
-    assert len(like_leaves) == len(leaves), \
-        f"checkpoint has {len(leaves)} leaves, target {len(like_leaves)}"
+    if len(like_leaves) != len(leaves):
+        raise CheckpointCorruptError(
+            f"checkpoint has {len(leaves)} leaves, target "
+            f"{len(like_leaves)}")
     placed = []
     for arr, tgt in zip(leaves, like_leaves):
         a = np.asarray(arr)
@@ -87,6 +217,107 @@ def load_state(path, like=None):
                 a = jax.numpy.asarray(a, tgt.dtype)
         placed.append(a)
     return jax.tree_util.tree_unflatten(treedef, placed), index
+
+
+# -- step-directory layout (resume picks the latest VALID save) ------------
+def step_dir(root, step):
+    return os.path.join(root, f"step_{int(step):08d}")
+
+
+def save_checkpoint(state, root, step, async_=False):
+    """Save under root/step_NNNNNNNN (atomic). async_=True returns the
+    writer thread (wait_until_finished() to flush)."""
+    path = step_dir(root, step)
+    if async_:
+        return save_state_async(state, path, step=step)
+    save_state(state, path, step=step)
+    return path
+
+
+def available_steps(root):
+    """Committed step numbers under root, ascending. Torn temp dirs
+    (*.tmp-*) and stray names are excluded; validity is NOT checked here
+    (load_latest does that, checksums and all)."""
+    if not os.path.isdir(root):
+        return []
+    steps = set()
+    for name in os.listdir(root):
+        if ".tmp-" in name or not name.startswith("step_"):
+            continue
+        # a step parked at step_N.old-* (crash mid-swap) still counts:
+        # load_state resolves the survivor through _resolve_dir
+        base = name.split(".old-")[0]
+        try:
+            steps.add(int(base[len("step_"):]))
+        except ValueError:
+            continue
+    return sorted(steps)
+
+
+def load_latest(root, like=None, verify=True):
+    """Restore the newest VALID checkpoint under root: walks step dirs
+    newest-first, skipping torn/corrupt saves (a crash mid-write leaves
+    either an uncommitted temp dir — invisible here — or, on legacy
+    non-atomic layouts, a checksum/manifest failure that this walk steps
+    over). Raises FileNotFoundError when nothing valid survives."""
+    skipped = []
+    for step in reversed(available_steps(root)):
+        path = step_dir(root, step)
+        try:
+            return load_state(path, like=like, verify=verify)
+        except CheckpointCorruptError as e:
+            skipped.append((step, str(e)))
+            continue
+    detail = "".join(f"\n  step {s}: {m}" for s, m in skipped)
+    raise FileNotFoundError(
+        f"no valid checkpoint under {root!r}"
+        + (f" ({len(skipped)} corrupt save(s) skipped):{detail}"
+           if skipped else ""))
+
+
+# -- preemption ------------------------------------------------------------
+_preempt = {"installed": False, "callback": None, "signum": None}
+
+
+def flush_on_preemption():
+    """The preemption path: drain pending async saves, then run the
+    installed final-save callback (if any). Idempotent; safe to call
+    directly (tests do)."""
+    try:
+        wait_until_finished()
+    finally:
+        cb = _preempt["callback"]
+        if cb is not None:
+            cb()
+
+
+def _preemption_handler(signum, frame):
+    try:
+        flush_on_preemption()
+    finally:
+        # exit even if the flush re-raised a failed writer's error — a
+        # preempted process must terminate, not leak the exception into
+        # whatever frame the signal interrupted
+        raise SystemExit(128 + signum)
+
+
+def install_preemption_hook(callback=None, signum=signal.SIGTERM):
+    """Arrange for pending async checkpoint writes to be flushed (and
+    `callback()` — e.g. a final synchronous save — to run) when the
+    process receives `signum` (SIGTERM: the TPU-pod preemption notice).
+    Returns True if the signal handler was installed, False when not on
+    the main thread (the flush still runs via the callback path if the
+    caller invokes flush_on_preemption itself)."""
+    _preempt["callback"] = callback
+    if _preempt["installed"] and _preempt["signum"] == signum:
+        return True
+    try:
+        signal.signal(signum, _preemption_handler)
+    except ValueError:          # not the main thread
+        return False
+    _preempt["installed"] = True
+    _preempt["signum"] = signum
+    return True
 
 
 def save_model_and_optimizer(model, optimizer, path, step=None):

@@ -128,4 +128,13 @@ class ElasticManager:
     def exit(self, completed=True):
         self._stop.set()
         self.store.delete(self.prefix + self.host)
+        # preemption/teardown discipline: an async checkpoint still in
+        # flight when the host leaves the job would be a torn save the
+        # NEXT incarnation has to skip — flush it while we still can
+        try:
+            from ...checkpoint import wait_until_finished
+            wait_until_finished()
+        except Exception:
+            pass  # exiting anyway; the atomic-commit protocol keeps the
+            #       last COMPLETED save loadable regardless
         return ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR

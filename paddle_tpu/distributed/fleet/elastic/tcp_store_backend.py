@@ -14,10 +14,20 @@ import time
 
 class TCPStoreElasticStore:
     def __init__(self, host, port, is_master=False, world_size=1,
-                 poll_interval=1.0, prefix="/"):
+                 poll_interval=1.0, prefix="/", connect_retries=3):
         from ...store import TCPStore
-        self._store = TCPStore(host, port, is_master=is_master,
-                               world_size=world_size)
+        from ....failsafe import fault_point, retry_with_backoff
+
+        def _connect():
+            fault_point("dist.store_connect")
+            return TCPStore(host, port, is_master=is_master,
+                            world_size=world_size)
+
+        # a non-master joining before the master binds is ordinary
+        # elastic churn: retry with backoff instead of dying on the
+        # first refused connection
+        self._store = retry_with_backoff(_connect, retries=connect_retries,
+                                         base_delay=0.25, max_delay=2.0)
         self._prefix = prefix
         self._watchers = []
         self._known = {}

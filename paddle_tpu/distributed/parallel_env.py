@@ -86,11 +86,28 @@ def init_parallel_env(strategy=None):
             master, port = env.trainer_endpoints[0].split(":")
         rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
         # --- TCPStore rendezvous (ref: parallel.py:489) ---
+        # bounded retry-with-backoff: a master that comes up a beat late
+        # (pod restart, elastic rescale) is the NORMAL case, not an
+        # error — but the retry budget is finite so a truly dead master
+        # still fails fast enough to reschedule
         store = None
         try:
             from .store import TCPStore
-            store = TCPStore(master, int(port) + 1, world_size=world,
-                             is_master=(rank == 0), timeout=120)
+            from ..failsafe import fault_point, retry_with_backoff
+
+            def _connect():
+                fault_point("dist.store_init")
+                return TCPStore(master, int(port) + 1, world_size=world,
+                                is_master=(rank == 0), timeout=120)
+
+            # only the CONNECT retries; the counter barrier is NOT
+            # idempotent (each call increments the rank count), so it
+            # runs exactly once per rank after the store is up
+            store = retry_with_backoff(
+                _connect,
+                retries=int(os.getenv("PADDLE_STORE_RETRIES", "3")),
+                base_delay=float(os.getenv("PADDLE_STORE_BACKOFF", "0.25")),
+                max_delay=5.0)
             store.barrier("init_ready", world)
         except Exception:
             store = None  # jax.distributed has its own rendezvous; the

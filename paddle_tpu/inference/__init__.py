@@ -352,6 +352,12 @@ _SERVING_EXPORTS = {
     "LLMEngine": "serving", "PageAllocator": "serving",
     "EngineFullError": "serving",
     "ContinuousBatchingEngine": "scheduler", "PrefixCache": "scheduler",
+    # typed serving-robustness surface (docs/robustness.md)
+    "SchedulerError": "scheduler", "EngineBusyError": "scheduler",
+    "UnknownRequestError": "scheduler",
+    "RequestNotFinishedError": "scheduler",
+    "RequestFailedError": "scheduler", "RequestCancelledError": "scheduler",
+    "DeadlineExceededError": "scheduler", "RequestFailure": "scheduler",
 }
 
 

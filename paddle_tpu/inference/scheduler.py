@@ -37,16 +37,81 @@ assert byte identity with generate()).
 """
 import collections
 import math
+import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..failsafe import InjectedFault, fault_point
 from ..ops.pallas.paged_attention import expand_kv_heads, paged_attention
 from .serving import LLMEngine, EngineFullError, _rms, _mm
 
-QUEUED, PREFILL, DECODE, DONE, FAILED = \
-    "queued", "prefill", "decode", "done", "failed"
+QUEUED, PREFILL, DECODE, DONE, FAILED, CANCELLED = \
+    "queued", "prefill", "decode", "done", "failed", "cancelled"
+
+
+class SchedulerError(RuntimeError):
+    """Base of the scheduler's typed errors."""
+
+
+class EngineBusyError(SchedulerError):
+    """Backpressure: the admission queue is at queue_limit. The caller
+    should shed load or retry later — nothing was enqueued."""
+
+
+class UnknownRequestError(SchedulerError, KeyError):
+    """A uid this engine has never issued (or one already forgotten)."""
+
+    def __str__(self):              # KeyError repr-quotes its arg
+        return self.args[0] if self.args else ""
+
+
+class RequestNotFinishedError(SchedulerError):
+    """result() on a request that is still queued/prefilling/decoding."""
+
+
+class RequestFailedError(SchedulerError):
+    """result() on a request that was retired with an error; carries the
+    RequestFailure record as .failure."""
+
+    def __init__(self, failure):
+        self.failure = failure
+        super().__init__(str(failure))
+
+
+class RequestCancelledError(RequestFailedError):
+    """result() on a request retired by cancel()."""
+
+
+class DeadlineExceededError(SchedulerError):
+    """Recorded error for a request whose deadline/TTL expired before it
+    finished."""
+
+
+class RequestFailure:
+    """Typed per-request error record: WHICH request died, at WHAT stage,
+    with WHAT error — while the engine kept stepping."""
+
+    __slots__ = ("uid", "stage", "error", "message", "step",
+                 "tokens_generated")
+
+    def __init__(self, uid, stage, exc, step, tokens_generated=0):
+        self.uid = uid
+        self.stage = stage              # admit | prefill | decode |
+        #                                 deadline | cancel
+        self.error = type(exc).__name__
+        self.message = str(exc)
+        self.step = step                # engine step count at failure
+        self.tokens_generated = tokens_generated
+
+    def __repr__(self):
+        return (f"RequestFailure(uid={self.uid}, stage={self.stage!r}, "
+                f"error={self.error}, step={self.step})")
+
+    def __str__(self):
+        return (f"request {self.uid} failed at stage {self.stage!r} "
+                f"(engine step {self.step}): {self.error}: {self.message}")
 
 
 class Request:
@@ -55,9 +120,11 @@ class Request:
     __slots__ = ("uid", "ids", "t0", "max_new_tokens", "eos_token_id",
                  "state", "slot", "pages", "shared_idx", "cow_reserve",
                  "filled", "resume", "tok", "out", "result",
-                 "pages_shared")
+                 "pages_shared", "deadline", "ttl_steps", "born_step",
+                 "error")
 
-    def __init__(self, uid, ids, max_new_tokens, eos_token_id):
+    def __init__(self, uid, ids, max_new_tokens, eos_token_id,
+                 deadline=None, ttl_steps=None, born_step=0):
         self.uid = uid
         self.ids = ids                  # np.int64 [t0]
         self.t0 = int(ids.size)
@@ -75,6 +142,10 @@ class Request:
         self.out = []                   # generated token ids
         self.result = None              # np.int64 [t0 + n_generated]
         self.pages_shared = 0
+        self.deadline = deadline        # absolute time.monotonic() cutoff
+        self.ttl_steps = ttl_steps      # engine-step budget (deterministic)
+        self.born_step = born_step      # engine step count at submission
+        self.error = None               # RequestFailure when retired bad
 
 
 class PrefixCache:
@@ -205,14 +276,28 @@ class ContinuousBatchingEngine(LLMEngine):
         max_batch). A step runs at the smallest bucket covering the
         highest live slot.
       prefix_cache: enable content-addressed prompt-page sharing.
+      queue_limit: bounded admission queue — add_request past this depth
+        raises EngineBusyError (typed backpressure) instead of growing
+        an unbounded backlog. None (default) = unbounded.
+      default_deadline_ms: deadline applied to requests submitted
+        without one (None = no deadline).
       do_sample/temperature/top_k/top_p/seed: engine-level sampling for
         step(); greedy (default) is deterministic per request and
         byte-equivalent to LLMEngine.generate(). Sampled mode draws from
         one engine-wide stream, so tokens depend on scheduling order.
+
+    Failure posture: a request that trips a fault (injected or real) at
+    a per-request boundary — admission allocation, a prefill chunk, its
+    slice of a decode step, deadline expiry — is retired ALONE with a
+    RequestFailure record (pages and prefix-cache refs reclaimed); the
+    engine keeps stepping every other request. Only a failure inside a
+    donated-buffer compiled call still takes the pools down (KV is
+    gone), and even then queued requests survive the rebuild.
     """
 
     def __init__(self, model, max_len=1024, page_size=128, max_batch=8,
                  prefill_chunk=None, slot_buckets=None, prefix_cache=True,
+                 queue_limit=None, default_deadline_ms=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  seed=0, **kw):
         super().__init__(model, max_len=max_len, page_size=page_size,
@@ -231,6 +316,9 @@ class ContinuousBatchingEngine(LLMEngine):
         self._key = jax.random.key(seed)
         self._prefix = PrefixCache(page_size) if prefix_cache else None
 
+        self.queue_limit = (None if queue_limit is None
+                            else int(queue_limit))
+        self.default_deadline_ms = default_deadline_ms
         self._queue = collections.deque()
         self._requests = {}
         self._slots = [None] * max_batch
@@ -251,11 +339,24 @@ class ContinuousBatchingEngine(LLMEngine):
         self.admissions = 0
         self.slot_reuses = 0
         self.cow_copies = 0
+        self.failure_count = 0
+        self.cancellations = 0
+        self.deadline_expiries = 0
         self._slot_used = [False] * max_batch
 
     # -- public ------------------------------------------------------------
-    def add_request(self, ids, max_new_tokens=32, eos_token_id=None):
-        """Queue one prompt (1-D int sequence). Returns a request uid."""
+    def add_request(self, ids, max_new_tokens=32, eos_token_id=None,
+                    deadline_ms=None, ttl_steps=None):
+        """Queue one prompt (1-D int sequence). Returns a request uid.
+
+        deadline_ms: wall-clock budget from NOW; a request still
+          unfinished when it expires retires with a DeadlineExceededError
+          record (queued requests are shed without ever running).
+        ttl_steps: the same contract counted in ENGINE STEPS instead of
+          wall time — deterministic, the form chaos tests use.
+        Raises EngineBusyError (typed backpressure, nothing enqueued)
+        when the admission queue is at queue_limit.
+        """
         ids = np.asarray(ids, np.int64).ravel()
         if ids.size == 0:
             raise ValueError("empty prompt")
@@ -267,17 +368,56 @@ class ContinuousBatchingEngine(LLMEngine):
                 f"prompt length {ids.size} + max_new_tokens "
                 f"{max_new_tokens} = {ids.size + max_new_tokens} exceeds "
                 f"this engine's max_len={self.max_len}")
-        r = Request(self._next_uid, ids, max_new_tokens, eos_token_id)
+        if self.queue_limit is not None and \
+                len(self._queue) >= self.queue_limit:
+            raise EngineBusyError(
+                f"admission queue full: {len(self._queue)} queued "
+                f"requests at queue_limit={self.queue_limit} "
+                f"({sum(1 for s in self._slots if s)} running); retry "
+                "later or raise queue_limit")
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        r = Request(self._next_uid, ids, max_new_tokens, eos_token_id,
+                    deadline=deadline,
+                    ttl_steps=None if ttl_steps is None else int(ttl_steps),
+                    born_step=self.steps)
         self._next_uid += 1
         self._requests[r.uid] = r
         self._queue.append(r)
         return r.uid
 
+    def cancel(self, uid):
+        """Cancel a request. Queued: shed before it ever runs. In-flight:
+        retired now, slot/pages/prefix-refs reclaimed. Returns True if
+        this call cancelled it, False if it had already finished (or
+        failed). Unknown uids raise UnknownRequestError."""
+        r = self._requests.get(uid)
+        if r is None:
+            raise UnknownRequestError(f"unknown request uid {uid}")
+        if r.state in (DONE, FAILED, CANCELLED):
+            return False
+        if r.state == QUEUED:
+            self._queue.remove(r)
+        self._fail_request(
+            r, "cancel", SchedulerError(f"request {uid} cancelled"),
+            state=CANCELLED)
+        self.cancellations += 1
+        return True
+
     def step(self):
-        """One engine iteration: admit what fits, then run ONE compiled
-        program — a prefill chunk or a decode step (alternating when
-        both have work, so long prompts don't stall live decodes).
-        Returns False when there is nothing to do."""
+        """One engine iteration: shed expired deadlines, admit what
+        fits, then run ONE compiled program — a prefill chunk or a
+        decode step (alternating when both have work, so long prompts
+        don't stall live decodes). Returns False when there is nothing
+        to do.
+
+        Per-request isolation: a fault raised at a request boundary
+        (its admission, its prefill chunk, its slice of the decode
+        batch) retires THAT request with a RequestFailure record and the
+        step carries on."""
+        self._expire_deadlines()
         self._admit()
         prefills = [r for r in self._slots if r and r.state == PREFILL]
         decodes = [r for r in self._slots if r and r.state == DECODE]
@@ -286,18 +426,36 @@ class ContinuousBatchingEngine(LLMEngine):
                 # nothing admitted AND nothing running: the queue head
                 # cannot fit even with every slot idle — a real capacity
                 # bug, not back-pressure
+                head = self._queue[0]
+                need = self._pages_needed(head.t0, head.max_new_tokens)
                 raise EngineFullError(
-                    f"request {self._queue[0].uid} cannot be admitted "
-                    "into an idle engine (page pool pinned?)")
+                    f"request {head.uid} cannot be admitted into an idle "
+                    f"engine: needs {need} KV pages but only "
+                    f"{self.allocator.available} of "
+                    f"{self.allocator.n_pages} are free (page pool "
+                    "pinned?)")
             return False
         self.steps += 1
         try:
             if prefills and (not decodes or not self._prefer_decode):
-                self._prefill_step(prefills[0])
+                r = prefills[0]
+                try:
+                    fault_point("cb.prefill", detail=f"uid={r.uid}")
+                    self._prefill_step(r)
+                except InjectedFault as e:
+                    self._fail_request(r, "prefill", e)
                 self.prefill_steps += 1
                 self._prefer_decode = True
             else:
-                self._decode_step(decodes)
+                live = []
+                for r in decodes:
+                    try:
+                        fault_point("cb.decode", detail=f"uid={r.uid}")
+                        live.append(r)
+                    except InjectedFault as e:
+                        self._fail_request(r, "decode", e)
+                if live:
+                    self._decode_step(live)
                 self.decode_steps += 1
                 self._prefer_decode = False
         except Exception:
@@ -307,7 +465,10 @@ class ContinuousBatchingEngine(LLMEngine):
 
     def drain(self):
         """Run until every queued/in-flight request retires. Returns
-        {uid: output} for requests completed by this call."""
+        {uid: output} for requests completed by this call (an empty dict
+        on an idle engine — never a hang, never a KeyError). Requests
+        that retired with an error are NOT in the dict; read them via
+        failures()/result()."""
         finished = {}
         before = {u for u, r in self._requests.items() if r.state == DONE}
         while self.step():
@@ -319,11 +480,74 @@ class ContinuousBatchingEngine(LLMEngine):
 
     def result(self, uid):
         """Output array for a finished request: [prompt + generated],
-        trimmed at the request's own EOS (inclusive)."""
-        r = self._requests[uid]
+        trimmed at the request's own EOS (inclusive).
+
+        Typed failures instead of KeyError/None: UnknownRequestError for
+        a uid this engine never issued, RequestNotFinishedError while
+        still in flight, RequestCancelledError / RequestFailedError
+        (carrying the RequestFailure record) for error retirements."""
+        r = self._requests.get(uid)
+        if r is None:
+            raise UnknownRequestError(f"unknown request uid {uid}")
+        if r.state == CANCELLED:
+            raise RequestCancelledError(r.error)
+        if r.state == FAILED:
+            raise RequestFailedError(r.error)
         if r.state != DONE:
-            raise RuntimeError(f"request {uid} is {r.state}, not done")
+            raise RequestNotFinishedError(
+                f"request {uid} is {r.state}, not done")
         return r.result
+
+    def status(self, uid):
+        """State string for a uid: queued/prefill/decode/done/failed/
+        cancelled."""
+        r = self._requests.get(uid)
+        if r is None:
+            raise UnknownRequestError(f"unknown request uid {uid}")
+        return r.state
+
+    def failures(self):
+        """{uid: RequestFailure} for every request retired with an error
+        (cancellations included)."""
+        return {u: r.error for u, r in self._requests.items()
+                if r.error is not None}
+
+    def pending(self):
+        """uids still queued or in flight, submission order."""
+        return [u for u, r in self._requests.items()
+                if r.state in (QUEUED, PREFILL, DECODE)]
+
+    def __len__(self):
+        """Number of requests still queued or in flight."""
+        return sum(1 for r in self._requests.values()
+                   if r.state in (QUEUED, PREFILL, DECODE))
+
+    def health(self):
+        """One serving-health snapshot (cheap; safe to poll): queue and
+        slot occupancy, page-pool headroom, prefix-cache state, and the
+        lifetime counters a monitor alarms on."""
+        states = collections.Counter(
+            r.state for r in self._requests.values())
+        return {
+            "queued": len(self._queue),
+            "running": sum(1 for s in self._slots if s is not None),
+            "slots_total": self.max_batch,
+            "queue_limit": self.queue_limit,
+            "pages_free": self.allocator.available,
+            "pages_total": self.allocator.n_pages,
+            "prefix_pages": 0 if self._prefix is None else len(self._prefix),
+            "prefix_hits": 0 if self._prefix is None else self._prefix.hits,
+            "done": states[DONE],
+            "failed": states[FAILED],
+            "cancelled": states[CANCELLED],
+            "steps": self.steps,
+            "prefill_steps": self.prefill_steps,
+            "decode_steps": self.decode_steps,
+            "admissions": self.admissions,
+            "failures": self.failure_count,
+            "deadline_expiries": self.deadline_expiries,
+            "cow_copies": self.cow_copies,
+        }
 
     def generate_many(self, prompts, max_new_tokens=32, eos_token_id=None):
         """Submit a list of (ragged) prompts and drain. Returns a list of
@@ -378,15 +602,28 @@ class ContinuousBatchingEngine(LLMEngine):
             if fresh > self.allocator.available:
                 return                       # wait for retirements (FIFO)
             self._queue.popleft()
+            # claim pages under a guard: an allocation failure here
+            # (injected page.alloc fault, or a real race) releases every
+            # page this request already claimed and retires ONLY this
+            # request — the pool stays consistent and admission moves on
+            pages = []
+            try:
+                fault_point("cb.admit", detail=f"uid={r.uid}")
+                for pg in shared:
+                    pages.append(self.allocator.share(pg))
+                for _ in range(need - n_shared):
+                    pages.append(self.allocator.alloc())
+                r.cow_reserve = self.allocator.alloc() if cow else None
+            except Exception as e:
+                if pages:
+                    self.allocator.free(pages)
+                self._fail_request(r, "admit", e)
+                continue
             if self._prefix is not None:
                 if shared:
                     self._prefix.hits += len(shared)
                 else:
                     self._prefix.misses += 1
-            pages = [self.allocator.share(pg) for pg in shared]
-            pages += [self.allocator.alloc()
-                      for _ in range(need - n_shared)]
-            r.cow_reserve = self.allocator.alloc() if cow else None
             r.pages = pages
             r.shared_idx = set(range(n_shared))
             r.pages_shared = n_shared
@@ -612,6 +849,56 @@ class ContinuousBatchingEngine(LLMEngine):
             self._retire(r)
 
     # -- retirement / failure ----------------------------------------------
+    def _expire_deadlines(self):
+        """Shed every request whose wall-clock deadline or step TTL has
+        passed: queued ones before they run, in-flight ones with their
+        slot/pages reclaimed. Runs at the top of each step()."""
+        now = None
+        # live requests only (queue + slots) — NOT the full request
+        # history, which grows for the life of the engine
+        live = list(self._queue) + [s for s in self._slots
+                                    if s is not None]
+        for r in live:
+            expired = False
+            if r.ttl_steps is not None and \
+                    self.steps - r.born_step >= r.ttl_steps:
+                expired = True
+                why = (f"ttl of {r.ttl_steps} engine steps exhausted "
+                       f"(submitted at step {r.born_step}, now "
+                       f"{self.steps})")
+            elif r.deadline is not None:
+                if now is None:
+                    now = time.monotonic()
+                if now >= r.deadline:
+                    expired = True
+                    why = f"wall-clock deadline passed at step {self.steps}"
+            if not expired:
+                continue
+            if r.state == QUEUED:
+                self._queue.remove(r)
+            self._fail_request(r, "deadline", DeadlineExceededError(why))
+            self.deadline_expiries += 1
+
+    def _fail_request(self, r, stage, exc, state=FAILED):
+        """Retire ONE request with a typed error record; reclaim its
+        slot, pages, CoW reserve, and prefix-cache references. The
+        engine keeps stepping everyone else."""
+        r.error = RequestFailure(r.uid, stage, exc, self.steps,
+                                 tokens_generated=len(r.out))
+        r.state = state
+        if r.slot is not None:
+            self._slots[r.slot] = None
+            r.slot = None
+        if r.pages:
+            self.allocator.free(r.pages)      # shared pages: drops OUR
+            r.pages = []                      # ref only; cache/other
+            #                                   holders keep theirs
+        if r.cow_reserve is not None:
+            self.allocator.free([r.cow_reserve])
+            r.cow_reserve = None
+        r.shared_idx = set()
+        self.failure_count += 1
+
     def _retire(self, r):
         r.result = np.concatenate([r.ids,
                                    np.asarray(r.out, np.int64)])
@@ -640,6 +927,18 @@ class ContinuousBatchingEngine(LLMEngine):
         for i, r in enumerate(getattr(self, "_slots", [])):
             if r is not None:
                 r.state = FAILED
+                if r.error is None:
+                    r.error = RequestFailure(
+                        r.uid, "engine",
+                        SchedulerError("KV pools rebuilt mid-flight "
+                                       "(compiled call failed)"),
+                        getattr(self, "steps", 0),
+                        tokens_generated=len(r.out))
+                self.failure_count += 1
+                r.pages = []          # pool is being rebuilt: page ids
+                r.cow_reserve = None  # are meaningless, nothing to free
+                r.shared_idx = set()
+                r.slot = None
                 self._slots[i] = None
         prefix = getattr(self, "_prefix", None)
         if prefix is not None:

@@ -25,6 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..failsafe import fault_point
 from ..tensor.tensor import Tensor
 from ..autograd import tape
 from ..models.llama import LlamaForCausalLM, _rope_cache
@@ -62,11 +63,13 @@ class PageAllocator:
         #                         tests assert shared prefixes shrink it)
 
     def alloc(self):
+        fault_point("page.alloc")
         if not self._free:
             raise EngineFullError(
-                f"KV page pool exhausted: all {self.n_pages} pages are "
-                "in use (retire sequences or build the engine with a "
-                "larger max_batch*max_len budget)")
+                f"KV page pool exhausted: 1 page needed, 0 of "
+                f"{self.n_pages} available — all pages are in use "
+                "(retire sequences or build the engine with a larger "
+                "max_batch*max_len budget)")
         p = self._free.pop()
         self._ref[p] = 1
         self.total_allocs += 1
@@ -76,7 +79,10 @@ class PageAllocator:
         """Take an additional reference on an ALLOCATED page (prefix
         sharing). Returns the page id for chaining."""
         if self._ref[page] <= 0:
-            raise RuntimeError(f"share() of free page {page}")
+            raise RuntimeError(
+                f"share() of free page {page} (refcount "
+                f"{self._ref[page]}, never allocated or already "
+                "recycled)")
         self._ref[page] += 1
         return page
 
@@ -88,7 +94,9 @@ class PageAllocator:
         return to the free list."""
         for p in pages:
             if self._ref[p] <= 0:
-                raise RuntimeError(f"double free of page {p}")
+                raise RuntimeError(
+                    f"double free of page {p}: refcount is already "
+                    f"{self._ref[p]} (every holder has released it)")
             self._ref[p] -= 1
             if self._ref[p] == 0:
                 self._free.append(p)
@@ -521,10 +529,18 @@ class LLMEngine:
                 "are free; finish or retire in-flight sequences first")
         tables_np = np.zeros((b, self.max_pages_per_seq), np.int32)
         seq_pages = []
-        for i in range(b):
-            pages = [self.allocator.alloc() for _ in range(need)]
-            seq_pages.append(pages)
-            tables_np[i, :need] = pages
+        try:
+            for i in range(b):
+                pages = []
+                seq_pages.append(pages)      # registered BEFORE filling:
+                for _ in range(need):        # a failing alloc (injected
+                    pages.append(self.allocator.alloc())  # or racing)
+                tables_np[i, :need] = pages  # frees the partial claim
+        except Exception:
+            for pages in seq_pages:
+                if pages:
+                    self.allocator.free(pages)
+            raise
         tables = jnp.asarray(tables_np)
 
         prefill = self._prefill_fns.get(t_pad)

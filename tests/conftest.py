@@ -39,3 +39,6 @@ def _seed():
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / long-running tests")
+    config.addinivalue_line(
+        "markers", "faults: fault-injection robustness tests "
+        "(paddle_tpu.failsafe harness; see docs/robustness.md)")

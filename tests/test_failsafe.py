@@ -1,0 +1,201 @@
+"""Fault-injection harness unit tests (ISSUE 2 tentpole):
+deterministic nth-call / seeded-probabilistic triggers, env + context
+activation, scoping, and retry_with_backoff's bounded schedule."""
+import os
+
+import pytest
+
+from paddle_tpu import failsafe
+from paddle_tpu.failsafe import (InjectedFault, fault_point, inject,
+                                 retry_with_backoff)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    failsafe.reset()
+    yield
+    os.environ.pop(failsafe.ENV_VAR, None)
+    failsafe.reset()
+
+
+class TestFaultPoint:
+    def test_disarmed_is_silent(self):
+        for _ in range(10):
+            fault_point("t.noop")
+        assert "t.noop" in failsafe.fault_points()
+
+    def test_nth_call_fires_exactly_once(self):
+        fired = []
+        with inject("t.nth", nth=3) as spec:
+            for i in range(1, 7):
+                try:
+                    fault_point("t.nth")
+                except InjectedFault:
+                    fired.append(i)
+        assert fired == [3]
+        assert spec.calls == 6 and spec.fired == 1
+
+    def test_always_fires_once_by_default(self):
+        with inject("t.always"):
+            with pytest.raises(InjectedFault, match="t.always"):
+                fault_point("t.always")
+            fault_point("t.always")          # default times=1: spent
+
+    def test_multi_nth_fires_on_every_listed_call(self):
+        fired = []
+        with inject("t.multi", nth=[2, 5]):
+            for i in range(1, 8):
+                try:
+                    fault_point("t.multi")
+                except InjectedFault:
+                    fired.append(i)
+        assert fired == [2, 5]
+
+    def test_times_bounds_firings(self):
+        hits = 0
+        with inject("t.times", nth=None, p=1.0, times=2):
+            for _ in range(5):
+                try:
+                    fault_point("t.times")
+                except InjectedFault:
+                    hits += 1
+        assert hits == 2
+
+    def test_seeded_probability_is_deterministic(self):
+        def pattern(seed):
+            out = []
+            with inject("t.prob", p=0.3, seed=seed, times=None):
+                for i in range(50):
+                    try:
+                        fault_point("t.prob")
+                        out.append(0)
+                    except InjectedFault:
+                        out.append(1)
+            return out
+
+        a, b = pattern(7), pattern(7)
+        assert a == b and sum(a) > 0
+        assert pattern(8) != a            # different seed, different run
+
+    def test_scope_disarms_even_on_error(self):
+        with pytest.raises(ValueError):
+            with inject("t.scope", nth=1):
+                raise ValueError("unrelated")
+        fault_point("t.scope")            # disarmed: silent
+
+    def test_custom_exception_class(self):
+        with inject("t.exc", exc=OSError):
+            with pytest.raises(OSError, match="t.exc"):
+                fault_point("t.exc")
+
+    def test_detail_rides_into_fault(self):
+        with inject("t.detail"):
+            with pytest.raises(InjectedFault, match="uid=42"):
+                fault_point("t.detail", detail="uid=42")
+
+    def test_nth_and_p_conflict(self):
+        with pytest.raises(ValueError, match="not both"):
+            failsafe.FaultSpec("t.bad", nth=1, p=0.5)
+
+
+class TestEnvActivation:
+    def test_env_arms_and_fires(self):
+        os.environ[failsafe.ENV_VAR] = "t.env:nth=2"
+        try:
+            fault_point("t.env")                      # call 1: silent
+            with pytest.raises(InjectedFault):
+                fault_point("t.env")                  # call 2: fires
+            fault_point("t.env")                      # spent
+        finally:
+            del os.environ[failsafe.ENV_VAR]
+        failsafe.reset()
+        fault_point("t.env")                          # env gone: silent
+
+    def test_env_probabilistic_with_seed(self):
+        os.environ[failsafe.ENV_VAR] = "t.envp:p=1.0:seed=3:times=1"
+        try:
+            with pytest.raises(InjectedFault):
+                fault_point("t.envp")
+        finally:
+            del os.environ[failsafe.ENV_VAR]
+
+    def test_env_bad_field_raises(self):
+        os.environ[failsafe.ENV_VAR] = "t.envbad:bogus=1"
+        try:
+            with pytest.raises(ValueError, match="bogus"):
+                fault_point("t.envbad")
+        finally:
+            del os.environ[failsafe.ENV_VAR]
+            failsafe.reset()
+
+
+class TestRetryWithBackoff:
+    def test_succeeds_after_transient_failures(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        slept = []
+        assert retry_with_backoff(flaky, retries=5, base_delay=0.1,
+                                  sleep=slept.append) == "ok"
+        assert len(calls) == 3
+        assert slept == [0.1, 0.2]        # exponential schedule
+
+    def test_exhausts_and_reraises_last(self):
+        def dead():
+            raise ConnectionError("still down")
+
+        slept = []
+        with pytest.raises(ConnectionError, match="still down"):
+            retry_with_backoff(dead, retries=3, base_delay=0.05,
+                               sleep=slept.append)
+        assert len(slept) == 3            # retries sleeps, then raise
+
+    def test_max_delay_caps_schedule(self):
+        slept = []
+
+        def dead():
+            raise OSError("x")
+
+        with pytest.raises(OSError):
+            retry_with_backoff(dead, retries=4, base_delay=1.0,
+                               factor=10.0, max_delay=2.5,
+                               sleep=slept.append)
+        assert slept == [1.0, 2.5, 2.5, 2.5]
+
+    def test_retry_on_filters(self):
+        def typed():
+            raise KeyError("not retryable here")
+
+        with pytest.raises(KeyError):
+            retry_with_backoff(typed, retries=5, retry_on=(OSError,),
+                               sleep=lambda _: None)
+
+    def test_on_retry_observability(self):
+        seen = []
+
+        def flaky():
+            if len(seen) < 2:
+                raise OSError("t")
+            return 1
+
+        retry_with_backoff(flaky, retries=5, base_delay=0.1,
+                           on_retry=lambda n, e, d: seen.append((n, d)),
+                           sleep=lambda _: None)
+        assert seen == [(1, 0.1), (2, 0.2)]
+
+    def test_negative_retries_rejected(self):
+        with pytest.raises(ValueError, match="retries"):
+            retry_with_backoff(lambda: 1, retries=-1)
+
+    def test_works_with_fault_point(self):
+        with inject("t.retry", nth=1):
+            out = retry_with_backoff(lambda: fault_point("t.retry") or 7,
+                                     retries=2, sleep=lambda _: None)
+        assert out == 7

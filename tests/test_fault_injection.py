@@ -1,0 +1,541 @@
+"""Crash-safe serving + checkpointing under injected faults (ISSUE 2).
+
+Serving: per-request error isolation (a failing admission / prefill
+chunk / decode slice retires ONE request with a typed RequestFailure;
+the engine keeps stepping and reclaims every page), deadlines/TTLs,
+bounded-queue backpressure, cancel(), typed result() errors, health().
+
+Checkpointing: atomic temp-write + manifest + rename-commit, checksum
+verification, latest-valid-step fallback, async error propagation, and
+the preemption flush.
+
+The slow-marked chaos soak streams ~20 requests under seeded random
+faults and asserts the acceptance contract: the engine never dies,
+every request ends done-or-typed-error, survivors are byte-identical to
+a fault-free run, and the allocator leaks nothing.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import failsafe
+from paddle_tpu.failsafe import InjectedFault, inject
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.inference.serving import (LLMEngine, PageAllocator,
+                                          EngineFullError)
+from paddle_tpu.inference.scheduler import (
+    ContinuousBatchingEngine, EngineBusyError, UnknownRequestError,
+    RequestNotFinishedError, RequestFailedError, RequestCancelledError)
+from paddle_tpu.distributed import checkpoint as ckpt
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    failsafe.reset()
+    yield
+    failsafe.reset()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    paddle.seed(3)
+    cfg = LlamaConfig.tiny()
+    return LlamaForCausalLM(cfg), cfg
+
+
+@pytest.fixture(scope="module")
+def ref_engine(tiny):
+    model, _ = tiny
+    return LLMEngine(model, max_len=64, page_size=8, max_batch=2)
+
+
+def ref_gen(ref_engine, ids, n, eos=None):
+    return ref_engine.generate(np.asarray(ids)[None, :], max_new_tokens=n,
+                               eos_token_id=eos)[0]
+
+
+def _cb(model, **kw):
+    base = dict(max_len=64, page_size=8, max_batch=2, prefill_chunk=8)
+    base.update(kw)
+    return ContinuousBatchingEngine(model, **base)
+
+
+def _assert_no_leak(cb):
+    """All pages are free except the prefix cache's refcount-1 holds."""
+    held = 0 if cb._prefix is None else len(cb._prefix)
+    assert cb.allocator.available == cb.allocator.n_pages - held, \
+        (cb.allocator.available, cb.allocator.n_pages, held)
+
+
+# -- serving: per-request isolation -----------------------------------------
+class TestServingFaultIsolation:
+    def test_decode_fault_retires_one_request(self, tiny, ref_engine):
+        model, cfg = tiny
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, cfg.vocab_size, (t,)).astype(np.int64)
+                   for t in (12, 5, 9)]
+        refs = [ref_gen(ref_engine, p, 6) for p in prompts]
+        cb = _cb(model)
+        with inject("cb.decode", nth=3):
+            uids = [cb.add_request(p, max_new_tokens=6) for p in prompts]
+            cb.drain()                      # must not raise
+        states = [cb.status(u) for u in uids]
+        assert states.count("failed") == 1 and states.count("done") == 2
+        for i, u in enumerate(uids):
+            if cb.status(u) == "done":
+                np.testing.assert_array_equal(cb.result(u), refs[i])
+            else:
+                with pytest.raises(RequestFailedError) as ei:
+                    cb.result(u)
+                f = ei.value.failure
+                assert f.uid == u and f.stage == "decode"
+                assert f.error == "InjectedFault"
+        assert cb.failure_count == 1
+        _assert_no_leak(cb)
+
+    def test_prefill_fault_mid_chunks(self, tiny, ref_engine):
+        """A long prompt dies between prefill chunks; its pages (some
+        potentially shared) come back and the other request is
+        untouched."""
+        model, cfg = tiny
+        rng = np.random.RandomState(1)
+        long_p = rng.randint(0, cfg.vocab_size, (24,)).astype(np.int64)
+        short_p = rng.randint(0, cfg.vocab_size, (5,)).astype(np.int64)
+        ref_short = ref_gen(ref_engine, short_p, 4)
+        cb = _cb(model)
+        with inject("cb.prefill", nth=2):    # 2nd prefill chunk
+            ua = cb.add_request(long_p, max_new_tokens=4)
+            ub = cb.add_request(short_p, max_new_tokens=4)
+            cb.drain()
+        assert cb.status(ua) == "failed"
+        assert cb.failures()[ua].stage == "prefill"
+        assert cb.status(ub) == "done"
+        np.testing.assert_array_equal(cb.result(ub), ref_short)
+        _assert_no_leak(cb)
+
+    def test_alloc_fault_at_admission(self, tiny, ref_engine):
+        """An allocation failure while claiming a request's pages frees
+        the partial claim and fails ONLY that request."""
+        model, cfg = tiny
+        rng = np.random.RandomState(2)
+        prompts = [rng.randint(0, cfg.vocab_size, (t,)).astype(np.int64)
+                   for t in (8, 9)]
+        refs = [ref_gen(ref_engine, p, 4) for p in prompts]
+        cb = _cb(model, prefix_cache=False)
+        with inject("page.alloc", nth=2):    # dies mid-claim, page 1 held
+            ua = cb.add_request(prompts[0], max_new_tokens=4)
+            ub = cb.add_request(prompts[1], max_new_tokens=4)
+            cb.drain()
+        assert cb.status(ua) == "failed"
+        assert cb.failures()[ua].stage == "admit"
+        assert cb.status(ub) == "done"
+        np.testing.assert_array_equal(cb.result(ub), refs[1])
+        assert cb.allocator.available == cb.allocator.n_pages
+
+    def test_engine_exception_still_aborts_pools(self, tiny):
+        """Non-request-scoped failures (a custom exception from a fault
+        point, i.e. anything not InjectedFault at a request boundary)
+        keep the existing abort-everything contract: pools rebuild,
+        in-flight requests get typed engine-failure records."""
+        model, cfg = tiny
+        cb = _cb(model)
+        p = (np.arange(12) % cfg.vocab_size).astype(np.int64)
+        with inject("cb.decode", exc=MemoryError):
+            u = cb.add_request(p, max_new_tokens=6)
+            with pytest.raises(MemoryError):
+                cb.drain()
+        assert cb.status(u) == "failed"
+        assert cb.failures()[u].stage == "engine"
+        assert cb.allocator.available == cb.allocator.n_pages
+
+
+# -- serving: deadlines, backpressure, cancel -------------------------------
+class TestDeadlinesAndBackpressure:
+    def test_ttl_steps_expires_deterministically(self, tiny, ref_engine):
+        model, cfg = tiny
+        rng = np.random.RandomState(3)
+        pa = rng.randint(0, cfg.vocab_size, (8,)).astype(np.int64)
+        pb = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int64)
+        cb = _cb(model)
+        ua = cb.add_request(pa, max_new_tokens=30, ttl_steps=3)
+        ub = cb.add_request(pb, max_new_tokens=4)
+        cb.drain()
+        assert cb.status(ua) == "failed"
+        f = cb.failures()[ua]
+        assert f.stage == "deadline" and f.error == "DeadlineExceededError"
+        assert cb.deadline_expiries == 1
+        np.testing.assert_array_equal(cb.result(ub),
+                                      ref_gen(ref_engine, pb, 4))
+        _assert_no_leak(cb)
+
+    def test_wallclock_deadline_sheds_queued(self, tiny):
+        model, cfg = tiny
+        cb = _cb(model)
+        p = (np.arange(8) % cfg.vocab_size).astype(np.int64)
+        u = cb.add_request(p, max_new_tokens=4, deadline_ms=0.0)
+        cb.drain()
+        assert cb.status(u) == "failed"
+        assert cb.failures()[u].error == "DeadlineExceededError"
+
+    def test_default_deadline_ms_applies(self, tiny):
+        model, cfg = tiny
+        cb = _cb(model, default_deadline_ms=0.0)
+        p = (np.arange(8) % cfg.vocab_size).astype(np.int64)
+        u = cb.add_request(p, max_new_tokens=4)
+        cb.drain()
+        assert cb.status(u) == "failed"
+
+    def test_queue_limit_typed_backpressure(self, tiny):
+        model, cfg = tiny
+        cb = _cb(model, queue_limit=2)
+        p = (np.arange(6) % cfg.vocab_size).astype(np.int64)
+        cb.add_request(p, max_new_tokens=2)
+        cb.add_request(p.copy(), max_new_tokens=2)
+        with pytest.raises(EngineBusyError, match="queue_limit=2"):
+            cb.add_request(p.copy(), max_new_tokens=2)
+        cb.drain()                       # pressure drains; engine fine
+        assert cb.health()["done"] == 2
+
+    def test_cancel_queued_and_inflight(self, tiny, ref_engine):
+        model, cfg = tiny
+        rng = np.random.RandomState(4)
+        pa = rng.randint(0, cfg.vocab_size, (8,)).astype(np.int64)
+        pb = rng.randint(0, cfg.vocab_size, (7,)).astype(np.int64)
+        cb = _cb(model, max_batch=1)
+        ua = cb.add_request(pa, max_new_tokens=8)
+        ub = cb.add_request(pb, max_new_tokens=4)   # waits behind ua
+        while cb.status(ua) != "decode":
+            cb.step()
+        assert cb.cancel(ua) is True                # in-flight cancel
+        assert cb.status(ua) == "cancelled"
+        with pytest.raises(RequestCancelledError):
+            cb.result(ua)
+        cb.drain()
+        np.testing.assert_array_equal(cb.result(ub),
+                                      ref_gen(ref_engine, pb, 4))
+        assert cb.cancel(ub) is False               # already done
+        with pytest.raises(UnknownRequestError):
+            cb.cancel(12345)
+        _assert_no_leak(cb)
+
+    def test_pool_pressure_evicts_cache_before_rejecting(self, tiny):
+        """Graceful degradation: a full-pool admission evicts idle
+        prefix-cache pages instead of bouncing the request."""
+        model, cfg = tiny
+        cb = ContinuousBatchingEngine(model, max_len=32, page_size=8,
+                                      max_batch=1)
+        pa = (np.arange(16) % cfg.vocab_size).astype(np.int64)
+        pb = ((np.arange(16) + 7) % cfg.vocab_size).astype(np.int64)
+        cb.generate_many([pa], max_new_tokens=16)   # cache now holds pages
+        assert len(cb._prefix) > 0
+        out = cb.generate_many([pb], max_new_tokens=16)  # needs the pool
+        assert out[0].size == 32                    # served, not rejected
+
+
+# -- serving: typed introspection -------------------------------------------
+class TestTypedIntrospection:
+    def test_result_unknown_and_inflight(self, tiny):
+        model, cfg = tiny
+        cb = _cb(model)
+        with pytest.raises(UnknownRequestError, match="unknown request"):
+            cb.result(999)
+        with pytest.raises(UnknownRequestError):
+            cb.status(999)
+        u = cb.add_request((np.arange(6) % cfg.vocab_size).astype(np.int64),
+                           max_new_tokens=2)
+        with pytest.raises(RequestNotFinishedError, match="queued"):
+            cb.result(u)
+        assert len(cb) == 1 and cb.pending() == [u]
+        cb.drain()
+        assert len(cb) == 0 and cb.pending() == []
+
+    def test_drain_empty_engine_returns_empty(self, tiny):
+        model, cfg = tiny
+        cb = _cb(model)
+        assert cb.drain() == {}                     # no hang, no raise
+
+    def test_health_snapshot_shape(self, tiny):
+        model, cfg = tiny
+        cb = _cb(model, queue_limit=8)
+        h = cb.health()
+        for k in ("queued", "running", "slots_total", "pages_free",
+                  "pages_total", "prefix_pages", "done", "failed",
+                  "cancelled", "failures", "deadline_expiries", "steps"):
+            assert k in h, k
+        assert h["pages_free"] == h["pages_total"]
+        assert h["queue_limit"] == 8
+
+
+# -- allocator diagnostics (satellite) --------------------------------------
+class TestAllocatorDiagnostics:
+    def test_double_free_names_page_and_refcount(self):
+        a = PageAllocator(4)
+        pg = a.alloc()
+        a.free([pg])
+        with pytest.raises(RuntimeError,
+                           match=rf"double free of page {pg}.*refcount"):
+            a.free([pg])
+
+    def test_share_free_page_names_refcount(self):
+        a = PageAllocator(4)
+        with pytest.raises(RuntimeError,
+                           match=r"share\(\) of free page 2 \(refcount 0"):
+            a.share(2)
+
+    def test_exhaustion_reports_pool_size(self):
+        a = PageAllocator(2)
+        a.alloc(), a.alloc()
+        with pytest.raises(EngineFullError, match=r"0 of 2 available"):
+            a.alloc()
+
+    def test_idle_engine_full_reports_need_vs_available(self, tiny):
+        model, cfg = tiny
+        cb = ContinuousBatchingEngine(model, max_len=32, page_size=8,
+                                      max_batch=1, prefix_cache=False)
+        held = [cb.allocator.alloc() for _ in range(3)]   # pin 3 of 4
+        cb.add_request((np.arange(16) % cfg.vocab_size).astype(np.int64),
+                       max_new_tokens=8)
+        with pytest.raises(EngineFullError,
+                           match=r"needs 3 KV pages.*1 of 4"):
+            cb.step()
+        cb.allocator.free(held)
+
+
+# -- checkpointing ----------------------------------------------------------
+def _tree(seed=0, scale=1.0):
+    rng = np.random.RandomState(seed)
+    return {"w": rng.randn(4, 3).astype(np.float32) * scale,
+            "b": rng.randn(3).astype(np.float32) * scale,
+            "step_count": np.int64(seed)}
+
+
+class TestAtomicCheckpoint:
+    def test_save_load_roundtrip_with_checksums(self, tmp_path):
+        st = _tree(1)
+        ckpt.save_state(st, str(tmp_path / "ck"), step=7)
+        got, index = ckpt.load_state(str(tmp_path / "ck"), like=st)
+        assert index["step"] == 7 and len(index["checksums"]) == 3
+        np.testing.assert_array_equal(got["w"], st["w"])
+        assert not any(".tmp-" in n for n in os.listdir(tmp_path))
+
+    def test_commit_crash_leaves_previous_intact(self, tmp_path):
+        """Crash between temp-write and rename: the old save survives
+        and resume picks it."""
+        root = str(tmp_path / "run")
+        ckpt.save_checkpoint(_tree(1), root, step=1)
+        with inject("ckpt.commit", nth=1):
+            with pytest.raises(InjectedFault):
+                ckpt.save_checkpoint(_tree(2), root, step=2)
+        assert ckpt.available_steps(root) == [1]
+        got, index = ckpt.load_latest(root, like=_tree(0))
+        assert index["step"] == 1
+        np.testing.assert_array_equal(got["w"], _tree(1)["w"])
+        # no torn temp dir left behind to confuse the next scan
+        assert not any(".tmp-" in n for n in os.listdir(root))
+
+    def test_hard_crash_torn_tempdir_is_skipped(self, tmp_path):
+        """A REAL crash (no cleanup) leaves the temp dir on disk; the
+        resume walk must not even consider it."""
+        root = tmp_path / "run"
+        ckpt.save_checkpoint(_tree(1), str(root), step=1)
+        torn = root / "step_00000002.tmp-9999-deadbeef"
+        torn.mkdir()
+        (torn / "leaf_0.npy").write_bytes(b"garbage")
+        assert ckpt.available_steps(str(root)) == [1]
+        _, index = ckpt.load_latest(str(root), like=_tree(0))
+        assert index["step"] == 1
+
+    def test_crash_mid_swap_recovers_from_old_survivor(self, tmp_path):
+        """A hard crash between the two renames of a replace-existing
+        commit parks the committed save at `<path>.old-*`; readers must
+        find it."""
+        root = str(tmp_path / "run")
+        ckpt.save_checkpoint(_tree(1), root, step=1)
+        path = ckpt.step_dir(root, 1)
+        os.rename(path, path + ".old-deadbeef")   # simulate the window
+        assert ckpt.available_steps(root) == [1]
+        got, index = ckpt.load_latest(root, like=_tree(0))
+        assert index["step"] == 1
+        np.testing.assert_array_equal(got["w"], _tree(1)["w"])
+
+    def test_corrupt_leaf_detected_and_skipped(self, tmp_path):
+        root = str(tmp_path / "run")
+        ckpt.save_checkpoint(_tree(1), root, step=1)
+        ckpt.save_checkpoint(_tree(2), root, step=2)
+        # bit-rot a leaf of step 2 (manifest checksum now disagrees)
+        leaf = os.path.join(ckpt.step_dir(root, 2), "leaf_0.npy")
+        raw = bytearray(open(leaf, "rb").read())
+        raw[-1] ^= 0xFF
+        open(leaf, "wb").write(bytes(raw))
+        with pytest.raises(ckpt.CheckpointCorruptError,
+                           match="checksum mismatch"):
+            ckpt.load_state(ckpt.step_dir(root, 2))
+        got, index = ckpt.load_latest(root, like=_tree(0))
+        assert index["step"] == 1           # fell back past the corruption
+        np.testing.assert_array_equal(got["w"], _tree(1)["w"])
+
+    def test_missing_leaf_is_torn(self, tmp_path):
+        path = str(tmp_path / "ck")
+        ckpt.save_state(_tree(1), path, step=1)
+        os.remove(os.path.join(path, "leaf_1.npy"))
+        with pytest.raises(ckpt.CheckpointCorruptError, match="torn"):
+            ckpt.load_state(path)
+
+    def test_write_leaf_fault_cleans_temp(self, tmp_path):
+        root = str(tmp_path / "run")
+        with inject("ckpt.write_leaf", nth=2):
+            with pytest.raises(InjectedFault):
+                ckpt.save_checkpoint(_tree(1), root, step=1)
+        assert ckpt.available_steps(root) == []
+        assert not any(".tmp-" in n for n in os.listdir(root))
+        with pytest.raises(FileNotFoundError, match="no valid checkpoint"):
+            ckpt.load_latest(root)
+
+    def test_resave_same_path_stays_atomic(self, tmp_path):
+        path = str(tmp_path / "ck")
+        ckpt.save_state(_tree(1), path, step=1)
+        ckpt.save_state(_tree(2), path, step=2)
+        got, index = ckpt.load_state(path, like=_tree(0))
+        assert index["step"] == 2
+        np.testing.assert_array_equal(got["w"], _tree(2)["w"])
+        assert not any(".old-" in n for n in os.listdir(tmp_path))
+
+    def test_legacy_index_layout_still_loads(self, tmp_path):
+        """Pre-atomic saves (index.json, no checksums) stay readable."""
+        path = tmp_path / "legacy"
+        path.mkdir()
+        st = _tree(3)
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(st)
+        for i, leaf in enumerate(leaves):
+            np.save(str(path / f"leaf_{i}.npy"), np.asarray(leaf))
+        (path / "index.json").write_text(json.dumps(
+            {"n_leaves": len(leaves), "step": 9, "treedef": str(treedef)}))
+        got, index = ckpt.load_state(str(path), like=st)
+        assert index["step"] == 9
+        np.testing.assert_array_equal(got["w"], st["w"])
+
+
+class TestAsyncAndPreemption:
+    def test_async_writer_error_propagates(self, tmp_path):
+        with inject("ckpt.write_leaf", nth=1):
+            ckpt.save_state_async(_tree(1), str(tmp_path / "ck"), step=1)
+            with pytest.raises(InjectedFault):
+                ckpt.wait_until_finished()
+        ckpt.wait_until_finished()          # error queue drained
+
+    def test_preemption_flushes_async_save(self, tmp_path):
+        root = str(tmp_path / "run")
+        final = []
+        ckpt.install_preemption_hook(
+            callback=lambda: final.append(
+                ckpt.save_checkpoint(_tree(5), root, step=5)))
+        ckpt.save_checkpoint(_tree(4), root, step=4, async_=True)
+        ckpt.flush_on_preemption()          # what SIGTERM triggers
+        assert ckpt.available_steps(root) == [4, 5]
+        _, index = ckpt.load_latest(root)
+        assert index["step"] == 5 and final
+        ckpt.install_preemption_hook(callback=None)
+
+    def test_handler_exits_after_flush(self, tmp_path):
+        import signal as _signal
+        assert ckpt.install_preemption_hook(callback=None) is True
+        with pytest.raises(SystemExit):
+            ckpt._preemption_handler(_signal.SIGTERM, None)
+
+    def test_elastic_exit_flushes_pending(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        root = str(tmp_path / "run")
+        m = ElasticManager("127.0.0.1:8910", job_id="t")
+        m.register()
+        ckpt.save_checkpoint(_tree(6), root, step=6, async_=True)
+        m.exit(completed=True)
+        assert ckpt.available_steps(root) == [6]   # committed before exit
+
+
+class TestRendezvousRetry:
+    def test_store_connect_retries_through_faults(self):
+        """The elastic TCPStore adapter retries a flaky connect with
+        backoff instead of dying on the first refusal."""
+        from paddle_tpu.failsafe import retry_with_backoff, fault_point
+        attempts = []
+
+        def _connect():
+            fault_point("dist.store_connect")
+            return "connected"
+
+        with inject("dist.store_connect", nth=1):
+            out = retry_with_backoff(
+                _connect, retries=3, base_delay=0.01,
+                sleep=lambda d: attempts.append(d))
+        assert out == "connected" and len(attempts) == 1
+
+
+# -- chaos soak (acceptance) ------------------------------------------------
+@pytest.mark.slow
+class TestChaosSoak:
+    def test_twenty_requests_under_random_faults(self, tiny, ref_engine):
+        """The acceptance contract: ~20 ragged requests stream through
+        an engine with seeded probabilistic faults on decode, prefill,
+        and page allocation, plus a couple of tight TTLs. The engine
+        never dies; every request ends done or typed-failed; every
+        DONE output is byte-identical to the fault-free reference; all
+        pages come back."""
+        model, cfg = tiny
+        rng = np.random.RandomState(42)
+        n_req = 20
+        lens = rng.randint(3, 14, n_req)
+        budgets = rng.randint(3, 9, n_req)
+        arrivals = np.cumsum(rng.poisson(2, n_req))
+        arrivals -= arrivals[0]
+        prompts = [rng.randint(0, cfg.vocab_size, (int(t),))
+                   .astype(np.int64) for t in lens]
+        refs = [ref_gen(ref_engine, prompts[i], int(budgets[i]))
+                for i in range(n_req)]
+
+        cb = ContinuousBatchingEngine(model, max_len=64, page_size=8,
+                                      max_batch=4, prefill_chunk=8)
+        uids = {}
+        with inject("cb.decode", p=0.02, seed=5, times=None), \
+                inject("cb.prefill", p=0.02, seed=9, times=None), \
+                inject("page.alloc", p=0.01, seed=11, times=None):
+            pending = list(range(n_req))
+            tick = 0
+            while pending or len(cb):
+                while pending and arrivals[pending[0]] <= tick:
+                    i = pending.pop(0)
+                    # every 7th request carries a tight TTL
+                    ttl = 6 if i % 7 == 3 else None
+                    uids[i] = cb.add_request(prompts[i],
+                                             int(budgets[i]),
+                                             ttl_steps=ttl)
+                if not cb.step() and pending:
+                    tick = int(arrivals[pending[0]])
+                else:
+                    tick += 1
+
+        n_done = n_failed = 0
+        for i, u in uids.items():
+            state = cb.status(u)
+            assert state in ("done", "failed"), (i, state)
+            if state == "done":
+                n_done += 1
+                np.testing.assert_array_equal(
+                    cb.result(u), refs[i],
+                    err_msg=f"survivor {i} diverged from fault-free run")
+            else:
+                n_failed += 1
+                f = cb.failures()[u]
+                assert f.uid == u and f.stage in (
+                    "admit", "prefill", "decode", "deadline"), f
+        assert n_done + n_failed == n_req
+        assert n_done > 0, "soak produced no survivors to compare"
+        assert n_failed > 0, "soak injected no effective faults"
+        _assert_no_leak(cb)
+        h = cb.health()
+        assert h["failures"] == n_failed and h["done"] >= n_done

@@ -13,6 +13,10 @@ vs_baseline: achieved MFU / 0.45 (the BASELINE.md north-star MFU target).
 The TPU backend is initialized with retry+backoff: a transient
 backend-unavailable error must degrade to a recorded JSON error (or a
 successful retry), never a crash without output (VERDICT round-1 weak #2).
+When no TPU is reachable at all, the bench re-runs the 350M config in a
+fresh JAX_PLATFORMS=cpu subprocess and emits the metric set tagged
+"backend": "cpu-fallback" with exit code 0 (VERDICT round-5: every round
+must leave a parseable BENCH artifact).
 """
 import json
 import os
@@ -56,6 +60,9 @@ def _init_backend_with_retry(retries=5, base_delay=5.0, probe_timeout=120.0):
             f"(axon tunnel down?)")
         if isinstance(last, TimeoutError):
             break  # a hung probe thread cannot be retried in-process
+        if "not in the list of known backends" in str(last):
+            break  # a misconfigured backend name never becomes healthy
+            # (transient tunnel errors — UNAVAILABLE etc — still retry)
         if attempt == retries - 1:
             break
         delay = base_delay * (2 ** attempt)
@@ -169,15 +176,21 @@ def _run_config(which):
            "n_params": n, "backend": devs[0].platform})
 
 
-def _run_config_subprocess(which, timeout=1800):
+def _run_config_subprocess(which, timeout=1800, env_override=None):
     """Each config gets a FRESH process (and thus a fresh chip): the axon
     tunnel overcommits HBM instead of failing allocation, so residue from
     a previous config silently pages the next one to host memory (r5:
-    in-process 1.3B measured 13% MFU vs 52% fresh — 4x off, same code)."""
+    in-process 1.3B measured 13% MFU vs 52% fresh — 4x off, same code).
+    env_override: extra environment for the child (the cpu-fallback path
+    forces JAX_PLATFORMS=cpu this way — the parent's jax may be wedged on
+    a dead tunnel, a fresh child is not)."""
     import subprocess
+    env = None
+    if env_override:
+        env = {**os.environ, **env_override}
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--config", which],
-        capture_output=True, text=True, timeout=timeout)
+        capture_output=True, text=True, timeout=timeout, env=env)
     for line in reversed(proc.stdout.strip().splitlines()):
         try:
             d = json.loads(line)
@@ -193,15 +206,27 @@ def _run_config_subprocess(which, timeout=1800):
 
 
 def _run():
-    r350 = _run_config_subprocess("llama350m")
-    extra = {"llama350m_tokens_per_sec_per_chip": r350["tokens_per_sec"],
-             "llama350m_mfu": r350["mfu"],
-             "llama350m_batch_size": r350["batch_size"]}
+    extra = {}
+    try:
+        r350 = _run_config_subprocess("llama350m")
+    except Exception as e:  # noqa: BLE001 — backend down, not a code bug
+        # no TPU reachable: degrade to a CPU-captured metric set instead
+        # of rc=1 with no artifact — every round must leave a parseable
+        # BENCH line (VERDICT round-5). A fresh subprocess pinned to
+        # JAX_PLATFORMS=cpu sidesteps whatever wedged the TPU probe.
+        extra["tpu_error"] = f"{type(e).__name__}: {e}"[:300]
+        r350 = _run_config_subprocess(
+            "llama350m", env_override={"JAX_PLATFORMS": "cpu"})
+        r350["backend"] = "cpu-fallback"
+    extra.update({
+        "llama350m_tokens_per_sec_per_chip": r350["tokens_per_sec"],
+        "llama350m_mfu": r350["mfu"],
+        "llama350m_batch_size": r350["batch_size"]})
     headline = ("llama350m_tokens_per_sec_per_chip",
                 r350["tokens_per_sec"], r350["mfu"], r350["recompute"])
 
     # HEADLINE metric (round-5): the 1.3B d=128 config, TPU only.
-    if r350["backend"] not in ("cpu",):
+    if r350["backend"] not in ("cpu", "cpu-fallback"):
         try:
             r13 = _run_config_subprocess("llama1p3b")
             extra["llama1p3b_params"] = r13["n_params"]

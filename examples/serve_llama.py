@@ -50,6 +50,12 @@ def main():
                     help="bounded admission queue: add_request past this "
                          "depth raises EngineBusyError backpressure "
                          "(scheduler mode)")
+    ap.add_argument("--decode-block", type=int, default=1,
+                    help="K > 1: device-resident multi-step decode — one "
+                         "compiled dispatch runs a ragged prefill phase "
+                         "+ K decode steps (on-device sampling/EOS); "
+                         "the host intervenes every K tokens "
+                         "(scheduler mode; see docs/serving.md)")
     args = ap.parse_args()
 
     import paddle_tpu as paddle
@@ -89,7 +95,8 @@ def main():
             max_batch=max(2, g["bs"]), quant=quant,
             weight_dtype=weight_dtype,
             queue_limit=args.queue_limit,
-            default_deadline_ms=args.deadline_ms)
+            default_deadline_ms=args.deadline_ms,
+            decode_block=args.decode_block)
         rng = np.random.RandomState(0)
         # ragged prompts; 1 shares 0's prefix (once 0 finishes prefill,
         # the cache turns the shared pages into refcounted read-only
@@ -112,10 +119,13 @@ def main():
                 # not an engine crash
                 print(f"  request {i} shed by backpressure: {e}")
         engine.drain()
+        fused = (f"{engine.fused_blocks} fused blocks "
+                 f"({engine.chained_blocks} pipelined), "
+                 if args.decode_block > 1 else "")
         print(f"model={args.model} quant={args.quant} scheduler: "
               f"{len(submitted)} ragged requests in "
               f"{engine.steps} steps ({engine.prefill_steps} prefill / "
-              f"{engine.decode_steps} decode), "
+              f"{engine.decode_steps} decode), {fused}"
               f"{engine._prefix.hits} prefix-page hits, "
               f"{engine.cow_copies} copy-on-writes")
         for i, u in submitted:

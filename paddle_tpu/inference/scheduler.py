@@ -44,7 +44,9 @@ import jax
 import jax.numpy as jnp
 
 from ..failsafe import InjectedFault, fault_point
-from ..ops.pallas.paged_attention import expand_kv_heads, paged_attention
+from ..failsafe import armed as _faults_armed
+from ..ops.pallas.paged_attention import (expand_kv_heads, paged_attention,
+                                          ragged_paged_attention)
 from .serving import LLMEngine, EngineFullError, _rms, _mm
 
 QUEUED, PREFILL, DECODE, DONE, FAILED, CANCELLED = \
@@ -232,13 +234,24 @@ class PrefixCache:
 
     def evict(self, n_pages, allocator, protect=()):
         """Free up to `n_pages` cache-only pages (refcount 1), oldest
-        first, skipping `protect`. Returns the number freed."""
+        first, skipping `protect`. Returns the number freed.
+
+        O(1) amortized: entries pop from the LRU head; an entry that
+        cannot be evicted right now — protected for the current
+        admission, or refcount > 1 because a running request still
+        reads it — is BY DEFINITION in use, so it is moved to the MRU
+        end rather than rescanned by every future eviction (the old
+        linear scan walked every pinned chain again on each call). Each
+        entry is examined at most once per call."""
         freed = 0
-        for key in list(self._entries):
-            if freed >= n_pages:
-                break
+        scanned = 0
+        limit = len(self._entries)
+        while freed < n_pages and scanned < limit and self._entries:
+            key = next(iter(self._entries))
             page = self._entries[key]
+            scanned += 1
             if page in protect or allocator.refcount(page) != 1:
+                self._entries.move_to_end(key)
                 continue
             self._drop(key, page)
             allocator.free([page])
@@ -264,6 +277,34 @@ class PrefixCache:
                 del self._children[key[0]]
 
 
+class _FusedBlock:
+    """One in-flight fused dispatch (decode_block > 1): which requests
+    rode it, plus the device futures the host has not yet fetched. The
+    carries (tok/lens/act/rem/key) stay ON DEVICE so the next block can
+    be dispatched from them without a host round trip (double-buffered
+    pipelining)."""
+
+    __slots__ = ("w", "K", "pf_items", "dec_items", "tables", "eos_dev",
+                 "first", "toks", "emitted", "tok_fin", "lens_fin",
+                 "act_fin", "rem_fin", "has_prefill", "has_decode",
+                 "chained")
+
+    def __init__(self, w, K):
+        self.w = w
+        self.K = K
+        self.pf_items = []          # [(Request, chunk-end position)]
+        self.dec_items = []         # [Request]
+        self.tables = None          # device [w, mp] (reused by chains)
+        self.eos_dev = None         # device [w] eos ids (-1 = none)
+        self.first = None           # device [w] first tokens (prefill)
+        self.toks = None            # device [K, w] sampled tokens
+        self.emitted = None         # device [K, w] bool: token is real
+        self.tok_fin = self.lens_fin = self.act_fin = self.rem_fin = None
+        self.has_prefill = False
+        self.has_decode = False
+        self.chained = False
+
+
 class ContinuousBatchingEngine(LLMEngine):
     """Request-at-a-time serving over the paged-KV engine.
 
@@ -276,6 +317,17 @@ class ContinuousBatchingEngine(LLMEngine):
         max_batch). A step runs at the smallest bucket covering the
         highest live slot.
       prefix_cache: enable content-addressed prompt-page sharing.
+      decode_block: K > 1 runs the hot loop DEVICE-RESIDENT — one
+        compiled dispatch covers a ragged prefill phase plus K decode
+        steps (on-device sampling, per-slot EOS/budget flags); the host
+        intervenes every K tokens to retire/admit/refill, and in a
+        pure-decode steady state dispatches block N+1 before fetching
+        block N's tokens. Greedy outputs stay byte-identical to K=1;
+        deadlines/TTLs round UP to block boundaries and fault points
+        fire once per block (docs/serving.md).
+      ragged_kernel: force (True/False) the Pallas ragged-prefill
+        kernel; default None = kernel on TPU, dense gathered math under
+        interpret/CPU.
       queue_limit: bounded admission queue — add_request past this depth
         raises EngineBusyError (typed backpressure) instead of growing
         an unbounded backlog. None (default) = unbounded.
@@ -299,10 +351,22 @@ class ContinuousBatchingEngine(LLMEngine):
                  prefill_chunk=None, slot_buckets=None, prefix_cache=True,
                  queue_limit=None, default_deadline_ms=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                 seed=0, **kw):
+                 seed=0, decode_block=1, ragged_kernel=None, **kw):
         super().__init__(model, max_len=max_len, page_size=page_size,
                          max_batch=max_batch, **kw)
         self.prefill_chunk = int(prefill_chunk or page_size)
+        # decode_block=K > 1: device-resident multi-step decode — ONE
+        # compiled dispatch runs a ragged-prefill phase plus K decode
+        # steps (on-device sampling, per-slot EOS/budget flags); the
+        # host only intervenes at block boundaries. K=1 keeps the
+        # original one-program-per-step path. See docs/serving.md
+        # "Block-granularity scheduling".
+        self.decode_block = max(1, int(decode_block))
+        # ragged_kernel: fused-prefill attention backend. None (default)
+        # = the Pallas ragged kernel on TPU, the dense gathered path
+        # under interpret/CPU (the dense path is what is byte-identical
+        # to the per-step engine); True/False force either.
+        self.ragged_kernel = ragged_kernel
         if slot_buckets is None:
             slot_buckets = []
             w = 1
@@ -330,6 +394,10 @@ class ContinuousBatchingEngine(LLMEngine):
         self._prefer_decode = False
         self._cb_step_fns = {}
         self._cb_prefill_fn = None
+        self._cb_fused_fns = {}
+        self._pf_dummies = {}
+        self._pending = None            # in-flight fused block (its
+        #                                 readback not yet processed)
         self._copy_fn = None
 
         # observability (tests + the serving bench assert on these)
@@ -342,6 +410,9 @@ class ContinuousBatchingEngine(LLMEngine):
         self.failure_count = 0
         self.cancellations = 0
         self.deadline_expiries = 0
+        self.fused_blocks = 0
+        self.chained_blocks = 0         # blocks dispatched BEFORE the
+        #                                 previous block's readback
         self._slot_used = [False] * max_batch
 
     # -- public ------------------------------------------------------------
@@ -407,34 +478,36 @@ class ContinuousBatchingEngine(LLMEngine):
         return True
 
     def step(self):
-        """One engine iteration: shed expired deadlines, admit what
+        """One engine iteration. Returns False when there is nothing to
+        do.
+
+        decode_block == 1 (default): shed expired deadlines, admit what
         fits, then run ONE compiled program — a prefill chunk or a
         decode step (alternating when both have work, so long prompts
-        don't stall live decodes). Returns False when there is nothing
-        to do.
+        don't stall live decodes).
+
+        decode_block == K > 1: one BLOCK — a single compiled dispatch
+        covering a ragged prefill phase (every prefilling slot advances
+        one chunk) plus K device-resident decode steps with on-device
+        sampling and per-slot EOS/budget retirement flags; the host
+        intervenes only here, at the block boundary. In a pure-decode
+        steady state the next block is dispatched BEFORE this block's
+        tokens are fetched (double-buffered readback), so host
+        bookkeeping overlaps device compute.
 
         Per-request isolation: a fault raised at a request boundary
         (its admission, its prefill chunk, its slice of the decode
-        batch) retires THAT request with a RequestFailure record and the
-        step carries on."""
+        batch/block) retires THAT request with a RequestFailure record
+        and the step carries on. In fused mode faults are checked at
+        host sync points, i.e. once per block per request."""
+        if self.decode_block > 1:
+            return self._fused_step()
         self._expire_deadlines()
         self._admit()
         prefills = [r for r in self._slots if r and r.state == PREFILL]
         decodes = [r for r in self._slots if r and r.state == DECODE]
         if not prefills and not decodes:
-            if self._queue:
-                # nothing admitted AND nothing running: the queue head
-                # cannot fit even with every slot idle — a real capacity
-                # bug, not back-pressure
-                head = self._queue[0]
-                need = self._pages_needed(head.t0, head.max_new_tokens)
-                raise EngineFullError(
-                    f"request {head.uid} cannot be admitted into an idle "
-                    f"engine: needs {need} KV pages but only "
-                    f"{self.allocator.available} of "
-                    f"{self.allocator.n_pages} are free (page pool "
-                    "pinned?)")
-            return False
+            return self._idle_or_raise()
         self.steps += 1
         try:
             if prefills and (not decodes or not self._prefer_decode):
@@ -547,6 +620,9 @@ class ContinuousBatchingEngine(LLMEngine):
             "failures": self.failure_count,
             "deadline_expiries": self.deadline_expiries,
             "cow_copies": self.cow_copies,
+            "decode_block": self.decode_block,
+            "fused_blocks": self.fused_blocks,
+            "chained_blocks": self.chained_blocks,
         }
 
     def generate_many(self, prompts, max_new_tokens=32, eos_token_id=None):
@@ -747,63 +823,79 @@ class ContinuousBatchingEngine(LLMEngine):
         ids_chunk[0, :end - start] = r.ids[start:end]
         if self._cb_prefill_fn is None:
             self._cb_prefill_fn = self._build_cb_prefill(chunk)
+        t_dev = time.perf_counter()
         logits, self.k_pages, self.v_pages = self._cb_prefill_fn(
             self.weights, jnp.asarray(ids_chunk), self.k_pages,
             self.v_pages, jnp.asarray(self._tables_np[r.slot:r.slot + 1]),
             jnp.int32(start), jnp.int32(r.t0))
+        self.device_seconds += time.perf_counter() - t_dev
         r.filled = end
         if end < r.t0:
             return
         # prompt complete: publish full prompt pages to the prefix cache
         # (before the first decode write, so concurrent requests share),
         # then sample the first token from the final chunk's logits
-        if self._prefix is not None:
-            key = ()
-            p = self.page_size
-            for j in range(r.t0 // p):
-                key = self._prefix.insert(key, r.ids[j * p:(j + 1) * p],
-                                          r.pages[j], self.allocator)
+        self._publish_prefix(r)
+        t_dev = time.perf_counter()
         tok = self._sample_tokens(logits)[0]
+        self.device_seconds += time.perf_counter() - t_dev
         self._lens_np[r.slot] = r.t0
         r.state = DECODE
         self._push_token(r, tok)
 
+    def _publish_prefix(self, r):
+        """Make a completed prompt's FULL pages shareable (the partial
+        tail page stays private — decode writes land there)."""
+        if self._prefix is None:
+            return
+        key = ()
+        p = self.page_size
+        for j in range(r.t0 // p):
+            key = self._prefix.insert(key, r.ids[j * p:(j + 1) * p],
+                                      r.pages[j], self.allocator)
+
     # -- decode ------------------------------------------------------------
-    def _build_cb_step(self, w):
-        """Decode step at slot-bucket width w: one token for every slot,
+    def _cb_decode_math(self, W, tok, k_pages_all, v_pages_all, tables,
+                        lens, active, w):
+        """One decode step at slot-bucket width w, fully traceable
+        (shared by the per-step jit and the fused multi-step scan, so
+        both paths run byte-identical math): one token for every slot,
         inactive slots write nothing (scatter-drop) and skip attention
         compute/DMA via the kernel's active mask."""
         p = self.page_size
+        h = jnp.take(W["emb"], tok[:, None], axis=0).astype(
+            self.kv_dtype)
+        pos_ids = lens[:, None]
+        oob = jnp.int32(self.n_pages * p)
+        new_k, new_v = [], []
+        for li, wset in enumerate(W["layers"]):
+            q, k, v = self._layer_qkv(W, wset, h, pos_ids)
+            slots = (tables[jnp.arange(w), lens // p] * p + lens % p)
+            slots = jnp.where(active, slots, oob)
+            kp = k_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+            vp = v_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+            kp = kp.at[slots].set(k[:, 0].astype(self.kv_dtype),
+                                  mode="drop")
+            vp = vp.at[slots].set(v[:, 0].astype(self.kv_dtype),
+                                  mode="drop")
+            kp = kp.reshape(self.n_pages, p, self.nh_kv, self.hd)
+            vp = vp.reshape(self.n_pages, p, self.nh_kv, self.hd)
+            new_k.append(kp)
+            new_v.append(vp)
+            attn = paged_attention(
+                q[:, 0], kp, vp, tables,
+                jnp.where(active, lens + 1, 0),
+                interpret=self.interpret,
+                active=active.astype(jnp.int32))
+            h = self._layer_tail(W, wset, h, attn[:, None])
+        h = _rms(h, W["norm"], W["eps"])
+        logits = _mm(h, W["head"], self.interpret)
+        return logits[:, 0], new_k, new_v
 
+    def _build_cb_step(self, w):
         def step(W, tok, k_pages_all, v_pages_all, tables, lens, active):
-            h = jnp.take(W["emb"], tok[:, None], axis=0).astype(
-                self.kv_dtype)
-            pos_ids = lens[:, None]
-            oob = jnp.int32(self.n_pages * p)
-            new_k, new_v = [], []
-            for li, wset in enumerate(W["layers"]):
-                q, k, v = self._layer_qkv(W, wset, h, pos_ids)
-                slots = (tables[jnp.arange(w), lens // p] * p + lens % p)
-                slots = jnp.where(active, slots, oob)
-                kp = k_pages_all[li].reshape(-1, self.nh_kv, self.hd)
-                vp = v_pages_all[li].reshape(-1, self.nh_kv, self.hd)
-                kp = kp.at[slots].set(k[:, 0].astype(self.kv_dtype),
-                                      mode="drop")
-                vp = vp.at[slots].set(v[:, 0].astype(self.kv_dtype),
-                                      mode="drop")
-                kp = kp.reshape(self.n_pages, p, self.nh_kv, self.hd)
-                vp = vp.reshape(self.n_pages, p, self.nh_kv, self.hd)
-                new_k.append(kp)
-                new_v.append(vp)
-                attn = paged_attention(
-                    q[:, 0], kp, vp, tables,
-                    jnp.where(active, lens + 1, 0),
-                    interpret=self.interpret,
-                    active=active.astype(jnp.int32))
-                h = self._layer_tail(W, wset, h, attn[:, None])
-            h = _rms(h, W["norm"], W["eps"])
-            logits = _mm(h, W["head"], self.interpret)
-            return logits[:, 0], new_k, new_v
+            return self._cb_decode_math(W, tok, k_pages_all, v_pages_all,
+                                        tables, lens, active, w)
 
         return jax.jit(step, donate_argnums=(2, 3))
 
@@ -824,14 +916,371 @@ class ContinuousBatchingEngine(LLMEngine):
         if fn is None:
             fn = self._build_cb_step(w)
             self._cb_step_fns[w] = fn
+        t_dev = time.perf_counter()
         logits, self.k_pages, self.v_pages = fn(
             self.weights, jnp.asarray(self._tok_np[:w]), self.k_pages,
             self.v_pages, jnp.asarray(self._tables_np[:w]),
             jnp.asarray(self._lens_np[:w]), jnp.asarray(active))
         toks = self._sample_tokens(logits)
+        self.device_seconds += time.perf_counter() - t_dev
         for r in decodes:
             self._lens_np[r.slot] += 1
             self._push_token(r, toks[r.slot])
+
+    # -- fused multi-step decode (device-resident blocks) ------------------
+    def _idle_or_raise(self):
+        """Nothing running and nothing admitted: either truly idle
+        (False) or the queue head cannot fit an IDLE engine — a real
+        capacity bug, not back-pressure."""
+        if self._queue:
+            head = self._queue[0]
+            need = self._pages_needed(head.t0, head.max_new_tokens)
+            raise EngineFullError(
+                f"request {head.uid} cannot be admitted into an idle "
+                f"engine: needs {need} KV pages but only "
+                f"{self.allocator.available} of "
+                f"{self.allocator.n_pages} are free (page pool "
+                "pinned?)")
+        return False
+
+    def _build_cb_fused(self, w, with_prefill, with_decode):
+        """ONE compiled program for a whole scheduling block at slot
+        width w: a ragged prefill phase — every prefilling slot advances
+        one chunk at its OWN offset, in one dispatch — followed by
+        decode_block device-resident decode steps (lax.scan over the
+        same per-step math) with on-device sampling and per-slot
+        EOS/budget retirement flags. The host only sees the block's
+        outputs: sampled tokens, an emitted mask, and the final carries
+        (which the next block can consume WITHOUT a host round trip —
+        see _chain_block).
+
+        Ragged prefill attention: the Pallas ragged kernel
+        (per-slot q_start/ctx_len scalar prefetch) on TPU; under
+        interpret/CPU the dense gathered form, which is what stays
+        byte-identical to the per-step engine's chunk prefill."""
+        from ..models.generation import _sample
+        chunk = self.prefill_chunk
+        K = self.decode_block
+        p = self.page_size
+        mp = self.max_pages_per_seq
+        do_sample, temperature, top_k, top_p = self._sampling
+        use_kernel = (self.ragged_kernel is True) or \
+            (self.ragged_kernel is None and not self.interpret)
+
+        def prefill_phase(W, ids, k_pages_all, v_pages_all, tables,
+                          starts, ends, pf_act):
+            h = jnp.take(W["emb"], ids, axis=0).astype(self.kv_dtype)
+            pos = starts[:, None] + jnp.arange(chunk, dtype=jnp.int32)
+            oob = jnp.int32(self.n_pages * p)
+            ctx = jnp.minimum(starts + chunk, ends)
+            new_k, new_v = [], []
+            for li, wset in enumerate(W["layers"]):
+                q, k, v = self._layer_qkv(W, wset, h, pos)
+                slots = tables[jnp.arange(w)[:, None], pos // p] * p \
+                    + pos % p
+                # inactive slots and padded tails write NOTHING
+                ok_w = jnp.logical_and(pos < ends[:, None],
+                                       pf_act[:, None])
+                slots = jnp.where(ok_w, slots, oob)
+                kp = k_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+                vp = v_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+                kp = kp.at[slots].set(k.astype(self.kv_dtype),
+                                      mode="drop")
+                vp = vp.at[slots].set(v.astype(self.kv_dtype),
+                                      mode="drop")
+                kp = kp.reshape(self.n_pages, p, self.nh_kv, self.hd)
+                vp = vp.reshape(self.n_pages, p, self.nh_kv, self.hd)
+                new_k.append(kp)
+                new_v.append(vp)
+                if use_kernel:
+                    attn = ragged_paged_attention(
+                        q, kp, vp, tables, ctx, starts,
+                        active=pf_act.astype(jnp.int32),
+                        interpret=self.interpret)
+                else:
+                    ck = kp[tables].reshape(w, mp * p, self.nh_kv,
+                                            self.hd)
+                    cv = vp[tables].reshape(w, mp * p, self.nh_kv,
+                                            self.hd)
+                    ck = expand_kv_heads(ck, self.nh)
+                    cv = expand_kv_heads(cv, self.nh)
+                    logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck) \
+                        / math.sqrt(self.hd)
+                    kpos = jnp.arange(mp * p)[None, None, None, :]
+                    qpos = pos[:, None, :, None]
+                    logits = jnp.where(kpos <= qpos, logits, -1e30)
+                    wts = jax.nn.softmax(logits.astype(jnp.float32),
+                                         -1).astype(q.dtype)
+                    attn = jnp.einsum("bhqk,bkhd->bqhd", wts, cv)
+                h = self._layer_tail(W, wset, h, attn)
+            h = _rms(h, W["norm"], W["eps"])
+            last = jnp.clip(ends - 1 - starts, 0, chunk - 1)
+            h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)
+            logits = _mm(h_last, W["head"], self.interpret)
+            return logits[:, 0], new_k, new_v
+
+        def decode_scan(W, k_pages_all, v_pages_all, tables, tok, lens,
+                        act, rem, eos_ids, key):
+            def body(carry, _):
+                tok, lens, act, rem, key, kps, vps = carry
+                logits, kps, vps = self._cb_decode_math(
+                    W, tok, kps, vps, tables, lens, act, w)
+                key, sub = jax.random.split(key)
+                nxt = _sample(logits, sub, do_sample, temperature,
+                              top_k, top_p)
+                nxt = jnp.where(act, nxt.astype(tok.dtype), tok)
+                emit = act
+                rem = jnp.where(act, rem - 1, rem)
+                lens = jnp.where(act, lens + 1, lens)
+                # retire ON DEVICE at the request's own EOS (-1 sentinel
+                # never matches: token ids are non-negative) or budget —
+                # a retired slot stops writing KV and skips attention
+                # compute/DMA for the REST of the block
+                act = jnp.logical_and(
+                    act, jnp.logical_and(rem > 0, nxt != eos_ids))
+                return (nxt, lens, act, rem, key, kps, vps), (nxt, emit)
+
+            carry0 = (tok, lens, act, rem, key, k_pages_all, v_pages_all)
+            (tok, lens, act, rem, key, kps, vps), (toks, emitted) = \
+                jax.lax.scan(body, carry0, None, length=K)
+            return toks, emitted, tok, lens, act, rem, key, kps, vps
+
+        def fused(W, k_pages_all, v_pages_all, tables, pf_ids, pf_act,
+                  pf_start, pf_end, tok, lens, act, rem, eos_ids, key):
+            first = toks = emitted = None
+            if with_prefill:
+                pf_logits, k_pages_all, v_pages_all = prefill_phase(
+                    W, pf_ids, k_pages_all, v_pages_all, tables,
+                    pf_start, pf_end, pf_act)
+                key, sub = jax.random.split(key)
+                first = _sample(pf_logits, sub, do_sample, temperature,
+                                top_k, top_p)
+            if with_decode:
+                (toks, emitted, tok, lens, act, rem, key, k_pages_all,
+                 v_pages_all) = decode_scan(
+                    W, k_pages_all, v_pages_all, tables, tok, lens, act,
+                    rem, eos_ids, key)
+            return (first, toks, emitted, tok, lens, act, rem, key,
+                    k_pages_all, v_pages_all)
+
+        return jax.jit(fused, donate_argnums=(1, 2))
+
+    def _get_fused(self, w, with_prefill, with_decode):
+        key = (w, with_prefill, with_decode)
+        fn = self._cb_fused_fns.get(key)
+        if fn is None:
+            fn = self._build_cb_fused(w, with_prefill, with_decode)
+            self._cb_fused_fns[key] = fn
+        return fn
+
+    def _fused_step(self):
+        """One block-granular engine iteration (decode_block > 1):
+        process the previous block if one is still in flight, dispatch
+        the next, fetch and apply tokens. In a pure-decode steady state
+        the NEXT block is dispatched from this block's device carries
+        BEFORE this block's tokens are fetched, so the host's readback +
+        bookkeeping overlaps the device's compute."""
+        try:
+            if self._pending is not None:
+                blk = self._pending
+                self._pending = None
+            else:
+                blk = self._dispatch_block()
+                if blk is None:
+                    return False
+                if blk is True:        # every participant faulted at
+                    return True        # the sync point; still a step
+            if self._can_chain(blk):
+                self._pending = self._chain_block(blk)
+            self._process_block(blk)
+        except InjectedFault:
+            raise                      # faults fire at dispatch only
+        except Exception:
+            self._pending = None
+            self._abort_in_flight()
+            raise
+        return True
+
+    def _dispatch_block(self):
+        """Host sync point: shed deadlines, admit, check fault points
+        (block granularity — once per request per block), then dispatch
+        ONE fused program. Returns a _FusedBlock, True when every
+        participant faulted, or None when idle."""
+        self._expire_deadlines()
+        self._admit()
+        prefills = [r for r in self._slots if r and r.state == PREFILL]
+        decodes = [r for r in self._slots if r and r.state == DECODE]
+        if not prefills and not decodes:
+            self._idle_or_raise()      # raises on a stuck queue head
+            return None
+        live_pf, live_dec = [], []
+        for r in prefills:
+            try:
+                fault_point("cb.prefill", detail=f"uid={r.uid}")
+                live_pf.append(r)
+            except InjectedFault as e:
+                self._fail_request(r, "prefill", e)
+        for r in decodes:
+            try:
+                fault_point("cb.decode", detail=f"uid={r.uid}")
+                live_dec.append(r)
+            except InjectedFault as e:
+                self._fail_request(r, "decode", e)
+        if not live_pf and not live_dec:
+            self.steps += 1
+            return True
+        K = self.decode_block
+        chunk = self.prefill_chunk
+        top = max(r.slot for r in live_pf + live_dec)
+        w = next(b for b in self._slot_buckets if b > top)
+        blk = _FusedBlock(w, K)
+        pf_ids = np.zeros((w, chunk), np.int64)
+        pf_act = np.zeros(w, bool)
+        pf_start = np.zeros(w, np.int32)
+        pf_end = np.zeros(w, np.int32)
+        for r in live_pf:
+            start = r.filled
+            end = min(start + chunk, r.t0)
+            self._make_writable(r, start, end)
+            pf_ids[r.slot, :end - start] = r.ids[start:end]
+            pf_act[r.slot] = True
+            pf_start[r.slot] = start
+            pf_end[r.slot] = r.t0
+            blk.pf_items.append((r, end))
+        act = np.zeros(w, bool)
+        rem = np.zeros(w, np.int32)
+        eos = np.full(w, -1, np.int32)
+        for r in live_dec:
+            pos = int(self._lens_np[r.slot])
+            # the block writes KV at positions [pos, pos+K) while the
+            # slot stays active; CoW every shared page it can touch NOW
+            # (the only shareable page decode can reach is the prompt's
+            # partial tail page, so this copies exactly what the
+            # per-step path would)
+            hi = min(pos + K, r.t0 + r.max_new_tokens - 1)
+            self._make_writable(r, pos, max(hi, pos + 1))
+            self._tok_np[r.slot] = r.tok
+            act[r.slot] = True
+            rem[r.slot] = r.max_new_tokens - len(r.out)
+            if r.eos_token_id is not None:
+                eos[r.slot] = r.eos_token_id
+            blk.dec_items.append(r)
+        blk.has_prefill = bool(live_pf)
+        blk.has_decode = bool(live_dec)
+        fn = self._get_fused(w, blk.has_prefill, blk.has_decode)
+        blk.tables = jnp.asarray(self._tables_np[:w])
+        blk.eos_dev = jnp.asarray(eos)
+        t_dev = time.perf_counter()
+        (blk.first, blk.toks, blk.emitted, blk.tok_fin, blk.lens_fin,
+         blk.act_fin, blk.rem_fin, self._key, self.k_pages,
+         self.v_pages) = fn(
+            self.weights, self.k_pages, self.v_pages, blk.tables,
+            jnp.asarray(pf_ids), jnp.asarray(pf_act),
+            jnp.asarray(pf_start), jnp.asarray(pf_end),
+            jnp.asarray(self._tok_np[:w]), jnp.asarray(self._lens_np[:w]),
+            jnp.asarray(act), jnp.asarray(rem), blk.eos_dev, self._key)
+        self.device_seconds += time.perf_counter() - t_dev
+        self.fused_blocks += 1
+        # steps advance by the block's DEVICE micro-steps so TTL budgets
+        # stay comparable with the per-step engine (expiry itself is
+        # only checked here, at block boundaries — rounded UP)
+        self.steps += len(live_pf) + (K if live_dec else 0)
+        self.prefill_steps += len(live_pf)
+        self.decode_steps += K if live_dec else 0
+        return blk
+
+    def _can_chain(self, blk):
+        """Pipeline only in the pure-decode steady state where the next
+        block's inputs cannot depend on this block's tokens: no prefill
+        anywhere, nothing queued, no deadline/TTL holder (their expiry
+        is promised at SINGLE block boundaries), no armed fault points
+        (faults fire at host sync points), no copy-on-write pending, and
+        at least one request that must outlive this block."""
+        if blk.K <= 1 or not blk.has_decode or blk.has_prefill:
+            return False
+        if self._queue or self._pending is not None:
+            return False
+        if any(s is not None and s.state == PREFILL for s in self._slots):
+            return False
+        if _faults_armed():
+            return False
+        ok = False
+        for r in blk.dec_items:
+            if r.state != DECODE:
+                continue
+            if r.deadline is not None or r.ttl_steps is not None:
+                return False
+            if r.shared_idx:
+                return False
+            if r.max_new_tokens - len(r.out) > blk.K:
+                ok = True
+        return ok
+
+    def _chain_block(self, blk):
+        """Dispatch block N+1 straight from block N's device carries —
+        before N's tokens are fetched. No host state crosses: tables,
+        eos ids, tok/lens/act/rem all ride on device."""
+        chunk = self.prefill_chunk
+        w = blk.w
+        nxt = _FusedBlock(w, blk.K)
+        nxt.dec_items = blk.dec_items
+        nxt.tables = blk.tables
+        nxt.eos_dev = blk.eos_dev
+        nxt.has_decode = True
+        nxt.chained = True
+        fn = self._get_fused(w, False, True)
+        dummy = self._pf_dummies.get(w)
+        if dummy is None:
+            dummy = (jnp.asarray(np.zeros((w, chunk), np.int64)),
+                     jnp.asarray(np.zeros(w, bool)),
+                     jnp.asarray(np.zeros(w, np.int32)),
+                     jnp.asarray(np.zeros(w, np.int32)))
+            self._pf_dummies[w] = dummy
+        (nxt.first, nxt.toks, nxt.emitted, nxt.tok_fin, nxt.lens_fin,
+         nxt.act_fin, nxt.rem_fin, self._key, self.k_pages,
+         self.v_pages) = fn(
+            self.weights, self.k_pages, self.v_pages, blk.tables,
+            *dummy, blk.tok_fin, blk.lens_fin, blk.act_fin, blk.rem_fin,
+            blk.eos_dev, self._key)
+        self.fused_blocks += 1
+        self.chained_blocks += 1
+        self.steps += blk.K
+        self.decode_steps += blk.K
+        return nxt
+
+    def _process_block(self, blk):
+        """Fetch a block's tokens (the only blocking readback) and
+        replay them through the SAME retirement bookkeeping the
+        per-step path uses — host and device agree on EOS/budget by
+        construction, so _push_token retires exactly where the device's
+        active flag dropped."""
+        t_dev = time.perf_counter()
+        first = np.asarray(blk.first) if blk.has_prefill else None
+        if blk.has_decode:
+            toks = np.asarray(blk.toks)
+            emitted = np.asarray(blk.emitted)
+        self.device_seconds += time.perf_counter() - t_dev
+        for r, end in blk.pf_items:
+            if r.state != PREFILL or r.slot is None:
+                continue               # cancelled while in flight
+            r.filled = end
+            if end >= r.t0:
+                # prompt complete: publish pages, then its first token
+                # (sampled ON DEVICE from the final chunk's logits)
+                self._publish_prefix(r)
+                self._lens_np[r.slot] = r.t0
+                r.state = DECODE
+                self._push_token(r, int(first[r.slot]))
+        if blk.has_decode:
+            for k in range(blk.K):
+                for r in blk.dec_items:
+                    if r.state != DECODE or r.slot is None:
+                        continue       # retired at an earlier k /
+                        #                cancelled while in flight
+                    if not emitted[k, r.slot]:
+                        continue
+                    self._lens_np[r.slot] += 1
+                    self._push_token(r, int(toks[k, r.slot]))
 
     def _sample_tokens(self, logits):
         from ..models.generation import _sample
@@ -916,6 +1365,7 @@ class ContinuousBatchingEngine(LLMEngine):
         """A donated-buffer call died mid-flight: the pools are gone and
         with them every in-flight sequence's KV and the prefix cache.
         Rebuild empty; queued (not yet admitted) requests survive."""
+        self._pending = None           # its buffers died with the pools
         self._reset_kv()
 
     def _reset_kv(self):
@@ -940,6 +1390,7 @@ class ContinuousBatchingEngine(LLMEngine):
                 r.shared_idx = set()
                 r.slot = None
                 self._slots[i] = None
+        self._pending = None
         prefix = getattr(self, "_prefix", None)
         if prefix is not None:
             prefix.clear()                   # allocator is reset below

@@ -236,6 +236,13 @@ class LLMEngine:
         self._step_fn = None
         self._prefill_fns = {}
         self._loop_fns = {}
+        # approximate wall-clock seconds spent in compiled dispatches
+        # and blocked on their readbacks. Accumulated by the
+        # continuous-batching engine's step/block paths (observability:
+        # a rough "how much of my wall time was the device" signal —
+        # the serving bench measures host overhead against a separately
+        # timed bare step instead, see decode_bench.py).
+        self.device_seconds = 0.0
         # batch buckets (OPT-IN): generate() pads the request batch up to
         # the nearest bucket so varying batch sizes reuse a handful of
         # compiled prefill/step programs instead of one per size. Off by

@@ -102,8 +102,11 @@ def _decode_kernel(page_table_ref, seq_lens_ref, active_ref, q_ref, k_ref,
 
 
 def wv_diag(w, v, d, rep=1):
-    """sum_p w[h,p] * v[p,h_kv,d] -> [h,d] without the cross-head
-    product; q heads [g*rep, (g+1)*rep) read kv head g (GQA), one
+    """sum_p w[r,p] * v[p,h_kv,d] -> [r*h_kv... ,d] without the
+    cross-head product. `rep` is the number of w ROWS per kv head: rows
+    [g*rep, (g+1)*rep) read kv head g — plain GQA decode passes the
+    query-head replication factor; the ragged chunk kernel passes
+    rep*tq (its rows are (head, query-token) pairs, head-major). One
     [rep, p] x [p, d] dot per kv head. Unrolled 2-D dots (Mosaic
     rejects batched dot_general — see _decode_kernel), per-head slices
     (Mosaic also rejects the 3-D transpose on older toolchains)."""
@@ -111,7 +114,7 @@ def wv_diag(w, v, d, rep=1):
         jax.lax.dot_general(
             w[g * rep:(g + 1) * rep], v[:, g, :], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)     # [rep, d]
-        for g in range(v.shape[1])], axis=0)        # [h, d]
+        for g in range(v.shape[1])], axis=0)        # [rows, d]
 
 
 def expand_kv_heads(x, h_q):
@@ -188,6 +191,188 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
             interpret=interpret,
         )(table, lens, act, q, k_pages, v_pages)
     return out
+
+
+def _ragged_kernel(page_table_ref, ctx_lens_ref, q_starts_ref, active_ref,
+                   q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                   p, d, tq, n_pages_max, scale, rep=1):
+    """Chunked (multi-token-q) variant of _decode_kernel: slot b carries
+    tq query tokens at GLOBAL positions q_starts[b] + [0, tq); its keys
+    are the slot's own pages, causally masked per query token. Query
+    rows arrive (head, token)-flattened HEAD-MAJOR — row g*rep*tq + j*tq
+    + qi is q head g*rep+j at chunk offset qi — so each kv head's rows
+    are one contiguous [rep*tq, d] slice (same Mosaic-friendly unrolled
+    2-D dots as decode)."""
+    b = pl.program_id(0)
+    pi = pl.program_id(1)
+
+    @pl.when(pi == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    ctx_len = ctx_lens_ref[b]
+    q_start = q_starts_ref[b]
+    page_start = pi * p
+    # queries attend kpos <= q_start + qi < ctx_len: pages at/after the
+    # context end contribute nothing — skip compute (an inactive slot's
+    # index map additionally pins its page DMA to block 0)
+    run = jnp.logical_and(active_ref[b] > 0, page_start < ctx_len)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # [h*tq, d]
+        k = k_ref[0].astype(jnp.float32)                       # [p, h_kv, d]
+        v = v_ref[0].astype(jnp.float32)
+        h_kv = k.shape[1]
+        rows = rep * tq                       # q rows per kv head
+        logits = jnp.concatenate([
+            jax.lax.dot_general(
+                q[g * rows:(g + 1) * rows], k[:, g, :],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)     # [rep*tq, p]
+            for g in range(h_kv)], axis=0)              # [h*tq, p]
+        # causal + length mask at GLOBAL positions: row r of a kv-head
+        # block is chunk offset r % tq, key column c is position
+        # page_start + c
+        qpos = q_start + jax.lax.rem(
+            jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0),
+            jnp.int32(tq))
+        kpos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) \
+            + page_start
+        ok = jnp.logical_and(kpos <= qpos, kpos < ctx_len)
+        logits = jnp.where(ok, logits, jnp.float32(NEG_INF))
+
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
+        w = jnp.exp(logits - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[...] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(w, axis=-1, keepdims=True), l_scr.shape)
+        acc_scr[...] = alpha * acc_scr[...] + wv_diag(w, v, d, rep=rows)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(pi == n_pages_max - 1)
+    def _emit():
+        # fully-masked rows (padded chunk tail, inactive slots) have
+        # l == 0 and acc == 0: the clamp emits exact zeros, never NaN
+        l_fin = jnp.maximum(l_scr[:, :1], jnp.float32(1e-30))
+        o_ref[0] = (acc_scr[...] / l_fin).astype(o_ref.dtype)
+
+
+def ragged_paged_attention(q, k_pages, v_pages, page_table, ctx_lens,
+                           q_starts, active=None, scale=None,
+                           interpret=False):
+    """Ragged-chunk paged attention: ONE kernel invocation covers slots
+    sitting at DIFFERENT positions — each slot b contributes tq query
+    tokens at global positions q_starts[b] + [0, tq), attending its own
+    pages causally up to ctx_lens[b]. This is what lets chunked prefill
+    (slots mid-prompt at arbitrary offsets) ride inside the same fused
+    serving step as decode instead of a separate dispatch (PAPERS.md
+    ragged paged attention; decode is the tq == 1 special case of this
+    masking, kept on its own tuned kernel).
+
+    q          : [b, tq, h, d]   (tq chunk tokens per slot)
+    k/v_pages  : [n_pages, p, h_kv, d]   (GQA: h % h_kv == 0)
+    page_table : [b, max_pages] int32
+    ctx_lens   : [b] int32  — tokens in cache AFTER this chunk's write
+                  (i.e. the chunk's end position); keys at/after it mask
+    q_starts   : [b] int32  — global position of each slot's first
+                  chunk token (ragged: per-slot, scalar-prefetched)
+    active     : optional [b] mask; inactive slots skip compute AND page
+                  DMA (index map pins their fetches to block 0) and emit
+                  zeros.
+
+    Returns [b, tq, h, d]. Rows past a slot's real chunk length are
+    garbage (they attend whatever the causal window holds) — callers
+    index the rows they wrote, exactly like the padded dense prefill."""
+    b, tq, h, d = q.shape
+    n_pages, p, h_kv, dd = k_pages.shape
+    assert dd == d and h % h_kv == 0, (q.shape, k_pages.shape)
+    rep = h // h_kv
+    max_pages = page_table.shape[1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    # rows head-major [(h, tq) -> h*tq, d]: each kv head's rep*tq query
+    # rows form one contiguous slice (see _ragged_kernel)
+    qr = jnp.swapaxes(q, 1, 2).reshape(b, h * tq, d)
+    table = jnp.clip(page_table.astype(jnp.int32), 0, n_pages - 1)
+    lens = ctx_lens.astype(jnp.int32)
+    starts = q_starts.astype(jnp.int32)
+    if active is None:
+        act = jnp.ones((b,), jnp.int32)
+    else:
+        act = active.astype(jnp.int32)
+
+    kernel = functools.partial(_ragged_kernel, p=p, d=d, tq=tq,
+                               n_pages_max=max_pages, scale=s, rep=rep)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, h * tq, d),
+                         lambda bb, pi, tbl, ln, st, ac: (bb, 0, 0)),
+            pl.BlockSpec((1, p, h_kv, d),
+                         lambda bb, pi, tbl, ln, st, ac:
+                         (tbl[bb, pi] * ac[bb], 0, 0, 0)),
+            pl.BlockSpec((1, p, h_kv, d),
+                         lambda bb, pi, tbl, ln, st, ac:
+                         (tbl[bb, pi] * ac[bb], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h * tq, d),
+                               lambda bb, pi, tbl, ln, st, ac: (bb, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h * tq, 128), jnp.float32),
+            pltpu.VMEM((h * tq, 128), jnp.float32),
+            pltpu.VMEM((h * tq, d), jnp.float32),
+        ],
+    )
+    with enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, h * tq, d), q.dtype),
+            compiler_params=tpu_compiler_params(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interpret,
+        )(table, lens, starts, act, qr, k_pages, v_pages)
+    return jnp.swapaxes(out.reshape(b, h, tq, d), 1, 2)
+
+
+def ragged_paged_attention_reference(q, k_pages, v_pages, page_table,
+                                     ctx_lens, q_starts, active=None,
+                                     scale=None):
+    """XLA reference for tests: per-slot gather + dense causal softmax
+    at the slot's global offset (GQA kv heads repeated)."""
+    b, tq, h, d = q.shape
+    n_pages, p, h_kv, _ = k_pages.shape
+    max_pages = page_table.shape[1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    outs = []
+    for i in range(b):
+        if active is not None and not int(active[i]):
+            outs.append(jnp.zeros((tq, h, d), q.dtype))
+            continue
+        ks = k_pages[page_table[i]].reshape(max_pages * p, h_kv, d)
+        vs = v_pages[page_table[i]].reshape(max_pages * p, h_kv, d)
+        if h_kv != h:
+            ks = jnp.repeat(ks, h // h_kv, axis=1)
+            vs = jnp.repeat(vs, h // h_kv, axis=1)
+        logits = jnp.einsum("qhd,khd->hqk", q[i].astype(jnp.float32),
+                            ks.astype(jnp.float32)) * s
+        kpos = jnp.arange(max_pages * p)[None, None, :]
+        qpos = (int(q_starts[i]) + jnp.arange(tq))[None, :, None]
+        ok = (kpos <= qpos) & (kpos < int(ctx_lens[i]))
+        logits = jnp.where(ok, logits, NEG_INF)
+        w = jax.nn.softmax(logits, axis=-1)
+        # fully-masked rows: renormalize the uniform softmax to zero out
+        any_ok = ok.any(-1)
+        w = jnp.where(any_ok[..., None], w, 0.0)
+        outs.append(jnp.einsum("hqk,khd->qhd", w,
+                               vs.astype(jnp.float32)).astype(q.dtype))
+    return jnp.stack(outs)
 
 
 def paged_attention_reference(q, k_pages, v_pages, page_table, seq_lens,

@@ -26,7 +26,16 @@ try:
 except AttributeError:
     pass  # pre-jax_num_cpu_devices stack: the XLA_FLAGS above covers it
 
+import time  # noqa: E402
+
 import pytest  # noqa: E402
+
+# tier-1 runtime guard: the driver kills the suite at 870s (timeout -k),
+# which silently drops every test past the cutoff from DOTS_PASSED. Warn
+# LOUDLY before that cliff so a PR adding slow tests sees it in the log.
+_SUITE_BUDGET_WARN_S = 800
+_suite_t0 = [None]
+_test_durations = []
 
 
 @pytest.fixture(autouse=True)
@@ -42,3 +51,49 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "faults: fault-injection robustness tests "
         "(paddle_tpu.failsafe harness; see docs/robustness.md)")
+
+
+def pytest_sessionstart(session):
+    _suite_t0[0] = time.monotonic()
+
+
+_budget_warned = [False]
+
+
+def pytest_runtest_logreport(report):
+    if report.when != "call":
+        return
+    _test_durations.append((report.duration, report.nodeid))
+    # warn MID-RUN the moment the budget is crossed: when the driver's
+    # `timeout -k 10 870` kills pytest, the terminal-summary hook below
+    # never runs — an end-of-run warning cannot fire in exactly the
+    # scenario it guards against
+    if not _budget_warned[0] and _suite_t0[0] is not None and \
+            time.monotonic() - _suite_t0[0] > _SUITE_BUDGET_WARN_S:
+        _budget_warned[0] = True
+        import sys
+        print(f"\n!!! tier-1 guard: suite passed {_SUITE_BUDGET_WARN_S}s "
+              f"at {report.nodeid} — the 870s driver timeout will "
+              "truncate this run and DOTS_PASSED will drop. Mark new "
+              "long tests @pytest.mark.slow or shrink them.",
+              file=sys.stderr, flush=True)
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    if _suite_t0[0] is None:
+        return
+    total = time.monotonic() - _suite_t0[0]
+    tr = terminalreporter
+    tr.section("tier-1 runtime guard")
+    tr.write_line(f"total wall time: {total:.1f}s "
+                  f"(driver timeout 870s, warn at {_SUITE_BUDGET_WARN_S}s)")
+    for dur, nodeid in sorted(_test_durations, reverse=True)[:10]:
+        tr.write_line(f"  {dur:7.2f}s  {nodeid}")
+    if total > _SUITE_BUDGET_WARN_S:
+        tr.write_line("")
+        tr.write_line(
+            f"!!! SUITE RUNTIME {total:.0f}s EXCEEDS THE "
+            f"{_SUITE_BUDGET_WARN_S}s BUDGET — the 870s driver timeout "
+            "will start truncating the run and DOTS_PASSED will drop. "
+            "Mark new long tests @pytest.mark.slow or shrink them.",
+            red=True, bold=True)

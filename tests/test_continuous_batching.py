@@ -213,6 +213,53 @@ class TestContinuousBatchingStream:
         assert cb.admissions == 12
 
 
+class TestPrefixLRUEviction:
+    """Pinning the O(1)-amortized eviction order (ISSUE 4 satellite):
+    oldest-unused first; an entry that cannot be evicted because a
+    running request still holds it is IN USE and moves to the MRU end
+    instead of being rescanned by every later eviction."""
+
+    def _cache3(self):
+        from paddle_tpu.inference.scheduler import PrefixCache
+        a = PageAllocator(8)
+        c = PrefixCache(4)
+        pages = {}
+        for name, toks in (("A", (1, 2, 3, 4)), ("B", (5, 6, 7, 8)),
+                           ("C", (9, 10, 11, 12))):
+            pg = a.alloc()
+            c.insert((), toks, pg, a)    # cache takes its own reference
+            a.free([pg])                 # creator retires: cache-only
+            pages[name] = pg
+        return a, c, pages
+
+    def test_oldest_unused_evicts_first(self):
+        a, c, pages = self._cache3()
+        # touch A: LRU order becomes B, C, A
+        hit, covered = c.match(np.asarray([1, 2, 3, 4], np.int64))
+        assert hit == [pages["A"]] and covered == 4
+        assert c.evict(1, a) == 1
+        assert a.refcount(pages["B"]) == 0      # B was the LRU victim
+        assert a.refcount(pages["A"]) == 1
+        assert a.refcount(pages["C"]) == 1
+
+    def test_in_use_entry_bumped_not_rescanned(self):
+        a, c, pages = self._cache3()
+        a.share(pages["A"])                     # a running request holds A
+        assert c.evict(2, a) == 2               # B and C free; A survives
+        assert a.refcount(pages["A"]) == 2
+        assert len(c) == 1
+        # release the request's hold: A is now the (only) LRU victim
+        a.free([pages["A"]])
+        assert c.evict(1, a) == 1
+        assert a.available == a.n_pages
+
+    def test_protect_set_survives(self):
+        a, c, pages = self._cache3()
+        assert c.evict(3, a, protect={pages["B"]}) == 2
+        assert a.refcount(pages["B"]) == 1      # protected page kept
+        assert len(c) == 1
+
+
 class TestPrefixCache:
     def test_sharing_cow_and_savings(self, tiny, ref_engine):
         model, cfg = tiny

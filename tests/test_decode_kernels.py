@@ -6,8 +6,9 @@ import jax
 import jax.numpy as jnp
 
 import paddle_tpu  # noqa: F401
-from paddle_tpu.ops.pallas.paged_attention import (paged_attention,
-                                                   paged_attention_reference)
+from paddle_tpu.ops.pallas.paged_attention import (
+    paged_attention, paged_attention_reference, ragged_paged_attention,
+    ragged_paged_attention_reference)
 from paddle_tpu.ops.pallas.quantized_matmul import (quantized_matmul,
                                                     quantize_weights)
 
@@ -55,6 +56,78 @@ class TestPagedAttention:
         np.testing.assert_allclose(
             np.asarray(out, np.float32), np.asarray(ref, np.float32),
             rtol=5e-2, atol=5e-2)
+
+
+class TestRaggedPagedAttention:
+    """ISSUE 4 ragged prefill fusion: one kernel invocation covers
+    slots at DIFFERENT positions (per-slot q_start/ctx_len scalar
+    prefetch), each attending its own pages causally."""
+
+    def _rand(self, rng, b, tq, h, h_kv, d, p, n_pages, max_pages):
+        q = jnp.asarray(rng.randn(b, tq, h, d) * 0.3, jnp.float32)
+        kp = jnp.asarray(rng.randn(n_pages, p, h_kv, d) * 0.3, jnp.float32)
+        vp = jnp.asarray(rng.randn(n_pages, p, h_kv, d) * 0.3, jnp.float32)
+        table = jnp.asarray(rng.randint(0, n_pages, (b, max_pages)),
+                            jnp.int32)
+        return q, kp, vp, table
+
+    def _check(self, q, kp, vp, table, ctx, starts, act=None, tol=2e-4):
+        out = ragged_paged_attention(q, kp, vp, table, ctx, starts,
+                                     active=act, interpret=True)
+        ref = ragged_paged_attention_reference(q, kp, vp, table, ctx,
+                                               starts, active=act)
+        out, ref = np.asarray(out), np.asarray(ref)
+        tq = q.shape[1]
+        for i in range(q.shape[0]):
+            if act is not None and not int(act[i]):
+                assert np.all(out[i] == 0), "inactive slot must emit zeros"
+                continue
+            # rows past a slot's real chunk length are garbage by
+            # contract — compare the valid rows only
+            n_valid = max(0, min(tq, int(ctx[i]) - int(starts[i])))
+            np.testing.assert_allclose(out[i, :n_valid], ref[i, :n_valid],
+                                       rtol=tol, atol=tol,
+                                       err_msg=f"slot {i}")
+
+    def test_slots_at_different_offsets(self):
+        rng = np.random.RandomState(0)
+        b, tq, h, d, p, n_pages, mp = 4, 8, 4, 32, 8, 16, 6
+        q, kp, vp, table = self._rand(rng, b, tq, h, h, d, p, n_pages, mp)
+        starts = jnp.asarray([0, 5, 23, 11], jnp.int32)
+        ctx = jnp.asarray([8, 13, 31, 19], jnp.int32)
+        self._check(q, kp, vp, table, ctx, starts)
+
+    def test_partial_chunk_and_active_mask(self):
+        rng = np.random.RandomState(1)
+        b, tq, h, d, p, n_pages, mp = 4, 4, 2, 32, 8, 8, 4
+        q, kp, vp, table = self._rand(rng, b, tq, h, h, d, p, n_pages, mp)
+        starts = jnp.asarray([0, 6, 2, 9], jnp.int32)
+        # slot 1 ends mid-chunk (ctx < start + tq); slot 2 is inactive
+        ctx = jnp.asarray([4, 8, 6, 13], jnp.int32)
+        act = jnp.asarray([1, 1, 0, 1], jnp.int32)
+        self._check(q, kp, vp, table, ctx, starts, act=act)
+
+    def test_gqa_grouped_heads(self):
+        rng = np.random.RandomState(2)
+        b, tq, h, h_kv, d, p, n_pages, mp = 2, 4, 8, 2, 32, 8, 16, 4
+        q, kp, vp, table = self._rand(rng, b, tq, h, h_kv, d, p,
+                                      n_pages, mp)
+        starts = jnp.asarray([3, 17], jnp.int32)
+        ctx = jnp.asarray([7, 21], jnp.int32)
+        self._check(q, kp, vp, table, ctx, starts, tol=2e-3)
+
+    def test_decode_is_the_tq1_special_case(self):
+        """tq=1 with q_start = ctx-1 must agree with the tuned decode
+        kernel."""
+        rng = np.random.RandomState(3)
+        b, h, d, p, n_pages, mp = 3, 4, 32, 8, 16, 4
+        q, kp, vp, table = self._rand(rng, b, 1, h, h, d, p, n_pages, mp)
+        lens = jnp.asarray([3, 17, 30], jnp.int32)
+        dec = paged_attention(q[:, 0], kp, vp, table, lens, interpret=True)
+        rag = ragged_paged_attention(q, kp, vp, table, lens, lens - 1,
+                                     interpret=True)[:, 0]
+        np.testing.assert_allclose(np.asarray(dec), np.asarray(rag),
+                                   rtol=2e-5, atol=2e-5)
 
 
 class TestQuantizedMatmul:

@@ -1,0 +1,225 @@
+"""Device-resident multi-step decode (ISSUE 4): decode_block=K runs a
+ragged prefill phase + K decode steps as ONE compiled dispatch, host
+intervention only at block boundaries.
+
+The contract under test: greedy outputs BYTE-IDENTICAL to the per-step
+engine (K=1), identical RequestFailure/deadline outcome sets, zero page
+leak — plus the double-buffered pipelining path (block N+1 dispatched
+before block N's tokens are fetched) producing the same bytes.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import failsafe
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.inference.scheduler import ContinuousBatchingEngine
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    paddle.seed(3)
+    cfg = LlamaConfig.tiny()
+    return LlamaForCausalLM(cfg), cfg
+
+
+def mk(model, K, **kw):
+    kw.setdefault("max_len", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("prefill_chunk", 8)
+    return ContinuousBatchingEngine(model, decode_block=K, **kw)
+
+
+def assert_no_leak(cb):
+    held = 0 if cb._prefix is None else len(cb._prefix)
+    assert cb.allocator.available == cb.allocator.n_pages - held, (
+        cb.allocator.available, cb.allocator.n_pages, held)
+
+
+# one engine per K for the whole module: the fused variants compile once
+@pytest.fixture(scope="module")
+def cb1(tiny):
+    return mk(tiny[0], 1)
+
+
+@pytest.fixture(scope="module")
+def cb8(tiny):
+    return mk(tiny[0], 8)
+
+
+def ragged_stream(cfg, n, seed=0, max_budget=12):
+    rng = np.random.RandomState(seed)
+    lens = rng.randint(3, 18, n)
+    prompts = [rng.randint(0, cfg.vocab_size, (int(t),)).astype(np.int64)
+               for t in lens]
+    budgets = [int(b) for b in rng.randint(3, max_budget, n)]
+    return prompts, budgets
+
+
+class TestFusedEquivalence:
+    def test_k8_matches_k1_on_ragged_stream(self, tiny, cb1, cb8):
+        # tier-1-sized (suite is 870s-timeout-bound): 5 ragged requests
+        # over 4 slots still exercises queueing, mixed prefill+decode
+        # blocks, and mid-block retirement; the 20-request acceptance
+        # soak is slow-marked below
+        _, cfg = tiny
+        prompts, budgets = ragged_stream(cfg, 5, seed=0, max_budget=9)
+        outs1 = cb1.generate_many(prompts, max_new_tokens=budgets)
+        outs8 = cb8.generate_many(prompts, max_new_tokens=budgets)
+        for i, (a, b) in enumerate(zip(outs1, outs8)):
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"request {i} diverged at K=8")
+        assert cb8.fused_blocks > 0
+        assert_no_leak(cb1)
+        assert_no_leak(cb8)
+
+    def test_eos_retirement_matches(self, tiny, cb1, cb8):
+        """Per-slot EOS flags on DEVICE must retire exactly where the
+        host loop would: discover a real token from a free run, then
+        re-decode with it as EOS in both modes."""
+        _, cfg = tiny
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(0, cfg.vocab_size, (t,)).astype(np.int64)
+                   for t in (9, 6)]
+        free = cb1.generate_many(prompts, max_new_tokens=12)
+        eos = int(free[0][prompts[0].size + 2])
+        o1 = cb1.generate_many(prompts, max_new_tokens=12,
+                               eos_token_id=eos)
+        o8 = cb8.generate_many(prompts, max_new_tokens=12,
+                               eos_token_id=eos)
+        for a, b in zip(o1, o8):
+            np.testing.assert_array_equal(a, b)
+        # the EOS really fired early for request 0
+        assert o1[0].size < prompts[0].size + 12 + 1 or \
+            int(o1[0][-1]) == eos
+
+    def test_pipelined_chaining_same_bytes(self, tiny, cb1, cb8):
+        """Steady-state decode: block N+1 is dispatched from block N's
+        device carries BEFORE N's readback — and the bytes still match
+        the per-step engine."""
+        _, cfg = tiny
+        rng = np.random.RandomState(7)
+        prompts = [rng.randint(0, cfg.vocab_size, (t,)).astype(np.int64)
+                   for t in (9, 5, 12, 7)]
+        chained0 = cb8.chained_blocks
+        o1 = cb1.generate_many(prompts, max_new_tokens=24)
+        o8 = cb8.generate_many(prompts, max_new_tokens=24)
+        for a, b in zip(o1, o8):
+            np.testing.assert_array_equal(a, b)
+        assert cb8.chained_blocks > chained0, \
+            "pure-decode stream never pipelined a block"
+        assert_no_leak(cb8)
+
+    def test_ttl_and_fault_outcomes_match(self, tiny, cb1, cb8):
+        """RequestFailure outcome SETS are identical across K (fused
+        deadlines round up to the block boundary but expire all the
+        same; faults fire at host sync points). The injected fault runs
+        against a LONE decode request: fault_point call counts are
+        per-step in one mode and per-block in the other, so a shared
+        nth trigger is only request-deterministic with one candidate."""
+        _, cfg = tiny
+        rng = np.random.RandomState(9)
+        base = rng.randint(0, cfg.vocab_size, (10,)).astype(np.int64)
+        outcomes = {}
+        for cb in (cb1, cb8):
+            uids = {}
+            uids["ttl"] = cb.add_request(base, max_new_tokens=40,
+                                         ttl_steps=6)
+            uids["ok"] = cb.add_request(base[:5], max_new_tokens=4)
+            cb.drain()
+            with failsafe.inject("cb.decode", nth=2):
+                uids["fault"] = cb.add_request(base[:7],
+                                               max_new_tokens=10)
+                cb.drain()
+            fails = cb.failures()
+            outcomes[cb.decode_block] = {
+                name: (fails[uid].stage if uid in fails else "done")
+                for name, uid in uids.items()}
+            assert cb.status(uids["ok"]) == "done"
+            assert_no_leak(cb)
+        assert outcomes[1] == outcomes[8], outcomes
+        assert outcomes[8]["ttl"] == "deadline"
+        assert outcomes[8]["fault"] == "decode"
+
+    def test_cancel_midflight_fused(self, tiny, cb8):
+        _, cfg = tiny
+        rng = np.random.RandomState(13)
+        a = cb8.add_request(
+            rng.randint(0, cfg.vocab_size, (9,)).astype(np.int64),
+            max_new_tokens=30)
+        b = cb8.add_request(
+            rng.randint(0, cfg.vocab_size, (6,)).astype(np.int64),
+            max_new_tokens=6)
+        for _ in range(2):
+            cb8.step()
+        assert cb8.cancel(a) is True
+        cb8.drain()
+        assert cb8.status(a) == "cancelled"
+        assert cb8.status(b) == "done"
+        assert_no_leak(cb8)
+
+    def test_prefix_share_and_cow_fused(self, tiny):
+        model, cfg = tiny
+        rng = np.random.RandomState(17)
+        base = rng.randint(0, cfg.vocab_size, (12,)).astype(np.int64)
+        # page_size 4: three full prompt pages publish and the re-run
+        # lands a partial-page hit on the tail page -> exactly one CoW
+        cb = mk(model, 8, max_batch=2, page_size=4)
+        uA = cb.add_request(base, max_new_tokens=5)
+        cb.drain()
+        uB = cb.add_request(base.copy(), max_new_tokens=5)
+        cb.drain()
+        np.testing.assert_array_equal(cb.result(uA), cb.result(uB))
+        assert cb.cow_copies == 1
+        assert cb._requests[uB].pages_shared >= 1
+        assert_no_leak(cb)
+
+    def test_single_token_budget_fused(self, tiny, cb1, cb8):
+        """max_new_tokens=1: the only token comes from the prefill
+        phase's on-device sample; the request must retire without ever
+        entering the decode scan."""
+        _, cfg = tiny
+        rng = np.random.RandomState(19)
+        p = rng.randint(0, cfg.vocab_size, (11,)).astype(np.int64)
+        o1 = cb1.generate_many([p], max_new_tokens=1)[0]
+        o8 = cb8.generate_many([p], max_new_tokens=1)[0]
+        np.testing.assert_array_equal(o1, o8)
+        assert o8.size == p.size + 1
+
+
+@pytest.mark.slow
+class TestFusedSoak:
+    def test_twenty_request_stream_acceptance(self, tiny):
+        """Acceptance: K=8 byte-identical to K=1 on a seeded 20-request
+        ragged stream, identical failure/deadline outcomes, zero page
+        leak."""
+        model, cfg = tiny
+        prompts, budgets = ragged_stream(cfg, 20, seed=42)
+        eos_ids = [None] * 20
+        results = {}
+        for K in (1, 8):
+            cb = mk(model, K)
+            uids = []
+            for i, (p, b) in enumerate(zip(prompts, budgets)):
+                ttl = 5 if i % 7 == 3 else None   # a few expire
+                uids.append(cb.add_request(p, max_new_tokens=b,
+                                           eos_token_id=eos_ids[i],
+                                           ttl_steps=ttl))
+            cb.drain()
+            outs, fails = {}, {}
+            for i, u in enumerate(uids):
+                if u in cb.failures():
+                    fails[i] = cb.failures()[u].stage
+                else:
+                    outs[i] = cb.result(u)
+            results[K] = (outs, fails)
+            assert_no_leak(cb)
+        outs1, fails1 = results[1]
+        outs8, fails8 = results[8]
+        assert fails1 == fails8, (fails1, fails8)
+        assert set(outs1) == set(outs8)
+        for i in outs1:
+            np.testing.assert_array_equal(
+                outs1[i], outs8[i],
+                err_msg=f"request {i} diverged K=8 vs K=1")

@@ -205,19 +205,36 @@ def _run_config_subprocess(which, timeout=1800, env_override=None):
                        f"(rc={proc.returncode}): {' | '.join(tail)}"[:400])
 
 
+def _run_config_robust(which, extra):
+    """TPU attempt -> fresh-subprocess CPU fallback -> error-tagged stub.
+
+    NEVER raises: a per-config subprocess failure (e.g. the BENCH_r05
+    `backend unavailable: jax.devices() unresponsive` rc=1) must route to
+    the cpu-fallback path, and a failure of THAT must still leave a
+    tagged zero metric set — the bench always exits 0 with a parseable
+    artifact, whatever the backends are doing."""
+    try:
+        return _run_config_subprocess(which)
+    except Exception as e:  # noqa: BLE001 — backend down, not a code bug
+        # degrade to a CPU-captured metric set instead of rc=1 with no
+        # artifact (VERDICT round-5). A fresh subprocess pinned to
+        # JAX_PLATFORMS=cpu sidesteps whatever wedged the TPU probe.
+        extra[f"{which}_tpu_error"] = f"{type(e).__name__}: {e}"[:300]
+    try:
+        r = _run_config_subprocess(
+            which, env_override={"JAX_PLATFORMS": "cpu"})
+        r["backend"] = "cpu-fallback"
+        return r
+    except Exception as e:  # noqa: BLE001
+        extra[f"{which}_cpu_error"] = f"{type(e).__name__}: {e}"[:300]
+    return {"config": which, "tokens_per_sec": 0.0, "mfu": 0.0,
+            "batch_size": 0, "recompute": False, "n_params": 0,
+            "backend": "error"}
+
+
 def _run():
     extra = {}
-    try:
-        r350 = _run_config_subprocess("llama350m")
-    except Exception as e:  # noqa: BLE001 — backend down, not a code bug
-        # no TPU reachable: degrade to a CPU-captured metric set instead
-        # of rc=1 with no artifact — every round must leave a parseable
-        # BENCH line (VERDICT round-5). A fresh subprocess pinned to
-        # JAX_PLATFORMS=cpu sidesteps whatever wedged the TPU probe.
-        extra["tpu_error"] = f"{type(e).__name__}: {e}"[:300]
-        r350 = _run_config_subprocess(
-            "llama350m", env_override={"JAX_PLATFORMS": "cpu"})
-        r350["backend"] = "cpu-fallback"
+    r350 = _run_config_robust("llama350m", extra)
     extra.update({
         "llama350m_tokens_per_sec_per_chip": r350["tokens_per_sec"],
         "llama350m_mfu": r350["mfu"],
@@ -226,7 +243,7 @@ def _run():
                 r350["tokens_per_sec"], r350["mfu"], r350["recompute"])
 
     # HEADLINE metric (round-5): the 1.3B d=128 config, TPU only.
-    if r350["backend"] not in ("cpu", "cpu-fallback"):
+    if r350["backend"] not in ("cpu", "cpu-fallback", "error"):
         try:
             r13 = _run_config_subprocess("llama1p3b")
             extra["llama1p3b_params"] = r13["n_params"]

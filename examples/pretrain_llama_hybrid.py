@@ -78,6 +78,11 @@ def main():
                     help="AOT-compile a north-star recipe (7b/13b) and "
                          "print its per-device memory accounting instead "
                          "of training")
+    ap.add_argument("--grad-compress", choices=["none", "int8"],
+                    default="none", dest="grad_compress",
+                    help="int8: gradient collectives ride the chunked "
+                         "int8 allreduce with error feedback "
+                         "(docs/distributed_perf.md)")
     args = ap.parse_args()
 
     if args.aot_memory:
@@ -117,7 +122,9 @@ def main():
     cfg = LlamaConfig.tiny(num_hidden_layers=4)
     model = LlamaForCausalLM(cfg)
     trainer = SpmdTrainer(model, mesh, lr=1e-3, micro_batch_size=2,
-                          pp_schedule="1f1b", recompute=True)
+                          pp_schedule="1f1b", recompute=True,
+                          grad_compress=(None if args.grad_compress == "none"
+                                         else args.grad_compress))
     state = trainer.init_state()
 
     # 3. train

@@ -31,6 +31,7 @@ from .mesh import (global_mesh, set_global_mesh, build_mesh, mesh_axis_size,
                    in_spmd_region, current_axis_name)
 from .parallel import DataParallel
 from . import fleet
+from . import comm_compress
 from . import communication
 from . import sharding
 from .fleet import meta_parallel

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ...jax_compat import axis_size as _axis_size
+
 PARTIAL = "__partial__"  # pseudo entry: spec[0] may carry ("partial", axis)
 
 
@@ -138,7 +140,7 @@ def reshard_spec(x, src, dst, partial_axes=(), record=None,
         src = (None,) * ndim
         for d, e in enumerate(dst):
             for axis in _entry_axes(e):  # outer first: nested block order
-                n = lax.axis_size(axis)
+                n = _axis_size(axis)
                 idx = lax.axis_index(axis)
                 sz = x.shape[d] // n
                 x = lax.dynamic_slice_in_dim(x, idx * sz, sz, axis=d)
@@ -198,7 +200,7 @@ def reshard_spec(x, src, dst, partial_axes=(), record=None,
     for axis in _axes_of(dst):
         if _axis_dim(src, axis) is None:
             ddim = _axis_dim(dst, axis)
-            n = lax.axis_size(axis)
+            n = _axis_size(axis)
             idx = lax.axis_index(axis)
             sz = x.shape[ddim] // n
             x = lax.dynamic_slice_in_dim(x, idx * sz, sz, axis=ddim)

@@ -127,10 +127,6 @@ def _group_size(group):
     return group.nranks
 
 
-def is_available():
-    return True
-
-
 def _require_initialized_multiproc(verb):
     """Eager cross-process collectives need a live jax.distributed runtime;
     silently no-op'ing would train unsynchronized replicas (VERDICT round-1
@@ -244,16 +240,51 @@ def _subgroup_gather(arr, group):
     return np.stack(out)
 
 
-def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
-    """ref: communication/all_reduce.py. In-place on `tensor`."""
+def _prod_psum_fn(axis):
+    """PROD via gather-multiply. exp(psum(log)) NaN'd on zero/negative
+    inputs and rounds off integer products past f32 precision; an
+    explicit all_gather keeps jnp.prod's exact semantics for every
+    dtype — zeros, signs, and int products are just products. Costs
+    n x the wire bytes of a psum, acceptable for the rare PROD."""
+    def fn(a):
+        return jnp.prod(lax.all_gather(a, axis), axis=0).astype(a.dtype)
+    return fn
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               compress=None, compress_chunk=None):
+    """ref: communication/all_reduce.py. In-place on `tensor`.
+
+    compress="int8": SUM/AVG ride the chunked int8 two-stage allreduce
+    (comm_compress.quantized_psum — ~4x fewer bytes on the wire) instead
+    of the exact f32 psum. Lossy: callers that care about the bias should
+    carry error feedback (EagerReducer / SpmdTrainer do). compress=None
+    (the default) is the exact path, byte-identical to prior behavior."""
+    if compress not in (None, "int8"):
+        raise ValueError(f"compress must be None or 'int8', got "
+                         f"{compress!r}")
+    if compress == "int8" and op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise ValueError("compress='int8' supports SUM/AVG only")
     axis = _axis_of(group)
     if in_spmd_region(axis) and axis is not None:
-        fns = {ReduceOp.SUM: lambda a: lax.psum(a, axis),
-               ReduceOp.MAX: lambda a: lax.pmax(a, axis),
-               ReduceOp.MIN: lambda a: lax.pmin(a, axis),
-               ReduceOp.AVG: lambda a: lax.pmean(a, axis),
-               ReduceOp.PROD: lambda a: jnp.exp(lax.psum(jnp.log(a), axis))}
-        out = apply(fns[op], tensor, name="c_allreduce")
+        if compress == "int8" and mesh_axis_size(axis) > 1:
+            from . import comm_compress as _cc
+            n = mesh_axis_size(axis)
+            chunk = _cc.resolve_chunk(compress_chunk)
+
+            def qfn(a):
+                y, _err = _cc.quantized_psum(a, axis, axis_size=n,
+                                             chunk=chunk)
+                return y / n if op == ReduceOp.AVG else y
+
+            out = apply(qfn, tensor, name="c_allreduce_q8")
+        else:
+            fns = {ReduceOp.SUM: lambda a: lax.psum(a, axis),
+                   ReduceOp.MAX: lambda a: lax.pmax(a, axis),
+                   ReduceOp.MIN: lambda a: lax.pmin(a, axis),
+                   ReduceOp.AVG: lambda a: lax.pmean(a, axis),
+                   ReduceOp.PROD: _prod_psum_fn(axis)}
+            out = apply(fns[op], tensor, name="c_allreduce")
         tensor.data, tensor._node, tensor.stop_gradient = \
             out.data, out._node, out.stop_gradient
         return tensor
@@ -262,6 +293,15 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     # Eager cross-process path (multi-controller): host-level allreduce
     # (_process_gather routes subgroups through the store transport).
     _require_initialized_multiproc("all_reduce")
+    if compress == "int8":
+        from . import comm_compress as _cc
+        tot, _err = _cc.eager_quantized_allreduce(
+            _raw(tensor), group,
+            chunk=_cc.resolve_chunk(compress_chunk))
+        if op == ReduceOp.AVG:
+            tot = tot / _group_size(group)
+        tensor.data = tot.astype(tensor.data.dtype)
+        return tensor
     summed = _process_gather(_raw(tensor), group)
     red = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max, ReduceOp.MIN: jnp.min,
            ReduceOp.AVG: jnp.mean, ReduceOp.PROD: jnp.prod}[op]
@@ -301,8 +341,17 @@ def all_gather_into_tensor(tensor, group=None, concat_axis=0):
 
 
 def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
-                   sync_op=True):
-    """ref: communication/reduce_scatter.py — output written to `tensor`."""
+                   sync_op=True, compress=None, compress_chunk=None):
+    """ref: communication/reduce_scatter.py — output written to `tensor`.
+
+    compress="int8" (SUM only): the scatter phase moves int8 + per-chunk
+    scales (comm_compress.quantized_psum_scatter); the owner's accumulate
+    stays exact f32. Default None is byte-identical to prior behavior."""
+    if compress not in (None, "int8"):
+        raise ValueError(f"compress must be None or 'int8', got "
+                         f"{compress!r}")
+    if compress == "int8" and op != ReduceOp.SUM:
+        raise ValueError("compress='int8' reduce_scatter supports SUM only")
     g_axis = _axis_of(group)
     if isinstance(tensor_list_or_input, (list, tuple)):
         from ..tensor.manipulation import concat
@@ -310,9 +359,22 @@ def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
     else:
         inp = tensor_list_or_input
     if in_spmd_region(g_axis) and g_axis is not None:
-        out = apply(lambda a: lax.psum_scatter(a, g_axis, scatter_dimension=0,
-                                               tiled=True), inp,
-                    name="c_reducescatter")
+        if compress == "int8" and mesh_axis_size(g_axis) > 1:
+            from . import comm_compress as _cc
+            n = mesh_axis_size(g_axis)
+            chunk = _cc.resolve_chunk(compress_chunk)
+
+            def qfn(a):
+                y, _err = _cc.quantized_psum_scatter(a, g_axis, axis_size=n,
+                                                     chunk=chunk)
+                return y.astype(a.dtype)
+
+            out = apply(qfn, inp, name="c_reducescatter_q8")
+        else:
+            out = apply(lambda a: lax.psum_scatter(a, g_axis,
+                                                   scatter_dimension=0,
+                                                   tiled=True), inp,
+                        name="c_reducescatter")
         tensor.data, tensor._node, tensor.stop_gradient = \
             out.data, out._node, out.stop_gradient
         return tensor
@@ -321,12 +383,20 @@ def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
         return tensor
     _require_initialized_multiproc("reduce_scatter")
     n = _group_size(group)
+    my = _my_group_rank(group)
+    if compress == "int8":
+        from . import comm_compress as _cc
+        tot, _err = _cc.eager_quantized_allreduce(
+            _raw(inp), group, chunk=_cc.resolve_chunk(compress_chunk))
+        rows = tot.shape[0] // n
+        tensor.data = tot[my * rows:(my + 1) * rows].astype(
+            tensor.data.dtype)
+        return tensor
     stacked = _process_gather(_raw(inp), group)  # [n, n*chunk, ...]
     red = {ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max, ReduceOp.MIN: np.min,
            ReduceOp.AVG: np.mean, ReduceOp.PROD: np.prod}[op]
     full = red(stacked, axis=0)
     chunk = full.shape[0] // n
-    my = _my_group_rank(group)
     tensor.data = jnp.asarray(full[my * chunk:(my + 1) * chunk]).astype(
         tensor.data.dtype)
     return tensor

@@ -18,6 +18,7 @@ from jax import lax
 from .....ops import apply
 from .....tensor.tensor import Tensor
 from ....mesh import in_spmd_region
+from .....jax_compat import axis_size as _axis_size
 
 
 @functools.lru_cache(maxsize=None)
@@ -85,7 +86,7 @@ def _c_split(tensor, group=None):
         return tensor
 
     def fn(a):
-        n = lax.axis_size(axis)
+        n = _axis_size(axis)
         idx = lax.axis_index(axis)
         sz = a.shape[-1] // n
         return lax.dynamic_slice_in_dim(a, idx * sz, sz, axis=a.ndim - 1)

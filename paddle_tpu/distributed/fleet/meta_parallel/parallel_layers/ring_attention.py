@@ -19,6 +19,7 @@ from jax import lax
 from .....ops import apply
 from .....tensor.tensor import Tensor
 from ....mesh import in_spmd_region
+from .....jax_compat import axis_size as _axis_size
 
 NEG_INF = -1e30
 
@@ -64,7 +65,7 @@ def ring_attention(q, k, v, axis_name="sep", causal=True, scale=None,
     chunk) pair draws an independent mask)."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     scale = jnp.float32(scale)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
 
@@ -131,7 +132,7 @@ def sep_split(x, axis_name="sep", seq_axis=1):
         return x
 
     def fn(a):
-        n = lax.axis_size(axis_name)
+        n = _axis_size(axis_name)
         idx = lax.axis_index(axis_name)
         sz = a.shape[seq_axis] // n
         return lax.dynamic_slice_in_dim(a, idx * sz, sz, axis=seq_axis)

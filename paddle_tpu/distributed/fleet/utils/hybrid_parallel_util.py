@@ -31,28 +31,53 @@ def broadcast_sep_parameters(model, hcg):
     pass
 
 
-def fused_allreduce_gradients(parameter_list, hcg):
+def fused_allreduce_gradients(parameter_list, hcg, compress=None,
+                              compress_chunk=None):
     """ref: :206 — allreduce grads over the data-parallel group; params
     tagged by mark_as_sequence_parallel_parameter additionally SUM over
     the model axis (their op touched only a sequence shard, so per-rank
     grads are partial — ref sequence_parallel_utils
-    register_sequence_parallel_allreduce_hooks)."""
+    register_sequence_parallel_allreduce_hooks).
+
+    compress="int8": the data-parallel averages ride the chunked int8
+    allreduce (comm_compress; see docs/distributed_perf.md). Stateless
+    helper, so no error feedback is carried here — callers that sync
+    every step and care about the bias should use EagerReducer/
+    SpmdTrainer, which persist EF residuals."""
     from ....ops import apply
     from jax import lax
 
+    if compress not in (None, "int8"):
+        raise ValueError(f"compress must be None or 'int8', got "
+                         f"{compress!r}")
     group = hcg.get_data_parallel_group() if hcg is not None else None
     if group is not None and group.nranks > 1:
         for p in parameter_list:
             if p.grad is not None:
-                all_reduce(p.grad, op=ReduceOp.AVG, group=group)
+                all_reduce(p.grad, op=ReduceOp.AVG, group=group,
+                           compress=compress, compress_chunk=compress_chunk)
     elif in_spmd_region("data"):
         # no group handle inside a bare shard_map region: pmean over the
         # axis directly (all_reduce(group=None) resolves to the world
         # group whose axis is None and would silently no-op)
-        for p in parameter_list:
-            if p.grad is not None:
-                g = apply(lambda a: lax.pmean(a, "data"), p.grad)
-                p.grad.data = g.data
+        if compress == "int8":
+            from ...comm_compress import quantized_psum, \
+                resolve_chunk
+            from ...mesh import mesh_axis_size
+            n = mesh_axis_size("data")
+            for p in parameter_list:
+                if p.grad is not None:
+                    g = apply(
+                        lambda a: quantized_psum(
+                            a, "data", axis_size=n,
+                            chunk=resolve_chunk(compress_chunk))[0] / n,
+                        p.grad)
+                    p.grad.data = g.data
+        else:
+            for p in parameter_list:
+                if p.grad is not None:
+                    g = apply(lambda a: lax.pmean(a, "data"), p.grad)
+                    p.grad.data = g.data
 
     if in_spmd_region("model"):
         from ..meta_parallel.parallel_layers.mp_ops import _mp_allreduce

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ....ops import apply
+from ....jax_compat import axis_size as _axis_size
 from ...mesh import in_spmd_region
 
 
@@ -60,7 +61,7 @@ def _allgather_seq_slice_grad_fn(axis, seq_axis):
         return f(x), None
 
     def bwd(_, g):
-        n = lax.axis_size(axis)
+        n = _axis_size(axis)
         idx = lax.axis_index(axis)
         sz = g.shape[seq_axis] // n
         return (lax.dynamic_slice_in_dim(g, idx * sz, sz, axis=seq_axis),)
@@ -76,7 +77,7 @@ def _scatter_seq_fn(axis, seq_axis):
     position's cotangent lives on exactly one rank)."""
     @jax.custom_vjp
     def f(x):
-        n = lax.axis_size(axis)
+        n = _axis_size(axis)
         idx = lax.axis_index(axis)
         sz = x.shape[seq_axis] // n
         return lax.dynamic_slice_in_dim(x, idx * sz, sz, axis=seq_axis)

@@ -21,8 +21,15 @@ from .parallel_env import get_world_size
 class DataParallel(Layer):
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
-                 group=None):
+                 group=None, grad_compress=None, compress_chunk=None):
         super().__init__()
+        if grad_compress not in (None, "int8"):
+            # validate even when no reducer gets built (world 1 / SPMD):
+            # a typo must not silently disable compression
+            raise ValueError(f"grad_compress must be None or 'int8', got "
+                             f"{grad_compress!r}")
+        from .comm_compress import resolve_chunk
+        resolve_chunk(compress_chunk)  # same eager contract for the chunk
         self._layers = layers
         self._group = group
         self.find_unused_parameters = find_unused_parameters
@@ -30,12 +37,16 @@ class DataParallel(Layer):
         self._reducer = None
         if get_world_size() > 1 and not in_spmd_region("data"):
             # eager multi-process DP: bucketed fused allreduce with
-            # during-backward dispatch (EagerReducer semantics)
+            # during-backward dispatch (EagerReducer semantics);
+            # grad_compress="int8" turns the flushes into chunked int8
+            # allreduces with per-bucket error feedback (see
+            # docs/distributed_perf.md)
             from .reducer import EagerReducer
             self._reducer = EagerReducer(
                 list(layers.parameters()),
                 bucket_bytes=int(comm_buffer_size) * 1024 * 1024,
-                group=group)
+                group=group, compress=grad_compress,
+                compress_chunk=compress_chunk)
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)

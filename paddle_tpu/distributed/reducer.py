@@ -23,10 +23,24 @@ import jax.numpy as jnp
 
 from ..tensor.tensor import Tensor
 from .collective import all_reduce, ReduceOp
+from .comm_compress import resolve_chunk
 
 
 class EagerReducer:
-    def __init__(self, params, bucket_bytes=25 * 1024 * 1024, group=None):
+    def __init__(self, params, bucket_bytes=25 * 1024 * 1024, group=None,
+                 compress=None, compress_chunk=None):
+        """compress="int8": bucket flushes ride the chunked int8
+        allreduce (comm_compress) with a per-bucket error-feedback
+        residual carried across steps, so the wire moves ~4x fewer bytes
+        while the long-run gradient sum stays exact. Default None keeps
+        the exact f32 flush, byte-identical to prior behavior."""
+        if compress not in (None, "int8"):
+            raise ValueError(f"compress must be None or 'int8', got "
+                             f"{compress!r}")
+        self.compress = compress
+        self.compress_chunk = resolve_chunk(compress_chunk)
+        self._ef_residual = {}
+        self._ef_members = {}    # bucket -> member set the residual is for
         self.group = group
         all_params = [p for p in params if not p.stop_gradient]
         # sparse-grad params (Embedding(sparse=True)) are excluded from
@@ -120,16 +134,61 @@ class EagerReducer:
         if not bucket:
             self._flushed[bi] = True
             return
+        present = tuple(i for i, p in enumerate(self.buckets[bi])
+                        if p.grad is not None)
         flats = [p.grad.data.reshape(-1).astype(jnp.float32) for p in bucket]
         sizes = [f.shape[0] for f in flats]
         fused = Tensor(jnp.concatenate(flats), stop_gradient=True)
-        all_reduce(fused, op=ReduceOp.AVG, group=self.group)
+        self._reduce_fused(fused, bi, present)
         off = 0
         for p, n in zip(bucket, sizes):
             piece = fused.data[off:off + n].reshape(p.grad.shape)
             p.grad = Tensor(piece.astype(p.grad.dtype), stop_gradient=True)
             off += n
         self._flushed[bi] = True
+
+    def _reduce_fused(self, fused, bi, present=None):
+        """AVG-allreduce one fused bucket. compress="int8" moves int8 +
+        per-chunk scales on the wire; the eager cross-process path adds
+        the previous step's residual before quantizing and keeps the new
+        quantization error (EF-SGD per bucket)."""
+        if self.compress != "int8":
+            all_reduce(fused, op=ReduceOp.AVG, group=self.group)
+            return
+        from .mesh import in_spmd_region
+        from .parallel_env import get_world_size
+        axis = self.group.axis_name if self.group is not None else None
+        if in_spmd_region(axis) and axis is not None:
+            # traced values: the int8 psum compiles into the program; a
+            # host-side residual cannot exist here (SpmdTrainer's
+            # state["ef"] is the EF carrier for compiled steps)
+            all_reduce(fused, op=ReduceOp.AVG, group=self.group,
+                       compress="int8", compress_chunk=self.compress_chunk)
+            return
+        world = (self.group.nranks if self.group is not None
+                 else get_world_size())
+        if world <= 1:
+            return  # nothing crosses a wire; exact by construction
+        from .collective import _require_initialized_multiproc
+        from . import comm_compress as _cc
+        _require_initialized_multiproc("all_reduce")
+        v = fused.data
+        res = self._ef_residual.get(bi)
+        # bucket membership can change between steps (params with no
+        # grad are skipped): a residual computed for a DIFFERENT member
+        # set must reset, even when the fused lengths coincide — shape
+        # alone would misattribute old error to the wrong params
+        if res is not None and self._ef_members.get(bi) == present \
+                and res.shape == v.shape:
+            v = v + res
+        tot, err = _cc.eager_quantized_allreduce(
+            v, self.group, chunk=self.compress_chunk)
+        self._ef_residual[bi] = err
+        self._ef_members[bi] = present
+        # AVG parity with the exact flush; the residual stays UNscaled —
+        # every rank feeds its own error back, and the next average
+        # divides the recovered sum by `world` again
+        fused.data = (tot / world).astype(fused.data.dtype)
 
     # -- public -------------------------------------------------------------
     def sync(self):

@@ -53,6 +53,16 @@ def tpu_compiler_params(**kw):
     return cls(**kw)
 
 
+def axis_size(axis_name):
+    """lax.axis_size(axis_name), or the psum-of-1 idiom where it doesn't
+    exist yet (0.4.x) — jax constant-folds psum over a literal, so the
+    result is a static int usable in shape arithmetic either way."""
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
 def set_cpu_device_count(n, platform="cpu"):
     """Force an n-device CPU platform for tests/multi-process workers.
 

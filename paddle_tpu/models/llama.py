@@ -159,7 +159,8 @@ class LlamaAttention(Layer):
             ka = ka.reshape(b, s, ka.shape[-1] // hd, hd)
             va = va.reshape(b, s, va.shape[-1] // hd, hd)
             if sp:
-                n_sep = lax.axis_size("sep")
+                from ..jax_compat import axis_size as _axis_size
+                n_sep = _axis_size("sep")
                 if s * n_sep > cos.shape[0]:
                     raise ValueError(
                         f"global sequence {s * n_sep} (local {s} x sep "

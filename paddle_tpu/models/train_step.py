@@ -41,6 +41,7 @@ from ..autograd import tape
 from ..framework import random as frnd
 from ..tensor.tensor import Tensor
 from ..distributed.mesh import spmd_axes
+from ..distributed.comm_compress import resolve_chunk as _resolve_chunk
 from ..distributed.fleet.meta_parallel.spmd import _Swap, param_spec
 # fwd psum / bwd identity — the Megatron "allreduce pair" (mp_ops:40);
 # used to share values across ranks without inflating the grad convention
@@ -97,10 +98,16 @@ class SpmdTrainer:
                  param_dtype=None, sharding_stage=2, pp_schedule="gpipe",
                  virtual_pp_degree=1, fuse_head_ce=True, ce_chunk=4096,
                  matmul_precision=None, recompute_policy="save_attn",
-                 moment_dtype="float32"):
+                 moment_dtype="float32", grad_compress=None,
+                 compress_chunk=None, grad_accum=1):
         if sharding_stage not in (1, 2, 3):
             raise ValueError(f"sharding_stage must be 1/2/3, got "
                              f"{sharding_stage}")
+        if grad_compress not in (None, "int8"):
+            raise ValueError(f"grad_compress must be None or 'int8', got "
+                             f"{grad_compress!r}")
+        if int(grad_accum) < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         if pp_schedule not in ("gpipe", "1f1b", "interleave"):
             raise ValueError(f"pp_schedule must be gpipe/1f1b/interleave, "
                              f"got {pp_schedule}")
@@ -117,6 +124,23 @@ class SpmdTrainer:
         self.recompute = recompute
         self.micro_batch_size = micro_batch_size
         self.sharding_stage = sharding_stage
+        # --- comm compression + deferred sync (docs/distributed_perf.md) ---
+        # grad_compress="int8": gradient collectives over the batch-like
+        # axes (data/sep psum, stage-1/2 sharding psum_scatter, stage-3
+        # gather-on-use grad scatter) ride chunked int8 with per-chunk
+        # scales; compression error is carried in state["ef"] and fed
+        # back into the next step's gradients (EF-SGD), so the quality
+        # cost is transient rounding, not accumulated drift. None (the
+        # default) keeps every collective exact f32 — byte-identical to
+        # prior behavior.
+        self.grad_compress = grad_compress
+        self.compress_chunk = _resolve_chunk(compress_chunk)
+        # grad_accum=K: split the local batch into K microbatches, scan a
+        # LOCAL value_and_grad over them (no collectives inside), and
+        # sync gradients ONCE after the scan — the deferred-sync pattern
+        # that hands XLA's latency-hiding scheduler one batch of
+        # collectives to overlap with the tail of backward compute.
+        self.grad_accum = int(grad_accum)
         self.pp_schedule = pp_schedule
         self.v_pp = virtual_pp_degree
         self.fuse_head_ce = fuse_head_ce
@@ -134,6 +158,11 @@ class SpmdTrainer:
         self._mdt = jnp.dtype(moment_dtype)
 
         self.S_pipe = mesh.shape.get("pipe", 1)
+        if self.grad_accum > 1 and self.S_pipe > 1:
+            raise ValueError(
+                "grad_accum>1 is the non-pipeline deferred-sync path; "
+                "with pipe>1 the microbatch loop (micro_batch_size=) "
+                "already accumulates locally and syncs once per step")
         self.S_shard = mesh.shape.get("sharding", 1)
         self.S_sep = mesh.shape.get("sep", 1)
         self.batch_axes = tuple(a for a in ("data", "sharding")
@@ -261,8 +290,13 @@ class SpmdTrainer:
             self._param_specs12(), is_leaf=lambda x: isinstance(x, P))
 
     def _state_specs(self):
-        return {"params": self._param_specs(), "opt": self._opt_specs(),
-                "step": P()}
+        specs = {"params": self._param_specs(), "opt": self._opt_specs(),
+                 "step": P()}
+        if self.grad_compress is not None:
+            # error-feedback residuals mirror the params tree exactly
+            # (stage 1/2: local-block shaped; stage 3: chunk shaped), f32
+            specs["ef"] = self._param_specs()
+        return specs
 
     # ---- stage-3 chunk <-> block conversion (runs inside shard_map) --------
     def _chunkify_outer(self, p_loc, i):
@@ -293,10 +327,24 @@ class SpmdTrainer:
             return lax.dynamic_slice_in_dim(flat, r * chunk, chunk, axis=1)
         return flat
 
+    def _gather_chunks(self, chunk):
+        """Stage-3 gather-on-use. With grad_compress the gather's AD
+        TRANSPOSE — the ZeRO-3 grad reduce-scatter — moves int8 instead
+        of f32 (comm_compress.all_gather_with_qscatter_grad); the forward
+        param gather itself stays exact, so non-grad users
+        (init/canonical/gather_params) are byte-identical either way."""
+        if self.grad_compress == "int8":
+            from ..distributed.comm_compress import (
+                all_gather_with_qscatter_grad)
+            return all_gather_with_qscatter_grad(
+                chunk, "sharding", axis_size=self.S_shard,
+                chunk=self.compress_chunk)
+        return lax.all_gather(chunk, "sharding", axis=0, tiled=True)
+
     def _ungather_outer(self, chunk, i):
         n = self.outer_loc_n[i]
         if self.S_shard > 1:
-            flat = lax.all_gather(chunk, "sharding", axis=0, tiled=True)
+            flat = self._gather_chunks(chunk)
         else:
             flat = chunk
         return flat[:n].reshape(self.outer_loc_shapes[i])
@@ -305,7 +353,7 @@ class SpmdTrainer:
         """chunk: [chunk_i] for ONE layer -> local block."""
         n = self.layer_loc_n[i]
         if self.S_shard > 1:
-            flat = lax.all_gather(chunk, "sharding", axis=0, tiled=True)
+            flat = self._gather_chunks(chunk)
         else:
             flat = chunk
         return flat[:n].reshape(self.layer_loc_shapes[i])
@@ -361,10 +409,13 @@ class SpmdTrainer:
                                            self._opt_specs()),
                                 check_vma=False)
             params, opt = jax.jit(smapped)(params12)
-            return {"params": params, "opt": opt,
-                    "step": jax.device_put(
-                        jnp.zeros((), jnp.int32),
-                        NamedSharding(self.mesh, P()))}
+            state = {"params": params, "opt": opt,
+                     "step": jax.device_put(
+                         jnp.zeros((), jnp.int32),
+                         NamedSharding(self.mesh, P()))}
+            if self.grad_compress is not None:
+                state["ef"] = self._init_ef(params)
+            return state
 
         # stage 1/2: AdamW moments created INSIDE the SPMD region so chunk
         # sizes follow the LOCAL (model/pipe-sharded) param shapes; flat dim
@@ -383,10 +434,22 @@ class SpmdTrainer:
                             in_specs=(self._param_specs12(),),
                             out_specs=self._opt_specs(), check_vma=False)
         opt = jax.jit(smapped)(params12)
-        return {"params": params12, "opt": opt,
-                "step": jax.device_put(
-                        jnp.zeros((), jnp.int32),
-                        NamedSharding(self.mesh, P()))}
+        state = {"params": params12, "opt": opt,
+                 "step": jax.device_put(
+                         jnp.zeros((), jnp.int32),
+                         NamedSharding(self.mesh, P()))}
+        if self.grad_compress is not None:
+            state["ef"] = self._init_ef(params12)
+        return state
+
+    def _init_ef(self, params):
+        """Zero error-feedback residuals: f32, one per param leaf, the
+        leaf's (global) shape and sharding spec."""
+        specs = self._param_specs()
+        return {kind: [jax.device_put(jnp.zeros(a.shape, jnp.float32),
+                                      NamedSharding(self.mesh, s))
+                       for a, s in zip(params[kind], specs[kind])]
+                for kind in ("outer", "stacked")}
 
     # ---- mesh-independent canonical state (cross-mesh restore) -------------
     def _stage12_moment_geom(self):
@@ -582,7 +645,15 @@ class SpmdTrainer:
                                     for k in ("m", "v")}
                                    for (n, c), ent in zip(mg_stacked,
                                                           m12["stacked"])]}
-            return {"params": params, "opt": opt, "step": step}
+            out = {"params": params, "opt": opt, "step": step}
+            if self.grad_compress is not None:
+                # EF residuals are transient device state (sub-one-step
+                # rounding error): canonical form drops them, restore
+                # re-zeros them
+                out["ef"] = {kind: [jnp.zeros(a.shape, jnp.float32)
+                                    for a in params[kind]]
+                             for kind in ("outer", "stacked")}
+            return out
 
         mspec12 = jax.tree_util.tree_map(
             lambda s: {"m": s, "v": s},
@@ -829,11 +900,24 @@ class SpmdTrainer:
                         loss / mesh.shape["model"])
                 return loss
 
-        def adamw_update12(p, g, st, step, lr):
-            """stage 1/2: p is the full local block; g is psum'd over 'data'
-            but still PARTIAL over 'sharding' — reduce-scatter completes the
-            sum while handing each rank exactly its owned chunk
-            (ref: group_sharded_stage2.py grad reduce-to-owner hooks)."""
+        def _adamw_core(pl, gl, st, step, lr):
+            """the AdamW math itself — moments, bias correction, decoupled
+            decay — shared by all four (exact/int8 x stage12/stage3)
+            variants so a fix here cannot drift between them. pl/gl are
+            f32 views of this rank's owned slice."""
+            m = b1 * st["m"].astype(jnp.float32) + (1 - b1) * gl
+            v = b2 * st["v"].astype(jnp.float32) + (1 - b2) * gl * gl
+            t = step.astype(jnp.float32)
+            mhat = m / (1 - b1 ** t)
+            vhat = v / (1 - b2 ** t)
+            pl = pl * (1 - lr * wd) - lr * mhat / (jnp.sqrt(vhat) + eps)
+            return pl, {"m": m.astype(mdt), "v": v.astype(mdt)}
+
+        def _update12_scaffold(p, g, st, step, lr, scatter):
+            """stage 1/2 scaffold shared by the exact and int8 paths:
+            pad + flatten, reduce-to-owner via scatter(gf) -> (owned
+            grad chunk, residual-or-None), core update on the owned
+            chunk, re-gather, unpad. Returns (p', moments, residual)."""
             shape = p.shape
             n = int(np.prod(shape))
             pad = (-n) % S_shard
@@ -843,45 +927,99 @@ class SpmdTrainer:
             pf = p.reshape(-1).astype(jnp.float32)
             if pad:
                 pf = jnp.concatenate([pf, jnp.zeros(pad, jnp.float32)])
+            err = None
             if S_shard > 1:
                 chunk = gf.shape[0] // S_shard
-                gl = lax.psum_scatter(gf, "sharding", scatter_dimension=0,
-                                      tiled=True)
+                gl, err = scatter(gf)
                 r = lax.axis_index("sharding")
                 pl = lax.dynamic_slice_in_dim(pf, r * chunk, chunk)
             else:
                 gl, pl = gf, pf
-            m = b1 * st["m"].astype(jnp.float32) + (1 - b1) * gl
-            v = b2 * st["v"].astype(jnp.float32) + (1 - b2) * gl * gl
-            t = step.astype(jnp.float32)
-            mhat = m / (1 - b1 ** t)
-            vhat = v / (1 - b2 ** t)
-            pl = pl * (1 - lr * wd) - lr * mhat / (jnp.sqrt(vhat) + eps)
+            pl, stn = _adamw_core(pl, gl, st, step, lr)
             if S_shard > 1:
                 pf = lax.all_gather(pl, "sharding", axis=0, tiled=True)
             else:
                 pf = pl
             if pad:
                 pf = pf[:n]
-            return (pf.reshape(shape).astype(p.dtype),
-                    {"m": m.astype(mdt), "v": v.astype(mdt)})
+            return pf.reshape(shape).astype(p.dtype), stn, err
+
+        def adamw_update12(p, g, st, step, lr):
+            """stage 1/2: p is the full local block; g is psum'd over 'data'
+            but still PARTIAL over 'sharding' — reduce-scatter completes the
+            sum while handing each rank exactly its owned chunk
+            (ref: group_sharded_stage2.py grad reduce-to-owner hooks)."""
+            def scatter(gf):
+                return lax.psum_scatter(gf, "sharding",
+                                        scatter_dimension=0,
+                                        tiled=True), None
+            pn, stn, _ = _update12_scaffold(p, g, st, step, lr, scatter)
+            return pn, stn
 
         def adamw_update3(p, g, st, step, lr):
             """stage 3: p IS the owned chunk; g arrived reduce-scattered by
             the AD transpose of the gather-on-use all_gather. Elementwise
             update, nothing re-gathered (ref: group_sharded_stage3.py:486)."""
-            gf = g.astype(jnp.float32)
-            m = b1 * st["m"].astype(jnp.float32) + (1 - b1) * gf
-            v = b2 * st["v"].astype(jnp.float32) + (1 - b2) * gf * gf
-            t = step.astype(jnp.float32)
-            mhat = m / (1 - b1 ** t)
-            vhat = v / (1 - b2 ** t)
-            pf = (p.astype(jnp.float32) * (1 - lr * wd)
-                  - lr * mhat / (jnp.sqrt(vhat) + eps))
-            return pf.astype(p.dtype), {"m": m.astype(mdt),
-                                        "v": v.astype(mdt)}
+            pl, stn = _adamw_core(p.astype(jnp.float32),
+                                  g.astype(jnp.float32), st, step, lr)
+            return pl.astype(p.dtype), stn
 
         adamw_update = adamw_update3 if stage3 else adamw_update12
+
+        # ---- compressed gradient reduction (grad_compress="int8") ---------
+        comp = self.grad_compress == "int8"
+        cchunk = self.compress_chunk
+        if comp:
+            from ..distributed import comm_compress as _cc
+
+            def compress_reduce(g, ef):
+                """EF-add + chunked-int8 psum over the batch-like axes.
+
+                Returns (reduced f32 grad, accumulated residual, repl):
+                each stage's residual is divided by the replication degree
+                already accumulated (errors computed AFTER reducing axis A
+                are identical across A's ranks — next step every rank
+                feeds them back, so the psum over A would scale them by
+                |A| without the division)."""
+                v = g.astype(jnp.float32) + ef
+                err_tot = jnp.zeros(v.shape, jnp.float32)
+                out, repl = v, 1
+                for ax in data_axes + sep_axes:
+                    nax = int(mesh.shape[ax])
+                    if nax == 1:
+                        continue
+                    out, err = _cc.quantized_psum(out, ax, axis_size=nax,
+                                                  chunk=cchunk)
+                    err_tot = err_tot + err / repl
+                    repl *= nax
+                return out, err_tot, repl
+
+            def adamw_update12_c(p, g, ef, st, step, lr):
+                """stage 1/2 update with int8 DP psum + int8 'sharding'
+                reduce-scatter; same scaffold + core as adamw_update12,
+                plus the EF residual bookkeeping."""
+                gr, err_tot, repl = compress_reduce(g, ef)
+
+                def scatter(gf):
+                    return _cc.quantized_psum_scatter(
+                        gf, "sharding", axis_size=S_shard, chunk=cchunk)
+                pn, stn, err_s = _update12_scaffold(p, gr, st, step, lr,
+                                                    scatter)
+                if err_s is not None:
+                    n = int(np.prod(p.shape))
+                    err_tot = err_tot + (err_s[:n].reshape(p.shape) / repl)
+                return pn, stn, err_tot
+
+            def adamw_update3_c(p, g, ef, st, step, lr):
+                """stage 3: g is the owned chunk (already reduce-scattered
+                — in int8 when grad_compress is on, via the gather-on-use
+                custom VJP); compress the remaining DP psum with EF."""
+                gr, err_tot, _ = compress_reduce(g, ef)
+                pl, stn = _adamw_core(p.astype(jnp.float32), gr, st,
+                                      step, lr)
+                return pl.astype(p.dtype), stn, err_tot
+
+            adamw_update_c = adamw_update3_c if stage3 else adamw_update12_c
 
         # ---- 1F1B / interleaved schedule (hand-rolled bwd) ----------------
         use_1f1b = S > 1 and self.pp_schedule in ("1f1b", "interleave")
@@ -942,6 +1080,44 @@ class SpmdTrainer:
                 for ax in batch_axes + sep_axes:
                     loss = lax.pmean(loss, ax)
                 return loss, grads
+        elif self.grad_accum > 1:
+            K_acc = self.grad_accum
+
+            def loss_and_grads(params, ids, labels, key):
+                # deferred sync: a lax.scan of LOCAL value_and_grad over K
+                # microbatches — no GRADIENT collectives inside the scan
+                # (loss_fn still pmeans the scalar loss and re-shares
+                # untied params each iteration); the one batched gradient
+                # sync happens after, where XLA's latency-hiding scheduler
+                # can overlap it with the last microbatch's backward
+                # (docs/distributed_perf.md)
+                B_loc, T = ids.shape
+                if B_loc % K_acc:
+                    raise ValueError(
+                        f"grad_accum={K_acc} must divide the per-rank "
+                        f"batch {B_loc}")
+                ids_k = ids.reshape(K_acc, B_loc // K_acc, T)
+                lab_k = labels.reshape(K_acc, B_loc // K_acc, T)
+                keys = jax.random.split(key, K_acc)
+
+                def body(carry, xs):
+                    acc_l, acc_g = carry
+                    mb_ids, mb_lab, mb_key = xs
+                    l, g = jax.value_and_grad(loss_fn)(params, mb_ids,
+                                                       mb_lab, mb_key)
+                    acc_g = jax.tree_util.tree_map(
+                        lambda a, b: a + b.astype(jnp.float32), acc_g, g)
+                    return (acc_l + l, acc_g), None
+
+                zero_g = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                (loss, grads), _ = lax.scan(
+                    body, (jnp.zeros((), jnp.float32), zero_g),
+                    (ids_k, lab_k, keys))
+                # each slice's loss/grad is a slice-mean; averaging the K
+                # equal slices reproduces the full-batch mean
+                grads = jax.tree_util.tree_map(lambda a: a / K_acc, grads)
+                return loss / K_acc, grads
         else:
             def loss_and_grads(params, ids, labels, key):
                 return jax.value_and_grad(loss_fn)(params, ids, labels, key)
@@ -961,15 +1137,19 @@ class SpmdTrainer:
             # already pmean'd so AD emits 1/N-scaled partials -> psum).
             # 'sharding'-axis completion happens in the update:
             # psum_scatter (stage 1/2) or the AD-inserted reduce-scatter of
-            # the gather-on-use (stage 3).
-            def reduce_grad(g):
-                for ax in data_axes + sep_axes:
-                    g = lax.psum(g, ax)
-                return g
+            # the gather-on-use (stage 3). With grad_compress both of
+            # those syncs ride chunked int8 inside the per-param update
+            # (compress_reduce / quantized_psum_scatter) instead.
+            if not comp:
+                def reduce_grad(g):
+                    for ax in data_axes + sep_axes:
+                        g = lax.psum(g, ax)
+                    return g
 
-            grads = jax.tree_util.tree_map(reduce_grad, grads)
+                grads = jax.tree_util.tree_map(reduce_grad, grads)
             # Megatron-SP: norm weights saw only this rank's sequence
-            # shard — complete their grads across the TP group
+            # shard — complete their grads across the TP group (exact:
+            # the model axis is not a compressed path)
             if sp_active:
                 grads["stacked"] = [
                     lax.psum(g, "model") if flag else g
@@ -980,6 +1160,19 @@ class SpmdTrainer:
                                   for g in grads["outer"]]
             new_params = {"outer": [], "stacked": []}
             new_opt = {"outer": [], "stacked": []}
+            if comp:
+                new_ef = {"outer": [], "stacked": []}
+                for kind in ("outer", "stacked"):
+                    for p, g, ef, st in zip(params[kind], grads[kind],
+                                            state["ef"][kind],
+                                            state["opt"][kind]):
+                        np_, nst, nef = adamw_update_c(p, g, ef, st, step,
+                                                       lr)
+                        new_params[kind].append(np_)
+                        new_opt[kind].append(nst)
+                        new_ef[kind].append(nef)
+                return ({"params": new_params, "opt": new_opt,
+                         "ef": new_ef, "step": step}, loss)
             for kind in ("outer", "stacked"):
                 for p, g, st in zip(params[kind], grads[kind],
                                     state["opt"][kind]):
@@ -1066,9 +1259,16 @@ class SpmdTrainer:
                    for k in ("m", "v")} for (_, c) in mg_outer]
             ms = [{k: sds((c * n_dev,), self._mdt, all_axes)
                    for k in ("m", "v")} for (_, c) in mg_stacked]
-        return {"params": {"outer": p_outer, "stacked": p_stacked},
-                "opt": {"outer": mo, "stacked": ms},
-                "step": sds((), jnp.int32, P())}
+        out = {"params": {"outer": p_outer, "stacked": p_stacked},
+               "opt": {"outer": mo, "stacked": ms},
+               "step": sds((), jnp.int32, P())}
+        if self.grad_compress is not None:
+            out["ef"] = {
+                "outer": [sds(x.shape, jnp.float32, sp) for x, sp in
+                          zip(p_outer, specs["outer"])],
+                "stacked": [sds(x.shape, jnp.float32, sp) for x, sp in
+                            zip(p_stacked, specs["stacked"])]}
+        return out
 
     def memory_analysis(self, state, ids, labels):
         """Compile-time per-device memory accounting of the step program

@@ -27,6 +27,7 @@ from jax import lax
 
 from .passes import PassBase, register_pass
 from ..distributed.mesh import in_spmd_region
+from ..jax_compat import axis_size as _axis_size
 
 
 @register_pass("data_parallel_gradient_sync")
@@ -177,7 +178,7 @@ def build_train_callable(program, optimizer, fetch_ids, shard_degree=1):
         opt_st = {k: v for k, v in st.items() if not k.startswith("__")}
         if chunked and in_spmd_region(shard["axis"]):
             axis = shard["axis"]
-            S = lax.axis_size(axis)
+            S = _axis_size(axis)
             shape = tuple(p.data.shape)
             n = int(np.prod(shape))
             pad = (-n) % S

@@ -79,6 +79,10 @@ def pytest_runtest_logreport(report):
               file=sys.stderr, flush=True)
 
 
+_LAST_WALL_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               ".tier1_last_wall.json")
+
+
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
     if _suite_t0[0] is None:
         return
@@ -87,6 +91,58 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
     tr.section("tier-1 runtime guard")
     tr.write_line(f"total wall time: {total:.1f}s "
                   f"(driver timeout 870s, warn at {_SUITE_BUDGET_WARN_S}s)")
+    # delta vs the previous COMPLETED full-suite run (cacheprovider is
+    # disabled in the tier-1 command, so the record lives in a sidecar
+    # file; a run the driver kills at 870s never reaches this hook and
+    # leaves the record untouched). The delta is what a PR review needs:
+    # did THIS change add wall time that will displace tail tests past
+    # the kill? Filtered/partial invocations (single files, -k) are
+    # neither compared nor recorded — a 5s subset run must not poison
+    # the baseline the guard measures against.
+    import json
+    full_suite = len(_test_durations) >= 200
+    prev = None
+    try:
+        with open(_LAST_WALL_FILE) as f:
+            prev = json.load(f)
+    except (OSError, ValueError):
+        pass
+    # comparability gate: tier-1 (-m 'not slow') and the full suite both
+    # clear the >=200 floor but differ by hundreds of tests — a delta
+    # across selections is noise (and a negative one can mask a real
+    # tier-1 regression). Compare only when the counts are within 10%;
+    # the record below still refreshes, so the next same-selection run
+    # compares again.
+    comparable = (prev is not None
+                  and isinstance(prev.get("total_wall_s"), (int, float))
+                  and isinstance(prev.get("n_tests"), int)
+                  and prev["n_tests"] > 0
+                  and abs(len(_test_durations) - prev["n_tests"])
+                  <= 0.1 * prev["n_tests"])
+    if full_suite and prev and not comparable:
+        tr.write_line(
+            f"delta vs previous run: skipped — different selection "
+            f"({prev.get('n_tests', '?')} tests then, "
+            f"{len(_test_durations)} now)")
+    if full_suite and comparable:
+        delta = total - prev["total_wall_s"]
+        tr.write_line(
+            f"delta vs previous run: {delta:+.1f}s "
+            f"(previous: {prev['total_wall_s']:.1f}s, "
+            f"{prev.get('n_tests', '?')} tests; now {len(_test_durations)})")
+        if delta > 30:
+            tr.write_line(
+                f"!!! this run is {delta:.0f}s slower than the previous "
+                "one — with the suite already timeout-bound, that wall "
+                "time displaces tail tests out of DOTS_PASSED.",
+                yellow=True, bold=True)
+    if full_suite:
+        try:
+            with open(_LAST_WALL_FILE, "w") as f:
+                json.dump({"total_wall_s": round(total, 1),
+                           "n_tests": len(_test_durations)}, f)
+        except OSError:
+            pass
     for dur, nodeid in sorted(_test_durations, reverse=True)[:10]:
         tr.write_line(f"  {dur:7.2f}s  {nodeid}")
     if total > _SUITE_BUDGET_WARN_S:

@@ -20,15 +20,45 @@ must leave a parseable BENCH artifact).
 """
 import json
 import os
+import socket
 import sys
 import time
 import traceback
 
 
 def _emit(payload):
+    # Provenance stamp on EVERY metric set (BENCH_r03-r05: "backend
+    # unavailable" debugging had to reconstruct which jax/backend/host a
+    # line came from out of driver logs). Callers' explicit values win —
+    # e.g. the cpu-fallback subprocess tags "backend": "cpu-fallback".
+    payload.setdefault("jax_version", _jax_version())
+    payload.setdefault("backend", _backend_name())
+    payload.setdefault("hostname", socket.gethostname())
     sys.stdout.flush()
     print(json.dumps(payload))
     sys.stdout.flush()
+
+
+def _jax_version():
+    try:
+        import jax
+        return jax.__version__
+    except Exception:
+        return "unimportable"
+
+
+def _backend_name():
+    """jax.default_backend() without forcing backend init here: if the
+    backend has not come up yet (or never does), the stamp must not
+    hang or raise — the whole point is emitting on failure paths."""
+    try:
+        import jax
+        from jax._src import xla_bridge
+        if getattr(xla_bridge, "_backends", None):
+            return jax.default_backend()
+        return os.environ.get("JAX_PLATFORMS") or "uninitialized"
+    except Exception:
+        return "unknown"
 
 
 def _init_backend_with_retry(retries=5, base_delay=5.0, probe_timeout=120.0):

@@ -56,6 +56,15 @@ def main():
                          "+ K decode steps (on-device sampling/EOS); "
                          "the host intervenes every K tokens "
                          "(scheduler mode; see docs/serving.md)")
+    ap.add_argument("--megakernel", choices=["auto", "off", "layer",
+                                             "multi"], default="auto",
+                    help="decode-layer Pallas megakernel: one fused "
+                         "kernel per layer (or per stack, 'multi') "
+                         "streams int8/dense weights through VMEM — "
+                         "auto turns it on only on a real TPU with a "
+                         "lane-aligned geometry; forcing it on CPU runs "
+                         "interpret mode (parity, not speed; scheduler "
+                         "mode, docs/serving.md \"Megakernel decode\")")
     args = ap.parse_args()
 
     import paddle_tpu as paddle
@@ -96,7 +105,9 @@ def main():
             weight_dtype=weight_dtype,
             queue_limit=args.queue_limit,
             default_deadline_ms=args.deadline_ms,
-            decode_block=args.decode_block)
+            decode_block=args.decode_block,
+            megakernel={"auto": None, "off": False}.get(args.megakernel,
+                                                        args.megakernel))
         rng = np.random.RandomState(0)
         # ragged prompts; 1 shares 0's prefix (once 0 finishes prefill,
         # the cache turns the shared pages into refcounted read-only
@@ -122,6 +133,7 @@ def main():
         fused = (f"{engine.fused_blocks} fused blocks "
                  f"({engine.chained_blocks} pipelined), "
                  if args.decode_block > 1 else "")
+        fused += f"megakernel={engine.health()['megakernel']}, "
         print(f"model={args.model} quant={args.quant} scheduler: "
               f"{len(submitted)} ragged requests in "
               f"{engine.steps} steps ({engine.prefill_steps} prefill / "

@@ -328,6 +328,16 @@ class ContinuousBatchingEngine(LLMEngine):
       ragged_kernel: force (True/False) the Pallas ragged-prefill
         kernel; default None = kernel on TPU, dense gathered math under
         interpret/CPU.
+      megakernel: decode-layer megakernel knob (ops/pallas/
+        decode_megakernel). None (default) = auto: the per-layer
+        megakernel on TPU when the geometry supports it, the existing
+        fused op-chain under interpret/CPU; True/"layer" forces the
+        per-layer megakernel (interpret mode on CPU — the parity
+        fallback, byte-identical greedy to the op-chain path); "multi"
+        scans ALL layers inside one kernel invocation (weights stream
+        across layer boundaries; the KV pool is viewed [L, ...] per
+        decode step — see docs/serving.md "Megakernel decode"); False
+        forces off.
       queue_limit: bounded admission queue — add_request past this depth
         raises EngineBusyError (typed backpressure) instead of growing
         an unbounded backlog. None (default) = unbounded.
@@ -351,7 +361,8 @@ class ContinuousBatchingEngine(LLMEngine):
                  prefill_chunk=None, slot_buckets=None, prefix_cache=True,
                  queue_limit=None, default_deadline_ms=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                 seed=0, decode_block=1, ragged_kernel=None, **kw):
+                 seed=0, decode_block=1, ragged_kernel=None,
+                 megakernel=None, **kw):
         super().__init__(model, max_len=max_len, page_size=page_size,
                          max_batch=max_batch, **kw)
         self.prefill_chunk = int(prefill_chunk or page_size)
@@ -367,6 +378,20 @@ class ContinuousBatchingEngine(LLMEngine):
         # under interpret/CPU (the dense path is what is byte-identical
         # to the per-step engine); True/False force either.
         self.ragged_kernel = ragged_kernel
+        # megakernel: decode-layer Pallas megakernel — auto ("layer")
+        # on TPU, off under interpret/CPU unless forced. Weights are
+        # repacked ONCE here into the streamed layout (views/cheap
+        # reshapes for aligned geometries; "multi" additionally stacks
+        # them [L, ...] so one invocation streams every layer).
+        self.megakernel = self._resolve_megakernel(megakernel)
+        if self.megakernel:
+            from ..ops.pallas.decode_megakernel import (pack_decode_layer,
+                                                        stack_packed)
+            packed = [pack_decode_layer(ws, cdtype=self.kv_dtype)
+                      for ws in self.weights["layers"]]
+            self.weights["mk"] = (stack_packed(packed)
+                                  if self.megakernel == "multi"
+                                  else packed)
         if slot_buckets is None:
             slot_buckets = []
             w = 1
@@ -623,6 +648,9 @@ class ContinuousBatchingEngine(LLMEngine):
             "decode_block": self.decode_block,
             "fused_blocks": self.fused_blocks,
             "chained_blocks": self.chained_blocks,
+            # active decode-kernel mode: "off" = per-op XLA chain,
+            # "layer"/"multi" = the Pallas decode megakernel
+            "megakernel": self.megakernel if self.megakernel else "off",
         }
 
     def generate_many(self, prompts, max_new_tokens=32, eos_token_id=None):
@@ -855,13 +883,111 @@ class ContinuousBatchingEngine(LLMEngine):
                                       r.pages[j], self.allocator)
 
     # -- decode ------------------------------------------------------------
+    def _resolve_megakernel(self, val):
+        """megakernel= knob -> False / "layer" / "multi". Auto (None)
+        turns the per-layer megakernel on only where it is the fast
+        path AND the geometry reslices cleanly: real TPU, lane-multiple
+        head/hidden dims (megakernel_supported). Forcing True on CPU
+        runs it in interpret mode — the parity fallback the tests pin
+        against the op-chain path."""
+        from ..ops.pallas.decode_megakernel import megakernel_supported
+        ok = megakernel_supported(self.nh, self.nh_kv, self.hd,
+                                  self.cfg.hidden_size,
+                                  self.cfg.intermediate_size)
+        if val is None:
+            return "layer" if (ok and not self.interpret) else False
+        if val is False:
+            return False
+        if val in (True, "layer"):
+            mode = "layer"
+        elif val == "multi":
+            mode = "multi"
+        else:
+            raise ValueError(
+                f"megakernel must be None, False, True, 'layer' or "
+                f"'multi', got {val!r}")
+        # forcing on a real TPU with a non-lane-aligned geometry would
+        # die deep in Mosaic lowering — fail HERE with the reason
+        # (interpret mode has no such constraint: CPU parity always ok)
+        if not self.interpret and not ok:
+            raise ValueError(
+                f"megakernel={mode!r} forced on TPU but the geometry "
+                f"(nh={self.nh}, nh_kv={self.nh_kv}, hd={self.hd}, "
+                f"hidden={self.cfg.hidden_size}, "
+                f"ffn={self.cfg.intermediate_size}) fails "
+                "megakernel_supported (head/hidden/ffn dims must be "
+                "lane multiples); use the auto default or a supported "
+                "geometry")
+        return mode
+
+    def _cb_decode_math_mk(self, W, tok, k_pages_all, v_pages_all,
+                           tables, lens, active, w):
+        """Megakernel decode step: each layer (or, in "multi" mode, the
+        whole stack) runs as ONE Pallas invocation — matmuls, norms,
+        rope and paged attention fused, weights streamed through VMEM.
+        The kernel attends with the current token's k/v substituted
+        into its page block and returns them for the SAME scatter the
+        op-chain path performs, so the page pool contents stay
+        byte-identical between the two paths."""
+        from ..ops.pallas.decode_megakernel import decode_megakernel
+        p = self.page_size
+        h = jnp.take(W["emb"], tok, axis=0).astype(self.kv_dtype)  # [w, H]
+        cos_sel = W["cos"][lens].astype(h.dtype)
+        sin_sel = W["sin"][lens].astype(h.dtype)
+        oob = jnp.int32(self.n_pages * p)
+        slots = (tables[jnp.arange(w), lens // p] * p + lens % p)
+        slots = jnp.where(active, slots, oob)
+        act_i = active.astype(jnp.int32)
+        kw = dict(nh=self.nh, nh_kv=self.nh_kv, hd=self.hd,
+                  eps=self.cfg.rms_norm_eps, interpret=self.interpret)
+
+        def scatter(pool, new):
+            flat = pool.reshape(-1, self.nh_kv, self.hd)
+            flat = flat.at[slots].set(
+                new.reshape(w, self.nh_kv, self.hd).astype(self.kv_dtype),
+                mode="drop")
+            return flat.reshape(self.n_pages, p, self.nh_kv, self.hd)
+
+        new_k, new_v = [], []
+        if self.megakernel == "multi":
+            # one invocation for the whole stack: the weight stream
+            # pipelines across layer boundaries. The KV pool is viewed
+            # [L, ...] for the call — inside the scanned step the pools
+            # are carries, so XLA materializes the stack each step:
+            # traffic ~ pool size, acceptable only while the pool is
+            # small next to the weight stream (docs/serving.md caveat;
+            # native [L, ...] pool storage is the follow-up that
+            # removes it — the per-layer mode avoids it entirely).
+            h, k_all, v_all = decode_megakernel(
+                h, W["mk"], jnp.stack(k_pages_all), jnp.stack(v_pages_all),
+                tables, lens, act_i, cos_sel, sin_sel, **kw)
+            for li in range(len(k_pages_all)):
+                new_k.append(scatter(k_pages_all[li], k_all[li]))
+                new_v.append(scatter(v_pages_all[li], v_all[li]))
+        else:
+            for li, mset in enumerate(W["mk"]):
+                h, k_new, v_new = decode_megakernel(
+                    h, mset, k_pages_all[li], v_pages_all[li], tables,
+                    lens, act_i, cos_sel, sin_sel, **kw)
+                new_k.append(scatter(k_pages_all[li], k_new))
+                new_v.append(scatter(v_pages_all[li], v_new))
+        h = _rms(h[:, None], W["norm"], W["eps"])
+        logits = _mm(h, W["head"], self.interpret)
+        return logits[:, 0], new_k, new_v
+
     def _cb_decode_math(self, W, tok, k_pages_all, v_pages_all, tables,
                         lens, active, w):
         """One decode step at slot-bucket width w, fully traceable
         (shared by the per-step jit and the fused multi-step scan, so
         both paths run byte-identical math): one token for every slot,
         inactive slots write nothing (scatter-drop) and skip attention
-        compute/DMA via the kernel's active mask."""
+        compute/DMA via the kernel's active mask. With megakernel= on,
+        the per-layer op chain is replaced by the fused Pallas
+        megakernel (same math, same page writes)."""
+        if self.megakernel:
+            return self._cb_decode_math_mk(W, tok, k_pages_all,
+                                           v_pages_all, tables, lens,
+                                           active, w)
         p = self.page_size
         h = jnp.take(W["emb"], tok[:, None], axis=0).astype(
             self.kv_dtype)

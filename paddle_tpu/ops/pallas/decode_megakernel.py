@@ -1,0 +1,595 @@
+"""Per-layer decode MEGAKERNEL (TPU Pallas): one kernel invocation runs a
+whole transformer decode layer — int8 weight-only Q/K/V/O/MLP matmuls,
+RMS-norm, rope, and paged attention — with the weights STREAMED through
+VMEM tile-by-tile.
+
+Why: PR 3 made the decode loop device-resident, but the fused block still
+emits one XLA op per layer op, and int8 7B decode is weight-bandwidth-
+bound (NOTES_r5). MPK (PAPERS.md) shows compiling the whole tensor
+program into one mega-kernel erases exactly the per-op dispatch and the
+HBM round trips between ops. This kernel is that idea at decode scale:
+
+  - ONE 1-D grid whose steps walk a statically-built SCHEDULE of tiles:
+      Q -> K -> V -> ATTN -> O -> G -> U -> D        (per layer)
+    Matmul phases iterate (n-tile outer, k-tile inner) over the weight;
+    the ATTN phase iterates (slot, page) exactly like the tuned
+    paged-attention kernel. Scalar-prefetched schedule arrays drive
+    every BlockSpec index map, so each grid step DMAs precisely the
+    weight tile / KV page it needs while Pallas's pipeline prefetches
+    the NEXT step's block — the weights double-buffer through VMEM and
+    the kernel runs at weight-bandwidth, not dispatch, limits.
+  - Activations (a decode step is [b<=8, H]) live ENTIRELY in VMEM
+    scratch for the whole layer: hidden state, normed input, q/k/v,
+    attention accumulators, MLP activations. Nothing bounces to HBM
+    between ops.
+  - The multi-layer variant stacks weights [L, ...] and extends the
+    schedule across layers, so while layer L's MLP tail computes, layer
+    L+1's Q/K/V weight tiles are already streaming in: the weight-
+    stream pipeline crosses layer boundaries inside ONE invocation.
+
+Numerics are kept step-for-step identical to the unfused engine path
+(`inference/scheduler._cb_decode_math`): the matmul k-tiling matches
+quantized_matmul's (f32 accumulator, per-channel scale at emission), the
+norm replicates serving._rms's cast order, and the attention phase runs
+the decode kernel's per-page online softmax with the CURRENT token's
+k/v substituted into its page block (the unfused path scatters them into
+the page before attending; substituting after the load is the same
+block content, so the online-softmax trajectory is bitwise-equal on
+CPU/f32). Interpret mode on CPU is the parity fallback; see
+tests/test_decode_megakernel.py.
+
+Layout notes: q/k/v/attention rows live FLAT [b, heads*hd] in VMEM and
+are reshaped [heads, hd] per slot only inside the ATTN phase — Mosaic
+tolerates that reshape when hd is a lane multiple, which is what
+`megakernel_supported` gates on for the auto engine knob.
+"""
+import functools
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...jax_compat import enable_x64, tpu_compiler_params
+from .paged_attention import NEG_INF, wv_diag
+from .quantized_matmul import dot_tile_f32, scale_emit
+from .rms_norm import rms_rows as _rms_rows
+
+# schedule phase ids (ints baked into the scalar-prefetched schedule)
+PH_Q, PH_K, PH_V, PH_ATTN, PH_O, PH_G, PH_U, PH_D = range(8)
+
+# default streaming tile sizes; k matches quantized_matmul's bk=512 so
+# the f32 accumulation order (and therefore the bits) agree with the
+# unfused engine path
+DEF_BK = 512
+DEF_BN = 512
+
+
+def _ktile(dim, want):
+    """Tile size for a dimension: the dim itself when it fits, else
+    `want` with the caller zero-padding up to a multiple. EXACTLY
+    quantized_matmul's `min(bk, k)`-then-pad scheme — a cheaper
+    power-of-two-divisor fallback (no padding) would change the NUMBER
+    of k-tiles for dims like 7B's ffn 11008 (43x256 vs 22x512) and with
+    it the f32 accumulation association, breaking bit-identity with the
+    op-chain path. Deterministic from (dim, want) so pack-time and
+    call-time agree."""
+    return dim if dim <= want else want
+
+
+def _pad_axis(a, mult, axis):
+    pad = (-a.shape[axis]) % mult
+    if not pad:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(a, widths)
+
+
+def _pack_w(w, bk, bn, cdtype):
+    """One projection weight -> (values [k_pad, n_pad], scales [1, n_pad]).
+    int8 engine snapshots arrive as (int8 [k, n], scales [n]); dense
+    weights keep their dtype with unit scales (the kernel's
+    `(acc * scale)` is then an exact f32 identity). Zero-padding rows
+    add exact 0.0 to the f32 accumulator and zero-scale columns emit
+    exact zeros, so padding never perturbs real outputs."""
+    if isinstance(w, tuple):
+        vals, scales = w
+    else:
+        vals = w.astype(cdtype) if w.dtype != cdtype else w
+        scales = jnp.ones((w.shape[1],), jnp.float32)
+    k, n = vals.shape
+    vals = _pad_axis(vals, _ktile(k, bk), 0)
+    vals = _pad_axis(vals, _ktile(n, bn), 1)
+    scales = _pad_axis(scales.astype(jnp.float32).reshape(1, -1),
+                       _ktile(n, bn), 1)
+    return vals, scales
+
+
+def pack_decode_layer(wset, cdtype=jnp.float32, bk=DEF_BK, bn=DEF_BN):
+    """Repack ONE engine layer snapshot (serving._snapshot_llama entry)
+    into the megakernel's streamed layout: per-projection (values,
+    scales) padded to the streaming tile grid, norm weights as [1, H]
+    rows. Views/cheap reshapes where no padding is needed — the int8
+    pool is NOT duplicated for the common aligned geometries."""
+    out = {}
+    for name, key in (("q", "wq"), ("k", "wk"), ("v", "wv"), ("o", "wo"),
+                      ("g", "wg"), ("u", "wu"), ("d", "wd")):
+        vals, scales = _pack_w(wset[key], bk, bn, cdtype)
+        out["w" + name] = vals
+        out["s" + name] = scales
+    hp = out["wq"].shape[0]
+    out["ln1"] = _pad_axis(wset["ln1"].reshape(1, -1), hp, 1)
+    out["ln2"] = _pad_axis(wset["ln2"].reshape(1, -1), hp, 1)
+    return out
+
+
+def stack_packed(layers):
+    """[{per-layer packed}] -> one stacked dict ([L, ...] leaves) for the
+    multi-layer megakernel. This COPIES the weights once at engine build
+    (the price of streaming across layer boundaries from one invocation);
+    the per-layer mode reuses the engine's arrays in place."""
+    return {k: jnp.stack([lay[k] for lay in layers])
+            for k in layers[0]}
+
+
+def megakernel_supported(nh, nh_kv, hd, hidden, ffn):
+    """Geometry gate for the AUTO engine knob on real TPUs: the flat
+    [b, heads*hd] activation layout is resliced per head / per segment,
+    which Mosaic only lowers cleanly at lane-multiple boundaries.
+    Interpret mode (CPU parity/fallback) has no such constraint."""
+    return (hd % 128 == 0 and hidden % 128 == 0 and ffn % 128 == 0
+            and (nh_kv * hd) % 128 == 0)
+
+
+def _rope_flat(x, c, s, n_heads, hd):
+    """Rope over the FLAT [b, n_heads*hd] layout: per-head unrolled
+    half-pair rotation (heads are small and static at decode — the same
+    unroll the paged-attention kernels use). c/s: [b, hd//2], already in
+    x.dtype (matching _layer_qkv's cast-then-multiply order)."""
+    hd2 = hd // 2
+    outs = []
+    for g in range(n_heads):
+        x1 = x[:, g * hd:g * hd + hd2]
+        x2 = x[:, g * hd + hd2:(g + 1) * hd]
+        outs.append(x1 * c - x2 * s)
+        outs.append(x2 * c + x1 * s)
+    return jnp.concatenate(outs, axis=1)
+
+
+def _build_schedule(L, b, mp, counts):
+    """Static tile walk -> four int32 arrays (phase, a0, a1, layer).
+    Matmul phases: a0 = k-tile (inner), a1 = n-tile (outer) — k inner
+    matches quantized_matmul's grid so each output tile's f32
+    accumulation order is identical. ATTN: a0 = slot, a1 = page."""
+    ph, a0, a1, li = [], [], [], []
+    for lyr in range(L):
+        for P in (PH_Q, PH_K, PH_V):
+            nk, nn = counts[P]
+            for n in range(nn):
+                for k in range(nk):
+                    ph.append(P); a0.append(k); a1.append(n); li.append(lyr)
+        for slot in range(b):
+            for page in range(mp):
+                ph.append(PH_ATTN); a0.append(slot); a1.append(page)
+                li.append(lyr)
+        for P in (PH_O, PH_G, PH_U, PH_D):
+            nk, nn = counts[P]
+            for n in range(nn):
+                for k in range(nk):
+                    ph.append(P); a0.append(k); a1.append(n); li.append(lyr)
+    return (np.asarray(ph, np.int32), np.asarray(a0, np.int32),
+            np.asarray(a1, np.int32), np.asarray(li, np.int32))
+
+
+def _mk_kernel(ph_ref, a0_ref, a1_ref, li_ref, tbl_ref, len_ref, act_ref,
+               h_ref, cos_ref, sin_ref, ln1_ref, ln2_ref,
+               wq_ref, sq_ref, wk_ref, sk_ref, wv_ref, sv_ref,
+               wo_ref, so_ref, wg_ref, sg_ref, wu_ref, su_ref,
+               wd_ref, sd_ref, kp_ref, vp_ref,
+               ho_ref, kn_ref, vn_ref,
+               h_scr, x_scr, q_scr, k_scr, v_scr, attn_scr, g_scr, u_scr,
+               act_scr, acc_scr, m_scr, l_scr, aacc_scr, *,
+               stacked, counts, bkh, bkf, bns, dims, eps, p, mp, scale):
+    s = pl.program_id(0)
+    ph = ph_ref[s]
+    a0 = a0_ref[s]
+    a1 = a1_ref[s]
+    lyr = li_ref[s]
+    (b, H, Hp, NQ, NQp, NK, nh, nh_kv, hd) = dims
+    rep = nh // nh_kv
+    cdtype = h_scr.dtype
+
+    def wblk(ref):
+        return ref[0] if stacked else ref[...]
+
+    def srow(ref):
+        return ref[0, 0] if stacked else ref[0]
+
+    def lnrow(ref):
+        # a (1, Hp) row either way; broadcasts against [b, Hp]
+        return ref[0] if stacked else ref[...]
+
+    # -- layer entry: load h (layer 0) and pre-norm into x_scr ------------
+    @pl.when(jnp.logical_and(ph == PH_Q,
+                             jnp.logical_and(a0 == 0, a1 == 0)))
+    def _enter_layer():
+        @pl.when(lyr == 0)
+        def _():
+            h_scr[...] = h_ref[...]
+        x_scr[...] = _rms_rows(h_scr[...], lnrow(ln1_ref), eps, H)
+
+    # -- shared matmul step: acc += x_tile @ w_tile; emit at last k ------
+    def mm_phase(P, x_src, bk, w_ref, s_ref, emit):
+        nk, nn = counts[P]
+        bn = bns[P]
+
+        @pl.when(ph == P)
+        def _():
+            @pl.when(a0 == 0)
+            def _():
+                acc_scr[...] = jnp.zeros_like(acc_scr)
+            acc_scr[:, :bn] += dot_tile_f32(x_src[:, pl.ds(a0 * bk, bk)],
+                                            wblk(w_ref))
+
+            @pl.when(a0 == nk - 1)
+            def _():
+                emit(scale_emit(acc_scr[:, :bn], srow(s_ref), cdtype),
+                     nn, bn)
+
+    def seg_write(tgt):
+        def emit(out, nn, bn):
+            tgt[:, pl.ds(a1 * bn, bn)] = out
+        return emit
+
+    def seg_add(tgt):
+        def emit(out, nn, bn):
+            sl = pl.ds(a1 * bn, bn)
+            tgt[:, sl] = tgt[:, sl] + out
+        return emit
+
+    mm_phase(PH_Q, x_scr, bkh, wq_ref, sq_ref, seg_write(q_scr))
+    mm_phase(PH_K, x_scr, bkh, wk_ref, sk_ref, seg_write(k_scr))
+    mm_phase(PH_V, x_scr, bkh, wv_ref, sv_ref, seg_write(v_scr))
+    mm_phase(PH_O, attn_scr, bkh, wo_ref, so_ref, seg_add(h_scr))
+    mm_phase(PH_G, x_scr, bkh, wg_ref, sg_ref, seg_write(g_scr))
+    mm_phase(PH_U, x_scr, bkh, wu_ref, su_ref, seg_write(u_scr))
+    mm_phase(PH_D, act_scr, bkf, wd_ref, sd_ref, seg_add(h_scr))
+
+    def _phase_end(P):
+        nk, nn = counts[P]
+        return jnp.logical_and(ph == P,
+                               jnp.logical_and(a0 == nk - 1, a1 == nn - 1))
+
+    # -- phase epilogues --------------------------------------------------
+    @pl.when(_phase_end(PH_Q))
+    def _rope_q():
+        c = cos_ref[...]
+        sn = sin_ref[...]
+        q_scr[:, :NQ] = _rope_flat(q_scr[:, :NQ], c, sn, nh, hd)
+
+    @pl.when(_phase_end(PH_K))
+    def _rope_k():
+        c = cos_ref[...]
+        sn = sin_ref[...]
+        k_scr[:, :NK] = _rope_flat(k_scr[:, :NK], c, sn, nh_kv, hd)
+        if stacked:
+            kn_ref[0] = k_scr[...]
+        else:
+            kn_ref[...] = k_scr[...]
+
+    @pl.when(_phase_end(PH_V))
+    def _emit_v():
+        if stacked:
+            vn_ref[0] = v_scr[...]
+        else:
+            vn_ref[...] = v_scr[...]
+
+    @pl.when(_phase_end(PH_O))
+    def _norm2():
+        x_scr[...] = _rms_rows(h_scr[...], lnrow(ln2_ref), eps, H)
+
+    @pl.when(_phase_end(PH_U))
+    def _swiglu():
+        g = g_scr[...]
+        act_scr[...] = jax.nn.silu(
+            g.astype(jnp.float32)).astype(cdtype) * u_scr[...]
+
+    @pl.when(_phase_end(PH_D))
+    def _emit_h():
+        ho_ref[...] = h_scr[...]
+
+    # -- paged attention phase (a0 = slot, a1 = page) ---------------------
+    # Identical math to paged_attention._decode_kernel over the slot's
+    # pages, with the current token's k/v substituted into its page
+    # block (the unfused engine scatters them into the page BEFORE
+    # attending; the block contents — and so the online-softmax
+    # trajectory — are the same).
+    @pl.when(ph == PH_ATTN)
+    def _attn():
+        slot = a0
+        page = a1
+
+        @pl.when(page == 0)
+        def _():
+            m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+            l_scr[...] = jnp.zeros_like(l_scr)
+            aacc_scr[...] = jnp.zeros_like(aacc_scr)
+
+        alive = act_ref[slot] > 0
+        # NOTE: every jnp.where operand in this kernel must be an
+        # explicitly-typed i32 — interpret mode re-discharges the kernel
+        # jaxpr at OUTER-jit lowering time, outside the enable_x64(False)
+        # window, and a weak python-int literal re-canonicalizes to i64
+        # there, producing an inconsistent select_n (MLIR verify error).
+        seq_len = jnp.where(alive, len_ref[slot] + jnp.int32(1),
+                            jnp.int32(0))
+        page_start = page * p
+        run = jnp.logical_and(alive, page_start < seq_len)
+
+        @pl.when(run)
+        def _compute():
+            q = q_scr[pl.ds(slot, 1), :][:, :NQ].reshape(nh, hd).astype(
+                jnp.float32) * jnp.float32(scale)
+            k = (kp_ref[0, 0] if stacked else kp_ref[0]).astype(jnp.float32)
+            v = (vp_ref[0, 0] if stacked else vp_ref[0]).astype(jnp.float32)
+            cur = len_ref[slot]
+            on_page = (cur // jnp.int32(p)) == page
+            rows = jax.lax.broadcasted_iota(jnp.int32, (p, 1, 1), 0)
+            sub = jnp.logical_and(
+                on_page, rows == jax.lax.rem(cur, jnp.int32(p)))
+            kc = k_scr[pl.ds(slot, 1), :][:, :NK].reshape(
+                nh_kv, hd).astype(jnp.float32)
+            vc = v_scr[pl.ds(slot, 1), :][:, :NK].reshape(
+                nh_kv, hd).astype(jnp.float32)
+            k = jnp.where(sub, kc[None], k)
+            v = jnp.where(sub, vc[None], v)
+            logits = jnp.concatenate([
+                jax.lax.dot_general(
+                    q[g * rep:(g + 1) * rep], k[:, g, :],
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                for g in range(nh_kv)], axis=0)                # [nh, p]
+            pos = jax.lax.broadcasted_iota(
+                jnp.int32, logits.shape, 1) + page_start
+            logits = jnp.where(pos < seq_len, logits,
+                               jnp.float32(NEG_INF))
+            m_prev = m_scr[:, :1]
+            l_prev = l_scr[:, :1]
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(logits, axis=-1, keepdims=True))
+            w = jnp.exp(logits - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_scr[...] = jnp.broadcast_to(
+                alpha * l_prev + jnp.sum(w, axis=-1, keepdims=True),
+                l_scr.shape)
+            aacc_scr[...] = alpha * aacc_scr[...] + wv_diag(w, v, hd,
+                                                            rep=rep)
+            m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+        @pl.when(page == mp - 1)
+        def _emit():
+            l_fin = jnp.maximum(l_scr[:, :1], jnp.float32(1e-30))
+            res = (aacc_scr[...] / l_fin).astype(cdtype)       # [nh, hd]
+            row = res.reshape(1, NQ)
+            if NQp != NQ:              # scratch pads must be exact zeros
+                row = jnp.pad(row, ((0, 0), (0, NQp - NQ)))
+            attn_scr[pl.ds(slot, 1), :] = row
+
+
+def decode_megakernel(h, mk, k_pages, v_pages, page_table, lens, active,
+                      cos_sel, sin_sel, *, nh, nh_kv, hd, eps,
+                      scale=None, interpret=False):
+    """Run transformer decode layer(s) as ONE Pallas megakernel.
+
+    h          : [b, H] hidden state (one decode token per slot)
+    mk         : packed weights — pack_decode_layer() dict (one layer)
+                 or stack_packed() dict ([L, ...] leaves; multi-layer)
+    k/v_pages  : [n_pages, p, h_kv, hd] for one layer, or [L, n_pages,
+                 p, h_kv, hd] stacked for the multi-layer variant
+    page_table : [b, max_pages] int32
+    lens       : [b] int32 — tokens already cached (the current token's
+                 position); the kernel attends lens+1 positions with the
+                 current token's k/v substituted in-block
+    active     : [b] — retired slots skip attention compute AND page DMA
+                 (their page fetches pin to block 0) and emit zeros
+    cos_sel/sin_sel: [b, hd//2] rope rows AT each slot's position,
+                 already cast to h.dtype
+
+    Returns (h_out [b, H], k_new [(L,) b, h_kv*hd], v_new [...]): the
+    post-layer hidden state and the rope'd current-token k/v per layer,
+    which the CALLER scatters into the page pool — preserving the
+    engine's existing scatter (and its byte-exact page contents).
+    """
+    b, H = h.shape
+    stacked = k_pages.ndim == 5
+    L = mk["wq"].shape[0] if stacked else 1
+    pshape = k_pages.shape[1:] if stacked else k_pages.shape
+    n_pages, p, h_kv, dd = pshape
+    assert dd == hd and h_kv == nh_kv, (k_pages.shape, nh_kv, hd)
+    mp = page_table.shape[1]
+    NQ, NK = nh * hd, nh_kv * hd
+    assert NQ == H, (nh, hd, H)
+    s = scale if scale is not None else 1.0 / math.sqrt(hd)
+    cdtype = h.dtype
+
+    def shp(key):
+        sh = mk[key].shape
+        return sh[1:] if stacked else sh
+
+    Hp = shp("wq")[0]
+    Fp = shp("wd")[0]
+    NQp = shp("wq")[1]
+    NKp = shp("wk")[1]
+    Hop = shp("wo")[1]
+    Fg = shp("wg")[1]
+    # the pack rules derive every pad from (dim, 512) alone, so the
+    # q-output, o-input and o-output pads of the SAME hidden size agree
+    assert NQp == Hp == Hop == shp("wd")[1], (NQp, Hp, Hop, shp("wd")[1])
+    assert Fg == Fp == shp("wu")[1], (Fg, Fp, shp("wu")[1])
+    bkh = _ktile(Hp, DEF_BK)
+    bkf = _ktile(Fp, DEF_BK)
+    bns = {PH_Q: _ktile(NQp, DEF_BN), PH_K: _ktile(NKp, DEF_BN),
+           PH_V: _ktile(NKp, DEF_BN), PH_O: _ktile(Hop, DEF_BN),
+           PH_G: _ktile(Fg, DEF_BN), PH_U: _ktile(Fg, DEF_BN),
+           PH_D: _ktile(Hop, DEF_BN)}
+    counts = {P: (Fp // bkf if P == PH_D else Hp // bkh, n // bns[P])
+              for P, n in ((PH_Q, NQp), (PH_K, NKp), (PH_V, NKp),
+                           (PH_O, Hop), (PH_G, Fg), (PH_U, Fg),
+                           (PH_D, Hop))}
+    bn_max = max(bns.values())
+
+    ph_arr, a0_arr, a1_arr, li_arr = _build_schedule(L, b, mp, counts)
+    n_steps = ph_arr.size
+
+    hpad = _pad_axis(h, Hp, 1)
+    table = jnp.clip(page_table.astype(jnp.int32), 0, n_pages - 1)
+    lens_i = lens.astype(jnp.int32)
+    act_i = (jnp.ones((b,), jnp.int32) if active is None
+             else active.astype(jnp.int32))
+
+    # index maps are traced at jit-lowering time, OUTSIDE the
+    # enable_x64(False) window below — under the package's global x64
+    # every literal must be pinned to i32 or the block indices promote
+    # to i64 and Mosaic/interpret lowering rejects them
+    i32 = jnp.int32
+
+    def full(shape):
+        return pl.BlockSpec(shape, lambda st, *_: (0,) * len(shape))
+
+    def w_spec(P, key):
+        nk, nn = counts[P]
+        bk = bkf if P == PH_D else bkh
+        bn = bns[P]
+
+        def idx(st, ph, a0, a1, li, tbl, ln, ac):
+            mine = ph[st] == P
+            before = ph[st] < P
+            k = jnp.where(mine, a0[st],
+                          jnp.where(before, i32(0), i32(nk - 1)))
+            n = jnp.where(mine, a1[st],
+                          jnp.where(before, i32(0), i32(nn - 1)))
+            return (li[st], k, n) if stacked else (k, n)
+
+        return pl.BlockSpec(((1, bk, bn) if stacked else (bk, bn)), idx)
+
+    def s_spec(P):
+        nn = counts[P][1]
+        bn = bns[P]
+
+        def idx(st, ph, a0, a1, li, tbl, ln, ac):
+            mine = ph[st] == P
+            before = ph[st] < P
+            n = jnp.where(mine, a1[st],
+                          jnp.where(before, i32(0), i32(nn - 1)))
+            return (li[st], 0, n) if stacked else (0, n)
+
+        return pl.BlockSpec(((1, 1, bn) if stacked else (1, bn)), idx)
+
+    def ln_spec():
+        def idx(st, ph, a0, a1, li, tbl, ln, ac):
+            return (li[st], 0, 0) if stacked else (0, 0)
+
+        return pl.BlockSpec(((1, 1, Hp) if stacked else (1, Hp)), idx)
+
+    def page_spec():
+        def idx(st, ph, a0, a1, li, tbl, ln, ac):
+            mine = ph[st] == PH_ATTN
+            before = ph[st] < PH_ATTN
+            slot = jnp.where(mine, a0[st],
+                             jnp.where(before, i32(0), i32(b - 1)))
+            page = jnp.where(mine, a1[st],
+                             jnp.where(before, i32(0), i32(mp - 1)))
+            pg = tbl[slot, page] * ac[slot]
+            return ((li[st], pg, 0, 0, 0) if stacked
+                    else (pg, 0, 0, 0))
+
+        return pl.BlockSpec(((1, 1, p, h_kv, hd) if stacked
+                             else (1, p, h_kv, hd)), idx)
+
+    def out_kv_spec():
+        if stacked:
+            return pl.BlockSpec((1, b, NKp),
+                                lambda st, ph, a0, a1, li, *_:
+                                (li[st], 0, 0))
+        return pl.BlockSpec((b, NKp), lambda st, *_: (0, 0))
+
+    kernel = functools.partial(
+        _mk_kernel, stacked=stacked, counts=counts, bkh=bkh, bkf=bkf,
+        bns=bns, dims=(b, H, Hp, NQ, NQp, NK, nh, nh_kv, hd),
+        eps=float(eps), p=p, mp=mp, scale=float(s))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=7,
+        grid=(n_steps,),
+        in_specs=[
+            full((b, Hp)),                       # h
+            full((b, hd // 2)),                  # cos
+            full((b, hd // 2)),                  # sin
+            ln_spec(), ln_spec(),                # ln1, ln2
+            w_spec(PH_Q, "wq"), s_spec(PH_Q),
+            w_spec(PH_K, "wk"), s_spec(PH_K),
+            w_spec(PH_V, "wv"), s_spec(PH_V),
+            w_spec(PH_O, "wo"), s_spec(PH_O),
+            w_spec(PH_G, "wg"), s_spec(PH_G),
+            w_spec(PH_U, "wu"), s_spec(PH_U),
+            w_spec(PH_D, "wd"), s_spec(PH_D),
+            page_spec(), page_spec(),            # k_pages, v_pages
+        ],
+        out_specs=[
+            pl.BlockSpec((b, Hp), lambda st, *_: (0, 0)),
+            out_kv_spec(), out_kv_spec(),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, Hp), cdtype),         # h_scr
+            pltpu.VMEM((b, Hp), cdtype),         # x_scr
+            pltpu.VMEM((b, NQp), cdtype),        # q_scr
+            pltpu.VMEM((b, NKp), cdtype),        # k_scr
+            pltpu.VMEM((b, NKp), cdtype),        # v_scr
+            pltpu.VMEM((b, NQp), cdtype),        # attn_scr
+            pltpu.VMEM((b, Fg), cdtype),         # g_scr
+            pltpu.VMEM((b, Fg), cdtype),         # u_scr
+            pltpu.VMEM((b, Fp), cdtype),         # act_scr
+            pltpu.VMEM((b, bn_max), jnp.float32),   # acc_scr
+            pltpu.VMEM((nh, 128), jnp.float32),  # m_scr
+            pltpu.VMEM((nh, 128), jnp.float32),  # l_scr
+            pltpu.VMEM((nh, hd), jnp.float32),   # aacc_scr
+        ],
+    )
+    kv_out_shape = ((L, b, NKp) if stacked else (b, NKp))
+    with enable_x64(False):
+        ho, kn, vn = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((b, Hp), cdtype),
+                jax.ShapeDtypeStruct(kv_out_shape, cdtype),
+                jax.ShapeDtypeStruct(kv_out_shape, cdtype),
+            ],
+            compiler_params=tpu_compiler_params(
+                dimension_semantics=("arbitrary",)),
+            interpret=interpret,
+        )(jnp.asarray(ph_arr), jnp.asarray(a0_arr), jnp.asarray(a1_arr),
+          jnp.asarray(li_arr), table, lens_i, act_i,
+          hpad, cos_sel, sin_sel, mk["ln1"], mk["ln2"],
+          mk["wq"], mk["sq"], mk["wk"], mk["sk"], mk["wv"], mk["sv"],
+          mk["wo"], mk["so"], mk["wg"], mk["sg"], mk["wu"], mk["su"],
+          mk["wd"], mk["sd"], k_pages, v_pages)
+    kn = kn[..., :NK]
+    vn = vn[..., :NK]
+    return ho[:, :H], kn, vn
+
+
+def megakernel_weight_bytes(mk, n_layers=None):
+    """Weight bytes one decode step streams through this kernel (the
+    roofline numerator decode_bench reports): every projection's values
+    + scales + both norms, per layer."""
+    keys = ("wq", "sq", "wk", "sk", "wv", "sv", "wo", "so",
+            "wg", "sg", "wu", "su", "wd", "sd", "ln1", "ln2")
+    total = sum(int(np.prod(mk[k].shape)) * mk[k].dtype.itemsize
+                for k in keys)
+    if n_layers is not None:       # per-layer dict counted L times
+        total *= n_layers
+    return total

@@ -10,6 +10,18 @@ fused_multi_transformer variant, fused_multi_transformer_int8_op.cu).
 
 The k-loop is the innermost grid dimension with an f32 VMEM accumulator;
 the per-channel scale is applied once at emission.
+
+The two tile bodies — `dot_tile_f32` (one k-tile MXU step) and
+`scale_emit` (per-channel dequant at emission) — are module-level so the
+decode megakernel (ops/pallas/decode_megakernel) runs the SAME ops in
+the same order: its streamed per-layer matmuls are bit-identical to this
+standalone kernel because they share these definitions, not because two
+copies happen to agree.
+
+jax-compat audit (PR 6): every version-sensitive API here routes through
+paddle_tpu.jax_compat (enable_x64, tpu_compiler_params); the remaining
+pallas surface (pl.BlockSpec(block_shape, index_map), pl.when, pl.cdiv,
+pltpu.VMEM scratch) is present and identical on the baked jax 0.4.37.
 """
 import functools
 
@@ -21,6 +33,23 @@ from jax.experimental.pallas import tpu as pltpu
 from ...jax_compat import enable_x64, tpu_compiler_params
 
 
+def dot_tile_f32(x_tile, w_tile):
+    """One k-tile partial product in f32: x [m, bk] @ w [bk, bn].
+    int8 (or any sub-f32) tiles dequantize by the .astype alone — the
+    per-channel scale is applied once, at emission (scale_emit)."""
+    return jax.lax.dot_general(
+        x_tile.astype(jnp.float32), w_tile.astype(jnp.float32),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def scale_emit(acc, scale_row, out_dtype):
+    """Apply the per-output-channel scale to a finished f32 accumulator
+    tile and cast to the output dtype. scale_row: [bn] (unit scales make
+    this an exact f32 identity for dense weights)."""
+    return (acc * scale_row[None, :].astype(jnp.float32)).astype(out_dtype)
+
+
 def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_scr, *, nk):
     ki = pl.program_id(2)
 
@@ -28,17 +57,11 @@ def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_scr, *, nk):
     def _init():
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    x = x_ref[...].astype(jnp.float32)
-    w = w_ref[...].astype(jnp.float32)  # int8 -> f32 dequant (unit scale)
-    acc_scr[...] += jax.lax.dot_general(
-        x, w, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    acc_scr[...] += dot_tile_f32(x_ref[...], w_ref[...])
 
     @pl.when(ki == nk - 1)
     def _emit():
-        o_ref[...] = (acc_scr[...] *
-                      s_ref[0][None, :].astype(jnp.float32)
-                      ).astype(o_ref.dtype)
+        o_ref[...] = scale_emit(acc_scr[...], s_ref[0], o_ref.dtype)
 
 
 def quantized_matmul(x, w_int8, scales, out_dtype=None, bm=256, bn=256,

@@ -3,6 +3,18 @@
 The LLaMA-family norm; row-tiled VMEM kernel replacing an
 XLA op chain (ref analog: phi/kernels/fusion rms_norm / the fused LN
 epilogues in fused_multi_transformer_op.cu.h).
+
+Two cast orders live here on purpose:
+  - the fused fwd kernel multiplies by the norm weight IN f32 before the
+    output cast (training-path rounding);
+  - `rms_rows` casts x*rsqrt back to x.dtype BEFORE the weight multiply
+    — inference/serving._rms's order, which the decode megakernel must
+    reproduce bit-for-bit. Identical for f32; different roundings for
+    bf16, so they are NOT interchangeable.
+
+jax-compat audit (PR 6): version-sensitive APIs route through
+paddle_tpu.jax_compat (enable_x64, tpu_compiler_params); the remaining
+pallas surface used here is identical on the baked jax 0.4.37.
 """
 import functools
 
@@ -12,6 +24,22 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ...jax_compat import enable_x64, tpu_compiler_params
+
+
+def rms_rows(x, w_row, eps, d_real=None):
+    """RMS-norm over [rows, d] in serving cast order — the tile body the
+    decode megakernel runs in VMEM (and the reference math of
+    inference/serving._rms). d_real: the unpadded feature count when x
+    carries exact-zero pad columns — zeros leave the sum unchanged but
+    the mean's denominator must stay the real width."""
+    d = x.shape[-1] if d_real is None else d_real
+    x32 = x.astype(jnp.float32)
+    if x.shape[-1] == d:
+        var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    else:
+        var = jnp.sum(x32 * x32, axis=-1, keepdims=True) / d
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) \
+        * w_row.astype(x.dtype)
 
 
 def _rms_fwd_kernel(x_ref, w_ref, o_ref, *, eps):

@@ -132,6 +132,15 @@ def generate_op_reference():
              f"{len(table)} public ops across {len(by_mod)} modules. "
              "Backends: `xla` = default XLA lowering; `pallas` = "
              "hand-written TPU kernel override.",
+             "",
+             "Beyond per-op overrides, the serving engine fuses the "
+             "entire decode layer into one Pallas invocation — int8 "
+             "matmuls + RMS-norm + rope + paged attention with "
+             "double-buffered weight streaming "
+             "(`ops/pallas/decode_megakernel.py`); see docs/serving.md "
+             '["Megakernel decode"]'
+             "(serving.md#megakernel-decode-megakernel) for the engine "
+             "knob and VMEM budget rules.",
              ""]
     for mod in sorted(by_mod):
         lines.append(f"## {mod}")

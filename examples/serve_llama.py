@@ -56,6 +56,22 @@ def main():
                          "+ K decode steps (on-device sampling/EOS); "
                          "the host intervenes every K tokens "
                          "(scheduler mode; see docs/serving.md)")
+    ap.add_argument("--speculate", type=int, default=0,
+                    help="T >= 2: speculative decoding — a drafter "
+                         "proposes T-1 tokens per verify pass, the "
+                         "target scores all of them in ONE multi-token-q"
+                         " ragged-paged-attention pass, and accept/"
+                         "reject runs inside the on-device scan carries;"
+                         " greedy outputs stay byte-identical to "
+                         "non-speculative serving (scheduler mode, "
+                         "docs/serving.md \"Speculative decoding\")")
+    ap.add_argument("--drafter", choices=["ngram", "prefix"],
+                    default="ngram",
+                    help="zero-extra-model drafter: 'ngram' = prompt-"
+                         "lookup over the request's own context; "
+                         "'prefix' = continuations walked from the "
+                         "content-addressed prefix cache (other "
+                         "requests' traffic)")
     ap.add_argument("--megakernel", choices=["auto", "off", "layer",
                                              "multi"], default="auto",
                     help="decode-layer Pallas megakernel: one fused "
@@ -106,8 +122,16 @@ def main():
             queue_limit=args.queue_limit,
             default_deadline_ms=args.deadline_ms,
             decode_block=args.decode_block,
-            megakernel={"auto": None, "off": False}.get(args.megakernel,
-                                                        args.megakernel))
+            speculate=args.speculate or None,
+            drafter=args.drafter,
+            # speculation downgrades only the "auto" default; an
+            # EXPLICIT --megakernel layer/multi with --speculate lets
+            # the engine raise its typed conflict error rather than
+            # silently benchmarking the op-chain path
+            megakernel=(False if (args.speculate >= 2
+                                  and args.megakernel == "auto") else
+                        {"auto": None, "off": False}.get(args.megakernel,
+                                                         args.megakernel)))
         rng = np.random.RandomState(0)
         # ragged prompts; 1 shares 0's prefix (once 0 finishes prefill,
         # the cache turns the shared pages into refcounted read-only
@@ -134,6 +158,13 @@ def main():
                  f"({engine.chained_blocks} pipelined), "
                  if args.decode_block > 1 else "")
         fused += f"megakernel={engine.health()['megakernel']}, "
+        if args.speculate >= 2:
+            h = engine.health()
+            fused += (f"speculate={h['speculate']}/{h['drafter']}: "
+                      f"{h['spec_emitted']} tokens in "
+                      f"{h['spec_passes']} verify passes "
+                      f"({h['spec_tokens_per_pass']:.2f}/pass, "
+                      f"accept {h['spec_accept_rate']:.2f}), ")
         print(f"model={args.model} quant={args.quant} scheduler: "
               f"{len(submitted)} ragged requests in "
               f"{engine.steps} steps ({engine.prefill_steps} prefill / "

@@ -358,6 +358,11 @@ _SERVING_EXPORTS = {
     "RequestNotFinishedError": "scheduler",
     "RequestFailedError": "scheduler", "RequestCancelledError": "scheduler",
     "DeadlineExceededError": "scheduler", "RequestFailure": "scheduler",
+    # speculative-decoding drafters (docs/serving.md "Speculative
+    # decoding"): zero-extra-model n-gram/prefix-cache drafters + the
+    # small-model drafter, and the Drafter base for custom ones
+    "Drafter": "speculative", "NGramDrafter": "speculative",
+    "PrefixCacheDrafter": "speculative", "ModelDrafter": "speculative",
 }
 
 

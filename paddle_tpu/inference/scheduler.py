@@ -46,11 +46,32 @@ import jax.numpy as jnp
 from ..failsafe import InjectedFault, fault_point
 from ..failsafe import armed as _faults_armed
 from ..ops.pallas.paged_attention import (expand_kv_heads, paged_attention,
-                                          ragged_paged_attention)
+                                          ragged_paged_attention,
+                                          spec_verify_attention)
 from .serving import LLMEngine, EngineFullError, _rms, _mm
+from .speculative import resolve_drafter
 
 QUEUED, PREFILL, DECODE, DONE, FAILED, CANCELLED = \
     "queued", "prefill", "decode", "done", "failed", "cancelled"
+
+
+def _pools_put(pools, li, arr, acc):
+    """Collect one layer's updated page array inside a traced fn that
+    must handle BOTH pool forms: the per-layer list (default) appends to
+    `acc` (the caller returns it via _pools_result), the NATIVE stacked
+    [L, ...] array (megakernel="multi") takes a dynamic-update-slice in
+    place — no per-step restack. Returns the (possibly new) pools."""
+    if isinstance(pools, (list, tuple)):
+        acc.append(arr)
+        return pools
+    return pools.at[li].set(arr)
+
+
+def _pools_result(pools, acc):
+    """The value a traced fn returns for its updated pools: the
+    collected per-layer list, or the stacked array itself (already
+    updated in place by _pools_put)."""
+    return acc if isinstance(pools, (list, tuple)) else pools
 
 
 class SchedulerError(RuntimeError):
@@ -123,10 +144,12 @@ class Request:
                  "state", "slot", "pages", "shared_idx", "cow_reserve",
                  "filled", "resume", "tok", "out", "result",
                  "pages_shared", "deadline", "ttl_steps", "born_step",
-                 "error")
+                 "error", "tenant", "priority", "draft_k",
+                 "spec_drafted", "spec_accepted")
 
     def __init__(self, uid, ids, max_new_tokens, eos_token_id,
-                 deadline=None, ttl_steps=None, born_step=0):
+                 deadline=None, ttl_steps=None, born_step=0,
+                 tenant="default", priority=0, draft_k=0):
         self.uid = uid
         self.ids = ids                  # np.int64 [t0]
         self.t0 = int(ids.size)
@@ -148,6 +171,13 @@ class Request:
         self.ttl_steps = ttl_steps      # engine-step budget (deterministic)
         self.born_step = born_step      # engine step count at submission
         self.error = None               # RequestFailure when retired bad
+        self.tenant = tenant            # admission-policy tenant name
+        self.priority = int(priority)   # higher admits (and preempts)
+        #                                 first; strict across tenants
+        self.draft_k = int(draft_k)     # current per-request draft
+        #                                 length (adaptive speculation)
+        self.spec_drafted = 0           # drafts offered to verification
+        self.spec_accepted = 0          # drafts the target accepted
 
 
 class PrefixCache:
@@ -232,6 +262,38 @@ class PrefixCache:
     def chain_key(self, parent_key, tokens):
         return (parent_key, tuple(int(t) for t in tokens))
 
+    def continuation(self, ids, k):
+        """Predict up to `k` tokens FOLLOWING `ids` from the cached page
+        chains — the prefix-cache-seeded DRAFTER's walk (speculative.py
+        PrefixCacheDrafter). Every full page of `ids` must be cached
+        (the chain is content-addressed, so a single mismatch means no
+        other request ever served this context); the remaining partial
+        tail then selects a cached child page whose tokens extend it,
+        and full-page children keep the walk descending. Returns an
+        int64 array, possibly empty (cold cache / divergent context)."""
+        p = self.p
+        ids = np.asarray(ids)
+        key = ()
+        for j in range(ids.size // p):
+            key = (key, tuple(int(t) for t in ids[j * p:(j + 1) * p]))
+            if key not in self._entries:
+                return np.empty((0,), np.int64)
+        rem = tuple(int(t) for t in ids[(ids.size // p) * p:])
+        out = []
+        while len(out) < k:
+            nxt = None
+            for tokens in self._children.get(key, {}).values():
+                if len(tokens) > len(rem) and tokens[:len(rem)] == rem:
+                    nxt = tokens
+                    break
+            if nxt is None:
+                break
+            out.extend(nxt[len(rem):])
+            # cached children are always full pages: descend the chain
+            key = (key, nxt)
+            rem = ()
+        return np.asarray(out[:k], np.int64)
+
     def evict(self, n_pages, allocator, protect=()):
         """Free up to `n_pages` cache-only pages (refcount 1), oldest
         first, skipping `protect`. Returns the number freed.
@@ -287,7 +349,7 @@ class _FusedBlock:
     __slots__ = ("w", "K", "pf_items", "dec_items", "tables", "eos_dev",
                  "first", "toks", "emitted", "tok_fin", "lens_fin",
                  "act_fin", "rem_fin", "has_prefill", "has_decode",
-                 "chained")
+                 "chained", "dlens")
 
     def __init__(self, w, K):
         self.w = w
@@ -303,6 +365,8 @@ class _FusedBlock:
         self.has_prefill = False
         self.has_decode = False
         self.chained = False
+        self.dlens = None           # np [K, w] drafts offered per pass
+        #                             per slot (speculative blocks only)
 
 
 class ContinuousBatchingEngine(LLMEngine):
@@ -335,9 +399,29 @@ class ContinuousBatchingEngine(LLMEngine):
         per-layer megakernel (interpret mode on CPU — the parity
         fallback, byte-identical greedy to the op-chain path); "multi"
         scans ALL layers inside one kernel invocation (weights stream
-        across layer boundaries; the KV pool is viewed [L, ...] per
-        decode step — see docs/serving.md "Megakernel decode"); False
-        forces off.
+        across layer boundaries; the KV pools are stored NATIVELY
+        stacked [L, ...], so no per-step restack — see docs/serving.md
+        "Megakernel decode"); False forces off.
+      speculate: T >= 2 turns on SPECULATIVE DECODING — each decode scan
+        step becomes a verify pass over T feed tokens (pending token +
+        up to T-1 drafts) scored in ONE multi-token-q ragged-paged-
+        attention invocation, accept/reject computed inside the scan
+        carries (accepted length advances lens on device; rejected
+        drafts cost nothing — writes are length-gated, no KV scrub).
+        Greedy outputs are byte-identical to the non-speculative engine.
+        See docs/serving.md "Speculative decoding".
+      drafter: "ngram" (default; prompt-lookup), "prefix" (prefix-cache-
+        seeded chains), or a speculative.Drafter instance (e.g.
+        ModelDrafter for a small draft model).
+      spec_adaptive: per-request draft length shrinks (halve on a
+        zero-accept pass) / grows (double on a clean sweep) within
+        [1, T-1] on trailing acceptance.
+      tenants: {name: {"share": s, "priority": p}} admission policy —
+        priority strict-orders admission AND allows decode-slot
+        preemption of strictly-lower-priority running requests (victim
+        work re-queues, never lost); share weights fair-share virtual
+        time (1/share per emitted token) among equal priorities, so
+        speculation's variable yield is charged fairly.
       queue_limit: bounded admission queue — add_request past this depth
         raises EngineBusyError (typed backpressure) instead of growing
         an unbounded backlog. None (default) = unbounded.
@@ -362,10 +446,51 @@ class ContinuousBatchingEngine(LLMEngine):
                  queue_limit=None, default_deadline_ms=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  seed=0, decode_block=1, ragged_kernel=None,
-                 megakernel=None, **kw):
+                 megakernel=None, speculate=None, drafter="ngram",
+                 spec_adaptive=True, tenants=None, **kw):
         super().__init__(model, max_len=max_len, page_size=page_size,
                          max_batch=max_batch, **kw)
         self.prefill_chunk = int(prefill_chunk or page_size)
+        # speculate=T (>= 2): speculative decoding — every decode scan
+        # step becomes a VERIFY PASS over T feed tokens (the pending
+        # token + up to T-1 drafter proposals) scored through ONE
+        # multi-token-q ragged-paged-attention invocation, with greedy/
+        # sampled acceptance computed inside the lax.scan carries:
+        # accepted length advances `lens` on device, rejected drafts
+        # need no KV scrub (writes are length-gated — `lens` simply does
+        # not advance over them). Host intervention stays at block
+        # boundaries: draft before dispatch, replay tokens after.
+        # Greedy outputs are byte-identical to the non-speculative
+        # engine (acceptance under greedy is deterministic); sampled
+        # mode keeps the target distribution (deterministic drafters are
+        # the q=delta case of rejection sampling) but draws a different
+        # key stream. See docs/serving.md "Speculative decoding".
+        if speculate is True:
+            # int(True) == 1 would silently degenerate to plain decode
+            raise ValueError(
+                "speculate takes the VERIFY WIDTH (an int >= 2: the "
+                "pending token + up to width-1 drafts per pass), not "
+                "True")
+        self._spec = 0 if speculate in (None, False) else int(speculate)
+        if self._spec == 1:
+            self._spec = 0              # T=1 degenerates to plain decode
+        if self._spec < 0:
+            raise ValueError(f"speculate must be >= 2, got {speculate}")
+        if self._spec:
+            if self._spec > max_len:
+                raise ValueError(
+                    f"speculate={self._spec} exceeds max_len={max_len}")
+            # the decode megakernel is single-token-q; the verify pass
+            # runs the op-chain + ragged-kernel path instead (a multi-
+            # token megakernel geometry is the named follow-up)
+            if megakernel not in (None, False):
+                raise ValueError(
+                    "speculate= is not supported with megakernel= "
+                    "forced on: the decode megakernel is single-token-q "
+                    "(verify runs the multi-token-q ragged kernel); "
+                    "leave megakernel=None/False")
+            megakernel = False
+        self.spec_adaptive = bool(spec_adaptive)
         # decode_block=K > 1: device-resident multi-step decode — ONE
         # compiled dispatch runs a ragged-prefill phase plus K decode
         # steps (on-device sampling, per-slot EOS/budget flags); the
@@ -392,6 +517,14 @@ class ContinuousBatchingEngine(LLMEngine):
             self.weights["mk"] = (stack_packed(packed)
                                   if self.megakernel == "multi"
                                   else packed)
+        if self.megakernel == "multi":
+            # NATIVE stacked KV pools: "multi" consumes the whole [L,...]
+            # stack every step, so store it stacked — the per-scan-step
+            # jnp.stack restack PR 6 documented (XLA traffic ~ pool size
+            # inside the fused block) is gone; every compiled path
+            # handles both forms (list per layer / one stacked array)
+            self.k_pages = jnp.stack(self.k_pages)
+            self.v_pages = jnp.stack(self.v_pages)
         if slot_buckets is None:
             slot_buckets = []
             w = 1
@@ -404,6 +537,30 @@ class ContinuousBatchingEngine(LLMEngine):
                           float(top_p))
         self._key = jax.random.key(seed)
         self._prefix = PrefixCache(page_size) if prefix_cache else None
+        self._drafter = (resolve_drafter(drafter, self._prefix)
+                         if self._spec else None)
+        # multi-tenant admission policy: tenants={name: {"share": s,
+        # "priority": p}}. Admission orders the queue by (priority desc,
+        # fair-share virtual time asc, arrival); a strictly-higher-
+        # priority candidate that cannot fit PREEMPTS the lowest-
+        # priority running request (its work re-queues, not fails).
+        # Virtual time charges 1/share per emitted token, so
+        # speculation's variable token yield is charged exactly like
+        # plain decode and cannot starve low-share tenants.
+        self._tenant_cfg = {}
+        for name, cfg in (tenants or {}).items():
+            share = float(cfg.get("share", 1.0))
+            if share <= 0:
+                raise ValueError(
+                    f"tenant {name!r} share must be > 0, got {share}")
+            self._tenant_cfg[name] = {
+                "share": share, "priority": int(cfg.get("priority", 0))}
+        self._tenant_vt = {}            # tenant -> tokens / share
+        #   (first sight BASELINES at the minimum recorded vt — a
+        #    late-joining tenant competes from the current service
+        #    floor instead of monopolizing admission while it "catches
+        #    up" from zero against long-running incumbents)
+        self._tenant_tokens = collections.Counter()
 
         self.queue_limit = (None if queue_limit is None
                             else int(queue_limit))
@@ -438,11 +595,20 @@ class ContinuousBatchingEngine(LLMEngine):
         self.fused_blocks = 0
         self.chained_blocks = 0         # blocks dispatched BEFORE the
         #                                 previous block's readback
+        self.preemptions = 0            # decode-slot preemptions (work
+        #                                 re-queued, not failed)
+        self.spec_passes = 0            # verify passes that ran
+        self.spec_emitted = 0           # decode tokens emitted by them
+        self.spec_drafted_total = 0     # drafts offered
+        self.spec_accepted_total = 0    # drafts accepted
+        self.draft_errors = 0           # real (non-injected) drafter
+        #                                 exceptions, degraded to dlen=0
         self._slot_used = [False] * max_batch
 
     # -- public ------------------------------------------------------------
     def add_request(self, ids, max_new_tokens=32, eos_token_id=None,
-                    deadline_ms=None, ttl_steps=None):
+                    deadline_ms=None, ttl_steps=None, tenant=None,
+                    priority=None):
         """Queue one prompt (1-D int sequence). Returns a request uid.
 
         deadline_ms: wall-clock budget from NOW; a request still
@@ -450,6 +616,13 @@ class ContinuousBatchingEngine(LLMEngine):
           record (queued requests are shed without ever running).
         ttl_steps: the same contract counted in ENGINE STEPS instead of
           wall time — deterministic, the form chaos tests use.
+        tenant: admission-policy tenant name (fair-share virtual time is
+          tracked per tenant; unregistered tenants get share 1.0).
+        priority: admission priority (higher first, strict); defaults to
+          the tenant's registered priority, else 0. A queued request of
+          strictly higher priority may PREEMPT a running lower-priority
+          one when the engine is full — the victim re-queues with its
+          generated tokens folded into its prompt, nothing is lost.
         Raises EngineBusyError (typed backpressure, nothing enqueued)
         when the admission queue is at queue_limit.
         """
@@ -475,10 +648,14 @@ class ContinuousBatchingEngine(LLMEngine):
             deadline_ms = self.default_deadline_ms
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
+        tenant = tenant or "default"
+        if priority is None:
+            priority = self._tenant_cfg.get(tenant, {}).get("priority", 0)
         r = Request(self._next_uid, ids, max_new_tokens, eos_token_id,
                     deadline=deadline,
                     ttl_steps=None if ttl_steps is None else int(ttl_steps),
-                    born_step=self.steps)
+                    born_step=self.steps, tenant=tenant, priority=priority,
+                    draft_k=max(1, self._spec - 1) if self._spec else 0)
         self._next_uid += 1
         self._requests[r.uid] = r
         self._queue.append(r)
@@ -524,8 +701,13 @@ class ContinuousBatchingEngine(LLMEngine):
         (its admission, its prefill chunk, its slice of the decode
         batch/block) retires THAT request with a RequestFailure record
         and the step carries on. In fused mode faults are checked at
-        host sync points, i.e. once per block per request."""
-        if self.decode_block > 1:
+        host sync points, i.e. once per block per request.
+
+        speculate=T routes through the fused path at EVERY decode_block
+        (a decode_block=1 spec block is one verify pass): the verify
+        scan, its on-device accept/reject carries, and the host draft
+        boundary all live there."""
+        if self.decode_block > 1 or self._spec:
             return self._fused_step()
         self._expire_deadlines()
         self._admit()
@@ -651,7 +833,55 @@ class ContinuousBatchingEngine(LLMEngine):
             # active decode-kernel mode: "off" = per-op XLA chain,
             # "layer"/"multi" = the Pallas decode megakernel
             "megakernel": self.megakernel if self.megakernel else "off",
+            # speculative decoding: verify width, drafter, and the
+            # accept telemetry the adaptive-K policy runs on
+            "speculate": self._spec,
+            "drafter": (self._drafter.name if self._drafter is not None
+                        else None),
+            "spec_passes": self.spec_passes,
+            "spec_emitted": self.spec_emitted,
+            "spec_accept_rate": (
+                self.spec_accepted_total / self.spec_drafted_total
+                if self.spec_drafted_total else 0.0),
+            "spec_tokens_per_pass": (
+                self.spec_emitted / self.spec_passes
+                if self.spec_passes else 0.0),
+            "draft_errors": self.draft_errors,
+            # multi-tenant admission: preemptions + per-tenant service
+            "preemptions": self.preemptions,
+            "tenants": {
+                t: {"tokens": self._tenant_tokens[t],
+                    "vt": round(self._tenant_vt.get(t, 0.0), 3),
+                    "share": self._tenant_cfg.get(t, {}).get("share", 1.0),
+                    "queued": sum(1 for q in self._queue
+                                  if q.tenant == t),
+                    "running": sum(1 for s in self._slots
+                                   if s is not None and s.tenant == t)}
+                for t in sorted(set(self._tenant_tokens)
+                                | set(self._tenant_cfg)
+                                | {q.tenant for q in self._queue}
+                                | {s.tenant for s in self._slots
+                                   if s is not None})},
         }
+
+    def generate(self, *args, **kw):
+        """Inherited static-batch generate(). With native stacked pools
+        (megakernel="multi") the base engine's prefill/step programs
+        expect per-layer pool lists, so the stack is unpacked around the
+        call (once per generate(), not per step) and restored after —
+        unless a mid-flight failure already rebuilt the pools (the CB
+        _reset_kv restacks them itself)."""
+        if self.megakernel != "multi":
+            return super().generate(*args, **kw)
+        L = self.cfg.num_hidden_layers
+        self.k_pages = [self.k_pages[i] for i in range(L)]
+        self.v_pages = [self.v_pages[i] for i in range(L)]
+        try:
+            return super().generate(*args, **kw)
+        finally:
+            if isinstance(self.k_pages, list):
+                self.k_pages = jnp.stack(self.k_pages)
+                self.v_pages = jnp.stack(self.v_pages)
 
     def generate_many(self, prompts, max_new_tokens=32, eos_token_id=None):
         """Submit a list of (ragged) prompts and drain. Returns a list of
@@ -674,13 +904,105 @@ class ContinuousBatchingEngine(LLMEngine):
         # the last step reads lens+1 = t0+mnt-1 positions
         return -(-max(t0, t0 + max_new_tokens - 1) // self.page_size)
 
+    def _vt(self, tenant):
+        """Fair-share virtual time for a tenant; a tenant first seen
+        NOW starts at the minimum recorded vt (stride-scheduling entry
+        rule) so newcomers compete from the current service floor
+        rather than winning every slot until they out-consume
+        long-running incumbents."""
+        vt = self._tenant_vt.get(tenant)
+        if vt is None:
+            vt = min(self._tenant_vt.values(), default=0.0)
+            self._tenant_vt[tenant] = vt
+        return vt
+
+    def _pick_next(self):
+        """Admission-policy queue head: priority (desc, strict), then
+        fair-share virtual time (asc — the least-served tenant per
+        share), then arrival order. FIFO degenerates back out when no
+        tenants/priorities are configured (all keys tie)."""
+        return min(self._queue,
+                   key=lambda r: (-r.priority, self._vt(r.tenant),
+                                  r.uid))
+
+    def _preemption_victim(self, cand):
+        """A running request the candidate may evict: strictly LOWER
+        priority only (strictness makes preemption cycles impossible —
+        the victim re-queues at its own priority and can never preempt
+        back), and only when evicting lower-priority work could
+        actually seat the candidate (FEASIBILITY: its page need — plus
+        the worst-case CoW reserve — must fit in free pages + the
+        victims' EXCLUSIVELY-held pages; a refcount-shared page —
+        prefix-cache or co-held by another request — does not return
+        to the free list when one holder releases it, so it is not
+        counted, conservatively). Without the check, one oversized
+        high-priority request would cascade through every victim,
+        destroy all in-flight progress, and still fail. Among victims,
+        the most-served tenant's newest request loses the least
+        completed work."""
+        running = [s for s in self._slots if s is not None]
+        lower = [s for s in running if s.priority < cand.priority]
+        if not lower:
+            return None
+        need = self._pages_needed(cand.t0, cand.max_new_tokens) + 1
+        reclaimable = self.allocator.available + sum(
+            sum(1 for p in s.pages if self.allocator.refcount(p) == 1)
+            + (1 if s.cow_reserve is not None else 0)
+            for s in lower)
+        if need > reclaimable:
+            return None
+        return min(lower,
+                   key=lambda r: (r.priority, -self._vt(r.tenant),
+                                  -r.uid))
+
+    def _release_slot(self, r):
+        """Reclaim a running request's slot, pages, and CoW reserve —
+        the ONE slot-release sequence shared by retirement, failure,
+        and preemption (shared pages drop only this request's
+        reference; cache/other holders keep theirs)."""
+        if r.slot is not None:
+            self._slots[r.slot] = None
+            r.slot = None
+        if r.pages:
+            self.allocator.free(r.pages)
+            r.pages = []
+        if r.cow_reserve is not None:
+            self.allocator.free([r.cow_reserve])
+            r.cow_reserve = None
+        r.shared_idx = set()
+
+    def _preempt(self, r):
+        """Decode-slot preemption (the PR 2 retirement machinery minus
+        the failure record): reclaim the victim's slot/pages/CoW
+        reserve, fold its generated tokens into its prompt, and re-queue
+        it — on re-admission it re-prefills the folded context (usually
+        through its own published prefix-cache pages) and continues;
+        greedy continuations are byte-identical to an uninterrupted
+        run. `result()` still returns [original prompt + all generated
+        tokens]."""
+        self._release_slot(r)
+        if r.out:
+            r.ids = np.concatenate([r.ids, np.asarray(r.out, np.int64)])
+            r.t0 = r.ids.size
+            r.max_new_tokens -= len(r.out)
+            r.out = []
+        r.tok = None
+        r.filled = r.resume = 0
+        r.state = QUEUED
+        self._queue.append(r)
+        self.preemptions += 1
+
     def _admit(self):
         while self._queue:
+            r = self._pick_next()
             slot = next((i for i, s in enumerate(self._slots) if s is None),
                         None)
             if slot is None:
-                return
-            r = self._queue[0]
+                victim = self._preemption_victim(r)
+                if victim is None:
+                    return
+                self._preempt(victim)
+                continue               # re-evaluate with the freed slot
             shared, covered = ([], 0) if self._prefix is None else \
                 self._prefix.match(r.ids)
             resume = min(covered, r.t0 - 1)
@@ -704,8 +1026,15 @@ class ContinuousBatchingEngine(LLMEngine):
                     self._prefix.evict(fresh - self.allocator.available,
                                        self.allocator)
             if fresh > self.allocator.available:
-                return                       # wait for retirements (FIFO)
-            self._queue.popleft()
+                # page pressure: a strictly-higher-priority candidate may
+                # preempt a lower-priority running request to free its
+                # pages — one victim per attempt, then re-evaluate
+                victim = self._preemption_victim(r)
+                if victim is not None:
+                    self._preempt(victim)
+                    continue
+                return              # wait for retirements (policy order)
+            self._queue.remove(r)
             # claim pages under a guard: an allocation failure here
             # (injected page.alloc fault, or a real race) releases every
             # page this request already claimed and retires ONLY this
@@ -753,8 +1082,13 @@ class ContinuousBatchingEngine(LLMEngine):
     # -- copy-on-write -----------------------------------------------------
     def _build_copy(self):
         def copy(kps, vps, src, dst):
-            return ([k.at[dst].set(k[src]) for k in kps],
-                    [v.at[dst].set(v[src]) for v in vps])
+            if isinstance(kps, (list, tuple)):
+                return ([k.at[dst].set(k[src]) for k in kps],
+                        [v.at[dst].set(v[src]) for v in vps])
+            # native stacked pools (megakernel="multi"): one page copy
+            # across every layer's [L, ...] slice
+            return (kps.at[:, dst].set(kps[:, src]),
+                    vps.at[:, dst].set(vps[:, src]))
 
         return jax.jit(copy, donate_argnums=(0, 1))
 
@@ -816,8 +1150,8 @@ class ContinuousBatchingEngine(LLMEngine):
                                       mode="drop")
                 kp = kp.reshape(self.n_pages, p, self.nh_kv, self.hd)
                 vp = vp.reshape(self.n_pages, p, self.nh_kv, self.hd)
-                new_k.append(kp)
-                new_v.append(vp)
+                k_pages_all = _pools_put(k_pages_all, li, kp, new_k)
+                v_pages_all = _pools_put(v_pages_all, li, vp, new_v)
                 # gather this sequence's full context back out of the
                 # pool: [mp*p, h_kv, d]; keys past the causal horizon
                 # carry finite garbage and mask to exact zero weight
@@ -838,7 +1172,8 @@ class ContinuousBatchingEngine(LLMEngine):
             last = jnp.clip(t_end - 1 - t_start, 0, chunk - 1)
             h_last = jax.lax.dynamic_index_in_dim(h, last, axis=1)
             logits = _mm(h_last, W["head"], self.interpret)
-            return logits[:, 0], new_k, new_v
+            return (logits[:, 0], _pools_result(k_pages_all, new_k),
+                    _pools_result(v_pages_all, new_v))
 
         return jax.jit(prefill, donate_argnums=(2, 3))
 
@@ -935,8 +1270,8 @@ class ContinuousBatchingEngine(LLMEngine):
         cos_sel = W["cos"][lens].astype(h.dtype)
         sin_sel = W["sin"][lens].astype(h.dtype)
         oob = jnp.int32(self.n_pages * p)
-        slots = (tables[jnp.arange(w), lens // p] * p + lens % p)
-        slots = jnp.where(active, slots, oob)
+        slots_raw = (tables[jnp.arange(w), lens // p] * p + lens % p)
+        slots = jnp.where(active, slots_raw, oob)
         act_i = active.astype(jnp.int32)
         kw = dict(nh=self.nh, nh_kv=self.nh_kv, hd=self.hd,
                   eps=self.cfg.rms_norm_eps, interpret=self.interpret)
@@ -948,23 +1283,36 @@ class ContinuousBatchingEngine(LLMEngine):
                 mode="drop")
             return flat.reshape(self.n_pages, p, self.nh_kv, self.hd)
 
-        new_k, new_v = [], []
         if self.megakernel == "multi":
             # one invocation for the whole stack: the weight stream
-            # pipelines across layer boundaries. The KV pool is viewed
-            # [L, ...] for the call — inside the scanned step the pools
-            # are carries, so XLA materializes the stack each step:
-            # traffic ~ pool size, acceptable only while the pool is
-            # small next to the weight stream (docs/serving.md caveat;
-            # native [L, ...] pool storage is the follow-up that
-            # removes it — the per-layer mode avoids it entirely).
+            # pipelines across layer boundaries. The pools are stored
+            # NATIVELY stacked [L, ...] for this mode, so the kernel
+            # consumes them directly — the per-scan-step jnp.stack
+            # restack PR 6 documented (XLA traffic ~ pool size every
+            # step) is gone — and the returned per-layer k/v land in ONE
+            # flat scatter with per-layer offsets (same elements, same
+            # bytes as the per-layer scatters).
+            L = self.cfg.num_hidden_layers
+            npp = self.n_pages * p
             h, k_all, v_all = decode_megakernel(
-                h, W["mk"], jnp.stack(k_pages_all), jnp.stack(v_pages_all),
+                h, W["mk"], k_pages_all, v_pages_all,
                 tables, lens, act_i, cos_sel, sin_sel, **kw)
-            for li in range(len(k_pages_all)):
-                new_k.append(scatter(k_pages_all[li], k_all[li]))
-                new_v.append(scatter(v_pages_all[li], v_all[li]))
+            base = jnp.arange(L, dtype=jnp.int32)[:, None] * jnp.int32(npp)
+            gidx = jnp.where(active[None, :], base + slots_raw[None, :],
+                             jnp.int32(L * npp))      # global drop index
+            shape = (self.nh_kv, self.hd)
+
+            def scatter_all(pools, new_all):
+                flat = pools.reshape(L * npp, *shape)
+                flat = flat.at[gidx.reshape(-1)].set(
+                    new_all.reshape(L * w, *shape).astype(self.kv_dtype),
+                    mode="drop")
+                return flat.reshape(L, self.n_pages, p, *shape)
+
+            new_k = scatter_all(k_pages_all, k_all)
+            new_v = scatter_all(v_pages_all, v_all)
         else:
+            new_k, new_v = [], []
             for li, mset in enumerate(W["mk"]):
                 h, k_new, v_new = decode_megakernel(
                     h, mset, k_pages_all[li], v_pages_all[li], tables,
@@ -1018,6 +1366,63 @@ class ContinuousBatchingEngine(LLMEngine):
         logits = _mm(h, W["head"], self.interpret)
         return logits[:, 0], new_k, new_v
 
+    def _cb_spec_verify_math(self, W, feed, k_pages_all, v_pages_all,
+                             tables, lens, active, rem, dlen, w):
+        """ONE speculative VERIFY pass at slot width w: slot b feeds T
+        tokens (its pending token + up to T-1 drafts) at global
+        positions lens[b] + [0, T), writing their KV length-gated and
+        scoring every position through the multi-token-q ragged
+        paged-attention kernel (spec_verify_attention). Rows are
+        BIT-IDENTICAL to T sequential `_cb_decode_math` steps on the
+        interpret path — the greedy byte-identity contract — because
+        matmul/norm rows are position-independent and the ragged kernel
+        walks the same per-page online softmax as the decode kernel.
+
+        Write gating IS the rollback story: feed position j writes only
+        when j == 0 (the committed pending token) or j <= dlen[b] (a
+        real draft) and j < min(T, rem[b]) (the budget cap). A rejected
+        draft's KV stays in the pool but `lens` never advances over it,
+        so the next pass (or the next plain step) overwrites it and no
+        attention ever reads it — no scrub, no extra pass.
+
+        feed: [w, T] int; returns (logits [w, T, V], new_k, new_v)."""
+        p = self.page_size
+        T = feed.shape[1]
+        h = jnp.take(W["emb"], feed, axis=0).astype(self.kv_dtype)
+        j = jnp.arange(T, dtype=jnp.int32)[None, :]               # [1, T]
+        pos = lens[:, None] + j                                   # [w, T]
+        # ungated tail positions may point past the request's page
+        # claim; clamp for the table/rope GATHERS only (their rows are
+        # discarded — emission never reaches them)
+        pos_c = jnp.minimum(pos, jnp.int32(self.max_len - 1))
+        cap = jnp.minimum(jnp.int32(T), rem)[:, None]
+        write_ok = jnp.logical_and(
+            active[:, None],
+            jnp.logical_and(j < cap, j <= dlen[:, None]))
+        oob = jnp.int32(self.n_pages * p)
+        new_k, new_v = [], []
+        for li, wset in enumerate(W["layers"]):
+            q, k, v = self._layer_qkv(W, wset, h, pos_c)
+            slots = tables[jnp.arange(w)[:, None], pos_c // p] * p \
+                + pos_c % p
+            slots = jnp.where(write_ok, slots, oob)
+            kp = k_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+            vp = v_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+            kp = kp.at[slots].set(k.astype(self.kv_dtype), mode="drop")
+            vp = vp.at[slots].set(v.astype(self.kv_dtype), mode="drop")
+            kp = kp.reshape(self.n_pages, p, self.nh_kv, self.hd)
+            vp = vp.reshape(self.n_pages, p, self.nh_kv, self.hd)
+            new_k.append(kp)
+            new_v.append(vp)
+            attn = spec_verify_attention(
+                q, kp, vp, tables, lens,
+                active=active.astype(jnp.int32),
+                interpret=self.interpret)
+            h = self._layer_tail(W, wset, h, attn)
+        h = _rms(h, W["norm"], W["eps"])
+        logits = _mm(h, W["head"], self.interpret)
+        return logits, new_k, new_v
+
     def _build_cb_step(self, w):
         def step(W, tok, k_pages_all, v_pages_all, tables, lens, active):
             return self._cb_decode_math(W, tok, k_pages_all, v_pages_all,
@@ -1059,7 +1464,7 @@ class ContinuousBatchingEngine(LLMEngine):
         (False) or the queue head cannot fit an IDLE engine — a real
         capacity bug, not back-pressure."""
         if self._queue:
-            head = self._queue[0]
+            head = self._pick_next()
             need = self._pages_needed(head.t0, head.max_new_tokens)
             raise EngineFullError(
                 f"request {head.uid} cannot be admitted into an idle "
@@ -1116,8 +1521,8 @@ class ContinuousBatchingEngine(LLMEngine):
                                       mode="drop")
                 kp = kp.reshape(self.n_pages, p, self.nh_kv, self.hd)
                 vp = vp.reshape(self.n_pages, p, self.nh_kv, self.hd)
-                new_k.append(kp)
-                new_v.append(vp)
+                k_pages_all = _pools_put(k_pages_all, li, kp, new_k)
+                v_pages_all = _pools_put(v_pages_all, li, vp, new_v)
                 if use_kernel:
                     attn = ragged_paged_attention(
                         q, kp, vp, tables, ctx, starts,
@@ -1143,7 +1548,8 @@ class ContinuousBatchingEngine(LLMEngine):
             last = jnp.clip(ends - 1 - starts, 0, chunk - 1)
             h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)
             logits = _mm(h_last, W["head"], self.interpret)
-            return logits[:, 0], new_k, new_v
+            return (logits[:, 0], _pools_result(k_pages_all, new_k),
+                    _pools_result(v_pages_all, new_v))
 
         def decode_scan(W, k_pages_all, v_pages_all, tables, tok, lens,
                         act, rem, eos_ids, key):
@@ -1171,8 +1577,83 @@ class ContinuousBatchingEngine(LLMEngine):
                 jax.lax.scan(body, carry0, None, length=K)
             return toks, emitted, tok, lens, act, rem, key, kps, vps
 
+        T = self._spec                  # verify width (0 = spec off)
+        iT = (jnp.arange(T, dtype=jnp.int32)[None, :] if T else None)
+        iD = (jnp.arange(max(T - 1, 0), dtype=jnp.int32)[None, :]
+              if T else None)
+
+        def spec_scan(W, k_pages_all, v_pages_all, tables, tok, lens,
+                      act, rem, eos_ids, key, drafts, dlen):
+            """K VERIFY passes with accept/reject inside the scan
+            carries: each pass feeds [tok, drafts_s] (T tokens), samples
+            the target's token at every position, and commits the
+            longest draft prefix the target agrees with plus the
+            target's own next token. `lens` advances by the emitted
+            count (length-gated writes make rejection free — nothing to
+            scrub), `rem`/`act` retire on budget/EOS exactly like the
+            plain scan. `dlen` is PER PASS [K, w] (a short drafter
+            continuation offers fewer — possibly zero — drafts in later
+            passes; zero-padding is never counted as an offered draft).
+            Outputs [K, w, T] tokens + an emitted mask; the host replays
+            them through the same `_push_token` path."""
+
+            def body(carry, xs):
+                drafts_s, dlen_s = xs
+                tok, lens, act, rem, key, kps, vps = carry
+                feed = jnp.concatenate([tok[:, None], drafts_s], axis=1)
+                logits, kps, vps = self._cb_spec_verify_math(
+                    W, feed, kps, vps, tables, lens, act, rem, dlen_s, w)
+                key, sub = jax.random.split(key)
+                g = _sample(logits.reshape(w * T, -1), sub, do_sample,
+                            temperature, top_k, top_p)
+                g = g.reshape(w, T).astype(tok.dtype)
+                # accepted prefix: draft i matches the target's token at
+                # its position AND every earlier draft matched (greedy =
+                # deterministic argmax agreement; sampled = the q=delta
+                # case of rejection sampling, distribution-exact)
+                match = jnp.logical_and(drafts_s == g[:, :T - 1],
+                                        iD < dlen_s[:, None])
+                # i32-pinned reductions: under the package's global x64,
+                # integer sum/cumsum otherwise accumulate to i64 and the
+                # scan carry dtypes stop matching
+                n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32),
+                                            axis=1, dtype=jnp.int32),
+                                axis=1, dtype=jnp.int32)
+                cap = jnp.minimum(jnp.int32(T), rem)
+                n_emit = jnp.minimum(n_acc + jnp.int32(1), cap)
+                is_eos = g == eos_ids[:, None].astype(tok.dtype)
+                eos_before = jnp.cumsum(is_eos.astype(jnp.int32),
+                                        axis=1, dtype=jnp.int32) \
+                    - is_eos.astype(jnp.int32)
+                # emit the prefix up to the first EOS (inclusive) within
+                # the accepted+bonus window — exactly where the per-step
+                # engine's _push_token sequence would stop
+                emit = jnp.logical_and(
+                    jnp.logical_and(iT < n_emit[:, None],
+                                    eos_before == jnp.int32(0)),
+                    act[:, None])
+                n_fin = jnp.sum(emit.astype(jnp.int32), axis=1,
+                                dtype=jnp.int32)
+                last = jnp.maximum(n_fin - jnp.int32(1), jnp.int32(0))
+                nxt = jnp.take_along_axis(g, last[:, None], axis=1)[:, 0]
+                nxt = jnp.where(act, nxt, tok)
+                lens = jnp.where(act, lens + n_fin, lens)
+                rem = jnp.where(act, rem - n_fin, rem)
+                hit_eos = jnp.any(jnp.logical_and(emit, is_eos), axis=1)
+                act = jnp.logical_and(
+                    act, jnp.logical_and(rem > 0,
+                                         jnp.logical_not(hit_eos)))
+                return (nxt, lens, act, rem, key, kps, vps), (g, emit)
+
+            carry0 = (tok, lens, act, rem, key, k_pages_all, v_pages_all)
+            (tok, lens, act, rem, key, kps, vps), (toks, emitted) = \
+                jax.lax.scan(body, carry0,
+                             (drafts, dlen))   # [K,w,T-1] / [K,w]
+            return toks, emitted, tok, lens, act, rem, key, kps, vps
+
         def fused(W, k_pages_all, v_pages_all, tables, pf_ids, pf_act,
-                  pf_start, pf_end, tok, lens, act, rem, eos_ids, key):
+                  pf_start, pf_end, tok, lens, act, rem, eos_ids, key,
+                  drafts=None, dlen=None):
             first = toks = emitted = None
             if with_prefill:
                 pf_logits, k_pages_all, v_pages_all = prefill_phase(
@@ -1182,10 +1663,16 @@ class ContinuousBatchingEngine(LLMEngine):
                 first = _sample(pf_logits, sub, do_sample, temperature,
                                 top_k, top_p)
             if with_decode:
-                (toks, emitted, tok, lens, act, rem, key, k_pages_all,
-                 v_pages_all) = decode_scan(
-                    W, k_pages_all, v_pages_all, tables, tok, lens, act,
-                    rem, eos_ids, key)
+                if T:
+                    (toks, emitted, tok, lens, act, rem, key,
+                     k_pages_all, v_pages_all) = spec_scan(
+                        W, k_pages_all, v_pages_all, tables, tok, lens,
+                        act, rem, eos_ids, key, drafts, dlen)
+                else:
+                    (toks, emitted, tok, lens, act, rem, key,
+                     k_pages_all, v_pages_all) = decode_scan(
+                        W, k_pages_all, v_pages_all, tables, tok, lens,
+                        act, rem, eos_ids, key)
             return (first, toks, emitted, tok, lens, act, rem, key,
                     k_pages_all, v_pages_all)
 
@@ -1276,14 +1763,69 @@ class ContinuousBatchingEngine(LLMEngine):
         act = np.zeros(w, bool)
         rem = np.zeros(w, np.int32)
         eos = np.full(w, -1, np.int32)
+        T = self._spec
+        if T:
+            # host side of the draft/verify boundary: the drafter
+            # proposes an OPTIMISTIC continuation of S*T tokens per
+            # request, sliced into per-pass drafts — pass s's slice is
+            # only exactly-positioned if every earlier pass fully
+            # accepted; otherwise it mostly mismatches and that pass
+            # degrades to one (target-chosen) token, never to a wrong
+            # one. dlen is PER PASS: a short continuation offers fewer
+            # (or zero) drafts in later passes — zero-pad is never
+            # charged as an offered draft (it would punish a short-but-
+            # right drafter and collapse adaptive draft_k).
+            drafts_np = np.zeros((K, w, T - 1), np.int64)
+            dlen_np = np.zeros((K, w), np.int32)
         for r in live_dec:
+            if T:
+                try:
+                    fault_point("cb.draft", detail=f"uid={r.uid}")
+                except InjectedFault as e:
+                    self._fail_request(r, "draft", e)
+                    continue
+                want = min(r.draft_k, T - 1)
+                cont = np.empty((0,), np.int64)
+                if want > 0:
+                    try:
+                        cont = np.asarray(self._drafter.propose(
+                            np.concatenate(
+                                [r.ids, np.asarray(r.out, np.int64)]),
+                            K * (want + 1)), np.int64).ravel()
+                    except Exception:
+                        # a broken drafter degrades speculation for this
+                        # request, never its correctness (verification
+                        # emits the target's token regardless)
+                        self.draft_errors += 1
+                        cont = np.empty((0,), np.int64)
+                # a fully-accepted pass emits want drafts + the bonus
+                # token, so consecutive passes stride want+1 through the
+                # continuation — striding by T instead would misalign
+                # every pass after the first whenever adaptive K has
+                # shrunk want below T-1, even under perfect drafting
+                stride = want + 1
+                for s in range(K):
+                    seg = cont[s * stride:s * stride + want]
+                    drafts_np[s, r.slot, :seg.size] = seg
+                    dlen_np[s, r.slot] = seg.size
+                try:
+                    # the verify boundary proper: AFTER this request's
+                    # drafter ran, BEFORE it joins the verify dispatch
+                    # (docs/robustness.md) — retires one request with
+                    # the same stage the plain decode boundary uses
+                    fault_point("cb.verify", detail=f"uid={r.uid}")
+                except InjectedFault as e:
+                    self._fail_request(r, "decode", e)
+                    continue
             pos = int(self._lens_np[r.slot])
             # the block writes KV at positions [pos, pos+K) while the
-            # slot stays active; CoW every shared page it can touch NOW
-            # (the only shareable page decode can reach is the prompt's
-            # partial tail page, so this copies exactly what the
-            # per-step path would)
-            hi = min(pos + K, r.t0 + r.max_new_tokens - 1)
+            # slot stays active (speculation widens that to K verify
+            # passes of up to T tokens each); CoW every shared page it
+            # can touch NOW (the only shareable page decode can reach is
+            # the prompt's partial tail page, so this copies exactly
+            # what the per-step path would)
+            span = K * T if T else K
+            hi = min(pos + span, r.t0 + r.max_new_tokens - 1)
             self._make_writable(r, pos, max(hi, pos + 1))
             self._tok_np[r.slot] = r.tok
             act[r.slot] = True
@@ -1291,12 +1833,19 @@ class ContinuousBatchingEngine(LLMEngine):
             if r.eos_token_id is not None:
                 eos[r.slot] = r.eos_token_id
             blk.dec_items.append(r)
+        if T and not blk.dec_items and not live_pf:
+            self.steps += 1            # every decoder faulted at draft
+            return True
         blk.has_prefill = bool(live_pf)
-        blk.has_decode = bool(live_dec)
+        blk.has_decode = bool(blk.dec_items)
         fn = self._get_fused(w, blk.has_prefill, blk.has_decode)
         blk.tables = jnp.asarray(self._tables_np[:w])
         blk.eos_dev = jnp.asarray(eos)
+        if T:
+            blk.dlens = dlen_np
         t_dev = time.perf_counter()
+        spec_args = ((jnp.asarray(drafts_np), jnp.asarray(dlen_np))
+                     if T else ())
         (blk.first, blk.toks, blk.emitted, blk.tok_fin, blk.lens_fin,
          blk.act_fin, blk.rem_fin, self._key, self.k_pages,
          self.v_pages) = fn(
@@ -1304,15 +1853,18 @@ class ContinuousBatchingEngine(LLMEngine):
             jnp.asarray(pf_ids), jnp.asarray(pf_act),
             jnp.asarray(pf_start), jnp.asarray(pf_end),
             jnp.asarray(self._tok_np[:w]), jnp.asarray(self._lens_np[:w]),
-            jnp.asarray(act), jnp.asarray(rem), blk.eos_dev, self._key)
+            jnp.asarray(act), jnp.asarray(rem), blk.eos_dev, self._key,
+            *spec_args)
         self.device_seconds += time.perf_counter() - t_dev
         self.fused_blocks += 1
         # steps advance by the block's DEVICE micro-steps so TTL budgets
         # stay comparable with the per-step engine (expiry itself is
-        # only checked here, at block boundaries — rounded UP)
-        self.steps += len(live_pf) + (K if live_dec else 0)
+        # only checked here, at block boundaries — rounded UP). A spec
+        # block's K micro-steps are VERIFY PASSES (1..T tokens each):
+        # TTLs count passes, not tokens.
+        self.steps += len(live_pf) + (K if blk.has_decode else 0)
         self.prefill_steps += len(live_pf)
-        self.decode_steps += K if live_dec else 0
+        self.decode_steps += K if blk.has_decode else 0
         return blk
 
     def _can_chain(self, blk):
@@ -1323,6 +1875,11 @@ class ContinuousBatchingEngine(LLMEngine):
         (faults fire at host sync points), no copy-on-write pending, and
         at least one request that must outlive this block."""
         if blk.K <= 1 or not blk.has_decode or blk.has_prefill:
+            return False
+        if self._spec:
+            # the drafter runs on the HOST against the newest context;
+            # a chained block would re-verify stale drafts (correct but
+            # useless speculation) — dispatch from the sync point instead
             return False
         if self._queue or self._pending is not None:
             return False
@@ -1397,7 +1954,54 @@ class ContinuousBatchingEngine(LLMEngine):
                 self._lens_np[r.slot] = r.t0
                 r.state = DECODE
                 self._push_token(r, int(first[r.slot]))
-        if blk.has_decode:
+        if blk.has_decode and self._spec:
+            # speculative block: toks/emitted are [K, w, T] — replay
+            # each pass's emitted prefix through the SAME _push_token
+            # retirement path, then feed the acceptance stats to the
+            # per-request adaptive-K policy
+            T = self._spec
+            for s in range(toks.shape[0]):
+                for r in blk.dec_items:
+                    if r.state != DECODE or r.slot is None:
+                        continue       # retired at an earlier pass /
+                        #                cancelled while in flight
+                    em = emitted[s, r.slot]
+                    n = int(em.sum())
+                    if n == 0:
+                        continue
+                    # drafts past the request's remaining budget can
+                    # never be accepted (the device caps emission at
+                    # rem) — don't charge them as rejected, or a
+                    # perfect drafter reads below 1.0 at every
+                    # end-of-budget pass
+                    rem_r = r.max_new_tokens - len(r.out)
+                    offered = min(int(blk.dlens[s, r.slot]),
+                                  max(rem_r - 1, 0))
+                    accepted = min(max(0, n - 1), offered)
+                    self.spec_passes += 1
+                    self.spec_emitted += n
+                    self.spec_drafted_total += offered
+                    self.spec_accepted_total += accepted
+                    r.spec_drafted += offered
+                    r.spec_accepted += accepted
+                    if self.spec_adaptive and offered:
+                        # shrink fast on a complete miss, grow on a
+                        # clean sweep; the window [1, T-1] keeps at
+                        # least one draft in flight so recovery costs
+                        # one cheap pass, not a policy reset
+                        if accepted >= offered and n > offered:
+                            r.draft_k = min(T - 1, max(1, r.draft_k * 2))
+                        elif accepted == 0:
+                            r.draft_k = max(1, r.draft_k // 2)
+                    slot = r.slot
+                    for i in range(T):
+                        if not em[i]:
+                            continue
+                        self._lens_np[slot] += 1
+                        self._push_token(r, int(toks[s, slot, i]))
+                        if r.state != DECODE:
+                            break      # EOS/budget retirement mid-pass
+        elif blk.has_decode:
             for k in range(blk.K):
                 for r in blk.dec_items:
                     if r.state != DECODE or r.slot is None:
@@ -1419,6 +2023,12 @@ class ContinuousBatchingEngine(LLMEngine):
         tok = int(tok)
         r.out.append(tok)
         r.tok = tok
+        # fair-share accounting: 1/share virtual time per emitted token,
+        # so a speculating tenant's higher per-pass yield is charged
+        # exactly like plain decode
+        share = self._tenant_cfg.get(r.tenant, {}).get("share", 1.0)
+        self._tenant_vt[r.tenant] = self._vt(r.tenant) + 1.0 / share
+        self._tenant_tokens[r.tenant] += 1
         if (r.eos_token_id is not None and tok == r.eos_token_id) or \
                 len(r.out) >= r.max_new_tokens:
             self._retire(r)
@@ -1461,31 +2071,14 @@ class ContinuousBatchingEngine(LLMEngine):
         r.error = RequestFailure(r.uid, stage, exc, self.steps,
                                  tokens_generated=len(r.out))
         r.state = state
-        if r.slot is not None:
-            self._slots[r.slot] = None
-            r.slot = None
-        if r.pages:
-            self.allocator.free(r.pages)      # shared pages: drops OUR
-            r.pages = []                      # ref only; cache/other
-            #                                   holders keep theirs
-        if r.cow_reserve is not None:
-            self.allocator.free([r.cow_reserve])
-            r.cow_reserve = None
-        r.shared_idx = set()
+        self._release_slot(r)
         self.failure_count += 1
 
     def _retire(self, r):
         r.result = np.concatenate([r.ids,
                                    np.asarray(r.out, np.int64)])
         r.state = DONE
-        self._slots[r.slot] = None
-        self.allocator.free(r.pages)
-        if r.cow_reserve is not None:
-            self.allocator.free([r.cow_reserve])
-            r.cow_reserve = None
-        r.pages = []
-        r.shared_idx = set()
-        r.slot = None
+        self._release_slot(r)
 
     def _abort_in_flight(self):
         """A donated-buffer call died mid-flight: the pools are gone and
@@ -1521,3 +2114,7 @@ class ContinuousBatchingEngine(LLMEngine):
         if prefix is not None:
             prefix.clear()                   # allocator is reset below
         super()._reset_kv()
+        if getattr(self, "megakernel", None) == "multi":
+            # restore the native stacked [L, ...] pool form
+            self.k_pages = jnp.stack(self.k_pages)
+            self.v_pages = jnp.stack(self.v_pages)

@@ -1,0 +1,189 @@
+"""Drafters for speculative decoding (ISSUE 7 / ROADMAP item 3).
+
+Speculative decoding emits MORE than one accepted token per verification
+pass: a cheap DRAFTER proposes the next few tokens, the target model
+scores all of them in ONE multi-token-q ragged-paged-attention pass, and
+an on-device accept/reject (inside the engine's `lax.scan` carries)
+commits the longest matching prefix plus the target's own next token.
+Verification is always correct regardless of draft quality — a bad draft
+just degrades to one (target-chosen) token per pass — so drafters are
+free to be heuristic.
+
+Two drafters cost NO extra model:
+
+  - `NGramDrafter` — prompt-lookup decoding: match the trailing n-gram
+    of the request's context (prompt + generated so far) against its own
+    earlier tokens and propose the continuation that followed the most
+    recent occurrence. Repetitive suffixes (templated prompts, greedy
+    cycles, quoted spans) draft near-perfectly.
+  - `PrefixCacheDrafter` — seed drafts from the engine's content-
+    addressed `PrefixCache`: other requests' cached prompt chains are
+    observed continuations of this request's context, so a request whose
+    context is a prefix of previously-served traffic drafts the rest of
+    that traffic.
+
+`ModelDrafter` wraps an actual (small) draft model: greedy proposals
+from a dense forward over the bucketed-padded context. It is the
+classic two-model speculation; the zero-model drafters above are the
+default because they add no weights and no extra HBM streams.
+
+Acceptance semantics (engine side, documented here for drafter authors):
+the target samples its own token at every draft position (greedy =
+argmax); draft token i is accepted iff it EQUALS the target's token at
+that position and every earlier draft was accepted. For deterministic
+(delta-distribution) drafters this is exactly the rejection-sampling
+rule, so sampled-mode outputs keep the target model's distribution.
+"""
+import numpy as np
+
+
+class Drafter:
+    """Interface: propose up to `k` continuation tokens for a context.
+
+    `ctx` is the request's full token history (prompt + every generated
+    token, the last of which is the token about to be fed). Return a 1-D
+    int array of length <= k — shorter (or empty) simply shrinks this
+    pass's speculation. Must be cheap: it runs on the host once per
+    request per block, between device dispatches."""
+
+    name = "base"
+
+    def propose(self, ctx, k):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class NGramDrafter(Drafter):
+    """Prompt-lookup drafting: the continuation after the most recent
+    earlier occurrence of the context's trailing n-gram.
+
+    Tries n = `n` down to `min_n`; the first n-gram with an earlier
+    occurrence wins (longer patterns are more specific, so their
+    continuations accept more often). O(|ctx| * n) per call via a
+    vectorized sliding-window compare — contexts are at most a few
+    thousand tokens in this engine."""
+
+    name = "ngram"
+
+    def __init__(self, n=3, min_n=1):
+        if n < min_n or min_n < 1:
+            raise ValueError(f"need n >= min_n >= 1, got n={n} "
+                             f"min_n={min_n}")
+        self.n = int(n)
+        self.min_n = int(min_n)
+
+    def propose(self, ctx, k):
+        ctx = np.asarray(ctx)
+        out = np.empty((0,), np.int64)
+        if k <= 0:
+            return out
+        for n in range(min(self.n, ctx.size - 1), self.min_n - 1, -1):
+            pat = ctx[-n:]
+            win = np.lib.stride_tricks.sliding_window_view(ctx, n)
+            hits = np.flatnonzero((win == pat).all(axis=1))
+            # drop the trailing self-match; keep the MOST RECENT earlier
+            # occurrence that has at least one continuation token
+            hits = hits[hits + n < ctx.size]
+            if hits.size:
+                s = int(hits[-1])
+                return ctx[s + n:s + n + k].astype(np.int64)
+        return out
+
+
+class PrefixCacheDrafter(Drafter):
+    """Drafts seeded from the engine's content-addressed prefix cache:
+    walk the cache's chain index for the request's context and propose
+    the cached continuation other requests already served. Built by the
+    engine (it owns the cache); `PrefixCache.continuation` does the
+    chain walk. `fallback` (optional, what drafter="prefix" installs:
+    an NGramDrafter) handles the cold-cache / divergent-context case
+    where the walk returns nothing."""
+
+    name = "prefix"
+
+    def __init__(self, cache, fallback=None):
+        self.cache = cache
+        self.fallback = fallback
+
+    def propose(self, ctx, k):
+        if self.cache is not None:
+            out = self.cache.continuation(np.asarray(ctx), k)
+            if out.size:
+                return out
+        if self.fallback is not None:
+            return self.fallback.propose(ctx, k)
+        return np.empty((0,), np.int64)
+
+
+class ModelDrafter(Drafter):
+    """Greedy proposals from a small draft MODEL (the classic two-model
+    speculation). Each proposal step runs one dense forward over the
+    context padded up to a `bucket` multiple (bounding compile count);
+    padding sits AFTER the true tokens, so causal attention leaves the
+    scored position untouched. k forwards per propose() — meant for
+    small drafters where that is still far cheaper than a target step."""
+
+    name = "model"
+
+    def __init__(self, model, bucket=32, max_ctx=None):
+        self.model = model
+        self.bucket = int(bucket)
+        self.max_ctx = max_ctx      # optional cap: draft from the tail
+        self._fns = {}
+
+    def _logits_fn(self, t_pad):
+        fn = self._fns.get(t_pad)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+            from ..tensor.tensor import Tensor
+
+            def fwd(ids, last):
+                logits = self.model(Tensor(ids)).data
+                return jax.lax.dynamic_index_in_dim(
+                    logits, last, axis=1, keepdims=False)[0]
+
+            fn = jax.jit(fwd, static_argnums=())
+            self._fns[t_pad] = fn
+        return fn
+
+    def propose(self, ctx, k):
+        import jax.numpy as jnp
+        ctx = np.asarray(ctx, np.int64)
+        if self.max_ctx is not None and ctx.size > self.max_ctx:
+            ctx = ctx[-self.max_ctx:]
+        out = []
+        toks = list(ctx)
+        for _ in range(max(0, k)):
+            t = len(toks)
+            t_pad = -(-t // self.bucket) * self.bucket
+            ids = np.zeros((1, t_pad), np.int64)
+            ids[0, :t] = toks
+            logits = self._logits_fn(t_pad)(jnp.asarray(ids),
+                                            jnp.int32(t - 1))
+            nxt = int(np.argmax(np.asarray(logits)))
+            out.append(nxt)
+            toks.append(nxt)
+        return np.asarray(out, np.int64)
+
+
+def resolve_drafter(spec, prefix_cache=None):
+    """Engine knob -> Drafter instance. Accepts a Drafter, or one of
+    "ngram" / "prefix" (the zero-extra-model drafters); "prefix" needs
+    the engine's PrefixCache and falls back to n-gram proposals when the
+    cache walk has nothing (cold cache)."""
+    if isinstance(spec, Drafter):
+        return spec
+    if spec in (None, "ngram"):
+        return NGramDrafter()
+    if spec == "prefix":
+        if prefix_cache is None:
+            raise ValueError(
+                "drafter='prefix' needs prefix_cache=True on the engine "
+                "(the drafter walks the content-addressed page chains)")
+        return PrefixCacheDrafter(prefix_cache, fallback=NGramDrafter())
+    raise ValueError(
+        f"drafter must be a Drafter instance, 'ngram' or 'prefix', "
+        f"got {spec!r}")

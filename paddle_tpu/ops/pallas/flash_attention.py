@@ -266,12 +266,20 @@ def _mask_spec(mask, B, h_grid, nb, bq, bk, bwd, causal=False):
     mb, mh = mask.shape[0], mask.shape[1]
 
     if causal:
+        # literals pinned i32: interpret-mode pallas_call under an OUTER
+        # jit re-discharges index maps outside the enable_x64(False)
+        # window, where a weak python-int re-canonicalizes to i64 and
+        # MLIR verification rejects the mixed floor_divide (the same
+        # trap class as the decode-megakernel where-operand pins)
         if bwd:
             def cell(kb, i):  # skipped q blocks clamp up to the diagonal
-                return (jnp.maximum(i, (kb * bk) // bq), kb)
+                return (jnp.maximum(i, (kb * jnp.int32(bk)) // jnp.int32(bq)),
+                        kb)
         else:
             def cell(i, kb):  # skipped k blocks clamp back to the diagonal
-                return (i, jnp.minimum(kb, (i * bq + bq - 1) // bk))
+                return (i, jnp.minimum(kb, (i * jnp.int32(bq)
+                                            + jnp.int32(bq - 1))
+                                       // jnp.int32(bk)))
     else:
         if bwd:
             def cell(kb, i):
@@ -337,7 +345,10 @@ def _flash_fwd(q, k, v, mask, h, causal, scale, bq, bk, s_true, interpret,
         # see an unchanged index and Pallas elides the DMA entirely —
         # ~half the K/V HBM streaming at causal shapes
         def _kv_map(bb, hh, i, kb):
-            return (bb, jnp.minimum(kb, (i * bq + bq - 1) // bk), hh)
+            # i32-pinned literals: see _mask_spec's causal clamp note
+            return (bb, jnp.minimum(kb, (i * jnp.int32(bq)
+                                         + jnp.int32(bq - 1))
+                                    // jnp.int32(bk)), hh)
         kv_spec = pl.BlockSpec((nb, bk, d), _kv_map)
     else:
         kv_spec = pl.BlockSpec((nb, bk, d),
@@ -531,7 +542,8 @@ def _flash_bwd(q, k, v, o, lse_l, do, mask, h, causal, scale, bq, bk,
         # index elides the fetch (the dq-partial OUTPUT map stays exact:
         # skipped cells must flush zeros)
         def _qrow(kb, i):
-            return jnp.maximum(i, (kb * bk) // bq)
+            # i32-pinned literals: see _mask_spec's causal clamp note
+            return jnp.maximum(i, (kb * jnp.int32(bk)) // jnp.int32(bq))
         q_spec = pl.BlockSpec(
             (nb, bq, d), lambda bb, hh, kb, i: (bb, _qrow(kb, i), hh))
         row_spec = pl.BlockSpec(

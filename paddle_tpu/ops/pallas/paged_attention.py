@@ -341,6 +341,37 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, ctx_lens,
     return jnp.swapaxes(out.reshape(b, h, tq, d), 1, 2)
 
 
+def spec_verify_attention(q, k_pages, v_pages, page_table, lens,
+                          active=None, scale=None, interpret=False):
+    """Speculative-decode VERIFY entry: score K draft tokens per slot in
+    ONE ragged-paged-attention invocation (ISSUE 7 / ROADMAP item 3).
+
+    Slot b holds `lens[b]` committed tokens; its K feed tokens (the
+    pending token + K-1 drafts) sit at global positions lens[b] + [0, K)
+    and their k/v were scattered into the slot's pages BEFORE this call
+    (length-gated, so rejected drafts need no scrub — `lens` simply does
+    not advance over them). Each query row attends causally up to its
+    own position, which is exactly the mask the sequential decode kernel
+    applies one token at a time: on the interpret path the two kernels
+    share the same per-page online-softmax trajectory, so verify logits
+    are BIT-IDENTICAL to K sequential decode steps — the property the
+    engine's greedy byte-identity contract rests on.
+
+    q: [b, K, h, d]; pages [n_pages, p, h_kv, d]; page_table [b, mp];
+    lens [b] committed lengths (i32-pinned here, as are the ragged
+    kernel's index maps — the PR 5/6 weak-literal traps). Returns
+    [b, K, h, d]."""
+    K = q.shape[1]
+    lens = lens.astype(jnp.int32)
+    # ctx covers every feed position; per-row causality is the binding
+    # mask (kpos <= qpos), so unwritten positions past a row's own
+    # write gate are never attended by rows the engine keeps
+    ctx = lens + jnp.int32(K)
+    return ragged_paged_attention(q, k_pages, v_pages, page_table, ctx,
+                                  lens, active=active, scale=scale,
+                                  interpret=interpret)
+
+
 def ragged_paged_attention_reference(q, k_pages, v_pages, page_table,
                                      ctx_lens, q_starts, active=None,
                                      scale=None):

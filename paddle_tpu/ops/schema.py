@@ -140,7 +140,15 @@ def generate_op_reference():
              "(`ops/pallas/decode_megakernel.py`); see docs/serving.md "
              '["Megakernel decode"]'
              "(serving.md#megakernel-decode-megakernel) for the engine "
-             "knob and VMEM budget rules.",
+             "knob and VMEM budget rules. Speculative decoding rides "
+             "the same kernels: `paged_attention."
+             "spec_verify_attention` scores K draft tokens per slot in "
+             "one multi-token-q ragged invocation, with accept/reject "
+             "in the engine's on-device scan carries — see "
+             '["Speculative decoding"]'
+             "(serving.md#speculative-decoding-speculate) for drafter "
+             "choices, adaptive-K policy, and tenant budget/preemption "
+             "semantics.",
              ""]
     for mod in sorted(by_mod):
         lines.append(f"## {mod}")

@@ -5,6 +5,12 @@ import pytest
 import paddle_tpu as paddle
 
 
+# The model-zoo forward sweeps are heavy (15-57s each on the PR 6
+# untimed run) and were grandfathered past the 15s per-test budget;
+# they are coverage sweeps, not regression canaries, so they now run
+# slow-tier — the tier-1 window spends those seconds on tail tests the
+# 870s driver timeout was truncating instead.
+@pytest.mark.slow
 @pytest.mark.parametrize("name", [
     "resnet18", "vgg11", "mobilenet_v1", "mobilenet_v2", "alexnet",
     "squeezenet1_1", "shufflenet_v2_x0_5", "densenet121",
@@ -20,6 +26,7 @@ def test_forward_shapes(name):
     assert out.shape == [1, 10]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("name", ["mobilenet_v3_small", "mobilenet_v3_large",
                                   "resnext50_32x4d"])
 def test_forward_shapes_v3(name):
@@ -31,6 +38,7 @@ def test_forward_shapes_v3(name):
     assert model(x).shape == [1, 10]
 
 
+@pytest.mark.slow
 def test_inception_v3():
     from paddle_tpu.vision.models import inception_v3
     paddle.seed(0)
@@ -40,6 +48,7 @@ def test_inception_v3():
     assert m(paddle.randn([1, 3, 160, 160])).shape == [1, 10]
 
 
+@pytest.mark.slow
 def test_googlenet_aux_heads():
     from paddle_tpu.vision.models import googlenet
     paddle.seed(0)

@@ -566,6 +566,82 @@ def main():
                "spec_passes": passes,
                "unit": "tokens/s"})
 
+    # -- multi-replica failover: the availability layer's price tags -----
+    # Three numbers (docs/serving.md "Multi-replica routing & hot-swap"):
+    # steady-state router throughput vs ONE bare engine (the routing
+    # overhead), degraded throughput with a replica killed mid-stream
+    # (capacity under failure: survivors absorb the re-queued work), and
+    # failover_recovery_ms — the wall cost of the router step that
+    # detects the kill, salvages in-flight state, and re-queues it on
+    # survivors (the control-plane gap a client would see as added
+    # latency, not an error). Runs the micro geometry: the claim is the
+    # CONTROL plane's, device speed rides the other sections. rc=0-safe
+    # like every section — a failure emits an error-tagged zero line.
+    try:
+        from paddle_tpu.inference.router import EngineRouter
+
+        fo_rng = np.random.RandomState(23)
+        fo_prompts = [fo_rng.randint(0, f_cfg.vocab_size, int(t))
+                      .astype(np.int64)
+                      for t in fo_rng.randint(6, 16, 8)]
+        fo_new = 16
+
+        def fo_factory():
+            return ContinuousBatchingEngine(f_model, decode_block=1,
+                                            megakernel=False, **fused_kw)
+
+        def _router_run(n_replicas, kill_at=None):
+            router = EngineRouter(fo_factory, replicas=n_replicas,
+                                  quarantine_threshold=3)
+            # warmup: compile every replica's programs outside the timing
+            for rep in router._replicas:
+                rep.engine.generate_many(
+                    [fo_rng.randint(0, f_cfg.vocab_size, 6)
+                     .astype(np.int64)], max_new_tokens=2)
+            uids = [router.add_request(p, max_new_tokens=fo_new)
+                    for p in fo_prompts]
+            recovery = None
+            t0 = time.perf_counter()
+            steps = 0
+            while router.pending():
+                if kill_at is not None and steps == kill_at:
+                    with failsafe.inject("replica.step", nth=1):
+                        tk = time.perf_counter()
+                        router.step()
+                        recovery = (time.perf_counter() - tk) * 1e3
+                else:
+                    router.step()
+                steps += 1
+            wall = time.perf_counter() - t0
+            toks = sum(router.result(u).size for u in uids) \
+                - sum(p.size for p in fo_prompts)
+            assert router.health()["failed"] == 0
+            return toks / max(wall, 1e-9), recovery, router
+
+        single_tps, _, _ = _router_run(1)
+        steady_tps, _, _ = _router_run(3)
+        degraded_tps, recovery_ms, router = _router_run(3, kill_at=3)
+        assert router.failovers >= 1, "kill never landed"
+        _emit({
+            "metric": "cb_failover",
+            "model": "llama-micro" if not (seven_b or on_tpu)
+                     else ("llama7b" if seven_b else "llama350m"),
+            "replicas": 3,
+            "requests": len(fo_prompts),
+            "value": round(degraded_tps, 2),
+            "unit": "tokens/s",
+            "failover_recovery_ms": round(recovery_ms, 2),
+            "steady_tokens_per_sec": round(steady_tps, 2),
+            "single_replica_tokens_per_sec": round(single_tps, 2),
+            "router_overhead_frac": round(
+                max(0.0, 1.0 - steady_tps / max(single_tps, 1e-9)), 4),
+            "requeued": router.requeued,
+            "failovers": router.failovers,
+        })
+    except Exception as e:  # noqa: BLE001 — bench must stay rc=0
+        _emit({"metric": "cb_failover", "value": 0.0, "unit": "tokens/s",
+               "error": f"{type(e).__name__}: {e}"})
+
 
 if __name__ == "__main__":
     main()

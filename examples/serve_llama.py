@@ -72,6 +72,20 @@ def main():
                          "'prefix' = continuations walked from the "
                          "content-addressed prefix cache (other "
                          "requests' traffic)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="N > 1: serve through the fault-tolerant "
+                         "EngineRouter — N engine replicas, health-"
+                         "balanced routing, replica failover with "
+                         "in-flight re-queue, circuit-breaker "
+                         "quarantine (scheduler mode; docs/serving.md "
+                         "\"Multi-replica routing & hot-swap\")")
+    ap.add_argument("--hot-swap", metavar="DIR", default=None,
+                    help="perform a mid-stream zero-downtime rolling "
+                         "weight swap from this CRC32-manifest snapshot "
+                         "directory (saved first from the live weights "
+                         "when the path does not exist yet — a self-"
+                         "contained round-trip demo); needs "
+                         "--replicas >= 2")
     ap.add_argument("--megakernel", choices=["auto", "off", "layer",
                                              "multi"], default="auto",
                     help="decode-layer Pallas megakernel: one fused "
@@ -112,6 +126,50 @@ def main():
         weight_dtype = None
 
     quant = None if args.quant == "none" else args.quant
+    if args.hot_swap and args.replicas < 2:
+        ap.error("--hot-swap needs --replicas >= 2 (the router keeps "
+                 "serving from the other replicas while one flips)")
+    if args.replicas > 1:
+        # fault-tolerant fleet: N replicas behind the health-checked
+        # router — failover, quarantine, and (optionally) a mid-stream
+        # zero-downtime weight hot-swap
+        from paddle_tpu.inference.router import EngineRouter
+
+        def factory():
+            return ContinuousBatchingEngine(
+                model, max_len=g["max_len"], page_size=g["page"],
+                max_batch=max(2, g["bs"]), quant=quant,
+                weight_dtype=weight_dtype,
+                decode_block=args.decode_block)
+
+        router = EngineRouter(factory, replicas=args.replicas)
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, g["cfg"].vocab_size, (t,))
+                   .astype(np.int64) for t in (16, 9, 5, 12)]
+        uids = [router.add_request(p, max_new_tokens=args.max_new_tokens)
+                for p in prompts]
+        for _ in range(2):
+            router.step()                    # replicas mid-flight
+        if args.hot_swap:
+            if not os.path.isdir(args.hot_swap):
+                # round-trip demo: snapshot the live weights first
+                router.save_weights_snapshot(args.hot_swap, step=0)
+            print(f"  hot-swap: {router.hot_swap(args.hot_swap)}")
+        router.drain()
+        h = router.health()
+        print(f"model={args.model} quant={args.quant} "
+              f"router: {len(uids)} requests over {args.replicas} "
+              f"replicas, {h['done']} done / {h['failed']} failed, "
+              f"{h['failovers']} failovers, {h['hot_swaps']} hot-swaps")
+        for name, rh in h["replicas"].items():
+            print(f"  {name}: breaker={rh['breaker']} "
+                  f"pages_free={rh.get('pages_free')}")
+        for i, u in enumerate(uids):
+            o = router.result(u)
+            print(f"  request {i}: {prompts[i].size} -> {o.size} "
+                  f"tokens, tail {o[-4:].tolist()}")
+        return
+
     if args.scheduler:
         from paddle_tpu.inference.scheduler import (EngineBusyError,
                                                     RequestFailedError)

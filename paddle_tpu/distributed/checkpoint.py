@@ -219,6 +219,34 @@ def load_state(path, like=None, verify=True):
     return jax.tree_util.tree_unflatten(treedef, placed), index
 
 
+# -- engine-facing snapshot load/verify (serving weight hot-swap) ----------
+def save_snapshot(weights, path, step=None):
+    """Atomic CRC32-manifest save of a serving-engine weight pytree —
+    the artifact `load_snapshot_for` verifies before a zero-downtime
+    hot-swap flip (docs/serving.md "Multi-replica routing & hot-swap")."""
+    save_state(weights, path, step=step)
+
+
+def load_snapshot_for(like, path):
+    """Load a weight snapshot and verify it is INSTALLABLE into the
+    engine tree `like`: per-leaf CRC32 (torn/bit-rotted writes), tree
+    structure (leaf count via load_state), and per-leaf SHAPE — all
+    checked before anything is handed to the engine, so a bad artifact
+    fails the swap while the old weights are still serving, never
+    after the flip. Returns the placed pytree."""
+    state, index = load_state(path, like=like, verify=True)
+    got = jax.tree_util.tree_leaves(state)
+    want = jax.tree_util.tree_leaves(like)
+    for i, (g, w) in enumerate(zip(got, want)):
+        if tuple(np.shape(g)) != tuple(np.shape(w)):
+            raise CheckpointCorruptError(
+                f"snapshot {path!r} leaf {i} shape {tuple(np.shape(g))} "
+                f"does not match the serving engine's "
+                f"{tuple(np.shape(w))} — wrong model geometry for this "
+                "engine")
+    return state
+
+
 # -- step-directory layout (resume picks the latest VALID save) ------------
 def step_dir(root, step):
     return os.path.join(root, f"step_{int(step):08d}")

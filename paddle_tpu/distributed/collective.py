@@ -199,9 +199,16 @@ def _process_gather(arr, group):
     publishes under a group-scoped generation key and reads the others;
     non-members never enter, so nothing hangs (the analog of the
     reference's gloo sub-communicators, carried by the TCPStore)."""
-    from .parallel_env import get_world_size
+    from .parallel_env import get_store, get_world_size
     ranks = _group_ranks(group)
     if group is not None and len(ranks) != get_world_size():
+        return _subgroup_gather(np.asarray(arr), group)
+    import jax
+    if jax.default_backend() == "cpu" and get_store() is not None:
+        # process_allgather jit-compiles a cross-process program, which
+        # the CPU backend does not implement ("Multiprocess computations
+        # aren't implemented") — the store transport carries the world
+        # gather there, exactly as it does for subgroups
         return _subgroup_gather(np.asarray(arr), group)
     from jax.experimental import multihost_utils
     return multihost_utils.process_allgather(np.asarray(arr))

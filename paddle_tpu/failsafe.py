@@ -33,6 +33,23 @@ import threading
 import time
 
 
+class RetriesExhaustedError(RuntimeError):
+    """retry_with_backoff gave up: the retry budget ran out (with
+    raise_exhausted=True) or the max_elapsed cap tripped. Carries the
+    last underlying exception as .last_exception (also chained via
+    __cause__), plus .attempts and .elapsed (sum of backoff delays —
+    deterministic under an injected sleep)."""
+
+    def __init__(self, last_exception, attempts, elapsed, why):
+        self.last_exception = last_exception
+        self.attempts = attempts
+        self.elapsed = elapsed
+        super().__init__(
+            f"retries exhausted after {attempts} attempt(s) ({why}); "
+            f"last error: {type(last_exception).__name__}: "
+            f"{last_exception}")
+
+
 class InjectedFault(RuntimeError):
     """The error a triggered fault point raises (unless the armed spec
     carries a custom exception class). Carries the point name so handlers
@@ -211,30 +228,50 @@ class inject:
 
 def retry_with_backoff(fn, retries=5, base_delay=0.05, factor=2.0,
                        max_delay=2.0, retry_on=(Exception,), jitter=0.0,
-                       seed=0, on_retry=None, sleep=time.sleep):
+                       seed=0, on_retry=None, sleep=time.sleep,
+                       max_elapsed=None, raise_exhausted=False):
     """Call fn() up to retries+1 times with exponential backoff.
 
-    Returns fn()'s value; re-raises the LAST error once retries are
-    exhausted. `retry_on` bounds what is retryable (everything else
-    propagates immediately). `jitter` adds up to jitter*delay of seeded
-    (deterministic) random spread. `sleep` is injectable so tests assert
-    the delay schedule without waiting it out; `on_retry(attempt, exc,
-    delay)` is the observability hook.
+    Returns fn()'s value; once the budget runs out, re-raises the LAST
+    error (default) or raises RetriesExhaustedError carrying it
+    (raise_exhausted=True — the router's quarantine probes use this so
+    callers can catch ONE typed error instead of `retry_on`).
+
+    `retry_on` bounds what is retryable (everything else propagates
+    immediately). `jitter` adds up to jitter*delay of seeded
+    (deterministic) random spread — same seed, same schedule, always.
+    `max_elapsed` caps the TOTAL backoff budget: when the delays slept
+    so far plus the next delay would exceed it, the helper stops
+    retrying and raises RetriesExhaustedError (elapsed is the sum of
+    scheduled delays, so the cap stays deterministic under an injected
+    sleep). `sleep` is injectable so tests assert the delay schedule
+    without waiting it out; `on_retry(attempt, exc, delay)` is the
+    observability hook.
     """
     if retries < 0:
         raise ValueError(f"retries must be >= 0, got {retries}")
     rng = random.Random(seed)
     delay = float(base_delay)
+    elapsed = 0.0
     for attempt in range(retries + 1):
         try:
             return fn()
         except retry_on as e:
             if attempt == retries:
+                if raise_exhausted:
+                    raise RetriesExhaustedError(
+                        e, attempt + 1, elapsed,
+                        f"retry budget of {retries} spent") from e
                 raise
             d = min(delay, max_delay)
             if jitter:
                 d += rng.random() * jitter * d
+            if max_elapsed is not None and elapsed + d > max_elapsed:
+                raise RetriesExhaustedError(
+                    e, attempt + 1, elapsed,
+                    f"max_elapsed={max_elapsed}s cap hit") from e
             if on_retry is not None:
                 on_retry(attempt + 1, e, d)
             sleep(d)
+            elapsed += d
             delay *= factor

@@ -363,6 +363,11 @@ _SERVING_EXPORTS = {
     # small-model drafter, and the Drafter base for custom ones
     "Drafter": "speculative", "NGramDrafter": "speculative",
     "PrefixCacheDrafter": "speculative", "ModelDrafter": "speculative",
+    # multi-replica availability layer (docs/serving.md "Multi-replica
+    # routing & hot-swap", docs/robustness.md replica failure model)
+    "EngineRouter": "router", "EngineReplica": "router",
+    "CircuitBreaker": "router", "ReplicaFailedError": "router",
+    "NoReplicaAvailableError": "router", "HotSwapError": "router",
 }
 
 

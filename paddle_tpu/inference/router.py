@@ -1,0 +1,842 @@
+"""Fault-tolerant multi-replica serving: the availability layer.
+
+One ContinuousBatchingEngine is one fault domain: a poisoned dispatch
+kills every in-flight request, and a weight deploy stops traffic. This
+module fronts N engine REPLICAS with an `EngineRouter` that makes the
+fleet behave like one engine that happens not to die (ROADMAP item 1's
+"millions of users" gap; the Gemma-on-TPU serving comparison treats
+multi-replica routing as table stakes, and the MLPerf TPU-pod scaling
+story presumes workers fail and rejoin without restarting the job):
+
+  - HEALTH-balanced routing: each add_request lands on the replica with
+    the most headroom (queue depth, free slots, free KV pages — read
+    from the engine's own health() snapshot). Per-tenant admission
+    (tenant=/priority=) rides through end to end: every replica runs
+    the same fair-share/priority policy on its local queue.
+  - FAILOVER: a replica failure — an armed `replica.step` /
+    `replica.heartbeat` / `replica.admit` fault point, or a real
+    exception escaping the engine — re-queues that replica's in-flight
+    requests on the survivors. Generated tokens fold into the prompt
+    exactly like the scheduler's preemption path, so greedy
+    continuations are BYTE-IDENTICAL to an uninterrupted run, and the
+    router's delivery ledger guarantees exactly-once results: no uid is
+    ever dropped, none is ever answered twice (duplicate deliveries are
+    counted and ignored).
+  - QUARANTINE: a replica that keeps failing trips a circuit breaker
+    (closed -> open) and stops receiving traffic; re-admission runs as
+    bounded `retry_with_backoff` probes (seeded jitter, max_elapsed cap,
+    typed RetriesExhaustedError) instead of retry-storming a sick
+    replica. A surviving probe puts it in half-open (trial traffic);
+    a clean step closes the breaker, another failure reopens it with a
+    doubled probe backoff.
+  - ZERO-DOWNTIME WEIGHT HOT-SWAP (ROADMAP item 5a): hot_swap() rolls a
+    new snapshot through the fleet one replica at a time — drain the
+    replica (migrate its in-flight to the others), load + CRC32-verify
+    the snapshot through the atomic checkpoint layer, flip at a block
+    boundary, re-admit. The router keeps serving from the other
+    replicas throughout; a CheckpointCorruptError rolls EVERY
+    already-flipped replica back to the old weights so the fleet never
+    serves mixed results of a torn deploy.
+
+The replica boundary is `EngineReplica` — the ONLY class that touches
+engine internals. A process/pod backend later reimplements exactly this
+surface (submit/step/health/export/evict/weights) over an RPC channel;
+the router itself never reaches past it.
+
+Numerics: routing never changes tokens. Greedy outputs through the
+router are byte-identical to a single engine serving the same requests
+(pinned across speculate on/off and decode_block 1/8 in
+tests/test_router.py, including under seeded chaos kills).
+"""
+import collections
+import time
+
+import numpy as np
+
+from ..failsafe import (RetriesExhaustedError, fault_point,
+                        retry_with_backoff)
+from .scheduler import (DECODE, DONE, FAILED, PREFILL, QUEUED,
+                        EngineBusyError, EngineFullError, RequestFailure,
+                        RequestFailedError, RequestNotFinishedError,
+                        SchedulerError, UnknownRequestError)
+
+ACTIVE, DRAINING = "active", "draining"
+
+
+class ReplicaFailedError(SchedulerError):
+    """A replica was declared dead (fault point or escaped exception);
+    its in-flight work was re-queued on survivors."""
+
+
+class NoReplicaAvailableError(EngineBusyError):
+    """No replica can take this request right now (all quarantined or
+    at queue_limit) and the router's own hold queue is full — typed
+    backpressure, nothing was enqueued."""
+
+
+class HotSwapError(SchedulerError):
+    """A weight hot-swap aborted; every replica was rolled back to (or
+    never left) the old weights and serving continued throughout.
+    Carries the underlying cause as __cause__."""
+
+
+class CircuitBreaker:
+    """Per-replica quarantine state machine.
+
+    closed: normal traffic; `threshold` CONSECUTIVE failures open it.
+    open: no traffic; after `probe_backoff` router steps a re-admission
+      probe may run (the router wraps it in retry_with_backoff). A
+      failed probe doubles the backoff (capped); a surviving probe
+      moves to half-open.
+    half-open: trial traffic; ONE clean step closes the breaker (and
+      resets the backoff), ONE failure reopens it.
+    """
+
+    __slots__ = ("threshold", "state", "failures", "probe_backoff",
+                 "_base_backoff", "next_probe_step", "opened", "reopened",
+                 "closed_after_probe", "last_error")
+
+    def __init__(self, threshold=2, probe_backoff=4):
+        self.threshold = max(1, int(threshold))
+        self.state = "closed"
+        self.failures = 0               # consecutive
+        self._base_backoff = max(1, int(probe_backoff))
+        self.probe_backoff = self._base_backoff
+        self.next_probe_step = None     # router step gating the probe
+        self.opened = 0                 # lifetime open transitions
+        self.reopened = 0               # opens from half-open/failed probe
+        self.closed_after_probe = 0
+        self.last_error = None
+
+    def record_failure(self, exc, at_step):
+        self.failures += 1
+        self.last_error = f"{type(exc).__name__}: {exc}"
+        if self.state == "half_open" or self.failures >= self.threshold:
+            self._open(at_step, reopen=self.state == "half_open")
+
+    def record_success(self):
+        self.failures = 0
+        if self.state == "half_open":
+            self.state = "closed"
+            self.probe_backoff = self._base_backoff
+            self.closed_after_probe += 1
+
+    def record_probe_failure(self, at_step):
+        self._open(at_step, reopen=True)
+
+    def record_probe_success(self):
+        self.state = "half_open"
+
+    def ready_to_probe(self, step):
+        return self.state == "open" and step >= self.next_probe_step
+
+    def _open(self, at_step, reopen=False):
+        if self.state != "open":
+            self.opened += 1
+        if reopen:
+            self.reopened += 1
+            self.probe_backoff = min(self.probe_backoff * 2,
+                                     64 * self._base_backoff)
+        self.state = "open"
+        self.next_probe_step = at_step + self.probe_backoff
+
+
+class EngineReplica:
+    """One serving replica behind the router — the replica BOUNDARY.
+
+    This in-process backend wraps a ContinuousBatchingEngine directly;
+    everything the router needs goes through these methods, so a
+    process/pod backend only reimplements this class (same surface over
+    RPC), never the router. The engine object survives a declared
+    failure: a fault-point kill leaves it intact (its requests are
+    evicted and re-queued elsewhere), a real mid-dispatch exception
+    already rebuilt its pools via the engine's own abort path — either
+    way `step()`/`submit()` remain callable, which is what quarantine
+    probes verify before re-admission.
+    """
+
+    def __init__(self, name, factory):
+        self.name = name
+        self._factory = factory
+        self.engine = factory()
+        self.state = ACTIVE
+        self.breaker = None             # installed by the router
+        self.kills = 0                  # declared failures
+        self.swaps = 0                  # weight flips applied
+        self.failed_probes = 0          # consecutive exhausted probe
+        #                                 series (rebuild trigger)
+
+    # -- traffic -----------------------------------------------------------
+    def submit(self, spec):
+        """Admit a resume spec (scheduler.export_request shape); returns
+        this replica's engine uid."""
+        return self.engine.submit_resume(spec)
+
+    def step(self):
+        return self.engine.step()
+
+    def health(self):
+        return self.engine.health()
+
+    def headroom(self):
+        """O(1) routing snapshot (queued/running/slots/pages) — the
+        hot-path subset of health(), which walks the engine's full
+        request history and is for monitors/probes only."""
+        return self.engine.headroom()
+
+    def has_work(self):
+        h = self.engine.headroom()
+        return bool(h["queued"] or h["running"])
+
+    # -- per-request state -------------------------------------------------
+    def status(self, uid):
+        return self.engine.status(uid)
+
+    def result(self, uid):
+        return self.engine.result(uid)
+
+    def failure(self, uid):
+        return self.engine.failures().get(uid)
+
+    def export_resume(self, uid):
+        return self.engine.export_request(uid)
+
+    def evict(self, uid):
+        """Drop a request from this replica WITHOUT failing it at the
+        router level (its re-queued copy carries the work forward);
+        pages/slots reclaim through the engine's cancel path."""
+        try:
+            self.engine.cancel(uid)
+        except UnknownRequestError:
+            pass
+        return None
+
+    def queue_head_uid(self):
+        """The engine uid admission would pick next (the request an
+        EngineFullError is complaining about)."""
+        q = self.engine._queue
+        return self.engine._pick_next().uid if q else None
+
+    # -- weights -----------------------------------------------------------
+    def export_weights(self):
+        return self.engine.export_weights()
+
+    def load_weights_snapshot(self, path):
+        return self.engine.load_weights_snapshot(path)
+
+    def save_weights_snapshot(self, path, step=None):
+        return self.engine.save_weights_snapshot(path, step=step)
+
+    def install_weights(self, new):
+        self.engine.install_weights(new)
+        self.swaps += 1
+
+    # -- lifecycle ---------------------------------------------------------
+    def rebuild(self):
+        """Fresh engine from the factory (a quarantine probe's last
+        resort when the current engine object is unusable)."""
+        self.engine = self._factory()
+        return self.engine
+
+
+class _RouterRequest:
+    """Router-side ledger entry for one submitted request."""
+
+    __slots__ = ("uid", "replica", "engine_uid", "state", "result",
+                 "failure", "requeues", "tenant")
+
+    def __init__(self, uid, tenant):
+        self.uid = uid
+        self.replica = None             # current replica name
+        self.engine_uid = None
+        self.state = QUEUED
+        self.result = None
+        self.failure = None
+        self.requeues = 0
+        self.tenant = tenant
+
+
+class EngineRouter:
+    """Health-checked router over N engine replicas (module docstring).
+
+    factory: zero-arg callable building ONE ContinuousBatchingEngine
+      (each replica calls it once; quarantine probes may call it again
+      to rebuild a wrecked engine). All replicas must share model +
+      engine config — the router assumes any replica can serve any
+      request.
+    replicas: fleet size (>= 1).
+    quarantine_threshold: consecutive declared failures that open a
+      replica's circuit breaker.
+    probe_backoff: router steps between an open breaker and its first
+      re-admission probe (doubles per failed probe, capped).
+    probe_retries / probe_base_delay / probe_jitter / probe_max_elapsed:
+      the retry_with_backoff budget of ONE probe attempt series; seeded
+      jitter keeps schedules deterministic, probe_sleep is injectable
+      for tests.
+    hold_limit: bound on the router's own hold queue (requests parked
+      while every replica is quarantined/draining). None = unbounded.
+    """
+
+    # consecutive exhausted probe series before a quarantined replica's
+    # engine object is presumed wrecked and rebuilt from the factory
+    REBUILD_AFTER_PROBES = 3
+
+    def __init__(self, factory, replicas=2, quarantine_threshold=2,
+                 probe_backoff=4, probe_retries=1, probe_base_delay=0.01,
+                 probe_jitter=0.0, probe_max_elapsed=None, probe_seed=0,
+                 probe_sleep=time.sleep, hold_limit=None):
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self._replicas = []
+        for i in range(int(replicas)):
+            rep = EngineReplica(f"r{i}", factory)
+            rep.breaker = CircuitBreaker(threshold=quarantine_threshold,
+                                         probe_backoff=probe_backoff)
+            self._replicas.append(rep)
+        self._by_name = {r.name: r for r in self._replicas}
+        self._probe_kw = dict(retries=int(probe_retries),
+                              base_delay=float(probe_base_delay),
+                              jitter=float(probe_jitter),
+                              max_elapsed=probe_max_elapsed,
+                              seed=int(probe_seed), sleep=probe_sleep,
+                              raise_exhausted=True)
+        self.hold_limit = None if hold_limit is None else int(hold_limit)
+        self._reqs = {}                 # router uid -> _RouterRequest
+        self._assigned = collections.defaultdict(set)  # name -> {ruid}
+        self._held = collections.deque()               # unrouted ruids
+        self._specs = {}                # ruid -> pending resume spec
+        self._next_uid = 0
+        self._rr = 0                    # routing tie-break rotation
+        # observability (tests + decode_bench's cb_failover assert these)
+        self.steps = 0
+        self.failovers = 0              # replica-declared-dead events
+        self.requeued = 0               # in-flight requests moved
+        self.duplicates_dropped = 0     # second deliveries ignored
+        self.probes = 0
+        self.hot_swaps = 0              # completed fleet swaps
+        self.swap_rollbacks = 0
+
+    # -- public ------------------------------------------------------------
+    def add_request(self, ids, max_new_tokens=32, eos_token_id=None,
+                    deadline_ms=None, ttl_steps=None, tenant=None,
+                    priority=None):
+        """Queue one prompt on the healthiest replica; returns a ROUTER
+        uid (stable across failovers — the engine-level uid may change
+        when the request migrates). Signature mirrors
+        ContinuousBatchingEngine.add_request; per-tenant admission is
+        enforced by each replica's own policy."""
+        ids = np.asarray(ids, np.int64).ravel()
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        spec = {"prompt": ids, "max_new_tokens": int(max_new_tokens),
+                "eos_token_id": eos_token_id, "tenant": tenant or "default",
+                "priority": priority, "ttl_steps": ttl_steps,
+                "deadline": deadline}
+        rr = _RouterRequest(self._next_uid, spec["tenant"])
+        self._next_uid += 1
+        self._reqs[rr.uid] = rr
+        try:
+            self._route(rr, spec)
+        except Exception:
+            del self._reqs[rr.uid]
+            raise
+        return rr.uid
+
+    def step(self):
+        """One router iteration: re-route held requests, probe
+        quarantined replicas, then step every serving replica once
+        (collecting completions after each). Returns False when no
+        replica had work and nothing is held."""
+        self.steps += 1
+        self._flush_held()
+        did = False
+        for rep in self._replicas:
+            if rep.breaker.state == "open":
+                if rep.breaker.ready_to_probe(self.steps):
+                    did |= self._probe(rep)
+                continue
+            if not rep.has_work():
+                if rep.breaker.state == "half_open":
+                    # no trial traffic arrived: a clean idle heartbeat
+                    # is the closing observation (otherwise a lightly
+                    # loaded fleet leaves revived replicas half-open
+                    # forever — traffic always prefers closed ones)
+                    try:
+                        fault_point("replica.heartbeat", detail=rep.name)
+                        rep.headroom()
+                        rep.breaker.record_success()
+                    except Exception as e:
+                        self._on_replica_failure(rep, e)
+                    did = True
+                continue
+            try:
+                fault_point("replica.heartbeat", detail=rep.name)
+                fault_point("replica.step", detail=rep.name)
+                moved = rep.step()
+            except EngineFullError as e:
+                # a request that can NEVER fit an idle replica is a
+                # per-REQUEST problem (capacity), not a replica fault
+                self._fail_stuck_head(rep, e)
+                did = True
+                continue
+            except Exception as e:      # InjectedFault or real
+                self._on_replica_failure(rep, e)
+                did = True
+                continue
+            rep.breaker.record_success()
+            self._collect(rep)
+            did = did or moved
+        return did or bool(self._held)
+
+    def drain(self):
+        """Run until every submitted request has a result or failure.
+        Returns {router_uid: output} for requests completed by this
+        call."""
+        before = {u for u, r in self._reqs.items() if r.state == DONE}
+        while self.step():
+            pass
+        # a final collect: completions from the last productive step
+        for rep in self._replicas:
+            if rep.breaker.state != "open":
+                self._collect(rep)
+        return {u: r.result for u, r in self._reqs.items()
+                if r.state == DONE and u not in before}
+
+    def result(self, uid):
+        """Exactly-once output for a router uid: the SAME array no
+        matter how many replicas the request crossed or how many times
+        a replica tried to deliver it. Typed errors mirror the
+        scheduler's."""
+        rr = self._reqs.get(uid)
+        if rr is None:
+            raise UnknownRequestError(f"unknown request uid {uid}")
+        if rr.state == FAILED:
+            raise RequestFailedError(rr.failure)
+        if rr.state != DONE:
+            raise RequestNotFinishedError(
+                f"request {uid} is {rr.state}, not done")
+        return rr.result
+
+    def status(self, uid):
+        rr = self._reqs.get(uid)
+        if rr is None:
+            raise UnknownRequestError(f"unknown request uid {uid}")
+        return rr.state
+
+    def failures(self):
+        """{router_uid: RequestFailure} for requests that failed AT THE
+        ROUTER LEVEL (shed deadlines, capacity, exhausted re-queues) —
+        replica-local failures that were recovered by failover never
+        appear here."""
+        return {u: r.failure for u, r in self._reqs.items()
+                if r.failure is not None}
+
+    def pending(self):
+        return [u for u, r in self._reqs.items()
+                if r.state in (QUEUED, PREFILL, DECODE)]
+
+    def __len__(self):
+        return len(self.pending())
+
+    def health(self):
+        """Fleet snapshot: per-replica engine health + breaker state,
+        plus the router's own counters."""
+        reps = {}
+        for rep in self._replicas:
+            br = rep.breaker
+            entry = {"state": rep.state, "breaker": br.state,
+                     "failures": br.failures, "kills": rep.kills,
+                     "swaps": rep.swaps, "last_error": br.last_error,
+                     "assigned": len(self._assigned[rep.name])}
+            if br.state != "open":
+                try:
+                    entry.update(rep.headroom())
+                except Exception as e:  # health must never throw
+                    entry["health_error"] = f"{type(e).__name__}: {e}"
+            reps[rep.name] = entry
+        states = collections.Counter(r.state for r in self._reqs.values())
+        return {
+            "replicas": reps,
+            "held": len(self._held),
+            "pending": len(self.pending()),
+            "done": states[DONE],
+            "failed": states[FAILED],
+            "steps": self.steps,
+            "failovers": self.failovers,
+            "requeued": self.requeued,
+            "duplicates_dropped": self.duplicates_dropped,
+            "probes": self.probes,
+            "hot_swaps": self.hot_swaps,
+            "swap_rollbacks": self.swap_rollbacks,
+        }
+
+    # -- weight hot-swap ---------------------------------------------------
+    def save_weights_snapshot(self, path, step=None):
+        """Snapshot the fleet's CURRENT weights (from the first
+        non-quarantined replica — homogeneous by construction) through
+        the atomic CRC32-manifest checkpoint layer; the artifact a
+        later hot_swap() loads and verifies."""
+        for rep in self._replicas:
+            if rep.breaker.state != "open":
+                return rep.save_weights_snapshot(path, step=step)
+        raise ReplicaFailedError(
+            "every replica is quarantined — no healthy weights to "
+            "snapshot")
+
+    def hot_swap(self, path):
+        """Zero-downtime rolling weight swap: for each replica — hold
+        its queue, MIGRATE its running requests to the other replicas,
+        load + verify `path` through the atomic CRC32-manifest
+        checkpoint layer, flip at a block boundary, re-admit. Serving
+        never stops: the other replicas keep stepping traffic (and
+        absorb the migrations). On CheckpointCorruptError (or any
+        load/flip error) every already-flipped replica is rolled back
+        to the old weights — the fleet finishes the call either fully
+        on the new snapshot or fully on the old one, never mixed —
+        and HotSwapError is raised with the cause chained.
+
+        Quarantined replicas are skipped (flagged in the summary); a
+        later successful probe re-admits them still on the old weights,
+        so re-run hot_swap after recovery if the fleet must converge.
+        Replicas an operator already put in DRAINING (drain_replica)
+        are likewise skipped and LEFT draining — a deploy must not
+        silently un-drain a maintenance hold. Returns {replica_name:
+        "swapped" | "skipped-quarantined" | "skipped-draining"}."""
+        flipped = []                    # (replica, old_weights)
+        drained_here = set()            # replicas THIS call set DRAINING
+        summary = {}
+        try:
+            for rep in self._replicas:
+                if rep.breaker.state == "open":
+                    summary[rep.name] = "skipped-quarantined"
+                    continue
+                if rep.state == DRAINING:
+                    summary[rep.name] = "skipped-draining"
+                    continue
+                rep.state = DRAINING    # routing skips it from here on
+                drained_here.add(rep.name)
+                self._migrate_running(rep)
+                old = rep.export_weights()
+                new = rep.load_weights_snapshot(path)   # CRC32 + shapes
+                rep.install_weights(new)                # block boundary
+                flipped.append((rep, old))
+                rep.state = ACTIVE
+                summary[rep.name] = "swapped"
+        except Exception as e:
+            for rep, old in flipped:
+                rep.state = DRAINING
+                self._migrate_running(rep)      # should be none; safety
+                rep.install_weights(old)
+            self.swap_rollbacks += 1
+            for rep in self._replicas:
+                if rep.state == DRAINING and rep.name in drained_here:
+                    rep.state = ACTIVE  # operator-drained stay drained
+            raise HotSwapError(
+                f"hot swap of {path!r} aborted "
+                f"({type(e).__name__}: {e}); all replicas rolled back "
+                "to the previous weights, serving continued") from e
+        self.hot_swaps += 1
+        return summary
+
+    def drain_replica(self, name):
+        """Graceful drain without a swap: hold the replica's queue and
+        migrate its running requests to the rest of the fleet. The
+        replica stays DRAINING (no new traffic) until activate()."""
+        rep = self._by_name[name]
+        rep.state = DRAINING
+        self._migrate_running(rep)
+        return rep
+
+    def activate(self, name):
+        self._by_name[name].state = ACTIVE
+
+    # -- routing -----------------------------------------------------------
+    def _routable(self, exclude=()):
+        """Replicas that may take NEW work, healthiest first: fewest
+        queued, most free slots, most free pages; half-open breakers
+        rank after closed ones (trial traffic only when the healthy
+        fleet is full); a rotating tie-break spreads exact ties instead
+        of piling them on r0. `exclude`d replicas are skipped ENTIRELY
+        — no heartbeat, no headroom read — so salvaging a dying replica
+        never re-heartbeats it and double-charges its breaker for one
+        logical failure."""
+        cand = []
+        n = len(self._replicas)
+        for i, rep in enumerate(self._replicas):
+            if rep.name in exclude or rep.state != ACTIVE or \
+                    rep.breaker.state == "open":
+                continue
+            try:
+                fault_point("replica.heartbeat", detail=rep.name)
+                h = rep.headroom()
+            except Exception as e:
+                self._on_replica_failure(rep, e)
+                continue
+            cand.append((rep.breaker.state == "half_open", h["queued"],
+                         h["running"] - h["slots_total"], -h["pages_free"],
+                         (i - self._rr) % n, rep))
+        cand.sort(key=lambda t: t[:5])
+        self._rr += 1
+        return [t[-1] for t in cand]
+
+    def _route(self, rr, spec, exclude=(), internal=False):
+        """Place a request (fresh or re-queued) on the best replica; if
+        none can take it, hold it at the router (bounded) rather than
+        drop it.
+
+        internal=True (failover/migration/held re-routing) NEVER
+        raises: backpressure and limits only apply to fresh admissions —
+        a salvaged request that cannot be placed right now is held
+        unconditionally (dropping it would break zero-loss), and one no
+        replica can EVER take fails at the router instead of aborting
+        the salvage loop that is resolving its replica's death."""
+        last_busy = None
+        for rep in self._routable(exclude):
+            try:
+                fault_point("replica.admit", detail=rep.name)
+                euid = rep.submit(spec)
+            except (EngineBusyError, ValueError) as e:
+                # ValueError = this engine can't EVER take it (length
+                # beyond max_len) — with homogeneous replicas that is a
+                # caller error on fresh admissions
+                if isinstance(e, ValueError):
+                    if internal:
+                        self._deliver(rr.uid, failure=RequestFailure(
+                            rr.uid, "capacity", e, self.steps))
+                        return False
+                    raise
+                last_busy = e
+                continue
+            except Exception as e:      # InjectedFault or real
+                self._on_replica_failure(rep, e)
+                continue
+            rr.replica, rr.engine_uid = rep.name, euid
+            rr.state = QUEUED
+            self._assigned[rep.name].add(rr.uid)
+            # keep the submitted spec: if the replica later dies with
+            # unreadable host state, failover re-submits THIS spec (work
+            # since then is recomputed; delivery stays exactly-once)
+            self._specs[rr.uid] = spec
+            return True
+        if not internal:
+            if last_busy is not None and not self._held and \
+                    all(r.breaker.state != "open" and r.state == ACTIVE
+                        for r in self._replicas):
+                # every replica is healthy but at queue_limit: surface
+                # the engines' own backpressure instead of absorbing it
+                raise last_busy
+            if self.hold_limit is not None and \
+                    len(self._held) >= self.hold_limit:
+                raise NoReplicaAvailableError(
+                    f"no replica can take this request "
+                    f"({len(self._held)} already held at "
+                    f"hold_limit={self.hold_limit}); retry later")
+        self._specs[rr.uid] = spec
+        rr.replica, rr.engine_uid = None, None
+        rr.state = QUEUED
+        self._held.append(rr.uid)
+        return False
+
+    def _flush_held(self):
+        for _ in range(len(self._held)):
+            ruid = self._held.popleft()
+            rr = self._reqs[ruid]
+            if rr.state not in (QUEUED,) or ruid not in self._specs:
+                continue
+            # re-holds on failure; never raises (these requests were
+            # already admitted once — backpressure applies to fresh
+            # admissions only)
+            self._route(rr, self._specs[ruid], internal=True)
+
+    # -- delivery (exactly-once) -------------------------------------------
+    def _deliver(self, ruid, result=None, failure=None):
+        """Commit a terminal outcome for a router uid EXACTLY ONCE: the
+        first delivery wins, every later one (a replica replaying its
+        results after a failover, an injected duplicate) is counted and
+        dropped."""
+        rr = self._reqs.get(ruid)
+        if rr is None:
+            return False
+        if rr.state in (DONE, FAILED):
+            self.duplicates_dropped += 1
+            return False
+        if rr.replica is not None:
+            self._assigned[rr.replica].discard(ruid)
+        rr.replica, rr.engine_uid = None, None
+        if failure is not None:
+            rr.state, rr.failure = FAILED, failure
+        else:
+            rr.state, rr.result = DONE, result
+        self._specs.pop(ruid, None)
+        return True
+
+    def _collect(self, rep):
+        """Pull terminal outcomes from a replica into the router ledger
+        (and mirror live states for status())."""
+        for ruid in list(self._assigned[rep.name]):
+            rr = self._reqs[ruid]
+            try:
+                st = rep.status(rr.engine_uid)
+            except UnknownRequestError:
+                continue
+            if st == DONE:
+                self._deliver(ruid, result=rep.result(rr.engine_uid))
+            elif st in (FAILED, "cancelled"):
+                self._deliver(ruid, failure=rep.failure(rr.engine_uid))
+            else:
+                rr.state = st
+        return None
+
+    # -- failover ----------------------------------------------------------
+    def _salvage_one(self, rep, ruid, keep_queued=False):
+        """Resolve ONE request assigned to a dead/draining replica —
+        the single triage shared by failover and migration. Finished
+        work delivers (exactly-once, never recomputed), per-request
+        failures (deadline/cancel/poison) surface, live work re-queues
+        on the rest of the fleet with its generated tokens folded into
+        the prompt. keep_queued=True (migration) leaves engine-queued
+        requests in place — they carry no KV, so they hold through a
+        weight flip. Never raises."""
+        rr = self._reqs[ruid]
+        if ruid not in self._assigned[rep.name] or \
+                rr.replica != rep.name:
+            # REENTRANCY: re-routing a salvaged request reads other
+            # replicas' health, whose fault points can declare THIS
+            # replica dead again in a nested handler that already moved
+            # this ruid — processing the stale snapshot entry would
+            # re-queue it twice and evict whichever innocent request
+            # now owns its old engine uid here
+            return
+        salvage = None
+        try:
+            st = rep.status(rr.engine_uid)
+            if st == DONE:
+                # completed before the failure but not yet collected:
+                # deliver, don't re-run (exactly-once)
+                self._deliver(ruid, result=rep.result(rr.engine_uid))
+                return
+            if st in (FAILED, "cancelled"):
+                fl = rep.failure(rr.engine_uid)
+                if fl is not None and fl.stage != "engine":
+                    # the REQUEST failed (deadline/cancel/poison), not
+                    # the replica — failover must not resurrect it
+                    self._deliver(ruid, failure=fl)
+                    return
+                # stage=="engine": the replica's pools died under it —
+                # its committed tokens are still in the record's host
+                # state; fall through to re-queue
+            elif st == QUEUED and keep_queued:
+                return
+            salvage = rep.export_resume(rr.engine_uid)
+        except Exception:
+            # replica host state unreadable: re-submit the LAST known
+            # spec (original prompt if never re-queued) — tokens may be
+            # recomputed but never delivered twice
+            salvage = self._specs.get(ruid)
+        self._assigned[rep.name].discard(ruid)
+        rep.evict(rr.engine_uid)
+        rr.replica, rr.engine_uid = None, None
+        rr.state = QUEUED
+        if salvage is None:
+            self._deliver(ruid, failure=RequestFailure(
+                ruid, "replica",
+                ReplicaFailedError(
+                    f"replica {rep.name} died and the request could "
+                    "not be salvaged"), self.steps))
+            return
+        rr.requeues += 1
+        self.requeued += 1
+        self._route(rr, self._clean_spec(salvage), exclude=(rep.name,),
+                    internal=True)
+
+    def _on_replica_failure(self, rep, exc):
+        """Declare a replica dead for its CURRENT work: salvage every
+        assigned request, then charge the breaker. The replica object
+        itself stays usable — a fault-point kill leaves the engine
+        intact minus the evicted requests, a real dispatch error
+        already rebuilt its pools — so a closed/half-open breaker lets
+        it take fresh traffic next step, and an open one routes it
+        through quarantine probes instead."""
+        rep.kills += 1
+        self.failovers += 1
+        for ruid in list(self._assigned[rep.name]):
+            self._salvage_one(rep, ruid)
+        rep.breaker.record_failure(exc, self.steps)
+
+    @staticmethod
+    def _clean_spec(spec):
+        """export_request payload -> submit_resume payload (drop the
+        source engine's bookkeeping keys)."""
+        return {k: spec[k] for k in
+                ("prompt", "max_new_tokens", "eos_token_id", "tenant",
+                 "priority", "ttl_steps", "deadline") if k in spec}
+
+    def _migrate_running(self, rep):
+        """Hot-swap/drain helper: move a DRAINING replica's admitted
+        (prefill/decode) requests to the rest of the fleet so the
+        weight flip sees empty slots. Queued requests HOLD on the
+        replica through the flip (they carry no KV) — that is the
+        'queue held at the block boundary' contract."""
+        for ruid in list(self._assigned[rep.name]):
+            self._salvage_one(rep, ruid, keep_queued=True)
+
+    def _fail_stuck_head(self, rep, exc):
+        """EngineFullError on an idle replica: the queue-head request
+        can NEVER fit — fail that ONE request at the router (it would
+        never fit any homogeneous replica either) and keep the replica
+        serving."""
+        euid = rep.queue_head_uid()
+        ruid = next((u for u in self._assigned[rep.name]
+                     if self._reqs[u].engine_uid == euid), None)
+        if ruid is None:
+            return
+        self._assigned[rep.name].discard(ruid)
+        rep.evict(euid)
+        self._deliver(ruid, failure=RequestFailure(
+            ruid, "capacity", exc, self.steps))
+
+    # -- quarantine probes -------------------------------------------------
+    def _probe(self, rep):
+        """Bounded re-admission probe for an open breaker: heartbeat
+        the replica (its OWN fault point, so chaos runs exercise probe
+        failure too) and check it answers health sanely, under
+        retry_with_backoff's seeded-jitter schedule. Success -> the
+        breaker goes half-open (trial traffic); RetriesExhaustedError
+        -> it reopens with a doubled backoff — and after
+        REBUILD_AFTER_PROBES consecutive exhausted probe series the
+        engine object itself is presumed wrecked and rebuilt from the
+        factory (any still-assigned requests are salvaged first: a
+        rebuild resets the engine's uid space, so their host state
+        would otherwise be unreachable). Never raises."""
+        self.probes += 1
+
+        def attempt():
+            fault_point("replica.heartbeat", detail=f"{rep.name}:probe")
+            h = rep.health()
+            if not isinstance(h, dict) or "pages_free" not in h:
+                raise ReplicaFailedError(
+                    f"replica {rep.name} probe returned a malformed "
+                    f"health snapshot: {type(h).__name__}")
+            return h
+
+        try:
+            retry_with_backoff(attempt, **self._probe_kw)
+        except RetriesExhaustedError as e:
+            rep.breaker.last_error = str(e)
+            rep.breaker.record_probe_failure(self.steps)
+            rep.failed_probes += 1
+            if rep.failed_probes >= self.REBUILD_AFTER_PROBES:
+                for ruid in list(self._assigned[rep.name]):
+                    self._salvage_one(rep, ruid)
+                try:
+                    rep.rebuild()
+                except Exception as re_exc:  # factory itself broken:
+                    rep.breaker.last_error = (   # keep probing, the
+                        f"rebuild failed: {type(re_exc).__name__}: "
+                        f"{re_exc}")             # breaker stays open
+                else:
+                    rep.failed_probes = 0
+            return False
+        rep.failed_probes = 0
+        rep.breaker.record_probe_success()
+        return True

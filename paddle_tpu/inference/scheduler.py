@@ -802,6 +802,17 @@ class ContinuousBatchingEngine(LLMEngine):
         return sum(1 for r in self._requests.values()
                    if r.state in (QUEUED, PREFILL, DECODE))
 
+    def headroom(self):
+        """O(1) routing snapshot — the subset of health() a router's
+        admission path polls once per request. health() walks the full
+        request history (it Counters every request this engine has ever
+        seen) and is for monitors; this is for the hot path."""
+        return {"queued": len(self._queue),
+                "running": sum(1 for s in self._slots if s is not None),
+                "slots_total": self.max_batch,
+                "pages_free": self.allocator.available,
+                "pages_total": self.allocator.n_pages}
+
     def health(self):
         """One serving-health snapshot (cheap; safe to poll): queue and
         slot occupancy, page-pool headroom, prefix-cache state, and the
@@ -2032,6 +2043,96 @@ class ContinuousBatchingEngine(LLMEngine):
         if (r.eos_token_id is not None and tok == r.eos_token_id) or \
                 len(r.out) >= r.max_new_tokens:
             self._retire(r)
+
+    # -- replica boundary: in-flight export + weight flip --------------------
+    def export_request(self, uid):
+        """Resume spec for one request — everything a DIFFERENT engine
+        needs to continue it from its last committed token: the folded
+        prompt (original ids + tokens generated so far — exactly the
+        preemption fold, so a greedy continuation is byte-identical to
+        an uninterrupted run), the REMAINING budget, and the admission
+        identity (eos/tenant/priority/deadline/remaining TTL). Only
+        meaningful for LIVE requests (queued/prefill/decode) and
+        engine-stage failures — the states failover re-queues; a
+        finished request's output must be read via result(), never
+        regenerated from a spec (`state` rides along so callers can
+        tell, and submit_resume rejects a spent budget)."""
+        r = self._requests.get(uid)
+        if r is None:
+            raise UnknownRequestError(f"unknown request uid {uid}")
+        prompt = (np.concatenate([r.ids, np.asarray(r.out, np.int64)])
+                  if r.out else r.ids.copy())
+        ttl = r.ttl_steps
+        if ttl is not None:
+            ttl = max(0, ttl - (self.steps - r.born_step))
+        return {
+            "uid": uid,
+            "state": r.state,
+            "prompt": prompt,
+            "generated": len(r.out),
+            "max_new_tokens": r.max_new_tokens - len(r.out),
+            "eos_token_id": r.eos_token_id,
+            "tenant": r.tenant,
+            "priority": r.priority,
+            "ttl_steps": ttl,
+            "deadline": r.deadline,        # absolute monotonic cutoff
+        }
+
+    def export_inflight(self):
+        """Resume specs for every request still queued or in flight
+        (submission order) — the payload a router salvages when this
+        replica is declared dead."""
+        return [self.export_request(u)
+                for u, r in self._requests.items()
+                if r.state in (QUEUED, PREFILL, DECODE)]
+
+    def submit_resume(self, spec):
+        """Admit an export_request spec into THIS engine. The folded
+        prompt re-prefills (usually through published prefix pages) and
+        the continuation proceeds under the remaining budget — greedy
+        outputs byte-identical to the uninterrupted run (the preemption
+        contract, pinned in tests). Returns this engine's uid for it."""
+        deadline_ms = None
+        if spec.get("deadline") is not None:
+            # absolute -> relative; an already-expired deadline admits
+            # and is shed by the next _expire_deadlines sweep (the
+            # same outcome the original engine would have reached)
+            deadline_ms = max(
+                0.0, (spec["deadline"] - time.monotonic()) * 1e3)
+        return self.add_request(
+            spec["prompt"], max_new_tokens=spec["max_new_tokens"],
+            eos_token_id=spec["eos_token_id"], deadline_ms=deadline_ms,
+            ttl_steps=spec["ttl_steps"], tenant=spec["tenant"],
+            priority=spec["priority"])
+
+    def install_weights(self, new):
+        """Zero-downtime flip, gated at a BLOCK BOUNDARY: no slot may
+        hold in-flight KV (cache contents computed under the old
+        weights would silently corrupt continuations), so callers drain
+        or migrate running requests first — EngineBusyError here is the
+        backpressure signal, not a failure. Queued (not yet admitted)
+        requests HOLD through the flip and run under the new weights.
+        The prefix cache is dropped with the old weights (its pages are
+        old-weight KV); the megakernel repack is rebuilt."""
+        busy = [r.uid for r in self._slots if r is not None]
+        if busy:
+            raise EngineBusyError(
+                f"install_weights with {len(busy)} request(s) in flight "
+                f"(uids {busy}): their KV was computed under the OLD "
+                "weights — drain or migrate them first (the router's "
+                "hot_swap does)")
+        super().install_weights(new)
+        if self._prefix is not None:
+            self._prefix.clear(self.allocator)
+        if self.megakernel:
+            from ..ops.pallas.decode_megakernel import (pack_decode_layer,
+                                                        stack_packed)
+            packed = [pack_decode_layer(ws, cdtype=self.kv_dtype)
+                      for ws in self.weights["layers"]]
+            self.weights["mk"] = (stack_packed(packed)
+                                  if self.megakernel == "multi"
+                                  else packed)
+        return self
 
     # -- retirement / failure ----------------------------------------------
     def _expire_deadlines(self):

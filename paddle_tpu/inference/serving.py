@@ -470,6 +470,52 @@ class LLMEngine:
         self.v_pages = [jnp.zeros(shape, self.kv_dtype) for _ in range(L)]
         self.allocator = PageAllocator(self.n_pages)
 
+    # -- weight snapshots (zero-downtime hot-swap substrate) ----------------
+    # Derived/config entries are rebuilt at install, never serialized:
+    # rope tables and eps come from the config ("eps" as a python float
+    # stays WEAK-typed inside _rms — a round-tripped f64 array would
+    # promote the norm math and bit-drift greedy outputs), "mk" is the
+    # megakernel repack.
+    _DERIVED_WEIGHT_KEYS = ("cos", "sin", "eps", "mk")
+
+    def export_weights(self):
+        """The engine's serializable weight pytree: everything the model
+        snapshot holds except derived entries (rope tables, megakernel
+        repacks — rebuilt by install_weights)."""
+        return {k: v for k, v in self.weights.items()
+                if k not in self._DERIVED_WEIGHT_KEYS}
+
+    def save_weights_snapshot(self, path, step=None):
+        """Atomic CRC32-manifest save of the CURRENT weights (the
+        artifact a later hot-swap loads and verifies)."""
+        from ..distributed import checkpoint as ckpt
+        ckpt.save_snapshot(self.export_weights(), path, step=step)
+        return path
+
+    def load_weights_snapshot(self, path):
+        """Load + verify (CRC32, tree structure, per-leaf shapes) a
+        snapshot against THIS engine's weight tree without installing
+        it. Raises CheckpointCorruptError before the engine is touched;
+        the flip itself is install_weights."""
+        from ..distributed import checkpoint as ckpt
+        return ckpt.load_snapshot_for(self.export_weights(), path)
+
+    def install_weights(self, new):
+        """Flip the serving weights to `new` (an export_weights-shaped
+        pytree, e.g. from load_weights_snapshot). The jitted programs
+        take weights as an ARGUMENT pytree, so the flip needs no
+        recompilation — the next dispatch simply runs the new values.
+        Derived entries (rope tables) are preserved; subclasses rebuild
+        theirs (megakernel repack) and gate the flip at a safe point."""
+        cur = self.export_weights()
+        if (jax.tree_util.tree_structure(cur)
+                != jax.tree_util.tree_structure(new)):
+            raise ValueError(
+                "install_weights: snapshot tree structure does not match "
+                "this engine's weights (different quant/layer layout?)")
+        self.weights.update(new)
+        return self
+
     # -- public -------------------------------------------------------------
     def generate(self, input_ids, max_new_tokens=32, eos_token_id=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,

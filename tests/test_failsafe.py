@@ -199,3 +199,61 @@ class TestRetryWithBackoff:
             out = retry_with_backoff(lambda: fault_point("t.retry") or 7,
                                      retries=2, sleep=lambda _: None)
         assert out == 7
+
+    def test_seeded_jitter_is_deterministic(self):
+        def dead():
+            raise OSError("x")
+
+        schedules = []
+        for _ in range(2):
+            slept = []
+            with pytest.raises(OSError):
+                retry_with_backoff(dead, retries=3, base_delay=0.1,
+                                   jitter=0.5, seed=7,
+                                   sleep=slept.append)
+            schedules.append(slept)
+        # same seed -> bit-identical schedule, every delay inflated by
+        # (0, jitter*delay]
+        assert schedules[0] == schedules[1]
+        for base, got in zip([0.1, 0.2, 0.4], schedules[0]):
+            assert base < got <= base * 1.5
+        slept9 = []
+        with pytest.raises(OSError):
+            retry_with_backoff(dead, retries=3, base_delay=0.1,
+                               jitter=0.5, seed=9, sleep=slept9.append)
+        assert slept9 != schedules[0]     # different seed, different spread
+
+    def test_max_elapsed_cap_raises_typed(self):
+        from paddle_tpu.failsafe import RetriesExhaustedError
+
+        def dead():
+            raise ConnectionError("still down")
+
+        slept = []
+        with pytest.raises(RetriesExhaustedError) as ei:
+            retry_with_backoff(dead, retries=10, base_delay=1.0,
+                               factor=2.0, max_delay=100.0,
+                               max_elapsed=5.0, sleep=slept.append)
+        # 1 + 2 slept (3.0); the next 4.0 would exceed the 5.0 cap
+        assert slept == [1.0, 2.0]
+        assert isinstance(ei.value.last_exception, ConnectionError)
+        assert ei.value.attempts == 3
+        assert ei.value.elapsed == 3.0
+        assert isinstance(ei.value.__cause__, ConnectionError)
+
+    def test_raise_exhausted_types_the_budget_exit(self):
+        from paddle_tpu.failsafe import RetriesExhaustedError
+
+        def dead():
+            raise OSError("down")
+
+        with pytest.raises(RetriesExhaustedError) as ei:
+            retry_with_backoff(dead, retries=2, base_delay=0.01,
+                               raise_exhausted=True,
+                               sleep=lambda _: None)
+        assert ei.value.attempts == 3
+        assert isinstance(ei.value.last_exception, OSError)
+        # default stays the legacy contract: the last error re-raises
+        with pytest.raises(OSError):
+            retry_with_backoff(dead, retries=2, base_delay=0.01,
+                               sleep=lambda _: None)

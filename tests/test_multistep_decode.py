@@ -161,11 +161,28 @@ class TestFusedEquivalence:
 
     def test_prefix_share_and_cow_fused(self, tiny):
         model, cfg = tiny
+        # ROOT CAUSE of the PR 7 "flake": not leaked engine state — the
+        # engine path is deterministic (no wall-clock, no sampling,
+        # per-engine cache/allocator; isolation pinned by
+        # test_prefix_cow_isolated_from_cross_engine_state below). This
+        # test's WALL TIME sat at the conftest 15s per-test enforcement
+        # boundary (fresh K=8 fused-scan compiles at a one-off
+        # page_size=4 geometry: ~19s cold, ~13s warm) — under suite
+        # load the budget guard tripped and FAILED THE RUN naming this
+        # test, which reads exactly like a one-off in-suite test
+        # failure and reproduces nowhere quiet. Fixed by shrinking the
+        # compile surface (K=4, max_len=32 — same fused share/CoW/
+        # partial-page-hit coverage, half the scan). The armed-fault
+        # precondition stays as a loud diagnostic for the one suite
+        # state that COULD corrupt this test.
+        assert not failsafe.armed(), (
+            "fault specs leaked into this test from an earlier one: "
+            f"{sorted(failsafe.armed())}")
         rng = np.random.RandomState(17)
         base = rng.randint(0, cfg.vocab_size, (12,)).astype(np.int64)
         # page_size 4: three full prompt pages publish and the re-run
         # lands a partial-page hit on the tail page -> exactly one CoW
-        cb = mk(model, 8, max_batch=2, page_size=4)
+        cb = mk(model, 4, max_batch=2, page_size=4, max_len=32)
         uA = cb.add_request(base, max_new_tokens=5)
         cb.drain()
         uB = cb.add_request(base.copy(), max_new_tokens=5)
@@ -174,6 +191,50 @@ class TestFusedEquivalence:
         assert cb.cow_copies == 1
         assert cb._requests[uB].pages_shared >= 1
         assert_no_leak(cb)
+
+    @pytest.mark.slow
+    def test_prefix_cow_isolated_from_cross_engine_state(self, tiny,
+                                                         cb1, cb8):
+        """Regression pin for the PR 7 flake class: a fresh engine's
+        prefix-share/CoW/allocator behavior must be bit-for-bit
+        independent of (a) OTHER engines having served the same token
+        content (the caches are content-addressed — a global registry
+        would cross-match), (b) fault contexts armed and disarmed
+        around it, and (c) the module engines' accumulated cache state.
+        Runs the exact scenario of test_prefix_share_and_cow_fused
+        twice under maximal interference and asserts identical
+        telemetry + bytes."""
+        model, cfg = tiny
+        rng = np.random.RandomState(17)
+        base = rng.randint(0, cfg.vocab_size, (12,)).astype(np.int64)
+
+        def scenario():
+            cb = mk(model, 4, max_batch=2, page_size=4, max_len=32)
+            uA = cb.add_request(base, max_new_tokens=5)
+            cb.drain()
+            uB = cb.add_request(base.copy(), max_new_tokens=5)
+            cb.drain()
+            out = (cb.result(uA).copy(), cb.result(uB).copy())
+            tele = (cb.cow_copies, cb._requests[uB].pages_shared,
+                    cb._prefix.hits, len(cb._prefix),
+                    cb.allocator.available, cb.allocator.total_allocs)
+            assert_no_leak(cb)
+            return out, tele
+
+        (a0, b0), tele0 = scenario()
+        # interference: the SAME content through a different engine
+        # (same page_size so the chain keys match if anything global
+        # exists), plus armed-then-disarmed faults around a run
+        other = mk(model, 4, max_batch=2, page_size=4, max_len=32)
+        other.generate_many([base, base[:7]], max_new_tokens=[5, 4])
+        with failsafe.inject("cb.decode", nth=999), \
+                failsafe.inject("page.alloc", p=0.0, seed=1):
+            other.generate_many([base], max_new_tokens=[3])
+        assert not failsafe.armed()
+        (a1, b1), tele1 = scenario()
+        np.testing.assert_array_equal(a0, a1)
+        np.testing.assert_array_equal(b0, b1)
+        assert tele0 == tele1, (tele0, tele1)
 
     def test_single_token_budget_fused(self, tiny, cb1, cb8):
         """max_new_tokens=1: the only token comes from the prefill

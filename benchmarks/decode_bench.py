@@ -91,6 +91,15 @@ def _nominal_bw_gbps():
 
 
 def main():
+    # the TP sweep below needs >1 host device on the CPU backend; the
+    # flag must land BEFORE jax import (the conftest idiom — rewrite
+    # any inherited value rather than skip it)
+    import re as _re
+    _flags = _re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                     os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        _flags.strip() + " --xla_force_host_platform_device_count=8"
+    ).strip()
     import jax
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -640,6 +649,165 @@ def main():
         })
     except Exception as e:  # noqa: BLE001 — bench must stay rc=0
         _emit({"metric": "cb_failover", "value": 0.0, "unit": "tokens/s",
+               "error": f"{type(e).__name__}: {e}"})
+
+    # -- tensor-parallel decode + disaggregated handoff ------------------
+    # Two numbers for ISSUE 10 (docs/serving.md "Sharded decode &
+    # disaggregated prefill"): cb_tp_tokens_per_sec at tp=1 vs tp=2/4 on
+    # the mesh (CPU host devices here — the value is protocol/accounting
+    # evidence plus the in-bench byte-identity assertion; TPU carries
+    # the wall-clock claim, where the same programs run over ICI), with
+    # tp_allreduce_frac = the measured per-step collective share (a
+    # microbenched all_gather of the exact-mode reassembly shapes over
+    # the same mesh, divided into the measured step wall). And
+    # prefill_handoff_ms — the export→import→commit wall of moving one
+    # prefilled request between engines (the latency a disaggregated
+    # topology pays INSTEAD of a decode-worker re-prefill).
+    # shared setup for BOTH sections below (hoisted out of the TP try:
+    # the handoff metric needs none of the TP machinery and must not
+    # die to a TP-section failure)
+    paddle.seed(0)
+    tp_cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                         intermediate_size=128, num_hidden_layers=1,
+                         num_attention_heads=4,
+                         max_position_embeddings=128)
+    tp_model = LlamaForCausalLM(tp_cfg)
+    tp_kw = dict(max_len=64, page_size=16, max_batch=4,
+                 slot_buckets=(4,), megakernel=False)
+    tp_rng = np.random.RandomState(31)
+    tp_prompts = [tp_rng.randint(0, tp_cfg.vocab_size, int(t))
+                  .astype(np.int64)
+                  for t in tp_rng.randint(6, 16, 8)]
+    tp_new = 16
+    try:
+        import jax.numpy as jnp
+        from paddle_tpu.jax_compat import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        n_dev = len(jax.devices())
+        tp_ref = None
+        for tp in (1, 2, 4):
+            if tp > n_dev or tp_cfg.num_attention_heads % tp:
+                # emit the cap LOUDLY: a silently missing sweep line
+                # reads as "TP was exercised" when it was not
+                _emit({"metric": "cb_tp_tokens_per_sec", "tp": tp,
+                       "value": 0.0, "unit": "tokens/s",
+                       "skipped": f"needs {tp} devices / head-divisible"
+                                  f" geometry (visible devices: "
+                                  f"{n_dev})"})
+                continue
+            eng = None
+            eng = ContinuousBatchingEngine(tp_model, tp=tp, **tp_kw)
+            warm = [tp_rng.randint(0, tp_cfg.vocab_size, 6)
+                    .astype(np.int64) for _ in range(tp_kw["max_batch"])]
+            eng.generate_many(warm, max_new_tokens=4)
+            steps0 = eng.decode_steps
+            t0_ = time.perf_counter()
+            outs = eng.generate_many(tp_prompts, max_new_tokens=tp_new)
+            wall = time.perf_counter() - t0_
+            toks = sum(o.size for o in outs) \
+                - sum(p.size for p in tp_prompts)
+            d_steps = max(1, eng.decode_steps - steps0)
+            if tp == 1:
+                tp_ref = outs
+                frac = 0.0
+            else:
+                # greedy byte-identity sharded-vs-unsharded, asserted
+                # IN-BENCH (the test-suite bar, re-checked where the
+                # numbers are made)
+                for i, (a, b) in enumerate(zip(tp_ref, outs)):
+                    assert a.shape == b.shape and (a == b).all(), (
+                        f"tp={tp} diverged from the unsharded engine at "
+                        f"request {i} — greedy outputs must be "
+                        "byte-identical")
+                # microbench the exact-mode reassembly collectives at
+                # the real decode shapes: per layer, one head gather
+                # [w, 1, nh_l, hd] and one activation gather
+                # [w, 1, ffn/tp]
+                mesh = eng._tpc.mesh
+                w = tp_kw["max_batch"]
+                nh_l = tp_cfg.num_attention_heads // tp
+                hd = tp_cfg.hidden_size // tp_cfg.num_attention_heads
+                ffn_l = tp_cfg.intermediate_size // tp
+
+                def gathers(a, b):
+                    return (jax.lax.all_gather(a, "mp", axis=2,
+                                               tiled=True),
+                            jax.lax.all_gather(b, "mp", axis=2,
+                                               tiled=True))
+
+                gfn = jax.jit(shard_map(
+                    gathers, mesh=mesh,
+                    in_specs=(P(None, None, "mp", None),
+                              P(None, None, "mp")),
+                    out_specs=(P(), P()), check_vma=False))
+                xa = jnp.zeros((w, 1, nh_l * tp, hd), jnp.float32)
+                xb = jnp.zeros((w, 1, ffn_l * tp), jnp.float32)
+                ga, gb = gfn(xa, xb)
+                jax.block_until_ready(ga)
+                t0_ = time.perf_counter()
+                for _ in range(20):
+                    ga, gb = gfn(xa, xb)
+                jax.block_until_ready(ga)
+                t_coll = (time.perf_counter() - t0_) / 20 \
+                    * tp_cfg.num_hidden_layers
+                frac = min(1.0, t_coll * d_steps / max(wall, 1e-9))
+            _emit({
+                "metric": "cb_tp_tokens_per_sec",
+                "model": "llama-micro", "tp": tp,
+                "tp_mode": "exact" if tp > 1 else None,
+                "requests": len(tp_prompts),
+                "decode_steps": d_steps,
+                "value": round(toks / max(wall, 1e-9), 2),
+                "tp_allreduce_frac": round(frac, 4),
+                "unit": "tokens/s",
+            })
+
+    except Exception as e:  # noqa: BLE001 — bench must stay rc=0
+        _emit({"metric": "cb_tp_tokens_per_sec", "value": 0.0,
+               "unit": "tokens/s",
+               "error": f"{type(e).__name__}: {e}"})
+
+    # prefill->decode KV-page handoff latency — its OWN rc=0 guard so
+    # a handoff failure is reported under its own metric name, never
+    # as a fourth broken cb_tp line
+    try:
+        A = ContinuousBatchingEngine(tp_model, **tp_kw)
+        B = ContinuousBatchingEngine(tp_model, **tp_kw)
+        ref_eng = ContinuousBatchingEngine(tp_model, **tp_kw)
+        hand_prompt = tp_prompts[0]
+        u_ref = ref_eng.add_request(hand_prompt, max_new_tokens=tp_new)
+        ref_eng.drain()
+        hand_ref = ref_eng.result(u_ref)
+        # warm both engines' compiles so the timed region is handoff
+        # (% keeps the shifted warm prompt in-vocabulary)
+        warm_p = (hand_prompt + 1) % tp_cfg.vocab_size
+        A.generate_many([warm_p], max_new_tokens=2)
+        B.generate_many([warm_p], max_new_tokens=2)
+        ua = A.add_request(hand_prompt, max_new_tokens=tp_new)
+        while A.status(ua) != "decode":
+            A.step()
+        t0_ = time.perf_counter()
+        payload = A.export_kv_pages(ua)
+        ub = B.import_kv_pages(payload)
+        A.release_handoff(ua)
+        handoff_ms = (time.perf_counter() - t0_) * 1e3
+        B.drain()
+        assert np.array_equal(B.result(ub), hand_ref), (
+            "handoff continuation diverged from the single-engine run")
+        page_mb = sum(a.nbytes for a in payload["k"]) \
+            + sum(a.nbytes for a in payload["v"])
+        _emit({
+            "metric": "prefill_handoff_ms",
+            "model": "llama-micro",
+            "value": round(handoff_ms, 3),
+            "pages": len(payload["k"][0]),
+            "payload_mb": round(page_mb / 1e6, 4),
+            "unit": "ms",
+        })
+    except Exception as e:  # noqa: BLE001 — bench must stay rc=0
+        _emit({"metric": "prefill_handoff_ms", "value": 0.0,
+               "unit": "ms",
                "error": f"{type(e).__name__}: {e}"})
 
 

@@ -86,6 +86,32 @@ def main():
                          "when the path does not exist yet — a self-"
                          "contained round-trip demo); needs "
                          "--replicas >= 2")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="N > 1: tensor-parallel serving — ONE engine "
+                         "sharded over an N-device 'mp' mesh (heads + "
+                         "paged-KV pools sharded over heads, column/"
+                         "row-parallel matmuls under shard_map); greedy "
+                         "outputs byte-identical to tp=1 in the default "
+                         "exact mode (docs/serving.md \"Sharded decode "
+                         "& disaggregated prefill\")")
+    ap.add_argument("--tp-mode", choices=["exact", "psum"],
+                    default="exact",
+                    help="TP tail mode: 'exact' reassembles via "
+                         "all_gather (byte-identical), 'psum' runs the "
+                         "Megatron per-token all-reduce (wire-optimal, "
+                         "rtol-close)")
+    ap.add_argument("--tp-compress", choices=["none", "int8"],
+                    default="none",
+                    help="int8-quantize the psum-mode all-reduce "
+                         "(comm_compress.quantized_psum; ~4x fewer "
+                         "wire bytes)")
+    ap.add_argument("--disagg", metavar="P:D", default=None,
+                    help="disaggregated serving: P prefill workers + D "
+                         "decode workers behind the router — new "
+                         "requests prefill on the P pool and migrate at "
+                         "first-token via CRC-checked KV-page handoff "
+                         "(zero recompute; scheduler machinery, implies "
+                         "router mode)")
     ap.add_argument("--megakernel", choices=["auto", "off", "layer",
                                              "multi"], default="auto",
                     help="decode-layer Pallas megakernel: one fused "
@@ -126,9 +152,51 @@ def main():
         weight_dtype = None
 
     quant = None if args.quant == "none" else args.quant
+    tp_kw = {}
+    if args.tp > 1:
+        tp_kw = dict(tp=args.tp, tp_mode=args.tp_mode,
+                     tp_compress=(None if args.tp_compress == "none"
+                                  else args.tp_compress))
     if args.hot_swap and args.replicas < 2:
         ap.error("--hot-swap needs --replicas >= 2 (the router keeps "
                  "serving from the other replicas while one flips)")
+    if args.disagg:
+        # disaggregated prefill/decode: P prefill + D decode workers,
+        # requests migrate at first-token via KV-page handoff
+        from paddle_tpu.inference.router import EngineRouter
+        try:
+            p_n, d_n = (int(x) for x in args.disagg.split(":"))
+        except ValueError:
+            ap.error("--disagg expects P:D (e.g. --disagg 1:2)")
+
+        def factory():
+            return ContinuousBatchingEngine(
+                model, max_len=g["max_len"], page_size=g["page"],
+                max_batch=max(2, g["bs"]), quant=quant,
+                weight_dtype=weight_dtype,
+                decode_block=args.decode_block, **tp_kw)
+
+        router = EngineRouter(factory,
+                              topology={"prefill": p_n, "decode": d_n})
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, g["cfg"].vocab_size, (t,))
+                   .astype(np.int64) for t in (16, 9, 5, 12)]
+        uids = [router.add_request(p, max_new_tokens=args.max_new_tokens)
+                for p in prompts]
+        router.drain()
+        h = router.health()
+        print(f"model={args.model} quant={args.quant} disagg "
+              f"{p_n}:{d_n}: {h['done']} done / {h['failed']} failed, "
+              f"{h['kv_handoffs']} KV handoffs "
+              f"({h['handoff_failures']} retried)")
+        for name, rh in h["replicas"].items():
+            print(f"  {name} [{rh['role']}]: breaker={rh['breaker']} "
+                  f"pages_free={rh.get('pages_free')}")
+        for i, u in enumerate(uids):
+            o = router.result(u)
+            print(f"  request {i}: {prompts[i].size} -> {o.size} "
+                  f"tokens, tail {o[-4:].tolist()}")
+        return
     if args.replicas > 1:
         # fault-tolerant fleet: N replicas behind the health-checked
         # router — failover, quarantine, and (optionally) a mid-stream
@@ -140,7 +208,7 @@ def main():
                 model, max_len=g["max_len"], page_size=g["page"],
                 max_batch=max(2, g["bs"]), quant=quant,
                 weight_dtype=weight_dtype,
-                decode_block=args.decode_block)
+                decode_block=args.decode_block, **tp_kw)
 
         router = EngineRouter(factory, replicas=args.replicas)
         rng = np.random.RandomState(0)
@@ -186,10 +254,11 @@ def main():
             # EXPLICIT --megakernel layer/multi with --speculate lets
             # the engine raise its typed conflict error rather than
             # silently benchmarking the op-chain path
-            megakernel=(False if (args.speculate >= 2
+            megakernel=(False if ((args.speculate >= 2 or args.tp > 1)
                                   and args.megakernel == "auto") else
                         {"auto": None, "off": False}.get(args.megakernel,
-                                                         args.megakernel)))
+                                                         args.megakernel)),
+            **tp_kw)
         rng = np.random.RandomState(0)
         # ragged prompts; 1 shares 0's prefix (once 0 finishes prefill,
         # the cache turns the shared pages into refcounted read-only
@@ -246,7 +315,7 @@ def main():
     engine = LLMEngine(model, max_len=g["max_len"], page_size=g["page"],
                        max_batch=g["bs"],
                        quant=quant,
-                       weight_dtype=weight_dtype)
+                       weight_dtype=weight_dtype, **tp_kw)
 
     rng = np.random.RandomState(0)
     prompts = rng.randint(0, g["cfg"].vocab_size,

@@ -368,6 +368,11 @@ _SERVING_EXPORTS = {
     "EngineRouter": "router", "EngineReplica": "router",
     "CircuitBreaker": "router", "ReplicaFailedError": "router",
     "NoReplicaAvailableError": "router", "HotSwapError": "router",
+    # tensor-parallel serving (docs/serving.md "Sharded decode &
+    # disaggregated prefill")
+    "TPContext": "tp",
+    # KV-page handoff (disaggregated prefill/decode)
+    "KVHandoffError": "handoff", "StoreKVTransport": "handoff",
 }
 
 

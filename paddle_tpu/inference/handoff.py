@@ -1,0 +1,213 @@
+"""KV-page handoff: move a prefilled request between engines, no recompute.
+
+Disaggregated serving splits prefill (compute-bound, bursty) from decode
+(bandwidth-bound, steady) into separate worker pools (docs/serving.md
+"Sharded decode & disaggregated prefill"). The thing that makes the
+split cheap is this handoff: the prefill engine exports the request's
+KV pages (`ContinuousBatchingEngine.export_kv_pages`), the decode
+engine imports them (`import_kv_pages`), and the continuation proceeds
+from the first token with ZERO prefill recompute — byte-identical to a
+single-engine run, because the imported pool bytes are the exported
+pool bytes.
+
+This module holds the transfer-integrity layer shared by every
+transport:
+
+  - payload checksums: every page blob and the resume metadata carry a
+    CRC32 stamped at export and verified at import
+    (`checksum_payload` / `verify_payload` — KVHandoffError on
+    mismatch). Even the in-process handoff verifies: it is how a
+    buggy transport, a torn store write, or an aliased buffer turns
+    into a typed error instead of silently corrupt attention.
+  - StoreKVTransport: the CPU/multi-process transport — the payload
+    rides the TCPStore rendezvous (distributed/store.py) as chunked
+    binary keys with a JSON manifest. On TPU pods the same payload
+    moves device-to-device (the router's in-process handoff passes
+    arrays directly; an ICI transport reimplements send/recv only).
+
+Allocator-side safety (serving.PageAllocator export/import tickets):
+a transfer token is BURNED on import commit, so re-importing the same
+page chain raises instead of aliasing one KV image into two requests;
+a failed import rolls back every claimed page.
+"""
+import json
+import zlib
+
+import numpy as np
+
+
+class KVHandoffError(RuntimeError):
+    """A KV-page handoff failed integrity or protocol checks (CRC
+    mismatch, geometry mismatch, torn transport manifest)."""
+
+
+# metadata fields covered by the meta CRC (order matters — it is the
+# serialization order). The deadline budget rides here too: a torn
+# store value that flips deadline_remaining_ms but still parses would
+# otherwise silently shed (or un-SLA) the imported request.
+_META_FIELDS = ("uid", "state", "generated", "max_new_tokens",
+                "eos_token_id", "tenant", "priority", "ttl_steps",
+                "deadline", "deadline_remaining_ms")
+
+
+def _meta_crc(payload):
+    spec = payload["spec"]
+    meta = [spec.get(k) for k in _META_FIELDS]
+    meta.append(int(payload["lens"]))
+    blob = json.dumps(meta, default=str).encode()
+    crc = zlib.crc32(blob)
+    crc = zlib.crc32(np.ascontiguousarray(
+        np.asarray(spec["prompt"], np.int64)).tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
+def _page_crc(arr):
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def checksum_payload(payload):
+    """Stamp CRC32s over the resume metadata and every layer's K/V page
+    blob. Returns the payload (mutated in place) for chaining."""
+    payload["crc"] = {
+        "meta": _meta_crc(payload),
+        "k": [_page_crc(a) for a in payload["k"]],
+        "v": [_page_crc(a) for a in payload["v"]],
+    }
+    return payload
+
+
+def verify_payload(payload):
+    """Raise KVHandoffError unless every CRC matches what was stamped
+    at export."""
+    crc = payload.get("crc")
+    if not isinstance(crc, dict):
+        raise KVHandoffError("handoff payload carries no checksums")
+    if crc["meta"] != _meta_crc(payload):
+        raise KVHandoffError(
+            "handoff metadata CRC mismatch (resume spec corrupted in "
+            "transit)")
+    for name in ("k", "v"):
+        blobs, sums = payload[name], crc[name]
+        if len(blobs) != len(sums):
+            raise KVHandoffError(
+                f"handoff {name}-page layer count mismatch: "
+                f"{len(blobs)} blobs, {len(sums)} checksums")
+        for li, (a, want) in enumerate(zip(blobs, sums)):
+            got = _page_crc(a)
+            if got != want:
+                raise KVHandoffError(
+                    f"handoff {name}-page CRC mismatch at layer {li}: "
+                    f"{got:#010x} != {want:#010x} (KV bytes corrupted "
+                    "in transit)")
+    return payload
+
+
+class StoreKVTransport:
+    """KV handoff over the TCPStore rendezvous (the CPU / cross-process
+    transport). Arrays are shipped as chunked binary values under a
+    manifest key; the CRC layer above catches torn or reordered writes.
+
+    store: distributed.store.TCPStore (or anything with set/get).
+    prefix: key namespace (several transports can share one store).
+    chunk_bytes: store value chunk size (the store's get buffer is
+      1 MB; stay comfortably below it).
+    """
+
+    def __init__(self, store, prefix="kvxfer", chunk_bytes=1 << 19):
+        self.store = store
+        self.prefix = prefix
+        self.chunk_bytes = int(chunk_bytes)
+
+    # -- wire format --------------------------------------------------------
+    @staticmethod
+    def _pack(payload):
+        """payload -> (manifest_json_bytes, binary_blob). Arrays are
+        concatenated in manifest order; the manifest records shapes,
+        dtypes, and offsets."""
+        spec = dict(payload["spec"])
+        prompt = np.ascontiguousarray(np.asarray(spec.pop("prompt"),
+                                                 np.int64))
+        arrays = [("prompt", prompt)]
+        for name in ("k", "v"):
+            for li, a in enumerate(payload[name]):
+                arrays.append((f"{name}{li}",
+                               np.ascontiguousarray(np.asarray(a))))
+        blob = bytearray()
+        index = []
+        for name, a in arrays:
+            index.append({"name": name, "shape": list(a.shape),
+                          "dtype": str(a.dtype), "off": len(blob),
+                          "nbytes": a.nbytes})
+            blob += a.tobytes()
+        manifest = {
+            "spec": spec, "lens": int(payload["lens"]),
+            "layers": len(payload["k"]),
+            "geometry": payload["geometry"],
+            "token": payload["token"],
+            "crc": payload["crc"],
+            "index": index, "blob_bytes": len(blob),
+        }
+        return json.dumps(manifest).encode(), bytes(blob)
+
+    @staticmethod
+    def _unpack(manifest_bytes, blob):
+        m = json.loads(manifest_bytes.decode())
+        if len(blob) != m["blob_bytes"]:
+            raise KVHandoffError(
+                f"handoff blob truncated: {len(blob)} of "
+                f"{m['blob_bytes']} bytes arrived")
+        arrays = {}
+        for ent in m["index"]:
+            a = np.frombuffer(
+                blob, dtype=np.dtype(ent["dtype"]), count=np.prod(
+                    ent["shape"], dtype=int), offset=ent["off"])
+            arrays[ent["name"]] = a.reshape(ent["shape"]).copy()
+        spec = dict(m["spec"])
+        spec["prompt"] = arrays["prompt"]
+        L = m["layers"]
+        payload = {
+            "spec": spec, "lens": m["lens"], "token": m["token"],
+            "geometry": m["geometry"], "crc": m["crc"],
+            "k": [arrays[f"k{li}"] for li in range(L)],
+            "v": [arrays[f"v{li}"] for li in range(L)],
+        }
+        return payload
+
+    # -- transport ----------------------------------------------------------
+    def send(self, payload):
+        """Publish a handoff payload; returns the key the receiver
+        passes to recv(). The manifest is written LAST so a reader
+        never observes a torn transfer."""
+        manifest, blob = self._pack(payload)
+        key = f"{self.prefix}/{payload['token']}"
+        n_chunks = max(1, -(-len(blob) // self.chunk_bytes))
+        for i in range(n_chunks):
+            lo = i * self.chunk_bytes
+            self.store.set(f"{key}/c{i}", blob[lo:lo + self.chunk_bytes])
+        self.store.set(f"{key}/manifest",
+                       json.dumps({"chunks": n_chunks}).encode()
+                       + b"\n" + manifest)
+        return key
+
+    def recv(self, key, timeout_ms=30000):
+        """Fetch + reassemble + CRC-verify a payload by key."""
+        raw = self.store.get(f"{key}/manifest", wait=True,
+                             timeout_ms=timeout_ms)
+        head, manifest = raw.split(b"\n", 1)
+        n_chunks = json.loads(head.decode())["chunks"]
+        blob = b"".join(self.store.get(f"{key}/c{i}", wait=True,
+                                       timeout_ms=timeout_ms)
+                        for i in range(n_chunks))
+        return verify_payload(self._unpack(manifest, blob))
+
+    def delete(self, key):
+        """Best-effort cleanup of a consumed transfer."""
+        raw = self.store.get(f"{key}/manifest", wait=False)
+        try:
+            head, _ = raw.split(b"\n", 1)
+            n = json.loads(head.decode())["chunks"]
+        except Exception:
+            n = 0
+        for i in range(n):
+            self.store.delete_key(f"{key}/c{i}")
+        self.store.delete_key(f"{key}/manifest")

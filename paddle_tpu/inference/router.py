@@ -155,11 +155,14 @@ class EngineReplica:
     probes verify before re-admission.
     """
 
-    def __init__(self, name, factory):
+    def __init__(self, name, factory, role="any"):
         self.name = name
         self._factory = factory
         self.engine = factory()
         self.state = ACTIVE
+        self.role = role                # "prefill" | "decode" | "any"
+        #                                 (disaggregated topology mode;
+        #                                 "any" = the classic fleet)
         self.breaker = None             # installed by the router
         self.kills = 0                  # declared failures
         self.swaps = 0                  # weight flips applied
@@ -216,6 +219,23 @@ class EngineReplica:
         EngineFullError is complaining about)."""
         q = self.engine._queue
         return self.engine._pick_next().uid if q else None
+
+    # -- KV-page handoff (disaggregated prefill/decode) ----------------------
+    def export_kv(self, uid):
+        """Package a decode-state request's KV image for migration
+        (scheduler.export_kv_pages — CRC-stamped, ticketed)."""
+        return self.engine.export_kv_pages(uid)
+
+    def import_kv(self, payload):
+        """Seat an exported request here; returns this replica's engine
+        uid (scheduler.import_kv_pages — verified, rollback-safe)."""
+        return self.engine.import_kv_pages(payload)
+
+    def release_handoff(self, uid):
+        return self.engine.release_handoff(uid)
+
+    def abort_handoff(self, uid):
+        return self.engine.abort_handoff(uid)
 
     # -- weights -----------------------------------------------------------
     def export_weights(self):
@@ -284,12 +304,36 @@ class EngineRouter:
     def __init__(self, factory, replicas=2, quarantine_threshold=2,
                  probe_backoff=4, probe_retries=1, probe_base_delay=0.01,
                  probe_jitter=0.0, probe_max_elapsed=None, probe_seed=0,
-                 probe_sleep=time.sleep, hold_limit=None):
+                 probe_sleep=time.sleep, hold_limit=None, topology=None):
+        # topology={"prefill": N, "decode": M}: DISAGGREGATED mode —
+        # N prefill workers take every fresh admission, M decode
+        # workers receive requests at first-token via KV-page handoff
+        # (export_kv_pages/import_kv_pages: page-table remap + refcount
+        # transfer, CRC-checked; zero prefill recompute). A request
+        # whose handoff cannot land right now keeps decoding on its
+        # prefill worker and retries next step (availability over
+        # purity); a worker dying mid-handoff re-queues through the
+        # standard salvage path — exactly-once, byte-identical
+        # continuation. `replicas` is ignored when topology is given.
+        self._topology = None
+        roles = None
+        if topology is not None:
+            np_ = int(topology.get("prefill", 0))
+            nd = int(topology.get("decode", 0))
+            if np_ < 1 or nd < 1:
+                raise ValueError(
+                    f"topology needs at least one prefill and one "
+                    f"decode worker, got {topology!r}")
+            self._topology = {"prefill": np_, "decode": nd}
+            roles = ["prefill"] * np_ + ["decode"] * nd
+            replicas = np_ + nd
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         self._replicas = []
         for i in range(int(replicas)):
-            rep = EngineReplica(f"r{i}", factory)
+            role = roles[i] if roles else "any"
+            name = f"{role[0] if roles else 'r'}{i}"
+            rep = EngineReplica(name, factory, role=role)
             rep.breaker = CircuitBreaker(threshold=quarantine_threshold,
                                          probe_backoff=probe_backoff)
             self._replicas.append(rep)
@@ -315,6 +359,10 @@ class EngineRouter:
         self.probes = 0
         self.hot_swaps = 0              # completed fleet swaps
         self.swap_rollbacks = 0
+        self.kv_handoffs = 0            # prefill->decode page migrations
+        self.handoff_failures = 0       # export/import/commit attempts
+        #                                 that fell back (request safe
+        #                                 either way — never lost)
 
     # -- public ------------------------------------------------------------
     def add_request(self, ids, max_new_tokens=32, eos_token_id=None,
@@ -386,6 +434,8 @@ class EngineRouter:
             rep.breaker.record_success()
             self._collect(rep)
             did = did or moved
+        if self._topology is not None:
+            did |= self._handoff_sweep()
         return did or bool(self._held)
 
     def drain(self):
@@ -444,7 +494,8 @@ class EngineRouter:
         reps = {}
         for rep in self._replicas:
             br = rep.breaker
-            entry = {"state": rep.state, "breaker": br.state,
+            entry = {"state": rep.state, "role": rep.role,
+                     "breaker": br.state,
                      "failures": br.failures, "kills": rep.kills,
                      "swaps": rep.swaps, "last_error": br.last_error,
                      "assigned": len(self._assigned[rep.name])}
@@ -468,6 +519,9 @@ class EngineRouter:
             "probes": self.probes,
             "hot_swaps": self.hot_swaps,
             "swap_rollbacks": self.swap_rollbacks,
+            "topology": self._topology,
+            "kv_handoffs": self.kv_handoffs,
+            "handoff_failures": self.handoff_failures,
         }
 
     # -- weight hot-swap ---------------------------------------------------
@@ -591,7 +645,17 @@ class EngineRouter:
         replica can EVER take fails at the router instead of aborting
         the salvage loop that is resolving its replica's death."""
         last_busy = None
-        for rep in self._routable(exclude):
+        reps = self._routable(exclude)
+        if self._topology is not None:
+            # disaggregated mode: every fresh admission (and every
+            # spec-requeue — a salvaged request re-prefills anyway)
+            # prefers the prefill pool; decode workers are the fallback
+            # when NO prefill worker is routable (availability over
+            # purity — a quarantined prefill tier must not black-hole
+            # admissions while healthy decode engines idle)
+            reps = ([r for r in reps if r.role == "prefill"]
+                    + [r for r in reps if r.role != "prefill"])
+        for rep in reps:
             try:
                 fault_point("replica.admit", detail=rep.name)
                 euid = rep.submit(spec)
@@ -779,6 +843,114 @@ class EngineRouter:
         'queue held at the block boundary' contract."""
         for ruid in list(self._assigned[rep.name]):
             self._salvage_one(rep, ruid, keep_queued=True)
+
+    # -- disaggregated prefill/decode handoff --------------------------------
+    def _handoff_sweep(self):
+        """Migrate every first-token-ready request off the prefill
+        workers onto decode workers (topology mode). Runs once per
+        router step, AFTER the replica stepping loop, so handoffs
+        always happen at an engine sync point (no in-flight block holds
+        newer tokens than the host sees). A request whose handoff
+        cannot land keeps decoding where it is and retries next step."""
+        moved = False
+        for rep in self._replicas:
+            if rep.role != "prefill" or rep.state != ACTIVE or \
+                    rep.breaker.state == "open":
+                continue
+            for ruid in list(self._assigned[rep.name]):
+                rr = self._reqs[ruid]
+                if rr.state == DECODE and rr.replica == rep.name:
+                    moved |= self._handoff_kv(rep, ruid)
+        return moved
+
+    def _handoff_kv(self, rep, ruid):
+        """One prefill->decode KV-page migration, exactly-once under a
+        kill at ANY of its three fault points:
+
+          kv.export  — fires before the source opens its ticket: the
+            request is untouched, it keeps decoding on the prefill
+            worker (retry next sweep).
+          kv.import  — the target engine rolls the import back whole
+            (pages freed, token not burned); the next target is tried,
+            else the export is aborted and the request stays.
+          handoff.commit — the source dies AFTER the target seated the
+            copy: the ledger was repointed FIRST, so delivery comes
+            from the target exactly once; the source's zombie copy is
+            evicted and its ticket aborted, and the source is declared
+            failed so its other requests salvage normally.
+
+        Greedy continuations are byte-identical to a single-engine run
+        in every branch: the landed copy decodes from the imported
+        bytes, a fallen-back request continues from its own pages."""
+        rr = self._reqs[ruid]
+        euid = rr.engine_uid
+
+        def has_room(t):
+            h = t.headroom()           # O(1) — the routing snapshot
+            return (h["running"] < h["slots_total"]
+                    and h["pages_free"] > 0)
+
+        # pre-filter saturated targets BEFORE paying the export: the
+        # payload is a full host copy + CRC pass of every KV page, and
+        # a slotless (or page-exhausted) target would only bounce it;
+        # the import side re-checks the exact page need pre-CRC, so a
+        # near-full pool costs a cheap refusal, not a checksum sweep
+        targets = [t for t in self._routable(exclude=(rep.name,))
+                   if t.role == "decode" and has_room(t)]
+        if not targets:
+            return False               # no decode capacity: stay put
+        try:
+            payload = rep.export_kv(euid)
+        except Exception:
+            # export fault point (or a non-decode race): nothing was
+            # ticketed, the request keeps serving on the source
+            self.handoff_failures += 1
+            return False
+        landed = None
+        for tgt in targets:
+            try:
+                new_euid = tgt.import_kv(payload)
+            except (EngineBusyError, EngineFullError):
+                continue               # full target (slots or pages):
+                #                        backpressure, try the next
+            except Exception:
+                # kv.import fault: the target engine already rolled its
+                # import back (pages freed, token reusable)
+                self.handoff_failures += 1
+                continue
+            landed = (tgt, new_euid)
+            break
+        if landed is None:
+            rep.abort_handoff(euid)
+            self.handoff_failures += 1
+            return False
+        tgt, new_euid = landed
+        # repoint the ledger BEFORE the source commit: if the source
+        # dies at handoff.commit the request is already owned by the
+        # target — the source's salvage loop skips it (assignment
+        # check) and its zombie copy can never deliver
+        self._assigned[rep.name].discard(ruid)
+        rr.replica, rr.engine_uid = tgt.name, new_euid
+        self._assigned[tgt.name].add(ruid)
+        try:
+            fault_point("handoff.commit",
+                        detail=f"{rep.name}->{tgt.name} uid={ruid}")
+            rep.release_handoff(euid)
+        except Exception as e:
+            # source died at commit: burn its zombie copy and declare
+            # the worker failed (its OTHER requests re-queue); the
+            # migrated request itself is safe on the target
+            try:
+                rep.abort_handoff(euid)
+            except Exception:
+                pass
+            rep.evict(euid)
+            self.handoff_failures += 1
+            self._on_replica_failure(rep, e)
+            self.kv_handoffs += 1
+            return True
+        self.kv_handoffs += 1
+        return True
 
     def _fail_stuck_head(self, rep, exc):
         """EngineFullError on an idle replica: the queue-head request

@@ -53,6 +53,10 @@ from .speculative import resolve_drafter
 
 QUEUED, PREFILL, DECODE, DONE, FAILED, CANCELLED = \
     "queued", "prefill", "decode", "done", "failed", "cancelled"
+# terminal state of a request whose KV pages were handed off to another
+# engine (export_kv_pages -> release_handoff): its continuation — and
+# its result — live on the importing engine
+MIGRATED = "migrated"
 
 
 def _pools_put(pools, li, arr, acc):
@@ -508,6 +512,18 @@ class ContinuousBatchingEngine(LLMEngine):
         # repacked ONCE here into the streamed layout (views/cheap
         # reshapes for aligned geometries; "multi" additionally stacks
         # them [L, ...] so one invocation streams every layer).
+        if self.tp > 1:
+            # the megakernel consumes a host-repacked full-geometry
+            # weight schedule; a per-shard repack (local heads/ffn
+            # tiles) is the named follow-up — until then TP decode runs
+            # the op-chain + paged-attention kernel per shard
+            if megakernel not in (None, False):
+                raise ValueError(
+                    "megakernel= is not supported with tp > 1 yet: the "
+                    "packed weight schedule is full-geometry (per-shard "
+                    "repack is the named follow-up); leave "
+                    "megakernel=None/False")
+            megakernel = False
         self.megakernel = self._resolve_megakernel(megakernel)
         if self.megakernel:
             from ..ops.pallas.decode_megakernel import (pack_decode_layer,
@@ -597,6 +613,9 @@ class ContinuousBatchingEngine(LLMEngine):
         #                                 previous block's readback
         self.preemptions = 0            # decode-slot preemptions (work
         #                                 re-queued, not failed)
+        self.handoffs_out = 0           # KV-page exports committed away
+        self.handoffs_in = 0            # KV-page imports seated here
+        self._handoffs_out = {}         # uid -> pending export token
         self.spec_passes = 0            # verify passes that ran
         self.spec_emitted = 0           # decode tokens emitted by them
         self.spec_drafted_total = 0     # drafts offered
@@ -669,7 +688,7 @@ class ContinuousBatchingEngine(LLMEngine):
         r = self._requests.get(uid)
         if r is None:
             raise UnknownRequestError(f"unknown request uid {uid}")
-        if r.state in (DONE, FAILED, CANCELLED):
+        if r.state in (DONE, FAILED, CANCELLED, MIGRATED):
             return False
         if r.state == QUEUED:
             self._queue.remove(r)
@@ -773,6 +792,11 @@ class ContinuousBatchingEngine(LLMEngine):
             raise RequestCancelledError(r.error)
         if r.state == FAILED:
             raise RequestFailedError(r.error)
+        if r.state == MIGRATED:
+            raise RequestNotFinishedError(
+                f"request {uid} migrated to another engine via KV "
+                "handoff — read its result there (the router's ledger "
+                "tracks the move)")
         if r.state != DONE:
             raise RequestNotFinishedError(
                 f"request {uid} is {r.state}, not done")
@@ -844,6 +868,11 @@ class ContinuousBatchingEngine(LLMEngine):
             # active decode-kernel mode: "off" = per-op XLA chain,
             # "layer"/"multi" = the Pallas decode megakernel
             "megakernel": self.megakernel if self.megakernel else "off",
+            # tensor parallelism (inference/tp.py): shard count, tail
+            # mode, and whether the per-token reduce rides int8
+            "tp": self.tp,
+            "tp_mode": self.tp_mode,
+            "tp_compress": self.tp_compress,
             # speculative decoding: verify width, drafter, and the
             # accept telemetry the adaptive-K policy runs on
             "speculate": self._spec,
@@ -858,6 +887,10 @@ class ContinuousBatchingEngine(LLMEngine):
                 self.spec_emitted / self.spec_passes
                 if self.spec_passes else 0.0),
             "draft_errors": self.draft_errors,
+            # disaggregated prefill/decode: KV-page handoffs through
+            # this engine (docs/serving.md)
+            "handoffs_out": self.handoffs_out,
+            "handoffs_in": self.handoffs_in,
             # multi-tenant admission: preemptions + per-tenant service
             "preemptions": self.preemptions,
             "tenants": {
@@ -1101,7 +1134,10 @@ class ContinuousBatchingEngine(LLMEngine):
             return (kps.at[:, dst].set(kps[:, src]),
                     vps.at[:, dst].set(vps[:, src]))
 
-        return jax.jit(copy, donate_argnums=(0, 1))
+        _, R, POOL = self._tp_specs()
+        return self._jit_tp(copy, in_specs=(POOL, POOL, R, R),
+                            out_specs=(POOL, POOL),
+                            donate_argnums=(0, 1))
 
     def _cow(self, r, idx):
         """First divergent write into a shared page: copy its KV into
@@ -1153,23 +1189,23 @@ class ContinuousBatchingEngine(LLMEngine):
                 # NOTHING — scatter-drop, so cached pages stay garbage-
                 # free and shared pages are never touched
                 slots = jnp.where(pos < t_end, slots, oob)
-                kp = k_pages_all[li].reshape(-1, self.nh_kv, self.hd)
-                vp = v_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+                kp = k_pages_all[li].reshape(-1, self.nh_kv_l, self.hd)
+                vp = v_pages_all[li].reshape(-1, self.nh_kv_l, self.hd)
                 kp = kp.at[slots].set(k[0].astype(self.kv_dtype),
                                       mode="drop")
                 vp = vp.at[slots].set(v[0].astype(self.kv_dtype),
                                       mode="drop")
-                kp = kp.reshape(self.n_pages, p, self.nh_kv, self.hd)
-                vp = vp.reshape(self.n_pages, p, self.nh_kv, self.hd)
+                kp = kp.reshape(self.n_pages, p, self.nh_kv_l, self.hd)
+                vp = vp.reshape(self.n_pages, p, self.nh_kv_l, self.hd)
                 k_pages_all = _pools_put(k_pages_all, li, kp, new_k)
                 v_pages_all = _pools_put(v_pages_all, li, vp, new_v)
                 # gather this sequence's full context back out of the
                 # pool: [mp*p, h_kv, d]; keys past the causal horizon
                 # carry finite garbage and mask to exact zero weight
-                ck = kp[table[0]].reshape(mp * p, self.nh_kv, self.hd)
-                cv = vp[table[0]].reshape(mp * p, self.nh_kv, self.hd)
-                ck = expand_kv_heads(ck, self.nh)
-                cv = expand_kv_heads(cv, self.nh)
+                ck = kp[table[0]].reshape(mp * p, self.nh_kv_l, self.hd)
+                cv = vp[table[0]].reshape(mp * p, self.nh_kv_l, self.hd)
+                ck = expand_kv_heads(ck, self.nh_l)
+                cv = expand_kv_heads(cv, self.nh_l)
                 logits = jnp.einsum("qhd,khd->hqk", q[0], ck) \
                     / math.sqrt(self.hd)
                 kpos = jnp.arange(mp * p)[None, None, :]
@@ -1186,7 +1222,11 @@ class ContinuousBatchingEngine(LLMEngine):
             return (logits[:, 0], _pools_result(k_pages_all, new_k),
                     _pools_result(v_pages_all, new_v))
 
-        return jax.jit(prefill, donate_argnums=(2, 3))
+        W, R, POOL = self._tp_specs()
+        return self._jit_tp(prefill,
+                            in_specs=(W, R, POOL, POOL, R, R, R),
+                            out_specs=(R, POOL, POOL),
+                            donate_argnums=(2, 3))
 
     def _prefill_step(self, r):
         chunk = self.prefill_chunk
@@ -1357,14 +1397,14 @@ class ContinuousBatchingEngine(LLMEngine):
             q, k, v = self._layer_qkv(W, wset, h, pos_ids)
             slots = (tables[jnp.arange(w), lens // p] * p + lens % p)
             slots = jnp.where(active, slots, oob)
-            kp = k_pages_all[li].reshape(-1, self.nh_kv, self.hd)
-            vp = v_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+            kp = k_pages_all[li].reshape(-1, self.nh_kv_l, self.hd)
+            vp = v_pages_all[li].reshape(-1, self.nh_kv_l, self.hd)
             kp = kp.at[slots].set(k[:, 0].astype(self.kv_dtype),
                                   mode="drop")
             vp = vp.at[slots].set(v[:, 0].astype(self.kv_dtype),
                                   mode="drop")
-            kp = kp.reshape(self.n_pages, p, self.nh_kv, self.hd)
-            vp = vp.reshape(self.n_pages, p, self.nh_kv, self.hd)
+            kp = kp.reshape(self.n_pages, p, self.nh_kv_l, self.hd)
+            vp = vp.reshape(self.n_pages, p, self.nh_kv_l, self.hd)
             new_k.append(kp)
             new_v.append(vp)
             attn = paged_attention(
@@ -1417,12 +1457,12 @@ class ContinuousBatchingEngine(LLMEngine):
             slots = tables[jnp.arange(w)[:, None], pos_c // p] * p \
                 + pos_c % p
             slots = jnp.where(write_ok, slots, oob)
-            kp = k_pages_all[li].reshape(-1, self.nh_kv, self.hd)
-            vp = v_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+            kp = k_pages_all[li].reshape(-1, self.nh_kv_l, self.hd)
+            vp = v_pages_all[li].reshape(-1, self.nh_kv_l, self.hd)
             kp = kp.at[slots].set(k.astype(self.kv_dtype), mode="drop")
             vp = vp.at[slots].set(v.astype(self.kv_dtype), mode="drop")
-            kp = kp.reshape(self.n_pages, p, self.nh_kv, self.hd)
-            vp = vp.reshape(self.n_pages, p, self.nh_kv, self.hd)
+            kp = kp.reshape(self.n_pages, p, self.nh_kv_l, self.hd)
+            vp = vp.reshape(self.n_pages, p, self.nh_kv_l, self.hd)
             new_k.append(kp)
             new_v.append(vp)
             attn = spec_verify_attention(
@@ -1439,7 +1479,11 @@ class ContinuousBatchingEngine(LLMEngine):
             return self._cb_decode_math(W, tok, k_pages_all, v_pages_all,
                                         tables, lens, active, w)
 
-        return jax.jit(step, donate_argnums=(2, 3))
+        Wsp, R, POOL = self._tp_specs()
+        return self._jit_tp(step,
+                            in_specs=(Wsp, R, POOL, POOL, R, R, R),
+                            out_specs=(R, POOL, POOL),
+                            donate_argnums=(2, 3))
 
     def _decode_step(self, decodes):
         p = self.page_size
@@ -1524,14 +1568,14 @@ class ContinuousBatchingEngine(LLMEngine):
                 ok_w = jnp.logical_and(pos < ends[:, None],
                                        pf_act[:, None])
                 slots = jnp.where(ok_w, slots, oob)
-                kp = k_pages_all[li].reshape(-1, self.nh_kv, self.hd)
-                vp = v_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+                kp = k_pages_all[li].reshape(-1, self.nh_kv_l, self.hd)
+                vp = v_pages_all[li].reshape(-1, self.nh_kv_l, self.hd)
                 kp = kp.at[slots].set(k.astype(self.kv_dtype),
                                       mode="drop")
                 vp = vp.at[slots].set(v.astype(self.kv_dtype),
                                       mode="drop")
-                kp = kp.reshape(self.n_pages, p, self.nh_kv, self.hd)
-                vp = vp.reshape(self.n_pages, p, self.nh_kv, self.hd)
+                kp = kp.reshape(self.n_pages, p, self.nh_kv_l, self.hd)
+                vp = vp.reshape(self.n_pages, p, self.nh_kv_l, self.hd)
                 k_pages_all = _pools_put(k_pages_all, li, kp, new_k)
                 v_pages_all = _pools_put(v_pages_all, li, vp, new_v)
                 if use_kernel:
@@ -1540,12 +1584,12 @@ class ContinuousBatchingEngine(LLMEngine):
                         active=pf_act.astype(jnp.int32),
                         interpret=self.interpret)
                 else:
-                    ck = kp[tables].reshape(w, mp * p, self.nh_kv,
+                    ck = kp[tables].reshape(w, mp * p, self.nh_kv_l,
                                             self.hd)
-                    cv = vp[tables].reshape(w, mp * p, self.nh_kv,
+                    cv = vp[tables].reshape(w, mp * p, self.nh_kv_l,
                                             self.hd)
-                    ck = expand_kv_heads(ck, self.nh)
-                    cv = expand_kv_heads(cv, self.nh)
+                    ck = expand_kv_heads(ck, self.nh_l)
+                    cv = expand_kv_heads(cv, self.nh_l)
                     logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck) \
                         / math.sqrt(self.hd)
                     kpos = jnp.arange(mp * p)[None, None, None, :]
@@ -1687,7 +1731,12 @@ class ContinuousBatchingEngine(LLMEngine):
             return (first, toks, emitted, tok, lens, act, rem, key,
                     k_pages_all, v_pages_all)
 
-        return jax.jit(fused, donate_argnums=(1, 2))
+        Wsp, R, POOL = self._tp_specs()
+        # positional arg specs: drafts/dlen ride only when speculating
+        in_specs = (Wsp, POOL, POOL) + (R,) * (11 + (2 if T else 0))
+        out_specs = (R, R, R, R, R, R, R, R, POOL, POOL)
+        return self._jit_tp(fused, in_specs=in_specs,
+                            out_specs=out_specs, donate_argnums=(1, 2))
 
     def _get_fused(self, w, with_prefill, with_decode):
         key = (w, with_prefill, with_decode)
@@ -2104,6 +2153,242 @@ class ContinuousBatchingEngine(LLMEngine):
             eos_token_id=spec["eos_token_id"], deadline_ms=deadline_ms,
             ttl_steps=spec["ttl_steps"], tenant=spec["tenant"],
             priority=spec["priority"])
+
+    # -- KV-page handoff (disaggregated prefill/decode) ----------------------
+    def _sync_pending(self):
+        """Apply a chained block still in flight so host state (lens,
+        generated tokens) is current before a handoff reads it."""
+        while self._pending is not None:
+            blk = self._pending
+            self._pending = None
+            self._process_block(blk)
+
+    def export_kv_pages(self, uid):
+        """Package a post-prefill request for migration to ANOTHER
+        engine with zero recompute: resume identity (the export_request
+        spec), cache length, and the raw K/V bytes of every page that
+        holds committed context, CRC-stamped (inference/handoff.py).
+
+        The source keeps serving the request until release_handoff();
+        abort_handoff() cancels cleanly. Only DECODE-state requests
+        carry a coherent KV image (mid-prefill pages are half-written;
+        queued requests have none) — others raise ValueError, and the
+        caller falls back to the spec-requeue salvage path (recompute,
+        never lost). `kv.export` is the fault point."""
+        r = self._requests.get(uid)
+        if r is None:
+            raise UnknownRequestError(f"unknown request uid {uid}")
+        # apply any in-flight chained block FIRST: it can retire this
+        # request (EOS/budget), and the state check must see that
+        self._sync_pending()
+        if r.state != DECODE or r.slot is None:
+            raise ValueError(
+                f"export_kv_pages: request {uid} is {r.state!r} — only "
+                "a decode-state request carries a complete KV image "
+                "(use export_request for the spec-requeue path)")
+        fault_point("kv.export", detail=f"uid={uid}")
+        p = self.page_size
+        lens = int(self._lens_np[r.slot])
+        n_used = -(-lens // p)
+        used = [int(pg) for pg in r.pages[:n_used]]
+        token = self.allocator.export_begin(used)
+        idx = np.asarray(used, np.int64)
+        k_blobs, v_blobs = [], []
+        # pools index identically in both forms (per-layer list, or the
+        # natively stacked [L, ...] array of megakernel="multi")
+        for li in range(self.cfg.num_hidden_layers):
+            k_blobs.append(np.asarray(self.k_pages[li][idx]))
+            v_blobs.append(np.asarray(self.v_pages[li][idx]))
+        from .handoff import checksum_payload
+        spec = self.export_request(uid)
+        # absolute monotonic deadlines don't survive a host boundary
+        # (StoreKVTransport's whole point): ship the REMAINING budget
+        # and let the importer rebase it on its own clock — the same
+        # conversion submit_resume does for the failover path
+        if spec.get("deadline") is not None:
+            spec["deadline_remaining_ms"] = max(
+                0.0, (spec["deadline"] - time.monotonic()) * 1e3)
+            spec["deadline"] = None
+        payload = {
+            "token": token,
+            "spec": spec,
+            "lens": lens,
+            "geometry": {"page_size": p, "nh_kv": self.nh_kv,
+                         "hd": self.hd,
+                         "layers": self.cfg.num_hidden_layers,
+                         "kv_dtype": str(jnp.dtype(self.kv_dtype))},
+            "k": k_blobs, "v": v_blobs,
+        }
+        self._handoffs_out[uid] = token
+        return checksum_payload(payload)
+
+    def abort_handoff(self, uid):
+        """Cancel a pending export: the request keeps serving HERE."""
+        token = self._handoffs_out.pop(uid, None)
+        if token is not None:
+            self.allocator.export_abort(token)
+
+    def release_handoff(self, uid):
+        """Source-side commit of a completed handoff: the request now
+        lives on the importing engine. Its used pages' transfer refs
+        drop via the allocator ticket, the remainder (unused budget
+        tail, CoW reserve) through the normal slot release; the request
+        retires MIGRATED (result() must be read from the importer)."""
+        r = self._requests.get(uid)
+        if r is None:
+            raise UnknownRequestError(f"unknown request uid {uid}")
+        token = self._handoffs_out.pop(uid, None)
+        if token is None:
+            raise ValueError(
+                f"release_handoff: no pending export for request {uid}")
+        if r.state != DECODE:
+            # retired (EOS/budget/fault) since the export — its pages
+            # are already released, the ticket must not free them again;
+            # the coordinator resolves the duplicate (deliver from HERE,
+            # cancel the imported copy — exactly-once either way)
+            self.allocator.export_abort(token)
+            raise ValueError(
+                f"release_handoff: request {uid} is {r.state!r} (it "
+                "retired after the export) — handoff aborted, read the "
+                "result from this engine")
+        used = set(self.allocator.export_pages(token))
+        self.allocator.export_commit(token)
+        r.pages = [pg for pg in r.pages if pg not in used]
+        r.state = MIGRATED
+        self._release_slot(r)
+        self.handoffs_out += 1
+
+    def import_kv_pages(self, payload):
+        """Admit an export_kv_pages payload into THIS engine: CRC +
+        geometry verify, claim pages under the transfer token (a token
+        already imported here RAISES — no silent aliasing), write the
+        KV bytes into the pools, seat the request directly in DECODE
+        state, and republish its full prompt pages to the prefix cache
+        (parity with a locally-prefilled request). Greedy continuation
+        is byte-identical to an uninterrupted single-engine run — the
+        imported bytes ARE the exported bytes (pinned in tests).
+
+        Raises EngineBusyError when no slot is free (the handoff
+        coordinator holds and retries — nothing is claimed), KVHandoff-
+        Error on integrity failures, EngineFullError propagating from
+        the page claim. Any failure after the claim rolls the import
+        back (pages freed, token NOT burned). `kv.import` is the fault
+        point."""
+        from .handoff import KVHandoffError, verify_payload
+        fault_point("kv.import", detail=f"token={payload.get('token')}")
+        g = payload["geometry"]
+        mine = {"page_size": self.page_size, "nh_kv": self.nh_kv,
+                "hd": self.hd, "layers": self.cfg.num_hidden_layers,
+                "kv_dtype": str(jnp.dtype(self.kv_dtype))}
+        if {k: g.get(k) for k in mine} != mine:
+            raise KVHandoffError(
+                f"handoff geometry mismatch: payload {g} vs engine "
+                f"{mine} (disaggregated pools must share model + cache "
+                "geometry)")
+        spec = payload["spec"]
+        remaining = int(spec["max_new_tokens"])
+        if remaining <= 0:
+            raise ValueError(
+                "import_kv_pages: spent generation budget (the source "
+                "should deliver the finished result, not migrate it)")
+        gen = int(spec["generated"])
+        prompt = np.asarray(spec["prompt"], np.int64).ravel()
+        ids = prompt[:prompt.size - gen]
+        out = [int(t) for t in prompt[prompt.size - gen:]]
+        if not out:
+            raise ValueError(
+                "import_kv_pages: no committed first token — migrate "
+                "at first-token or later (that is the handoff point)")
+        t0 = int(ids.size)
+        mnt_total = remaining + gen
+        if t0 + mnt_total > self.max_len:
+            raise ValueError(
+                f"prompt {t0} + total budget {mnt_total} exceeds "
+                f"max_len={self.max_len}")
+        lens = int(payload["lens"])
+        p = self.page_size
+        n_used = -(-lens // p)
+        slot = next((i for i, s in enumerate(self._slots) if s is None),
+                    None)
+        need = self._pages_needed(t0, mnt_total)
+        if slot is None:
+            # slot AND page availability are checked BEFORE the CRC
+            # sweep: backpressure must cost the coordinator a cheap
+            # refusal, not a full-payload checksum pass
+            raise EngineBusyError(
+                f"import_kv_pages: no free slot ({self.max_batch} "
+                "running); retry after a retirement")
+        if need > self.allocator.available:
+            raise EngineFullError(
+                f"import_kv_pages: needs {need} KV pages but only "
+                f"{self.allocator.available} of "
+                f"{self.allocator.n_pages} are free; retry after a "
+                "retirement")
+        verify_payload(payload)
+        pages = self.allocator.import_begin(payload["token"], need)
+        r = None
+        try:
+            idx = jnp.asarray(np.asarray(pages[:n_used], np.int64))
+            for li in range(self.cfg.num_hidden_layers):
+                kc = jnp.asarray(payload["k"][li], self.kv_dtype)
+                vc = jnp.asarray(payload["v"][li], self.kv_dtype)
+                if isinstance(self.k_pages, (list, tuple)):
+                    self.k_pages[li] = self.k_pages[li].at[idx].set(kc)
+                    self.v_pages[li] = self.v_pages[li].at[idx].set(vc)
+                else:               # natively stacked pools ("multi")
+                    self.k_pages = self.k_pages.at[li, idx].set(kc)
+                    self.v_pages = self.v_pages.at[li, idx].set(vc)
+            if self._tpc is not None:
+                # at-set outside the compiled paths may drop the mesh
+                # layout; re-place so the next dispatch is zero-copy
+                self.k_pages = self._tpc.place_pools(self.k_pages)
+                self.v_pages = self._tpc.place_pools(self.v_pages)
+            deadline = spec.get("deadline")     # same-host payloads
+            if spec.get("deadline_remaining_ms") is not None:
+                # cross-host payload: rebase the shipped remaining
+                # budget on THIS host's monotonic clock
+                deadline = (time.monotonic()
+                            + spec["deadline_remaining_ms"] / 1e3)
+            r = Request(self._next_uid, ids, mnt_total,
+                        spec["eos_token_id"],
+                        deadline=deadline,
+                        ttl_steps=spec.get("ttl_steps"),
+                        born_step=self.steps,
+                        tenant=spec.get("tenant") or "default",
+                        priority=int(spec.get("priority") or 0),
+                        draft_k=max(1, self._spec - 1) if self._spec
+                        else 0)
+            r.out = out
+            r.tok = out[-1]
+            r.pages = pages
+            r.slot = slot
+            r.filled = r.resume = t0
+            r.state = DECODE
+            self._next_uid += 1
+            self._requests[r.uid] = r
+            self._slots[slot] = r
+            self._tables_np[slot] = 0
+            self._tables_np[slot, :len(pages)] = pages
+            self._lens_np[slot] = lens
+            self._publish_prefix(r)
+            self.allocator.import_commit(payload["token"])
+        except Exception:
+            # roll the import back whole: pages freed, token NOT
+            # burned (a retry may target this engine again), slot and
+            # request maps untouched by the partial seat
+            if r is not None:
+                if self._requests.get(r.uid) is r:
+                    del self._requests[r.uid]
+                if self._slots[slot] is r:
+                    self._slots[slot] = None
+            self.allocator.import_abort(payload["token"])
+            raise
+        self.admissions += 1
+        self.handoffs_in += 1
+        if self._slot_used[slot]:
+            self.slot_reuses += 1
+        self._slot_used[slot] = True
+        return r.uid
 
     def install_weights(self, new):
         """Zero-downtime flip, gated at a BLOCK BOUNDARY: no slot may

@@ -18,6 +18,7 @@ Pieces:
   - generate(): the host loop (greedy or temperature/top-k/top-p
     sampling, shared with models.generation._sample)
 """
+import collections
 import functools
 import math
 
@@ -61,6 +62,107 @@ class PageAllocator:
         self._ref = [0] * n_pages
         self.total_allocs = 0   # fresh pages handed out (prefix-cache
         #                         tests assert shared prefixes shrink it)
+        # cross-engine page transfer bookkeeping (KV handoff,
+        # docs/serving.md "Disaggregated prefill/decode"): exports are
+        # TICKETED so a transfer is either committed (source refs
+        # dropped) or aborted (nothing changed), and imports BURN the
+        # ticket token so the same page chain can never be imported
+        # twice (two requests silently aliasing one exported KV image).
+        self._exports = {}       # token -> tuple(pages) pending export
+        self._imports = {}       # token -> list(pages) pending import
+        # burned tokens (committed imports), BOUNDED: double-import
+        # protection only has to cover transfers whose retry could
+        # still be in flight — an unbounded set would grow one uuid per
+        # handoff for the life of a decode worker
+        self._imported = collections.OrderedDict()
+        self._imported_cap = 4096
+
+    # -- cross-engine transfer (the KV-handoff substrate) -------------------
+    def export_begin(self, pages):
+        """Open a transfer ticket for `pages` (all must be live). The
+        pages stay owned by this allocator until export_commit; abort
+        leaves everything untouched. Returns the ticket token."""
+        import uuid
+        pages = tuple(int(p) for p in pages)
+        for p in pages:
+            if not (0 <= p < self.n_pages) or self._ref[p] <= 0:
+                raise RuntimeError(
+                    f"export_begin of page {p}: not a live page "
+                    f"(refcount {self._ref[p] if 0 <= p < self.n_pages else 'n/a'})")
+        token = uuid.uuid4().hex
+        self._exports[token] = pages
+        return token
+
+    def export_pages(self, token):
+        """The page tuple under a pending export ticket."""
+        pages = self._exports.get(token)
+        if pages is None:
+            raise RuntimeError(
+                f"export_pages of unknown/closed transfer {token!r}")
+        return pages
+
+    def export_commit(self, token):
+        """Close the ticket and drop THIS transfer's reference on each
+        page (ownership moved to the importer's copy); shared holders
+        (prefix cache, co-readers) keep theirs."""
+        pages = self._exports.pop(token, None)
+        if pages is None:
+            raise RuntimeError(
+                f"export_commit of unknown/closed transfer {token!r}")
+        self.free(pages)
+
+    def export_abort(self, token):
+        """Cancel a pending export: ticket closed, pages untouched."""
+        if self._exports.pop(token, None) is None:
+            raise RuntimeError(
+                f"export_abort of unknown/closed transfer {token!r}")
+
+    def import_begin(self, token, n):
+        """Claim `n` fresh pages to receive the transfer `token`.
+        A token already imported (or mid-import) RAISES — silently
+        aliasing one exported KV image into two requests is how a
+        retried handoff corrupts an innocent request's attention.
+        Nothing is claimed when the pool cannot cover `n`."""
+        if token in self._imported or token in self._imports:
+            raise RuntimeError(
+                f"double import of transfer {token!r}: this page chain "
+                "was already imported here (a retried handoff must "
+                "abort the first import or target another engine)")
+        if n > self.available:
+            raise EngineFullError(
+                f"import of {n} KV pages needs {n} free pages but only "
+                f"{self.available} of {self.n_pages} are free")
+        pages = []
+        self._imports[token] = pages
+        try:
+            for _ in range(n):
+                pages.append(self.alloc())
+        except Exception:
+            self.import_abort(token)
+            raise
+        return list(pages)
+
+    def import_commit(self, token):
+        """Burn the token (double-import protection) and keep the
+        pages — the importer's request now owns them."""
+        if token not in self._imports:
+            raise RuntimeError(
+                f"import_commit of unknown transfer {token!r}")
+        del self._imports[token]
+        self._imported[token] = True
+        while len(self._imported) > self._imported_cap:
+            self._imported.popitem(last=False)
+
+    def import_abort(self, token):
+        """Roll a failed import back: claimed pages return to the free
+        list and the token is NOT burned (the handoff may be retried
+        here after the failure is resolved)."""
+        pages = self._imports.pop(token, None)
+        if pages is None:
+            raise RuntimeError(
+                f"import_abort of unknown transfer {token!r}")
+        if pages:
+            self.free(pages)
 
     def alloc(self):
         fault_point("page.alloc")
@@ -183,7 +285,8 @@ class LLMEngine:
 
     def __init__(self, model, max_len=1024, page_size=128, max_batch=8,
                  quant=None, use_pallas=None, batch_buckets=None,
-                 weight_dtype=None, flash_prefill_min=256):
+                 weight_dtype=None, flash_prefill_min=256,
+                 tp=1, tp_mode="exact", tp_compress=None):
         assert isinstance(model, LlamaForCausalLM), "LLaMA family only"
         if quant not in (None, "int8"):
             raise ValueError(f"unsupported quant {quant!r}")
@@ -215,6 +318,26 @@ class LLMEngine:
             raise ValueError(
                 f"num_attention_heads ({self.nh}) must be a multiple of "
                 f"num_key_value_heads ({self.nh_kv})")
+        # tensor parallelism: tp > 1 runs every compiled dispatch under
+        # shard_map on a 1-D "mp" mesh — heads + KV pools sharded over
+        # heads, matmuls column/row-parallel (inference/tp.py). The
+        # traced math below uses the LOCAL head counts (nh_l/nh_kv_l ==
+        # the globals at tp=1), so one code path serves both.
+        self.tp = int(tp or 1)
+        if self.tp > 1:
+            if self.nh % self.tp or self.nh_kv % self.tp:
+                raise ValueError(
+                    f"tp={self.tp} must divide both num_attention_heads "
+                    f"({self.nh}) and num_key_value_heads ({self.nh_kv}) "
+                    "— heads shard evenly, GQA groups never split")
+            from .tp import TPContext
+            self._tpc = TPContext(self.tp, tp_mode, tp_compress)
+        else:
+            self._tpc = None
+        self.tp_mode = tp_mode if self.tp > 1 else None
+        self.tp_compress = tp_compress if self.tp > 1 else None
+        self.nh_l = self.nh // self.tp
+        self.nh_kv_l = self.nh_kv // self.tp
         self.quant = quant
         # interpret Pallas kernels off-TPU so the engine runs in CI
         self.interpret = (use_pallas is False) or \
@@ -257,6 +380,52 @@ class LLMEngine:
         # prefill/step never closure-capture arrays (HLO-constant bloat)
         self.weights["cos"] = cos
         self.weights["sin"] = sin
+        if self._tpc is not None:
+            # place weights + pools onto the mesh ONCE — every later
+            # dispatch is zero-copy (jit would silently reshard per call
+            # otherwise, moving the whole snapshot each step)
+            self._w_specs = self._tpc.weight_specs(self.weights)
+            self.weights = self._tpc.place(self.weights, self._w_specs)
+            self.k_pages = self._tpc.place_pools(self.k_pages)
+            self.v_pages = self._tpc.place_pools(self.v_pages)
+
+    # -- tensor parallelism (inference/tp.py) -------------------------------
+    def _jit_tp(self, fn, in_specs, out_specs, donate_argnums=()):
+        """jit(fn), or jit(shard_map(fn)) on the mp mesh when tp > 1.
+        The traced fns are written against LOCAL head counts, so the
+        same body serves both paths."""
+        if self._tpc is None:
+            return jax.jit(fn, donate_argnums=donate_argnums)
+        return jax.jit(self._tpc.wrap(fn, in_specs, out_specs),
+                       donate_argnums=donate_argnums)
+
+    def _tp_specs(self):
+        """(weight_spec, replicated, pool_spec) shorthand for builders.
+        Meaningless (unused) at tp=1."""
+        from .tp import POOL, REPL
+        return (self._w_specs if self._tpc is not None else None,
+                REPL, POOL)
+
+    def _tp_gather_heads(self, x):
+        """exact-mode TP: reassemble full heads before o_proj (identity
+        at tp=1 and in psum mode, where wo is row-sharded instead)."""
+        if self._tpc is None or self._tpc.mode != "exact":
+            return x
+        return self._tpc.gather_heads(x)
+
+    def _tp_gather_cols(self, x):
+        """exact-mode TP: reassemble full MLP activations before
+        down_proj (identity at tp=1 / psum mode)."""
+        if self._tpc is None or self._tpc.mode != "exact":
+            return x
+        return self._tpc.gather_cols(x)
+
+    def _tp_reduce(self, x):
+        """psum-mode TP: the per-token all-reduce closing a row-parallel
+        pair (identity at tp=1 / exact mode)."""
+        if self._tpc is None or self._tpc.mode != "psum":
+            return x
+        return self._tpc.reduce(x)
 
     # -- math ---------------------------------------------------------------
     def _attn_dense(self, q, k, v):
@@ -291,14 +460,15 @@ class LLMEngine:
         return self._attn_dense(q, k, v)
 
     def _layer_qkv(self, W, wset, h, pos_ids):
+        # head-count comes from the matmul's own width (nh_l/nh_kv_l):
+        # under shard_map the column-sharded wq/wk/wv produce this
+        # shard's heads only, at tp=1 the full set — same code path
         cos, sin = W["cos"], W["sin"]
         b, t, H = h.shape
         x = _rms(h, wset["ln1"], W["eps"])
-        q = _mm(x, wset["wq"], self.interpret).reshape(b, t, self.nh, self.hd)
-        k = _mm(x, wset["wk"], self.interpret).reshape(b, t, self.nh_kv,
-                                                       self.hd)
-        v = _mm(x, wset["wv"], self.interpret).reshape(b, t, self.nh_kv,
-                                                       self.hd)
+        q = _mm(x, wset["wq"], self.interpret).reshape(b, t, -1, self.hd)
+        k = _mm(x, wset["wk"], self.interpret).reshape(b, t, -1, self.hd)
+        v = _mm(x, wset["wv"], self.interpret).reshape(b, t, -1, self.hd)
         # GQA: k/v STAY at nh_kv heads — the paged cache stores the
         # checkpoint's kv width (1/rep the HBM of an expanded cache) and
         # the decode kernel maps q head i -> kv head i // rep natively
@@ -313,14 +483,23 @@ class LLMEngine:
         return rope(q), rope(k), v
 
     def _layer_tail(self, W, wset, h, attn_out):
+        # TP row-parallel pair (o_proj / down_proj): "exact" mode
+        # gathers the sharded operand and runs the full matmul
+        # replicated (byte-identical to tp=1 — the gather is pure data
+        # movement); "psum" mode keeps the operand local against
+        # row-sharded weights and all-reduces the partial outputs. At
+        # tp=1 every hook is identity and this is the original chain.
         b, t = attn_out.shape[:2]
+        attn_out = self._tp_gather_heads(attn_out)
         o = _mm(attn_out.reshape(b, t, -1), wset["wo"], self.interpret)
+        o = self._tp_reduce(o)
         h = h + o
         x = _rms(h, wset["ln2"], W["eps"])
         g = _mm(x, wset["wg"], self.interpret)
         u = _mm(x, wset["wu"], self.interpret)
         act = jax.nn.silu(g.astype(jnp.float32)).astype(g.dtype) * u
-        return h + _mm(act, wset["wd"], self.interpret)
+        act = self._tp_gather_cols(act)
+        return h + self._tp_reduce(_mm(act, wset["wd"], self.interpret))
 
     # -- prefill ------------------------------------------------------------
     def _build_prefill(self, t_pad):
@@ -353,20 +532,24 @@ class LLMEngine:
                 slots = (tables[jnp.arange(b)[:, None],
                                 pos // self.page_size]
                          * self.page_size + pos % self.page_size)  # [b,t]
-                kp = k_pages_all[li].reshape(-1, self.nh_kv, self.hd)
-                vp = v_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+                kp = k_pages_all[li].reshape(-1, self.nh_kv_l, self.hd)
+                vp = v_pages_all[li].reshape(-1, self.nh_kv_l, self.hd)
                 kp = kp.at[slots].set(k.astype(self.kv_dtype))
                 vp = vp.at[slots].set(v.astype(self.kv_dtype))
                 new_k.append(kp.reshape(self.n_pages, self.page_size,
-                                        self.nh_kv, self.hd))
+                                        self.nh_kv_l, self.hd))
                 new_v.append(vp.reshape(self.n_pages, self.page_size,
-                                        self.nh_kv, self.hd))
+                                        self.nh_kv_l, self.hd))
             h = _rms(h, W["norm"], W["eps"])
             h_last = jax.lax.dynamic_index_in_dim(h, t0 - 1, axis=1)
             logits = _mm(h_last, W["head"], self.interpret)
             return logits[:, 0], new_k, new_v
 
-        return jax.jit(prefill, donate_argnums=(2, 3))
+        W, R, POOL = self._tp_specs()
+        return self._jit_tp(prefill,
+                            in_specs=(W, R, POOL, POOL, R, R),
+                            out_specs=(R, POOL, POOL),
+                            donate_argnums=(2, 3))
 
     # -- decode step ----------------------------------------------------------
     def _step_math(self, W, tok, k_pages_all, v_pages_all, tables, lens):
@@ -384,12 +567,12 @@ class LLMEngine:
             q, k, v = self._layer_qkv(W, wset, h, pos_ids)
             # write this token's kv at each sequence's slot
             slots = (tables[jnp.arange(b), lens // p] * p + lens % p)
-            kp = k_pages_all[li].reshape(-1, self.nh_kv, self.hd)
-            vp = v_pages_all[li].reshape(-1, self.nh_kv, self.hd)
+            kp = k_pages_all[li].reshape(-1, self.nh_kv_l, self.hd)
+            vp = v_pages_all[li].reshape(-1, self.nh_kv_l, self.hd)
             kp = kp.at[slots].set(k[:, 0].astype(self.kv_dtype))
             vp = vp.at[slots].set(v[:, 0].astype(self.kv_dtype))
-            kp = kp.reshape(self.n_pages, p, self.nh_kv, self.hd)
-            vp = vp.reshape(self.n_pages, p, self.nh_kv, self.hd)
+            kp = kp.reshape(self.n_pages, p, self.nh_kv_l, self.hd)
+            vp = vp.reshape(self.n_pages, p, self.nh_kv_l, self.hd)
             new_k.append(kp)
             new_v.append(vp)
             attn = paged_attention(q[:, 0], kp, vp, tables, lens + 1,
@@ -404,7 +587,10 @@ class LLMEngine:
             return self._step_math(W, tok, k_pages_all, v_pages_all,
                                    tables, lens)
 
-        return jax.jit(step, donate_argnums=(2, 3))
+        W, R, POOL = self._tp_specs()
+        return self._jit_tp(step, in_specs=(W, R, POOL, POOL, R, R),
+                            out_specs=(R, POOL, POOL),
+                            donate_argnums=(2, 3))
 
     def _build_decode_loop(self, n, do_sample, temperature, top_k, top_p):
         """Device-side decode: n steps as ONE dispatch (lax.scan over
@@ -432,7 +618,11 @@ class LLMEngine:
                                                    length=n)
             return jnp.swapaxes(toks, 0, 1), kp, vp   # [b, n]
 
-        return jax.jit(loop, donate_argnums=(2, 3))
+        W, R, POOL = self._tp_specs()
+        return self._jit_tp(loop,
+                            in_specs=(W, R, POOL, POOL, R, R, R),
+                            out_specs=(R, POOL, POOL),
+                            donate_argnums=(2, 3))
 
     def _reclaim_pages(self, n):
         """Hook: free up to n idle pages (no-op here; the continuous-
@@ -468,6 +658,9 @@ class LLMEngine:
         shape = (self.n_pages, self.page_size, self.nh_kv, self.hd)
         self.k_pages = [jnp.zeros(shape, self.kv_dtype) for _ in range(L)]
         self.v_pages = [jnp.zeros(shape, self.kv_dtype) for _ in range(L)]
+        if self._tpc is not None:
+            self.k_pages = self._tpc.place_pools(self.k_pages)
+            self.v_pages = self._tpc.place_pools(self.v_pages)
         self.allocator = PageAllocator(self.n_pages)
 
     # -- weight snapshots (zero-downtime hot-swap substrate) ----------------
@@ -514,6 +707,10 @@ class LLMEngine:
                 "install_weights: snapshot tree structure does not match "
                 "this engine's weights (different quant/layer layout?)")
         self.weights.update(new)
+        if self._tpc is not None:
+            # re-place the fresh (host/unsharded) leaves onto the mesh;
+            # already-placed leaves (rope tables) are a no-op
+            self.weights = self._tpc.place(self.weights, self._w_specs)
         return self
 
     # -- public -------------------------------------------------------------

@@ -1,0 +1,180 @@
+"""Tensor-parallel serving support: one engine spanning a device mesh.
+
+A single-chip `LLMEngine` caps the servable model at one HBM and the
+per-replica throughput at one chip's FLOPs (ROADMAP item 1; the
+Gemma-on-TPU serving comparison in PAPERS.md makes sharded decode over
+the ICI mesh the perf/$ case for TPU serving). This module holds the
+mesh/sharding plumbing that lets every compiled serving dispatch —
+prefill, chunked CB prefill, the per-step decode, the fused multi-step
+block, the speculative verify pass — run unchanged under `shard_map`
+on a 1-D "mp" (model-parallel) mesh:
+
+  - ATTENTION HEADS and the paged-KV pools shard over heads: shard s
+    holds q heads [s*nh/tp, (s+1)*nh/tp) and the matching kv heads, and
+    ITS OWN slice of every KV page. Page tables, lens, and the page
+    allocator stay replicated host state — paging decisions are
+    head-independent. The paged-attention / ragged kernels run
+    PER-SHARD on their local heads with no cross-shard traffic (head
+    independence is what makes KV the perfectly shardable half of
+    serving memory).
+  - MATMULS follow the reference's ColumnParallelLinear /
+    RowParallelLinear split (fleet/meta_parallel mp_layers + mp_ops):
+    wq/wk/wv and gate/up are column-parallel (output channels sharded,
+    int8 per-channel scales riding along), wo and down are the
+    row-parallel pair.
+
+Two tail modes, because exactness and wire-optimality pull apart:
+
+  tp_mode="exact" (default): the row-parallel pair is REASSEMBLED
+    instead of reduced — attention outputs all_gather over heads before
+    a replicated o_proj, MLP activations all_gather over columns before
+    a replicated down_proj. Every matmul then runs at exactly the
+    unsharded shapes on exactly the unsharded values, so greedy outputs
+    are byte-identical to the tp=1 engine (the repo's exactness bar,
+    pinned in tests/test_tp_decode.py). The cost: wo/wd compute and
+    residency are replicated (the gather moves the same bytes the psum
+    would).
+  tp_mode="psum": true Megatron row-parallel — wo/wd shard rows, each
+    shard computes a partial output, one per-token all-reduce per pair
+    (the fwd side of mp_ops._mp_allreduce; the bwd-identity half is
+    irrelevant at inference). tp_compress="int8" rides PR 4's
+    comm_compress.quantized_psum so the per-token reduce moves int8 +
+    per-chunk scales (~4x fewer wire bytes); the EF residual is dropped
+    (inference is stateless — there is no next step to carry it into).
+    f32 association differs from the single-chip dot, so outputs are
+    CLOSE (rtol-pinned), not byte-identical — the TPU perf mode.
+
+On the CPU/interpret mesh the collectives run over XLA host devices —
+the same programs, the same specs, byte-for-byte the math the TPU mesh
+runs — which is what lets the tier-1 suite pin tp=2/4 behavior without
+a pod. See docs/serving.md "Sharded decode & disaggregated prefill".
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..jax_compat import shard_map
+
+AXIS = "mp"                    # the serving model-parallel mesh axis
+REPL = P()                     # replicated spec (tables, lens, tokens…)
+POOL = P(None, None, AXIS, None)   # [n_pages, page, heads, hd] pools
+
+
+class TPContext:
+    """Mesh + spec + collective bundle for one tensor-parallel engine.
+
+    tp: shard count (must divide both nh and nh_kv — heads shard
+      evenly; GQA groups never split across shards because nh/nh_kv is
+      preserved per shard).
+    mode: "exact" | "psum" (module docstring).
+    compress: None | "int8" — quantize the psum-mode all-reduce
+      (rejected under "exact": there is no reduce to compress).
+    """
+
+    def __init__(self, tp, mode="exact", compress=None, devices=None):
+        tp = int(tp)
+        if tp < 2:
+            raise ValueError(f"TPContext needs tp >= 2, got {tp}")
+        if mode not in ("exact", "psum"):
+            raise ValueError(
+                f"tp_mode must be 'exact' or 'psum', got {mode!r}")
+        if compress not in (None, "int8"):
+            raise ValueError(
+                f"tp_compress must be None or 'int8', got {compress!r}")
+        if compress is not None and mode != "psum":
+            raise ValueError(
+                "tp_compress rides the per-token all-reduce, which only "
+                "exists under tp_mode='psum' (the 'exact' mode gathers "
+                "instead of reducing)")
+        devs = list(devices if devices is not None else jax.devices())
+        if len(devs) < tp:
+            raise ValueError(
+                f"tp={tp} needs {tp} devices but only {len(devs)} are "
+                f"visible (backend {jax.default_backend()!r}); on CPU "
+                "set --xla_force_host_platform_device_count")
+        self.tp = tp
+        self.mode = mode
+        self.compress = compress
+        self.mesh = Mesh(np.array(devs[:tp]), (AXIS,))
+
+    # -- spec construction --------------------------------------------------
+    def _col(self, w):
+        """Column-parallel weight spec: [in, out] sharded on out; int8
+        (w, scales) pairs shard the per-output-channel scales along."""
+        return (P(None, AXIS), P(AXIS)) if isinstance(w, tuple) \
+            else P(None, AXIS)
+
+    def _tail(self, w):
+        """The row-parallel pair's spec: sharded rows under "psum"
+        (scales are per-OUTPUT-channel — replicated when rows shard),
+        fully replicated under "exact"."""
+        if self.mode == "psum":
+            return (P(AXIS, None), P()) if isinstance(w, tuple) \
+                else P(AXIS, None)
+        return (P(), P()) if isinstance(w, tuple) else P()
+
+    def weight_specs(self, weights):
+        """PartitionSpec pytree mirroring an LLMEngine weight snapshot
+        (_snapshot_llama shape + the rope tables)."""
+        layers = [dict(ln1=P(), ln2=P(),
+                       wq=self._col(ws["wq"]), wk=self._col(ws["wk"]),
+                       wv=self._col(ws["wv"]), wo=self._tail(ws["wo"]),
+                       wg=self._col(ws["wg"]), wu=self._col(ws["wu"]),
+                       wd=self._tail(ws["wd"]))
+                  for ws in weights["layers"]]
+        spec = {k: P() for k in weights if k not in ("layers", "head")}
+        spec["layers"] = layers
+        # lm_head stays replicated in both modes: sampling needs the
+        # full vocab row anyway, and a vocab-parallel head (+gather) is
+        # a follow-up orthogonal to the decode sharding
+        spec["head"] = (P(), P()) if isinstance(weights["head"], tuple) \
+            else P()
+        return spec
+
+    # -- placement ----------------------------------------------------------
+    def place(self, tree, specs):
+        """device_put every ARRAY leaf onto the mesh per its spec
+        (python scalars — eps — pass through untouched so they stay
+        weak-typed inside the traced math)."""
+        def put(x, s):
+            if not hasattr(x, "ndim"):
+                return x
+            return jax.device_put(x, NamedSharding(self.mesh, s))
+        return jax.tree_util.tree_map(put, tree, specs)
+
+    def place_pools(self, pools):
+        return [jax.device_put(p, NamedSharding(self.mesh, POOL))
+                for p in pools]
+
+    # -- the shard_map wrapper ----------------------------------------------
+    def wrap(self, fn, in_specs, out_specs):
+        return shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+    # -- in-trace collectives (called from the engine's layer math) ---------
+    def gather_heads(self, x):
+        """[..., nh_local, hd] -> [..., nh, hd]: reassemble the exact
+        per-head attention outputs in shard (= original head) order —
+        pure data movement, no arithmetic, so byte-identity survives."""
+        return lax.all_gather(x, AXIS, axis=x.ndim - 2, tiled=True)
+
+    def gather_cols(self, x):
+        """[..., cols_local] -> [..., cols] (exact-mode MLP activation
+        reassembly before the replicated down_proj)."""
+        return lax.all_gather(x, AXIS, axis=x.ndim - 1, tiled=True)
+
+    def reduce(self, x):
+        """psum-mode row-parallel output reduce: the fwd-allreduce of
+        mp_ops._mp_allreduce, optionally int8-quantized through PR 4's
+        two-stage quantized_psum (EF residual dropped — inference)."""
+        if self.compress == "int8":
+            from ..distributed.comm_compress import quantized_psum
+            y, _err = quantized_psum(x, AXIS, axis_size=self.tp)
+            return y.astype(x.dtype)
+        # the cached custom-vjp allreduce the training MP layers use —
+        # at inference only its forward (lax.psum) ever runs
+        from ..distributed.fleet.meta_parallel.parallel_layers.mp_ops \
+            import _allreduce_fn
+        return _allreduce_fn(AXIS)(x)

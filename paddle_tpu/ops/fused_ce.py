@@ -92,11 +92,21 @@ def fused_linear_ce(h, w, labels, axis=None, chunk=4096, ignore_index=-100,
             logits, lc, axis=axis, ignore_index=ignore_index)
         valid = lc != ignore_index
         tot, cnt = carry
-        return (tot + jnp.sum(li),
-                cnt + jnp.sum(valid.astype(jnp.float32))), None
+        return (tot + jnp.sum(li, keepdims=True),
+                cnt + jnp.sum(valid.astype(jnp.float32),
+                              keepdims=True)), None
 
     body = jax.checkpoint(body)
+    # the accumulators are RANK-1 [1] on purpose, squeezed only at the
+    # return: a rank-0 lax.scan carry inside shard_map breaks jax.grad
+    # on the 0.4.x stack — partial-eval turns the scalar carry into a
+    # residual that dodges shard_map's _promote_scalar_residuals (it is
+    # forwarded, not fresh), so the transpose binds a rank-0 aval to
+    # {0: axis} out-names and dies in _check_names with _SpecError.
+    # Rank-1 carries sidestep the promotion entirely; the math is
+    # unchanged (tier-1 fused_ce parity tests pin both paths, and
+    # test_scalar_scan_carry_grad_under_shard_map pins the trap class).
     (total, count), _ = lax.scan(
-        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        body, (jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.float32)),
         (hm, lm))
-    return total, count
+    return total[0], count[0]

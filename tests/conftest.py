@@ -48,27 +48,49 @@ _SINGLE_TEST_GRANDFATHERED = (
     "tests/test_acceptance_configs.py::test_config1_resnet_dygraph",
     "tests/test_cross_mesh_checkpoint.py::test_zero3_to_zero2_and_pipe",
     "tests/test_device_decode_loop.py::test_device_loop_eos_trims_like_host",
-    "tests/test_elastic_resume.py::test_kill_watch_restart_resume",
-    "tests/test_fault_injection.py::TestServingFaultIsolation::"
-    "test_decode_fault_retires_one_request",
-    "tests/test_flash_dropout.py::test_grad_matches_finite_difference",
-    "tests/test_flash_dropout.py::test_mean_preserved_roughly",
-    "tests/test_multistep_decode.py::TestFusedEquivalence::"
-    "test_k8_matches_k1_on_ragged_stream",
-    "tests/test_namespace_tail.py::test_model_variant_factories",
     "tests/test_pipeline_1f1b.py::TestOneFOneB::"
     "test_1f1b_memory_bounded_in_microbatches",
     "tests/test_ring_attention.py::test_ring_attention_grads",
-    "tests/test_sequence_parallel.py::test_sep2_dp2_matches_dense",
-    "tests/test_sequence_parallel.py::test_sep2_matches_dense_long_seq",
-    "tests/test_sequence_parallel.py::test_sep2_mp2_matches_dense",
     "tests/test_serving_weight_dtype.py::test_lazy_int8_matches_eager_int8",
-    "tests/test_spmd_trainer.py::test_parallel_configs_agree",
     "tests/test_training_e2e.py::TestDygraphTraining::"
     "test_resnet18_forward_backward",
-    # (PR 7 shrank this list: the test_vision_models.py forward sweeps
-    # are @pytest.mark.slow now instead of grandfathered hogs)
+    # These two inherited the module-fixture COMPILE bill when PR 10
+    # moved test_k8_matches_k1_on_ragged_stream (which used to run
+    # first and absorb it) to slow: measured 22.2s/18.0s as the first
+    # cb8-fixture consumers, ~7s warm. Shrinking the shared fixture's
+    # compile surface is the real fix (follow-up).
+    "tests/test_multistep_decode.py::TestFusedEquivalence::"
+    "test_eos_retirement_matches",
+    "tests/test_multistep_decode.py::TestFusedEquivalence::"
+    "test_pipelined_chaining_same_bytes",
+    # (PR 7 moved the test_vision_models.py forward sweeps to slow;
+    # PR 10 moved the 10 slowest remaining hogs — see
+    # _PR10_RECLAIMED_S below. The entries still here all measured
+    # UNDER the 15s budget solo and stay only as load-headroom: a
+    # suite-contended run can push a 10-14s test past the boundary,
+    # which is exactly the PR 8 prefix_share flake class.)
 )
+
+# The 10 slowest grandfathered tests, measured solo on this box at PR
+# 10 and moved to @pytest.mark.slow — their tier-1 window seconds now
+# run the new TP/handoff suites instead of re-proving long-stable
+# coverage every run (the full suite still runs them on the slow lane).
+_PR10_RECLAIMED_S = {
+    "tests/test_elastic_resume.py::test_kill_watch_restart_resume": 107.7,
+    "tests/test_namespace_tail.py::test_model_variant_factories": 70.9,
+    "tests/test_flash_dropout.py::test_grad_matches_finite_difference":
+        56.7,
+    "tests/test_multistep_decode.py::TestFusedEquivalence::"
+    "test_k8_matches_k1_on_ragged_stream": 40.2,
+    "tests/test_sequence_parallel.py::test_sep2_dp2_matches_dense": 31.5,
+    "tests/test_sequence_parallel.py::test_sep2_mp2_matches_dense": 31.0,
+    "tests/test_sequence_parallel.py::test_sep2_matches_dense_long_seq":
+        31.0,
+    "tests/test_flash_dropout.py::test_mean_preserved_roughly": 23.3,
+    "tests/test_fault_injection.py::TestServingFaultIsolation::"
+    "test_decode_fault_retires_one_request": 18.5,
+    "tests/test_spmd_trainer.py::test_parallel_configs_agree": 14.1,
+}
 _suite_t0 = [None]
 _test_durations = []
 _overbudget = []
@@ -91,6 +113,30 @@ def pytest_configure(config):
 
 def pytest_sessionstart(session):
     _suite_t0[0] = time.monotonic()
+
+
+# The tier-1 window (870s) truncates the suite TAIL, and pytest
+# collects alphabetically — so a new PR's acceptance tests, usually
+# named after their feature, land exactly where the timeout bites.
+# Hoist the newest acceptance files to the FRONT of the collection:
+# the truncated tail then re-proves long-stable coverage instead of
+# silently skipping the tests this PR is gated on. (Ordering is
+# file-granular; within a file, order is unchanged.)
+_COLLECT_FIRST = (
+    "tests/test_tp_decode.py",        # PR 10 tensor-parallel decode
+    "tests/test_kv_handoff.py",       # PR 10 disaggregated handoff
+)
+
+
+def pytest_collection_modifyitems(session, config, items):
+    def rank(item):
+        nodeid = item.nodeid
+        for i, prefix in enumerate(_COLLECT_FIRST):
+            if nodeid.startswith(prefix):
+                return i
+        return len(_COLLECT_FIRST)
+
+    items.sort(key=rank)              # stable: non-hoisted order kept
 
 
 _budget_warned = [False]
@@ -141,6 +187,10 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
     tr.section("tier-1 runtime guard")
     tr.write_line(f"total wall time: {total:.1f}s "
                   f"(driver timeout 870s, warn at {_SUITE_BUDGET_WARN_S}s)")
+    tr.write_line(
+        f"PR 10 reclaimed {sum(_PR10_RECLAIMED_S.values()):.0f}s of "
+        f"tier-1 wall ({len(_PR10_RECLAIMED_S)} grandfathered hogs "
+        "moved to slow; solo-measured durations in conftest)")
     # delta vs the previous COMPLETED full-suite run (cacheprovider is
     # disabled in the tier-1 command, so the record lives in a sidecar
     # file; a run the driver kills at 870s never reaches this hook and

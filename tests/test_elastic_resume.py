@@ -65,6 +65,7 @@ def _wait_file(path, timeout, procs=()):
     return False
 
 
+@pytest.mark.slow
 def test_kill_watch_restart_resume(tmp_path):
     from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
                                                       ElasticStatus)

@@ -74,6 +74,7 @@ def _assert_no_leak(cb):
 
 # -- serving: per-request isolation -----------------------------------------
 class TestServingFaultIsolation:
+    @pytest.mark.slow
     def test_decode_fault_retires_one_request(self, tiny, ref_engine):
         model, cfg = tiny
         rng = np.random.RandomState(0)

@@ -34,6 +34,7 @@ def test_deterministic_per_seed_and_differs_across_seeds(flash):
     assert np.abs(np.asarray(o1) - np.asarray(o3)).max() > 1e-4
 
 
+@pytest.mark.slow
 def test_mean_preserved_roughly(flash):
     # inverted-dropout scaling: E[out] == no-dropout out. The regression
     # slope <avg, o0>/<o0, o0> is robust to the zero-mean sampling noise
@@ -50,6 +51,7 @@ def test_mean_preserved_roughly(flash):
     assert np.isfinite(avg).all()
 
 
+@pytest.mark.slow
 def test_grad_matches_finite_difference(flash):
     """The backward kernels must regenerate the EXACT forward keep mask:
     with a fixed seed the function is deterministic, so analytic grads

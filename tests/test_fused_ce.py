@@ -75,6 +75,35 @@ def test_fused_vocab_parallel_matches_unsharded():
                                atol=1e-5)
 
 
+def test_scalar_scan_carry_grad_under_shard_map():
+    """Regression pin for the fused_ce vocab-parallel grad failure (the
+    pre-existing tier-1 break since PR 6): on the 0.4.x stack a RANK-0
+    lax.scan carry inside shard_map kills jax.grad with _SpecError —
+    the scalar carry becomes a partial-eval residual that dodges
+    _promote_scalar_residuals, so the transpose binds a rank-0 aval to
+    {0: axis} out-names. fused_linear_ce now carries rank-1 [1]
+    accumulators (squeezed at the return); this test pins BOTH that the
+    fused path differentiates under shard_map and that the rank-1-carry
+    shape of the same scan does (the trap-class witness), without
+    depending on the CE math."""
+    from jax import lax
+
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("model",))
+    xs = jnp.asarray(np.random.RandomState(3).randn(4, 8), jnp.float32)
+
+    def f(x):
+        def body(c, row):
+            return c + lax.psum(jnp.sum(row, keepdims=True), "model"), None
+        body = jax.checkpoint(body)
+        tot, _ = lax.scan(body, jnp.zeros((1,), jnp.float32), x)
+        return tot[0]
+
+    g = jax.grad(lambda x: shard_map(f, mesh=mesh,
+                                     in_specs=(P(None, "model"),),
+                                     out_specs=P(), check_vma=False)(x))(xs)
+    np.testing.assert_allclose(np.asarray(g), 1.0, rtol=1e-6)
+
+
 def test_ce_rows_ignore_index_zeroes_loss_and_grad():
     rng = np.random.RandomState(2)
     logits = jnp.asarray(rng.randn(6, 10), jnp.float32)

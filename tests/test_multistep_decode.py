@@ -58,6 +58,7 @@ def ragged_stream(cfg, n, seed=0, max_budget=12):
 
 
 class TestFusedEquivalence:
+    @pytest.mark.slow
     def test_k8_matches_k1_on_ragged_stream(self, tiny, cb1, cb8):
         # tier-1-sized (suite is 870s-timeout-bound): 5 ragged requests
         # over 4 slots still exercises queueing, mixed prefill+decode

@@ -233,6 +233,7 @@ def test_vision_dataset_families():
     assert seg_map.shape == (224, 224) and seg_map.dtype == np.int64
 
 
+@pytest.mark.slow
 def test_model_variant_factories():
     from paddle_tpu.vision import models as M
     paddle.seed(3)

@@ -36,6 +36,7 @@ def _traj(axes, seq=2048, steps=3, **kw):
     return out, tr, st
 
 
+@pytest.mark.slow
 def test_sep2_matches_dense_long_seq():
     base, _, _ = _traj({"data": 1, "pipe": 1, "sharding": 1, "model": 1})
     sp, _, _ = _traj({"data": 1, "pipe": 1, "sharding": 1, "model": 1, "sep": 2})
@@ -43,6 +44,7 @@ def test_sep2_matches_dense_long_seq():
                                err_msg=f"sep2 {sp} vs dense {base}")
 
 
+@pytest.mark.slow
 def test_sep2_dp2_matches_dense():
     base, _, _ = _traj({"data": 1, "pipe": 1, "sharding": 1, "model": 1})
     sp, _, _ = _traj({"data": 2, "pipe": 1, "sharding": 1, "model": 1, "sep": 2}, )
@@ -50,6 +52,7 @@ def test_sep2_dp2_matches_dense():
                                err_msg=f"dp2xsep2 {sp} vs dense {base}")
 
 
+@pytest.mark.slow
 def test_sep2_mp2_matches_dense():
     base, _, _ = _traj({"data": 1, "pipe": 1, "sharding": 1, "model": 1})
     sp, _, _ = _traj({"data": 1, "pipe": 1, "sharding": 1, "model": 2, "sep": 2})

@@ -54,6 +54,7 @@ def test_trainer_runs_and_learns(axes):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_parallel_configs_agree():
     """Same data + same init => same loss trajectory regardless of mesh
     split (the reference's N-proc-vs-1-proc loss comparison,

@@ -105,6 +105,28 @@ def main():
                     help="int8-quantize the psum-mode all-reduce "
                          "(comm_compress.quantized_psum; ~4x fewer "
                          "wire bytes)")
+    ap.add_argument("--kv-tier", choices=["host", "disk"], default=None,
+                    help="KV tiering: demote cold request pages out of "
+                         "the device pool to host RAM ('host') or host+"
+                         "disk ('disk', spilling under --tier-dir) in "
+                         "the CRC-stamped page-export format, restoring "
+                         "on demand at a block boundary — admission "
+                         "OVERSUBSCRIBES device pages against the tier, "
+                         "so long conversations survive at a fraction "
+                         "of HBM cost (scheduler/router modes, "
+                         "docs/serving.md \"Prefix-aware routing & KV "
+                         "tiering\")")
+    ap.add_argument("--tier-dir", default="/tmp/paddle_tpu_kv_tier",
+                    help="spill directory for --kv-tier disk")
+    ap.add_argument("--prefix-routing", action="store_true",
+                    help="cache-aware routing: replicas publish their "
+                         "content-addressed prefix chains into a fleet "
+                         "index and each admission lands on the replica "
+                         "with the longest cached prefix (headroom-"
+                         "weighted; a loaded best-prefix replica SHIPS "
+                         "its pages to a fresh one over the ticketed "
+                         "transfer path instead of re-prefilling) — "
+                         "needs --replicas >= 2")
     ap.add_argument("--disagg", metavar="P:D", default=None,
                     help="disaggregated serving: P prefill workers + D "
                          "decode workers behind the router — new "
@@ -160,6 +182,14 @@ def main():
     if args.hot_swap and args.replicas < 2:
         ap.error("--hot-swap needs --replicas >= 2 (the router keeps "
                  "serving from the other replicas while one flips)")
+    if args.prefix_routing and args.replicas < 2 and not args.disagg:
+        ap.error("--prefix-routing needs --replicas >= 2 (a fleet to "
+                 "route across)")
+    tier_kw = {}
+    if args.kv_tier:
+        tier_kw = dict(kv_tier=args.kv_tier,
+                       tier_dir=(args.tier_dir
+                                 if args.kv_tier == "disk" else None))
     if args.disagg:
         # disaggregated prefill/decode: P prefill + D decode workers,
         # requests migrate at first-token via KV-page handoff
@@ -174,10 +204,11 @@ def main():
                 model, max_len=g["max_len"], page_size=g["page"],
                 max_batch=max(2, g["bs"]), quant=quant,
                 weight_dtype=weight_dtype,
-                decode_block=args.decode_block, **tp_kw)
+                decode_block=args.decode_block, **tp_kw, **tier_kw)
 
         router = EngineRouter(factory,
-                              topology={"prefill": p_n, "decode": d_n})
+                              topology={"prefill": p_n, "decode": d_n},
+                              prefix_routing=args.prefix_routing)
         rng = np.random.RandomState(0)
         prompts = [rng.randint(0, g["cfg"].vocab_size, (t,))
                    .astype(np.int64) for t in (16, 9, 5, 12)]
@@ -208,13 +239,31 @@ def main():
                 model, max_len=g["max_len"], page_size=g["page"],
                 max_batch=max(2, g["bs"]), quant=quant,
                 weight_dtype=weight_dtype,
-                decode_block=args.decode_block, **tp_kw)
+                decode_block=args.decode_block, **tp_kw, **tier_kw)
 
-        router = EngineRouter(factory, replicas=args.replicas)
+        router = EngineRouter(factory, replicas=args.replicas,
+                              prefix_routing=args.prefix_routing)
         rng = np.random.RandomState(0)
         prompts = [rng.randint(0, g["cfg"].vocab_size, (t,))
                    .astype(np.int64) for t in (16, 9, 5, 12)]
-        uids = [router.add_request(p, max_new_tokens=args.max_new_tokens)
+        if args.prefix_routing:
+            # a shared system prompt: requests 1-3 reuse request 0's
+            # published pages — and the index steers them to its replica
+            prompts = [np.concatenate([prompts[0], p[:4]])
+                       for p in prompts[:3]] + [prompts[3]]
+        if args.prefix_routing:
+            # let request 0 finish (and publish its prompt pages +
+            # index claims) before the prefix-sharing follow-ups
+            # arrive — that is the traffic shape the index steers
+            uids = [router.add_request(
+                prompts[0], max_new_tokens=args.max_new_tokens)]
+            router.drain()
+            uids += [router.add_request(
+                p, max_new_tokens=args.max_new_tokens)
+                for p in prompts[1:]]
+        else:
+            uids = [router.add_request(
+                p, max_new_tokens=args.max_new_tokens)
                 for p in prompts]
         for _ in range(2):
             router.step()                    # replicas mid-flight
@@ -229,6 +278,17 @@ def main():
               f"router: {len(uids)} requests over {args.replicas} "
               f"replicas, {h['done']} done / {h['failed']} failed, "
               f"{h['failovers']} failovers, {h['hot_swaps']} hot-swaps")
+        if args.prefix_routing:
+            fleet_hits = sum(rep.engine._prefix.hits
+                             for rep in router._replicas)
+            print(f"  prefix routing: {h['prefix_routed']} steered, "
+                  f"{h['prefix_ships']} page ships, {fleet_hits} fleet "
+                  f"prefix-page hits, index={h['prefix_index']}")
+        if args.kv_tier:
+            print("  kv tier:", {rep.name: {
+                "demotions": rep.engine.demotions,
+                "restores": rep.engine.restores}
+                for rep in router._replicas})
         for name, rh in h["replicas"].items():
             print(f"  {name}: breaker={rh['breaker']} "
                   f"pages_free={rh.get('pages_free')}")
@@ -258,7 +318,7 @@ def main():
                                   and args.megakernel == "auto") else
                         {"auto": None, "off": False}.get(args.megakernel,
                                                          args.megakernel)),
-            **tp_kw)
+            **tp_kw, **tier_kw)
         rng = np.random.RandomState(0)
         # ragged prompts; 1 shares 0's prefix (once 0 finishes prefill,
         # the cache turns the shared pages into refcounted read-only
@@ -310,6 +370,10 @@ def main():
         h = engine.health()
         print(f"  health: {h['done']} done / {h['failed']} failed, "
               f"{h['pages_free']}/{h['pages_total']} pages free")
+        if args.kv_tier:
+            print(f"  kv tier ({h['kv_tier']}): {h['demotions']} "
+                  f"demotions / {h['restores']} restores "
+                  f"({h['restore_failures']} failed), tier={h['tier']}")
         return
 
     engine = LLMEngine(model, max_len=g["max_len"], page_size=g["page"],

@@ -373,6 +373,11 @@ _SERVING_EXPORTS = {
     "TPContext": "tp",
     # KV-page handoff (disaggregated prefill/decode)
     "KVHandoffError": "handoff", "StoreKVTransport": "handoff",
+    # cluster-scale KV memory hierarchy (docs/serving.md "Prefix-aware
+    # routing & KV tiering"): the fleet prefix index backends and the
+    # host/disk tier store
+    "PrefixIndex": "prefix_index", "StorePrefixIndex": "prefix_index",
+    "KVTierStore": "tiering", "KVTierError": "tiering",
 }
 
 

@@ -1,0 +1,287 @@
+"""Fleet-wide content-addressed prefix index (cache-aware routing).
+
+The per-engine `PrefixCache` (scheduler.py) already content-addresses
+full prompt pages by a CHAIN key — nested (parent_key, page_tokens)
+tuples, so a page matches only when its entire prompt prefix matches.
+At fleet scale that knowledge is stranded per replica: the router
+cannot know that replica r2 holds 6 cached pages of the hot system
+prompt, so it health-balances the request onto r0 and re-prefills what
+the fleet already computed (the ragged-paged-attention paper's point:
+prefix reuse IS the serving win for chat traffic).
+
+This module publishes those chain keys fleet-wide as compact DIGESTS:
+
+  - `chain_digest(parent_digest, page_tokens)`: one sha1 step per page,
+    so digest_j names the exact token content of the first j pages —
+    the same content-addressing as the chain key, hashed down to a
+    store-friendly hex string.
+  - `PrefixIndex`: the in-process backend — {digest: {replica:
+    (n_pages, stamp)}} with a monotonic stamp for expiry and an LRU
+    entry cap. Engines publish on prefill/import publish and retract on
+    cache eviction; the router reads `lookup()` at admission.
+  - `StorePrefixIndex`: the SAME surface over the TCPStore rendezvous
+    (distributed/store.py) for cross-process fleets — last-writer-wins
+    JSON merges per digest key (the index is a routing HINT: a stale or
+    torn entry costs one re-prefill, never correctness), a store
+    counter (`add`) as the shared stamp clock, and a per-replica digest
+    roster so `drop_replica` can clean up after a death.
+
+Consistency model (docs/serving.md "Prefix-aware routing & KV
+tiering"): the index is ADVISORY and eventually consistent. Publishes
+are fire-and-forget (the engine counts, never raises, past the
+`index.publish` fault point); lookups may name a replica whose cache
+has since evicted the pages — admission then simply misses the prefix
+cache and re-prefills, byte-identical either way. The router drops a
+replica's entries when it is declared dead or rebuilt; `expire()`
+ages out entries that were never retracted (a crashed publisher).
+"""
+import collections
+import hashlib
+import json
+
+import numpy as np
+
+from ..failsafe import fault_point
+
+EMPTY_DIGEST = ""
+
+
+def chain_digest(parent_digest, page_tokens):
+    """Digest of a page chain extended by one page: sha1 over the
+    parent's hex digest + this page's token content. Two chains share
+    a digest iff they share the whole token prefix (the chain-key
+    contract, hashed)."""
+    h = hashlib.sha1(parent_digest.encode())
+    h.update(np.ascontiguousarray(
+        np.asarray(page_tokens, np.int64)).tobytes())
+    return h.hexdigest()
+
+
+def prompt_digests(ids, page_size):
+    """Digests of every FULL-page prefix of a prompt, shortest first:
+    digests[j-1] names pages 0..j-1. The partial tail page is excluded
+    — only full pages are ever published (they are what the prefix
+    cache shares read-only)."""
+    ids = np.asarray(ids, np.int64).ravel()
+    out = []
+    d = EMPTY_DIGEST
+    for j in range(ids.size // page_size):
+        d = chain_digest(d, ids[j * page_size:(j + 1) * page_size])
+        out.append(d)
+    return out
+
+
+def chain_key_digest(chain_key):
+    """Digest of a PrefixCache chain key (nested (parent, tokens)
+    tuples) — what an engine retracts when the cache evicts that
+    entry."""
+    chunks = []
+    key = chain_key
+    while key != ():
+        key, toks = key
+        chunks.append(toks)
+    d = EMPTY_DIGEST
+    for toks in reversed(chunks):
+        d = chain_digest(d, toks)
+    return d
+
+
+class PrefixIndex:
+    """In-process fleet prefix index: {digest: {replica: (n_pages,
+    stamp)}}. All methods are cheap host ops; `publish` carries the
+    `index.publish` fault point (callers treat publish as advisory and
+    swallow the raise — chaos runs verify that posture)."""
+
+    def __init__(self, max_entries=65536):
+        self.max_entries = int(max_entries)
+        self._entries = collections.OrderedDict()
+        self._stamp = 0
+        self.publishes = 0
+        self.retractions = 0
+
+    def __len__(self):
+        return len(self._entries)
+
+    def clock(self):
+        """Monotonic publish stamp (for expire())."""
+        return self._stamp
+
+    def publish(self, replica, digest, n_pages):
+        """Record that `replica` holds the `n_pages`-page chain named
+        by `digest`. Re-publishing refreshes the stamp (hot prefixes
+        never age out while traffic touches them)."""
+        fault_point("index.publish", detail=f"{replica}:{n_pages}")
+        self._stamp += 1
+        ent = self._entries.get(digest)
+        if ent is None:
+            ent = self._entries[digest] = {}
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+        else:
+            self._entries.move_to_end(digest)
+        ent[replica] = (int(n_pages), self._stamp)
+        self.publishes += 1
+
+    def retract(self, replica, digest):
+        """Remove one replica's claim on a digest (cache eviction)."""
+        ent = self._entries.get(digest)
+        if ent is None:
+            return
+        if ent.pop(replica, None) is not None:
+            self.retractions += 1
+        if not ent:
+            del self._entries[digest]
+
+    def drop_replica(self, replica):
+        """Remove EVERY claim by a replica (declared dead, rebuilt, or
+        weight-flipped — its cache is gone or stale). Returns the
+        number of claims dropped."""
+        dropped = 0
+        for digest in list(self._entries):
+            ent = self._entries[digest]
+            if ent.pop(replica, None) is not None:
+                dropped += 1
+            if not ent:
+                del self._entries[digest]
+        self.retractions += dropped
+        return dropped
+
+    def expire(self, max_age):
+        """Drop claims whose stamp is older than `max_age` publishes
+        ago — the cleanup for publishers that died without retracting.
+        Returns the number of claims dropped."""
+        floor = self._stamp - int(max_age)
+        dropped = 0
+        for digest in list(self._entries):
+            ent = self._entries[digest]
+            for rep in [r for r, (_, s) in ent.items() if s < floor]:
+                del ent[rep]
+                dropped += 1
+            if not ent:
+                del self._entries[digest]
+        self.retractions += dropped
+        return dropped
+
+    def lookup(self, digests):
+        """{replica: covered_pages} — each replica's LONGEST published
+        chain among `digests` (shortest-first, as prompt_digests
+        returns them). Empty dict on a cold fleet."""
+        out = {}
+        for j in range(len(digests), 0, -1):
+            ent = self._entries.get(digests[j - 1])
+            if not ent:
+                continue
+            for rep in ent:
+                if rep not in out:
+                    out[rep] = j
+        return out
+
+    def stats(self):
+        return {"entries": len(self._entries), "stamp": self._stamp,
+                "publishes": self.publishes,
+                "retractions": self.retractions}
+
+
+class StorePrefixIndex:
+    """The PrefixIndex surface over a TCPStore (cross-process fleets).
+
+    Layout: `{prefix}/e/{digest}` holds a JSON {replica: [n_pages,
+    stamp]} map (read-modify-write, last-writer-wins — tolerable for a
+    routing hint); `{prefix}/r/{replica}` is that replica's published
+    digest roster (what drop_replica walks); `{prefix}/clock` is the
+    shared stamp counter (store.add)."""
+
+    def __init__(self, store, prefix="pfxidx", max_roster=4096,
+                 max_probe=32):
+        self.store = store
+        self.prefix = prefix
+        self.max_roster = int(max_roster)
+        # lookup() RTT bound: probe at most this many digests (longest
+        # first) per admission — without it a 2k-token prompt costs one
+        # store round trip per page on the routing hot path
+        self.max_probe = int(max_probe)
+        self.publishes = 0
+        self.retractions = 0
+
+    # -- store helpers ------------------------------------------------------
+    def _get_json(self, key, default):
+        try:
+            return json.loads(self.store.get(key, wait=False).decode())
+        except (KeyError, ValueError):
+            return default
+
+    def _set_json(self, key, obj):
+        self.store.set(key, json.dumps(obj).encode())
+
+    def clock(self):
+        return self._get_json(f"{self.prefix}/clock_v", 0)
+
+    # -- index surface ------------------------------------------------------
+    def publish(self, replica, digest, n_pages):
+        fault_point("index.publish", detail=f"{replica}:{n_pages}")
+        stamp = int(self.store.add(f"{self.prefix}/clock", 1))
+        self._set_json(f"{self.prefix}/clock_v", stamp)
+        ekey = f"{self.prefix}/e/{digest}"
+        ent = self._get_json(ekey, {})
+        ent[replica] = [int(n_pages), stamp]
+        self._set_json(ekey, ent)
+        rkey = f"{self.prefix}/r/{replica}"
+        roster = self._get_json(rkey, [])
+        if digest not in roster:
+            roster.append(digest)
+            dropped = roster[:-self.max_roster]
+            roster = roster[-self.max_roster:]
+            self._set_json(rkey, roster)
+            # claims trimmed off the roster must leave the store too:
+            # drop_replica only walks the roster, so an orphaned entry
+            # would advertise this replica forever after its death
+            for old in dropped:
+                self.retract(replica, old)
+        self.publishes += 1
+
+    def retract(self, replica, digest):
+        ekey = f"{self.prefix}/e/{digest}"
+        ent = self._get_json(ekey, {})
+        if ent.pop(replica, None) is None:
+            return
+        self.retractions += 1
+        if ent:
+            self._set_json(ekey, ent)
+        else:
+            self.store.delete_key(ekey)
+
+    def drop_replica(self, replica):
+        rkey = f"{self.prefix}/r/{replica}"
+        roster = self._get_json(rkey, [])
+        for digest in roster:
+            self.retract(replica, digest)
+        self.store.delete_key(rkey)
+        return len(roster)
+
+    def expire(self, max_age):
+        """Cross-process expire is per-entry on read (lookup drops
+        nothing server-side); operators run drop_replica on dead
+        workers instead. Provided for surface parity: walks no keys,
+        returns 0 (the store has no key enumeration)."""
+        return 0
+
+    def lookup(self, digests):
+        """Longest-chain claims, bounded: probes at most `max_probe`
+        digests longest-first and STOPS at the first hit — the longest
+        chain decides routing; replicas holding only shorter prefixes
+        are omitted (a hint degradation, not an error; the in-process
+        PrefixIndex returns the full per-replica map)."""
+        out = {}
+        floor = max(0, len(digests) - self.max_probe)
+        for j in range(len(digests), floor, -1):
+            ent = self._get_json(f"{self.prefix}/e/{digests[j - 1]}", {})
+            if ent:
+                for rep in ent:
+                    out[rep] = j
+                break
+        return out
+
+    def stats(self):
+        return {"entries": None, "stamp": self.clock(),
+                "publishes": self.publishes,
+                "retractions": self.retractions}

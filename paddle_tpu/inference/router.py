@@ -55,7 +55,7 @@ import numpy as np
 
 from ..failsafe import (RetriesExhaustedError, fault_point,
                         retry_with_backoff)
-from .scheduler import (DECODE, DONE, FAILED, PREFILL, QUEUED,
+from .scheduler import (DECODE, DEMOTED, DONE, FAILED, PREFILL, QUEUED,
                         EngineBusyError, EngineFullError, RequestFailure,
                         RequestFailedError, RequestNotFinishedError,
                         SchedulerError, UnknownRequestError)
@@ -168,6 +168,8 @@ class EngineReplica:
         self.swaps = 0                  # weight flips applied
         self.failed_probes = 0          # consecutive exhausted probe
         #                                 series (rebuild trigger)
+        self._prefix_index = None       # fleet prefix index (re-wired
+        #                                 across rebuilds)
 
     # -- traffic -----------------------------------------------------------
     def submit(self, spec):
@@ -188,8 +190,11 @@ class EngineReplica:
         return self.engine.headroom()
 
     def has_work(self):
+        # demoted counts as work: the engine's restore sweep only runs
+        # when stepped — a replica whose ONLY live request is parked in
+        # the tier must keep stepping or that request strands forever
         h = self.engine.headroom()
-        return bool(h["queued"] or h["running"])
+        return bool(h["queued"] or h["running"] or h.get("demoted"))
 
     # -- per-request state -------------------------------------------------
     def status(self, uid):
@@ -215,10 +220,39 @@ class EngineReplica:
         return None
 
     def queue_head_uid(self):
-        """The engine uid admission would pick next (the request an
-        EngineFullError is complaining about)."""
+        """The engine uid an idle-engine EngineFullError is complaining
+        about: the admission queue head, else the demoted-restore head
+        (a parked request whose fresh-page need cannot be met — same
+        capacity contract)."""
         q = self.engine._queue
-        return self.engine._pick_next().uid if q else None
+        if q:
+            return self.engine._pick_next().uid
+        demoted = self.engine._demoted
+        return next(iter(demoted)) if demoted else None
+
+    # -- fleet prefix index (cache-aware routing) -----------------------------
+    def attach_prefix_index(self, index):
+        """Wire this replica's engine into the fleet prefix index under
+        the replica name (publishes on prefill, retracts on eviction)."""
+        self._prefix_index = index
+        self.engine.attach_prefix_index(index, self.name)
+
+    def page_size(self):
+        return self.engine.page_size
+
+    def export_prefix(self, ids):
+        """Ticketed export of this replica's cached prefix chain for
+        `ids` (None when nothing is cached — a stale index hint)."""
+        return self.engine.export_prefix_pages(ids)
+
+    def import_prefix(self, payload):
+        return self.engine.import_prefix_pages(payload)
+
+    def finish_prefix_export(self, token):
+        return self.engine.finish_prefix_export(token)
+
+    def abort_prefix_export(self, token):
+        return self.engine.abort_prefix_export(token)
 
     # -- KV-page handoff (disaggregated prefill/decode) ----------------------
     def export_kv(self, uid):
@@ -254,8 +288,16 @@ class EngineReplica:
     # -- lifecycle ---------------------------------------------------------
     def rebuild(self):
         """Fresh engine from the factory (a quarantine probe's last
-        resort when the current engine object is unusable)."""
+        resort when the current engine object is unusable). The fleet
+        prefix index is re-wired — and this replica's stale claims
+        dropped, its cache died with the old engine."""
         self.engine = self._factory()
+        if self._prefix_index is not None:
+            try:
+                self._prefix_index.drop_replica(self.name)
+            except Exception:
+                pass
+            self.engine.attach_prefix_index(self._prefix_index, self.name)
         return self.engine
 
 
@@ -304,7 +346,8 @@ class EngineRouter:
     def __init__(self, factory, replicas=2, quarantine_threshold=2,
                  probe_backoff=4, probe_retries=1, probe_base_delay=0.01,
                  probe_jitter=0.0, probe_max_elapsed=None, probe_seed=0,
-                 probe_sleep=time.sleep, hold_limit=None, topology=None):
+                 probe_sleep=time.sleep, hold_limit=None, topology=None,
+                 prefix_routing=False, prefix_index=None):
         # topology={"prefill": N, "decode": M}: DISAGGREGATED mode —
         # N prefill workers take every fresh admission, M decode
         # workers receive requests at first-token via KV-page handoff
@@ -338,6 +381,27 @@ class EngineRouter:
                                          probe_backoff=probe_backoff)
             self._replicas.append(rep)
         self._by_name = {r.name: r for r in self._replicas}
+        # prefix_routing=True: CACHE-AWARE routing — replicas publish
+        # their content-addressed prefix chains into a fleet index
+        # (inference/prefix_index.py; pass prefix_index= to share a
+        # StorePrefixIndex across processes) and each fresh admission
+        # lands on the replica holding the LONGEST cached prefix,
+        # headroom-weighted (a replica with no free slot or a backlog
+        # ranks below a fresh one regardless of coverage). When the
+        # best-prefix replica lacks headroom, its cached pages SHIP to
+        # the chosen replica over the ticketed page-transfer path
+        # instead of re-prefilling (docs/serving.md "Prefix-aware
+        # routing & KV tiering"). Dead/rebuilt replicas drop out of the
+        # index; every hint is advisory — a stale entry costs one
+        # re-prefill, never correctness.
+        self.prefix_index = None
+        if prefix_routing or prefix_index is not None:
+            if prefix_index is None:
+                from .prefix_index import PrefixIndex
+                prefix_index = PrefixIndex()
+            self.prefix_index = prefix_index
+            for rep in self._replicas:
+                rep.attach_prefix_index(prefix_index)
         self._probe_kw = dict(retries=int(probe_retries),
                               base_delay=float(probe_base_delay),
                               jitter=float(probe_jitter),
@@ -363,6 +427,11 @@ class EngineRouter:
         self.handoff_failures = 0       # export/import/commit attempts
         #                                 that fell back (request safe
         #                                 either way — never lost)
+        self.prefix_routed = 0          # admissions steered by the index
+        self.prefix_ships = 0           # prefix-page chains shipped to
+        #                                 a fresh replica pre-admission
+        self.prefix_ship_failures = 0   # ships that fell back (request
+        #                                 re-prefills — never lost)
 
     # -- public ------------------------------------------------------------
     def add_request(self, ids, max_new_tokens=32, eos_token_id=None,
@@ -482,8 +551,12 @@ class EngineRouter:
                 if r.failure is not None}
 
     def pending(self):
+        # DEMOTED mirrors in from tiered replicas (_collect): a parked
+        # request is LIVE — it restores and finishes; dropping it here
+        # would let a `while router.pending(): step()` caller stop
+        # stepping and strand the conversation in the tier
         return [u for u, r in self._reqs.items()
-                if r.state in (QUEUED, PREFILL, DECODE)]
+                if r.state in (QUEUED, PREFILL, DECODE, DEMOTED)]
 
     def __len__(self):
         return len(self.pending())
@@ -522,6 +595,14 @@ class EngineRouter:
             "topology": self._topology,
             "kv_handoffs": self.kv_handoffs,
             "handoff_failures": self.handoff_failures,
+            # cache-aware routing (docs/serving.md "Prefix-aware
+            # routing & KV tiering")
+            "prefix_routing": self.prefix_index is not None,
+            "prefix_routed": self.prefix_routed,
+            "prefix_ships": self.prefix_ships,
+            "prefix_ship_failures": self.prefix_ship_failures,
+            "prefix_index": (self.prefix_index.stats()
+                             if self.prefix_index is not None else None),
         }
 
     # -- weight hot-swap ---------------------------------------------------
@@ -652,9 +733,16 @@ class EngineRouter:
             # prefers the prefill pool; decode workers are the fallback
             # when NO prefill worker is routable (availability over
             # purity — a quarantined prefill tier must not black-hole
-            # admissions while healthy decode engines idle)
-            reps = ([r for r in reps if r.role == "prefill"]
-                    + [r for r in reps if r.role != "prefill"])
+            # admissions while healthy decode engines idle). Prefix
+            # ordering applies WITHIN the prefill pool only — ordering
+            # (or shipping pages to) a decode worker the topology
+            # reorder then bypasses would waste the whole transfer
+            pf = [r for r in reps if r.role == "prefill"]
+            if self.prefix_index is not None and pf:
+                pf = self._prefix_order(spec, pf)
+            reps = pf + [r for r in reps if r.role != "prefill"]
+        elif self.prefix_index is not None and reps:
+            reps = self._prefix_order(spec, reps)
         for rep in reps:
             try:
                 fault_point("replica.admit", detail=rep.name)
@@ -700,6 +788,76 @@ class EngineRouter:
         rr.state = QUEUED
         self._held.append(rr.uid)
         return False
+
+    # -- cache-aware routing (fleet prefix index) ----------------------------
+    def _prefix_order(self, spec, reps):
+        """Reorder routable replicas by cached-prefix coverage,
+        HEADROOM-WEIGHTED: replicas with a free slot and an empty
+        queue rank first (longest coverage among them wins; a hot
+        replica doesn't melt just because it holds the cache), loaded
+        ones keep their health order behind. When the longest-coverage
+        replica is NOT the chosen one, its cached pages ship to the
+        chosen replica over the ticketed page-transfer path — the
+        admission then hits locally instead of re-prefilling. Every
+        failure path falls back to plain health routing (the index is
+        a hint)."""
+        from .prefix_index import prompt_digests
+        try:
+            digs = prompt_digests(spec["prompt"], reps[0].page_size())
+            cov = self.prefix_index.lookup(digs) if digs else {}
+        except Exception:
+            return reps
+        if not cov:
+            return reps
+        free = {}
+        for rep in reps:
+            try:
+                h = rep.headroom()
+                free[rep.name] = (h["queued"] == 0
+                                  and h["running"] < h["slots_total"])
+            except Exception:
+                free[rep.name] = False
+        order = {rep.name: i for i, rep in enumerate(reps)}
+        reps = sorted(reps, key=lambda rp: (
+            not free[rp.name], -cov.get(rp.name, 0), order[rp.name]))
+        chosen = reps[0]
+        best = max(reps, key=lambda rp: cov.get(rp.name, 0))
+        best_cov = cov.get(best.name, 0)
+        shipped = False
+        if best_cov > cov.get(chosen.name, 0) and free[chosen.name]:
+            # the best-prefix replica lacks headroom: move the pages to
+            # the replica that has it, not the request to the hot one
+            shipped = self._ship_prefix(best, chosen, spec["prompt"])
+            if shipped:
+                self.prefix_ships += 1
+            else:
+                self.prefix_ship_failures += 1
+        if cov.get(chosen.name, 0) or shipped:
+            self.prefix_routed += 1
+        return reps
+
+    def _ship_prefix(self, src, dst, prompt):
+        """One prefix-page ship src -> dst (ticketed, CRC-checked).
+        Never raises; False = fell back (the request re-prefills)."""
+        try:
+            payload = src.export_prefix(prompt)
+        except Exception:
+            return False
+        if payload is None:
+            return False                # stale hint: nothing cached
+        try:
+            dst.import_prefix(payload)
+        except Exception:
+            try:
+                src.abort_prefix_export(payload["token"])
+            except Exception:
+                pass
+            return False
+        try:
+            src.finish_prefix_export(payload["token"])
+        except Exception:
+            pass                        # ticket leak-proof: commit is
+        return True                     # local bookkeeping only
 
     def _flush_held(self):
         for _ in range(len(self._held)):
@@ -823,6 +981,13 @@ class EngineRouter:
         through quarantine probes instead."""
         rep.kills += 1
         self.failovers += 1
+        if self.prefix_index is not None:
+            # stale index claims would keep routing traffic (and ships)
+            # at a dead cache; the replica re-publishes as it re-serves
+            try:
+                self.prefix_index.drop_replica(rep.name)
+            except Exception:
+                pass
         for ruid in list(self._assigned[rep.name]):
             self._salvage_one(rep, ruid)
         rep.breaker.record_failure(exc, self.steps)

@@ -57,6 +57,10 @@ QUEUED, PREFILL, DECODE, DONE, FAILED, CANCELLED = \
 # engine (export_kv_pages -> release_handoff): its continuation — and
 # its result — live on the importing engine
 MIGRATED = "migrated"
+# NON-terminal parked state: the request's device pages were demoted to
+# the KV tier (host RAM/disk — inference/tiering.py); a restore sweep
+# re-seats it at a block boundary and it continues byte-identically
+DEMOTED = "demoted"
 
 
 def _pools_put(pools, li, arr, acc):
@@ -149,7 +153,7 @@ class Request:
                  "filled", "resume", "tok", "out", "result",
                  "pages_shared", "deadline", "ttl_steps", "born_step",
                  "error", "tenant", "priority", "draft_k",
-                 "spec_drafted", "spec_accepted")
+                 "spec_drafted", "spec_accepted", "demote", "seated_step")
 
     def __init__(self, uid, ids, max_new_tokens, eos_token_id,
                  deadline=None, ttl_steps=None, born_step=0,
@@ -182,6 +186,11 @@ class Request:
         #                                 length (adaptive speculation)
         self.spec_drafted = 0           # drafts offered to verification
         self.spec_accepted = 0          # drafts the target accepted
+        self.demote = None              # tier-restore record while the
+        #                                 request is DEMOTED
+        self.seated_step = born_step    # engine step of the last seat
+        #                                 (admission/import/restore) —
+        #                                 the demotion victim LRU key
 
 
 class PrefixCache:
@@ -206,6 +215,11 @@ class PrefixCache:
         self.hits = 0            # pages served from cache (counted by
         self.misses = 0          # the scheduler at ADMISSION, so failed
         #                          admission retries don't inflate them)
+        self.on_evict = None     # callback(chain_key) fired when an
+        #                          entry leaves the cache (the engine
+        #                          retracts it from the fleet prefix
+        #                          index; advisory — errors swallowed
+        #                          by the installer's wrapper)
 
     def __len__(self):
         return len(self._entries)
@@ -304,11 +318,15 @@ class PrefixCache:
 
         O(1) amortized: entries pop from the LRU head; an entry that
         cannot be evicted right now — protected for the current
-        admission, or refcount > 1 because a running request still
-        reads it — is BY DEFINITION in use, so it is moved to the MRU
-        end rather than rescanned by every future eviction (the old
-        linear scan walked every pinned chain again on each call). Each
-        entry is examined at most once per call."""
+        admission, refcount > 1 because a running request still reads
+        it, or under a PENDING EXPORT TICKET (a KV handoff, prefix
+        ship, or tier demote in flight names the page; the ticket's
+        commit drops a reference, so a concurrent free here would hand
+        the page to a new owner mid-transfer) — is BY DEFINITION in
+        use, so it is moved to the MRU end rather than rescanned by
+        every future eviction (the old linear scan walked every pinned
+        chain again on each call). Each entry is examined at most once
+        per call."""
         freed = 0
         scanned = 0
         limit = len(self._entries)
@@ -316,7 +334,8 @@ class PrefixCache:
             key = next(iter(self._entries))
             page = self._entries[key]
             scanned += 1
-            if page in protect or allocator.refcount(page) != 1:
+            if page in protect or allocator.refcount(page) != 1 or \
+                    allocator.is_exporting(page):
                 self._entries.move_to_end(key)
                 continue
             self._drop(key, page)
@@ -325,6 +344,9 @@ class PrefixCache:
         return freed
 
     def clear(self, allocator=None):
+        if self.on_evict is not None:
+            for key in list(self._entries):
+                self.on_evict(key)
         if allocator is not None:
             for key, page in list(self._entries.items()):
                 if allocator.refcount(page) > 0:
@@ -341,6 +363,8 @@ class PrefixCache:
             kids.pop(page, None)
             if not kids:
                 del self._children[key[0]]
+        if self.on_evict is not None:
+            self.on_evict(key)
 
 
 class _FusedBlock:
@@ -426,6 +450,15 @@ class ContinuousBatchingEngine(LLMEngine):
         work re-queues, never lost); share weights fair-share virtual
         time (1/share per emitted token) among equal priorities, so
         speculation's variable yield is charged fairly.
+      kv_tier: "host"/"disk" (or a tiering.KVTierStore) enables KV
+        TIERING — demote_request parks a cold request's device pages
+        in host RAM (spilling to disk past tier_host_cap_mb, under
+        tier_dir) in the CRC-stamped handoff format; restore_request /
+        the per-step restore sweep re-seats it byte-identically.
+        oversubscribe (default on when a tier is set) lets admission
+        demote the longest-resident running request when the queue
+        head cannot fit, so live requests can exceed the device pool
+        (docs/serving.md "Prefix-aware routing & KV tiering").
       queue_limit: bounded admission queue — add_request past this depth
         raises EngineBusyError (typed backpressure) instead of growing
         an unbounded backlog. None (default) = unbounded.
@@ -451,7 +484,9 @@ class ContinuousBatchingEngine(LLMEngine):
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  seed=0, decode_block=1, ragged_kernel=None,
                  megakernel=None, speculate=None, drafter="ngram",
-                 spec_adaptive=True, tenants=None, **kw):
+                 spec_adaptive=True, tenants=None, kv_tier=None,
+                 tier_dir=None, tier_host_cap_mb=None, oversubscribe=None,
+                 **kw):
         super().__init__(model, max_len=max_len, page_size=page_size,
                          max_batch=max_batch, **kw)
         self.prefill_chunk = int(prefill_chunk or page_size)
@@ -616,6 +651,42 @@ class ContinuousBatchingEngine(LLMEngine):
         self.handoffs_out = 0           # KV-page exports committed away
         self.handoffs_in = 0            # KV-page imports seated here
         self._handoffs_out = {}         # uid -> pending export token
+        # KV tiering (inference/tiering.py): kv_tier="host"/"disk" (or a
+        # KVTierStore) enables demote_request/restore_request — a cold
+        # request's device pages move to host RAM (then disk) in the
+        # CRC-stamped page-export format and restore on demand at a
+        # block boundary, byte-identical. oversubscribe (default: on
+        # whenever a tier is configured) lets ADMISSION demote the
+        # longest-resident lowest-priority running request when the
+        # queue head cannot fit, so live requests' page needs may
+        # exceed the device pool (docs/serving.md "Prefix-aware routing
+        # & KV tiering"). Demoted requests restore with priority over
+        # fresh admissions (no starvation). kv.demote / kv.restore are
+        # the fault points; a corrupt tier entry or injected restore
+        # fault retires exactly ONE request (stage "restore").
+        from .tiering import resolve_tier
+        self._tier = resolve_tier(kv_tier, tier_dir, tier_host_cap_mb)
+        self.oversubscribe = (self._tier is not None
+                              if oversubscribe is None
+                              else bool(oversubscribe))
+        self._demoted = collections.OrderedDict()   # uid -> Request
+        self.demotions = 0
+        self.restores = 0
+        self.restore_failures = 0       # restore-stage retirements
+        self.demote_errors = 0          # failed demote attempts (the
+        #                                 victim kept serving)
+        self.pages_demoted = 0          # device pages currently parked
+        #                                 in the tier (the oversub gauge)
+        # fleet prefix index (inference/prefix_index.py): attached by
+        # the router (attach_prefix_index); publish/retract are
+        # ADVISORY — wrapped so an index failure can never fail a
+        # request (the index.publish fault point proves it in chaos)
+        self._prefix_index = None
+        self._replica = None
+        self.index_publishes = 0
+        self.index_publish_errors = 0
+        self.prefix_exports = 0         # prefix-page chains shipped out
+        self.prefix_imports = 0         # chains seated from a ship
         self.spec_passes = 0            # verify passes that ran
         self.spec_emitted = 0           # decode tokens emitted by them
         self.spec_drafted_total = 0     # drafts offered
@@ -729,6 +800,7 @@ class ContinuousBatchingEngine(LLMEngine):
         if self.decode_block > 1 or self._spec:
             return self._fused_step()
         self._expire_deadlines()
+        self._restore_sweep()
         self._admit()
         prefills = [r for r in self._slots if r and r.state == PREFILL]
         decodes = [r for r in self._slots if r and r.state == DECODE]
@@ -817,14 +889,15 @@ class ContinuousBatchingEngine(LLMEngine):
                 if r.error is not None}
 
     def pending(self):
-        """uids still queued or in flight, submission order."""
+        """uids still queued or in flight (demoted included — a parked
+        request restores and finishes), submission order."""
         return [u for u, r in self._requests.items()
-                if r.state in (QUEUED, PREFILL, DECODE)]
+                if r.state in (QUEUED, PREFILL, DECODE, DEMOTED)]
 
     def __len__(self):
         """Number of requests still queued or in flight."""
         return sum(1 for r in self._requests.values()
-                   if r.state in (QUEUED, PREFILL, DECODE))
+                   if r.state in (QUEUED, PREFILL, DECODE, DEMOTED))
 
     def headroom(self):
         """O(1) routing snapshot — the subset of health() a router's
@@ -835,7 +908,12 @@ class ContinuousBatchingEngine(LLMEngine):
                 "running": sum(1 for s in self._slots if s is not None),
                 "slots_total": self.max_batch,
                 "pages_free": self.allocator.available,
-                "pages_total": self.allocator.n_pages}
+                "pages_total": self.allocator.n_pages,
+                # oversubscription gauges: device pages parked in the
+                # KV tier, and how many requests are parked (a router
+                # weighs these against raw pages_free)
+                "pages_demoted": self.pages_demoted,
+                "demoted": len(self._demoted)}
 
     def health(self):
         """One serving-health snapshot (cheap; safe to poll): queue and
@@ -891,6 +969,22 @@ class ContinuousBatchingEngine(LLMEngine):
             # this engine (docs/serving.md)
             "handoffs_out": self.handoffs_out,
             "handoffs_in": self.handoffs_in,
+            # KV tiering (docs/serving.md "Prefix-aware routing & KV
+            # tiering"): demote/restore traffic, the oversubscription
+            # gauge, and the tier store's own accounting
+            "kv_tier": self._tier.kind if self._tier is not None else None,
+            "demoted": len(self._demoted),
+            "pages_demoted": self.pages_demoted,
+            "demotions": self.demotions,
+            "restores": self.restores,
+            "restore_failures": self.restore_failures,
+            "demote_errors": self.demote_errors,
+            "tier": self._tier.stats() if self._tier is not None else None,
+            # fleet prefix index: publish traffic + prefix-page ships
+            "index_publishes": self.index_publishes,
+            "index_publish_errors": self.index_publish_errors,
+            "prefix_exports": self.prefix_exports,
+            "prefix_imports": self.prefix_imports,
             # multi-tenant admission: preemptions + per-tenant service
             "preemptions": self.preemptions,
             "tenants": {
@@ -1043,10 +1137,12 @@ class ContinuousBatchingEngine(LLMEngine):
                         None)
             if slot is None:
                 victim = self._preemption_victim(r)
-                if victim is None:
-                    return
-                self._preempt(victim)
-                continue               # re-evaluate with the freed slot
+                if victim is not None:
+                    self._preempt(victim)
+                    continue           # re-evaluate with the freed slot
+                if self._demote_for(r):
+                    continue           # oversubscription freed a slot
+                return
             shared, covered = ([], 0) if self._prefix is None else \
                 self._prefix.match(r.ids)
             resume = min(covered, r.t0 - 1)
@@ -1077,6 +1173,8 @@ class ContinuousBatchingEngine(LLMEngine):
                 if victim is not None:
                     self._preempt(victim)
                     continue
+                if self._demote_for(r):
+                    continue        # oversubscription freed pages
                 return              # wait for retirements (policy order)
             self._queue.remove(r)
             # claim pages under a guard: an allocation failure here
@@ -1107,6 +1205,7 @@ class ContinuousBatchingEngine(LLMEngine):
             r.slot = slot
             r.resume = r.filled = resume
             r.state = PREFILL
+            r.seated_step = self.steps
             self._slots[slot] = r
             self._tables_np[slot] = 0
             self._tables_np[slot, :len(pages)] = pages
@@ -1259,14 +1358,54 @@ class ContinuousBatchingEngine(LLMEngine):
 
     def _publish_prefix(self, r):
         """Make a completed prompt's FULL pages shareable (the partial
-        tail page stays private — decode writes land there)."""
+        tail page stays private — decode writes land there). With a
+        fleet prefix index attached, every full-page prefix digest is
+        published alongside — advisory (an index failure never fails
+        the request)."""
         if self._prefix is None:
             return
         key = ()
+        dig = None
         p = self.page_size
         for j in range(r.t0 // p):
-            key = self._prefix.insert(key, r.ids[j * p:(j + 1) * p],
-                                      r.pages[j], self.allocator)
+            chunk = r.ids[j * p:(j + 1) * p]
+            key = self._prefix.insert(key, chunk, r.pages[j],
+                                      self.allocator)
+            if self._prefix_index is not None:
+                from .prefix_index import EMPTY_DIGEST, chain_digest
+                dig = chain_digest(EMPTY_DIGEST if dig is None else dig,
+                                   chunk)
+                try:
+                    self._prefix_index.publish(self._replica, dig, j + 1)
+                    self.index_publishes += 1
+                except Exception:
+                    # index.publish fault or a store hiccup: the index
+                    # is a routing hint — serving never depends on it
+                    self.index_publish_errors += 1
+
+    # -- fleet prefix index (inference/prefix_index.py) ----------------------
+    def attach_prefix_index(self, index, replica):
+        """Wire this engine into a fleet prefix index under the name
+        `replica`: prefill/import publishes full-page prefix digests,
+        cache eviction retracts them, and a weight flip or pool rebuild
+        drops every claim (the cache died with it). The router calls
+        this once per replica at fleet construction."""
+        self._prefix_index = index
+        self._replica = replica
+        if self._prefix is not None:
+            self._prefix.on_evict = self._on_prefix_evict
+        return self
+
+    def _on_prefix_evict(self, chain_key):
+        if self._prefix_index is None:
+            return
+        from .prefix_index import chain_key_digest
+        try:
+            self._prefix_index.retract(self._replica,
+                                       chain_key_digest(chain_key))
+        except Exception:
+            self.index_publish_errors += 1
+
 
     # -- decode ------------------------------------------------------------
     def _resolve_megakernel(self, val):
@@ -1516,13 +1655,23 @@ class ContinuousBatchingEngine(LLMEngine):
     # -- fused multi-step decode (device-resident blocks) ------------------
     def _idle_or_raise(self):
         """Nothing running and nothing admitted: either truly idle
-        (False) or the queue head cannot fit an IDLE engine — a real
-        capacity bug, not back-pressure."""
+        (False) or the queue head / demoted head cannot fit an IDLE
+        engine — a real capacity bug, not back-pressure."""
         if self._queue:
             head = self._pick_next()
             need = self._pages_needed(head.t0, head.max_new_tokens)
             raise EngineFullError(
                 f"request {head.uid} cannot be admitted into an idle "
+                f"engine: needs {need} KV pages but only "
+                f"{self.allocator.available} of "
+                f"{self.allocator.n_pages} are free (page pool "
+                "pinned?)")
+        if self._demoted:
+            uid = next(iter(self._demoted))
+            d = self._requests[uid].demote
+            need = d["n_pages"] - len(d["shared"])
+            raise EngineFullError(
+                f"demoted request {uid} cannot restore into an idle "
                 f"engine: needs {need} KV pages but only "
                 f"{self.allocator.available} of "
                 f"{self.allocator.n_pages} are free (page pool "
@@ -1780,6 +1929,7 @@ class ContinuousBatchingEngine(LLMEngine):
         ONE fused program. Returns a _FusedBlock, True when every
         participant faulted, or None when idle."""
         self._expire_deadlines()
+        self._restore_sweep()
         self._admit()
         prefills = [r for r in self._slots if r and r.state == PREFILL]
         decodes = [r for r in self._slots if r and r.state == DECODE]
@@ -1942,6 +2092,10 @@ class ContinuousBatchingEngine(LLMEngine):
             # useless speculation) — dispatch from the sync point instead
             return False
         if self._queue or self._pending is not None:
+            return False
+        if self._demoted:
+            # restores happen at the host sync point a chain skips; a
+            # parked request must not wait out another's whole budget
             return False
         if any(s is not None and s.state == PREFILL for s in self._slots):
             return False
@@ -2129,11 +2283,13 @@ class ContinuousBatchingEngine(LLMEngine):
 
     def export_inflight(self):
         """Resume specs for every request still queued or in flight
-        (submission order) — the payload a router salvages when this
-        replica is declared dead."""
+        (submission order; demoted requests ride too — failover
+        recomputes them elsewhere, their tier entry dies with the
+        replica) — the payload a router salvages when this replica is
+        declared dead."""
         return [self.export_request(u)
                 for u, r in self._requests.items()
-                if r.state in (QUEUED, PREFILL, DECODE)]
+                if r.state in (QUEUED, PREFILL, DECODE, DEMOTED)]
 
     def submit_resume(self, spec):
         """Admit an export_request spec into THIS engine. The folded
@@ -2155,6 +2311,31 @@ class ContinuousBatchingEngine(LLMEngine):
             priority=spec["priority"])
 
     # -- KV-page handoff (disaggregated prefill/decode) ----------------------
+    def _kv_geometry(self):
+        """The cache-geometry stamp every page-image payload carries
+        (and every import verifies) — ONE definition for the handoff,
+        tier-demote, and prefix-ship paths."""
+        return {"page_size": self.page_size, "nh_kv": self.nh_kv,
+                "hd": self.hd, "layers": self.cfg.num_hidden_layers,
+                "kv_dtype": str(jnp.dtype(self.kv_dtype))}
+
+    def _package_pages(self, token, spec, lens, pages):
+        """CRC-stamped page-image payload — the one assembly shared by
+        KV handoff, tier demotion, and prefix shipping: per-layer K/V
+        blobs for `pages`, the cache geometry, checksums. Pools index
+        identically in both forms (per-layer list, or the natively
+        stacked [L, ...] array of megakernel="multi")."""
+        from .handoff import checksum_payload
+        idx = np.asarray(pages, np.int64)
+        k_blobs, v_blobs = [], []
+        for li in range(self.cfg.num_hidden_layers):
+            k_blobs.append(np.asarray(self.k_pages[li][idx]))
+            v_blobs.append(np.asarray(self.v_pages[li][idx]))
+        return checksum_payload({
+            "token": token, "spec": spec, "lens": lens,
+            "geometry": self._kv_geometry(),
+            "k": k_blobs, "v": v_blobs})
+
     def _sync_pending(self):
         """Apply a chained block still in flight so host state (lens,
         generated tokens) is current before a handoff reads it."""
@@ -2192,14 +2373,6 @@ class ContinuousBatchingEngine(LLMEngine):
         n_used = -(-lens // p)
         used = [int(pg) for pg in r.pages[:n_used]]
         token = self.allocator.export_begin(used)
-        idx = np.asarray(used, np.int64)
-        k_blobs, v_blobs = [], []
-        # pools index identically in both forms (per-layer list, or the
-        # natively stacked [L, ...] array of megakernel="multi")
-        for li in range(self.cfg.num_hidden_layers):
-            k_blobs.append(np.asarray(self.k_pages[li][idx]))
-            v_blobs.append(np.asarray(self.v_pages[li][idx]))
-        from .handoff import checksum_payload
         spec = self.export_request(uid)
         # absolute monotonic deadlines don't survive a host boundary
         # (StoreKVTransport's whole point): ship the REMAINING budget
@@ -2209,18 +2382,8 @@ class ContinuousBatchingEngine(LLMEngine):
             spec["deadline_remaining_ms"] = max(
                 0.0, (spec["deadline"] - time.monotonic()) * 1e3)
             spec["deadline"] = None
-        payload = {
-            "token": token,
-            "spec": spec,
-            "lens": lens,
-            "geometry": {"page_size": p, "nh_kv": self.nh_kv,
-                         "hd": self.hd,
-                         "layers": self.cfg.num_hidden_layers,
-                         "kv_dtype": str(jnp.dtype(self.kv_dtype))},
-            "k": k_blobs, "v": v_blobs,
-        }
         self._handoffs_out[uid] = token
-        return checksum_payload(payload)
+        return self._package_pages(token, spec, lens, used)
 
     def abort_handoff(self, uid):
         """Cancel a pending export: the request keeps serving HERE."""
@@ -2277,9 +2440,7 @@ class ContinuousBatchingEngine(LLMEngine):
         from .handoff import KVHandoffError, verify_payload
         fault_point("kv.import", detail=f"token={payload.get('token')}")
         g = payload["geometry"]
-        mine = {"page_size": self.page_size, "nh_kv": self.nh_kv,
-                "hd": self.hd, "layers": self.cfg.num_hidden_layers,
-                "kv_dtype": str(jnp.dtype(self.kv_dtype))}
+        mine = self._kv_geometry()
         if {k: g.get(k) for k in mine} != mine:
             raise KVHandoffError(
                 f"handoff geometry mismatch: payload {g} vs engine "
@@ -2390,16 +2551,394 @@ class ContinuousBatchingEngine(LLMEngine):
         self._slot_used[slot] = True
         return r.uid
 
+    # -- KV tiering (HBM -> host RAM -> disk; inference/tiering.py) ----------
+    def demote_request(self, uid):
+        """Move a decode-state request's device pages into the KV tier
+        (host RAM, spilling to disk — `kv_tier=`): its EXCLUSIVE pages'
+        bytes export under an allocator ticket in the CRC-stamped
+        handoff format and the device copies free; prefix-cache-shared
+        pages stay resident (they are deduplicated HBM other requests
+        read — the request keeps its references, so eviction cannot
+        pull them out from under the pending restore). The slot frees,
+        the request parks in DEMOTED state, and a later
+        restore_request / restore sweep re-seats it byte-identically.
+        `kv.demote` is the fault point (fires BEFORE the ticket opens —
+        a demote failure leaves the request serving untouched)."""
+        r = self._requests.get(uid)
+        if r is None:
+            raise UnknownRequestError(f"unknown request uid {uid}")
+        if self._tier is None:
+            raise ValueError(
+                "demote_request: no KV tier configured (kv_tier=)")
+        self._sync_pending()
+        if r.state != DECODE or r.slot is None:
+            raise ValueError(
+                f"demote_request: request {uid} is {r.state!r} — only a "
+                "decode-state request carries a complete KV image")
+        if uid in self._handoffs_out:
+            raise ValueError(
+                f"demote_request: request {uid} has a pending KV-page "
+                "handoff export (settle it first)")
+        fault_point("kv.demote", detail=f"uid={uid}")
+        p = self.page_size
+        lens = int(self._lens_np[r.slot])
+        n_used = -(-lens // p)
+        # pages KEPT resident: prefix-cache-shared ones (shared_idx)
+        # AND the request's own prompt pages it PUBLISHED to the cache
+        # (refcount 2: request + cache, but not in shared_idx) — the
+        # cache pins those in HBM either way, so exporting their bytes
+        # would free nothing, store a redundant tier copy, and make
+        # restore claim duplicates of pages still resident
+        kept = {}
+        for i in range(n_used):
+            pg = r.pages[i]
+            if i in r.shared_idx or (self._prefix is not None
+                                     and pg in self._prefix._by_page):
+                kept[i] = pg
+        excl_idx = [i for i in range(n_used) if i not in kept]
+        excl_pages = [r.pages[i] for i in excl_idx]
+        token = self.allocator.export_begin(excl_pages)
+        try:
+            self._tier.put(token, self._package_pages(
+                token, self.export_request(uid), lens, excl_pages))
+        except Exception:
+            # tier write failed (disk error): close the ticket, the
+            # request keeps serving from its device pages
+            self.allocator.export_abort(token)
+            raise
+        n_total = len(r.pages)
+        tail = r.pages[n_used:]
+        self.allocator.export_commit(token)      # drops the exported refs
+        if tail:
+            self.allocator.free(tail)
+        if r.cow_reserve is not None:
+            self.allocator.free([r.cow_reserve])
+            r.cow_reserve = None
+        self._slots[r.slot] = None
+        r.slot = None
+        r.demote = {"token": token, "lens": lens, "n_pages": n_total,
+                    "excl_idx": excl_idx, "shared": kept,
+                    # the ORIGINAL read-only labeling — kept pages the
+                    # request owns (self-published) seat back unshared
+                    "shared_idx": sorted(r.shared_idx)}
+        r.pages = [kept[i] for i in sorted(kept)]
+        r.shared_idx = set()
+        r.state = DEMOTED
+        self._demoted[uid] = r
+        self.demotions += 1
+        self.pages_demoted += n_total - len(kept)
+        return token
+
+    def restore_request(self, uid):
+        """Re-seat a DEMOTED request: claim fresh device pages under
+        the tier token (burned on commit — one tier entry seats at most
+        one continuation), write the exported bytes back, re-link the
+        kept shared pages at their table indices, and continue in
+        DECODE state — greedy output byte-identical to a never-demoted
+        run (pinned in tests across decode_block 1/8).
+
+        Raises EngineBusyError (no free slot) / EngineFullError (pages,
+        after prefix-cache eviction) as plain backpressure — nothing
+        claimed, retry later. A CORRUPT tier entry or an injected
+        `kv.restore` fault retires exactly THIS request with a typed
+        stage="restore" RequestFailure (tier entry dropped, kept refs
+        freed, zero page leak) and returns False; the engine keeps
+        stepping everyone else."""
+        r = self._requests.get(uid)
+        if r is None:
+            raise UnknownRequestError(f"unknown request uid {uid}")
+        if r.state != DEMOTED or r.demote is None:
+            raise ValueError(
+                f"restore_request: request {uid} is {r.state!r}, not "
+                "demoted")
+        d = r.demote
+        slot = next((i for i, s in enumerate(self._slots) if s is None),
+                    None)
+        if slot is None:
+            raise EngineBusyError(
+                f"restore_request: no free slot ({self.max_batch} "
+                "running); retry after a retirement")
+        shared = d["shared"]
+        n_fresh = d["n_pages"] - len(shared)
+        if n_fresh > self.allocator.available and self._prefix:
+            self._prefix.evict(n_fresh - self.allocator.available,
+                               self.allocator,
+                               protect=set(shared.values()))
+        if n_fresh > self.allocator.available:
+            raise EngineFullError(
+                f"restore_request: needs {n_fresh} KV pages but only "
+                f"{self.allocator.available} of "
+                f"{self.allocator.n_pages} are free; retry after a "
+                "retirement")
+        try:
+            fault_point("kv.restore", detail=f"uid={uid}")
+            payload = self._tier.get(d["token"])
+        except Exception as e:
+            # corrupt/lost tier entry or injected fault: THIS request
+            # retires alone (the PR 2 isolation contract) — tier entry
+            # dropped, kept shared refs freed via the release path
+            self.restore_failures += 1
+            self._fail_request(r, "restore", e)
+            return False
+        pages = self.allocator.import_begin(d["token"], n_fresh)
+        try:
+            excl_idx = d["excl_idx"]
+            if excl_idx:
+                idx = jnp.asarray(np.asarray(pages[:len(excl_idx)],
+                                             np.int64))
+                for li in range(self.cfg.num_hidden_layers):
+                    kc = jnp.asarray(payload["k"][li], self.kv_dtype)
+                    vc = jnp.asarray(payload["v"][li], self.kv_dtype)
+                    if isinstance(self.k_pages, (list, tuple)):
+                        self.k_pages[li] = \
+                            self.k_pages[li].at[idx].set(kc)
+                        self.v_pages[li] = \
+                            self.v_pages[li].at[idx].set(vc)
+                    else:           # natively stacked pools ("multi")
+                        self.k_pages = self.k_pages.at[li, idx].set(kc)
+                        self.v_pages = self.v_pages.at[li, idx].set(vc)
+                if self._tpc is not None:
+                    self.k_pages = self._tpc.place_pools(self.k_pages)
+                    self.v_pages = self._tpc.place_pools(self.v_pages)
+            table = [None] * d["n_pages"]
+            for i, pg in shared.items():
+                table[i] = pg
+            fi = 0
+            for i in excl_idx:
+                table[i] = pages[fi]
+                fi += 1
+            for i in range(d["n_pages"]):
+                if table[i] is None:
+                    table[i] = pages[fi]
+                    fi += 1
+            r.pages = table
+            r.shared_idx = set(d["shared_idx"])
+            r.slot = slot
+            r.state = DECODE
+            r.seated_step = self.steps
+            self._slots[slot] = r
+            self._tables_np[slot] = 0
+            self._tables_np[slot, :len(table)] = table
+            self._lens_np[slot] = d["lens"]
+            self.allocator.import_commit(d["token"])
+        except Exception:
+            # roll the restore back whole: claimed pages freed, token
+            # NOT burned, the request stays DEMOTED for a retry
+            if self._slots[slot] is r:
+                self._slots[slot] = None
+            r.slot = None
+            r.state = DEMOTED
+            r.pages = [shared[i] for i in sorted(shared)]
+            r.shared_idx = set()
+            self.allocator.import_abort(d["token"])
+            raise
+        self._tier.delete(d["token"])
+        self._demoted.pop(uid, None)
+        self.pages_demoted -= n_fresh
+        r.demote = None
+        self.restores += 1
+        return True
+
+    def _drop_demoted(self, r):
+        """Forget a DEMOTED request's tier entry and bookkeeping (it is
+        retiring: cancel/deadline/failure/pool rebuild). Its kept
+        shared-page references free through the normal release path."""
+        d = r.demote
+        if d is None:
+            return
+        try:
+            self._tier.delete(d["token"])
+        except Exception:
+            pass
+        self._demoted.pop(r.uid, None)
+        self.pages_demoted -= d["n_pages"] - len(d["shared"])
+        r.demote = None
+
+    def _restore_sweep(self):
+        """Re-seat demoted requests (oldest demotion first) while slots
+        are free. Demoted requests outrank FRESH admissions — they
+        already earned service, so a steady queue cannot starve a
+        parked conversation — but under queue pressure only one
+        restores per step (the queue keeps draining; admission may
+        demote again, round-robining the device pool through the
+        oversubscribed set). Returns True when any restore ran (success
+        or typed failure — both are progress)."""
+        did = False
+        while self._demoted:
+            if not any(s is None for s in self._slots):
+                break
+            uid = next(iter(self._demoted))
+            try:
+                self.restore_request(uid)
+            except (EngineBusyError, EngineFullError):
+                break               # capacity backpressure: next step
+            did = True
+            if self._queue:
+                break               # one per step under queue pressure
+        return did
+
+    def _demote_for(self, cand):
+        """Oversubscription: demote the longest-resident running
+        request at or below the candidate's priority so the candidate
+        can seat — its pages move to the tier instead of being thrown
+        away (preemption's recompute) or blocking admission. One victim
+        per attempt; the admission loop re-evaluates. Requests with a
+        pending handoff export are never victims (the ticket names
+        their pages)."""
+        if self._tier is None or not self.oversubscribe:
+            return False
+        victims = [s for s in self._slots
+                   if s is not None and s.state == DECODE
+                   and s.priority <= cand.priority
+                   and s.uid not in self._handoffs_out]
+        if not victims:
+            return False
+        victim = min(victims,
+                     key=lambda s: (s.priority, s.seated_step, s.uid))
+        try:
+            self.demote_request(victim.uid)
+            return True
+        except Exception:
+            # kv.demote fault or tier write failure: the victim keeps
+            # serving; admission waits instead
+            self.demote_errors += 1
+            return False
+
+    # -- prefix-page shipping (cache-aware routing's transfer path) ----------
+    def export_prefix_pages(self, ids):
+        """Package this engine's cached full-page chain covering a
+        prefix of `ids` for import into ANOTHER engine's prefix cache —
+        the router's alternative to re-prefilling when the best-prefix
+        replica lacks headroom. Returns None when no full page of `ids`
+        is cached (a stale index hint). The chain pages ride under an
+        export ticket holding its OWN references (the cache keeps
+        serving them here, and PrefixCache.evict skips ticketed pages);
+        the caller MUST settle the ticket: finish_prefix_export after a
+        landed import, abort_prefix_export otherwise."""
+        if self._prefix is None:
+            raise ValueError("export_prefix_pages: prefix cache disabled")
+        ids = np.asarray(ids, np.int64).ravel()
+        p = self.page_size
+        key = ()
+        pages = []
+        for j in range(ids.size // p):
+            k2 = self._prefix.chain_key(key, ids[j * p:(j + 1) * p])
+            page = self._prefix._entries.get(k2)
+            if page is None:
+                break
+            pages.append(page)
+            key = k2
+        if not pages:
+            return None
+        fault_point("kv.export", detail=f"prefix:{len(pages)}")
+        for pg in pages:
+            self.allocator.share(pg)         # the ticket's own refs
+        try:
+            token = self.allocator.export_begin(pages)
+        except Exception:
+            self.allocator.free(pages)
+            raise
+        covered = len(pages) * p
+        self.prefix_exports += 1
+        return self._package_pages(
+            token, {"state": "prefix", "prompt": ids[:covered].copy()},
+            covered, pages)
+
+    def finish_prefix_export(self, token):
+        """Settle a landed prefix ship: the ticket's references drop
+        (the cache keeps its own — local serving is unaffected)."""
+        self.allocator.export_commit(token)
+
+    def abort_prefix_export(self, token):
+        """Cancel a failed prefix ship: close the ticket and drop its
+        references — cache state is untouched."""
+        pages = list(self.allocator.export_pages(token))
+        self.allocator.export_abort(token)
+        self.allocator.free(pages)
+
+    def import_prefix_pages(self, payload):
+        """Seat a shipped prefix-page chain into THIS engine's prefix
+        cache: CRC + geometry verify, claim fresh pages under the
+        transfer token (burned on commit — a replayed ship raises),
+        write the KV bytes, register the chain content-addressed, and
+        publish it to the fleet index. A request admitted next shares
+        these pages exactly as if this engine had prefilled them.
+        Returns the number of pages seated."""
+        from .handoff import KVHandoffError, verify_payload
+        if self._prefix is None:
+            raise ValueError("import_prefix_pages: prefix cache disabled")
+        fault_point("kv.import", detail="prefix")
+        g = payload["geometry"]
+        mine = self._kv_geometry()
+        if {k: g.get(k) for k in mine} != mine:
+            raise KVHandoffError(
+                f"prefix-ship geometry mismatch: payload {g} vs engine "
+                f"{mine}")
+        verify_payload(payload)
+        prompt = np.asarray(payload["spec"]["prompt"], np.int64).ravel()
+        p = self.page_size
+        n = int(payload["lens"]) // p
+        if n * p != int(payload["lens"]) or prompt.size < n * p:
+            raise KVHandoffError(
+                f"prefix payload lens {payload['lens']} is not "
+                f"{n} full pages of the shipped prompt ({prompt.size} "
+                "tokens)")
+        if n > self.allocator.available and self._prefix:
+            self._prefix.evict(n - self.allocator.available,
+                               self.allocator)
+        pages = self.allocator.import_begin(payload["token"], n)
+        try:
+            idx = jnp.asarray(np.asarray(pages, np.int64))
+            for li in range(self.cfg.num_hidden_layers):
+                kc = jnp.asarray(payload["k"][li], self.kv_dtype)
+                vc = jnp.asarray(payload["v"][li], self.kv_dtype)
+                if isinstance(self.k_pages, (list, tuple)):
+                    self.k_pages[li] = self.k_pages[li].at[idx].set(kc)
+                    self.v_pages[li] = self.v_pages[li].at[idx].set(vc)
+                else:               # natively stacked pools ("multi")
+                    self.k_pages = self.k_pages.at[li, idx].set(kc)
+                    self.v_pages = self.v_pages.at[li, idx].set(vc)
+            if self._tpc is not None:
+                self.k_pages = self._tpc.place_pools(self.k_pages)
+                self.v_pages = self._tpc.place_pools(self.v_pages)
+        except Exception:
+            self.allocator.import_abort(payload["token"])
+            raise
+        self.allocator.import_commit(payload["token"])
+        # register the chain; a link already cached HERE keeps the
+        # local page (the imported copy's reference just drops below)
+        from .prefix_index import EMPTY_DIGEST, chain_digest
+        key = ()
+        dig = EMPTY_DIGEST
+        for j in range(n):
+            chunk = prompt[j * p:(j + 1) * p]
+            k2 = self._prefix.chain_key(key, chunk)
+            if k2 not in self._prefix._entries:
+                self._prefix.insert(key, chunk, pages[j], self.allocator)
+            key = k2
+            if self._prefix_index is not None:
+                dig = chain_digest(dig, chunk)
+                try:
+                    self._prefix_index.publish(self._replica, dig, j + 1)
+                    self.index_publishes += 1
+                except Exception:
+                    self.index_publish_errors += 1
+        self.allocator.free(pages)      # drop the import refs; the
+        self.prefix_imports += 1        # cache keeps its own
+        return n
+
     def install_weights(self, new):
         """Zero-downtime flip, gated at a BLOCK BOUNDARY: no slot may
         hold in-flight KV (cache contents computed under the old
         weights would silently corrupt continuations), so callers drain
         or migrate running requests first — EngineBusyError here is the
-        backpressure signal, not a failure. Queued (not yet admitted)
-        requests HOLD through the flip and run under the new weights.
-        The prefix cache is dropped with the old weights (its pages are
-        old-weight KV); the megakernel repack is rebuilt."""
+        backpressure signal, not a failure. DEMOTED requests count as
+        busy too: their tier bytes are old-weight KV. Queued (not yet
+        admitted) requests HOLD through the flip and run under the new
+        weights. The prefix cache is dropped with the old weights (its
+        pages are old-weight KV); the megakernel repack is rebuilt."""
         busy = [r.uid for r in self._slots if r is not None]
+        busy += list(self._demoted)
         if busy:
             raise EngineBusyError(
                 f"install_weights with {len(busy)} request(s) in flight "
@@ -2425,10 +2964,11 @@ class ContinuousBatchingEngine(LLMEngine):
         passed: queued ones before they run, in-flight ones with their
         slot/pages reclaimed. Runs at the top of each step()."""
         now = None
-        # live requests only (queue + slots) — NOT the full request
-        # history, which grows for the life of the engine
+        # live requests only (queue + slots + demoted) — NOT the full
+        # request history, which grows for the life of the engine
         live = list(self._queue) + [s for s in self._slots
-                                    if s is not None]
+                                    if s is not None] \
+            + list(self._demoted.values())
         for r in live:
             expired = False
             if r.ttl_steps is not None and \
@@ -2452,8 +2992,11 @@ class ContinuousBatchingEngine(LLMEngine):
 
     def _fail_request(self, r, stage, exc, state=FAILED):
         """Retire ONE request with a typed error record; reclaim its
-        slot, pages, CoW reserve, and prefix-cache references. The
-        engine keeps stepping everyone else."""
+        slot, pages, CoW reserve, prefix-cache references, and (for a
+        DEMOTED request) its tier entry. The engine keeps stepping
+        everyone else."""
+        if r.demote is not None:
+            self._drop_demoted(r)
         r.error = RequestFailure(r.uid, stage, exc, self.steps,
                                  tokens_generated=len(r.out))
         r.state = state
@@ -2479,6 +3022,23 @@ class ContinuousBatchingEngine(LLMEngine):
         KV AND the content-addressed cache — the fresh allocator will
         re-issue the cached page ids, so stale entries would alias other
         requests' pages."""
+        for uid, r in list(getattr(self, "_demoted", {}).items()):
+            # the pool rebuild killed the kept shared pages too; the
+            # tier bytes alone cannot re-seat (their shared-page table
+            # entries are gone) — typed engine-stage failure, like any
+            # in-flight request
+            self._drop_demoted(r)
+            r.pages = []
+            r.shared_idx = set()
+            r.state = FAILED
+            if r.error is None:
+                r.error = RequestFailure(
+                    r.uid, "engine",
+                    SchedulerError("KV pools rebuilt mid-flight "
+                                   "(compiled call failed)"),
+                    getattr(self, "steps", 0),
+                    tokens_generated=len(r.out))
+            self.failure_count += 1
         for i, r in enumerate(getattr(self, "_slots", [])):
             if r is not None:
                 r.state = FAILED

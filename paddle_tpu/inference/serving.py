@@ -101,6 +101,14 @@ class PageAllocator:
                 f"export_pages of unknown/closed transfer {token!r}")
         return pages
 
+    def is_exporting(self, page):
+        """True while `page` sits under ANY pending export ticket.
+        Reclaimers (PrefixCache.evict) must skip such pages even at
+        refcount 1: the ticket's commit will drop a reference, and a
+        concurrent free would hand the page to a new owner while the
+        transfer still names it."""
+        return any(page in pages for pages in self._exports.values())
+
     def export_commit(self, token):
         """Close the ticket and drop THIS transfer's reference on each
         page (ownership moved to the importer's copy); shared holders

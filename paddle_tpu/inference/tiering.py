@@ -1,0 +1,168 @@
+"""KV tiering: demoted request pages in host RAM, spilled to disk.
+
+HBM capacity — not FLOPs — is the binding constraint for chat serving
+(the Gemma-on-TPU comparison in PAPERS.md): a long-lived conversation
+pins its KV pages for the life of the session even while it sits idle
+between turns. The scheduler's demote path
+(`ContinuousBatchingEngine.demote_request`) evicts a cold request's
+device pages into THIS store using the CRC-stamped page-export format
+from PR 10 (`inference/handoff.py` — the same payload shape that rides
+the disaggregated handoff, so one integrity layer covers transfers AND
+tiers), and `restore_request` claims fresh device pages and writes the
+bytes back at a block boundary. The admission layer can then
+OVERSUBSCRIBE device pages: live requests' page needs may exceed the
+pool because the overflow lives here.
+
+Tier order is HBM -> host RAM -> disk: puts land in the host dict;
+when the host tier's byte budget overflows, the OLDEST entries spill
+to disk (atomic temp-write + rename, one manifest + one blob file per
+entry, the StoreKVTransport wire format so the CRCs stamped at demote
+ride into the files). `get` reads host or disk and re-verifies every
+CRC — a corrupt/torn tier entry surfaces as `KVHandoffError`, which
+the scheduler turns into a typed per-request restore failure (that ONE
+request retires; the engine keeps stepping — the PR 2 isolation
+contract).
+"""
+import collections
+import os
+
+from .handoff import StoreKVTransport, verify_payload
+
+
+class KVTierError(RuntimeError):
+    """A tier operation failed at the store layer (missing entry, disk
+    IO). Integrity failures raise KVHandoffError instead (the payload
+    arrived but its bytes are wrong)."""
+
+
+def resolve_tier(kv_tier, tier_dir=None, host_cap_mb=None):
+    """Engine-knob resolution: None/False -> None, an existing
+    KVTierStore passes through, "host"/"disk" builds one."""
+    if kv_tier in (None, False):
+        return None
+    if isinstance(kv_tier, KVTierStore):
+        return kv_tier
+    if kv_tier not in ("host", "disk"):
+        raise ValueError(
+            f"kv_tier must be None, 'host', 'disk' or a KVTierStore, "
+            f"got {kv_tier!r}")
+    return KVTierStore(kind=kv_tier, tier_dir=tier_dir,
+                       host_cap_mb=host_cap_mb)
+
+
+class KVTierStore:
+    """Two-level tier store for demoted KV page images.
+
+    kind="host": host RAM only (host_cap_mb ignored — demotion pressure
+      is bounded by the engine's live-request count).
+    kind="disk": host RAM front with a byte budget (host_cap_mb,
+      default 64); overflow spills oldest-first to `tier_dir` (required)
+      as <token>.manifest + <token>.blob, written temp-then-rename so a
+      crash never leaves a half entry where a whole one is expected —
+      a torn blob fails the CRC at restore instead.
+
+    Entries are keyed by the allocator transfer token minted at demote;
+    the token is burned at restore (PageAllocator.import_begin), so one
+    tier entry seats at most one continuation.
+    """
+
+    def __init__(self, kind="host", tier_dir=None, host_cap_mb=None):
+        if kind not in ("host", "disk"):
+            raise ValueError(f"kind must be 'host' or 'disk', got {kind!r}")
+        if kind == "disk" and not tier_dir:
+            raise ValueError("kind='disk' needs tier_dir=")
+        self.kind = kind
+        self.dir = tier_dir
+        if tier_dir:
+            os.makedirs(tier_dir, exist_ok=True)
+        self.host_cap = int((host_cap_mb if host_cap_mb is not None
+                             else 64) * 1e6)
+        self._host = collections.OrderedDict()   # token -> (manifest, blob)
+        self.host_bytes = 0
+        self.spills = 0          # host -> disk demotions
+        self.disk_reads = 0      # restores served from disk
+        self.puts = 0
+        self.gets = 0
+
+    def __contains__(self, token):
+        return token in self._host or (
+            self.dir is not None
+            and os.path.exists(self._path(token, "manifest")))
+
+    def __len__(self):
+        n = len(self._host)
+        if self.dir is not None:
+            n += sum(1 for f in os.listdir(self.dir)
+                     if f.endswith(".manifest")
+                     and f[:-len(".manifest")] not in self._host)
+        return n
+
+    def _path(self, token, ext):
+        return os.path.join(self.dir, f"{token}.{ext}")
+
+    # -- tier surface -------------------------------------------------------
+    def put(self, token, payload):
+        """Store a checksum_payload-stamped page image under `token`.
+        The payload is PACKED immediately (one contiguous blob), so the
+        tier never aliases live pool arrays."""
+        manifest, blob = StoreKVTransport._pack(payload)
+        self._host[token] = (manifest, blob)
+        self.host_bytes += len(blob)
+        self.puts += 1
+        if self.kind == "disk":
+            while self.host_bytes > self.host_cap and len(self._host) > 1:
+                self._spill_oldest()
+
+    def _spill_oldest(self):
+        token, (manifest, blob) = self._host.popitem(last=False)
+        self.host_bytes -= len(blob)
+        for ext, data in (("blob", blob), ("manifest", manifest)):
+            tmp = self._path(token, ext) + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._path(token, ext))
+        self.spills += 1
+
+    def get(self, token):
+        """Unpack + CRC-verify the entry; KVHandoffError on corruption,
+        KVTierError when the entry does not exist (already restored, or
+        a tier that lost data)."""
+        ent = self._host.get(token)
+        if ent is None and self.dir is not None:
+            try:
+                with open(self._path(token, "manifest"), "rb") as f:
+                    manifest = f.read()
+                with open(self._path(token, "blob"), "rb") as f:
+                    blob = f.read()
+            except OSError as e:
+                raise KVTierError(
+                    f"tier entry {token!r} unreadable: {e}") from e
+            ent = (manifest, blob)
+            self.disk_reads += 1
+        if ent is None:
+            raise KVTierError(
+                f"tier entry {token!r} not found (already restored, or "
+                "the tier lost it)")
+        self.gets += 1
+        return verify_payload(StoreKVTransport._unpack(*ent))
+
+    def delete(self, token):
+        """Best-effort removal (restore committed, or request died)."""
+        ent = self._host.pop(token, None)
+        if ent is not None:
+            self.host_bytes -= len(ent[1])
+        if self.dir is not None:
+            for ext in ("manifest", "blob"):
+                try:
+                    os.unlink(self._path(token, ext))
+                except OSError:
+                    pass
+
+    def stats(self):
+        return {"kind": self.kind, "entries": len(self),
+                "host_entries": len(self._host),
+                "host_bytes": self.host_bytes,
+                "spills": self.spills, "disk_reads": self.disk_reads,
+                "puts": self.puts, "gets": self.gets}

@@ -1,0 +1,414 @@
+"""KV tiering: HBM -> host RAM -> disk demotion + restore (ISSUE 11
+tentpole c) and the eviction-safety contract under transfer tickets.
+
+Layers under test, bottom-up:
+  - KVTierStore: host put/get/delete, disk spill past the host byte
+    budget, CRC catches a corrupt disk blob.
+  - PrefixCache.evict vs transfer tickets: a cache-only page (refcount
+    1) under a pending export ticket is NEVER freed out from under the
+    transfer; a demoted request's kept shared pages survive eviction
+    pressure for the life of the pending restore.
+  - demote_request/restore_request: greedy outputs BYTE-IDENTICAL to a
+    never-demoted run, pinned across decode_block in {1, 8}; zero page
+    leak; a corrupt tier entry or an injected kv.restore fault retires
+    exactly ONE request (stage "restore") while the engine keeps
+    stepping.
+  - oversubscription: more live requests than the device pool holds —
+    admission demotes, the sweep restores, everyone finishes with the
+    same bytes as an uncontended run.
+  - slow chaos soak: a 3-replica prefix-routed fleet under demotion
+    pressure + seeded kills loses nothing.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import failsafe
+from paddle_tpu.inference.handoff import KVHandoffError
+from paddle_tpu.inference.router import EngineRouter
+from paddle_tpu.inference.scheduler import (ContinuousBatchingEngine,
+                                            PrefixCache)
+from paddle_tpu.inference.serving import PageAllocator
+from paddle_tpu.inference.tiering import KVTierError, KVTierStore
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def _micro_cfg():
+    return LlamaConfig.tiny(num_hidden_layers=1, hidden_size=32,
+                            intermediate_size=64, num_attention_heads=2)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    paddle.seed(3)
+    cfg = _micro_cfg()
+    return LlamaForCausalLM(cfg), cfg
+
+
+ENGINE_KW = dict(max_len=64, page_size=8, max_batch=2, prefill_chunk=8)
+
+
+def _mk(model, **over):
+    kw = dict(ENGINE_KW)
+    kw.update(over)
+    return ContinuousBatchingEngine(model, **kw)
+
+
+def assert_no_leak(eng):
+    held = 0 if eng._prefix is None else len(eng._prefix)
+    assert eng.allocator.available == eng.allocator.n_pages - held, (
+        eng.allocator.available, eng.allocator.n_pages, held)
+    assert eng.pages_demoted == 0
+    assert not eng._demoted
+
+
+def _fake_payload(token, lens=8):
+    """A minimal checksum-stamped payload (one layer, one page)."""
+    from paddle_tpu.inference.handoff import checksum_payload
+    return checksum_payload({
+        "token": token,
+        "spec": {"state": "x", "prompt": np.arange(lens, dtype=np.int64)},
+        "lens": lens,
+        "geometry": {"page_size": 8, "nh_kv": 2, "hd": 16, "layers": 1,
+                     "kv_dtype": "float32"},
+        "k": [np.full((1, 8, 2, 16), 1.5, np.float32)],
+        "v": [np.full((1, 8, 2, 16), 2.5, np.float32)],
+    })
+
+
+# -------------------------------------------------------------- tier store
+class TestKVTierStore:
+    def test_host_roundtrip_and_delete(self):
+        st = KVTierStore(kind="host")
+        st.put("t0", _fake_payload("t0"))
+        out = st.get("t0")
+        assert out["lens"] == 8
+        np.testing.assert_array_equal(out["k"][0],
+                                      np.full((1, 8, 2, 16), 1.5))
+        st.delete("t0")
+        with pytest.raises(KVTierError, match="not found"):
+            st.get("t0")
+
+    def test_disk_spill_and_restore(self, tmp_path):
+        st = KVTierStore(kind="disk", tier_dir=str(tmp_path),
+                         host_cap_mb=0.004)     # ~4 KB: force spills
+        for i in range(3):
+            st.put(f"t{i}", _fake_payload(f"t{i}"))
+        assert st.spills >= 2               # oldest entries hit disk
+        out = st.get("t0")                  # served FROM disk
+        assert st.disk_reads == 1
+        np.testing.assert_array_equal(out["v"][0],
+                                      np.full((1, 8, 2, 16), 2.5))
+
+    def test_corrupt_disk_blob_fails_crc(self, tmp_path):
+        st = KVTierStore(kind="disk", tier_dir=str(tmp_path),
+                         host_cap_mb=0.001)
+        st.put("t0", _fake_payload("t0"))
+        st.put("t1", _fake_payload("t1"))   # spills t0
+        blob = tmp_path / "t0.blob"
+        raw = bytearray(blob.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF
+        blob.write_bytes(bytes(raw))
+        with pytest.raises(KVHandoffError, match="CRC mismatch"):
+            st.get("t0")
+
+
+# ------------------------------------------------- eviction vs tickets
+class TestEvictionSafety:
+    def test_evict_skips_pages_under_export_ticket(self):
+        """Satellite: evict(protect=) protects by page id only — a
+        cache-only page (refcount 1) under a PENDING export ticket
+        (prefix ship / handoff mid-flight) must survive eviction, or
+        the ticket's commit double-frees a page someone else now
+        owns."""
+        al = PageAllocator(4)
+        cache = PrefixCache(page_size=2)
+        pg = al.alloc()
+        cache.insert((), (7, 9), pg, al)    # cache takes its own ref
+        al.free([pg])                       # creator retires: refcount 1
+        token = al.export_begin([pg])       # transfer in flight
+        assert cache.evict(4, al) == 0      # MUST NOT free the page
+        assert al.refcount(pg) == 1
+        al.export_commit(token)             # commit drops the last ref
+        assert al.available == 4
+        # the cache entry now points at a freed page; a later evict
+        # pass drops the entry without touching the free list
+        assert len(cache) == 1
+
+    def test_evict_frees_after_ticket_closes(self):
+        al = PageAllocator(4)
+        cache = PrefixCache(page_size=2)
+        pg = al.alloc()
+        cache.insert((), (7, 9), pg, al)
+        al.free([pg])
+        token = al.export_begin([pg])
+        al.export_abort(token)              # ticket closed, untouched
+        assert cache.evict(4, al) == 1      # now evictable
+        assert al.available == 4
+
+    def test_demoted_shared_pages_survive_eviction_pressure(self, tiny):
+        """A demoted request KEEPS its references on prefix-cache-shared
+        pages (they are deduplicated HBM) — cache eviction under
+        admission pressure must never free them while the restore is
+        pending, and the restore must produce the exact bytes."""
+        model, cfg = tiny
+        rng = np.random.RandomState(5)
+        base = rng.randint(0, cfg.vocab_size, (17,)).astype(np.int64)
+        ref = _mk(model)
+        ra = ref.add_request(base, max_new_tokens=6)
+        ref.drain()
+        rb = ref.add_request(np.concatenate(
+            [base, np.asarray([3], np.int64)]), max_new_tokens=6)
+        ref.drain()
+        want_a, want_b = ref.result(ra), ref.result(rb)
+
+        eng = _mk(model, kv_tier="host")
+        ua = eng.add_request(base, max_new_tokens=6)
+        eng.drain()                          # publishes 2 prefix pages
+        np.testing.assert_array_equal(eng.result(ua), want_a)
+        ub = eng.add_request(np.concatenate(
+            [base, np.asarray([3], np.int64)]), max_new_tokens=6)
+        while eng.status(ub) != "decode":
+            eng.step()
+        r = eng._requests[ub]
+        shared = [r.pages[i] for i in sorted(r.shared_idx)]
+        assert shared, "request never shared the cached prefix"
+        eng.demote_request(ub)
+        # heavy eviction pressure: ask for far more than exists
+        eng._prefix.evict(999, eng.allocator)
+        for pg in shared:
+            assert eng.allocator.refcount(pg) >= 1, (
+                "demoted request's shared page evicted out from under "
+                "the pending restore")
+        eng.drain()                          # restore sweep re-seats
+        np.testing.assert_array_equal(eng.result(ub), want_b)
+        assert eng.restores == 1
+        assert_no_leak(eng)
+
+
+# ------------------------------------------------------ demote / restore
+class TestDemoteRestore:
+    @pytest.mark.parametrize("K", [1, 8])
+    def test_roundtrip_byte_identity(self, tiny, K):
+        """Greedy output of a demote->restore round trip is
+        byte-identical to a never-demoted run — the acceptance pin,
+        across the per-step and fused engines."""
+        model, cfg = tiny
+        rng = np.random.RandomState(11)
+        prompts = [rng.randint(0, cfg.vocab_size, (t,)).astype(np.int64)
+                   for t in (12, 7)]
+        ref = _mk(model, decode_block=K)
+        want = ref.generate_many(prompts, max_new_tokens=[10, 8])
+
+        eng = _mk(model, decode_block=K, kv_tier="host")
+        uids = [eng.add_request(p, n) for p, n in zip(prompts, [10, 8])]
+        while eng.status(uids[0]) != "decode":
+            eng.step()
+        eng.demote_request(uids[0])
+        assert eng.status(uids[0]) == "demoted"
+        assert eng.pages_demoted > 0
+        eng.drain()
+        for u, w in zip(uids, want):
+            np.testing.assert_array_equal(eng.result(u), w)
+        assert eng.demotions == 1 and eng.restores == 1
+        assert_no_leak(eng)
+
+    def test_kill_at_restore_retires_exactly_one(self, tiny):
+        """Injected kv.restore fault: the demoted request fails with a
+        typed stage="restore" record, the OTHER request finishes, zero
+        page leak — the acceptance criterion's isolation pin."""
+        model, cfg = tiny
+        rng = np.random.RandomState(13)
+        eng = _mk(model, kv_tier="host")
+        ua = eng.add_request(
+            rng.randint(0, cfg.vocab_size, (9,)).astype(np.int64),
+            max_new_tokens=8)
+        ub = eng.add_request(
+            rng.randint(0, cfg.vocab_size, (6,)).astype(np.int64),
+            max_new_tokens=8)
+        while eng.status(ua) != "decode":
+            eng.step()
+        eng.demote_request(ua)
+        with failsafe.inject("kv.restore", nth=1):
+            eng.drain()
+        assert eng.status(ua) == "failed"
+        assert eng.failures()[ua].stage == "restore"
+        assert eng.status(ub) == "done"
+        assert eng.restore_failures == 1
+        assert_no_leak(eng)
+
+    def test_corrupt_tier_entry_fails_one_request(self, tiny):
+        model, cfg = tiny
+        rng = np.random.RandomState(17)
+        eng = _mk(model, kv_tier="host")
+        ua = eng.add_request(
+            rng.randint(0, cfg.vocab_size, (9,)).astype(np.int64),
+            max_new_tokens=8)
+        ub = eng.add_request(
+            rng.randint(0, cfg.vocab_size, (6,)).astype(np.int64),
+            max_new_tokens=8)
+        while eng.status(ua) != "decode":
+            eng.step()
+        token = eng.demote_request(ua)
+        manifest, blob = eng._tier._host[token]
+        flipped = bytearray(blob)
+        flipped[len(flipped) // 2] ^= 0xFF   # corrupt the KV bytes
+        eng._tier._host[token] = (manifest, bytes(flipped))
+        eng.drain()
+        assert eng.status(ua) == "failed"
+        fl = eng.failures()[ua]
+        assert fl.stage == "restore" and "CRC" in fl.message
+        assert eng.status(ub) == "done"
+        assert_no_leak(eng)
+
+    def test_cancel_and_deadline_clean_up_demoted(self, tiny):
+        model, cfg = tiny
+        rng = np.random.RandomState(19)
+        eng = _mk(model, kv_tier="host")
+        ua = eng.add_request(
+            rng.randint(0, cfg.vocab_size, (9,)).astype(np.int64),
+            max_new_tokens=20)
+        while eng.status(ua) != "decode":
+            eng.step()
+        token = eng.demote_request(ua)
+        assert token in eng._tier
+        assert eng.cancel(ua) is True
+        assert token not in eng._tier        # tier entry dropped
+        assert_no_leak(eng)
+        # deadline expiry on a demoted request sheds the same way
+        ub = eng.add_request(
+            rng.randint(0, cfg.vocab_size, (7,)).astype(np.int64),
+            max_new_tokens=20, ttl_steps=50)
+        while eng.status(ub) != "decode":
+            eng.step()
+        eng.demote_request(ub)
+        eng.steps += 100                     # exhaust the TTL
+        eng._expire_deadlines()
+        assert eng.status(ub) == "failed"
+        assert eng.failures()[ub].error == "DeadlineExceededError"
+        assert_no_leak(eng)
+
+    def test_oversubscription_byte_identity(self, tiny):
+        """4 live requests over a 2-slot engine: admission demotes, the
+        sweep restores, everyone finishes with the SAME bytes as an
+        uncontended (4-slot, no-tier) run."""
+        model, cfg = tiny
+        rng = np.random.RandomState(23)
+        prompts = [rng.randint(0, cfg.vocab_size, (t,)).astype(np.int64)
+                   for t in (12, 9, 7, 10)]
+        budgets = [8, 6, 9, 7]
+        ref = _mk(model, max_batch=4)
+        want = ref.generate_many(prompts, max_new_tokens=budgets)
+
+        eng = _mk(model, kv_tier="host", max_batch=2)
+        uids = [eng.add_request(p, n)
+                for p, n in zip(prompts, budgets)]
+        eng.drain()
+        for u, w in zip(uids, want):
+            np.testing.assert_array_equal(eng.result(u), w)
+        assert eng.demotions > 0, "no demotion pressure ever built"
+        assert eng.restores == eng.demotions
+        assert_no_leak(eng)
+        h = eng.health()
+        assert h["kv_tier"] == "host" and h["demotions"] == eng.demotions
+
+    def test_demote_fault_leaves_request_serving(self, tiny):
+        model, cfg = tiny
+        rng = np.random.RandomState(29)
+        eng = _mk(model, kv_tier="host")
+        ua = eng.add_request(
+            rng.randint(0, cfg.vocab_size, (9,)).astype(np.int64),
+            max_new_tokens=8)
+        while eng.status(ua) != "decode":
+            eng.step()
+        with failsafe.inject("kv.demote", nth=1):
+            with pytest.raises(failsafe.InjectedFault):
+                eng.demote_request(ua)
+        assert eng.status(ua) == "decode"    # untouched, keeps serving
+        eng.drain()
+        assert eng.status(ua) == "done"
+        assert_no_leak(eng)
+
+
+class TestRouterTiering:
+    def test_demoted_only_replica_still_drains(self, tiny):
+        """Review-caught regression pin: a replica whose ONLY live
+        request is DEMOTED (queue empty, slots empty) must still be
+        stepped by the router — has_work() counts demoted — or the
+        restore sweep never runs and router.drain() exits with the
+        request stranded in 'demoted' forever."""
+        model, cfg = tiny
+        rng = np.random.RandomState(41)
+
+        def factory():
+            return _mk(model, kv_tier="host")
+
+        router = EngineRouter(factory, replicas=2)
+        u = router.add_request(
+            rng.randint(0, cfg.vocab_size, (9,)).astype(np.int64),
+            max_new_tokens=8)
+        rr = router._reqs[u]
+        rep = router._by_name[rr.replica]
+        while rep.engine.status(rr.engine_uid) != "decode":
+            router.step()
+        rep.engine.demote_request(rr.engine_uid)
+        assert not any(s is not None for s in rep.engine._slots)
+        assert rep.has_work()            # demoted IS work
+        router.drain()
+        assert router.status(u) == "done"
+        assert rep.engine.restores == 1
+        assert_no_leak(rep.engine)
+
+
+# ------------------------------------------------------------- chaos soak
+@pytest.mark.slow
+@pytest.mark.faults
+class TestTieredFleetSoak:
+    def test_seeded_chaos_with_demotion_pressure(self, tiny):
+        """3-replica prefix-routed fleet, 2-slot tiered engines, a
+        repeated system prompt + ragged tails, seeded kills across
+        replica.step / kv.restore / kv.demote / index.publish: every
+        request ends DONE or typed-FAILED (zero lost), survivors'
+        outputs are byte-identical to an unchaosed reference, no page
+        leaks anywhere."""
+        model, cfg = tiny
+        rng = np.random.RandomState(31)
+        sys_prompt = rng.randint(0, cfg.vocab_size, (17,)).astype(np.int64)
+        prompts, budgets = [], []
+        for i in range(12):
+            tail = rng.randint(0, cfg.vocab_size,
+                               (int(rng.randint(1, 6)),)).astype(np.int64)
+            prompts.append(np.concatenate([sys_prompt, tail])
+                           if i % 3 else tail)
+            budgets.append(int(rng.randint(4, 9)))
+        ref = _mk(model, max_batch=4)
+        want = ref.generate_many(prompts, max_new_tokens=budgets)
+
+        def factory():
+            return _mk(model, kv_tier="host")
+
+        router = EngineRouter(factory, replicas=3, prefix_routing=True,
+                              quarantine_threshold=3)
+        with failsafe.inject("replica.step", p=0.02, seed=7,
+                             times=None), \
+                failsafe.inject("kv.restore", p=0.05, seed=11,
+                                times=None), \
+                failsafe.inject("kv.demote", p=0.05, seed=13,
+                                times=None), \
+                failsafe.inject("index.publish", p=0.1, seed=17,
+                                times=None):
+            uids = [router.add_request(p, max_new_tokens=b)
+                    for p, b in zip(prompts, budgets)]
+            router.drain()
+        lost = [u for u in uids
+                if router.status(u) not in ("done", "failed")]
+        assert not lost, f"requests neither done nor failed: {lost}"
+        for u, w in zip(uids, want):
+            if router.status(u) == "done":
+                np.testing.assert_array_equal(router.result(u), w)
+        for rep in router._replicas:
+            eng = rep.engine
+            held = len(eng._prefix)
+            assert eng.allocator.available == \
+                eng.allocator.n_pages - held, rep.name
+            assert eng.pages_demoted == 0 or eng._demoted, rep.name

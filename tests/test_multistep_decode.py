@@ -48,6 +48,37 @@ def cb8(tiny):
     return mk(tiny[0], 8)
 
 
+# SMALL-geometry engine pair for the tier-1 equivalence tests (PR 10's
+# conftest note: these two tests inherited the cb8 module fixture's
+# compile bill — K=8 fused scans at slot buckets up to 4 — when the
+# test that used to absorb it moved to slow, and sat grandfathered over
+# the 15s budget). A 2-layer model at K=4 / max_batch=2 pins the same
+# contracts (per-slot on-device EOS retirement, chained-block byte
+# identity) at a fraction of the trace+compile surface; the K=8 / full
+# tiny() geometry coverage still runs on the slow lane above.
+@pytest.fixture(scope="module")
+def tiny_s():
+    paddle.seed(3)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    return LlamaForCausalLM(cfg), cfg
+
+
+def mk_s(model, K):
+    return ContinuousBatchingEngine(model, decode_block=K, max_len=48,
+                                    page_size=8, max_batch=2,
+                                    prefill_chunk=8)
+
+
+@pytest.fixture(scope="module")
+def cb1s(tiny_s):
+    return mk_s(tiny_s[0], 1)
+
+
+@pytest.fixture(scope="module")
+def cb4s(tiny_s):
+    return mk_s(tiny_s[0], 4)
+
+
 def ragged_stream(cfg, n, seed=0, max_budget=12):
     rng = np.random.RandomState(seed)
     lens = rng.randint(3, 18, n)
@@ -75,57 +106,57 @@ class TestFusedEquivalence:
         assert_no_leak(cb1)
         assert_no_leak(cb8)
 
-    def test_eos_retirement_matches(self, tiny, cb1, cb8):
+    def test_eos_retirement_matches(self, tiny_s, cb1s, cb4s):
         """Per-slot EOS flags on DEVICE must retire exactly where the
         host loop would: discover a real token from a free run, then
         re-decode with it as EOS in both modes."""
-        _, cfg = tiny
+        _, cfg = tiny_s
         rng = np.random.RandomState(5)
         prompts = [rng.randint(0, cfg.vocab_size, (t,)).astype(np.int64)
                    for t in (9, 6)]
-        free = cb1.generate_many(prompts, max_new_tokens=12)
+        free = cb1s.generate_many(prompts, max_new_tokens=12)
         eos = int(free[0][prompts[0].size + 2])
-        o1 = cb1.generate_many(prompts, max_new_tokens=12,
-                               eos_token_id=eos)
-        o8 = cb8.generate_many(prompts, max_new_tokens=12,
-                               eos_token_id=eos)
-        for a, b in zip(o1, o8):
+        o1 = cb1s.generate_many(prompts, max_new_tokens=12,
+                                eos_token_id=eos)
+        o4 = cb4s.generate_many(prompts, max_new_tokens=12,
+                                eos_token_id=eos)
+        for a, b in zip(o1, o4):
             np.testing.assert_array_equal(a, b)
         # the EOS really fired early for request 0
         assert o1[0].size < prompts[0].size + 12 + 1 or \
             int(o1[0][-1]) == eos
 
-    def test_pipelined_chaining_same_bytes(self, tiny, cb1, cb8):
+    def test_pipelined_chaining_same_bytes(self, tiny_s, cb1s, cb4s):
         """Steady-state decode: block N+1 is dispatched from block N's
         device carries BEFORE N's readback — and the bytes still match
         the per-step engine."""
-        _, cfg = tiny
+        _, cfg = tiny_s
         rng = np.random.RandomState(7)
         prompts = [rng.randint(0, cfg.vocab_size, (t,)).astype(np.int64)
-                   for t in (9, 5, 12, 7)]
-        chained0 = cb8.chained_blocks
-        o1 = cb1.generate_many(prompts, max_new_tokens=24)
-        o8 = cb8.generate_many(prompts, max_new_tokens=24)
-        for a, b in zip(o1, o8):
+                   for t in (9, 5)]
+        chained0 = cb4s.chained_blocks
+        o1 = cb1s.generate_many(prompts, max_new_tokens=24)
+        o4 = cb4s.generate_many(prompts, max_new_tokens=24)
+        for a, b in zip(o1, o4):
             np.testing.assert_array_equal(a, b)
-        assert cb8.chained_blocks > chained0, \
+        assert cb4s.chained_blocks > chained0, \
             "pure-decode stream never pipelined a block"
-        assert_no_leak(cb8)
+        assert_no_leak(cb4s)
 
-    def test_ttl_and_fault_outcomes_match(self, tiny, cb1, cb8):
+    def test_ttl_and_fault_outcomes_match(self, tiny_s, cb1s, cb4s):
         """RequestFailure outcome SETS are identical across K (fused
         deadlines round up to the block boundary but expire all the
         same; faults fire at host sync points). The injected fault runs
         against a LONE decode request: fault_point call counts are
         per-step in one mode and per-block in the other, so a shared
         nth trigger is only request-deterministic with one candidate."""
-        _, cfg = tiny
+        _, cfg = tiny_s
         rng = np.random.RandomState(9)
         base = rng.randint(0, cfg.vocab_size, (10,)).astype(np.int64)
         outcomes = {}
-        for cb in (cb1, cb8):
+        for cb in (cb1s, cb4s):
             uids = {}
-            uids["ttl"] = cb.add_request(base, max_new_tokens=40,
+            uids["ttl"] = cb.add_request(base, max_new_tokens=30,
                                          ttl_steps=6)
             uids["ok"] = cb.add_request(base[:5], max_new_tokens=4)
             cb.drain()
@@ -139,26 +170,26 @@ class TestFusedEquivalence:
                 for name, uid in uids.items()}
             assert cb.status(uids["ok"]) == "done"
             assert_no_leak(cb)
-        assert outcomes[1] == outcomes[8], outcomes
-        assert outcomes[8]["ttl"] == "deadline"
-        assert outcomes[8]["fault"] == "decode"
+        assert outcomes[1] == outcomes[4], outcomes
+        assert outcomes[4]["ttl"] == "deadline"
+        assert outcomes[4]["fault"] == "decode"
 
-    def test_cancel_midflight_fused(self, tiny, cb8):
-        _, cfg = tiny
+    def test_cancel_midflight_fused(self, tiny_s, cb4s):
+        _, cfg = tiny_s
         rng = np.random.RandomState(13)
-        a = cb8.add_request(
+        a = cb4s.add_request(
             rng.randint(0, cfg.vocab_size, (9,)).astype(np.int64),
             max_new_tokens=30)
-        b = cb8.add_request(
+        b = cb4s.add_request(
             rng.randint(0, cfg.vocab_size, (6,)).astype(np.int64),
             max_new_tokens=6)
         for _ in range(2):
-            cb8.step()
-        assert cb8.cancel(a) is True
-        cb8.drain()
-        assert cb8.status(a) == "cancelled"
-        assert cb8.status(b) == "done"
-        assert_no_leak(cb8)
+            cb4s.step()
+        assert cb4s.cancel(a) is True
+        cb4s.drain()
+        assert cb4s.status(a) == "cancelled"
+        assert cb4s.status(b) == "done"
+        assert_no_leak(cb4s)
 
     def test_prefix_share_and_cow_fused(self, tiny):
         model, cfg = tiny
@@ -237,17 +268,17 @@ class TestFusedEquivalence:
         np.testing.assert_array_equal(b0, b1)
         assert tele0 == tele1, (tele0, tele1)
 
-    def test_single_token_budget_fused(self, tiny, cb1, cb8):
+    def test_single_token_budget_fused(self, tiny_s, cb1s, cb4s):
         """max_new_tokens=1: the only token comes from the prefill
         phase's on-device sample; the request must retire without ever
         entering the decode scan."""
-        _, cfg = tiny
+        _, cfg = tiny_s
         rng = np.random.RandomState(19)
         p = rng.randint(0, cfg.vocab_size, (11,)).astype(np.int64)
-        o1 = cb1.generate_many([p], max_new_tokens=1)[0]
-        o8 = cb8.generate_many([p], max_new_tokens=1)[0]
-        np.testing.assert_array_equal(o1, o8)
-        assert o8.size == p.size + 1
+        o1 = cb1s.generate_many([p], max_new_tokens=1)[0]
+        o4 = cb4s.generate_many([p], max_new_tokens=1)[0]
+        np.testing.assert_array_equal(o1, o4)
+        assert o4.size == p.size + 1
 
 
 @pytest.mark.slow

@@ -61,6 +61,12 @@ def _weight_bytes_per_step(eng):
         mk = W["mk"]
         total = (sum(megakernel_weight_bytes(m) for m in mk)
                  if isinstance(mk, list) else megakernel_weight_bytes(mk))
+        if "mk_head" in W:
+            # whole-step mode streams the PACKED head + final norm
+            # (padded) inside the same schedule — count that layout,
+            # not the snapshot's
+            return total + sum(_leaf_bytes(W["mk_head"][k])
+                               for k in ("wh", "sh", "nf"))
     else:
         total = sum(_leaf_bytes(w)
                     for lay in W["layers"] for w in lay.values())
@@ -357,29 +363,42 @@ def main():
     # are separating out): host_overhead_frac(K) =
     #   1 - decode_steps(K) * t_step / wall(K)
     mb = fused_kw["max_batch"]
-    probe = ContinuousBatchingEngine(f_model, decode_block=1,
-                                     megakernel=False, **fused_kw)
-    probe.generate_many(
-        [f_rng.randint(0, f_cfg.vocab_size, 8).astype(np.int64)
-         for _ in range(mb)], max_new_tokens=4)
-    step_fn = probe._cb_step_fns[mb]
-    kp, vp = probe.k_pages, probe.v_pages
-    s_tok = jnp.asarray(np.zeros(mb, np.int64))
-    s_tab = jnp.asarray(probe._tables_np[:mb])
-    s_len = jnp.asarray(np.zeros(mb, np.int32))
-    s_act = jnp.asarray(np.ones(mb, bool))
-    logits, kp, vp = step_fn(probe.weights, s_tok, kp, vp, s_tab, s_len,
-                             s_act)
-    jax.block_until_ready(logits)
-    M = 30
-    t_start = time.perf_counter()
-    for _ in range(M):
+
+    def _bare_step_probe(mk_mode, tp_n=1):
+        """Per-MODE bare device step time: probe the compiled K=1 step
+        of an engine running exactly that decode math (op chain,
+        per-layer kernel, or the whole-step kernel; tp-matched) — a
+        host_overhead_frac derived from another mode's probe would
+        mis-attribute the win/loss between host and device. (Spec
+        cells reuse their mode's PLAIN-step probe: a verify pass does
+        more device work per step, so their host_overhead_frac is an
+        upper bound — tagged probe="plain-step".)"""
+        probe = ContinuousBatchingEngine(f_model, decode_block=1,
+                                         megakernel=mk_mode, tp=tp_n,
+                                         **fused_kw)
+        probe.generate_many(
+            [f_rng.randint(0, f_cfg.vocab_size, 8).astype(np.int64)
+             for _ in range(mb)], max_new_tokens=4)
+        step_fn = probe._cb_step_fns[mb]
+        kp, vp = probe.k_pages, probe.v_pages
+        s_tok = jnp.asarray(np.zeros(mb, np.int64))
+        s_tab = jnp.asarray(probe._tables_np[:mb])
+        s_len = jnp.asarray(np.zeros(mb, np.int32))
+        s_act = jnp.asarray(np.ones(mb, bool))
         logits, kp, vp = step_fn(probe.weights, s_tok, kp, vp, s_tab,
                                  s_len, s_act)
-    jax.block_until_ready(logits)
-    t_step = (time.perf_counter() - t_start) / M
-    probe.k_pages, probe.v_pages = kp, vp  # donated buffers moved
-    probe = None
+        jax.block_until_ready(logits)
+        M = 30
+        t_start = time.perf_counter()
+        for _ in range(M):
+            logits, kp, vp = step_fn(probe.weights, s_tok, kp, vp,
+                                     s_tab, s_len, s_act)
+        jax.block_until_ready(logits)
+        t = (time.perf_counter() - t_start) / M
+        probe.k_pages, probe.v_pages = kp, vp  # donated buffers moved
+        return t
+
+    t_step = _bare_step_probe(False)
 
     # weight roofline (PR 6): bytes/step is a property of the snapshot,
     # the nominal bandwidth of the backend — together they attribute a
@@ -389,7 +408,7 @@ def main():
     # erases). Measured once per geometry, stamped on every line below.
     peak_gbps = _nominal_bw_gbps()
 
-    def _fused_run(eng, tag_extra):
+    def _fused_run(eng, tag_extra, t_probe=None):
         warm = [f_rng.randint(0, f_cfg.vocab_size, int(t))
                 .astype(np.int64) for t in f_lens[:fused_kw["max_batch"]]]
         # warmup compiles every fused variant the stream will hit
@@ -410,8 +429,14 @@ def main():
         # weight bandwidth; the same bytes at nominal bandwidth over the
         # wall is how much of the run was irreducibly weight-bound
         moved = wbytes * (d_steps + pf_steps)
-        mk_on = tag_extra.get("megakernel") not in (None, "off")
-        _emit({
+        # host_overhead_frac only against the engine's OWN mode probe
+        # (t_probe): the op-chain probe on a megakernel line (or vice
+        # versa) would mis-attribute the win/loss between host and
+        # device
+        hof = (None if t_probe is None else round(
+            min(1.0, max(0.0, 1.0 - (d_steps + pf_steps) * t_probe
+                         / max(wall, 1e-9))), 4))
+        payload = {
             "metric": "cb_fused_steps_per_sec",
             "model": ("llama7b" if seven_b
                       else "llama350m" if on_tpu else "llama-micro"),
@@ -422,14 +447,9 @@ def main():
             "decode_steps": d_steps,
             "prefill_steps": pf_steps,
             "chained_blocks": eng.chained_blocks,
-            # t_step was probed on the OP-CHAIN engine: stamping it (or
-            # a host_overhead_frac derived from it) on a megakernel line
-            # would mis-attribute the win/loss between host and device
-            **({} if mk_on else {
-                "t_step_us": round(t_step * 1e6, 1),
-                "host_overhead_frac": round(
-                    min(1.0, max(0.0, 1.0 - (d_steps + pf_steps) * t_step
-                                 / max(wall, 1e-9))), 4)}),
+            **({} if t_probe is None else {
+                "t_step_us": round(t_probe * 1e6, 1),
+                "host_overhead_frac": hof}),
             "value": round(toks / max(wall, 1e-9), 2),
             "weight_mb_per_step": round(wbytes / 1e6, 3),
             "cb_weight_gbps": round(moved / max(wall, 1e-9) / 1e9, 3),
@@ -438,15 +458,16 @@ def main():
             "nominal_gbps": round(peak_gbps, 1),
             "unit": "tokens/s",
             **tag_extra,
-        })
-        return outs
+        }
+        _emit(payload)
+        return outs, payload
 
     mk_ref = None  # the K=8 op-chain outputs double as the mk baseline
     for K in (1, 4, 8):
         eng = None  # free the previous engine before building the next
         eng = ContinuousBatchingEngine(f_model, decode_block=K,
                                        megakernel=False, **fused_kw)
-        outs = _fused_run(eng, {"megakernel": "off"})
+        outs, _ = _fused_run(eng, {"megakernel": "off"}, t_probe=t_step)
         if K == 8:
             mk_ref = outs
 
@@ -474,25 +495,106 @@ def main():
                "megakernel": "unsupported-geometry", "value": 0.0,
                "unit": "tokens/s"})
         mk_modes = ()
-    elif on_tpu:
-        mk_modes = ("layer", "multi")
-    elif seven_b:
+    elif seven_b and not on_tpu:
         # interpret-mode megakernel over a 32-layer 7B stack would run
         # for hours; CPU parity evidence lives in the default micro run
-        # and tests/test_decode_megakernel.py
+        # and tests/test_megakernel_v2.py
         mk_modes = ()
     else:
-        mk_modes = ("layer",)
+        # "layer" = per-layer invocations + op-chain lm_head; "multi" =
+        # the WHOLE-STEP kernel (all layers + final norm + lm_head +
+        # greedy argmax in one invocation). Each mode's
+        # host_overhead_frac uses ITS OWN bare-step probe.
+        mk_modes = ("layer", "multi")
+    mk_payloads = {}
+    mode_probes = {}
     for mode in mk_modes:
+        mode_probes[(mode, 1)] = _bare_step_probe(mode)
         eng = None
         eng = ContinuousBatchingEngine(f_model, decode_block=8,
                                        megakernel=mode, **fused_kw)
-        outs = _fused_run(eng, {"megakernel": eng.health()["megakernel"]})
+        outs, pay = _fused_run(
+            eng, {"megakernel": eng.health()["megakernel"],
+                  "whole_step": eng.health()["megakernel_whole_step"]},
+            t_probe=mode_probes[(mode, 1)])
+        mk_payloads[mode] = pay
         for i, (a, b) in enumerate(zip(mk_ref, outs)):
             assert a.shape == b.shape and (a == b).all(), (
                 f"megakernel={mode} diverged from the op-chain path "
                 f"at request {i} — greedy outputs must be "
                 "byte-identical")
+    # -- whole-step vs per-layer dispatch ceiling (the v2 claim): the
+    # -- K=8 host_overhead_frac of the whole-step mode must sit
+    # -- STRICTLY below the per-layer mode on the same geometry —
+    # -- everything between layers and steps left the host. Its own
+    # -- rc=0 guard: a violation tags the line, never kills the bench.
+    try:
+        if "layer" in mk_payloads and "multi" in mk_payloads:
+            hof_layer = mk_payloads["layer"]["host_overhead_frac"]
+            hof_whole = mk_payloads["multi"]["host_overhead_frac"]
+            assert hof_whole < hof_layer, (
+                f"whole-step host_overhead_frac {hof_whole} is not "
+                f"strictly below per-layer {hof_layer} at K=8")
+            _emit({"metric": "cb_wholestep_host_overhead", "K": 8,
+                   "host_overhead_frac_layer": hof_layer,
+                   "host_overhead_frac_whole_step": hof_whole,
+                   "value": round(hof_layer - hof_whole, 4),
+                   "unit": "frac"})
+    except Exception as e:  # noqa: BLE001 — bench must stay rc=0
+        _emit({"metric": "cb_wholestep_host_overhead", "value": 0.0,
+               "unit": "frac", "error": f"{type(e).__name__}: {e}"})
+
+    # -- megakernel x speculation x tensor-parallel composition cells --
+    # The PR 12 acceptance grid at K=8: the whole-step kernel with the
+    # spec tq>1 verify schedule, with per-shard tp=2 segments, and with
+    # both — byte-identity vs the op-chain baseline asserted IN-BENCH
+    # for every cell (greedy spec == non-spec, tp exact == tp=1). Own
+    # rc=0 guard; a cell that cannot run (devices) emits a LOUD skip.
+    try:
+        if mk_modes:
+            import jax as _jax
+            cells = [("multi", 4, 1)]
+            if len(_jax.devices()) >= 2:
+                cells += [("multi", 0, 2), ("multi", 4, 2)]
+            else:
+                _emit({"metric": "cb_mk_compose", "value": 0.0,
+                       "unit": "tokens/s",
+                       "error": "tp=2 cells skipped: fewer than 2 "
+                                "devices visible"})
+            probes = dict(mode_probes)   # reuse the mk_modes-loop
+            for mode, spec, tp_n in cells:  # measurements (same key)
+                if (mode, tp_n) not in probes:
+                    probes[(mode, tp_n)] = _bare_step_probe(mode, tp_n)
+                eng = None
+                eng = ContinuousBatchingEngine(
+                    f_model, decode_block=8, megakernel=mode,
+                    speculate=spec or None, drafter="ngram", tp=tp_n,
+                    **fused_kw)
+                outs, pay = _fused_run(
+                    eng, {"megakernel": eng.health()["megakernel"],
+                          "whole_step":
+                              eng.health()["megakernel_whole_step"],
+                          "speculate": spec, "tp": tp_n,
+                          "probe": "plain-step" if spec else "own"},
+                    t_probe=probes[(mode, tp_n)])
+                if spec:
+                    h = eng.health()
+                    _emit({"metric": "cb_mk_compose_spec",
+                           "megakernel": mode, "tp": tp_n,
+                           "speculate": spec,
+                           "value": round(h["spec_tokens_per_pass"], 3),
+                           "spec_accept_rate": round(
+                               h["spec_accept_rate"], 3),
+                           "unit": "tokens/pass"})
+                for i, (a, b) in enumerate(zip(mk_ref, outs)):
+                    assert a.shape == b.shape and (a == b).all(), (
+                        f"megakernel={mode} speculate={spec} tp={tp_n} "
+                        f"diverged from the op-chain baseline at "
+                        f"request {i} — greedy outputs must be "
+                        "byte-identical")
+    except Exception as e:  # noqa: BLE001 — bench must stay rc=0
+        _emit({"metric": "cb_mk_compose", "value": 0.0,
+               "unit": "tokens/s", "error": f"{type(e).__name__}: {e}"})
 
     # -- speculative decoding: draft -> one-pass ragged verification -----
     # The repetitive-suffix workload (templated/looping traffic — the

@@ -136,9 +136,13 @@ def main():
                          "router mode)")
     ap.add_argument("--megakernel", choices=["auto", "off", "layer",
                                              "multi"], default="auto",
-                    help="decode-layer Pallas megakernel: one fused "
-                         "kernel per layer (or per stack, 'multi') "
-                         "streams int8/dense weights through VMEM — "
+                    help="decode megakernel: one fused Pallas kernel "
+                         "per layer ('layer') or the WHOLE decode step "
+                         "('multi': every layer + final norm + lm_head "
+                         "+ greedy argmax in one invocation) streams "
+                         "int8/dense weights through VMEM — composes "
+                         "with --speculate (the tq>1 verify schedule) "
+                         "and --tp (per-shard segments, exact mode). "
                          "auto turns it on only on a real TPU with a "
                          "lane-aligned geometry; forcing it on CPU runs "
                          "interpret mode (parity, not speed; scheduler "
@@ -310,14 +314,11 @@ def main():
             decode_block=args.decode_block,
             speculate=args.speculate or None,
             drafter=args.drafter,
-            # speculation downgrades only the "auto" default; an
-            # EXPLICIT --megakernel layer/multi with --speculate lets
-            # the engine raise its typed conflict error rather than
-            # silently benchmarking the op-chain path
-            megakernel=(False if ((args.speculate >= 2 or args.tp > 1)
-                                  and args.megakernel == "auto") else
-                        {"auto": None, "off": False}.get(args.megakernel,
-                                                         args.megakernel)),
+            # --megakernel composes with --speculate and --tp now
+            # (PR 12): no downgrade, no conflict gate — the engine runs
+            # the tq>1 verify schedule / per-shard segments itself
+            megakernel={"auto": None, "off": False}.get(args.megakernel,
+                                                        args.megakernel),
             **tp_kw, **tier_kw)
         rng = np.random.RandomState(0)
         # ragged prompts; 1 shares 0's prefix (once 0 finishes prefill,

@@ -686,17 +686,30 @@ class EngineRouter:
         self._by_name[name].state = ACTIVE
 
     # -- routing -----------------------------------------------------------
-    def _routable(self, exclude=()):
+    # TIER-AWARE routing (ROADMAP item 2 follow-up): an admission whose
+    # KV page need reaches this floor counts as a "long conversation"
+    # and weighs each replica's `pages_demoted` (device pages parked in
+    # its KV tier) against its raw free pages — a replica that freed
+    # pages by demoting running requests is NOT really that free:
+    # seating a long request there deepens the oversubscription spiral
+    # (its parked conversations restore, demote the newcomer, repeat).
+    # Short requests keep the plain health order (they fit in the churn).
+    tier_aware_pages = 4
+
+    def _routable(self, exclude=(), page_need=None):
         """Replicas that may take NEW work, healthiest first: fewest
-        queued, most free slots, most free pages; half-open breakers
-        rank after closed ones (trial traffic only when the healthy
-        fleet is full); a rotating tie-break spreads exact ties instead
-        of piling them on r0. `exclude`d replicas are skipped ENTIRELY
-        — no heartbeat, no headroom read — so salvaging a dying replica
-        never re-heartbeats it and double-charges its breaker for one
-        logical failure."""
+        queued, most free slots, most free pages (discounted by tier
+        pressure for long conversations — see tier_aware_pages);
+        half-open breakers rank after closed ones (trial traffic only
+        when the healthy fleet is full); a rotating tie-break spreads
+        exact ties instead of piling them on r0. `exclude`d replicas
+        are skipped ENTIRELY — no heartbeat, no headroom read — so
+        salvaging a dying replica never re-heartbeats it and
+        double-charges its breaker for one logical failure."""
         cand = []
         n = len(self._replicas)
+        long_conv = (page_need is not None
+                     and page_need >= self.tier_aware_pages)
         for i, rep in enumerate(self._replicas):
             if rep.name in exclude or rep.state != ACTIVE or \
                     rep.breaker.state == "open":
@@ -707,12 +720,31 @@ class EngineRouter:
             except Exception as e:
                 self._on_replica_failure(rep, e)
                 continue
+            free = h["pages_free"]
+            if long_conv:
+                free -= h.get("pages_demoted", 0)
             cand.append((rep.breaker.state == "half_open", h["queued"],
-                         h["running"] - h["slots_total"], -h["pages_free"],
+                         h["running"] - h["slots_total"], -free,
                          (i - self._rr) % n, rep))
         cand.sort(key=lambda t: t[:5])
         self._rr += 1
         return [t[-1] for t in cand]
+
+    def _page_need(self, spec):
+        """KV pages the spec's admission would claim (the engines'
+        _pages_needed rule) — the tier-aware routing weight. None when
+        it cannot be derived (no replicas / malformed spec): routing
+        falls back to the plain health order."""
+        try:
+            prompt = spec.get("prompt")
+            if prompt is None or not self._replicas:
+                return None
+            p = int(self._replicas[0].page_size())
+            t0 = int(np.asarray(prompt).size)
+            mnt = int(spec.get("max_new_tokens") or 0)
+            return -(-max(t0, t0 + mnt - 1) // p)
+        except Exception:
+            return None
 
     def _route(self, rr, spec, exclude=(), internal=False):
         """Place a request (fresh or re-queued) on the best replica; if
@@ -726,7 +758,7 @@ class EngineRouter:
         replica can EVER take fails at the router instead of aborting
         the salvage loop that is resolving its replica's death."""
         last_busy = None
-        reps = self._routable(exclude)
+        reps = self._routable(exclude, page_need=self._page_need(spec))
         if self._topology is not None:
             # disaggregated mode: every fresh admission (and every
             # spec-requeue — a salvaged request re-prefills anyway)

@@ -420,16 +420,21 @@ class ContinuousBatchingEngine(LLMEngine):
       ragged_kernel: force (True/False) the Pallas ragged-prefill
         kernel; default None = kernel on TPU, dense gathered math under
         interpret/CPU.
-      megakernel: decode-layer megakernel knob (ops/pallas/
+      megakernel: decode megakernel knob (ops/pallas/
         decode_megakernel). None (default) = auto: the per-layer
-        megakernel on TPU when the geometry supports it, the existing
-        fused op-chain under interpret/CPU; True/"layer" forces the
-        per-layer megakernel (interpret mode on CPU — the parity
-        fallback, byte-identical greedy to the op-chain path); "multi"
-        scans ALL layers inside one kernel invocation (weights stream
-        across layer boundaries; the KV pools are stored NATIVELY
-        stacked [L, ...], so no per-step restack — see docs/serving.md
-        "Megakernel decode"); False forces off.
+        megakernel on TPU when the (per-shard) geometry supports it,
+        the existing fused op-chain under interpret/CPU; True/"layer"
+        forces the per-layer megakernel (interpret mode on CPU — the
+        parity fallback, byte-identical greedy to the op-chain path);
+        "multi" is WHOLE-STEP mode: one invocation runs ALL layers
+        plus the final norm, the vocab-tiled lm_head and an on-kernel
+        greedy argmax (weights — lm_head included — stream across
+        phase boundaries; KV pools stored NATIVELY stacked [L, ...]);
+        False forces off. Composes with speculate= (the verify pass
+        rides the kernel's tq>1 schedule) and with tp>1 under
+        tp_mode="exact" (per-shard segments, vocab-parallel head,
+        psum-free greedy select) — see docs/serving.md "Megakernel
+        decode" for the composition matrix.
       speculate: T >= 2 turns on SPECULATIVE DECODING — each decode scan
         step becomes a verify pass over T feed tokens (pending token +
         up to T-1 drafts) scored in ONE multi-token-q ragged-paged-
@@ -519,16 +524,10 @@ class ContinuousBatchingEngine(LLMEngine):
             if self._spec > max_len:
                 raise ValueError(
                     f"speculate={self._spec} exceeds max_len={max_len}")
-            # the decode megakernel is single-token-q; the verify pass
-            # runs the op-chain + ragged-kernel path instead (a multi-
-            # token megakernel geometry is the named follow-up)
-            if megakernel not in (None, False):
-                raise ValueError(
-                    "speculate= is not supported with megakernel= "
-                    "forced on: the decode megakernel is single-token-q "
-                    "(verify runs the multi-token-q ragged kernel); "
-                    "leave megakernel=None/False")
-            megakernel = False
+            # (the PR 6 "megakernel is single-token-q" gate is GONE:
+            # the verify pass rides the megakernel's tq>1 schedule —
+            # see _cb_spec_verify_math_mk; byte-identity pinned in
+            # tests/test_megakernel_v2.py)
         self.spec_adaptive = bool(spec_adaptive)
         # decode_block=K > 1: device-resident multi-step decode — ONE
         # compiled dispatch runs a ragged-prefill phase plus K decode
@@ -547,27 +546,15 @@ class ContinuousBatchingEngine(LLMEngine):
         # repacked ONCE here into the streamed layout (views/cheap
         # reshapes for aligned geometries; "multi" additionally stacks
         # them [L, ...] so one invocation streams every layer).
-        if self.tp > 1:
-            # the megakernel consumes a host-repacked full-geometry
-            # weight schedule; a per-shard repack (local heads/ffn
-            # tiles) is the named follow-up — until then TP decode runs
-            # the op-chain + paged-attention kernel per shard
-            if megakernel not in (None, False):
-                raise ValueError(
-                    "megakernel= is not supported with tp > 1 yet: the "
-                    "packed weight schedule is full-geometry (per-shard "
-                    "repack is the named follow-up); leave "
-                    "megakernel=None/False")
-            megakernel = False
+        # megakernel + tp > 1 composes via per-shard SEGMENTS (PR 12):
+        # column-parallel q/k/v/gate/up packed per shard, local-head
+        # attention, the exact-mode gathers running BETWEEN kernel
+        # invocations — see _mk_walk and decode_megakernel seg=.
         self.megakernel = self._resolve_megakernel(megakernel)
+        self._mk_head = False           # whole-step mode: final norm +
+        self._mk_vl = 0                 # lm_head + argmax in-kernel
         if self.megakernel:
-            from ..ops.pallas.decode_megakernel import (pack_decode_layer,
-                                                        stack_packed)
-            packed = [pack_decode_layer(ws, cdtype=self.kv_dtype)
-                      for ws in self.weights["layers"]]
-            self.weights["mk"] = (stack_packed(packed)
-                                  if self.megakernel == "multi"
-                                  else packed)
+            self._build_mk_pack()
         if self.megakernel == "multi":
             # NATIVE stacked KV pools: "multi" consumes the whole [L,...]
             # stack every step, so store it stacked — the per-scan-step
@@ -576,6 +563,9 @@ class ContinuousBatchingEngine(LLMEngine):
             # handles both forms (list per layer / one stacked array)
             self.k_pages = jnp.stack(self.k_pages)
             self.v_pages = jnp.stack(self.v_pages)
+            if self._tpc is not None:
+                self.k_pages = self._tpc.place_pools(self.k_pages)
+                self.v_pages = self._tpc.place_pools(self.v_pages)
         if slot_buckets is None:
             slot_buckets = []
             w = 1
@@ -944,8 +934,11 @@ class ContinuousBatchingEngine(LLMEngine):
             "fused_blocks": self.fused_blocks,
             "chained_blocks": self.chained_blocks,
             # active decode-kernel mode: "off" = per-op XLA chain,
-            # "layer"/"multi" = the Pallas decode megakernel
+            # "layer"/"multi" = the Pallas decode megakernel;
+            # whole_step = the "multi" head fold (final norm + lm_head
+            # + greedy argmax inside the same invocation)
             "megakernel": self.megakernel if self.megakernel else "off",
+            "megakernel_whole_step": self._mk_head,
             # tensor parallelism (inference/tp.py): shard count, tail
             # mode, and whether the per-token reduce rides int8
             "tp": self.tp,
@@ -1020,6 +1013,11 @@ class ContinuousBatchingEngine(LLMEngine):
             if isinstance(self.k_pages, list):
                 self.k_pages = jnp.stack(self.k_pages)
                 self.v_pages = jnp.stack(self.v_pages)
+                if self._tpc is not None:
+                    # restacked host-side: re-place so the next sharded
+                    # dispatch is zero-copy instead of resharding
+                    self.k_pages = self._tpc.place_pools(self.k_pages)
+                    self.v_pages = self._tpc.place_pools(self.v_pages)
 
     def generate_many(self, prompts, max_new_tokens=32, eos_token_id=None):
         """Submit a list of (ragged) prompts and drain. Returns a list of
@@ -1317,7 +1315,7 @@ class ContinuousBatchingEngine(LLMEngine):
             h = _rms(h, W["norm"], W["eps"])
             last = jnp.clip(t_end - 1 - t_start, 0, chunk - 1)
             h_last = jax.lax.dynamic_index_in_dim(h, last, axis=1)
-            logits = _mm(h_last, W["head"], self.interpret)
+            logits = self._lm_head(W, h_last)
             return (logits[:, 0], _pools_result(k_pages_all, new_k),
                     _pools_result(v_pages_all, new_v))
 
@@ -1416,11 +1414,24 @@ class ContinuousBatchingEngine(LLMEngine):
         runs it in interpret mode — the parity fallback the tests pin
         against the op-chain path."""
         from ..ops.pallas.decode_megakernel import megakernel_supported
-        ok = megakernel_supported(self.nh, self.nh_kv, self.hd,
-                                  self.cfg.hidden_size,
-                                  self.cfg.intermediate_size)
+        # under tp the kernel runs per shard on LOCAL head/ffn slices —
+        # those are the dims Mosaic has to reslice cleanly
+        ffn = self.cfg.intermediate_size
+        ffn_l = ffn // self.tp if ffn % self.tp == 0 else ffn
+        ok = megakernel_supported(self.nh_l, self.nh_kv_l, self.hd,
+                                  self.cfg.hidden_size, ffn_l)
         if val is None:
-            return "layer" if (ok and not self.interpret) else False
+            if not ok or self.interpret:
+                return False
+            if self.tp > 1 and (self.tp_mode != "exact"
+                                or self.cfg.intermediate_size % self.tp):
+                # auto must never FORCE a tp-incomposable config into
+                # the typed _build_mk_pack rejection — psum-mode or an
+                # awkward ffn silently keeps the op-chain path, exactly
+                # as these configs ran before the megakernel composed
+                # with tp at all; forcing "layer"/"multi" still raises
+                return False
+            return "layer"
         if val is False:
             return False
         if val in (True, "layer"):
@@ -1445,73 +1456,218 @@ class ContinuousBatchingEngine(LLMEngine):
                 "geometry")
         return mode
 
+    def _build_mk_pack(self):
+        """Repack the weight snapshot into the megakernel's streamed
+        layout (once at build / weight flip; ~zero-copy for aligned
+        geometries). tp > 1 packs the column-parallel projections per
+        shard (q/k/v/gate/up + the vocab-parallel lm_head) and keeps
+        the exact-mode row pair (o/down) full-replicated — the same
+        weight placement the op-chain tp engine uses, so byte-identity
+        with tp=1 survives. megakernel="multi" additionally builds the
+        WHOLE-STEP head pack (final norm + lm_head + greedy argmax in
+        the same schedule)."""
+        from ..ops.pallas.decode_megakernel import (pack_decode_layer,
+                                                    pack_lm_head,
+                                                    stack_packed)
+        W = self.weights
+        if self.tp > 1:
+            if self.tp_mode != "exact":
+                raise ValueError(
+                    "megakernel with tp > 1 requires tp_mode='exact': "
+                    "the psum tail's row-parallel reduce cannot ride "
+                    "the packed schedule bit-exactly — the exact mode's "
+                    "gathers run BETWEEN kernel segments instead")
+            if self.cfg.intermediate_size % self.tp:
+                raise ValueError(
+                    f"megakernel with tp={self.tp} needs the ffn dim "
+                    f"({self.cfg.intermediate_size}) divisible by tp "
+                    "(column-parallel gate/up shard per-shard tile "
+                    "grids)")
+        packed = [pack_decode_layer(ws, cdtype=self.kv_dtype, tp=self.tp)
+                  for ws in W["layers"]]
+        mk = (stack_packed(packed) if self.megakernel == "multi"
+              else packed)
+        head_w = (W["head"][0] if isinstance(W["head"], tuple)
+                  else W["head"])
+        vocab = head_w.shape[1]
+        # whole-step head fold: "multi" mode only (per-layer mode keeps
+        # the op-chain norm/head — that spread IS the whole-step vs
+        # per-layer host_overhead_frac comparison decode_bench pins);
+        # an awkward vocab under tp falls back to the op-chain head
+        self._mk_head = (self.megakernel == "multi"
+                         and (self.tp == 1 or vocab % self.tp == 0))
+        self._mk_vl = vocab // self.tp if vocab % self.tp == 0 else vocab
+        mk_head = (pack_lm_head(W["head"], W["norm"],
+                                cdtype=self.kv_dtype, tp=self.tp)
+                   if self._mk_head else None)
+        if self._tpc is not None:
+            specs = self._tpc.mk_spec_tree(mk)
+            W["mk"] = self._tpc.place(mk, specs)
+            self._w_specs["mk"] = specs
+            if mk_head is not None:
+                hspecs = self._tpc.mk_spec_tree(mk_head)
+                W["mk_head"] = self._tpc.place(mk_head, hspecs)
+                self._w_specs["mk_head"] = hspecs
+        else:
+            W["mk"] = mk
+            if mk_head is not None:
+                W["mk_head"] = mk_head
+
+    def _mk_walk(self, W, h, k_pages_all, v_pages_all, tables, lens,
+                 act_i, cos_sel, sin_sel, tq=1, wmask=None):
+        """The megakernel layer walk shared by plain decode (tq=1) and
+        the speculative verify pass (tq=T): runs the whole stack as one
+        invocation ("multi", tp=1), per-layer invocations ("layer",
+        tp=1), or the per-shard qkv/tail/down SEGMENTS with exact-mode
+        gathers between them (tp>1). Returns (h, k_rows, v_rows, tok,
+        logits_local): tok/logits are None unless the whole-step head
+        fold ran (then tok is the combined GLOBAL greedy argmax and
+        logits_local this shard's vocab columns)."""
+        from ..ops.pallas.decode_megakernel import decode_megakernel
+        kw = dict(nh=self.nh_l, nh_kv=self.nh_kv_l, hd=self.hd,
+                  eps=self.cfg.rms_norm_eps, interpret=self.interpret)
+        head = W.get("mk_head") if self._mk_head else None
+        head_v = self._mk_vl
+        tok = maxv = logits = None
+        if self.tp == 1:
+            if self.megakernel == "multi":
+                out = decode_megakernel(
+                    h, W["mk"], k_pages_all, v_pages_all, tables, lens,
+                    act_i, cos_sel, sin_sel, tq=tq, wmask=wmask,
+                    head=head, head_v=head_v if head else None, **kw)
+                if head is not None:
+                    h, k_all, v_all, tok, maxv, logits = out
+                else:
+                    h, k_all, v_all = out
+            else:
+                k_all, v_all = [], []
+                for li, mset in enumerate(W["mk"]):
+                    h, kn, vn = decode_megakernel(
+                        h, mset, k_pages_all[li], v_pages_all[li],
+                        tables, lens, act_i, cos_sel, sin_sel, tq=tq,
+                        wmask=wmask, **kw)
+                    k_all.append(kn)
+                    v_all.append(vn)
+        else:
+            # per-shard segments: column-parallel QKV + local-head
+            # attention, gather heads, replicated O + column-parallel
+            # MLP front, gather columns, replicated down (+ the vocab-
+            # parallel head slice on the last layer in whole-step mode).
+            # The gathers are the SAME exact-mode reassembly the
+            # op-chain tp engine performs — pure data movement.
+            R = h.shape[0]
+            Fl = self.cfg.intermediate_size // self.tp
+            L = self.cfg.num_hidden_layers
+            mk = W["mk"]
+            stacked = not isinstance(mk, (list, tuple))
+            k_all, v_all = [], []
+            for li in range(L):
+                mset = ({k: v[li] for k, v in mk.items()} if stacked
+                        else mk[li])
+                attn_l, kn, vn = decode_megakernel(
+                    h, mset, k_pages_all[li], v_pages_all[li], tables,
+                    lens, act_i, cos_sel, sin_sel, seg="qkv", tq=tq,
+                    wmask=wmask, **kw)
+                k_all.append(kn)
+                v_all.append(vn)
+                attn_f = self._tpc.gather_heads(
+                    attn_l.reshape(R, self.nh_l, self.hd)).reshape(
+                    R, self.nh * self.hd)
+                h, act_l = decode_megakernel(
+                    h, mset, seg="tail", attn_in=attn_f, mlp_v=Fl, **kw)
+                act_f = self._tpc.gather_cols(act_l)
+                if li == L - 1 and head is not None:
+                    h, tok, maxv, logits = decode_megakernel(
+                        h, mset, seg="down", act_in=act_f, head=head,
+                        head_v=head_v, **kw)
+                else:
+                    h = decode_megakernel(h, mset, seg="down",
+                                          act_in=act_f, **kw)
+            if tok is not None:
+                # vocab-parallel whole-step select: combine the shards'
+                # (max, argmax) pairs psum-free — bitwise equal to
+                # argmax over the full gathered logits
+                tok = self._tpc.argmax_of_local_max(maxv, tok,
+                                                    self._mk_vl)
+        return h, k_all, v_all, tok, logits
+
+    def _mk_scatter(self, k_pages_all, v_pages_all, k_all, v_all,
+                    slots_raw, ok):
+        """Write the kernel-returned current-row k/v into the page
+        pools — the SAME bytes (same positions, same gating) the
+        op-chain path scatters. slots_raw: [rows] flat pool-row index
+        per feed row; ok: [rows] write gate (active slots at tq=1, the
+        verify write mask at tq>1). Handles all four pool/row forms:
+        per-layer lists, natively stacked pools, stacked kernel rows."""
+        p = self.page_size
+        shape = (self.nh_kv_l, self.hd)
+        npp = self.n_pages * p
+
+        def put(pool, rows, slots):
+            flat = pool.reshape(npp, *shape)
+            flat = flat.at[slots].set(
+                rows.reshape(-1, *shape).astype(self.kv_dtype),
+                mode="drop")
+            return flat.reshape(self.n_pages, p, *shape)
+
+        if isinstance(k_all, list):
+            slots = jnp.where(ok, slots_raw, jnp.int32(npp))
+            if isinstance(k_pages_all, (list, tuple)):
+                new_k = [put(k_pages_all[li], k_all[li], slots)
+                         for li in range(len(k_all))]
+                new_v = [put(v_pages_all[li], v_all[li], slots)
+                         for li in range(len(v_all))]
+                return new_k, new_v
+            for li in range(len(k_all)):    # stacked pools, listed rows
+                k_pages_all = k_pages_all.at[li].set(
+                    put(k_pages_all[li], k_all[li], slots))
+                v_pages_all = v_pages_all.at[li].set(
+                    put(v_pages_all[li], v_all[li], slots))
+            return k_pages_all, v_pages_all
+        # stacked rows [L, rows, NK] + stacked pools: ONE flat scatter
+        # with per-layer offsets (inactive/ungated rows drop GLOBALLY —
+        # layer li's oob must not alias layer li+1's page 0)
+        L = k_all.shape[0]
+        base = jnp.arange(L, dtype=jnp.int32)[:, None] * jnp.int32(npp)
+        gidx = jnp.where(ok[None, :], base + slots_raw[None, :],
+                         jnp.int32(L * npp))
+        rows = slots_raw.shape[0]
+
+        def put_all(pools, new_all):
+            flat = pools.reshape(L * npp, *shape)
+            flat = flat.at[gidx.reshape(-1)].set(
+                new_all.reshape(L * rows, *shape).astype(self.kv_dtype),
+                mode="drop")
+            return flat.reshape(L, self.n_pages, p, *shape)
+
+        return (put_all(k_pages_all, k_all), put_all(v_pages_all, v_all))
+
     def _cb_decode_math_mk(self, W, tok, k_pages_all, v_pages_all,
                            tables, lens, active, w):
         """Megakernel decode step: each layer (or, in "multi" mode, the
-        whole stack) runs as ONE Pallas invocation — matmuls, norms,
-        rope and paged attention fused, weights streamed through VMEM.
-        The kernel attends with the current token's k/v substituted
-        into its page block and returns them for the SAME scatter the
-        op-chain path performs, so the page pool contents stay
-        byte-identical between the two paths."""
-        from ..ops.pallas.decode_megakernel import decode_megakernel
+        whole stack PLUS the final norm, lm_head and greedy argmax)
+        runs as ONE Pallas invocation — matmuls, norms, rope and paged
+        attention fused, weights streamed through VMEM. The kernel
+        attends with the current token's k/v substituted into its page
+        block and returns them for the SAME scatter the op-chain path
+        performs, so the page pool contents stay byte-identical between
+        the two paths."""
         p = self.page_size
         h = jnp.take(W["emb"], tok, axis=0).astype(self.kv_dtype)  # [w, H]
         cos_sel = W["cos"][lens].astype(h.dtype)
         sin_sel = W["sin"][lens].astype(h.dtype)
-        oob = jnp.int32(self.n_pages * p)
         slots_raw = (tables[jnp.arange(w), lens // p] * p + lens % p)
-        slots = jnp.where(active, slots_raw, oob)
         act_i = active.astype(jnp.int32)
-        kw = dict(nh=self.nh, nh_kv=self.nh_kv, hd=self.hd,
-                  eps=self.cfg.rms_norm_eps, interpret=self.interpret)
-
-        def scatter(pool, new):
-            flat = pool.reshape(-1, self.nh_kv, self.hd)
-            flat = flat.at[slots].set(
-                new.reshape(w, self.nh_kv, self.hd).astype(self.kv_dtype),
-                mode="drop")
-            return flat.reshape(self.n_pages, p, self.nh_kv, self.hd)
-
-        if self.megakernel == "multi":
-            # one invocation for the whole stack: the weight stream
-            # pipelines across layer boundaries. The pools are stored
-            # NATIVELY stacked [L, ...] for this mode, so the kernel
-            # consumes them directly — the per-scan-step jnp.stack
-            # restack PR 6 documented (XLA traffic ~ pool size every
-            # step) is gone — and the returned per-layer k/v land in ONE
-            # flat scatter with per-layer offsets (same elements, same
-            # bytes as the per-layer scatters).
-            L = self.cfg.num_hidden_layers
-            npp = self.n_pages * p
-            h, k_all, v_all = decode_megakernel(
-                h, W["mk"], k_pages_all, v_pages_all,
-                tables, lens, act_i, cos_sel, sin_sel, **kw)
-            base = jnp.arange(L, dtype=jnp.int32)[:, None] * jnp.int32(npp)
-            gidx = jnp.where(active[None, :], base + slots_raw[None, :],
-                             jnp.int32(L * npp))      # global drop index
-            shape = (self.nh_kv, self.hd)
-
-            def scatter_all(pools, new_all):
-                flat = pools.reshape(L * npp, *shape)
-                flat = flat.at[gidx.reshape(-1)].set(
-                    new_all.reshape(L * w, *shape).astype(self.kv_dtype),
-                    mode="drop")
-                return flat.reshape(L, self.n_pages, p, *shape)
-
-            new_k = scatter_all(k_pages_all, k_all)
-            new_v = scatter_all(v_pages_all, v_all)
-        else:
-            new_k, new_v = [], []
-            for li, mset in enumerate(W["mk"]):
-                h, k_new, v_new = decode_megakernel(
-                    h, mset, k_pages_all[li], v_pages_all[li], tables,
-                    lens, act_i, cos_sel, sin_sel, **kw)
-                new_k.append(scatter(k_pages_all[li], k_new))
-                new_v.append(scatter(v_pages_all[li], v_new))
-        h = _rms(h[:, None], W["norm"], W["eps"])
-        logits = _mm(h, W["head"], self.interpret)
-        return logits[:, 0], new_k, new_v
+        h, k_all, v_all, tok_g, loc = self._mk_walk(
+            W, h, k_pages_all, v_pages_all, tables, lens, act_i,
+            cos_sel, sin_sel)
+        new_k, new_v = self._mk_scatter(k_pages_all, v_pages_all,
+                                        k_all, v_all, slots_raw, active)
+        if loc is None:
+            hN = _rms(h[:, None], W["norm"], W["eps"])
+            loc = _mm(hN, W["head"], self.interpret)[:, 0]
+            tok_g = self._tp_greedy_token(loc)
+        return self._gather_logits(loc), tok_g, new_k, new_v
 
     def _cb_decode_math(self, W, tok, k_pages_all, v_pages_all, tables,
                         lens, active, w):
@@ -1521,7 +1677,13 @@ class ContinuousBatchingEngine(LLMEngine):
         inactive slots write nothing (scatter-drop) and skip attention
         compute/DMA via the kernel's active mask. With megakernel= on,
         the per-layer op chain is replaced by the fused Pallas
-        megakernel (same math, same page writes)."""
+        megakernel (same math, same page writes).
+
+        Returns (logits, tok, new_k, new_v): logits the FULL-vocab row
+        (gathered under a vocab-parallel head — unused consumers are
+        DCE'd), tok the greedy argmax token (what the whole-step kernel
+        emits directly; computed psum-free under tp). Greedy callers
+        use tok, sampled callers logits — bitwise the same choice."""
         if self.megakernel:
             return self._cb_decode_math_mk(W, tok, k_pages_all,
                                            v_pages_all, tables, lens,
@@ -1553,8 +1715,9 @@ class ContinuousBatchingEngine(LLMEngine):
                 active=active.astype(jnp.int32))
             h = self._layer_tail(W, wset, h, attn[:, None])
         h = _rms(h, W["norm"], W["eps"])
-        logits = _mm(h, W["head"], self.interpret)
-        return logits[:, 0], new_k, new_v
+        loc = _mm(h, W["head"], self.interpret)[:, 0]
+        return (self._gather_logits(loc), self._tp_greedy_token(loc),
+                new_k, new_v)
 
     def _cb_spec_verify_math(self, W, feed, k_pages_all, v_pages_all,
                              tables, lens, active, rem, dlen, w):
@@ -1575,7 +1738,16 @@ class ContinuousBatchingEngine(LLMEngine):
         so the next pass (or the next plain step) overwrites it and no
         attention ever reads it — no scrub, no extra pass.
 
-        feed: [w, T] int; returns (logits [w, T, V], new_k, new_v)."""
+        feed: [w, T] int; returns (logits [w, T, V], g_tok [w, T]
+        greedy argmax rows, new_k, new_v) — the same contract as
+        _cb_decode_math, per feed position. With megakernel= on, the
+        verify pass rides the kernel's tq>1 schedule instead
+        (_cb_spec_verify_math_mk): same substituted block contents,
+        same ragged causal mask, same pool bytes."""
+        if self.megakernel:
+            return self._cb_spec_verify_math_mk(
+                W, feed, k_pages_all, v_pages_all, tables, lens, active,
+                rem, dlen, w)
         p = self.page_size
         T = feed.shape[1]
         h = jnp.take(W["emb"], feed, axis=0).astype(self.kv_dtype)
@@ -1610,13 +1782,57 @@ class ContinuousBatchingEngine(LLMEngine):
                 interpret=self.interpret)
             h = self._layer_tail(W, wset, h, attn)
         h = _rms(h, W["norm"], W["eps"])
-        logits = _mm(h, W["head"], self.interpret)
-        return logits, new_k, new_v
+        loc = _mm(h, W["head"], self.interpret)
+        return (self._gather_logits(loc), self._tp_greedy_token(loc),
+                new_k, new_v)
+
+    def _cb_spec_verify_math_mk(self, W, feed, k_pages_all, v_pages_all,
+                                tables, lens, active, rem, dlen, w):
+        """The verify pass on the MEGAKERNEL's tq>1 schedule: feed rows
+        flatten slot-major into the matmul phases, the ATTN phase runs
+        the ragged kernel's causal mask with every WRITE-GATED feed
+        token's k/v substituted into its page block, and in whole-step
+        mode the final norm + lm_head + per-position greedy argmax ride
+        the same invocation. The engine then performs the identical
+        write-gated scatter, so pool bytes — including rejected drafts'
+        rows — match the op-chain path bit-for-bit."""
+        p = self.page_size
+        T = feed.shape[1]
+        R = w * T
+        h = jnp.take(W["emb"], feed.reshape(-1), axis=0).astype(
+            self.kv_dtype)                                     # [R, H]
+        j = jnp.arange(T, dtype=jnp.int32)[None, :]
+        pos = lens[:, None] + j
+        pos_c = jnp.minimum(pos, jnp.int32(self.max_len - 1))
+        cap = jnp.minimum(jnp.int32(T), rem)[:, None]
+        write_ok = jnp.logical_and(
+            active[:, None],
+            jnp.logical_and(j < cap, j <= dlen[:, None]))
+        cos_sel = W["cos"][pos_c.reshape(-1)].astype(h.dtype)
+        sin_sel = W["sin"][pos_c.reshape(-1)].astype(h.dtype)
+        wm = write_ok.reshape(R).astype(jnp.int32)
+        h, k_all, v_all, tok_g, loc = self._mk_walk(
+            W, h, k_pages_all, v_pages_all, tables, lens,
+            active.astype(jnp.int32), cos_sel, sin_sel, tq=T, wmask=wm)
+        slots_raw = (tables[jnp.arange(w)[:, None], pos_c // p] * p
+                     + pos_c % p).reshape(R)
+        new_k, new_v = self._mk_scatter(k_pages_all, v_pages_all,
+                                        k_all, v_all, slots_raw,
+                                        write_ok.reshape(R))
+        if loc is None:
+            hN = _rms(h[:, None], W["norm"], W["eps"])
+            loc = _mm(hN, W["head"], self.interpret)[:, 0]
+            tok_g = self._tp_greedy_token(loc)
+        logits = self._gather_logits(loc)
+        return (logits.reshape(w, T, -1), tok_g.reshape(w, T),
+                new_k, new_v)
 
     def _build_cb_step(self, w):
         def step(W, tok, k_pages_all, v_pages_all, tables, lens, active):
-            return self._cb_decode_math(W, tok, k_pages_all, v_pages_all,
-                                        tables, lens, active, w)
+            logits, _tok, kps, vps = self._cb_decode_math(
+                W, tok, k_pages_all, v_pages_all, tables, lens, active,
+                w)
+            return logits, kps, vps
 
         Wsp, R, POOL = self._tp_specs()
         return self._jit_tp(step,
@@ -1751,7 +1967,7 @@ class ContinuousBatchingEngine(LLMEngine):
             h = _rms(h, W["norm"], W["eps"])
             last = jnp.clip(ends - 1 - starts, 0, chunk - 1)
             h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)
-            logits = _mm(h_last, W["head"], self.interpret)
+            logits = self._lm_head(W, h_last)
             return (logits[:, 0], _pools_result(k_pages_all, new_k),
                     _pools_result(v_pages_all, new_v))
 
@@ -1759,11 +1975,19 @@ class ContinuousBatchingEngine(LLMEngine):
                         act, rem, eos_ids, key):
             def body(carry, _):
                 tok, lens, act, rem, key, kps, vps = carry
-                logits, kps, vps = self._cb_decode_math(
+                logits, gtok, kps, vps = self._cb_decode_math(
                     W, tok, kps, vps, tables, lens, act, w)
                 key, sub = jax.random.split(key)
-                nxt = _sample(logits, sub, do_sample, temperature,
-                              top_k, top_p)
+                if do_sample:
+                    nxt = _sample(logits, sub, True, temperature,
+                                  top_k, top_p)
+                else:
+                    # the greedy token came out of the decode math
+                    # itself (whole-step mode: the kernel's running
+                    # argmax; tp: argmax-of-local-max) — bitwise equal
+                    # to argmax over the gathered logits, which DCE
+                    # then prunes from the compiled scan
+                    nxt = gtok
                 nxt = jnp.where(act, nxt.astype(tok.dtype), tok)
                 emit = act
                 rem = jnp.where(act, rem - 1, rem)
@@ -1805,12 +2029,15 @@ class ContinuousBatchingEngine(LLMEngine):
                 drafts_s, dlen_s = xs
                 tok, lens, act, rem, key, kps, vps = carry
                 feed = jnp.concatenate([tok[:, None], drafts_s], axis=1)
-                logits, kps, vps = self._cb_spec_verify_math(
+                logits, gtok, kps, vps = self._cb_spec_verify_math(
                     W, feed, kps, vps, tables, lens, act, rem, dlen_s, w)
                 key, sub = jax.random.split(key)
-                g = _sample(logits.reshape(w * T, -1), sub, do_sample,
-                            temperature, top_k, top_p)
-                g = g.reshape(w, T).astype(tok.dtype)
+                if do_sample:
+                    g = _sample(logits.reshape(w * T, -1), sub, True,
+                                temperature, top_k, top_p)
+                    g = g.reshape(w, T).astype(tok.dtype)
+                else:
+                    g = gtok.astype(tok.dtype)
                 # accepted prefix: draft i matches the target's token at
                 # its position AND every earlier draft matched (greedy =
                 # deterministic argmax agreement; sampled = the q=delta
@@ -2949,13 +3176,7 @@ class ContinuousBatchingEngine(LLMEngine):
         if self._prefix is not None:
             self._prefix.clear(self.allocator)
         if self.megakernel:
-            from ..ops.pallas.decode_megakernel import (pack_decode_layer,
-                                                        stack_packed)
-            packed = [pack_decode_layer(ws, cdtype=self.kv_dtype)
-                      for ws in self.weights["layers"]]
-            self.weights["mk"] = (stack_packed(packed)
-                                  if self.megakernel == "multi"
-                                  else packed)
+            self._build_mk_pack()
         return self
 
     # -- retirement / failure ----------------------------------------------
@@ -3061,6 +3282,10 @@ class ContinuousBatchingEngine(LLMEngine):
             prefix.clear()                   # allocator is reset below
         super()._reset_kv()
         if getattr(self, "megakernel", None) == "multi":
-            # restore the native stacked [L, ...] pool form
+            # restore the native stacked [L, ...] pool form (re-placed
+            # on the mesh so the next sharded dispatch is zero-copy)
             self.k_pages = jnp.stack(self.k_pages)
             self.v_pages = jnp.stack(self.v_pages)
+            if self._tpc is not None:
+                self.k_pages = self._tpc.place_pools(self.k_pages)
+                self.v_pages = self._tpc.place_pools(self.v_pages)

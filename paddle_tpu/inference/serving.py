@@ -408,11 +408,45 @@ class LLMEngine:
                        donate_argnums=donate_argnums)
 
     def _tp_specs(self):
-        """(weight_spec, replicated, pool_spec) shorthand for builders.
+        """(weight_spec, replicated, pool_spec) shorthand for builders —
+        pool spec tracks the CURRENT pool form (per-layer list, or the
+        natively stacked [L, ...] array of megakernel="multi").
         Meaningless (unused) at tp=1."""
-        from .tp import POOL, REPL
+        from .tp import POOL, REPL, STACKED_POOL
+        stacked = not isinstance(self.k_pages, (list, tuple))
         return (self._w_specs if self._tpc is not None else None,
-                REPL, POOL)
+                REPL, STACKED_POOL if stacked else POOL)
+
+    def _lm_head(self, W, h):
+        """Final logits: h @ lm_head. Under tensor parallelism with a
+        vocab-parallel head (inference/tp.py weight_specs) the local
+        matmul covers this shard's vocab columns and the FULL row
+        reassembles by an exact tiled gather — pure data movement, so
+        the result is byte-identical to the replicated head. Callers on
+        the greedy hot path should prefer _tp_greedy_token, which skips
+        the gather entirely (argmax-of-local-max)."""
+        return self._gather_logits(_mm(h, W["head"], self.interpret))
+
+    def _gather_logits(self, local_logits):
+        """Reassemble full-vocab logits from the vocab-parallel head's
+        local columns (exact tiled gather; identity at tp=1 or with a
+        replicated head). Callers that only argmax should skip this —
+        XLA dead-code-eliminates the gather when the result is unused."""
+        if self._tpc is not None and self._tpc.head_sharded:
+            return self._tpc.gather_cols(local_logits)
+        return local_logits
+
+    def _tp_greedy_token(self, local_logits):
+        """Greedy next token from (possibly vocab-local) logits rows:
+        plain argmax at tp=1 / replicated head; under the vocab-
+        parallel head, the psum-free argmax-of-local-max combine —
+        bitwise equal to argmax over the full gathered logits."""
+        if self._tpc is None or not self._tpc.head_sharded:
+            return jnp.argmax(local_logits, axis=-1).astype(jnp.int32)
+        m = jnp.max(local_logits, axis=-1)
+        a = jnp.argmax(local_logits, axis=-1).astype(jnp.int32)
+        return self._tpc.argmax_of_local_max(
+            m, a, local_logits.shape[-1])
 
     def _tp_gather_heads(self, x):
         """exact-mode TP: reassemble full heads before o_proj (identity
@@ -550,7 +584,7 @@ class LLMEngine:
                                         self.nh_kv_l, self.hd))
             h = _rms(h, W["norm"], W["eps"])
             h_last = jax.lax.dynamic_index_in_dim(h, t0 - 1, axis=1)
-            logits = _mm(h_last, W["head"], self.interpret)
+            logits = self._lm_head(W, h_last)
             return logits[:, 0], new_k, new_v
 
         W, R, POOL = self._tp_specs()
@@ -587,7 +621,7 @@ class LLMEngine:
                                    interpret=self.interpret)
             h = self._layer_tail(W, wset, h, attn[:, None])
         h = _rms(h, W["norm"], W["eps"])
-        logits = _mm(h, W["head"], self.interpret)
+        logits = self._lm_head(W, h)
         return logits[:, 0], new_k, new_v
 
     def _build_step(self):

@@ -15,7 +15,12 @@ Two drafters cost NO extra model:
     of the request's context (prompt + generated so far) against its own
     earlier tokens and propose the continuation that followed the most
     recent occurrence. Repetitive suffixes (templated prompts, greedy
-    cycles, quoted spans) draft near-perfectly.
+    cycles, quoted spans) draft near-perfectly. `max_ctx` caps the
+    scanned window: the per-propose cost is O(window * n) on the HOST,
+    between device dispatches — with the whole-step megakernel (PR 12)
+    collapsing the device side of a verify pass to one invocation, an
+    unbounded host scan over a long conversation would become the
+    block's critical path.
   - `PrefixCacheDrafter` — seed drafts from the engine's content-
     addressed `PrefixCache`: other requests' cached prompt chains are
     observed continuations of this request's context, so a request whose
@@ -67,15 +72,21 @@ class NGramDrafter(Drafter):
 
     name = "ngram"
 
-    def __init__(self, n=3, min_n=1):
+    def __init__(self, n=3, min_n=1, max_ctx=4096):
         if n < min_n or min_n < 1:
             raise ValueError(f"need n >= min_n >= 1, got n={n} "
                              f"min_n={min_n}")
         self.n = int(n)
         self.min_n = int(min_n)
+        # scan window cap (None = unbounded): proposals come from the
+        # trailing max_ctx tokens only, bounding the host-side sliding-
+        # window compare for long conversations (module docstring)
+        self.max_ctx = None if max_ctx is None else int(max_ctx)
 
     def propose(self, ctx, k):
         ctx = np.asarray(ctx)
+        if self.max_ctx is not None and ctx.size > self.max_ctx:
+            ctx = ctx[-self.max_ctx:]
         out = np.empty((0,), np.int64)
         if k <= 0:
             return out

@@ -60,6 +60,9 @@ from ..jax_compat import shard_map
 AXIS = "mp"                    # the serving model-parallel mesh axis
 REPL = P()                     # replicated spec (tables, lens, tokens…)
 POOL = P(None, None, AXIS, None)   # [n_pages, page, heads, hd] pools
+# natively stacked pools (megakernel="multi"): [L, n_pages, page, heads,
+# hd] — heads still the sharded axis
+STACKED_POOL = P(None, None, None, AXIS, None)
 
 
 class TPContext:
@@ -98,6 +101,10 @@ class TPContext:
         self.mode = mode
         self.compress = compress
         self.mesh = Mesh(np.array(devs[:tp]), (AXIS,))
+        # vocab-parallel lm_head: set by weight_specs when the vocab
+        # divides evenly — the head columns shard over "mp" and logits
+        # reassemble (exact) or reduce to an argmax gather-free
+        self.head_sharded = False
 
     # -- spec construction --------------------------------------------------
     def _col(self, w):
@@ -126,11 +133,22 @@ class TPContext:
                   for ws in weights["layers"]]
         spec = {k: P() for k in weights if k not in ("layers", "head")}
         spec["layers"] = layers
-        # lm_head stays replicated in both modes: sampling needs the
-        # full vocab row anyway, and a vocab-parallel head (+gather) is
-        # a follow-up orthogonal to the decode sharding
-        spec["head"] = (P(), P()) if isinstance(weights["head"], tuple) \
-            else P()
+        # VOCAB-PARALLEL lm_head (both modes): the head is column-
+        # parallel over the vocab whenever tp divides it — each shard
+        # streams 1/tp of the largest single weight on the decode path.
+        # Greedy select runs argmax-of-local-max (an all_gather of two
+        # [b] rows, psum-free); full logits, where a caller needs them,
+        # reassemble by an exact tiled gather — pure data movement, so
+        # byte-identity with the replicated head survives. An awkward
+        # vocab keeps the replicated fallback.
+        head = weights["head"]
+        vocab = (head[0] if isinstance(head, tuple) else head).shape[1]
+        self.head_sharded = vocab % self.tp == 0
+        if self.head_sharded:
+            spec["head"] = (P(None, AXIS), P(AXIS)) \
+                if isinstance(head, tuple) else P(None, AXIS)
+        else:
+            spec["head"] = (P(), P()) if isinstance(head, tuple) else P()
         return spec
 
     # -- placement ----------------------------------------------------------
@@ -145,6 +163,11 @@ class TPContext:
         return jax.tree_util.tree_map(put, tree, specs)
 
     def place_pools(self, pools):
+        """Per-layer pool list, or the natively stacked [L, ...] array
+        of megakernel="multi" — heads are the sharded axis either way."""
+        if not isinstance(pools, (list, tuple)):
+            return jax.device_put(pools,
+                                  NamedSharding(self.mesh, STACKED_POOL))
         return [jax.device_put(p, NamedSharding(self.mesh, POOL))
                 for p in pools]
 
@@ -153,7 +176,43 @@ class TPContext:
         return shard_map(fn, mesh=self.mesh, in_specs=in_specs,
                          out_specs=out_specs, check_vma=False)
 
+    # -- megakernel pack specs -----------------------------------------------
+    _MK_COL = frozenset(("wq", "sq", "wk", "sk", "wv", "sv",
+                         "wg", "sg", "wu", "su", "wh", "sh"))
+
+    def mk_spec_tree(self, packed):
+        """PartitionSpec tree mirroring a pack_decode_layer(tp=...) /
+        pack_lm_head(tp=...) dict (per-layer list or stacked): column-
+        parallel values + their per-channel scales shard their LAST
+        axis (the per-shard-concatenated pack hands each shard its own
+        padded tile grid); the replicated row pair (o/down), norms and
+        the final-norm row stay P()."""
+        def spec(key, arr):
+            if key in self._MK_COL:
+                return P(*([None] * (arr.ndim - 1) + [AXIS]))
+            return P()
+
+        if isinstance(packed, list):
+            return [{k: spec(k, v) for k, v in lay.items()}
+                    for lay in packed]
+        return {k: spec(k, v) for k, v in packed.items()}
+
     # -- in-trace collectives (called from the engine's layer math) ---------
+    def argmax_of_local_max(self, maxv, arg, v_local):
+        """Global greedy token from per-shard (max logit, local argmax)
+        pairs — the vocab-parallel head's PSUM-FREE select: all_gather
+        two small rows, pick the FIRST shard holding the global max
+        (exactly jnp.argmax's first-max-wins tie rule over the shard-
+        concatenated logits), offset its local index by the shard's
+        vocab base. Bitwise equal to argmax over the full logits."""
+        ms = lax.all_gather(maxv, AXIS)                  # [tp, ...]
+        ags = lax.all_gather(arg, AXIS)
+        s = jnp.argmax(ms, axis=0)
+        loc = jnp.take_along_axis(ags, s[None].astype(ags.dtype),
+                                  axis=0)[0]
+        return loc.astype(jnp.int32) \
+            + s.astype(jnp.int32) * jnp.int32(v_local)
+
     def gather_heads(self, x):
         """[..., nh_local, hd] -> [..., nh, hd]: reassemble the exact
         per-head attention outputs in shard (= original head) order —

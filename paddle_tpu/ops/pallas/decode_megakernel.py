@@ -1,47 +1,51 @@
-"""Per-layer decode MEGAKERNEL (TPU Pallas): one kernel invocation runs a
-whole transformer decode layer — int8 weight-only Q/K/V/O/MLP matmuls,
-RMS-norm, rope, and paged attention — with the weights STREAMED through
-VMEM tile-by-tile.
+"""Whole-step decode MEGAKERNEL (TPU Pallas): one kernel invocation runs
+a FULL decode step — every transformer layer (int8 weight-only matmuls,
+RMS-norm, rope, paged attention), the final norm, and the lm_head tiled
+over vocab with an on-kernel running-argmax token select — with the
+weights STREAMED through VMEM tile-by-tile.
 
-Why: PR 3 made the decode loop device-resident, but the fused block still
-emits one XLA op per layer op, and int8 7B decode is weight-bandwidth-
-bound (NOTES_r5). MPK (PAPERS.md) shows compiling the whole tensor
-program into one mega-kernel erases exactly the per-op dispatch and the
-HBM round trips between ops. This kernel is that idea at decode scale:
+v1 (PR 6) fused the per-layer math but stopped at the layer boundary:
+lm_head, sampling, and the KV page scatter stayed separate XLA ops, and
+the kernel was mutually exclusive with both speculation and tensor
+parallelism. v2 is the rest of the MPK claim (PAPERS.md — compile the
+WHOLE tensor program):
 
-  - ONE 1-D grid whose steps walk a statically-built SCHEDULE of tiles:
-      Q -> K -> V -> ATTN -> O -> G -> U -> D        (per layer)
-    Matmul phases iterate (n-tile outer, k-tile inner) over the weight;
-    the ATTN phase iterates (slot, page) exactly like the tuned
-    paged-attention kernel. Scalar-prefetched schedule arrays drive
-    every BlockSpec index map, so each grid step DMAs precisely the
-    weight tile / KV page it needs while Pallas's pipeline prefetches
-    the NEXT step's block — the weights double-buffer through VMEM and
-    the kernel runs at weight-bandwidth, not dispatch, limits.
-  - Activations (a decode step is [b<=8, H]) live ENTIRELY in VMEM
-    scratch for the whole layer: hidden state, normed input, q/k/v,
-    attention accumulators, MLP activations. Nothing bounces to HBM
-    between ops.
-  - The multi-layer variant stacks weights [L, ...] and extends the
-    schedule across layers, so while layer L's MLP tail computes, layer
-    L+1's Q/K/V weight tiles are already streaming in: the weight-
-    stream pipeline crosses layer boundaries inside ONE invocation.
+  - ONE 1-D grid walks a statically-built SCHEDULE of tiles:
+      [per layer]  Q -> K -> V -> ATTN -> O -> G -> U -> D
+      [step tail]  final-norm -> HEAD (lm_head n-tiles over vocab)
+    Matmul phases iterate (n-tile outer, k-tile inner); ATTN iterates
+    (slot, page); HEAD additionally maintains a RUNNING ARGMAX over the
+    emitted logits tiles so the greedy next token leaves the kernel as
+    a [b] int32 — the engine's `lax.scan` then drives the invocation
+    directly and a decode_block=K block is kernel launches plus only
+    the KV page scatter and the tiny carry updates (one compiled
+    program, no per-step XLA graph between launches).
+  - SPECULATION rides the same schedule: tq > 1 runs the matmul phases
+    over [b*tq] feed rows (rows are position-independent) and the ATTN
+    phase as the multi-token-q ragged variant — per-slot causal masking
+    via the SAME `ragged_causal_mask` the verify kernel uses, with the
+    current feed tokens' k/v substituted into their page blocks under
+    the engine's write mask (identical bytes to the scatter-then-attend
+    unfused path, including the not-yet-written stale rows).
+  - TENSOR PARALLELISM composes via per-shard SEGMENTS under shard_map:
+    `seg="qkv"` (column-parallel Q/K/V + local-head attention),
+    `seg="tail"` (replicated O + norm2 + column-parallel gate/up),
+    `seg="down"` (replicated down [+ final norm + the vocab-parallel
+    HEAD slice, whose local (max, argmax) pair the engine combines
+    gather-free]). The exact-mode gathers run BETWEEN segments — pure
+    data movement, so byte-identity with the tp=1 engine survives.
+    `pack_decode_layer(..., tp=N)` packs column weights per shard
+    (concatenated so a P(None, "mp")-sharded array hands each shard its
+    own padded tile grid).
 
-Numerics are kept step-for-step identical to the unfused engine path
-(`inference/scheduler._cb_decode_math`): the matmul k-tiling matches
-quantized_matmul's (f32 accumulator, per-channel scale at emission), the
-norm replicates serving._rms's cast order, and the attention phase runs
-the decode kernel's per-page online softmax with the CURRENT token's
-k/v substituted into its page block (the unfused path scatters them into
-the page before attending; substituting after the load is the same
-block content, so the online-softmax trajectory is bitwise-equal on
-CPU/f32). Interpret mode on CPU is the parity fallback; see
-tests/test_decode_megakernel.py.
-
-Layout notes: q/k/v/attention rows live FLAT [b, heads*hd] in VMEM and
-are reshaped [heads, hd] per slot only inside the ATTN phase — Mosaic
-tolerates that reshape when hd is a lane multiple, which is what
-`megakernel_supported` gates on for the auto engine knob.
+Numerics are kept step-for-step identical to the unfused engine path:
+matmul k-tiling shares `quantized_matmul`'s tile bodies (f32
+accumulate, per-channel scale at emission), norms share
+`rms_norm.rms_rows`, single-token attention runs the decode kernel's
+per-page online softmax, the tq variant the ragged kernel's (shared
+mask helper), and the HEAD running argmax reproduces `jnp.argmax`'s
+first-max-wins tie rule tile-by-tile. Interpret mode on CPU is the
+parity fallback; see tests/test_megakernel_v2.py.
 """
 import functools
 import math
@@ -53,12 +57,28 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ...jax_compat import enable_x64, tpu_compiler_params
-from .paged_attention import NEG_INF, wv_diag
+from .paged_attention import NEG_INF, ragged_causal_mask, wv_diag
 from .quantized_matmul import dot_tile_f32, scale_emit
 from .rms_norm import rms_rows as _rms_rows
 
 # schedule phase ids (ints baked into the scalar-prefetched schedule)
 PH_Q, PH_K, PH_V, PH_ATTN, PH_O, PH_G, PH_U, PH_D = range(8)
+PH_H = 8          # lm_head tiles (whole-step mode; preceded by an
+#                   in-schedule final-norm epilogue at its first step)
+
+# which phases each SEGMENT runs. "full" is the tp=1 whole-layer (or
+# whole-stack) walk; the other three split a layer at the exact-mode
+# gather boundaries so megakernel + tp>1 compose under shard_map.
+SEG_PHASES = {
+    "full": (PH_Q, PH_K, PH_V, PH_ATTN, PH_O, PH_G, PH_U, PH_D),
+    "qkv": (PH_Q, PH_K, PH_V, PH_ATTN),
+    "tail": (PH_O, PH_G, PH_U),
+    "down": (PH_D,),
+}
+# matmul phase -> (weight key, source buffer name)
+_MM_SRC = {PH_Q: ("q", "x"), PH_K: ("k", "x"), PH_V: ("v", "x"),
+           PH_O: ("o", "attn"), PH_G: ("g", "x"), PH_U: ("u", "x"),
+           PH_D: ("d", "act")}
 
 # default streaming tile sizes; k matches quantized_matmul's bk=512 so
 # the f32 accumulation order (and therefore the bits) agree with the
@@ -108,15 +128,51 @@ def _pack_w(w, bk, bn, cdtype):
     return vals, scales
 
 
-def pack_decode_layer(wset, cdtype=jnp.float32, bk=DEF_BK, bn=DEF_BN):
+def _pack_w_sharded(w, bk, bn, cdtype, tp):
+    """Column-parallel per-shard pack: slice the OUTPUT channels into tp
+    equal shards, pack each shard to its own padded tile grid, and
+    concatenate — a P(None, "mp")-sharded placement of the result hands
+    shard s exactly its local packed (values, scales). The k-axis pad
+    is shard-independent (derived from (k, bk) alone), so every shard
+    walks the same k-tile count as the tp=1 pack."""
+    if tp == 1:
+        return _pack_w(w, bk, bn, cdtype)
+    if isinstance(w, tuple):
+        vals, scales = w
+    else:
+        vals = w.astype(cdtype) if w.dtype != cdtype else w
+        scales = jnp.ones((w.shape[1],), jnp.float32)
+    n = vals.shape[1]
+    assert n % tp == 0, (n, tp)
+    nl = n // tp
+    vparts, sparts = [], []
+    for s in range(tp):
+        v, sc = _pack_w((vals[:, s * nl:(s + 1) * nl],
+                         scales[s * nl:(s + 1) * nl]), bk, bn, cdtype)
+        vparts.append(v)
+        sparts.append(sc)
+    return jnp.concatenate(vparts, 1), jnp.concatenate(sparts, 1)
+
+
+def pack_decode_layer(wset, cdtype=jnp.float32, bk=DEF_BK, bn=DEF_BN,
+                      tp=1):
     """Repack ONE engine layer snapshot (serving._snapshot_llama entry)
     into the megakernel's streamed layout: per-projection (values,
     scales) padded to the streaming tile grid, norm weights as [1, H]
     rows. Views/cheap reshapes where no padding is needed — the int8
-    pool is NOT duplicated for the common aligned geometries."""
+    pool is NOT duplicated for the common aligned geometries.
+
+    tp > 1 packs the COLUMN-parallel projections (q/k/v/gate/up) per
+    shard (see _pack_w_sharded) while o/down stay full — the exact-mode
+    row-parallel pair runs REPLICATED on gathered operands, exactly like
+    the op-chain tp engine, so byte-identity with tp=1 survives."""
     out = {}
-    for name, key in (("q", "wq"), ("k", "wk"), ("v", "wv"), ("o", "wo"),
-                      ("g", "wg"), ("u", "wu"), ("d", "wd")):
+    for name, key in (("q", "wq"), ("k", "wk"), ("v", "wv"),
+                      ("g", "wg"), ("u", "wu")):
+        vals, scales = _pack_w_sharded(wset[key], bk, bn, cdtype, tp)
+        out["w" + name] = vals
+        out["s" + name] = scales
+    for name, key in (("o", "wo"), ("d", "wd")):
         vals, scales = _pack_w(wset[key], bk, bn, cdtype)
         out["w" + name] = vals
         out["s" + name] = scales
@@ -124,6 +180,20 @@ def pack_decode_layer(wset, cdtype=jnp.float32, bk=DEF_BK, bn=DEF_BN):
     out["ln1"] = _pad_axis(wset["ln1"].reshape(1, -1), hp, 1)
     out["ln2"] = _pad_axis(wset["ln2"].reshape(1, -1), hp, 1)
     return out
+
+
+def pack_lm_head(head, norm_w, cdtype=jnp.float32, bk=DEF_BK, bn=DEF_BN,
+                 tp=1):
+    """Pack the final norm + lm_head for the whole-step HEAD phase:
+    {"wh": [H_pad, V_pad], "sh": [1, V_pad], "nf": [1, H_pad]}. The
+    k-axis pad matches pack_decode_layer's hidden pad (same (dim, bk)
+    rule), so the HEAD phase reuses the layer walk's x scratch rows.
+    tp > 1 shards the VOCAB columns per shard (the vocab-parallel
+    lm_head): each shard streams 1/tp of the head and emits its local
+    (max, argmax) pair for the engine's gather-free combine."""
+    wh, sh = _pack_w_sharded(head, bk, bn, cdtype, tp)
+    return {"wh": wh, "sh": sh,
+            "nf": _pad_axis(norm_w.reshape(1, -1), wh.shape[0], 1)}
 
 
 def stack_packed(layers):
@@ -145,10 +215,11 @@ def megakernel_supported(nh, nh_kv, hd, hidden, ffn):
 
 
 def _rope_flat(x, c, s, n_heads, hd):
-    """Rope over the FLAT [b, n_heads*hd] layout: per-head unrolled
+    """Rope over the FLAT [rows, n_heads*hd] layout: per-head unrolled
     half-pair rotation (heads are small and static at decode — the same
-    unroll the paged-attention kernels use). c/s: [b, hd//2], already in
-    x.dtype (matching _layer_qkv's cast-then-multiply order)."""
+    unroll the paged-attention kernels use). c/s: [rows, hd//2], already
+    in x.dtype (matching _layer_qkv's cast-then-multiply order); with
+    tq > 1 each feed row carries its own position's rope row."""
     hd2 = hd // 2
     outs = []
     for g in range(n_heads):
@@ -159,296 +230,518 @@ def _rope_flat(x, c, s, n_heads, hd):
     return jnp.concatenate(outs, axis=1)
 
 
-def _build_schedule(L, b, mp, counts):
+def _build_schedule(L, b, mp, counts, phases, head_counts=None):
     """Static tile walk -> four int32 arrays (phase, a0, a1, layer).
     Matmul phases: a0 = k-tile (inner), a1 = n-tile (outer) — k inner
     matches quantized_matmul's grid so each output tile's f32
-    accumulation order is identical. ATTN: a0 = slot, a1 = page."""
+    accumulation order is identical. ATTN: a0 = slot, a1 = page. The
+    HEAD phase (when present) appends after the last layer with
+    li = L-1 so every stacked layer-weight BlockSpec stays pinned on
+    its final block (no spurious re-DMA)."""
     ph, a0, a1, li = [], [], [], []
     for lyr in range(L):
-        for P in (PH_Q, PH_K, PH_V):
-            nk, nn = counts[P]
-            for n in range(nn):
-                for k in range(nk):
-                    ph.append(P); a0.append(k); a1.append(n); li.append(lyr)
-        for slot in range(b):
-            for page in range(mp):
-                ph.append(PH_ATTN); a0.append(slot); a1.append(page)
-                li.append(lyr)
-        for P in (PH_O, PH_G, PH_U, PH_D):
-            nk, nn = counts[P]
-            for n in range(nn):
-                for k in range(nk):
-                    ph.append(P); a0.append(k); a1.append(n); li.append(lyr)
+        for P in phases:
+            if P == PH_ATTN:
+                for slot in range(b):
+                    for page in range(mp):
+                        ph.append(P); a0.append(slot); a1.append(page)
+                        li.append(lyr)
+            else:
+                nk, nn = counts[P]
+                for n in range(nn):
+                    for k in range(nk):
+                        ph.append(P); a0.append(k); a1.append(n)
+                        li.append(lyr)
+    if head_counts is not None:
+        nk, nn = head_counts
+        for n in range(nn):
+            for k in range(nk):
+                ph.append(PH_H); a0.append(k); a1.append(n)
+                li.append(L - 1)
     return (np.asarray(ph, np.int32), np.asarray(a0, np.int32),
             np.asarray(a1, np.int32), np.asarray(li, np.int32))
 
 
-def _mk_kernel(ph_ref, a0_ref, a1_ref, li_ref, tbl_ref, len_ref, act_ref,
-               h_ref, cos_ref, sin_ref, ln1_ref, ln2_ref,
-               wq_ref, sq_ref, wk_ref, sk_ref, wv_ref, sv_ref,
-               wo_ref, so_ref, wg_ref, sg_ref, wu_ref, su_ref,
-               wd_ref, sd_ref, kp_ref, vp_ref,
-               ho_ref, kn_ref, vn_ref,
-               h_scr, x_scr, q_scr, k_scr, v_scr, attn_scr, g_scr, u_scr,
-               act_scr, acc_scr, m_scr, l_scr, aacc_scr, *,
-               stacked, counts, bkh, bkf, bns, dims, eps, p, mp, scale):
+# layer-weight keys that stack [L, ...] in "multi" mode (the head pack
+# and the final norm never stack — there is one lm_head per model)
+_STACKED_KEYS = frozenset(
+    ["w" + k for k in "qkvogud"] + ["s" + k for k in "qkvogud"]
+    + ["ln1", "ln2"])
+
+
+def _mk_kernel(*args, names, seg, stacked, counts, bks, bns, dims,
+               eps, p, mp, scale, head, T):
+    """One grid step of the schedule walk. `names` maps every ref
+    (scalar prefetch, inputs, outputs, scratch — in pallas_call order)
+    so the same body serves every segment/variant; python-level
+    conditionals on (seg, head, T, stacked) are STATIC — each built
+    kernel contains only its own phases."""
+    refs = dict(zip(names, args))
     s = pl.program_id(0)
-    ph = ph_ref[s]
-    a0 = a0_ref[s]
-    a1 = a1_ref[s]
-    lyr = li_ref[s]
-    (b, H, Hp, NQ, NQp, NK, nh, nh_kv, hd) = dims
+    ph = refs["ph"][s]
+    a0 = refs["a0"][s]
+    a1 = refs["a1"][s]
+    lyr = refs["li"][s]
+    R = dims["R"]
+    b = dims["b"]
+    H = dims["H"]
+    nh, nh_kv, hd = dims["nh"], dims["nh_kv"], dims["hd"]
+    NQ, NK = nh * hd, nh_kv * hd
+    NQp = dims["NQp"]
     rep = nh // nh_kv
-    cdtype = h_scr.dtype
+    hs = refs["h_scr"]
+    xs = refs["x_scr"]
+    acc = refs["acc_scr"]
+    cdtype = hs.dtype
 
-    def wblk(ref):
-        return ref[0] if stacked else ref[...]
+    def wblk(name):
+        r = refs[name]
+        return r[0] if (stacked and name in _STACKED_KEYS) else r[...]
 
-    def srow(ref):
-        return ref[0, 0] if stacked else ref[0]
+    def srow(name):
+        r = refs[name]
+        return r[0, 0] if (stacked and name in _STACKED_KEYS) else r[0]
 
-    def lnrow(ref):
-        # a (1, Hp) row either way; broadcasts against [b, Hp]
-        return ref[0] if stacked else ref[...]
+    def lnrow(name):
+        # a (1, Hp) row either way; broadcasts against [R, Hp]
+        r = refs[name]
+        return r[0] if (stacked and name in _STACKED_KEYS) else r[...]
 
-    # -- layer entry: load h (layer 0) and pre-norm into x_scr ------------
-    @pl.when(jnp.logical_and(ph == PH_Q,
+    # -- segment entry: load h (and pre-norm where the segment starts
+    # -- at the attention block) --------------------------------------
+    entry_ph = {"full": PH_Q, "qkv": PH_Q, "tail": PH_O,
+                "down": PH_D}[seg]
+
+    @pl.when(jnp.logical_and(ph == entry_ph,
                              jnp.logical_and(a0 == 0, a1 == 0)))
-    def _enter_layer():
-        @pl.when(lyr == 0)
-        def _():
-            h_scr[...] = h_ref[...]
-        x_scr[...] = _rms_rows(h_scr[...], lnrow(ln1_ref), eps, H)
+    def _enter():
+        if seg in ("full", "qkv"):
+            @pl.when(lyr == 0)
+            def _():
+                hs[...] = refs["h"][...]
+            xs[...] = _rms_rows(hs[...], lnrow("ln1"), eps, H)
+        else:
+            hs[...] = refs["h"][...]
 
-    # -- shared matmul step: acc += x_tile @ w_tile; emit at last k ------
-    def mm_phase(P, x_src, bk, w_ref, s_ref, emit):
+    # -- shared matmul step: acc += x_tile @ w_tile; emit at last k ----
+    def seg_write(tgt):
+        def emit(out, bn):
+            tgt[:, pl.ds(a1 * bn, bn)] = out
+        return emit
+
+    def seg_add(tgt):
+        def emit(out, bn):
+            sl = pl.ds(a1 * bn, bn)
+            tgt[:, sl] = tgt[:, sl] + out
+        return emit
+
+    def mm_src(src):
+        if src == "x":
+            return xs
+        if src == "attn":
+            return refs["attn_in"] if seg == "tail" else refs["attn_scr"]
+        return refs["act_in"] if seg == "down" else refs["act_scr"]
+
+    def mm_phase(P, emit):
+        wkey, src = _MM_SRC[P]
         nk, nn = counts[P]
         bn = bns[P]
+        bk = bks[P]
+        x_src = mm_src(src)
 
         @pl.when(ph == P)
         def _():
             @pl.when(a0 == 0)
             def _():
-                acc_scr[...] = jnp.zeros_like(acc_scr)
-            acc_scr[:, :bn] += dot_tile_f32(x_src[:, pl.ds(a0 * bk, bk)],
-                                            wblk(w_ref))
+                acc[...] = jnp.zeros_like(acc)
+            acc[:, :bn] += dot_tile_f32(x_src[:, pl.ds(a0 * bk, bk)],
+                                        wblk("w" + wkey))
 
             @pl.when(a0 == nk - 1)
             def _():
-                emit(scale_emit(acc_scr[:, :bn], srow(s_ref), cdtype),
-                     nn, bn)
+                emit(scale_emit(acc[:, :bn], srow("s" + wkey), cdtype),
+                     bn)
 
-    def seg_write(tgt):
-        def emit(out, nn, bn):
-            tgt[:, pl.ds(a1 * bn, bn)] = out
-        return emit
-
-    def seg_add(tgt):
-        def emit(out, nn, bn):
-            sl = pl.ds(a1 * bn, bn)
-            tgt[:, sl] = tgt[:, sl] + out
-        return emit
-
-    mm_phase(PH_Q, x_scr, bkh, wq_ref, sq_ref, seg_write(q_scr))
-    mm_phase(PH_K, x_scr, bkh, wk_ref, sk_ref, seg_write(k_scr))
-    mm_phase(PH_V, x_scr, bkh, wv_ref, sv_ref, seg_write(v_scr))
-    mm_phase(PH_O, attn_scr, bkh, wo_ref, so_ref, seg_add(h_scr))
-    mm_phase(PH_G, x_scr, bkh, wg_ref, sg_ref, seg_write(g_scr))
-    mm_phase(PH_U, x_scr, bkh, wu_ref, su_ref, seg_write(u_scr))
-    mm_phase(PH_D, act_scr, bkf, wd_ref, sd_ref, seg_add(h_scr))
+    emits = {PH_Q: lambda: seg_write(refs["q_scr"]),
+             PH_K: lambda: seg_write(refs["k_scr"]),
+             PH_V: lambda: seg_write(refs["v_scr"]),
+             PH_O: lambda: seg_add(hs),
+             PH_G: lambda: seg_write(refs["g_scr"]),
+             PH_U: lambda: seg_write(refs["u_scr"]),
+             PH_D: lambda: seg_add(hs)}
+    for P in SEG_PHASES[seg]:
+        if P != PH_ATTN:
+            mm_phase(P, emits[P]())
 
     def _phase_end(P):
         nk, nn = counts[P]
         return jnp.logical_and(ph == P,
                                jnp.logical_and(a0 == nk - 1, a1 == nn - 1))
 
-    # -- phase epilogues --------------------------------------------------
-    @pl.when(_phase_end(PH_Q))
-    def _rope_q():
-        c = cos_ref[...]
-        sn = sin_ref[...]
-        q_scr[:, :NQ] = _rope_flat(q_scr[:, :NQ], c, sn, nh, hd)
+    # -- phase epilogues ----------------------------------------------
+    if PH_Q in counts:
+        @pl.when(_phase_end(PH_Q))
+        def _rope_q():
+            c = refs["cos"][...]
+            sn = refs["sin"][...]
+            refs["q_scr"][:, :NQ] = _rope_flat(refs["q_scr"][:, :NQ],
+                                               c, sn, nh, hd)
 
-    @pl.when(_phase_end(PH_K))
-    def _rope_k():
-        c = cos_ref[...]
-        sn = sin_ref[...]
-        k_scr[:, :NK] = _rope_flat(k_scr[:, :NK], c, sn, nh_kv, hd)
-        if stacked:
-            kn_ref[0] = k_scr[...]
-        else:
-            kn_ref[...] = k_scr[...]
+        @pl.when(_phase_end(PH_K))
+        def _rope_k():
+            c = refs["cos"][...]
+            sn = refs["sin"][...]
+            refs["k_scr"][:, :NK] = _rope_flat(refs["k_scr"][:, :NK],
+                                               c, sn, nh_kv, hd)
+            if stacked:
+                refs["kn"][0] = refs["k_scr"][...]
+            else:
+                refs["kn"][...] = refs["k_scr"][...]
 
-    @pl.when(_phase_end(PH_V))
-    def _emit_v():
-        if stacked:
-            vn_ref[0] = v_scr[...]
-        else:
-            vn_ref[...] = v_scr[...]
+        @pl.when(_phase_end(PH_V))
+        def _emit_v():
+            if stacked:
+                refs["vn"][0] = refs["v_scr"][...]
+            else:
+                refs["vn"][...] = refs["v_scr"][...]
 
-    @pl.when(_phase_end(PH_O))
-    def _norm2():
-        x_scr[...] = _rms_rows(h_scr[...], lnrow(ln2_ref), eps, H)
+    if PH_O in counts:
+        @pl.when(_phase_end(PH_O))
+        def _norm2():
+            xs[...] = _rms_rows(hs[...], lnrow("ln2"), eps, H)
 
-    @pl.when(_phase_end(PH_U))
-    def _swiglu():
-        g = g_scr[...]
-        act_scr[...] = jax.nn.silu(
-            g.astype(jnp.float32)).astype(cdtype) * u_scr[...]
+    if PH_U in counts:
+        @pl.when(_phase_end(PH_U))
+        def _swiglu():
+            g = refs["g_scr"][...]
+            refs["act_scr"][...] = jax.nn.silu(
+                g.astype(jnp.float32)).astype(cdtype) * refs["u_scr"][...]
+            if seg == "tail":           # segment ends here: emit both
+                refs["ho"][...] = hs[...]
+                refs["act_out"][...] = refs["act_scr"][...]
 
-    @pl.when(_phase_end(PH_D))
-    def _emit_h():
-        ho_ref[...] = h_scr[...]
+    if PH_D in counts:
+        @pl.when(_phase_end(PH_D))
+        def _emit_h():
+            refs["ho"][...] = hs[...]
 
-    # -- paged attention phase (a0 = slot, a1 = page) ---------------------
-    # Identical math to paged_attention._decode_kernel over the slot's
-    # pages, with the current token's k/v substituted into its page
-    # block (the unfused engine scatters them into the page BEFORE
-    # attending; the block contents — and so the online-softmax
-    # trajectory — are the same).
-    @pl.when(ph == PH_ATTN)
-    def _attn():
-        slot = a0
-        page = a1
+    # -- paged attention phase (a0 = slot, a1 = page) ------------------
+    if PH_ATTN in SEG_PHASES[seg]:
+        attn_tgt = refs["attn_out"] if seg == "qkv" else refs["attn_scr"]
+        m_scr, l_scr, aacc = refs["m_scr"], refs["l_scr"], refs["aacc_scr"]
+        tblr, lensr, actr = refs["tbl"], refs["lens"], refs["act"]
+        wmr = refs["wm"]
 
-        @pl.when(page == 0)
-        def _():
-            m_scr[...] = jnp.full_like(m_scr, NEG_INF)
-            l_scr[...] = jnp.zeros_like(l_scr)
-            aacc_scr[...] = jnp.zeros_like(aacc_scr)
+        @pl.when(ph == PH_ATTN)
+        def _attn():
+            slot = a0
+            page = a1
 
-        alive = act_ref[slot] > 0
-        # NOTE: every jnp.where operand in this kernel must be an
-        # explicitly-typed i32 — interpret mode re-discharges the kernel
-        # jaxpr at OUTER-jit lowering time, outside the enable_x64(False)
-        # window, and a weak python-int literal re-canonicalizes to i64
-        # there, producing an inconsistent select_n (MLIR verify error).
-        seq_len = jnp.where(alive, len_ref[slot] + jnp.int32(1),
-                            jnp.int32(0))
-        page_start = page * p
-        run = jnp.logical_and(alive, page_start < seq_len)
+            @pl.when(page == 0)
+            def _():
+                m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+                l_scr[...] = jnp.zeros_like(l_scr)
+                aacc[...] = jnp.zeros_like(aacc)
 
-        @pl.when(run)
-        def _compute():
-            q = q_scr[pl.ds(slot, 1), :][:, :NQ].reshape(nh, hd).astype(
-                jnp.float32) * jnp.float32(scale)
-            k = (kp_ref[0, 0] if stacked else kp_ref[0]).astype(jnp.float32)
-            v = (vp_ref[0, 0] if stacked else vp_ref[0]).astype(jnp.float32)
-            cur = len_ref[slot]
-            on_page = (cur // jnp.int32(p)) == page
-            rows = jax.lax.broadcasted_iota(jnp.int32, (p, 1, 1), 0)
-            sub = jnp.logical_and(
-                on_page, rows == jax.lax.rem(cur, jnp.int32(p)))
-            kc = k_scr[pl.ds(slot, 1), :][:, :NK].reshape(
-                nh_kv, hd).astype(jnp.float32)
-            vc = v_scr[pl.ds(slot, 1), :][:, :NK].reshape(
-                nh_kv, hd).astype(jnp.float32)
-            k = jnp.where(sub, kc[None], k)
-            v = jnp.where(sub, vc[None], v)
-            logits = jnp.concatenate([
-                jax.lax.dot_general(
-                    q[g * rep:(g + 1) * rep], k[:, g, :],
-                    (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)
-                for g in range(nh_kv)], axis=0)                # [nh, p]
-            pos = jax.lax.broadcasted_iota(
-                jnp.int32, logits.shape, 1) + page_start
-            logits = jnp.where(pos < seq_len, logits,
-                               jnp.float32(NEG_INF))
-            m_prev = m_scr[:, :1]
-            l_prev = l_scr[:, :1]
-            m_new = jnp.maximum(m_prev,
-                                jnp.max(logits, axis=-1, keepdims=True))
-            w = jnp.exp(logits - m_new)
-            alpha = jnp.exp(m_prev - m_new)
-            l_scr[...] = jnp.broadcast_to(
-                alpha * l_prev + jnp.sum(w, axis=-1, keepdims=True),
-                l_scr.shape)
-            aacc_scr[...] = alpha * aacc_scr[...] + wv_diag(w, v, hd,
-                                                            rep=rep)
-            m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+            alive = actr[slot] > 0
+            # NOTE: every jnp.where operand in this kernel must be an
+            # explicitly-typed i32 — interpret mode re-discharges the
+            # kernel jaxpr at OUTER-jit lowering time, outside the
+            # enable_x64(False) window, and a weak python-int literal
+            # re-canonicalizes to i64 there, producing an inconsistent
+            # select_n (MLIR verify error).
+            seq_len = jnp.where(alive, lensr[slot] + jnp.int32(T),
+                                jnp.int32(0))
+            page_start = page * p
+            run = jnp.logical_and(alive, page_start < seq_len)
 
-        @pl.when(page == mp - 1)
-        def _emit():
-            l_fin = jnp.maximum(l_scr[:, :1], jnp.float32(1e-30))
-            res = (aacc_scr[...] / l_fin).astype(cdtype)       # [nh, hd]
-            row = res.reshape(1, NQ)
-            if NQp != NQ:              # scratch pads must be exact zeros
-                row = jnp.pad(row, ((0, 0), (0, NQp - NQ)))
-            attn_scr[pl.ds(slot, 1), :] = row
+            @pl.when(run)
+            def _compute():
+                k = (refs["kp"][0, 0] if stacked
+                     else refs["kp"][0]).astype(jnp.float32)
+                v = (refs["vp"][0, 0] if stacked
+                     else refs["vp"][0]).astype(jnp.float32)
+                base = lensr[slot]
+                rows_i = jax.lax.broadcasted_iota(jnp.int32, (p, 1, 1), 0)
+                if T == 1:
+                    # v1 single-token path: substitute the current
+                    # token's k/v into its page block (the unfused path
+                    # scatters them BEFORE attending — same block
+                    # contents, same online-softmax trajectory)
+                    on_page = (base // jnp.int32(p)) == page
+                    sub = jnp.logical_and(
+                        on_page, rows_i == jax.lax.rem(base, jnp.int32(p)))
+                    kc = refs["k_scr"][pl.ds(slot, 1), :][:, :NK].reshape(
+                        nh_kv, hd).astype(jnp.float32)
+                    vc = refs["v_scr"][pl.ds(slot, 1), :][:, :NK].reshape(
+                        nh_kv, hd).astype(jnp.float32)
+                    k = jnp.where(sub, kc[None], k)
+                    v = jnp.where(sub, vc[None], v)
+                    q = refs["q_scr"][pl.ds(slot, 1), :][:, :NQ].reshape(
+                        nh, hd).astype(jnp.float32) * jnp.float32(scale)
+                    logits = jnp.concatenate([
+                        jax.lax.dot_general(
+                            q[g * rep:(g + 1) * rep], k[:, g, :],
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+                        for g in range(nh_kv)], axis=0)        # [nh, p]
+                    pos = jax.lax.broadcasted_iota(
+                        jnp.int32, logits.shape, 1) + page_start
+                    logits = jnp.where(pos < seq_len, logits,
+                                       jnp.float32(NEG_INF))
+                    wrows = rep
+                else:
+                    # tq > 1 (speculative verify): substitute EVERY
+                    # write-gated feed token whose position lands on
+                    # this page — rows the gate skips keep the pool's
+                    # stale bytes, exactly like the unfused
+                    # scatter-then-attend path (write_ok rides in as
+                    # the wm prefetch row mask)
+                    for j in range(T):
+                        pos_j = base + jnp.int32(j)
+                        gate = jnp.logical_and(
+                            wmr[slot * T + j] > 0,
+                            (pos_j // jnp.int32(p)) == page)
+                        sub = jnp.logical_and(
+                            gate, rows_i == jax.lax.rem(pos_j,
+                                                        jnp.int32(p)))
+                        kc = refs["k_scr"][
+                            pl.ds(slot * T + j, 1), :][:, :NK].reshape(
+                            nh_kv, hd).astype(jnp.float32)
+                        vc = refs["v_scr"][
+                            pl.ds(slot * T + j, 1), :][:, :NK].reshape(
+                            nh_kv, hd).astype(jnp.float32)
+                        k = jnp.where(sub, kc[None], k)
+                        v = jnp.where(sub, vc[None], v)
+                    # q rows HEAD-MAJOR [nh*T, hd] (row g*rep*T + j*T
+                    # + qi = q head g*rep+j at feed offset qi) — the
+                    # ragged kernel's row convention, one contiguous
+                    # [rep*T, d] slice per kv head
+                    qs = refs["q_scr"][pl.ds(slot * T, T), :][:, :NQ] \
+                        .astype(jnp.float32) * jnp.float32(scale)
+                    logits = jnp.concatenate([
+                        jax.lax.dot_general(
+                            jnp.concatenate(
+                                [qs[:, hh * hd:(hh + 1) * hd]
+                                 for hh in range(g * rep, (g + 1) * rep)],
+                                axis=0),
+                            k[:, g, :], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+                        for g in range(nh_kv)], axis=0)     # [nh*T, p]
+                    ok = ragged_causal_mask(logits.shape, T, base,
+                                            page_start, seq_len)
+                    logits = jnp.where(ok, logits, jnp.float32(NEG_INF))
+                    wrows = rep * T
+                m_prev = m_scr[:, :1]
+                l_prev = l_scr[:, :1]
+                m_new = jnp.maximum(
+                    m_prev, jnp.max(logits, axis=-1, keepdims=True))
+                w = jnp.exp(logits - m_new)
+                alpha = jnp.exp(m_prev - m_new)
+                l_scr[...] = jnp.broadcast_to(
+                    alpha * l_prev + jnp.sum(w, axis=-1, keepdims=True),
+                    l_scr.shape)
+                aacc[...] = alpha * aacc[...] + wv_diag(w, v, hd,
+                                                        rep=wrows)
+                m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+            @pl.when(page == mp - 1)
+            def _emit():
+                l_fin = jnp.maximum(l_scr[:, :1], jnp.float32(1e-30))
+                res = (aacc[...] / l_fin).astype(cdtype)
+                if T == 1:
+                    row = res.reshape(1, NQ)               # [nh, hd]
+                    if NQp != NQ:     # scratch pads must be exact zeros
+                        row = jnp.pad(row, ((0, 0), (0, NQp - NQ)))
+                    attn_tgt[pl.ds(slot, 1), :] = row
+                else:
+                    res3 = res.reshape(nh, T, hd)
+                    for qi in range(T):
+                        row = res3[:, qi, :].reshape(1, NQ)
+                        if NQp != NQ:
+                            row = jnp.pad(row,
+                                          ((0, 0), (0, NQp - NQ)))
+                        attn_tgt[pl.ds(slot * T + qi, 1), :] = row
+
+    # -- whole-step tail: final norm + lm_head tiles + running argmax --
+    if head:
+        nkh, nnh = counts[PH_H]
+        bnh = bns[PH_H]
+        bkh2 = bks[PH_H]
+        Vh = dims["Vh"]
+        amax = refs["amax_scr"]
+        aidx = refs["aidx_scr"]
+
+        @pl.when(jnp.logical_and(ph == PH_H,
+                                 jnp.logical_and(a0 == 0, a1 == 0)))
+        def _enter_head():
+            xs[...] = _rms_rows(hs[...], refs["nf"][...], eps, H)
+            amax[...] = jnp.full_like(amax, NEG_INF)
+            aidx[...] = jnp.zeros_like(aidx)
+
+        @pl.when(ph == PH_H)
+        def _head():
+            @pl.when(a0 == 0)
+            def _():
+                acc[...] = jnp.zeros_like(acc)
+            acc[:, :bnh] += dot_tile_f32(xs[:, pl.ds(a0 * bkh2, bkh2)],
+                                         refs["wh"][...])
+
+            @pl.when(a0 == nkh - 1)
+            def _():
+                out = scale_emit(acc[:, :bnh], refs["sh"][0], cdtype)
+                refs["logits"][...] = out
+                # running argmax over the CAST logits (what jnp.argmax
+                # sees on the unfused path): strictly-greater update +
+                # first-index-within-tile argmax reproduces the global
+                # first-max-wins tie rule tile by tile; pad columns
+                # (zero scales -> exact 0.0) mask to NEG_INF
+                col = jax.lax.broadcasted_iota(
+                    jnp.int32, (R, bnh), 1) + a1 * jnp.int32(bnh)
+                vals = jnp.where(col < jnp.int32(Vh),
+                                 out.astype(jnp.float32),
+                                 jnp.float32(NEG_INF))
+                tmax = jnp.max(vals, axis=1, keepdims=True)
+                targ = jnp.argmax(vals, axis=1).astype(
+                    jnp.int32)[:, None] + a1 * jnp.int32(bnh)
+                upd = tmax > amax[:, :1]
+                aidx[...] = jnp.where(
+                    upd, jnp.broadcast_to(targ, aidx.shape), aidx[...])
+                amax[...] = jnp.where(
+                    upd, jnp.broadcast_to(tmax, amax.shape), amax[...])
+
+                @pl.when(a1 == nnh - 1)
+                def _():
+                    refs["tok"][...] = aidx[...]
+                    refs["maxv"][...] = amax[...]
 
 
-def decode_megakernel(h, mk, k_pages, v_pages, page_table, lens, active,
-                      cos_sel, sin_sel, *, nh, nh_kv, hd, eps,
-                      scale=None, interpret=False):
-    """Run transformer decode layer(s) as ONE Pallas megakernel.
+def _pad_to(a, width):
+    """Zero-pad the last axis up to an exact target width."""
+    if a.shape[-1] == width:
+        return a
+    assert a.shape[-1] < width, (a.shape, width)
+    pad = [(0, 0)] * (a.ndim - 1) + [(0, width - a.shape[-1])]
+    return jnp.pad(a, pad)
 
-    h          : [b, H] hidden state (one decode token per slot)
-    mk         : packed weights — pack_decode_layer() dict (one layer)
-                 or stack_packed() dict ([L, ...] leaves; multi-layer)
-    k/v_pages  : [n_pages, p, h_kv, hd] for one layer, or [L, n_pages,
-                 p, h_kv, hd] stacked for the multi-layer variant
-    page_table : [b, max_pages] int32
-    lens       : [b] int32 — tokens already cached (the current token's
-                 position); the kernel attends lens+1 positions with the
-                 current token's k/v substituted in-block
-    active     : [b] — retired slots skip attention compute AND page DMA
-                 (their page fetches pin to block 0) and emit zeros
-    cos_sel/sin_sel: [b, hd//2] rope rows AT each slot's position,
-                 already cast to h.dtype
 
-    Returns (h_out [b, H], k_new [(L,) b, h_kv*hd], v_new [...]): the
-    post-layer hidden state and the rope'd current-token k/v per layer,
-    which the CALLER scatters into the page pool — preserving the
-    engine's existing scatter (and its byte-exact page contents).
+def decode_megakernel(h, mk, k_pages=None, v_pages=None, page_table=None,
+                      lens=None, active=None, cos_sel=None, sin_sel=None,
+                      *, nh, nh_kv, hd, eps, scale=None, interpret=False,
+                      seg="full", head=None, head_v=None, mlp_v=None,
+                      tq=1, wmask=None, attn_in=None, act_in=None):
+    """Run decode layer(s) — up to the FULL decode step — as ONE Pallas
+    megakernel invocation.
+
+    seg="full" (default): the whole layer walk. h [R, H] hidden rows
+      (R = b slots, or b*tq feed rows when tq > 1), mk a
+      pack_decode_layer() dict (or stack_packed() for multi-layer),
+      pages/table/lens/active as in v1; cos_sel/sin_sel [R, hd//2] rope
+      rows at each ROW's position. Returns (h_out, k_new, v_new) — the
+      rope'd per-row k/v for the CALLER's page scatter (same pool
+      bytes as the unfused engine). With head=pack_lm_head(...) the
+      schedule appends the final norm + the lm_head vocab tiles +
+      running argmax and ALSO returns (tok [R] i32 greedy argmax,
+      maxv [R] f32 its logit, logits [R, head_v]) — the whole-step
+      mode. head_v = real (unpadded, local under tp) vocab columns.
+
+    tq > 1 (speculative verify): rows are slot-major feed tokens;
+      wmask [R] gates which feed tokens' k/v substitute into their page
+      blocks (the engine's write_ok, flattened) — ungated rows see the
+      pool's stale bytes exactly like the unfused scatter-then-attend
+      path, and the ATTN phase applies the ragged kernel's causal mask.
+
+    Tensor-parallel segments (run per shard under shard_map, exact-mode
+    gathers BETWEEN invocations):
+      seg="qkv":  h + local mk -> (attn [R, nh_l*hd], k_new, v_new)
+      seg="tail": h + attn_in (gathered, full heads) -> (h_after_o,
+                  act [R, mlp_v] local gate*up)
+      seg="down": h + act_in (gathered, full ffn) -> h_out, plus the
+                  head outputs when head= rides (vocab-local slice).
     """
-    b, H = h.shape
-    stacked = k_pages.ndim == 5
+    R, H = h.shape
+    if seg not in SEG_PHASES:
+        raise ValueError(f"unknown megakernel segment {seg!r}")
+    has_attn = seg in ("full", "qkv")
+    stacked = bool(has_attn and k_pages.ndim == 5)
     L = mk["wq"].shape[0] if stacked else 1
-    pshape = k_pages.shape[1:] if stacked else k_pages.shape
-    n_pages, p, h_kv, dd = pshape
-    assert dd == hd and h_kv == nh_kv, (k_pages.shape, nh_kv, hd)
-    mp = page_table.shape[1]
-    NQ, NK = nh * hd, nh_kv * hd
-    assert NQ == H, (nh, hd, H)
+    T = int(tq)
     s = scale if scale is not None else 1.0 / math.sqrt(hd)
     cdtype = h.dtype
+    NQ, NK = nh * hd, nh_kv * hd
 
     def shp(key):
         sh = mk[key].shape
-        return sh[1:] if stacked else sh
+        return sh[1:] if (stacked and key in _STACKED_KEYS) else sh
 
-    Hp = shp("wq")[0]
-    Fp = shp("wd")[0]
-    NQp = shp("wq")[1]
-    NKp = shp("wk")[1]
-    Hop = shp("wo")[1]
-    Fg = shp("wg")[1]
-    # the pack rules derive every pad from (dim, 512) alone, so the
-    # q-output, o-input and o-output pads of the SAME hidden size agree
-    assert NQp == Hp == Hop == shp("wd")[1], (NQp, Hp, Hop, shp("wd")[1])
-    assert Fg == Fp == shp("wu")[1], (Fg, Fp, shp("wu")[1])
-    bkh = _ktile(Hp, DEF_BK)
-    bkf = _ktile(Fp, DEF_BK)
-    bns = {PH_Q: _ktile(NQp, DEF_BN), PH_K: _ktile(NKp, DEF_BN),
-           PH_V: _ktile(NKp, DEF_BN), PH_O: _ktile(Hop, DEF_BN),
-           PH_G: _ktile(Fg, DEF_BN), PH_U: _ktile(Fg, DEF_BN),
-           PH_D: _ktile(Hop, DEF_BN)}
-    counts = {P: (Fp // bkf if P == PH_D else Hp // bkh, n // bns[P])
-              for P, n in ((PH_Q, NQp), (PH_K, NKp), (PH_V, NKp),
-                           (PH_O, Hop), (PH_G, Fg), (PH_U, Fg),
-                           (PH_D, Hop))}
+    counts, bks, bns = {}, {}, {}
+
+    def mm_dims(P, key):
+        kdim, ndim = shp("w" + key)
+        bks[P] = _ktile(kdim, DEF_BK)
+        bns[P] = _ktile(ndim, DEF_BN)
+        counts[P] = (kdim // bks[P], ndim // bns[P])
+        return kdim, ndim
+
+    dims = {"R": R, "H": H, "nh": nh, "nh_kv": nh_kv, "hd": hd}
+    if seg in ("full", "qkv"):
+        Hp, NQp = mm_dims(PH_Q, "q")
+        _, NKp = mm_dims(PH_K, "k")
+        mm_dims(PH_V, "v")
+        assert R == (R // T) * T
+        b = R // T
+        pshape = k_pages.shape[1:] if stacked else k_pages.shape
+        n_pages, p, h_kv, dd = pshape
+        assert dd == hd and h_kv == nh_kv, (k_pages.shape, nh_kv, hd)
+        mp = page_table.shape[1]
+    else:
+        b, mp, p, n_pages = R, 0, 1, 1
+        NQp = NKp = None
+    if seg == "full":
+        assert NQ == H, (nh, hd, H)
+        _, Hop = mm_dims(PH_O, "o")
+        _, Fg = mm_dims(PH_G, "g")
+        mm_dims(PH_U, "u")
+        Fp, _ = mm_dims(PH_D, "d")
+        # the pack rules derive every pad from (dim, 512) alone, so the
+        # q-output, o-input and o-output pads of the SAME hidden size
+        # agree
+        assert NQp == Hp == Hop == shp("wd")[1], (NQp, Hp, Hop)
+        assert Fg == Fp == shp("wu")[1], (Fg, Fp)
+    elif seg == "tail":
+        Oin, Hop = mm_dims(PH_O, "o")
+        Hg, Fg = mm_dims(PH_G, "g")
+        mm_dims(PH_U, "u")
+        assert Hg == Hop == mk["ln2"].shape[-1], (Hg, Hop)
+        assert Fg == shp("wu")[1], (Fg,)
+        Hp, Fp = Hop, Fg
+        attn_in = _pad_to(attn_in, Oin)
+    elif seg == "down":
+        Fp, Hop = mm_dims(PH_D, "d")
+        Hp, Fg = Hop, Fp
+        act_in = _pad_to(act_in, Fp)
+    else:
+        Fg = Fp = 0      # qkv: residual pad (Hp) came from wq's k-axis
+    if head is not None:
+        if seg not in ("full", "down"):
+            raise ValueError(
+                f"head= rides the step tail (seg 'full' or 'down'), "
+                f"not {seg!r}")
+        hk, Vp = head["wh"].shape
+        assert hk == Hp, (hk, Hp, "lm_head k-pad must match the hidden "
+                          "pad (same (dim, 512) rule)")
+        bks[PH_H] = _ktile(hk, DEF_BK)
+        bns[PH_H] = _ktile(Vp, DEF_BN)
+        counts[PH_H] = (hk // bks[PH_H], Vp // bns[PH_H])
+        dims["Vh"] = int(Vp if head_v is None else head_v)
+    dims.update(Hp=Hp, NQp=NQp, b=b)
+
+    ph_arr, a0_arr, a1_arr, li_arr = _build_schedule(
+        L, b, mp, counts, SEG_PHASES[seg], counts.get(PH_H))
+    n_steps = ph_arr.size
     bn_max = max(bns.values())
 
-    ph_arr, a0_arr, a1_arr, li_arr = _build_schedule(L, b, mp, counts)
-    n_steps = ph_arr.size
-
-    hpad = _pad_axis(h, Hp, 1)
-    table = jnp.clip(page_table.astype(jnp.int32), 0, n_pages - 1)
-    lens_i = lens.astype(jnp.int32)
-    act_i = (jnp.ones((b,), jnp.int32) if active is None
-             else active.astype(jnp.int32))
+    hpad = _pad_to(h, Hp)
 
     # index maps are traced at jit-lowering time, OUTSIDE the
     # enable_x64(False) window below — under the package's global x64
@@ -456,46 +749,46 @@ def decode_megakernel(h, mk, k_pages, v_pages, page_table, lens, active,
     # to i64 and Mosaic/interpret lowering rejects them
     i32 = jnp.int32
 
-    def full(shape):
+    def full_spec(shape):
         return pl.BlockSpec(shape, lambda st, *_: (0,) * len(shape))
 
-    def w_spec(P, key):
+    def w_spec(P, key, stk):
         nk, nn = counts[P]
-        bk = bkf if P == PH_D else bkh
-        bn = bns[P]
+        bk, bn = bks[P], bns[P]
 
-        def idx(st, ph, a0, a1, li, tbl, ln, ac):
+        def idx(st, ph, a0, a1, li, *rest):
             mine = ph[st] == P
             before = ph[st] < P
             k = jnp.where(mine, a0[st],
                           jnp.where(before, i32(0), i32(nk - 1)))
             n = jnp.where(mine, a1[st],
                           jnp.where(before, i32(0), i32(nn - 1)))
-            return (li[st], k, n) if stacked else (k, n)
+            return (li[st], k, n) if stk else (k, n)
 
-        return pl.BlockSpec(((1, bk, bn) if stacked else (bk, bn)), idx)
+        return pl.BlockSpec(((1, bk, bn) if stk else (bk, bn)), idx)
 
-    def s_spec(P):
+    def s_spec(P, stk):
         nn = counts[P][1]
         bn = bns[P]
 
-        def idx(st, ph, a0, a1, li, tbl, ln, ac):
+        def idx(st, ph, a0, a1, li, *rest):
             mine = ph[st] == P
             before = ph[st] < P
             n = jnp.where(mine, a1[st],
                           jnp.where(before, i32(0), i32(nn - 1)))
-            return (li[st], 0, n) if stacked else (0, n)
+            return (li[st], 0, n) if stk else (0, n)
 
-        return pl.BlockSpec(((1, 1, bn) if stacked else (1, bn)), idx)
+        return pl.BlockSpec(((1, 1, bn) if stk else (1, bn)), idx)
 
     def ln_spec():
-        def idx(st, ph, a0, a1, li, tbl, ln, ac):
+        def idx(st, ph, a0, a1, li, *rest):
             return (li[st], 0, 0) if stacked else (0, 0)
 
         return pl.BlockSpec(((1, 1, Hp) if stacked else (1, Hp)), idx)
 
     def page_spec():
-        def idx(st, ph, a0, a1, li, tbl, ln, ac):
+        def idx(st, ph, a0, a1, li, *rest):
+            tbl, ac = rest[0], rest[2]
             mine = ph[st] == PH_ATTN
             before = ph[st] < PH_ATTN
             slot = jnp.where(mine, a0[st],
@@ -506,90 +799,175 @@ def decode_megakernel(h, mk, k_pages, v_pages, page_table, lens, active,
             return ((li[st], pg, 0, 0, 0) if stacked
                     else (pg, 0, 0, 0))
 
-        return pl.BlockSpec(((1, 1, p, h_kv, hd) if stacked
-                             else (1, p, h_kv, hd)), idx)
+        return pl.BlockSpec(((1, 1, p, nh_kv, hd) if stacked
+                             else (1, p, nh_kv, hd)), idx)
 
     def out_kv_spec():
         if stacked:
-            return pl.BlockSpec((1, b, NKp),
+            return pl.BlockSpec((1, R, NKp),
                                 lambda st, ph, a0, a1, li, *_:
                                 (li[st], 0, 0))
-        return pl.BlockSpec((b, NKp), lambda st, *_: (0, 0))
+        return pl.BlockSpec((R, NKp), lambda st, *_: (0, 0))
+
+    def logits_spec():
+        nnh = counts[PH_H][1]
+        bnh = bns[PH_H]
+
+        def idx(st, ph, a0, a1, li, *rest):
+            mine = ph[st] == PH_H
+            return (0, jnp.where(mine, a1[st], i32(0)))
+
+        return pl.BlockSpec((R, bnh), idx)
+
+    # -- assemble inputs / outputs / scratch per segment ---------------
+    names, in_specs, operands = [], [], []
+
+    def add(name, arr, spec):
+        names.append(name)
+        in_specs.append(spec)
+        operands.append(arr)
+
+    # scalar prefetch (names first — kernel unpacks by name)
+    pre_names = ["ph", "a0", "a1", "li"]
+    pre_ops = [jnp.asarray(ph_arr), jnp.asarray(a0_arr),
+               jnp.asarray(a1_arr), jnp.asarray(li_arr)]
+    if has_attn:
+        table = jnp.clip(page_table.astype(jnp.int32), 0, n_pages - 1)
+        lens_i = lens.astype(jnp.int32)
+        act_i = (jnp.ones((b,), jnp.int32) if active is None
+                 else active.astype(jnp.int32))
+        wm_i = (jnp.ones((R,), jnp.int32) if wmask is None
+                else wmask.astype(jnp.int32))
+        pre_names += ["tbl", "lens", "act", "wm"]
+        pre_ops += [table, lens_i, act_i, wm_i]
+
+    add("h", hpad, full_spec((R, Hp)))
+    if has_attn:
+        add("cos", cos_sel, full_spec((R, hd // 2)))
+        add("sin", sin_sel, full_spec((R, hd // 2)))
+        add("ln1", mk["ln1"], ln_spec())
+        for P, key in ((PH_Q, "q"), (PH_K, "k"), (PH_V, "v")):
+            add("w" + key, mk["w" + key], w_spec(P, key, stacked))
+            add("s" + key, mk["s" + key], s_spec(P, stacked))
+    if seg == "tail":
+        add("attn_in", attn_in, full_spec(attn_in.shape))
+    if seg in ("full", "tail"):
+        add("ln2", mk["ln2"], ln_spec())
+        for P, key in ((PH_O, "o"), (PH_G, "g"), (PH_U, "u")):
+            add("w" + key, mk["w" + key], w_spec(P, key, stacked))
+            add("s" + key, mk["s" + key], s_spec(P, stacked))
+    if seg == "down":
+        add("act_in", act_in, full_spec(act_in.shape))
+    if seg in ("full", "down"):
+        add("wd", mk["wd"], w_spec(PH_D, "d", stacked))
+        add("sd", mk["sd"], s_spec(PH_D, stacked))
+    if has_attn:
+        add("kp", k_pages, page_spec())
+        add("vp", v_pages, page_spec())
+    if head is not None:
+        add("nf", head["nf"], full_spec((1, Hp)))
+        add("wh", head["wh"], w_spec(PH_H, "h", False))
+        add("sh", head["sh"], s_spec(PH_H, False))
+
+    out_names, out_specs, out_shapes = [], [], []
+
+    def add_out(name, shape, spec, dtype=None):
+        out_names.append(name)
+        out_specs.append(spec)
+        out_shapes.append(jax.ShapeDtypeStruct(shape, dtype or cdtype))
+
+    if seg == "qkv":
+        add_out("attn_out", (R, NQp), full_spec((R, NQp)))
+    else:
+        add_out("ho", (R, Hp), full_spec((R, Hp)))
+    if has_attn:
+        kv_shape = ((L, R, NKp) if stacked else (R, NKp))
+        add_out("kn", kv_shape, out_kv_spec())
+        add_out("vn", kv_shape, out_kv_spec())
+    if seg == "tail":
+        add_out("act_out", (R, Fg), full_spec((R, Fg)))
+    if head is not None:
+        add_out("tok", (R, 128), full_spec((R, 128)), jnp.int32)
+        add_out("maxv", (R, 128), full_spec((R, 128)), jnp.float32)
+        add_out("logits", (R, head["wh"].shape[1]), logits_spec())
+
+    scr_names = ["h_scr", "x_scr", "acc_scr"]
+    scratch = [pltpu.VMEM((R, Hp), cdtype), pltpu.VMEM((R, Hp), cdtype),
+               pltpu.VMEM((R, bn_max), jnp.float32)]
+    if has_attn:
+        scr_names += ["q_scr", "k_scr", "v_scr", "m_scr", "l_scr",
+                      "aacc_scr"]
+        scratch += [pltpu.VMEM((R, NQp), cdtype),
+                    pltpu.VMEM((R, NKp), cdtype),
+                    pltpu.VMEM((R, NKp), cdtype),
+                    pltpu.VMEM((nh * T, 128), jnp.float32),
+                    pltpu.VMEM((nh * T, 128), jnp.float32),
+                    pltpu.VMEM((nh * T, hd), jnp.float32)]
+    if seg == "full":
+        scr_names += ["attn_scr"]
+        scratch += [pltpu.VMEM((R, NQp), cdtype)]
+    if seg in ("full", "tail"):
+        scr_names += ["g_scr", "u_scr", "act_scr"]
+        scratch += [pltpu.VMEM((R, Fg), cdtype)] * 3
+    if head is not None:
+        scr_names += ["amax_scr", "aidx_scr"]
+        scratch += [pltpu.VMEM((R, 128), jnp.float32),
+                    pltpu.VMEM((R, 128), jnp.int32)]
 
     kernel = functools.partial(
-        _mk_kernel, stacked=stacked, counts=counts, bkh=bkh, bkf=bkf,
-        bns=bns, dims=(b, H, Hp, NQ, NQp, NK, nh, nh_kv, hd),
-        eps=float(eps), p=p, mp=mp, scale=float(s))
+        _mk_kernel, names=tuple(pre_names + names + out_names
+                                + scr_names),
+        seg=seg, stacked=stacked, counts=counts, bks=bks, bns=bns,
+        dims=dims, eps=float(eps), p=p, mp=mp, scale=float(s),
+        head=head is not None, T=T)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=7,
+        num_scalar_prefetch=len(pre_names),
         grid=(n_steps,),
-        in_specs=[
-            full((b, Hp)),                       # h
-            full((b, hd // 2)),                  # cos
-            full((b, hd // 2)),                  # sin
-            ln_spec(), ln_spec(),                # ln1, ln2
-            w_spec(PH_Q, "wq"), s_spec(PH_Q),
-            w_spec(PH_K, "wk"), s_spec(PH_K),
-            w_spec(PH_V, "wv"), s_spec(PH_V),
-            w_spec(PH_O, "wo"), s_spec(PH_O),
-            w_spec(PH_G, "wg"), s_spec(PH_G),
-            w_spec(PH_U, "wu"), s_spec(PH_U),
-            w_spec(PH_D, "wd"), s_spec(PH_D),
-            page_spec(), page_spec(),            # k_pages, v_pages
-        ],
-        out_specs=[
-            pl.BlockSpec((b, Hp), lambda st, *_: (0, 0)),
-            out_kv_spec(), out_kv_spec(),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((b, Hp), cdtype),         # h_scr
-            pltpu.VMEM((b, Hp), cdtype),         # x_scr
-            pltpu.VMEM((b, NQp), cdtype),        # q_scr
-            pltpu.VMEM((b, NKp), cdtype),        # k_scr
-            pltpu.VMEM((b, NKp), cdtype),        # v_scr
-            pltpu.VMEM((b, NQp), cdtype),        # attn_scr
-            pltpu.VMEM((b, Fg), cdtype),         # g_scr
-            pltpu.VMEM((b, Fg), cdtype),         # u_scr
-            pltpu.VMEM((b, Fp), cdtype),         # act_scr
-            pltpu.VMEM((b, bn_max), jnp.float32),   # acc_scr
-            pltpu.VMEM((nh, 128), jnp.float32),  # m_scr
-            pltpu.VMEM((nh, 128), jnp.float32),  # l_scr
-            pltpu.VMEM((nh, hd), jnp.float32),   # aacc_scr
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
     )
-    kv_out_shape = ((L, b, NKp) if stacked else (b, NKp))
     with enable_x64(False):
-        ho, kn, vn = pl.pallas_call(
+        outs = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
-            out_shape=[
-                jax.ShapeDtypeStruct((b, Hp), cdtype),
-                jax.ShapeDtypeStruct(kv_out_shape, cdtype),
-                jax.ShapeDtypeStruct(kv_out_shape, cdtype),
-            ],
+            out_shape=out_shapes,
             compiler_params=tpu_compiler_params(
                 dimension_semantics=("arbitrary",)),
             interpret=interpret,
-        )(jnp.asarray(ph_arr), jnp.asarray(a0_arr), jnp.asarray(a1_arr),
-          jnp.asarray(li_arr), table, lens_i, act_i,
-          hpad, cos_sel, sin_sel, mk["ln1"], mk["ln2"],
-          mk["wq"], mk["sq"], mk["wk"], mk["sk"], mk["wv"], mk["sv"],
-          mk["wo"], mk["so"], mk["wg"], mk["sg"], mk["wu"], mk["su"],
-          mk["wd"], mk["sd"], k_pages, v_pages)
-    kn = kn[..., :NK]
-    vn = vn[..., :NK]
-    return ho[:, :H], kn, vn
+        )(*pre_ops, *operands)
+    res = dict(zip(out_names, outs))
+    if seg == "qkv":
+        ret = [res["attn_out"][:, :NQ], res["kn"][..., :NK],
+               res["vn"][..., :NK]]
+    elif seg == "full":
+        ret = [res["ho"][:, :H], res["kn"][..., :NK],
+               res["vn"][..., :NK]]
+    elif seg == "tail":
+        act = res["act_out"]
+        ret = [res["ho"][:, :H],
+               act if mlp_v is None else act[:, :mlp_v]]
+    else:
+        ret = [res["ho"][:, :H]]
+    if head is not None:
+        ret += [res["tok"][:, 0], res["maxv"][:, 0],
+                res["logits"][:, :dims["Vh"]]]
+    return tuple(ret) if len(ret) > 1 else ret[0]
 
 
-def megakernel_weight_bytes(mk, n_layers=None):
+def megakernel_weight_bytes(mk, n_layers=None, head=None):
     """Weight bytes one decode step streams through this kernel (the
     roofline numerator decode_bench reports): every projection's values
-    + scales + both norms, per layer."""
+    + scales + both norms, per layer — plus the lm_head pack when the
+    whole-step mode streams it too."""
     keys = ("wq", "sq", "wk", "sk", "wv", "sv", "wo", "so",
             "wg", "sg", "wu", "su", "wd", "sd", "ln1", "ln2")
     total = sum(int(np.prod(mk[k].shape)) * mk[k].dtype.itemsize
                 for k in keys)
     if n_layers is not None:       # per-layer dict counted L times
         total *= n_layers
+    if head is not None:
+        total += sum(int(np.prod(head[k].shape)) * head[k].dtype.itemsize
+                     for k in ("wh", "sh", "nf"))
     return total

@@ -193,6 +193,21 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
     return out
 
 
+def ragged_causal_mask(shape, tq, q_start, page_start, ctx_len):
+    """The ragged multi-token-q causal mask over a [rows, p] logits
+    block whose rows are (head, token)-flattened with token MINOR (row r
+    is chunk offset r % tq): key column c (global position page_start +
+    c) is visible to row r iff it is causally at-or-before the row's own
+    global position q_start + r % tq AND inside the context. ONE
+    definition shared by _ragged_kernel and the decode megakernel's
+    tq>1 verify phase — the spec-verify byte-identity contract rests on
+    the two kernels computing this mask identically."""
+    qpos = q_start + jax.lax.rem(
+        jax.lax.broadcasted_iota(jnp.int32, shape, 0), jnp.int32(tq))
+    kpos = jax.lax.broadcasted_iota(jnp.int32, shape, 1) + page_start
+    return jnp.logical_and(kpos <= qpos, kpos < ctx_len)
+
+
 def _ragged_kernel(page_table_ref, ctx_lens_ref, q_starts_ref, active_ref,
                    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
                    p, d, tq, n_pages_max, scale, rep=1):
@@ -233,15 +248,10 @@ def _ragged_kernel(page_table_ref, ctx_lens_ref, q_starts_ref, active_ref,
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)     # [rep*tq, p]
             for g in range(h_kv)], axis=0)              # [h*tq, p]
-        # causal + length mask at GLOBAL positions: row r of a kv-head
-        # block is chunk offset r % tq, key column c is position
-        # page_start + c
-        qpos = q_start + jax.lax.rem(
-            jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0),
-            jnp.int32(tq))
-        kpos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) \
-            + page_start
-        ok = jnp.logical_and(kpos <= qpos, kpos < ctx_len)
+        # causal + length mask at GLOBAL positions (shared helper — the
+        # megakernel's verify phase applies the identical mask)
+        ok = ragged_causal_mask(logits.shape, tq, q_start, page_start,
+                                ctx_len)
         logits = jnp.where(ok, logits, jnp.float32(NEG_INF))
 
         m_prev = m_scr[:, :1]

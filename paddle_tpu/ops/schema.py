@@ -134,21 +134,27 @@ def generate_op_reference():
              "hand-written TPU kernel override.",
              "",
              "Beyond per-op overrides, the serving engine fuses the "
-             "entire decode layer into one Pallas invocation — int8 "
-             "matmuls + RMS-norm + rope + paged attention with "
+             "ENTIRE decode step into one Pallas invocation — every "
+             "layer's int8 matmuls + RMS-norm + rope + paged "
+             "attention, then the final norm, the lm_head tiled over "
+             "vocab, and an on-kernel running argmax, all with "
              "double-buffered weight streaming "
              "(`ops/pallas/decode_megakernel.py`); see docs/serving.md "
              '["Megakernel decode"]'
-             "(serving.md#megakernel-decode-megakernel) for the engine "
-             "knob and VMEM budget rules. Speculative decoding rides "
-             "the same kernels: `paged_attention."
-             "spec_verify_attention` scores K draft tokens per slot in "
-             "one multi-token-q ragged invocation, with accept/reject "
-             "in the engine's on-device scan carries — see "
-             '["Speculative decoding"]'
+             "(serving.md#megakernel-decode-megakernel) for the "
+             "schedule shape, VMEM budget rules, and the "
+             "speculation/tensor-parallel composition matrix. "
+             "Speculative decoding rides the same schedule (the tq>1 "
+             "verify variant shares `paged_attention."
+             "ragged_causal_mask` with `spec_verify_attention`), with "
+             "accept/reject in the engine's on-device scan carries — "
+             'see ["Speculative decoding"]'
              "(serving.md#speculative-decoding-speculate) for drafter "
              "choices, adaptive-K policy, and tenant budget/preemption "
-             "semantics.",
+             "semantics. Under tensor parallelism the kernel runs "
+             "per-shard segments with exact-mode gathers between them, "
+             "and the vocab-parallel lm_head's greedy select combines "
+             "per-shard (max, argmax) pairs psum-free.",
              ""]
     for mod in sorted(by_mod):
         lines.append(f"## {mod}")

@@ -359,6 +359,58 @@ class TestRouterTiering:
         assert rep.engine.restores == 1
         assert_no_leak(rep.engine)
 
+    def test_tier_aware_routing_weighs_pages_demoted(self, tiny):
+        """ROADMAP item-2 follow-up (PR 12): a LONG conversation's
+        admission discounts a replica's free pages by its tier
+        pressure (pages_demoted) — a replica that freed pages by
+        demoting running requests would demote the newcomer right back
+        once the parked conversations restore. Short requests keep the
+        plain health order (free-page count wins)."""
+        model, cfg = tiny
+        rng = np.random.RandomState(43)
+
+        def factory():
+            return _mk(model, kv_tier="host", max_batch=4)
+
+        router = EngineRouter(factory, replicas=2)
+        victim_rep, other = router._replicas
+
+        def run_to_decode(rep, prompt_len, mnt):
+            uid = rep.engine.add_request(
+                rng.randint(0, cfg.vocab_size,
+                            (prompt_len,)).astype(np.int64),
+                max_new_tokens=mnt)
+            while rep.engine.status(uid) != "decode":
+                rep.engine.step()
+            return uid
+
+        # equal RUNNING counts (the slot term outranks pages), but the
+        # other replica's live request claims more pages — so on raw
+        # free pages the demoting replica looks healthier...
+        run_to_decode(victim_rep, 9, 8)       # small claim
+        run_to_decode(other, 17, 40)          # big claim
+        parked = run_to_decode(victim_rep, 17, 40)
+        victim_rep.engine.demote_request(parked)
+        hv = victim_rep.headroom()
+        ho = other.headroom()
+        assert hv["running"] == ho["running"] == 1
+        assert hv["pages_demoted"] > 0 and ho["pages_demoted"] == 0
+        assert hv["pages_free"] > ho["pages_free"]
+        # ...until the parked pages (which want to come back) discount it
+        assert hv["pages_free"] - hv["pages_demoted"] < ho["pages_free"]
+        # LONG conversation (page need >= tier_aware_pages): tier
+        # pressure outweighs the raw free-page edge -> lands on `other`
+        need_pages = router.tier_aware_pages * int(ENGINE_KW["page_size"])
+        long_prompt = rng.randint(
+            0, cfg.vocab_size, (need_pages,)).astype(np.int64)
+        reps = router._routable(page_need=router._page_need(
+            {"prompt": long_prompt, "max_new_tokens": 1}))
+        assert reps[0] is other
+        # SHORT request: plain health order, the raw-free-page leader
+        # (the demoting replica) stays first
+        reps = router._routable(page_need=1)
+        assert reps[0] is victim_rep
+
 
 # ------------------------------------------------------------- chaos soak
 @pytest.mark.slow

@@ -327,8 +327,12 @@ def main():
     # — dispatch, token readback, python bookkeeping — is paid once per
     # block. On CPU the engine is host-dispatch-bound, exactly the regime
     # the fusion targets: the K=8/K=1 ratio IS the host-overhead win.
-    # host_overhead_frac = 1 - device_seconds/wall (wall time not spent
-    # blocked on compiled calls or their readbacks).
+    # host_overhead_frac = 1 - steps * t_bare_step / wall, where
+    # t_bare_step comes from the engine's OWN block-until-ready probe
+    # (probe_device_step_seconds — the engine's dispatch_seconds counter
+    # accrues dispatch wall incl. host call machinery and would
+    # overstate device busyness; docs/observability.md "Device
+    # attribution").
     import jax.numpy as jnp
 
     fused_kw = dict(cb_kw)
@@ -372,31 +376,17 @@ def main():
         mis-attribute the win/loss between host and device. (Spec
         cells reuse their mode's PLAIN-step probe: a verify pass does
         more device work per step, so their host_overhead_frac is an
-        upper bound — tagged probe="plain-step".)"""
+        upper bound — tagged probe="plain-step".) The measurement
+        itself is the engine's documented block-until-ready probe
+        (ContinuousBatchingEngine.probe_device_step_seconds) — this
+        bench used to carry that math privately."""
         probe = ContinuousBatchingEngine(f_model, decode_block=1,
                                          megakernel=mk_mode, tp=tp_n,
                                          **fused_kw)
         probe.generate_many(
             [f_rng.randint(0, f_cfg.vocab_size, 8).astype(np.int64)
              for _ in range(mb)], max_new_tokens=4)
-        step_fn = probe._cb_step_fns[mb]
-        kp, vp = probe.k_pages, probe.v_pages
-        s_tok = jnp.asarray(np.zeros(mb, np.int64))
-        s_tab = jnp.asarray(probe._tables_np[:mb])
-        s_len = jnp.asarray(np.zeros(mb, np.int32))
-        s_act = jnp.asarray(np.ones(mb, bool))
-        logits, kp, vp = step_fn(probe.weights, s_tok, kp, vp, s_tab,
-                                 s_len, s_act)
-        jax.block_until_ready(logits)
-        M = 30
-        t_start = time.perf_counter()
-        for _ in range(M):
-            logits, kp, vp = step_fn(probe.weights, s_tok, kp, vp,
-                                     s_tab, s_len, s_act)
-        jax.block_until_ready(logits)
-        t = (time.perf_counter() - t_start) / M
-        probe.k_pages, probe.v_pages = kp, vp  # donated buffers moved
-        return t
+        return probe.probe_device_step_seconds(iters=30)
 
     t_step = _bare_step_probe(False)
 
@@ -542,6 +532,105 @@ def main():
                    "unit": "frac"})
     except Exception as e:  # noqa: BLE001 — bench must stay rc=0
         _emit({"metric": "cb_wholestep_host_overhead", "value": 0.0,
+               "unit": "frac", "error": f"{type(e).__name__}: {e}"})
+
+    # -- telemetry overhead guard (ISSUE 13) -----------------------------
+    # The SAME K=8 stream with the serving telemetry plane off vs on,
+    # over the MAIN bench model (the 1-layer micro geometry is
+    # deliberately host-dominated for the host_overhead metric, which
+    # makes it the worst possible denominator for a relative-overhead
+    # pin — on the real model the per-block device work amortizes the
+    # fixed per-block telemetry cost exactly as in production).
+    # Telemetry captures monotonic timestamps only at block-boundary
+    # host points the engine already visits (zero extra device syncs;
+    # telemetry=None stays a single branch per site), so steady state
+    # must sit under 2% — asserted IN-BENCH, with greedy byte-identity
+    # on-vs-off. Statistic: runs are INTERLEAVED (off, on, off, on) so
+    # box drift lands on both modes; each series takes the MEDIAN of
+    # per-pair walls ratios, and up to 3 independent series run with
+    # the MINIMUM median carrying the claim — a real >2% systematic
+    # cost exceeds in every series, a scheduler hiccup cannot trip all
+    # three. Own rc=0 guard: a violation tags the line, never kills
+    # the bench.
+    try:
+        import statistics as _stats
+
+        from paddle_tpu.inference.telemetry import Telemetry
+
+        tel_rng = np.random.RandomState(41)
+        tel_mb = cb_kw["max_batch"]
+        tel_prompts = [tel_rng.randint(0, cfg.vocab_size,
+                                       int(t)).astype(np.int64)
+                       for t in tel_rng.randint(t_lo, t_hi + 1,
+                                                2 * n_req)]
+        tel_new = new_cb
+        tel_kw = dict(cb_kw, slot_buckets=(tel_mb,))
+
+        def _tel_engine(tel):
+            eng = ContinuousBatchingEngine(model, decode_block=8,
+                                           megakernel=False,
+                                           telemetry=tel, **tel_kw)
+            warm = [tel_rng.randint(0, cfg.vocab_size, 8)
+                    .astype(np.int64) for _ in range(tel_mb)]
+            eng.generate_many(warm, max_new_tokens=18)
+            return eng
+
+        def _timed(eng):
+            t0_ = time.perf_counter()
+            outs = eng.generate_many(tel_prompts,
+                                     max_new_tokens=tel_new)
+            return outs, time.perf_counter() - t0_
+
+        eng_off = _tel_engine(None)
+        tel = Telemetry()
+        eng_on = _tel_engine(tel)
+        medians = []
+        outs_off = outs_on = None
+        wall_off = wall_on = None
+        for _series in range(3):
+            _timed(eng_off)             # settle pair (page churn,
+            _timed(eng_on)              # allocator state, caches)
+            ratios = []
+            for _ in range(5):
+                outs_off, wall_off = _timed(eng_off)
+                outs_on, wall_on = _timed(eng_on)
+                ratios.append(wall_on / max(wall_off, 1e-9))
+            medians.append(_stats.median(ratios))
+            if medians[-1] - 1.0 < 0.02:
+                break                   # series within budget: done
+        for i, (a, b) in enumerate(zip(outs_off, outs_on)):
+            assert a.shape == b.shape and (a == b).all(), (
+                f"telemetry=on diverged from telemetry=off at request "
+                f"{i} — tracing must never touch the math")
+        toks = sum(o.size for o in outs_off) \
+            - sum(p.size for p in tel_prompts)
+        overhead = max(0.0, min(medians) - 1.0)
+        assert overhead < 0.02, (
+            f"telemetry steady-state overhead {overhead:.4f} is not "
+            f"under the 2% budget (series medians: "
+            f"{[round(m, 4) for m in medians]})")
+        ttft = tel.registry.hist.get("ttft_ms")
+        tpot = tel.registry.hist.get("tpot_ms")
+        _emit({
+            "metric": "cb_telemetry_overhead",
+            "model": "llama7b" if seven_b else "llama350m",
+            "K": 8,
+            "requests": len(tel_prompts),
+            "value": round(overhead, 4),
+            "unit": "frac",
+            "series_medians": [round(m, 4) for m in medians],
+            "tokens_per_sec_off": round(toks / max(wall_off, 1e-9), 2),
+            "tokens_per_sec_on": round(toks / max(wall_on, 1e-9), 2),
+            "ttft_p50_ms": (round(ttft.percentile(50), 3)
+                            if ttft and ttft.count else None),
+            "ttft_p99_ms": (round(ttft.percentile(99), 3)
+                            if ttft and ttft.count else None),
+            "tpot_p50_ms": (round(tpot.percentile(50), 3)
+                            if tpot and tpot.count else None),
+            "traced_requests": len(tel.done_traces()),
+        })
+    except Exception as e:  # noqa: BLE001 — bench must stay rc=0
+        _emit({"metric": "cb_telemetry_overhead", "value": 0.0,
                "unit": "frac", "error": f"{type(e).__name__}: {e}"})
 
     # -- megakernel x speculation x tensor-parallel composition cells --

@@ -23,6 +23,7 @@ fused_multi_transformer serving); the paged-KV engine is this
 framework's fused-decode tier.
 """
 import argparse
+import json
 import os
 import sys
 
@@ -134,6 +135,20 @@ def main():
                          "first-token via CRC-checked KV-page handoff "
                          "(zero recompute; scheduler machinery, implies "
                          "router mode)")
+    ap.add_argument("--trace-out", metavar="PATH", default=None,
+                    help="write the request-lifecycle timeline as "
+                         "chrome-trace/perfetto JSON to PATH when the "
+                         "demo finishes (admission/queue/prefill/TTFT/"
+                         "decode spans per request, plus demote/"
+                         "handoff/failover legs and fault events; "
+                         "scheduler and router modes, "
+                         "docs/observability.md)")
+    ap.add_argument("--metrics-every", type=int, metavar="N", default=0,
+                    help="print a compact telemetry snapshot every N "
+                         "engine/router steps while draining: TTFT/"
+                         "TPOT/queue-wait p50+p99, counters, and "
+                         "rate-converted health() deltas "
+                         "(docs/observability.md)")
     ap.add_argument("--megakernel", choices=["auto", "off", "layer",
                                              "multi"], default="auto",
                     help="decode megakernel: one fused Pallas kernel "
@@ -178,6 +193,33 @@ def main():
         weight_dtype = None
 
     quant = None if args.quant == "none" else args.quant
+    # observability (docs/observability.md): --trace-out/--metrics-every
+    # turn the telemetry plane on; router modes aggregate per-replica
+    # registries into the fleet view printed/exported below
+    want_tel = bool(args.trace_out or args.metrics_every)
+
+    def drive_router(router):
+        """Drain the router, printing a compact fleet-metrics line
+        every --metrics-every steps (TTFT/TPOT/queue-wait p50s from the
+        merged per-replica histograms)."""
+        n = 0
+        while router.step():
+            n += 1
+            if args.metrics_every and n % args.metrics_every == 0:
+                hists = (router.metrics().get("fleet") or {}).get(
+                    "histograms", {})
+                line = {k: {"p50_ms": v.get("p50_ms"),
+                            "n": v.get("count")}
+                        for k, v in hists.items() if v.get("count")}
+                print(f"  metrics@{n}: {json.dumps(line)}")
+        router.drain()                  # final collect pass
+
+    def router_trace_out(router):
+        if args.trace_out and want_tel:
+            router.export_chrome_trace(args.trace_out)
+            print(f"  trace written: {args.trace_out} (fleet timeline; "
+                  "load in Perfetto / chrome://tracing)")
+
     tp_kw = {}
     if args.tp > 1:
         tp_kw = dict(tp=args.tp, tp_mode=args.tp_mode,
@@ -212,13 +254,15 @@ def main():
 
         router = EngineRouter(factory,
                               topology={"prefill": p_n, "decode": d_n},
-                              prefix_routing=args.prefix_routing)
+                              prefix_routing=args.prefix_routing,
+                              telemetry=want_tel)
         rng = np.random.RandomState(0)
         prompts = [rng.randint(0, g["cfg"].vocab_size, (t,))
                    .astype(np.int64) for t in (16, 9, 5, 12)]
         uids = [router.add_request(p, max_new_tokens=args.max_new_tokens)
                 for p in prompts]
-        router.drain()
+        drive_router(router)
+        router_trace_out(router)
         h = router.health()
         print(f"model={args.model} quant={args.quant} disagg "
               f"{p_n}:{d_n}: {h['done']} done / {h['failed']} failed, "
@@ -246,7 +290,8 @@ def main():
                 decode_block=args.decode_block, **tp_kw, **tier_kw)
 
         router = EngineRouter(factory, replicas=args.replicas,
-                              prefix_routing=args.prefix_routing)
+                              prefix_routing=args.prefix_routing,
+                              telemetry=want_tel)
         rng = np.random.RandomState(0)
         prompts = [rng.randint(0, g["cfg"].vocab_size, (t,))
                    .astype(np.int64) for t in (16, 9, 5, 12)]
@@ -276,7 +321,8 @@ def main():
                 # round-trip demo: snapshot the live weights first
                 router.save_weights_snapshot(args.hot_swap, step=0)
             print(f"  hot-swap: {router.hot_swap(args.hot_swap)}")
-        router.drain()
+        drive_router(router)
+        router_trace_out(router)
         h = router.health()
         print(f"model={args.model} quant={args.quant} "
               f"router: {len(uids)} requests over {args.replicas} "
@@ -305,6 +351,10 @@ def main():
     if args.scheduler:
         from paddle_tpu.inference.scheduler import (EngineBusyError,
                                                     RequestFailedError)
+        tel = None
+        if want_tel:
+            from paddle_tpu.inference.telemetry import Telemetry
+            tel = Telemetry()
         engine = ContinuousBatchingEngine(
             model, max_len=g["max_len"], page_size=g["page"],
             max_batch=max(2, g["bs"]), quant=quant,
@@ -319,7 +369,7 @@ def main():
             # the tq>1 verify schedule / per-shard segments itself
             megakernel={"auto": None, "off": False}.get(args.megakernel,
                                                         args.megakernel),
-            **tp_kw, **tier_kw)
+            telemetry=tel, **tp_kw, **tier_kw)
         rng = np.random.RandomState(0)
         # ragged prompts; 1 shares 0's prefix (once 0 finishes prefill,
         # the cache turns the shared pages into refcounted read-only
@@ -341,7 +391,18 @@ def main():
                 # bounded queue: backpressure is a client-visible signal,
                 # not an engine crash
                 print(f"  request {i} shed by backpressure: {e}")
-        engine.drain()
+        if args.metrics_every:
+            # metered drain: the telemetry plane's periodic snapshot —
+            # histogram p50/p99s, counters, and rate-converted health()
+            # deltas (docs/observability.md)
+            n = 0
+            while engine.step():
+                n += 1
+                if n % args.metrics_every == 0:
+                    tel.sample(engine.health())
+                    print(f"  metrics@{n}: {json.dumps(tel.summary())}")
+        else:
+            engine.drain()
         fused = (f"{engine.fused_blocks} fused blocks "
                  f"({engine.chained_blocks} pipelined), "
                  if args.decode_block > 1 else "")
@@ -375,6 +436,13 @@ def main():
             print(f"  kv tier ({h['kv_tier']}): {h['demotions']} "
                   f"demotions / {h['restores']} restores "
                   f"({h['restore_failures']} failed), tier={h['tier']}")
+        if tel is not None:
+            print(f"  telemetry: {json.dumps(tel.summary())}")
+            if args.trace_out:
+                tel.export_chrome_trace(args.trace_out)
+                print(f"  trace written: {args.trace_out} "
+                      f"({len(tel.done_traces())} request span chains; "
+                      "load in Perfetto / chrome://tracing)")
         return
 
     engine = LLMEngine(model, max_len=g["max_len"], page_size=g["page"],

@@ -119,6 +119,7 @@ _LOCK = threading.RLock()
 _ARMED = {}          # name -> FaultSpec
 _SEEN = {}           # name -> lifetime call count (the site catalog)
 _ENV_CACHE = [None]  # last-parsed PADDLE_TPU_FAULTS value
+_FAULT_HOOKS = []    # observers called when a fault FIRES (telemetry)
 
 ENV_VAR = "PADDLE_TPU_FAULTS"
 
@@ -178,16 +179,44 @@ def reset():
         _ENV_CACHE[0] = os.environ.get(ENV_VAR, "")
 
 
+def add_fault_hook(fn):
+    """Register an observer called as fn(point_name, detail) whenever a
+    fault point FIRES (the armed spec decided this call raises). The
+    hook runs before the exception propagates and outside the harness
+    lock; hook errors are swallowed — observability must never change
+    fault semantics. The serving telemetry plane installs one so
+    injected and real faults land in the same request timeline
+    (docs/observability.md). Returns fn for decorator use."""
+    with _LOCK:
+        _FAULT_HOOKS.append(fn)
+    return fn
+
+
+def remove_fault_hook(fn):
+    with _LOCK:
+        try:
+            _FAULT_HOOKS.remove(fn)
+        except ValueError:
+            pass
+
+
 def fault_point(name, detail=None):
     """Declare a fault site. Raises the armed exception when a spec for
     `name` decides this call fires; otherwise ~free. `detail` (e.g. a
-    request uid) rides into the raised InjectedFault."""
+    request uid) rides into the raised InjectedFault. Registered fault
+    hooks (add_fault_hook) observe every firing."""
     with _LOCK:
         _SEEN[name] = _SEEN.get(name, 0) + 1
         _sync_env()
         spec = _ARMED.get(name)
         if spec is None or not spec.should_fire():
             return
+        hooks = list(_FAULT_HOOKS)
+    for h in hooks:
+        try:
+            h(name, detail)
+        except Exception:
+            pass
     raise spec.make_exc(detail)
 
 

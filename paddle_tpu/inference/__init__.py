@@ -378,6 +378,11 @@ _SERVING_EXPORTS = {
     # host/disk tier store
     "PrefixIndex": "prefix_index", "StorePrefixIndex": "prefix_index",
     "KVTierStore": "tiering", "KVTierError": "tiering",
+    # serving telemetry plane (docs/observability.md): per-request
+    # lifecycle tracing, latency histograms, fleet metrics export
+    "Telemetry": "telemetry", "MetricsRegistry": "telemetry",
+    "Histogram": "telemetry", "RequestTrace": "telemetry",
+    "chrome_trace": "telemetry", "export_chrome_trace": "telemetry",
 }
 
 

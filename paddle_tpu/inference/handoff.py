@@ -65,6 +65,14 @@ def _page_crc(arr):
     return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
 
 
+def payload_bytes(payload):
+    """Raw KV bytes a page-image payload carries (every layer's K and V
+    blobs; metadata excluded) — the wire/telemetry size of a handoff,
+    prefix ship, or tier demotion."""
+    return (sum(int(np.asarray(a).nbytes) for a in payload["k"])
+            + sum(int(np.asarray(a).nbytes) for a in payload["v"]))
+
+
 def checksum_payload(payload):
     """Stamp CRC32s over the resume metadata and every layer's K/V page
     blob. Returns the payload (mutated in place) for chaining."""

@@ -170,6 +170,9 @@ class EngineReplica:
         #                                 series (rebuild trigger)
         self._prefix_index = None       # fleet prefix index (re-wired
         #                                 across rebuilds)
+        self.telemetry = None           # per-replica Telemetry — lives
+        #                                 HERE, not on the engine, so
+        #                                 histograms survive a rebuild
 
     # -- traffic -----------------------------------------------------------
     def submit(self, spec):
@@ -229,6 +232,16 @@ class EngineReplica:
             return self.engine._pick_next().uid
         demoted = self.engine._demoted
         return next(iter(demoted)) if demoted else None
+
+    # -- telemetry ------------------------------------------------------------
+    def attach_telemetry(self, tel):
+        """Wire this replica's engine into a Telemetry under the
+        replica name. The Telemetry object (and with it the metrics
+        registry and completed traces) belongs to the REPLICA, so p50/
+        p95/p99 survive engine rebuilds, failover, and hot-swap —
+        rebuild() re-attaches the fresh engine to the same object."""
+        self.telemetry = tel
+        self.engine.attach_telemetry(tel, src=self.name)
 
     # -- fleet prefix index (cache-aware routing) -----------------------------
     def attach_prefix_index(self, index):
@@ -290,7 +303,10 @@ class EngineReplica:
         """Fresh engine from the factory (a quarantine probe's last
         resort when the current engine object is unusable). The fleet
         prefix index is re-wired — and this replica's stale claims
-        dropped, its cache died with the old engine."""
+        dropped, its cache died with the old engine. Telemetry is
+        re-attached too: the registry and completed traces live on
+        this replica, only the dead engine's LIVE traces drop (its uid
+        space restarts)."""
         self.engine = self._factory()
         if self._prefix_index is not None:
             try:
@@ -298,6 +314,8 @@ class EngineReplica:
             except Exception:
                 pass
             self.engine.attach_prefix_index(self._prefix_index, self.name)
+        if self.telemetry is not None:
+            self.engine.attach_telemetry(self.telemetry, src=self.name)
         return self.engine
 
 
@@ -347,7 +365,7 @@ class EngineRouter:
                  probe_backoff=4, probe_retries=1, probe_base_delay=0.01,
                  probe_jitter=0.0, probe_max_elapsed=None, probe_seed=0,
                  probe_sleep=time.sleep, hold_limit=None, topology=None,
-                 prefix_routing=False, prefix_index=None):
+                 prefix_routing=False, prefix_index=None, telemetry=None):
         # topology={"prefill": N, "decode": M}: DISAGGREGATED mode —
         # N prefill workers take every fresh admission, M decode
         # workers receive requests at first-token via KV-page handoff
@@ -402,6 +420,29 @@ class EngineRouter:
             self.prefix_index = prefix_index
             for rep in self._replicas:
                 rep.attach_prefix_index(prefix_index)
+        # telemetry=True (or a telemetry.Telemetry used as the ROUTER-
+        # level source) wires the whole fleet: each replica gets its
+        # OWN Telemetry (registry + traces live on the EngineReplica,
+        # so p50/p95/p99 survive engine rebuilds, failover, hot-swap)
+        # and the router keeps one for fleet-level request traces
+        # (route / requeue / handoff legs). metrics() merges the
+        # per-replica registries into one fleet view;
+        # export_chrome_trace() merges the timelines.
+        self._tel = None
+        self.telemetry = None
+        if telemetry:
+            from .telemetry import Telemetry
+            if isinstance(telemetry, Telemetry):
+                self._tel = telemetry
+                self._tel.name = "router"
+            else:
+                self._tel = Telemetry(name="router")
+            self.telemetry = self._tel
+            for rep in self._replicas:
+                # replica faults already land in the router timeline
+                # via its hook; per-replica hooks would duplicate them
+                rep.attach_telemetry(
+                    Telemetry(name=rep.name, capture_faults=False))
         self._probe_kw = dict(retries=int(probe_retries),
                               base_delay=float(probe_base_delay),
                               jitter=float(probe_jitter),
@@ -452,10 +493,15 @@ class EngineRouter:
         rr = _RouterRequest(self._next_uid, spec["tenant"])
         self._next_uid += 1
         self._reqs[rr.uid] = rr
+        if self._tel is not None:
+            self._tel.req_start("router", rr.uid, prompt_len=ids.size,
+                                max_new=int(max_new_tokens))
         try:
             self._route(rr, spec)
         except Exception:
             del self._reqs[rr.uid]
+            if self._tel is not None:
+                self._tel.drop("router", rr.uid)
             raise
         return rr.uid
 
@@ -605,6 +651,71 @@ class EngineRouter:
                              if self.prefix_index is not None else None),
         }
 
+    # -- telemetry / fleet metrics -----------------------------------------
+    def metrics(self):
+        """ONE fleet metrics view (requires telemetry=): the merged
+        per-replica registries — TTFT/TPOT/queue-wait/block/handoff/
+        restore histograms whose counts survive failover, rebuild, and
+        hot-swap because each registry lives on its EngineReplica — plus
+        per-replica snapshots and the router's own control-plane
+        counters. Each call also rate-samples every reachable replica's
+        health() counters into its registry (the `<counter>_per_s`
+        gauges), so two metrics() calls a scrape interval apart give
+        live rates."""
+        out = {"router": {
+            "steps": self.steps, "failovers": self.failovers,
+            "requeued": self.requeued, "probes": self.probes,
+            "hot_swaps": self.hot_swaps,
+            "swap_rollbacks": self.swap_rollbacks,
+            "kv_handoffs": self.kv_handoffs,
+            "handoff_failures": self.handoff_failures,
+            "held": len(self._held), "pending": len(self.pending()),
+        }}
+        if self._tel is None:
+            out["fleet"] = None
+            out["replicas"] = {}
+            return out
+        from .telemetry import MetricsRegistry
+        regs = []
+        reps_snap = {}
+        for rep in self._replicas:
+            tel = rep.telemetry
+            if tel is None:
+                continue
+            if rep.breaker.state != "open":
+                try:
+                    tel.registry.sample(rep.health())
+                except Exception:
+                    pass                # metrics must never throw
+            regs.append(tel.registry)
+            reps_snap[rep.name] = tel.registry.snapshot()
+        regs.append(self._tel.registry)
+        out["fleet"] = MetricsRegistry.merged(regs).snapshot()
+        out["replicas"] = reps_snap
+        return out
+
+    def prometheus(self, prefix="paddle_tpu"):
+        """Prometheus text exposition of the merged fleet registry."""
+        if self._tel is None:
+            raise ValueError("prometheus() needs EngineRouter("
+                             "telemetry=...) — nothing is collected")
+        from .telemetry import MetricsRegistry
+        regs = [rep.telemetry.registry for rep in self._replicas
+                if rep.telemetry is not None] + [self._tel.registry]
+        return MetricsRegistry.merged(regs).prometheus(prefix)
+
+    def export_chrome_trace(self, path):
+        """Write the FLEET timeline (router legs + every replica's
+        request spans) as one perfetto-loadable chrome-trace JSON —
+        each source is a pid, each request a tid."""
+        if self._tel is None:
+            raise ValueError("export_chrome_trace() needs EngineRouter("
+                             "telemetry=...) — nothing was traced")
+        from .telemetry import export_chrome_trace
+        tels = [self._tel] + [rep.telemetry for rep in self._replicas
+                              if rep.telemetry is not None]
+        return export_chrome_trace(path, tels)
+
     # -- weight hot-swap ---------------------------------------------------
     def save_weights_snapshot(self, path, step=None):
         """Snapshot the fleet's CURRENT weights (from the first
@@ -666,11 +777,18 @@ class EngineRouter:
             for rep in self._replicas:
                 if rep.state == DRAINING and rep.name in drained_here:
                     rep.state = ACTIVE  # operator-drained stay drained
+            if self._tel is not None:
+                self._tel.event("hot_swap_rollback", path=str(path),
+                                error=f"{type(e).__name__}: {e}")
             raise HotSwapError(
                 f"hot swap of {path!r} aborted "
                 f"({type(e).__name__}: {e}); all replicas rolled back "
                 "to the previous weights, serving continued") from e
         self.hot_swaps += 1
+        if self._tel is not None:
+            self._tel.event("hot_swap", path=str(path),
+                            swapped=sum(1 for v in summary.values()
+                                        if v == "swapped"))
         return summary
 
     def drain_replica(self, name):
@@ -801,6 +919,13 @@ class EngineRouter:
             # unreadable host state, failover re-submits THIS spec (work
             # since then is recomputed; delivery stays exactly-once)
             self._specs[rr.uid] = spec
+            if self._tel is not None:
+                # "route" (NOT "seat"): it marks the router-side seat
+                # timestamp for the span chain but must not observe
+                # queue_wait_ms — the engine's own seat already does,
+                # and the fleet merge would double-count
+                self._tel.req_event("router", rr.uid, "route",
+                                    replica=rep.name)
             return True
         if not internal:
             if last_busy is not None and not self._held and \
@@ -819,6 +944,9 @@ class EngineRouter:
         rr.replica, rr.engine_uid = None, None
         rr.state = QUEUED
         self._held.append(rr.uid)
+        if self._tel is not None:
+            self._tel.req_event("router", rr.uid, "hold",
+                                held=len(self._held))
         return False
 
     # -- cache-aware routing (fleet prefix index) ----------------------------
@@ -922,6 +1050,17 @@ class EngineRouter:
         else:
             rr.state, rr.result = DONE, result
         self._specs.pop(ruid, None)
+        if self._tel is not None:
+            # "delivered"/"failed_delivery" rather than the engines'
+            # "done"/"failed": the ENGINE's req_done already counted
+            # requests_done/requests_failed on its replica registry —
+            # reusing those state strings here would double-count every
+            # outcome in the merged fleet counters
+            self._tel.req_done("router", ruid,
+                               "delivered" if failure is None
+                               else "failed_delivery",
+                               stage=(failure.stage
+                                      if failure is not None else None))
         return True
 
     def _collect(self, rep):
@@ -1000,6 +1139,13 @@ class EngineRouter:
             return
         rr.requeues += 1
         self.requeued += 1
+        if self._tel is not None:
+            # the failover leg in the request's fleet timeline: its
+            # engine-side trace on `rep` ended (cancelled); the
+            # continuation re-prefills elsewhere byte-identically
+            self._tel.req_event("router", ruid, "requeue",
+                                from_replica=rep.name,
+                                requeues=rr.requeues)
         self._route(rr, self._clean_spec(salvage), exclude=(rep.name,),
                     internal=True)
 
@@ -1013,6 +1159,10 @@ class EngineRouter:
         through quarantine probes instead."""
         rep.kills += 1
         self.failovers += 1
+        if self._tel is not None:
+            self._tel.event("replica_failure", replica=rep.name,
+                            error=f"{type(exc).__name__}: {exc}",
+                            assigned=len(self._assigned[rep.name]))
         if self.prefix_index is not None:
             # stale index claims would keep routing traffic (and ships)
             # at a dead cache; the replica re-publishes as it re-serves
@@ -1027,10 +1177,13 @@ class EngineRouter:
     @staticmethod
     def _clean_spec(spec):
         """export_request payload -> submit_resume payload (drop the
-        source engine's bookkeeping keys)."""
+        source engine's bookkeeping keys; "generated" rides along so
+        the target engine knows a continuation is RESUMED — its first
+        local token is not the request's TTFT)."""
         return {k: spec[k] for k in
                 ("prompt", "max_new_tokens", "eos_token_id", "tenant",
-                 "priority", "ttl_steps", "deadline") if k in spec}
+                 "priority", "ttl_steps", "deadline", "generated")
+                if k in spec}
 
     def _migrate_running(self, rep):
         """Hot-swap/drain helper: move a DRAINING replica's admitted
@@ -1147,6 +1300,13 @@ class EngineRouter:
             self.kv_handoffs += 1
             return True
         self.kv_handoffs += 1
+        if self._tel is not None:
+            # handoff_ms itself is observed by the SOURCE engine's
+            # telemetry (kv_export -> migrated pairing); the router
+            # trace records the fleet-level leg
+            self._tel.req_event("router", ruid, "handoff",
+                                from_replica=rep.name,
+                                to_replica=tgt.name)
         return True
 
     def _fail_stuck_head(self, rep, exc):

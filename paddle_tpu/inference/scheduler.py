@@ -36,6 +36,7 @@ prefill would (on CPU/f32 bitwise so — the greedy-equivalence tests
 assert byte identity with generate()).
 """
 import collections
+import contextlib
 import math
 import time
 
@@ -45,11 +46,26 @@ import jax.numpy as jnp
 
 from ..failsafe import InjectedFault, fault_point
 from ..failsafe import armed as _faults_armed
+from ..profiler import RecordEvent as _RecordEvent
+from ..profiler import spans_active as _spans_active
+from .serving import LLMEngine, EngineFullError, _rms, _mm
+from .speculative import resolve_drafter
+
 from ..ops.pallas.paged_attention import (expand_kv_heads, paged_attention,
                                           ragged_paged_attention,
                                           spec_verify_attention)
-from .serving import LLMEngine, EngineFullError, _rms, _mm
-from .speculative import resolve_drafter
+
+_NULL_SPAN = contextlib.nullcontext()
+
+
+def _prof_span(name):
+    """profiler.RecordEvent around a compiled dispatch while a Profiler
+    is RECORDING (profiler.spans_active()); a shared no-op context
+    otherwise — one function call + one global read per dispatch when
+    profiling is off. The spans lower to jax.profiler.TraceAnnotation,
+    so they render next to the XPlane device trace in Perfetto
+    (docs/observability.md "Profiler integration")."""
+    return _RecordEvent(name) if _spans_active() else _NULL_SPAN
 
 QUEUED, PREFILL, DECODE, DONE, FAILED, CANCELLED = \
     "queued", "prefill", "decode", "done", "failed", "cancelled"
@@ -491,9 +507,26 @@ class ContinuousBatchingEngine(LLMEngine):
                  megakernel=None, speculate=None, drafter="ngram",
                  spec_adaptive=True, tenants=None, kv_tier=None,
                  tier_dir=None, tier_host_cap_mb=None, oversubscribe=None,
-                 **kw):
+                 telemetry=None, **kw):
         super().__init__(model, max_len=max_len, page_size=page_size,
                          max_batch=max_batch, **kw)
+        # telemetry=: a telemetry.Telemetry instance (or True to build
+        # one) threaded through every lifecycle transition — per-request
+        # spans (submit/seat/TTFT/blocks/spec passes/demote/handoff/
+        # retire), latency histograms, chrome-trace + Prometheus + JSONL
+        # exports. None (default) keeps a single-branch fast path at
+        # every site; greedy outputs are byte-identical on vs off
+        # (pinned in tests and in-bench). All timestamps are captured
+        # at host points the engine already visits — zero extra device
+        # syncs. See docs/observability.md.
+        self._tel = None
+        self._tel_src = "engine"
+        self.telemetry = None
+        if telemetry is True:
+            from .telemetry import Telemetry
+            telemetry = Telemetry()
+        if telemetry is not None and telemetry is not False:
+            self.attach_telemetry(telemetry)
         self.prefill_chunk = int(prefill_chunk or page_size)
         # speculate=T (>= 2): speculative decoding — every decode scan
         # step becomes a VERIFY PASS over T feed tokens (the pending
@@ -739,6 +772,9 @@ class ContinuousBatchingEngine(LLMEngine):
         self._next_uid += 1
         self._requests[r.uid] = r
         self._queue.append(r)
+        if self._tel is not None:
+            self._tel.req_start(self._tel_src, r.uid, prompt_len=r.t0,
+                                max_new=r.max_new_tokens)
         return r.uid
 
     def cancel(self, uid):
@@ -760,6 +796,21 @@ class ContinuousBatchingEngine(LLMEngine):
         return True
 
     def step(self):
+        """One engine iteration (see _step_impl for the scheduling
+        model). With telemetry attached, the whole iteration's wall
+        time lands in the `block_ms` histogram — this wrapper IS the
+        block-boundary host point, so the measurement costs two
+        monotonic reads and nothing on the telemetry=None fast path
+        (a single branch)."""
+        if self._tel is None:
+            return self._step_impl()
+        t0 = time.monotonic()
+        moved = self._step_impl()
+        if moved:
+            self._tel.block((time.monotonic() - t0) * 1e3)
+        return moved
+
+    def _step_impl(self):
         """One engine iteration. Returns False when there is nothing to
         do.
 
@@ -995,6 +1046,61 @@ class ContinuousBatchingEngine(LLMEngine):
                                    if s is not None})},
         }
 
+    def probe_device_step_seconds(self, iters=30):
+        """BLOCK-UNTIL-READY-sampled bare compiled decode-step time at
+        full slot width — the honest device-side denominator for host-
+        overhead attribution. `dispatch_seconds` accrues DISPATCH wall
+        (host call machinery included) and so overstates device
+        busyness; this probe queues `iters` compiled steps back-to-back
+        and blocks ONCE, so the per-call host cost amortizes away and
+        what remains is device compute (decode_bench's
+        host_overhead_frac is 1 - steps * this / wall — previously the
+        bench carried this math privately).
+
+        The probe dispatches REAL steps: it writes garbage KV into the
+        probe rows' page-0 slots and therefore (a) requires an IDLE
+        engine (raises RuntimeError otherwise) and (b) drops the prefix
+        cache afterwards — cached pages may alias the clobbered slots.
+        """
+        if any(s is not None for s in self._slots) or self._queue \
+                or self._demoted:
+            raise RuntimeError(
+                "probe_device_step_seconds needs an idle engine: the "
+                "probe dispatches real decode steps that clobber page-0 "
+                "KV slots (drain in-flight requests first)")
+        w = self.max_batch
+        fn = self._cb_step_fns.get(w)
+        if fn is None:
+            fn = self._build_cb_step(w)
+            self._cb_step_fns[w] = fn
+        kp, vp = self.k_pages, self.v_pages
+        tok = jnp.asarray(np.zeros(w, np.int64))
+        tab = jnp.asarray(self._tables_np[:w])
+        lens = jnp.asarray(np.zeros(w, np.int32))
+        act = jnp.asarray(np.ones(w, bool))
+        logits, kp, vp = fn(self.weights, tok, kp, vp, tab, lens, act)
+        jax.block_until_ready(logits)          # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(max(1, int(iters))):
+            logits, kp, vp = fn(self.weights, tok, kp, vp, tab, lens,
+                                act)
+        jax.block_until_ready(logits)
+        t = (time.perf_counter() - t0) / max(1, int(iters))
+        self.k_pages, self.v_pages = kp, vp    # donated buffers moved
+        if self._prefix is not None:
+            self._prefix.clear(self.allocator)
+        return t
+
+    def device_busy_frac(self, wall_seconds, n_steps, t_step=None):
+        """Fraction of `wall_seconds` the device was genuinely busy
+        running `n_steps` decode steps, derived from the block-until-
+        ready probe (pass `t_step` to reuse a measurement). The
+        complement is decode_bench's host_overhead_frac."""
+        if t_step is None:
+            t_step = self.probe_device_step_seconds()
+        return min(1.0, max(0.0, n_steps * t_step
+                            / max(wall_seconds, 1e-9)))
+
     def generate(self, *args, **kw):
         """Inherited static-batch generate(). With native stacked pools
         (megakernel="multi") the base engine's prefill/step programs
@@ -1116,6 +1222,9 @@ class ContinuousBatchingEngine(LLMEngine):
         greedy continuations are byte-identical to an uninterrupted
         run. `result()` still returns [original prompt + all generated
         tokens]."""
+        if self._tel is not None:
+            self._tel.req_event(self._tel_src, r.uid, "preempt",
+                                folded=len(r.out))
         self._release_slot(r)
         if r.out:
             r.ids = np.concatenate([r.ids, np.asarray(r.out, np.int64)])
@@ -1209,6 +1318,9 @@ class ContinuousBatchingEngine(LLMEngine):
             self._tables_np[slot, :len(pages)] = pages
             self._lens_np[slot] = 0
             self.admissions += 1
+            if self._tel is not None:
+                self._tel.req_event(self._tel_src, r.uid, "seat",
+                                    slot=slot, shared_pages=n_shared)
             if self._slot_used[slot]:
                 self.slot_reuses += 1
             self._slot_used[slot] = True
@@ -1335,11 +1447,18 @@ class ContinuousBatchingEngine(LLMEngine):
         if self._cb_prefill_fn is None:
             self._cb_prefill_fn = self._build_cb_prefill(chunk)
         t_dev = time.perf_counter()
-        logits, self.k_pages, self.v_pages = self._cb_prefill_fn(
-            self.weights, jnp.asarray(ids_chunk), self.k_pages,
-            self.v_pages, jnp.asarray(self._tables_np[r.slot:r.slot + 1]),
-            jnp.int32(start), jnp.int32(r.t0))
-        self.device_seconds += time.perf_counter() - t_dev
+        with _prof_span("cb.prefill_chunk"):
+            logits, self.k_pages, self.v_pages = self._cb_prefill_fn(
+                self.weights, jnp.asarray(ids_chunk), self.k_pages,
+                self.v_pages,
+                jnp.asarray(self._tables_np[r.slot:r.slot + 1]),
+                jnp.int32(start), jnp.int32(r.t0))
+        dt = time.perf_counter() - t_dev
+        self.dispatch_seconds += dt
+        if self._tel is not None:
+            self._tel.observe("prefill_chunk_ms", dt * 1e3)
+            self._tel.req_event(self._tel_src, r.uid, "prefill_chunk",
+                                filled=end)
         r.filled = end
         if end < r.t0:
             return
@@ -1349,7 +1468,7 @@ class ContinuousBatchingEngine(LLMEngine):
         self._publish_prefix(r)
         t_dev = time.perf_counter()
         tok = self._sample_tokens(logits)[0]
-        self.device_seconds += time.perf_counter() - t_dev
+        self.dispatch_seconds += time.perf_counter() - t_dev
         self._lens_np[r.slot] = r.t0
         r.state = DECODE
         self._push_token(r, tok)
@@ -1404,6 +1523,23 @@ class ContinuousBatchingEngine(LLMEngine):
         except Exception:
             self.index_publish_errors += 1
 
+    # -- telemetry (inference/telemetry.py) ----------------------------------
+    def attach_telemetry(self, tel, src=None):
+        """Wire this engine into a Telemetry object under source name
+        `src` (defaults to the telemetry's own name; the router passes
+        the replica name so fleet traces stay attributable). Request
+        traces are keyed (src, uid) — an engine REBUILD under the same
+        src must re-attach, which drops the dead engine's live traces
+        (its uid space restarts). Detach with attach_telemetry(None)."""
+        if tel is None:
+            self._tel = None
+            self.telemetry = None
+            return self
+        self._tel = tel
+        self.telemetry = tel
+        self._tel_src = src or getattr(tel, "name", None) or "engine"
+        tel.reset_live(self._tel_src)
+        return self
 
     # -- decode ------------------------------------------------------------
     def _resolve_megakernel(self, val):
@@ -1858,12 +1994,13 @@ class ContinuousBatchingEngine(LLMEngine):
             fn = self._build_cb_step(w)
             self._cb_step_fns[w] = fn
         t_dev = time.perf_counter()
-        logits, self.k_pages, self.v_pages = fn(
-            self.weights, jnp.asarray(self._tok_np[:w]), self.k_pages,
-            self.v_pages, jnp.asarray(self._tables_np[:w]),
-            jnp.asarray(self._lens_np[:w]), jnp.asarray(active))
-        toks = self._sample_tokens(logits)
-        self.device_seconds += time.perf_counter() - t_dev
+        with _prof_span("cb.decode_step"):
+            logits, self.k_pages, self.v_pages = fn(
+                self.weights, jnp.asarray(self._tok_np[:w]), self.k_pages,
+                self.v_pages, jnp.asarray(self._tables_np[:w]),
+                jnp.asarray(self._lens_np[:w]), jnp.asarray(active))
+            toks = self._sample_tokens(logits)
+        self.dispatch_seconds += time.perf_counter() - t_dev
         for r in decodes:
             self._lens_np[r.slot] += 1
             self._push_token(r, toks[r.slot])
@@ -2224,8 +2361,10 @@ class ContinuousBatchingEngine(LLMEngine):
                 want = min(r.draft_k, T - 1)
                 cont = np.empty((0,), np.int64)
                 if want > 0:
+                    t_draft = (time.monotonic()
+                               if self._tel is not None else None)
                     try:
-                        cont = np.asarray(self._drafter.propose(
+                        cont = np.asarray(self._drafter.timed_propose(
                             np.concatenate(
                                 [r.ids, np.asarray(r.out, np.int64)]),
                             K * (want + 1)), np.int64).ravel()
@@ -2235,6 +2374,10 @@ class ContinuousBatchingEngine(LLMEngine):
                         # emits the target's token regardless)
                         self.draft_errors += 1
                         cont = np.empty((0,), np.int64)
+                    if t_draft is not None:
+                        self._tel.observe(
+                            "draft_ms",
+                            (time.monotonic() - t_draft) * 1e3)
                 # a fully-accepted pass emits want drafts + the bonus
                 # token, so consecutive passes stride want+1 through the
                 # continuation — striding by T instead would misalign
@@ -2283,16 +2426,18 @@ class ContinuousBatchingEngine(LLMEngine):
         t_dev = time.perf_counter()
         spec_args = ((jnp.asarray(drafts_np), jnp.asarray(dlen_np))
                      if T else ())
-        (blk.first, blk.toks, blk.emitted, blk.tok_fin, blk.lens_fin,
-         blk.act_fin, blk.rem_fin, self._key, self.k_pages,
-         self.v_pages) = fn(
-            self.weights, self.k_pages, self.v_pages, blk.tables,
-            jnp.asarray(pf_ids), jnp.asarray(pf_act),
-            jnp.asarray(pf_start), jnp.asarray(pf_end),
-            jnp.asarray(self._tok_np[:w]), jnp.asarray(self._lens_np[:w]),
-            jnp.asarray(act), jnp.asarray(rem), blk.eos_dev, self._key,
-            *spec_args)
-        self.device_seconds += time.perf_counter() - t_dev
+        with _prof_span("cb.block"):
+            (blk.first, blk.toks, blk.emitted, blk.tok_fin, blk.lens_fin,
+             blk.act_fin, blk.rem_fin, self._key, self.k_pages,
+             self.v_pages) = fn(
+                self.weights, self.k_pages, self.v_pages, blk.tables,
+                jnp.asarray(pf_ids), jnp.asarray(pf_act),
+                jnp.asarray(pf_start), jnp.asarray(pf_end),
+                jnp.asarray(self._tok_np[:w]),
+                jnp.asarray(self._lens_np[:w]),
+                jnp.asarray(act), jnp.asarray(rem), blk.eos_dev,
+                self._key, *spec_args)
+        self.dispatch_seconds += time.perf_counter() - t_dev
         self.fused_blocks += 1
         # steps advance by the block's DEVICE micro-steps so TTL budgets
         # stay comparable with the per-step engine (expiry itself is
@@ -2360,12 +2505,13 @@ class ContinuousBatchingEngine(LLMEngine):
                      jnp.asarray(np.zeros(w, np.int32)),
                      jnp.asarray(np.zeros(w, np.int32)))
             self._pf_dummies[w] = dummy
-        (nxt.first, nxt.toks, nxt.emitted, nxt.tok_fin, nxt.lens_fin,
-         nxt.act_fin, nxt.rem_fin, self._key, self.k_pages,
-         self.v_pages) = fn(
-            self.weights, self.k_pages, self.v_pages, blk.tables,
-            *dummy, blk.tok_fin, blk.lens_fin, blk.act_fin, blk.rem_fin,
-            blk.eos_dev, self._key)
+        with _prof_span("cb.block_chain"):
+            (nxt.first, nxt.toks, nxt.emitted, nxt.tok_fin, nxt.lens_fin,
+             nxt.act_fin, nxt.rem_fin, self._key, self.k_pages,
+             self.v_pages) = fn(
+                self.weights, self.k_pages, self.v_pages, blk.tables,
+                *dummy, blk.tok_fin, blk.lens_fin, blk.act_fin,
+                blk.rem_fin, blk.eos_dev, self._key)
         self.fused_blocks += 1
         self.chained_blocks += 1
         self.steps += blk.K
@@ -2383,11 +2529,14 @@ class ContinuousBatchingEngine(LLMEngine):
         if blk.has_decode:
             toks = np.asarray(blk.toks)
             emitted = np.asarray(blk.emitted)
-        self.device_seconds += time.perf_counter() - t_dev
+        self.dispatch_seconds += time.perf_counter() - t_dev
         for r, end in blk.pf_items:
             if r.state != PREFILL or r.slot is None:
                 continue               # cancelled while in flight
             r.filled = end
+            if self._tel is not None:
+                self._tel.req_event(self._tel_src, r.uid,
+                                    "prefill_chunk", filled=end)
             if end >= r.t0:
                 # prompt complete: publish pages, then its first token
                 # (sampled ON DEVICE from the final chunk's logits)
@@ -2425,6 +2574,11 @@ class ContinuousBatchingEngine(LLMEngine):
                     self.spec_accepted_total += accepted
                     r.spec_drafted += offered
                     r.spec_accepted += accepted
+                    if self._tel is not None:
+                        self._tel.req_event(
+                            self._tel_src, r.uid, "spec_pass",
+                            offered=offered, accepted=accepted,
+                            emitted=n)
                     if self.spec_adaptive and offered:
                         # shrink fast on a complete miss, grow on a
                         # clean sweep; the window [1, T-1] keeps at
@@ -2464,6 +2618,11 @@ class ContinuousBatchingEngine(LLMEngine):
         tok = int(tok)
         r.out.append(tok)
         r.tok = tok
+        if self._tel is not None and len(r.out) == 1:
+            # the TTFT host point: the first generated token became
+            # visible to the host (an imported continuation arrives
+            # with tokens already committed, so this never re-fires)
+            self._tel.req_first_token(self._tel_src, r.uid)
         # fair-share accounting: 1/share virtual time per emitted token,
         # so a speculating tenant's higher per-pass yield is charged
         # exactly like plain decode
@@ -2531,11 +2690,22 @@ class ContinuousBatchingEngine(LLMEngine):
             # same outcome the original engine would have reached)
             deadline_ms = max(
                 0.0, (spec["deadline"] - time.monotonic()) * 1e3)
-        return self.add_request(
+        uid = self.add_request(
             spec["prompt"], max_new_tokens=spec["max_new_tokens"],
             eos_token_id=spec["eos_token_id"], deadline_ms=deadline_ms,
             ttl_steps=spec["ttl_steps"], tenant=spec["tenant"],
             priority=spec["priority"])
+        gen = int(spec.get("generated") or 0)
+        if gen and self._tel is not None:
+            # a resumed continuation: the folded prompt already holds
+            # `gen` committed tokens, so the first token THIS engine
+            # emits is not the request's TTFT (that was observed where
+            # the original first token appeared) — the marker makes
+            # req_first_token keep the span timestamp but skip the
+            # ttft_ms observation, so fleet counts stay == retired
+            self._tel.req_event(self._tel_src, uid, "resume",
+                                committed=gen)
+        return uid
 
     # -- KV-page handoff (disaggregated prefill/decode) ----------------------
     def _kv_geometry(self):
@@ -2610,6 +2780,9 @@ class ContinuousBatchingEngine(LLMEngine):
                 0.0, (spec["deadline"] - time.monotonic()) * 1e3)
             spec["deadline"] = None
         self._handoffs_out[uid] = token
+        if self._tel is not None:
+            self._tel.req_event(self._tel_src, uid, "kv_export",
+                                pages=len(used))
         return self._package_pages(token, spec, lens, used)
 
     def abort_handoff(self, uid):
@@ -2647,6 +2820,11 @@ class ContinuousBatchingEngine(LLMEngine):
         r.state = MIGRATED
         self._release_slot(r)
         self.handoffs_out += 1
+        if self._tel is not None:
+            # "migrated" pairs with "kv_export" -> handoff_ms histogram
+            self._tel.req_event(self._tel_src, uid, "migrated")
+            self._tel.req_done(self._tel_src, uid, MIGRATED,
+                               n_tokens=len(r.out))
 
     def import_kv_pages(self, payload):
         """Admit an export_kv_pages payload into THIS engine: CRC +
@@ -2773,6 +2951,12 @@ class ContinuousBatchingEngine(LLMEngine):
             raise
         self.admissions += 1
         self.handoffs_in += 1
+        if self._tel is not None:
+            self._tel.req_start(self._tel_src, r.uid, prompt_len=t0,
+                                max_new=remaining)
+            self._tel.req_event(self._tel_src, r.uid, "import_seat",
+                                slot=slot, lens=lens,
+                                committed_tokens=gen)
         if self._slot_used[slot]:
             self.slot_reuses += 1
         self._slot_used[slot] = True
@@ -2854,6 +3038,9 @@ class ContinuousBatchingEngine(LLMEngine):
         self._demoted[uid] = r
         self.demotions += 1
         self.pages_demoted += n_total - len(kept)
+        if self._tel is not None:
+            self._tel.req_event(self._tel_src, uid, "demote",
+                                pages=n_total - len(kept))
         return token
 
     def restore_request(self, uid):
@@ -2964,6 +3151,10 @@ class ContinuousBatchingEngine(LLMEngine):
         self.pages_demoted -= n_fresh
         r.demote = None
         self.restores += 1
+        if self._tel is not None:
+            # pairs with the "demote" event -> restore_ms histogram
+            self._tel.req_event(self._tel_src, uid, "restore",
+                                pages=n_fresh)
         return True
 
     def _drop_demoted(self, r):
@@ -3223,12 +3414,19 @@ class ContinuousBatchingEngine(LLMEngine):
         r.state = state
         self._release_slot(r)
         self.failure_count += 1
+        if self._tel is not None:
+            self._tel.req_done(self._tel_src, r.uid, state,
+                               n_tokens=len(r.out), stage=stage,
+                               error=type(exc).__name__)
 
     def _retire(self, r):
         r.result = np.concatenate([r.ids,
                                    np.asarray(r.out, np.int64)])
         r.state = DONE
         self._release_slot(r)
+        if self._tel is not None:
+            self._tel.req_done(self._tel_src, r.uid, DONE,
+                               n_tokens=len(r.out))
 
     def _abort_in_flight(self):
         """A donated-buffer call died mid-flight: the pools are gone and
@@ -3243,6 +3441,7 @@ class ContinuousBatchingEngine(LLMEngine):
         KV AND the content-addressed cache — the fresh allocator will
         re-issue the cached page ids, so stale entries would alias other
         requests' pages."""
+        tel = getattr(self, "_tel", None)
         for uid, r in list(getattr(self, "_demoted", {}).items()):
             # the pool rebuild killed the kept shared pages too; the
             # tier bytes alone cannot re-seat (their shared-page table
@@ -3260,6 +3459,9 @@ class ContinuousBatchingEngine(LLMEngine):
                     getattr(self, "steps", 0),
                     tokens_generated=len(r.out))
             self.failure_count += 1
+            if tel is not None:
+                tel.req_done(self._tel_src, r.uid, FAILED,
+                             n_tokens=len(r.out), stage="engine")
         for i, r in enumerate(getattr(self, "_slots", [])):
             if r is not None:
                 r.state = FAILED
@@ -3271,6 +3473,9 @@ class ContinuousBatchingEngine(LLMEngine):
                         getattr(self, "steps", 0),
                         tokens_generated=len(r.out))
                 self.failure_count += 1
+                if tel is not None:
+                    tel.req_done(self._tel_src, r.uid, FAILED,
+                                 n_tokens=len(r.out), stage="engine")
                 r.pages = []          # pool is being rebuilt: page ids
                 r.cow_reserve = None  # are meaningless, nothing to free
                 r.shared_idx = set()

@@ -39,6 +39,8 @@ that position and every earlier draft was accepted. For deterministic
 (delta-distribution) drafters this is exactly the rejection-sampling
 rule, so sampled-mode outputs keep the target model's distribution.
 """
+import time
+
 import numpy as np
 
 
@@ -55,6 +57,22 @@ class Drafter:
 
     def propose(self, ctx, k):
         raise NotImplementedError
+
+    def timed_propose(self, ctx, k):
+        """propose() with self-accounting: `proposals` / `propose_seconds`
+        accumulate on the instance (lazily, so subclasses that skip
+        super().__init__ still work). The engine calls THIS — the
+        drafter is host work on the block's critical path (the PR 12
+        NGramDrafter max_ctx bound exists for exactly that reason), so
+        its wall cost must be attributable: the telemetry plane's
+        `draft_ms` histogram and these counters are the two views."""
+        t0 = time.perf_counter()
+        try:
+            return self.propose(ctx, k)
+        finally:
+            self.proposals = getattr(self, "proposals", 0) + 1
+            self.propose_seconds = (getattr(self, "propose_seconds", 0.0)
+                                    + time.perf_counter() - t0)
 
     def __repr__(self):
         return f"{type(self).__name__}()"

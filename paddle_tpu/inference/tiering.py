@@ -25,6 +25,7 @@ contract).
 """
 import collections
 import os
+import time
 
 from .handoff import StoreKVTransport, verify_payload
 
@@ -83,6 +84,11 @@ class KVTierStore:
         self.disk_reads = 0      # restores served from disk
         self.puts = 0
         self.gets = 0
+        # wall accounting (the telemetry plane's restore_ms histogram
+        # measures demote->restore END TO END; these split out how much
+        # of it the tier store itself spent packing/verifying/spilling)
+        self.put_seconds = 0.0
+        self.get_seconds = 0.0
 
     def __contains__(self, token):
         return token in self._host or (
@@ -105,6 +111,7 @@ class KVTierStore:
         """Store a checksum_payload-stamped page image under `token`.
         The payload is PACKED immediately (one contiguous blob), so the
         tier never aliases live pool arrays."""
+        t0 = time.perf_counter()
         manifest, blob = StoreKVTransport._pack(payload)
         self._host[token] = (manifest, blob)
         self.host_bytes += len(blob)
@@ -112,6 +119,7 @@ class KVTierStore:
         if self.kind == "disk":
             while self.host_bytes > self.host_cap and len(self._host) > 1:
                 self._spill_oldest()
+        self.put_seconds += time.perf_counter() - t0
 
     def _spill_oldest(self):
         token, (manifest, blob) = self._host.popitem(last=False)
@@ -129,6 +137,7 @@ class KVTierStore:
         """Unpack + CRC-verify the entry; KVHandoffError on corruption,
         KVTierError when the entry does not exist (already restored, or
         a tier that lost data)."""
+        t0 = time.perf_counter()
         ent = self._host.get(token)
         if ent is None and self.dir is not None:
             try:
@@ -146,7 +155,9 @@ class KVTierStore:
                 f"tier entry {token!r} not found (already restored, or "
                 "the tier lost it)")
         self.gets += 1
-        return verify_payload(StoreKVTransport._unpack(*ent))
+        out = verify_payload(StoreKVTransport._unpack(*ent))
+        self.get_seconds += time.perf_counter() - t0
+        return out
 
     def delete(self, token):
         """Best-effort removal (restore committed, or request died)."""
@@ -165,4 +176,6 @@ class KVTierStore:
                 "host_entries": len(self._host),
                 "host_bytes": self.host_bytes,
                 "spills": self.spills, "disk_reads": self.disk_reads,
-                "puts": self.puts, "gets": self.gets}
+                "puts": self.puts, "gets": self.gets,
+                "put_ms": round(self.put_seconds * 1e3, 3),
+                "get_ms": round(self.get_seconds * 1e3, 3)}

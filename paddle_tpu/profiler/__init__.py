@@ -6,6 +6,7 @@ RecordEvent spans, chrome-trace export), timer.py benchmark.
 TPU-native backing: jax.profiler (XPlane/perfetto traces + TraceAnnotation
 spans) replaces the reference's CUPTI tracer (SURVEY §5.1).
 """
+import collections
 import contextlib
 import json
 import os
@@ -54,9 +55,25 @@ def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
 
 
 def export_chrome_tracing(dir_name, worker_name=None):
+    """on_trace_ready factory: write the span timeline as chrome-trace
+    JSON into dir_name/<worker>.json when the profiler stops. (Bit-rot
+    fix: this used to only record the directory on the profiler object
+    and nothing ever consumed it — the export path had no consumer
+    until the serving telemetry plane landed.)"""
     def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
         prof._export_dir = dir_name
+        prof.export(os.path.join(dir_name, f"{name}.json"))
     return handler
+
+
+def spans_active():
+    """True while a Profiler is RECORDING (its statistics collector is
+    live). The engine's dispatch sites gate their RecordEvent spans on
+    this — one cheap check, zero per-dispatch cost when no profiler is
+    attached."""
+    return _statistic._collector() is not None
 
 
 class RecordEvent:
@@ -79,13 +96,18 @@ class RecordEvent:
 
     def begin(self):
         self.begin_ts = time.perf_counter()
-        self._ann = jax.profiler.TraceAnnotation(self.name)
-        self._ann.__enter__()
+        try:
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None    # span timing still records host-side
 
     def end(self):
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
             self._ann = None
+        if self.begin_ts is None:
+            return              # end() without begin(): nothing to record
         self.end_ts = time.perf_counter()
         _EVENTS.append((self.name, self.begin_ts, self.end_ts))
         c = _statistic._collector()
@@ -93,7 +115,10 @@ class RecordEvent:
             c.record_span(self.name, self.begin_ts, self.end_ts)
 
 
-_EVENTS = []
+# span timeline consumed by Profiler.export — BOUNDED (a serving loop
+# emits one span per dispatch; an unbounded list was a leak the moment
+# the export path gained a consumer)
+_EVENTS = collections.deque(maxlen=16384)
 
 
 class Profiler:
@@ -131,6 +156,13 @@ class Profiler:
 
     def start(self):
         self._state = self._scheduler(self._step)
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            # fresh session: the exported span timeline must hold THIS
+            # session's spans, not a previous profiler's (the global
+            # buffer outlives profiler objects; before the export path
+            # had a consumer the stale carryover was invisible)
+            _EVENTS.clear()
         if self._state in (ProfilerState.RECORD,
                            ProfilerState.RECORD_AND_RETURN) \
                 and not self._timer_only and not self._active:
@@ -196,11 +228,17 @@ class Profiler:
         return out
 
     def export(self, path, format="json"):
+        """Chrome-trace JSON of the RecordEvent span timeline —
+        loadable in Perfetto / chrome://tracing next to the XPlane
+        device trace jax.profiler wrote under the logdir."""
         events = [{"name": n, "ph": "X", "ts": b * 1e6,
-                   "dur": (e - b) * 1e6, "pid": 0, "tid": 0}
-                  for n, b, e in _EVENTS]
+                   "dur": max(0.0, (e - b) * 1e6), "pid": 0, "tid": 0}
+                  for n, b, e in _EVENTS
+                  if b is not None and e is not None]
         with open(path, "w") as f:
-            json.dump({"traceEvents": events}, f)
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        return path
 
 
 def load_profiler_result(path):

@@ -1118,6 +1118,150 @@ def main():
                "unit": "ms",
                "error": f"{type(e).__name__}: {e}"})
 
+    # -- process-backed fleet (ISSUE 14, docs/serving.md "Multi-host
+    # fleets") ------------------------------------------------------------
+    # Two numbers: cb_fleet — a REAL 2-process fleet's tokens/s behind
+    # one router vs the in-process 2-replica baseline on byte-identical
+    # engines (fleet_rpc_overhead_frac = what the RPC plane + store
+    # ledger cost; CPU loopback here is the protocol floor, a pod pays
+    # network instead), with the outputs asserted byte-identical
+    # in-bench; and handoff_device_vs_store_ms — one KV-page
+    # export→import on the negotiated DEVICE path (no host bounce, no
+    # page CRC walk) vs the chunked StoreKVTransport (the cross-process
+    # path), same request. Own rc=0 guard; an environment that cannot
+    # spawn (no mp, sandboxed fork) emits an error-tagged skip line.
+    try:
+        from paddle_tpu.distributed.store import TCPStore
+        from paddle_tpu.inference.fleet import (build_engine_from_spec,
+                                                spawn_fleet)
+        from paddle_tpu.inference.handoff import StoreKVTransport
+        from paddle_tpu.inference.router import EngineRouter
+
+        fleet_spec = {
+            "model": {"preset": "config", "seed": 0, "vocab_size": 256,
+                      "hidden_size": 64, "intermediate_size": 128,
+                      "num_hidden_layers": 1, "num_attention_heads": 2,
+                      "max_position_embeddings": 128},
+            "engine": {"max_len": 64, "page_size": 16, "max_batch": 4,
+                       "slot_buckets": [4]},
+        }
+        fl_rng = np.random.RandomState(31)
+        fl_prompts = [fl_rng.randint(0, 256, int(t)).astype(np.int64)
+                      for t in fl_rng.randint(6, 16, 8)]
+        fl_new = 16
+
+        def _drive(router, uids):
+            t0 = time.perf_counter()
+            while router.pending():
+                router.step()
+            wall = time.perf_counter() - t0
+            toks = sum(router.result(u).size for u in uids) \
+                - sum(p.size for p in fl_prompts)
+            assert router.health()["failed"] == 0
+            return toks / max(wall, 1e-9), \
+                [router.result(u) for u in uids]
+
+        # in-process 2-replica baseline (same spec -> same weights)
+        base = EngineRouter(lambda: build_engine_from_spec(fleet_spec),
+                            replicas=2)
+        for rep in base._replicas:      # compile outside the timing
+            rep.engine.generate_many([fl_prompts[0]], max_new_tokens=2)
+        b_uids = [base.add_request(p, max_new_tokens=fl_new)
+                  for p in fl_prompts]
+        base_tps, base_out = _drive(base, b_uids)
+
+        handle = spawn_fleet(fleet_spec, 2)
+        try:
+            fr = EngineRouter(backends=handle.replicas,
+                              prefix_index=handle.prefix_index)
+            # compile each worker outside the timing (one tiny request)
+            warm = [fr.add_request((p + 1) % 256, max_new_tokens=2)
+                    for p in fl_prompts[:2]]
+            while fr.pending():
+                fr.step()
+            for u in warm:
+                fr.result(u)
+            f_uids = [fr.add_request(p, max_new_tokens=fl_new)
+                      for p in fl_prompts]
+            fleet_tps, fleet_out = _drive(fr, f_uids)
+            for a, b in zip(base_out, fleet_out):
+                assert np.array_equal(a, b), (
+                    "2-process fleet diverged from the in-process "
+                    "2-replica baseline")
+        finally:
+            handle.shutdown()
+        _emit({
+            "metric": "cb_fleet",
+            "model": "llama-micro",
+            "processes": 2,
+            "requests": len(fl_prompts),
+            "value": round(fleet_tps, 2),
+            "unit": "tokens/s",
+            "inproc_2replica_tokens_per_sec": round(base_tps, 2),
+            "fleet_rpc_overhead_frac": round(
+                max(0.0, 1.0 - fleet_tps / max(base_tps, 1e-9)), 4),
+            "byte_identical": True,
+        })
+    except Exception as e:  # noqa: BLE001 — bench must stay rc=0
+        _emit({"metric": "cb_fleet", "value": 0.0, "unit": "tokens/s",
+               "error": f"{type(e).__name__}: {e}"})
+
+    # own rc=0 guard (the file's one-guard-per-metric rule): a failure
+    # in this micro-bench must tag ITS metric, not emit a second,
+    # contradictory cb_fleet record after the real one already landed
+    try:
+        # device vs store transport: the same decode-state request's
+        # KV image moved (a) inside one runtime on the device path and
+        # (b) through the chunked store transport. Each path runs
+        # twice and reports the WARM iteration — the first device
+        # gather/scatter pays its XLA compile, which is a one-time
+        # cost, not the transport's
+        def _seat(eng):
+            u = eng.add_request(fl_prompts[0], max_new_tokens=fl_new)
+            while eng.status(u) != "decode":
+                eng.step()
+            return u
+
+        def _handoff_wall(move):
+            walls = []
+            for _ in range(2):          # cold (compile) then warm
+                A = build_engine_from_spec(fleet_spec)
+                B = build_engine_from_spec(fleet_spec)
+                warm_p = (fl_prompts[0] + 1) % 256
+                A.generate_many([warm_p], max_new_tokens=2)
+                B.generate_many([warm_p], max_new_tokens=2)
+                ua = _seat(A)
+                t0_ = time.perf_counter()
+                move(A, B, ua)
+                A.release_handoff(ua)
+                walls.append((time.perf_counter() - t0_) * 1e3)
+            return walls[-1]
+
+        def _move_device(A, B, ua):
+            B.import_kv_pages(A.export_kv_pages(ua, device=True))
+
+        st = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+        xp = StoreKVTransport(st)
+
+        def _move_store(A, B, ua):
+            key = xp.send(A.export_kv_pages(ua))
+            B.import_kv_pages(xp.recv(key))
+
+        device_ms = _handoff_wall(_move_device)
+        store_ms = _handoff_wall(_move_store)
+        _emit({
+            "metric": "handoff_device_vs_store_ms",
+            "model": "llama-micro",
+            "value": round(device_ms, 3),
+            "unit": "ms",
+            "store_ms": round(store_ms, 3),
+            "device_speedup": round(store_ms / max(device_ms, 1e-9), 2),
+        })
+    except Exception as e:  # noqa: BLE001 — bench must stay rc=0
+        _emit({"metric": "handoff_device_vs_store_ms", "value": 0.0,
+               "unit": "ms",
+               "error": f"{type(e).__name__}: {e}"})
+
 
 if __name__ == "__main__":
     main()

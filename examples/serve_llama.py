@@ -135,6 +135,33 @@ def main():
                          "first-token via CRC-checked KV-page handoff "
                          "(zero recompute; scheduler machinery, implies "
                          "router mode)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="PROCESS-BACKED fleet: spawn N worker "
+                         "processes (each owning one engine) and route "
+                         "over them via RPC/TCPStore — the multi-host "
+                         "serving surface, single-host demo "
+                         "(docs/serving.md \"Multi-host fleets\"). "
+                         "The fleet StorePrefixIndex is wired by "
+                         "default; composes with --disagg P:D "
+                         "(cross-process KV handoff over the "
+                         "negotiated store transport)")
+    ap.add_argument("--fleet-worker", action="store_true",
+                    help="run THIS process as one fleet worker: build "
+                         "the engine from the same flags and serve the "
+                         "replica surface until killed (multi-host "
+                         "mode — one per host, all pointing at "
+                         "--fleet-store)")
+    ap.add_argument("--fleet-store", metavar="HOST:PORT", default=None,
+                    help="rendezvous TCPStore for --fleet-worker (the "
+                         "--fleet spawner creates its own)")
+    ap.add_argument("--fleet-name", default="w0",
+                    help="this worker's replica name (--fleet-worker)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    metavar="P",
+                    help="serve router.prometheus() at "
+                         "http://127.0.0.1:P/metrics on a stdlib "
+                         "http.server thread (0 = ephemeral; router "
+                         "modes: --replicas/--disagg/--fleet)")
     ap.add_argument("--trace-out", metavar="PATH", default=None,
                     help="write the request-lifecycle timeline as "
                          "chrome-trace/perfetto JSON to PATH when the "
@@ -181,8 +208,74 @@ def main():
     }
     g = geometries[args.model]
 
+    def _fleet_spec():
+        """Engine spec for fleet WORKER processes — the same model +
+        engine the in-process branches build, as plain data
+        (fleet.build_engine_from_spec), so a worker needs no code
+        shipped and every process builds byte-identical weights from
+        the shared seed."""
+        if args.model == "tiny":
+            model_spec = {"preset": "tiny", "seed": 0}
+        elif args.model == "350m":
+            # derived from the SAME LlamaConfig the in-process
+            # branches build (every field is a plain scalar, so the
+            # spec round-trips the geometry exactly) — a duplicated
+            # literal here would silently drift when the geometries
+            # table changes
+            model_spec = {"preset": "config", "seed": 0,
+                          **vars(g["cfg"])}
+        else:
+            ap.error("--fleet/--fleet-worker supports tiny/350m (7b "
+                     "needs the LazyGuard checkpoint path — load from "
+                     "a snapshot on each host instead)")
+        engine_spec = dict(max_len=g["max_len"], page_size=g["page"],
+                          max_batch=max(2, g["bs"]),
+                          quant=(None if args.quant == "none"
+                                 else args.quant),
+                          decode_block=args.decode_block)
+        if args.tp > 1:
+            # workers inherit the parent env (device count flags), so
+            # TP shards inside each worker exactly like the in-process
+            # branches — dropping it here would silently serve
+            # unsharded while the user believes they demoed TP
+            engine_spec.update(
+                tp=args.tp, tp_mode=args.tp_mode,
+                tp_compress=(None if args.tp_compress == "none"
+                             else args.tp_compress))
+        if args.kv_tier:
+            engine_spec.update(kv_tier=args.kv_tier,
+                               tier_dir=(args.tier_dir if
+                                         args.kv_tier == "disk"
+                                         else None))
+        return {"model": model_spec, "engine": engine_spec}
+
+    if args.fleet_worker:
+        # multi-host mode: one of these per host, all pointing at the
+        # master store; the router host builds ProcessReplica(name,
+        # store) per worker (single-host demo: --fleet N does all of
+        # this in one command)
+        if not args.fleet_store:
+            ap.error("--fleet-worker needs --fleet-store HOST:PORT")
+        from paddle_tpu.distributed.store import TCPStore
+        from paddle_tpu.inference.fleet import (EngineHost,
+                                                build_engine_from_spec)
+        host_s, _, port_s = args.fleet_store.partition(":")
+        store = TCPStore(host_s, int(port_s))
+        engine = build_engine_from_spec(_fleet_spec())
+        host = EngineHost(engine, args.fleet_name, store)
+        print(f"fleet worker {args.fleet_name} serving "
+              f"{host.ip}:{host.port} (store {args.fleet_store})",
+              flush=True)
+        host.serve_forever()
+        return
+
     paddle.seed(0)
-    if args.model == "7b":
+    if args.fleet:
+        # fleet mode: every worker PROCESS builds its own engine from
+        # the spec — the router side never touches the weights, so
+        # building the model here would only burn startup time and RAM
+        model = weight_dtype = None
+    elif args.model == "7b":
         # checkpoint scale: NEVER build eagerly — meta init + lazy
         # materialization straight to the serving dtype
         with paddle.LazyGuard():
@@ -196,7 +289,20 @@ def main():
     # observability (docs/observability.md): --trace-out/--metrics-every
     # turn the telemetry plane on; router modes aggregate per-replica
     # registries into the fleet view printed/exported below
-    want_tel = bool(args.trace_out or args.metrics_every)
+    want_tel = bool(args.trace_out or args.metrics_every
+                    or args.metrics_port is not None)
+
+    def metrics_endpoint(router):
+        """--metrics-port: the Prometheus scrape endpoint over the
+        live router (telemetry.serve_prometheus); returns the server
+        or None."""
+        if args.metrics_port is None:
+            return None
+        from paddle_tpu.inference.telemetry import serve_prometheus
+        srv = serve_prometheus(router, port=args.metrics_port)
+        print(f"  metrics: http://127.0.0.1:{srv.server_address[1]}"
+              "/metrics")
+        return srv
 
     def drive_router(router):
         """Drain the router, printing a compact fleet-metrics line
@@ -236,6 +342,68 @@ def main():
         tier_kw = dict(kv_tier=args.kv_tier,
                        tier_dir=(args.tier_dir
                                  if args.kv_tier == "disk" else None))
+    if args.fleet:
+        # PROCESS-BACKED fleet: N worker processes behind one router —
+        # every replica is a ProcessReplica speaking the EngineReplica
+        # surface over RPC; with --disagg the KV handoff crosses
+        # processes on the negotiated store transport
+        from paddle_tpu.inference.fleet import spawn_fleet
+        from paddle_tpu.inference.router import EngineRouter
+        topo = roles = None
+        if args.disagg:
+            try:
+                p_n, d_n = (int(x) for x in args.disagg.split(":"))
+            except ValueError:
+                ap.error("--disagg expects P:D (e.g. --disagg 1:2)")
+            if p_n + d_n != args.fleet:
+                ap.error(f"--disagg {args.disagg} needs "
+                         f"--fleet {p_n + d_n}")
+            topo = {"prefill": p_n, "decode": d_n}
+            roles = ["prefill"] * p_n + ["decode"] * d_n
+        # spawn_fleet wires the fleet StorePrefixIndex by default (the
+        # natural multi-process backend — what the --fleet help text
+        # promises); --prefix-routing is only meaningful in-process
+        handle = spawn_fleet(_fleet_spec(), args.fleet, roles=roles)
+        srv = None
+        try:
+            # the workers are non-daemon processes: anything that
+            # raises after spawn (a RequestFailure out of result(),
+            # Ctrl-C mid-drive) must still shut the fleet down or the
+            # interpreter hangs at exit joining orphan workers
+            router = EngineRouter(backends=handle.replicas,
+                                  topology=topo,
+                                  prefix_index=handle.prefix_index,
+                                  telemetry=want_tel)
+            srv = metrics_endpoint(router)
+            rng = np.random.RandomState(0)
+            prompts = [rng.randint(0, g["cfg"].vocab_size, (t,))
+                       .astype(np.int64) for t in (16, 9, 5, 12)]
+            uids = [router.add_request(p,
+                                       max_new_tokens=args.max_new_tokens)
+                    for p in prompts]
+            drive_router(router)
+            router_trace_out(router)
+            h = router.health()
+            print(f"model={args.model} quant={args.quant} fleet "
+                  f"{args.fleet} processes"
+                  + (f" (disagg {args.disagg})" if topo else "")
+                  + f": {h['done']} done / {h['failed']} failed, "
+                  f"{h['failovers']} failovers, {h['kv_handoffs']} KV "
+                  f"handoffs "
+                  f"(transports {dict(router.handoff_transports)})")
+            for name, rh in h["replicas"].items():
+                print(f"  {name} [{rh['role']}]: breaker={rh['breaker']} "
+                      f"worker={rh.get('worker')}")
+            for i, u in enumerate(uids):
+                o = router.result(u)
+                print(f"  request {i}: {prompts[i].size} -> {o.size} "
+                      f"tokens, tail {o[-4:].tolist()}")
+        finally:
+            if srv is not None:
+                srv.shutdown()
+            handle.shutdown()
+        return
+
     if args.disagg:
         # disaggregated prefill/decode: P prefill + D decode workers,
         # requests migrate at first-token via KV-page handoff
@@ -256,6 +424,7 @@ def main():
                               topology={"prefill": p_n, "decode": d_n},
                               prefix_routing=args.prefix_routing,
                               telemetry=want_tel)
+        srv = metrics_endpoint(router)
         rng = np.random.RandomState(0)
         prompts = [rng.randint(0, g["cfg"].vocab_size, (t,))
                    .astype(np.int64) for t in (16, 9, 5, 12)]
@@ -275,6 +444,8 @@ def main():
             o = router.result(u)
             print(f"  request {i}: {prompts[i].size} -> {o.size} "
                   f"tokens, tail {o[-4:].tolist()}")
+        if srv is not None:
+            srv.shutdown()
         return
     if args.replicas > 1:
         # fault-tolerant fleet: N replicas behind the health-checked
@@ -292,6 +463,7 @@ def main():
         router = EngineRouter(factory, replicas=args.replicas,
                               prefix_routing=args.prefix_routing,
                               telemetry=want_tel)
+        srv = metrics_endpoint(router)
         rng = np.random.RandomState(0)
         prompts = [rng.randint(0, g["cfg"].vocab_size, (t,))
                    .astype(np.int64) for t in (16, 9, 5, 12)]
@@ -346,6 +518,8 @@ def main():
             o = router.result(u)
             print(f"  request {i}: {prompts[i].size} -> {o.size} "
                   f"tokens, tail {o[-4:].tolist()}")
+        if srv is not None:
+            srv.shutdown()
         return
 
     if args.scheduler:

@@ -296,14 +296,24 @@ int pts_set(void* h, const char* key, const char* val, int vlen) {
                                           std::string(val, vlen), &out);
 }
 
-// returns length, or -1 notfound / -2 error; caller buffer must be large
-// enough (call with nullptr to query size is not supported: use wait+get)
+// returns length, or -1 notfound / -2 error / -3 buffer too small.
+// On -3 the REQUIRED size is written into the first 8 bytes of buf
+// (little-endian int64, buflen >= 8 permitting): the server already
+// shipped the whole value to learn it was too big, so the caller can
+// retry ONCE with an exact buffer instead of re-transferring the
+// value on every doubling step.
 int pts_get(void* h, const char* key, char* buf, int buflen) {
   std::string out;
   int st = static_cast<Client*>(h)->request(OP_GET, key, "", &out);
   if (st != 0) return st == 1 ? -1 : -2;
   int n = static_cast<int>(out.size());
-  if (n > buflen) return -3;
+  if (n > buflen) {
+    if (buflen >= 8) {
+      long long need = n;
+      std::memcpy(buf, &need, 8);
+    }
+    return -3;
+  }
   std::memcpy(buf, out.data(), n);
   return n;
 }

@@ -41,6 +41,14 @@ def _recv_msg(sock):
     return pickle.loads(bytes(buf))
 
 
+# Public framing surface: the serving fleet plane
+# (inference/fleet.py) rides exactly this wire format — 4-byte
+# big-endian length + pickle — for its EngineReplica RPCs, so one
+# framing definition serves both the generic rpc agent and the fleet.
+send_msg = _send_msg
+recv_msg = _recv_msg
+
+
 class _RpcAgent:
     """One per process: socket server thread + client connections."""
 

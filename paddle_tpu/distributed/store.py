@@ -95,13 +95,30 @@ class TCPStore:
             st = lib.pts_wait(self._client, key.encode(), timeout_ms)
             if st != 0:
                 raise TimeoutError(f"TCPStore.wait({key!r}) timed out")
-        buf = ctypes.create_string_buffer(1 << 20)
-        n = lib.pts_get(self._client, key.encode(), buf, len(buf))
-        if n == -1:
-            raise KeyError(key)
-        if n < 0:
-            raise RuntimeError(f"TCPStore.get error {n}")
-        return buf.raw[:n]
+        # pts_get returns -3 when the caller buffer is too small and
+        # reports the REQUIRED size in the buffer's first 8 bytes, so
+        # a value bigger than the initial 1 MB (a fleet worker's
+        # resume ledger under many long prompts) costs exactly one
+        # retry with an exact-size buffer — each attempt transfers the
+        # whole value, so doubling blindly would re-download it per
+        # step (a stale .so that doesn't report the size falls back to
+        # doubling)
+        bufsize = 1 << 20
+        while True:
+            buf = ctypes.create_string_buffer(bufsize)
+            n = lib.pts_get(self._client, key.encode(), buf, len(buf))
+            if n == -1:
+                raise KeyError(key)
+            if n == -3:
+                need = int.from_bytes(buf.raw[:8], "little")
+                if need > (1 << 28) or bufsize >= (1 << 28):
+                    raise RuntimeError(
+                        f"TCPStore.get({key!r}): value exceeds 256 MB")
+                bufsize = need if need > bufsize else bufsize * 2
+                continue
+            if n < 0:
+                raise RuntimeError(f"TCPStore.get error {n}")
+            return buf.raw[:n]
 
     def add(self, key, amount=1):
         return int(_lib().pts_add(self._client, key.encode(), amount))

@@ -371,8 +371,15 @@ _SERVING_EXPORTS = {
     # tensor-parallel serving (docs/serving.md "Sharded decode &
     # disaggregated prefill")
     "TPContext": "tp",
-    # KV-page handoff (disaggregated prefill/decode)
+    # KV-page handoff (disaggregated prefill/decode) + the negotiated
+    # transport layer (docs/serving.md "Multi-host fleets")
     "KVHandoffError": "handoff", "StoreKVTransport": "handoff",
+    "DeviceTransport": "handoff", "negotiate": "handoff",
+    # process-backed replica fleet (docs/serving.md "Multi-host
+    # fleets"): worker host, drop-in RPC replica, spawner
+    "EngineHost": "fleet", "ProcessReplica": "fleet",
+    "FleetHandle": "fleet", "FleetRPCError": "fleet",
+    "spawn_fleet": "fleet", "build_engine_from_spec": "fleet",
     # cluster-scale KV memory hierarchy (docs/serving.md "Prefix-aware
     # routing & KV tiering"): the fleet prefix index backends and the
     # host/disk tier store
@@ -382,7 +389,9 @@ _SERVING_EXPORTS = {
     # lifecycle tracing, latency histograms, fleet metrics export
     "Telemetry": "telemetry", "MetricsRegistry": "telemetry",
     "Histogram": "telemetry", "RequestTrace": "telemetry",
+    "ReplicaTelemetryMirror": "telemetry",
     "chrome_trace": "telemetry", "export_chrome_trace": "telemetry",
+    "serve_prometheus": "telemetry",
 }
 
 

@@ -11,19 +11,36 @@ single-engine run, because the imported pool bytes are the exported
 pool bytes.
 
 This module holds the transfer-integrity layer shared by every
-transport:
+transport, plus the TRANSPORT NEGOTIATION the router runs per handoff
+(`negotiate`, docs/serving.md "Multi-host fleets"):
 
-  - payload checksums: every page blob and the resume metadata carry a
-    CRC32 stamped at export and verified at import
-    (`checksum_payload` / `verify_payload` — KVHandoffError on
-    mismatch). Even the in-process handoff verifies: it is how a
-    buggy transport, a torn store write, or an aliased buffer turns
-    into a typed error instead of silently corrupt attention.
+  - payload checksums: on the HOST and STORE paths every page blob and
+    the resume metadata carry a CRC32 stamped at export and verified
+    at import (`checksum_payload` / `verify_payload` — KVHandoffError
+    on mismatch): it is how a buggy transport, a torn store write, or
+    an aliased buffer turns into a typed error instead of silently
+    corrupt attention. Device-negotiated payloads (the default when
+    source and target share a runtime — including in-process disagg)
+    skip the page-byte CRC walk because the bytes never cross a host
+    boundary; only the metadata CRC verifies there.
+  - DeviceTransport: the ICI-class path when source and target share
+    one JAX runtime (same process/pod) — page blobs stay DEVICE
+    arrays end to end (`transport: "device"` payloads): export is a
+    device gather, import a device scatter (+`jax.device_put`
+    re-placement onto the target's mesh), and the host-bounce CRC walk
+    over the page bytes is skipped because the bytes never cross a
+    host boundary (the metadata CRC still verifies). On a TPU pod the
+    move rides the interconnect; on CPU it is the same code path as
+    parity evidence.
   - StoreKVTransport: the CPU/multi-process transport — the payload
     rides the TCPStore rendezvous (distributed/store.py) as chunked
-    binary keys with a JSON manifest. On TPU pods the same payload
-    moves device-to-device (the router's in-process handoff passes
-    arrays directly; an ICI transport reimplements send/recv only).
+    binary keys with a JSON manifest; only a handle crosses the RPC
+    plane between fleet workers.
+  - `negotiate(src_ep, dst_ep)`: "device" when the endpoints share a
+    runtime domain (`proc` + `backend` equal), "store" when both sit
+    on the same fleet store, else "host" (CRC-stamped payload through
+    the caller). The router tags every handoff with the transport that
+    actually ran — LOUDLY, in telemetry and its health counters.
 
 Allocator-side safety (serving.PageAllocator export/import tickets):
 a transfer token is BURNED on import commit, so re-importing the same
@@ -75,7 +92,16 @@ def payload_bytes(payload):
 
 def checksum_payload(payload):
     """Stamp CRC32s over the resume metadata and every layer's K/V page
-    blob. Returns the payload (mutated in place) for chaining."""
+    blob. Returns the payload (mutated in place) for chaining.
+
+    A `transport: "device"` payload stamps the METADATA only: its page
+    blobs are live device arrays that never cross a host boundary, so
+    checksumming them would force the exact host readback the device
+    path exists to avoid (verify_payload skips them symmetrically)."""
+    if payload.get("transport") == "device":
+        payload["crc"] = {"meta": _meta_crc(payload),
+                          "k": None, "v": None}
+        return payload
     payload["crc"] = {
         "meta": _meta_crc(payload),
         "k": [_page_crc(a) for a in payload["k"]],
@@ -86,7 +112,8 @@ def checksum_payload(payload):
 
 def verify_payload(payload):
     """Raise KVHandoffError unless every CRC matches what was stamped
-    at export."""
+    at export (device payloads: metadata only — the page bytes stayed
+    on device)."""
     crc = payload.get("crc")
     if not isinstance(crc, dict):
         raise KVHandoffError("handoff payload carries no checksums")
@@ -94,6 +121,8 @@ def verify_payload(payload):
         raise KVHandoffError(
             "handoff metadata CRC mismatch (resume spec corrupted in "
             "transit)")
+    if payload.get("transport") == "device":
+        return payload
     for name in ("k", "v"):
         blobs, sums = payload[name], crc[name]
         if len(blobs) != len(sums):
@@ -108,6 +137,64 @@ def verify_payload(payload):
                     f"{got:#010x} != {want:#010x} (KV bytes corrupted "
                     "in transit)")
     return payload
+
+
+def negotiate(src_ep, dst_ep):
+    """Pick the cheapest KV/prefix transport two replica endpoints can
+    share (each endpoint is a `transport_endpoint()` dict):
+
+      "device"  same `proc` token AND `backend`: one JAX runtime —
+                pages move device-to-device (ICI on a pod), no host
+                bounce, no page CRC walk.
+      "store"   both name the same fleet `store` (host, port, ns):
+                the chunked StoreKVTransport — pages never transit
+                the router process.
+      "host"    everything else: the CRC-stamped host payload through
+                the caller (the PR 10 path; always works).
+
+    The `proc` token is an INCARNATION id, not a pid: a worker thread
+    serving in the router's own process still gets "store"/"host" —
+    reachability over the RPC plane does not make two engines share a
+    device domain for payload-passing purposes unless they really are
+    driven by the same caller."""
+    if not isinstance(src_ep, dict) or not isinstance(dst_ep, dict):
+        return "host"
+    if (src_ep.get("proc") and src_ep.get("proc") == dst_ep.get("proc")
+            and src_ep.get("backend") == dst_ep.get("backend")):
+        return "device"
+    if src_ep.get("store") and \
+            tuple(src_ep["store"]) == tuple(dst_ep.get("store") or ()):
+        return "store"
+    return "host"
+
+
+class DeviceTransport:
+    """The device-domain (ICI-class) page mover: helpers the engines'
+    export/import paths use when a handoff negotiated "device".
+
+    The payload never materializes on the host: `gather` slices the
+    pool rows as a device array (on a TPU pod a cross-chip `place`
+    rides the interconnect via jax.device_put; on CPU the same code is
+    the parity path), and the importer's scatter consumes them
+    directly. Integrity: the metadata CRC still stamps/verifies; page
+    CRCs are skipped — the bytes never left the device, so there is no
+    wire to corrupt them on (docs/robustness.md `transport.device`)."""
+
+    @staticmethod
+    def gather(pool, idx):
+        """Device-resident page gather: pool[idx] without np.asarray —
+        the export-side replacement for the host-bounce copy."""
+        return pool[idx]
+
+    @staticmethod
+    def place(arr, target=None):
+        """Move a device array into the target device/sharding domain
+        (None = leave placement to the consumer's scatter). On a pod
+        this is the ICI hop; in one process it is a no-op view."""
+        if target is None:
+            return arr
+        import jax
+        return jax.device_put(arr, target)
 
 
 class StoreKVTransport:
@@ -132,6 +219,11 @@ class StoreKVTransport:
         """payload -> (manifest_json_bytes, binary_blob). Arrays are
         concatenated in manifest order; the manifest records shapes,
         dtypes, and offsets."""
+        if payload.get("transport") == "device":
+            raise KVHandoffError(
+                "a device-transport payload cannot ride the store "
+                "transport: its page blobs carry no CRCs (re-export "
+                "with the host path)")
         spec = dict(payload["spec"])
         prompt = np.ascontiguousarray(np.asarray(spec.pop("prompt"),
                                                  np.int64))
@@ -153,6 +245,12 @@ class StoreKVTransport:
             "geometry": payload["geometry"],
             "token": payload["token"],
             "crc": payload["crc"],
+            # the negotiated label ("store") must survive the manifest
+            # round trip or the importer's import_seat telemetry leg
+            # falls back to "host" — the mislabel the label exists to
+            # prevent (verify treats anything != "device" as the full
+            # page-CRC form, and _pack already refused "device" above)
+            "transport": payload.get("transport", "host"),
             "index": index, "blob_bytes": len(blob),
         }
         return json.dumps(manifest).encode(), bytes(blob)
@@ -176,6 +274,7 @@ class StoreKVTransport:
         payload = {
             "spec": spec, "lens": m["lens"], "token": m["token"],
             "geometry": m["geometry"], "crc": m["crc"],
+            "transport": m.get("transport", "host"),
             "k": [arrays[f"k{li}"] for li in range(L)],
             "v": [arrays[f"v{li}"] for li in range(L)],
         }

@@ -203,6 +203,21 @@ class StorePrefixIndex:
         self.publishes = 0
         self.retractions = 0
 
+    @property
+    def endpoint(self):
+        """(host, port, prefix) — what a fleet worker needs to open
+        its OWN client onto this index (a ctypes store handle cannot
+        cross a process; ProcessReplica.attach_prefix_index ships this
+        and the worker calls StorePrefixIndex.connect)."""
+        return (self.store.host, self.store.port, self.prefix)
+
+    @classmethod
+    def connect(cls, host, port, prefix="pfxidx", **kw):
+        """Build an index client on a fresh store connection (the
+        worker-process side of attach_prefix_index)."""
+        from ..distributed.store import TCPStore
+        return cls(TCPStore(host, int(port)), prefix=prefix, **kw)
+
     # -- store helpers ------------------------------------------------------
     def _get_json(self, key, default):
         try:

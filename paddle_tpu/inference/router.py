@@ -49,11 +49,13 @@ router are byte-identical to a single engine serving the same requests
 tests/test_router.py, including under seeded chaos kills).
 """
 import collections
+import os
 import time
+import uuid
 
 import numpy as np
 
-from ..failsafe import (RetriesExhaustedError, fault_point,
+from ..failsafe import (InjectedFault, RetriesExhaustedError, fault_point,
                         retry_with_backoff)
 from .scheduler import (DECODE, DEMOTED, DONE, FAILED, PREFILL, QUEUED,
                         EngineBusyError, EngineFullError, RequestFailure,
@@ -61,6 +63,13 @@ from .scheduler import (DECODE, DEMOTED, DONE, FAILED, PREFILL, QUEUED,
                         SchedulerError, UnknownRequestError)
 
 ACTIVE, DRAINING = "active", "draining"
+
+# device-domain token shared by every in-process EngineReplica: two
+# replicas whose endpoints carry the SAME token share one JAX runtime,
+# so a KV handoff between them may negotiate the device transport
+# (handoff.negotiate). Unique per process AND per import so a worker
+# thread serving in this process never aliases into the domain.
+_PROC_TOKEN = f"router:{os.getpid()}:{uuid.uuid4().hex[:8]}"
 
 
 class ReplicaFailedError(SchedulerError):
@@ -224,14 +233,9 @@ class EngineReplica:
 
     def queue_head_uid(self):
         """The engine uid an idle-engine EngineFullError is complaining
-        about: the admission queue head, else the demoted-restore head
-        (a parked request whose fresh-page need cannot be met — same
-        capacity contract)."""
-        q = self.engine._queue
-        if q:
-            return self.engine._pick_next().uid
-        demoted = self.engine._demoted
-        return next(iter(demoted)) if demoted else None
+        about (ContinuousBatchingEngine.queue_head_uid — one
+        definition; the fleet worker serves the same call)."""
+        return self.engine.queue_head_uid()
 
     # -- telemetry ------------------------------------------------------------
     def attach_telemetry(self, tel):
@@ -243,6 +247,33 @@ class EngineReplica:
         self.telemetry = tel
         self.engine.attach_telemetry(tel, src=self.name)
 
+    def metrics_registry(self, sample=True):
+        """This replica's MetricsRegistry for the router's fleet merge
+        (None without telemetry). sample=True rate-converts a fresh
+        health() snapshot first. A ProcessReplica reimplements this as
+        the cross-process registry pull — one RPC fetches registry
+        state + health together."""
+        tel = self.telemetry
+        if tel is None:
+            return None
+        if sample:
+            try:
+                tel.registry.sample(self.health())
+            except Exception:
+                pass                    # metrics must never throw
+        return tel.registry
+
+    def sync_telemetry(self):
+        """Refresh remote telemetry mirrors (trace export); in-process
+        traces are already live — nothing to do."""
+        return None
+
+    def extra_health(self):
+        """Backend-specific additions to the router's per-replica
+        health entry (the in-process schema is pinned; a process
+        backend adds its worker block here)."""
+        return {}
+
     # -- fleet prefix index (cache-aware routing) -----------------------------
     def attach_prefix_index(self, index):
         """Wire this replica's engine into the fleet prefix index under
@@ -253,10 +284,12 @@ class EngineReplica:
     def page_size(self):
         return self.engine.page_size
 
-    def export_prefix(self, ids):
+    def export_prefix(self, ids, device=False):
         """Ticketed export of this replica's cached prefix chain for
-        `ids` (None when nothing is cached — a stale index hint)."""
-        return self.engine.export_prefix_pages(ids)
+        `ids` (None when nothing is cached — a stale index hint);
+        device=True keeps the pages on device (negotiated same-runtime
+        ships only)."""
+        return self.engine.export_prefix_pages(ids, device=device)
 
     def import_prefix(self, payload):
         return self.engine.import_prefix_pages(payload)
@@ -268,10 +301,24 @@ class EngineReplica:
         return self.engine.abort_prefix_export(token)
 
     # -- KV-page handoff (disaggregated prefill/decode) ----------------------
-    def export_kv(self, uid):
+    def transport_endpoint(self):
+        """Transport-negotiation endpoint (handoff.negotiate): every
+        in-process replica shares this process's device-domain token,
+        so co-located prefill/decode pools negotiate the ICI-class
+        device path; `store` is None — in-process replicas need no
+        rendezvous store to move bytes."""
+        import jax
+        return {"proc": _PROC_TOKEN, "backend": jax.default_backend(),
+                "store": None}
+
+    def export_kv(self, uid, transport="host"):
         """Package a decode-state request's KV image for migration
-        (scheduler.export_kv_pages — CRC-stamped, ticketed)."""
-        return self.engine.export_kv_pages(uid)
+        (scheduler.export_kv_pages — CRC-stamped, ticketed). transport
+        is the negotiated kind: "device" keeps page blobs on device
+        (same-runtime targets only), "host"/"store" take the
+        host-bounce CRC path."""
+        return self.engine.export_kv_pages(
+            uid, device=(transport == "device"))
 
     def import_kv(self, payload):
         """Seat an exported request here; returns this replica's engine
@@ -361,11 +408,21 @@ class EngineRouter:
     # engine object is presumed wrecked and rebuilt from the factory
     REBUILD_AFTER_PROBES = 3
 
-    def __init__(self, factory, replicas=2, quarantine_threshold=2,
+    def __init__(self, factory=None, replicas=2, quarantine_threshold=2,
                  probe_backoff=4, probe_retries=1, probe_base_delay=0.01,
                  probe_jitter=0.0, probe_max_elapsed=None, probe_seed=0,
                  probe_sleep=time.sleep, hold_limit=None, topology=None,
-                 prefix_routing=False, prefix_index=None, telemetry=None):
+                 prefix_routing=False, prefix_index=None, telemetry=None,
+                 backends=None):
+        # backends=[replica, ...]: PRE-BUILT replica backends instead
+        # of factory-built in-process engines — the process-fleet mode
+        # (inference/fleet.py ProcessReplica, or any object serving the
+        # EngineReplica surface). The router wires breakers, roles,
+        # telemetry, and the prefix index onto them and then runs
+        # UNCHANGED: routing, failover salvage, quarantine, hot-swap,
+        # disagg handoff, and the metrics merge all go through the same
+        # boundary methods. With topology=, roles assign by position
+        # (first `prefill` workers, then `decode`).
         # topology={"prefill": N, "decode": M}: DISAGGREGATED mode —
         # N prefill workers take every fresh admission, M decode
         # workers receive requests at first-token via KV-page handoff
@@ -388,16 +445,34 @@ class EngineRouter:
             self._topology = {"prefill": np_, "decode": nd}
             roles = ["prefill"] * np_ + ["decode"] * nd
             replicas = np_ + nd
+        if backends is not None:
+            self._replicas = list(backends)
+            if roles is not None and len(self._replicas) != len(roles):
+                raise ValueError(
+                    f"topology {self._topology} needs "
+                    f"{len(roles)} backends, got {len(self._replicas)}")
+            for i, rep in enumerate(self._replicas):
+                rep.role = roles[i] if roles else rep.role or "any"
+                rep.breaker = CircuitBreaker(
+                    threshold=quarantine_threshold,
+                    probe_backoff=probe_backoff)
+            replicas = len(self._replicas)
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
-        self._replicas = []
-        for i in range(int(replicas)):
-            role = roles[i] if roles else "any"
-            name = f"{role[0] if roles else 'r'}{i}"
-            rep = EngineReplica(name, factory, role=role)
-            rep.breaker = CircuitBreaker(threshold=quarantine_threshold,
-                                         probe_backoff=probe_backoff)
-            self._replicas.append(rep)
+        if backends is None:
+            if factory is None:
+                raise ValueError(
+                    "EngineRouter needs an engine factory (or "
+                    "backends=[...] for a process-backed fleet)")
+            self._replicas = []
+            for i in range(int(replicas)):
+                role = roles[i] if roles else "any"
+                name = f"{role[0] if roles else 'r'}{i}"
+                rep = EngineReplica(name, factory, role=role)
+                rep.breaker = CircuitBreaker(
+                    threshold=quarantine_threshold,
+                    probe_backoff=probe_backoff)
+                self._replicas.append(rep)
         self._by_name = {r.name: r for r in self._replicas}
         # prefix_routing=True: CACHE-AWARE routing — replicas publish
         # their content-addressed prefix chains into a fleet index
@@ -468,6 +543,10 @@ class EngineRouter:
         self.handoff_failures = 0       # export/import/commit attempts
         #                                 that fell back (request safe
         #                                 either way — never lost)
+        self.handoff_transports = collections.Counter()
+        #                                 which negotiated path each
+        #                                 landed handoff ran (device/
+        #                                 store/host — the LOUD tag)
         self.prefix_routed = 0          # admissions steered by the index
         self.prefix_ships = 0           # prefix-page chains shipped to
         #                                 a fresh replica pre-admission
@@ -623,6 +702,10 @@ class EngineRouter:
                     entry.update(rep.headroom())
                 except Exception as e:  # health must never throw
                     entry["health_error"] = f"{type(e).__name__}: {e}"
+            try:
+                entry.update(rep.extra_health())
+            except Exception:
+                pass                    # backend extras are advisory
             reps[rep.name] = entry
         states = collections.Counter(r.state for r in self._reqs.values())
         return {
@@ -679,16 +762,29 @@ class EngineRouter:
         regs = []
         reps_snap = {}
         for rep in self._replicas:
-            tel = rep.telemetry
-            if tel is None:
+            # metrics_registry is the backend-agnostic pull: the
+            # in-process replica samples its own health() into its
+            # registry; a ProcessReplica fetches the remote registry
+            # state + health in ONE rpc and answers from its mirror
+            # (last-known state when the worker is unreachable — fleet
+            # p99s must not vanish with the process)
+            try:
+                if rep.breaker.state == "open":
+                    # a blackholed worker's pull would block a full
+                    # call_timeout PER SCRAPE (and serve_prometheus
+                    # renders under one lock, so every concurrent
+                    # scrape queues behind it): an open breaker
+                    # answers from the mirror — the last-known state
+                    # it exists to keep — until a probe closes it
+                    reg = getattr(rep.telemetry, "registry", None)
+                else:
+                    reg = rep.metrics_registry(sample=True)
+            except Exception:           # metrics must never throw
+                reg = getattr(rep.telemetry, "registry", None)
+            if reg is None:
                 continue
-            if rep.breaker.state != "open":
-                try:
-                    tel.registry.sample(rep.health())
-                except Exception:
-                    pass                # metrics must never throw
-            regs.append(tel.registry)
-            reps_snap[rep.name] = tel.registry.snapshot()
+            regs.append(reg)
+            reps_snap[rep.name] = reg.snapshot()
         regs.append(self._tel.registry)
         out["fleet"] = MetricsRegistry.merged(regs).snapshot()
         out["replicas"] = reps_snap
@@ -700,18 +796,37 @@ class EngineRouter:
             raise ValueError("prometheus() needs EngineRouter("
                              "telemetry=...) — nothing is collected")
         from .telemetry import MetricsRegistry
-        regs = [rep.telemetry.registry for rep in self._replicas
-                if rep.telemetry is not None] + [self._tel.registry]
+        regs = []
+        for rep in self._replicas:
+            try:
+                if rep.breaker.state == "open":
+                    reg = getattr(rep.telemetry, "registry", None)
+                else:                   # (see metrics(): an open
+                    #                     breaker answers from the
+                    #                     mirror, never the wire)
+                    reg = rep.metrics_registry(sample=False)
+            except Exception:
+                reg = getattr(rep.telemetry, "registry", None)
+            if reg is not None:
+                regs.append(reg)
+        regs.append(self._tel.registry)
         return MetricsRegistry.merged(regs).prometheus(prefix)
 
     def export_chrome_trace(self, path):
         """Write the FLEET timeline (router legs + every replica's
         request spans) as one perfetto-loadable chrome-trace JSON —
-        each source is a pid, each request a tid."""
+        each source is a pid, each request a tid. Remote replicas'
+        trace mirrors are refreshed first (one rpc per live worker)."""
         if self._tel is None:
             raise ValueError("export_chrome_trace() needs EngineRouter("
                              "telemetry=...) — nothing was traced")
         from .telemetry import export_chrome_trace
+        for rep in self._replicas:
+            try:
+                if rep.breaker.state != "open":
+                    rep.sync_telemetry()
+            except Exception:
+                pass                    # export what we last saw
         tels = [self._tel] + [rep.telemetry for rep in self._replicas
                               if rep.telemetry is not None]
         return export_chrome_trace(path, tels)
@@ -996,13 +1111,36 @@ class EngineRouter:
             self.prefix_routed += 1
         return reps
 
-    def _ship_prefix(self, src, dst, prompt):
-        """One prefix-page ship src -> dst (ticketed, CRC-checked).
-        Never raises; False = fell back (the request re-prefills)."""
+    def _transport_kind(self, src, dst):
+        """Negotiated transport for a page move src -> dst (handoff.
+        negotiate over the replicas' endpoints): "device" when they
+        share a JAX runtime (ICI-class, no host bounce), "store" when
+        both sit on one fleet store, else "host". Never raises —
+        an unreadable endpoint degrades to the always-works host
+        path."""
+        from .handoff import negotiate
         try:
-            payload = src.export_prefix(prompt)
+            return negotiate(src.transport_endpoint(),
+                             dst.transport_endpoint())
         except Exception:
-            return False
+            return "host"
+
+    def _ship_prefix(self, src, dst, prompt):
+        """One prefix-page ship src -> dst (ticketed, CRC-checked;
+        device-domain pairs skip the host bounce). Never raises;
+        False = fell back (the request re-prefills)."""
+        device = self._transport_kind(src, dst) == "device"
+        try:
+            payload = src.export_prefix(prompt, device=device)
+        except Exception:
+            if not device:
+                return False
+            # transport.device fault (or a device-path failure): the
+            # host-bounce path still works — fall back LOUDLY
+            try:
+                payload = src.export_prefix(prompt)
+            except Exception:
+                return False
         if payload is None:
             return False                # stale hint: nothing cached
         try:
@@ -1065,19 +1203,38 @@ class EngineRouter:
 
     def _collect(self, rep):
         """Pull terminal outcomes from a replica into the router ledger
-        (and mirror live states for status())."""
+        (and mirror live states for status()). A replica that becomes
+        UNREACHABLE mid-collect (a process worker killed between its
+        step and this read) aborts the pass — its requests stay
+        assigned and the next step()'s failure handling salvages them
+        through the standard failover path."""
+        # only TRANSPORT-class failures abort the pass (FleetRPCError,
+        # or an injected rpc.call/heartbeat fault standing in for one);
+        # a deterministic bug in result()/_deliver() must stay LOUD —
+        # swallowing it here would recur every step and spin drain()
+        # forever on a healthy replica
+        from .fleet import FleetRPCError
+        transport_errs = (FleetRPCError, InjectedFault)
         for ruid in list(self._assigned[rep.name]):
             rr = self._reqs[ruid]
             try:
                 st = rep.status(rr.engine_uid)
             except UnknownRequestError:
                 continue
-            if st == DONE:
-                self._deliver(ruid, result=rep.result(rr.engine_uid))
-            elif st in (FAILED, "cancelled"):
-                self._deliver(ruid, failure=rep.failure(rr.engine_uid))
-            else:
-                rr.state = st
+            except transport_errs:
+                break
+            try:
+                if st == DONE:
+                    self._deliver(ruid,
+                                  result=rep.result(rr.engine_uid))
+                elif st in (FAILED, "cancelled"):
+                    self._deliver(ruid,
+                                  failure=rep.failure(rr.engine_uid))
+                else:
+                    rr.state = st
+            except transport_errs:
+                break                   # unreachable mid-fetch: the
+                #                         next step salvages
         return None
 
     # -- failover ----------------------------------------------------------
@@ -1231,7 +1388,19 @@ class EngineRouter:
 
         Greedy continuations are byte-identical to a single-engine run
         in every branch: the landed copy decodes from the imported
-        bytes, a fallen-back request continues from its own pages."""
+        bytes, a fallen-back request continues from its own pages.
+
+        TRANSPORT: each (source, target) pair negotiates the cheapest
+        path (handoff.negotiate) — "device" keeps the pages on device
+        end-to-end (same JAX runtime: the ICI-class move), "store"
+        rides the chunked StoreKVTransport between fleet workers (only
+        a handle crosses the router), "host" is the CRC-stamped
+        payload through this process (always works). Device-eligible
+        targets are tried first; a device-path failure (the
+        `transport.device` fault point) falls back LOUDLY to the
+        host-bounce export. The transport that actually ran is tagged
+        in the request's telemetry leg and counted in
+        `handoff_transports`."""
         rr = self._reqs[ruid]
         euid = rr.engine_uid
 
@@ -1249,32 +1418,73 @@ class EngineRouter:
                    if t.role == "decode" and has_room(t)]
         if not targets:
             return False               # no decode capacity: stay put
-        try:
-            payload = rep.export_kv(euid)
-        except Exception:
-            # export fault point (or a non-decode race): nothing was
-            # ticketed, the request keeps serving on the source
-            self.handoff_failures += 1
-            return False
+        groups = {}
+        for t in targets:
+            groups.setdefault(self._transport_kind(rep, t),
+                              []).append(t)
         landed = None
-        for tgt in targets:
-            try:
-                new_euid = tgt.import_kv(payload)
-            except (EngineBusyError, EngineFullError):
-                continue               # full target (slots or pages):
-                #                        backpressure, try the next
-            except Exception:
-                # kv.import fault: the target engine already rolled its
-                # import back (pages freed, token reusable)
-                self.handoff_failures += 1
+        faults_charged = False
+        for kind in ("device", "store", "host"):
+            tgts = groups.get(kind)
+            if not tgts:
                 continue
-            landed = (tgt, new_euid)
-            break
+            try:
+                payload = rep.export_kv(euid, kind)
+            except Exception:
+                # export fault (kv.export pre-ticket, the device
+                # path's transport.device, a store send failure, or a
+                # lost RPC reply AFTER the worker ticketed): the
+                # request keeps serving on the source, but the ticket
+                # may be open — settle it (a no-op when the fault
+                # fired pre-ticket) or the orphaned token pins its
+                # pages out of PrefixCache.evict forever. ANY
+                # negotiated-path failure retries the same targets
+                # over the host-bounce path — negotiation is an
+                # optimization, never a new way to lose a handoff
+                try:
+                    rep.abort_handoff(euid)
+                except Exception:
+                    pass
+                self.handoff_failures += 1
+                faults_charged = True
+                if kind != "host":
+                    groups.setdefault("host", []).extend(tgts)
+                continue
+            hard_failed = []
+            for tgt in tgts:
+                try:
+                    new_euid = tgt.import_kv(payload)
+                except (EngineBusyError, EngineFullError):
+                    continue           # full target (slots or pages):
+                    #                    backpressure, try the next
+                except Exception:
+                    # kv.import fault: the target engine already rolled
+                    # its import back (pages freed, token reusable)
+                    self.handoff_failures += 1
+                    faults_charged = True
+                    hard_failed.append(tgt)
+                    continue
+                landed = (tgt, new_euid, kind)
+                break
+            if landed is not None:
+                break
+            rep.abort_handoff(euid)    # this kind's export is settled
+            #                            before the next kind exports
+            if kind != "host" and hard_failed:
+                # a HARD import failure on the negotiated path (not
+                # backpressure — a full target stays full either way)
+                # retries those targets over the host-bounce payload:
+                # same fallback contract as the export side
+                groups.setdefault("host", []).extend(hard_failed)
         if landed is None:
-            rep.abort_handoff(euid)
-            self.handoff_failures += 1
+            # every export/import fault was already charged above; the
+            # trailing count covers the all-backpressure exhaustion so
+            # one logical failed handoff never bills twice
+            if not faults_charged:
+                self.handoff_failures += 1
             return False
-        tgt, new_euid = landed
+        tgt, new_euid, kind = landed
+        self.handoff_transports[kind] += 1
         # repoint the ledger BEFORE the source commit: if the source
         # dies at handoff.commit the request is already owned by the
         # target — the source's salvage loop skips it (assignment
@@ -1303,10 +1513,12 @@ class EngineRouter:
         if self._tel is not None:
             # handoff_ms itself is observed by the SOURCE engine's
             # telemetry (kv_export -> migrated pairing); the router
-            # trace records the fleet-level leg
+            # trace records the fleet-level leg — LOUDLY tagged with
+            # the transport that actually moved the pages
             self._tel.req_event("router", ruid, "handoff",
                                 from_replica=rep.name,
-                                to_replica=tgt.name)
+                                to_replica=tgt.name,
+                                transport=kind)
         return True
 
     def _fail_stuck_head(self, rep, exc):

@@ -169,7 +169,8 @@ class Request:
                  "filled", "resume", "tok", "out", "result",
                  "pages_shared", "deadline", "ttl_steps", "born_step",
                  "error", "tenant", "priority", "draft_k",
-                 "spec_drafted", "spec_accepted", "demote", "seated_step")
+                 "spec_drafted", "spec_accepted", "demote", "seated_step",
+                 "idle_steps")
 
     def __init__(self, uid, ids, max_new_tokens, eos_token_id,
                  deadline=None, ttl_steps=None, born_step=0,
@@ -207,6 +208,10 @@ class Request:
         self.seated_step = born_step    # engine step of the last seat
         #                                 (admission/import/restore) —
         #                                 the demotion victim LRU key
+        self.idle_steps = 0             # consecutive engine steps this
+        #                                 seated decode request waited
+        #                                 without emitting (the
+        #                                 demote-on-idle trigger)
 
 
 class PrefixCache:
@@ -507,7 +512,7 @@ class ContinuousBatchingEngine(LLMEngine):
                  megakernel=None, speculate=None, drafter="ngram",
                  spec_adaptive=True, tenants=None, kv_tier=None,
                  tier_dir=None, tier_host_cap_mb=None, oversubscribe=None,
-                 telemetry=None, **kw):
+                 tier_idle_steps=None, telemetry=None, **kw):
         super().__init__(model, max_len=max_len, page_size=page_size,
                          max_batch=max_batch, **kw)
         # telemetry=: a telemetry.Telemetry instance (or True to build
@@ -692,6 +697,29 @@ class ContinuousBatchingEngine(LLMEngine):
         self.oversubscribe = (self._tier is not None
                               if oversubscribe is None
                               else bool(oversubscribe))
+        # tier_idle_steps=N: DEMOTE-ON-IDLE (ROADMAP item 2 follow-up)
+        # — a seated decode request that sits through N consecutive
+        # engine steps WITHOUT emitting a token (it was blocked behind
+        # other work, e.g. the K=1 prefill-priority steps of a long
+        # prompt) demotes its pages to the tier even without admission
+        # pressure, provided queued work exists to use the freed
+        # capacity (demoting into an empty queue would just thrash the
+        # restore sweep). Restore is byte-identical (the PR 11
+        # contract, unit-pinned). In fused-block mode (decode_block>1
+        # or speculate) every decode slot advances every block, so the
+        # counter never accumulates — the knob is a K=1 scheduling
+        # policy by construction.
+        self.tier_idle_steps = (None if tier_idle_steps is None
+                                else int(tier_idle_steps))
+        if self.tier_idle_steps is not None:
+            if self.tier_idle_steps < 1:
+                raise ValueError(
+                    f"tier_idle_steps must be >= 1, got {tier_idle_steps}")
+            if self._tier is None:
+                raise ValueError(
+                    "tier_idle_steps needs a KV tier (kv_tier=) to "
+                    "demote into")
+        self.idle_demotions = 0         # demote-on-idle firings
         self._demoted = collections.OrderedDict()   # uid -> Request
         self.demotions = 0
         self.restores = 0
@@ -842,6 +870,7 @@ class ContinuousBatchingEngine(LLMEngine):
             return self._fused_step()
         self._expire_deadlines()
         self._restore_sweep()
+        self._idle_demote_sweep()
         self._admit()
         prefills = [r for r in self._slots if r and r.state == PREFILL]
         decodes = [r for r in self._slots if r and r.state == DECODE]
@@ -858,6 +887,12 @@ class ContinuousBatchingEngine(LLMEngine):
                     self._fail_request(r, "prefill", e)
                 self.prefill_steps += 1
                 self._prefer_decode = True
+                for rd in decodes:
+                    # a prefill-priority step is a WAITED step for every
+                    # seated decode request (the demote-on-idle clock;
+                    # _push_token resets it on the next emitted token)
+                    if rd.state == DECODE:
+                        rd.idle_steps += 1
             else:
                 live = []
                 for r in decodes:
@@ -939,6 +974,16 @@ class ContinuousBatchingEngine(LLMEngine):
         """Number of requests still queued or in flight."""
         return sum(1 for r in self._requests.values()
                    if r.state in (QUEUED, PREFILL, DECODE, DEMOTED))
+
+    def queue_head_uid(self):
+        """The uid an idle-engine EngineFullError is complaining about:
+        the admission queue head (next to be picked), else the
+        demoted-restore head (a parked request whose fresh-page need
+        cannot be met — same capacity contract). None when neither
+        exists. Routers use this to attribute stuck-head failures."""
+        if self._queue:
+            return self._pick_next().uid
+        return next(iter(self._demoted)) if self._demoted else None
 
     def headroom(self):
         """O(1) routing snapshot — the subset of health() a router's
@@ -1237,6 +1282,23 @@ class ContinuousBatchingEngine(LLMEngine):
         self._queue.append(r)
         self.preemptions += 1
 
+    def _price_admission(self, r):
+        """The ONE page-pricing rule for seating `r` through the prefix
+        cache: returns (shared, resume, need, cow, fresh) where `fresh`
+        is the pages a seat actually claims — raw need minus the cached
+        chain, plus the CoW reserve when the divergence point falls
+        inside a shared page. Both consumers (_admit and the
+        _idle_demote_sweep capacity gate) MUST price through here, or
+        the gate demotes victims for heads admission would seat."""
+        shared, covered = ([], 0) if self._prefix is None else \
+            self._prefix.match(r.ids)
+        resume = min(covered, r.t0 - 1)
+        need = self._pages_needed(r.t0, r.max_new_tokens)
+        n_shared = len(shared)
+        cow = 1 if n_shared and resume // self.page_size < n_shared \
+            else 0
+        return shared, resume, need, cow, need - n_shared + cow
+
     def _admit(self):
         while self._queue:
             r = self._pick_next()
@@ -1250,14 +1312,8 @@ class ContinuousBatchingEngine(LLMEngine):
                 if self._demote_for(r):
                     continue           # oversubscription freed a slot
                 return
-            shared, covered = ([], 0) if self._prefix is None else \
-                self._prefix.match(r.ids)
-            resume = min(covered, r.t0 - 1)
-            need = self._pages_needed(r.t0, r.max_new_tokens)
+            shared, resume, need, cow, fresh = self._price_admission(r)
             n_shared = len(shared)
-            cow = 1 if n_shared and resume // self.page_size < n_shared \
-                else 0
-            fresh = need - n_shared + cow
             if fresh > self.allocator.available and self._prefix:
                 self._prefix.evict(fresh - self.allocator.available,
                                    self.allocator, protect=set(shared))
@@ -1266,7 +1322,7 @@ class ContinuousBatchingEngine(LLMEngine):
                 # pool (the CoW reserve, plus matched pages protected
                 # from eviction) — fall back to an unshared admission
                 # before concluding the request doesn't fit
-                shared, covered, resume, cow = [], 0, 0, 0
+                shared, resume, cow = [], 0, 0
                 n_shared = 0
                 fresh = need
                 if fresh > self.allocator.available and self._prefix:
@@ -2618,6 +2674,8 @@ class ContinuousBatchingEngine(LLMEngine):
         tok = int(tok)
         r.out.append(tok)
         r.tok = tok
+        r.idle_steps = 0                # progress: the demote-on-idle
+        #                                 clock restarts
         if self._tel is not None and len(r.out) == 1:
             # the TTFT host point: the first generated token became
             # visible to the host (an imported continuation arrives
@@ -2716,22 +2774,38 @@ class ContinuousBatchingEngine(LLMEngine):
                 "hd": self.hd, "layers": self.cfg.num_hidden_layers,
                 "kv_dtype": str(jnp.dtype(self.kv_dtype))}
 
-    def _package_pages(self, token, spec, lens, pages):
+    def _package_pages(self, token, spec, lens, pages, device=False):
         """CRC-stamped page-image payload — the one assembly shared by
         KV handoff, tier demotion, and prefix shipping: per-layer K/V
         blobs for `pages`, the cache geometry, checksums. Pools index
         identically in both forms (per-layer list, or the natively
-        stacked [L, ...] array of megakernel="multi")."""
-        from .handoff import checksum_payload
+        stacked [L, ...] array of megakernel="multi").
+
+        device=True is the negotiated ICI-class path (handoff.
+        DeviceTransport): blobs stay DEVICE arrays — no host readback,
+        no per-page CRC walk (the bytes never cross a host boundary;
+        the metadata CRC still stamps). Only valid when the importer
+        shares this engine's JAX runtime — `handoff.negotiate` is what
+        decides that."""
+        from .handoff import DeviceTransport, checksum_payload
         idx = np.asarray(pages, np.int64)
         k_blobs, v_blobs = [], []
         for li in range(self.cfg.num_hidden_layers):
-            k_blobs.append(np.asarray(self.k_pages[li][idx]))
-            v_blobs.append(np.asarray(self.v_pages[li][idx]))
-        return checksum_payload({
+            if device:
+                k_blobs.append(DeviceTransport.gather(self.k_pages[li],
+                                                      idx))
+                v_blobs.append(DeviceTransport.gather(self.v_pages[li],
+                                                      idx))
+            else:
+                k_blobs.append(np.asarray(self.k_pages[li][idx]))
+                v_blobs.append(np.asarray(self.v_pages[li][idx]))
+        payload = {
             "token": token, "spec": spec, "lens": lens,
             "geometry": self._kv_geometry(),
-            "k": k_blobs, "v": v_blobs})
+            "k": k_blobs, "v": v_blobs}
+        if device:
+            payload["transport"] = "device"
+        return checksum_payload(payload)
 
     def _sync_pending(self):
         """Apply a chained block still in flight so host state (lens,
@@ -2741,7 +2815,7 @@ class ContinuousBatchingEngine(LLMEngine):
             self._pending = None
             self._process_block(blk)
 
-    def export_kv_pages(self, uid):
+    def export_kv_pages(self, uid, device=False, transport=None):
         """Package a post-prefill request for migration to ANOTHER
         engine with zero recompute: resume identity (the export_request
         spec), cache length, and the raw K/V bytes of every page that
@@ -2752,7 +2826,18 @@ class ContinuousBatchingEngine(LLMEngine):
         carry a coherent KV image (mid-prefill pages are half-written;
         queued requests have none) — others raise ValueError, and the
         caller falls back to the spec-requeue salvage path (recompute,
-        never lost). `kv.export` is the fault point."""
+        never lost). `kv.export` is the fault point.
+
+        device=True: the negotiated device-domain export (see
+        _package_pages) — page blobs stay on device, `transport.device`
+        is its own fault point (an injected failure makes the router
+        fall back to the host-bounce path, pinned in tests).
+
+        transport=: the NEGOTIATED label for this export when the
+        host-format payload rides something other than the caller's
+        memory (the fleet's store transport) — it stamps the payload
+        and both telemetry legs, so a trace shows the transport that
+        actually ran, not "host" for every non-device path."""
         r = self._requests.get(uid)
         if r is None:
             raise UnknownRequestError(f"unknown request uid {uid}")
@@ -2765,6 +2850,8 @@ class ContinuousBatchingEngine(LLMEngine):
                 "a decode-state request carries a complete KV image "
                 "(use export_request for the spec-requeue path)")
         fault_point("kv.export", detail=f"uid={uid}")
+        if device:
+            fault_point("transport.device", detail=f"uid={uid}")
         p = self.page_size
         lens = int(self._lens_np[r.slot])
         n_used = -(-lens // p)
@@ -2780,10 +2867,28 @@ class ContinuousBatchingEngine(LLMEngine):
                 0.0, (spec["deadline"] - time.monotonic()) * 1e3)
             spec["deadline"] = None
         self._handoffs_out[uid] = token
+        label = transport or ("device" if device else "host")
         if self._tel is not None:
             self._tel.req_event(self._tel_src, uid, "kv_export",
-                                pages=len(used))
-        return self._package_pages(token, spec, lens, used)
+                                pages=len(used), transport=label)
+        try:
+            payload = self._package_pages(token, spec, lens, used,
+                                          device=device)
+        except Exception:
+            # post-ticket packaging failure (a real device gather /
+            # placement error, not the pre-ticket fault points): close
+            # the ticket here — the request keeps serving, and the
+            # caller's fallback must not find a stale token pinning
+            # these pages out of eviction
+            self.abort_handoff(uid)
+            raise
+        if not device:
+            # "device" is the only value verify_payload special-cases
+            # (metadata-only CRC); any other label keeps the full page
+            # CRC walk and just rides through to the importer's
+            # import_seat telemetry leg
+            payload["transport"] = label
+        return payload
 
     def abort_handoff(self, uid):
         """Cancel a pending export: the request keeps serving HERE."""
@@ -2956,7 +3061,9 @@ class ContinuousBatchingEngine(LLMEngine):
                                 max_new=remaining)
             self._tel.req_event(self._tel_src, r.uid, "import_seat",
                                 slot=slot, lens=lens,
-                                committed_tokens=gen)
+                                committed_tokens=gen,
+                                transport=payload.get("transport",
+                                                      "host"))
         if self._slot_used[slot]:
             self.slot_reuses += 1
         self._slot_used[slot] = True
@@ -3130,6 +3237,8 @@ class ContinuousBatchingEngine(LLMEngine):
             r.slot = slot
             r.state = DECODE
             r.seated_step = self.steps
+            r.idle_steps = 0            # a fresh seat restarts the
+            #                             demote-on-idle clock
             self._slots[slot] = r
             self._tables_np[slot] = 0
             self._tables_np[slot, :len(table)] = table
@@ -3195,6 +3304,57 @@ class ContinuousBatchingEngine(LLMEngine):
                 break               # one per step under queue pressure
         return did
 
+    def _idle_demote_sweep(self):
+        """DEMOTE-ON-IDLE (tier_idle_steps=N): park any seated decode
+        request that has waited N consecutive steps without emitting,
+        so its slot and device pages serve the QUEUED work it was
+        blocked alongside. Gated on a non-empty admission queue —
+        without waiting work, demoting would only bounce the request
+        through the restore sweep. Restore is byte-identical (the
+        PR 11 contract); a demote failure (kv.demote fault, tier write
+        error) leaves the victim serving and counts demote_errors."""
+        if self._tier is None or not self.tier_idle_steps or \
+                not self._queue:
+            return
+        # only when the queue head actually CANNOT seat: with a free
+        # slot and pages to spare, _admit (which runs next) seats it
+        # without anyone paying a demote/restore round trip. The gate
+        # must price the head the way _admit does — prefix-shared
+        # pages plus the CoW page, not the raw page count — or a head
+        # whose prompt is mostly cache-covered demotes a victim _admit
+        # never needed (eviction headroom stays _admit's business: a
+        # demote that eviction would have avoided is a tight-pool
+        # corner, not the every-step thrash this gate exists to stop)
+        head = self._pick_next()
+        if any(s is None for s in self._slots):
+            if self._pages_needed(head.t0, head.max_new_tokens) \
+                    <= self.allocator.available:
+                return                  # fits even without sharing —
+                #                         skip the prefix match (fresh
+                #                         <= need always, so this is
+                #                         the common-case early out
+                #                         that keeps the hot path to
+                #                         ONE match per step, _admit's)
+            _, _, _, _, fresh = self._price_admission(head)
+            if fresh <= self.allocator.available:
+                return
+        # one victim per step (the _demote_for rhythm): admission
+        # re-evaluates with the freed capacity, and the restore sweep
+        # trickles parked requests back one per step — demoting the
+        # whole idle set at once would be pure churn
+        victims = [r for r in self._slots
+                   if r is not None and r.state == DECODE
+                   and r.idle_steps >= self.tier_idle_steps
+                   and r.uid not in self._handoffs_out]
+        if not victims:
+            return
+        victim = max(victims, key=lambda r: r.idle_steps)
+        try:
+            self.demote_request(victim.uid)
+            self.idle_demotions += 1
+        except Exception:
+            self.demote_errors += 1
+
     def _demote_for(self, cand):
         """Oversubscription: demote the longest-resident running
         request at or below the candidate's priority so the candidate
@@ -3223,7 +3383,7 @@ class ContinuousBatchingEngine(LLMEngine):
             return False
 
     # -- prefix-page shipping (cache-aware routing's transfer path) ----------
-    def export_prefix_pages(self, ids):
+    def export_prefix_pages(self, ids, device=False):
         """Package this engine's cached full-page chain covering a
         prefix of `ids` for import into ANOTHER engine's prefix cache —
         the router's alternative to re-prefilling when the best-prefix
@@ -3232,7 +3392,9 @@ class ContinuousBatchingEngine(LLMEngine):
         export ticket holding its OWN references (the cache keeps
         serving them here, and PrefixCache.evict skips ticketed pages);
         the caller MUST settle the ticket: finish_prefix_export after a
-        landed import, abort_prefix_export otherwise."""
+        landed import, abort_prefix_export otherwise. device=True is
+        the negotiated same-runtime ship (no host bounce — see
+        _package_pages)."""
         if self._prefix is None:
             raise ValueError("export_prefix_pages: prefix cache disabled")
         ids = np.asarray(ids, np.int64).ravel()
@@ -3249,6 +3411,8 @@ class ContinuousBatchingEngine(LLMEngine):
         if not pages:
             return None
         fault_point("kv.export", detail=f"prefix:{len(pages)}")
+        if device:
+            fault_point("transport.device", detail="prefix")
         for pg in pages:
             self.allocator.share(pg)         # the ticket's own refs
         try:
@@ -3257,10 +3421,20 @@ class ContinuousBatchingEngine(LLMEngine):
             self.allocator.free(pages)
             raise
         covered = len(pages) * p
+        try:
+            payload = self._package_pages(
+                token, {"state": "prefix",
+                        "prompt": ids[:covered].copy()},
+                covered, pages, device=device)
+        except Exception:
+            # post-ticket packaging failure: the caller never receives
+            # the token, so abort_prefix_export is OURS to run — the
+            # ticket's share() refs would otherwise never drop (a hard
+            # page leak on every failed device-path ship)
+            self.abort_prefix_export(token)
+            raise
         self.prefix_exports += 1
-        return self._package_pages(
-            token, {"state": "prefix", "prompt": ids[:covered].copy()},
-            covered, pages)
+        return payload
 
     def finish_prefix_export(self, token):
         """Settle a landed prefix ship: the ticket's references drop

@@ -193,13 +193,17 @@ class MetricsRegistry:
         return dict(self._rates)
 
     def merge(self, other):
-        for name, h in other.hist.items():
+        # list() copies: `other` may be a LIVE registry another thread
+        # (the serving loop, a fleet mirror pull) is inserting into
+        # while a scrape thread merges — iterating the dict directly
+        # would raise "dictionary changed size during iteration"
+        for name, h in list(other.hist.items()):
             mine = self.hist.get(name)
             if mine is None:
                 mine = self.hist[name] = Histogram(h.buckets)
             mine.merge(h)
-        self.counters.update(other.counters)
-        for k, v in other._rates.items():
+        self.counters.update(dict(other.counters))
+        for k, v in list(other._rates.items()):
             self._rates[k] = self._rates.get(k, 0.0) + v
         return self
 
@@ -503,6 +507,31 @@ class Telemetry:
     def prometheus(self, prefix="paddle_tpu"):
         return self.registry.prometheus(prefix)
 
+    # -- cross-process state (the fleet pull) ---------------------------------
+    def state(self, full=True):
+        """Picklable snapshot of this telemetry's plane — registry
+        (histograms + counters) and, when `full`, traces and event
+        logs — the payload a fleet worker ships when the router pulls
+        its metrics (inference/fleet.py `telemetry_state`). Everything
+        in it is plain data (__slots__ classes, deques, Counters), so
+        the RPC framing's pickle carries it without custom reducers.
+
+        full=False is the metrics-pull shape: every scrape and
+        `EngineRouter.metrics()` call only consumes the registry +
+        health, so shipping hundreds of done traces, the live set,
+        the gevents ring, and the JSONL log per pull per worker would
+        be continuous redundant wire traffic — the trace plane ships
+        only on `sync_telemetry()` (the chrome-trace export path)."""
+        st = {"name": self.name,
+              "hist": dict(self.registry.hist),
+              "counters": collections.Counter(self.registry.counters)}
+        if full:
+            st.update(done=list(self.done),
+                      live=list(self._live.items()),
+                      gevents=list(self._gevents),
+                      log=list(self.log))
+        return st
+
     # -- exports -------------------------------------------------------------
     def chrome_trace(self):
         return chrome_trace([self])
@@ -569,6 +598,79 @@ class Telemetry:
 
         add_fault_hook(hook)
         self._fault_hook = hook
+
+
+class ReplicaTelemetryMirror(Telemetry):
+    """Router-side mirror of a PROCESS replica's telemetry: the object
+    `EngineRouter.metrics()/prometheus()/export_chrome_trace()` read
+    when the replica's engine lives in another process.
+
+    Each `install_state` pull replaces the mirror's registry contents
+    and traces with the worker's snapshot, merged over a BASE registry
+    that accumulates dead incarnations: when the worker is killed (or
+    respawned by a quarantine-probe rebuild), the last-known counts
+    fold into the base instead of vanishing — the PR 13 contract that
+    fleet p50/p95/p99 survive replica death, promoted to real process
+    boundaries. Rate sampling (`registry.sample`) stays LOCAL to the
+    mirror's registry object, so `<counter>_per_s` gauges keep their
+    baseline across pulls."""
+
+    def __init__(self, name):
+        super().__init__(name=name, capture_faults=False)
+        self._base = MetricsRegistry()
+        self._cur = None                # (incarnation, hist, counters)
+
+    def install_state(self, state):
+        if state is None:
+            return
+        inc = state.get("incarnation")
+        if self._cur is not None and self._cur[0] != inc:
+            self.fold_incarnation()     # the old worker is gone: keep
+            #                             its last-known counts (and
+            #                             drop the rate baseline — see
+            #                             fold_incarnation)
+        self._cur = (inc, state["hist"], state["counters"])
+        merged = MetricsRegistry()
+        merged.merge(self._base)
+        cur = MetricsRegistry()
+        cur.hist = state["hist"]
+        cur.counters = state["counters"]
+        merged.merge(cur)
+        # materialize into self.registry IN PLACE: the registry object
+        # identity (and its _last_sample rate baseline) must survive
+        # the refresh — it is what the router merges and samples
+        self.registry.hist = merged.hist
+        self.registry.counters = merged.counters
+        if "done" in state:             # a full pull (sync_telemetry);
+            #                             registry-only pulls keep the
+            #                             mirror's last-known traces
+            self.done = collections.deque(state["done"],
+                                          maxlen=self.done.maxlen)
+            self._live = dict(state["live"])
+            self._gevents = collections.deque(state["gevents"],
+                                              maxlen=4096)
+            self.log = collections.deque(state["log"],
+                                         maxlen=self.log.maxlen)
+
+    def fold_incarnation(self):
+        """Fold the current incarnation's last-known registry into the
+        base (called when the worker dies or respawns)."""
+        if self._cur is None:
+            return
+        _, hist, counters = self._cur
+        cur = MetricsRegistry()
+        cur.hist = hist
+        cur.counters = counters
+        self._base.merge(cur)
+        self._cur = None
+        # whatever incarnation reports next starts its counters near
+        # zero: sampling it against this one's baseline would export
+        # large NEGATIVE <counter>_per_s gauges — drop the baseline
+        # HERE so both fold paths (install_state's incarnation-change
+        # detection AND ProcessReplica.rebuild's explicit fold) skip
+        # one rate interval instead of spiking the dashboard
+        self.registry._last_sample = None
+        self.registry._rates = {}
 
 
 # -- chrome-trace (perfetto) export ------------------------------------------
@@ -647,3 +749,63 @@ def export_chrome_trace(path, telemetries):
     with open(path, "w") as f:
         json.dump(chrome_trace(telemetries), f)
     return path
+
+
+# -- Prometheus scrape endpoint ----------------------------------------------
+def serve_prometheus(source, port=0, host="127.0.0.1"):
+    """Serve `source.prometheus()` at /metrics over a stdlib
+    http.server THREAD — the scrape endpoint the PR 13 text exposition
+    was missing (serve_llama's --metrics-port; an EngineRouter, a
+    Telemetry, or anything with .prometheus() works as the source).
+
+    Returns the ThreadingHTTPServer: read the bound port from
+    `.server_address[1]` (port=0 picks an ephemeral one), stop with
+    `.shutdown()`. Each GET renders a FRESH exposition, so scraping a
+    fleet router also pulls its remote replicas' registries.
+
+    Renders are serialized (one lock per endpoint) and retried once on
+    RuntimeError: the source's registries are LIVE objects the serving
+    thread keeps mutating, and two concurrent scrapes of a fleet
+    router would race each other's mirror pulls."""
+    import http.server
+    import threading
+
+    render_lock = threading.Lock()
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.rstrip("/") not in ("", "/metrics"):
+                self.send_error(404)
+                return
+            try:
+                with render_lock:
+                    try:
+                        body = source.prometheus().encode()
+                    except RuntimeError:
+                        # dict mutated mid-iteration by the serving
+                        # thread: one retry re-reads a settled view
+                        body = source.prometheus().encode()
+            except Exception as e:      # noqa: BLE001 — scrape answer
+                self.send_error(500, f"{type(e).__name__}: {e}")
+                return
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):   # scrapes are not stdout news
+            pass
+
+    class _Server(http.server.ThreadingHTTPServer):
+        def shutdown(self):
+            # the documented stop is .shutdown() alone — close the
+            # listening socket with it, or every open/close cycle (a
+            # fleet restart, a test) leaks the bound fd until exit
+            super().shutdown()
+            self.server_close()
+
+    srv = _Server((host, int(port)), _Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv

@@ -1,0 +1,492 @@
+"""Process-backed replica fleet (ISSUE 14): the EngineReplica surface
+served over RPC/TCPStore — typed errors surviving the wire, the
+relative-deadline rebase, store-ledger salvage after a kill, a REAL
+2-process fleet byte-identical to the in-process router under kill -9,
+and the negotiated KV-handoff transports (device / store / host with
+loud tagging and fault fallback). The cross-process chaos soak is
+slow-marked.
+
+Tier-1 economy: most tests ride IN-THREAD EngineHost workers — the
+full wire path (sockets, framing, pickle, store rendezvous, ledger)
+without a process spawn per test; the one real-process test shares a
+single spawn for the kill -9 acceptance run.
+"""
+import os
+import signal
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import failsafe
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.inference.fleet import (EngineHost, FleetRPCError,
+                                        ProcessReplica, spawn_fleet)
+from paddle_tpu.inference.handoff import negotiate
+from paddle_tpu.inference.router import EngineRouter
+from paddle_tpu.inference.scheduler import (ContinuousBatchingEngine,
+                                            EngineBusyError,
+                                            RequestNotFinishedError,
+                                            UnknownRequestError)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def _micro_cfg():
+    # 1-layer micro geometry (the test_router rationale): the fleet's
+    # contracts are model-independent and every engine pays its own
+    # jit compiles
+    return LlamaConfig.tiny(num_hidden_layers=1, hidden_size=32,
+                            intermediate_size=64, num_attention_heads=2)
+
+
+ENGINE_KW = dict(max_len=64, page_size=8, max_batch=2, prefill_chunk=8)
+
+# the spec REAL worker processes build from (fleet.build_engine_from_
+# spec): same geometry + seed as the in-process fixture, so weights are
+# byte-identical across processes
+SPEC = {"model": {"preset": "tiny", "seed": 3, "num_hidden_layers": 1,
+                  "hidden_size": 32, "intermediate_size": 64,
+                  "num_attention_heads": 2},
+        "engine": dict(ENGINE_KW)}
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    paddle.seed(3)
+    cfg = _micro_cfg()
+    return LlamaForCausalLM(cfg), cfg
+
+
+def factory_for(model, **over):
+    kw = dict(ENGINE_KW)
+    kw.update(over)
+    return lambda: ContinuousBatchingEngine(model, **kw)
+
+
+def stream(cfg, n=4, seed=0):
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, cfg.vocab_size, (int(t),)).astype(np.int64)
+               for t in rng.randint(4, 14, n)]
+    budgets = [int(b) for b in rng.randint(3, 8, n)]
+    return prompts, budgets
+
+
+@pytest.fixture(scope="module")
+def reference(tiny):
+    model, cfg = tiny
+    prompts, budgets = stream(cfg)
+    eng = factory_for(model)()
+    return prompts, budgets, eng.generate_many(prompts,
+                                               max_new_tokens=budgets)
+
+
+@pytest.fixture(scope="module")
+def store():
+    return TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+
+
+def _thread_worker(model, name, store, **over):
+    """In-thread EngineHost: the full wire path minus the process
+    spawn (engine compiles still cost real time — share fixtures).
+    ledger_every=1 (not the production default 8): the ledger-salvage
+    assertions need the store fresh at the step the worker dies."""
+    host = EngineHost(factory_for(model, **over)(), name, store,
+                      ledger_every=1).start()
+    return host, ProcessReplica(name, store, call_timeout=60)
+
+
+@pytest.fixture(scope="module")
+def pair(tiny, store):
+    """Two long-lived thread workers + their replicas (non-destructive
+    tests only — killers build their own)."""
+    model, _ = tiny
+    hosts, reps = [], []
+    for i in range(2):
+        h, r = _thread_worker(model, f"p{i}", store)
+        hosts.append(h)
+        reps.append(r)
+    yield hosts, reps
+    for h in hosts:
+        h.stop()
+
+
+def assert_no_worker_leak(rep):
+    st = rep._call("alloc_stats")
+    assert st["available"] == st["n_pages"] - st["prefix_pages"], st
+
+
+class TestWire:
+    def test_typed_errors_survive_the_wire(self, tiny, store):
+        model, cfg = tiny
+        host, rep = _thread_worker(model, "wire0", store, queue_limit=1)
+        try:
+            with pytest.raises(UnknownRequestError):
+                rep.result(999)
+            with pytest.raises(UnknownRequestError) as ei:
+                rep.status(999)
+            # the worker-side traceback rides along as the cause chain
+            assert ei.value.__cause__ is not None
+            prompts, _ = stream(cfg, n=3, seed=5)
+            spec = {"prompt": prompts[0], "max_new_tokens": 4,
+                    "eos_token_id": None, "tenant": "default",
+                    "priority": None, "ttl_steps": None, "deadline": None}
+            uid = rep.submit(spec)
+            with pytest.raises(RequestNotFinishedError):
+                rep.result(uid)
+            rep.step()                  # seats the first request
+            rep.submit(dict(spec, prompt=prompts[1]))
+            # queue_limit=1 with one queued: typed backpressure crosses
+            # the wire as EngineBusyError, not a stringified
+            # RuntimeError
+            with pytest.raises(EngineBusyError):
+                rep.submit(dict(spec, prompt=prompts[2]))
+            while rep.has_work():
+                rep.step()
+            assert rep.status(uid) == "done"
+            assert rep.result(uid).size == prompts[0].size + 4
+        finally:
+            host.stop()
+
+    def test_deadline_ships_relative_and_rebases(self, tiny, store):
+        """The PR 10 relative-budget rule on the RPC plane: a spec's
+        absolute monotonic deadline never crosses the wire — submit
+        ships the remaining budget, the worker rebases on ITS clock,
+        and export_resume/the ledger ship it back as a budget again."""
+        model, cfg = tiny
+        host, rep = _thread_worker(model, "dl0", store)
+        try:
+            prompts, _ = stream(cfg, n=1, seed=6)
+            deadline = time.monotonic() + 5.0
+            uid = rep.submit({"prompt": prompts[0], "max_new_tokens": 8,
+                              "eos_token_id": None, "tenant": "default",
+                              "priority": None, "ttl_steps": None,
+                              "deadline": deadline})
+            # the wire form carries a remaining budget, not a clock
+            wire = rep._call("export_resume", uid)
+            assert wire["deadline"] is None
+            assert 3500 < wire["deadline_remaining_ms"] <= 5000
+            # the client-side landing rebases to THIS clock
+            spec = rep.export_resume(uid)
+            rem = spec["deadline"] - time.monotonic()
+            assert 3.0 < rem <= 5.0
+            # the store ledger obeys the same rule (kill -9 salvage
+            # must not import another host's clock)
+            led = rep._ledger()
+            assert led[uid]["deadline"] is None
+            assert led[uid]["deadline_remaining_ms"] <= 5000
+        finally:
+            host.stop()
+
+    def test_transport_negotiation_units(self, tiny, store, pair):
+        model, _ = tiny
+        _, reps = pair
+        # two in-process replicas share the router's device domain
+        a = EngineRouter(factory_for(model), replicas=2)
+        e0, e1 = (r.transport_endpoint() for r in a._replicas[:2])
+        assert negotiate(e0, e1) == "device"
+        # two workers on one fleet store negotiate the store transport
+        w0, w1 = (r.transport_endpoint() for r in reps)
+        assert w0["proc"] != w1["proc"]
+        assert negotiate(w0, w1) == "store"
+        # in-process <-> worker: host (the always-works fallback)
+        assert negotiate(e0, w0) == "host"
+        assert negotiate(None, w0) == "host"
+
+    def test_rpc_fault_point_is_injectable(self, pair):
+        _, reps = pair
+        with failsafe.inject("rpc.call", nth=1):
+            with pytest.raises(failsafe.InjectedFault):
+                reps[0].headroom()
+
+
+class TestFleetRouting:
+    def test_byte_identity_vs_single_engine(self, reference, pair):
+        prompts, budgets, ref = reference
+        _, reps = pair
+        router = EngineRouter(backends=reps)
+        uids = [router.add_request(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        router.drain()
+        for u, want in zip(uids, ref):
+            assert np.array_equal(router.result(u), want)
+        assert router.health()["failed"] == 0
+        for rep in reps:
+            assert_no_worker_leak(rep)
+
+    def test_metrics_cross_process_merge_and_schema(self, reference,
+                                                    pair):
+        """ProcessReplica.metrics() pulls the remote registries so the
+        router shows ONE fleet view — and the fleet-mode schema is
+        PINNED: renamed keys fail here, not on a dashboard."""
+        prompts, budgets, ref = reference
+        _, reps = pair
+        router = EngineRouter(backends=reps, telemetry=True)
+        uids = [router.add_request(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        router.drain()
+        m = router.metrics()
+        # top-level metrics schema (fleet mode == in-process mode)
+        assert sorted(m) == ["fleet", "replicas", "router"]
+        assert sorted(m["router"]) == [
+            "failovers", "handoff_failures", "held", "hot_swaps",
+            "kv_handoffs", "pending", "probes", "requeued", "steps",
+            "swap_rollbacks"]
+        assert sorted(m["replicas"]) == ["p0", "p1"]
+        # the merged fleet registry carries every replica's histograms
+        hist = m["fleet"]["histograms"]
+        for name in ("ttft_ms", "tpot_ms", "queue_wait_ms", "e2e_ms",
+                     "block_ms"):
+            assert name in hist, sorted(hist)
+        assert m["fleet"]["counters"]["requests_done"] == len(uids)
+        assert sum(s["histograms"].get("ttft_ms", {}).get("count", 0)
+                   for s in m["replicas"].values()) == len(uids)
+        # fleet-mode router.health() replica entry: the in-process
+        # schema plus the pinned worker block
+        h = router.health()["replicas"]["p0"]
+        assert sorted(h["worker"]) == ["incarnation", "pid",
+                                       "rpc_errors"]
+        # prometheus exposition spans the fleet
+        prom = router.prometheus()
+        assert "paddle_tpu_ttft_ms_bucket" in prom
+        assert "paddle_tpu_requests_done" in prom
+        # results still byte-identical with telemetry on
+        for u, want in zip(uids, ref):
+            assert np.array_equal(router.result(u), want)
+
+    def test_metrics_port_scrape(self, reference, pair):
+        """serve_llama --metrics-port: router.prometheus() over a
+        stdlib http.server thread, smoke-tested with a urllib GET."""
+        from paddle_tpu.inference.telemetry import serve_prometheus
+        prompts, budgets, _ = reference
+        _, reps = pair
+        router = EngineRouter(backends=reps, telemetry=True)
+        uids = [router.add_request(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        router.drain()
+        assert all(router.result(u) is not None for u in uids)
+        srv = serve_prometheus(router, port=0)
+        try:
+            port = srv.server_address[1]
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10).read()
+            text = body.decode()
+            assert "paddle_tpu_ttft_ms_bucket" in text
+            assert "paddle_tpu_requests_done" in text
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope", timeout=10)
+        finally:
+            srv.shutdown()
+
+
+class TestFailure:
+    def test_kill_worker_midstream_ledger_salvage(self, tiny, store,
+                                                  reference):
+        """An in-thread worker goes dark mid-stream (socket-level kill:
+        no replies, no cleanup — the kill -9 stand-in): the router's
+        failover salvages its requests from the STORE LEDGER with their
+        committed tokens, continuations land on the survivor, outputs
+        stay byte-identical, delivery stays exactly-once."""
+        model, _ = tiny
+        prompts, budgets, ref = reference
+        h0, r0 = _thread_worker(model, "kl0", store)
+        h1, r1 = _thread_worker(model, "kl1", store)
+        try:
+            router = EngineRouter(backends=[r0, r1],
+                                  probe_backoff=10_000)
+            uids = [router.add_request(p, max_new_tokens=b)
+                    for p, b in zip(prompts, budgets)]
+            for _ in range(5):
+                router.step()
+            live = [u for u in router._assigned["kl0"]
+                    if router._reqs[u].state in
+                    ("queued", "prefill", "decode")]
+            h0.kill_connections()
+            h0.stop()
+            # the dead worker's ledger still answers from the store
+            if live:
+                euid = router._reqs[live[0]].engine_uid
+                led_spec = r0.export_resume(euid)
+                assert led_spec["max_new_tokens"] >= 1
+            router.drain()
+            for u, want in zip(uids, ref):
+                assert np.array_equal(router.result(u), want)
+            assert router.health()["failed"] == 0
+            assert router.failovers >= 1
+            assert_no_worker_leak(r1)
+        finally:
+            h1.stop()
+
+    def test_probe_rebuild_respawns_worker(self, tiny, store):
+        """The router's quarantine-probe rebuild path over a process
+        backend: rebuild() respawns the worker (fresh incarnation) and
+        the replica serves again."""
+        model, cfg = tiny
+        h0, r0 = _thread_worker(model, "rb0", store)
+        holder = [h0]
+
+        def respawn():
+            holder.append(
+                EngineHost(factory_for(model)(), "rb0", store).start())
+        r0.respawn = respawn
+        try:
+            old_inc = r0._resolve()["incarnation"]
+            h0.kill_connections()
+            h0.stop()
+            with pytest.raises(FleetRPCError):
+                r0.headroom()
+            r0.rebuild()
+            assert r0._resolve()["incarnation"] != old_inc
+            prompts, _ = stream(cfg, n=1, seed=9)
+            uid = r0.submit({"prompt": prompts[0], "max_new_tokens": 3,
+                             "eos_token_id": None, "tenant": "default",
+                             "priority": None, "ttl_steps": None,
+                             "deadline": None})
+            while r0.has_work():
+                r0.step()
+            assert r0.result(uid).size == prompts[0].size + 3
+        finally:
+            for h in holder:
+                h.stop()
+
+
+class TestTransports:
+    def test_disagg_device_transport_in_process(self, tiny, reference):
+        """Co-located prefill/decode pools negotiate the DEVICE path:
+        pages never bounce through the host, the handoff is tagged
+        loudly, outputs stay byte-identical."""
+        model, _ = tiny
+        prompts, budgets, ref = reference
+        router = EngineRouter(factory_for(model),
+                              topology={"prefill": 1, "decode": 1},
+                              telemetry=True)
+        uids = [router.add_request(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        router.drain()
+        for u, want in zip(uids, ref):
+            assert np.array_equal(router.result(u), want)
+        assert router.kv_handoffs >= 1
+        assert router.handoff_transports["device"] == router.kv_handoffs
+        # the telemetry leg carries the transport tag
+        tagged = [at for tr in router.telemetry.done_traces()
+                  for _, n, at in tr.events if n == "handoff"]
+        assert tagged and all(at["transport"] == "device"
+                              for at in tagged)
+
+    def test_disagg_store_transport_across_workers(self, tiny, store,
+                                                   reference):
+        """Workers on one fleet store negotiate the chunked
+        StoreKVTransport: only a handle crosses the RPC plane, the
+        decode continuation is byte-identical, no pages leak on either
+        side."""
+        model, _ = tiny
+        prompts, budgets, ref = reference
+        h0, r0 = _thread_worker(model, "sx0", store)
+        h1, r1 = _thread_worker(model, "sx1", store)
+        try:
+            router = EngineRouter(backends=[r0, r1],
+                                  topology={"prefill": 1, "decode": 1})
+            uids = [router.add_request(p, max_new_tokens=b)
+                    for p, b in zip(prompts, budgets)]
+            router.drain()
+            for u, want in zip(uids, ref):
+                assert np.array_equal(router.result(u), want)
+            assert router.kv_handoffs >= 1
+            assert router.handoff_transports["store"] == \
+                router.kv_handoffs
+            for rep in (r0, r1):
+                assert_no_worker_leak(rep)
+        finally:
+            h0.stop()
+            h1.stop()
+
+    def test_device_fault_falls_back_to_host(self, tiny, reference):
+        """transport.device fault: the device export fails, the SAME
+        handoff retries over the host-bounce path — negotiation is an
+        optimization, never a new way to lose a request."""
+        model, _ = tiny
+        prompts, budgets, ref = reference
+        router = EngineRouter(factory_for(model),
+                              topology={"prefill": 1, "decode": 1})
+        uids = [router.add_request(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        with failsafe.inject("transport.device", p=1.0, seed=0):
+            router.drain()
+        for u, want in zip(uids, ref):
+            assert np.array_equal(router.result(u), want)
+        assert router.kv_handoffs >= 1
+        assert router.handoff_transports["host"] == router.kv_handoffs
+        assert router.handoff_transports["device"] == 0
+        assert router.handoff_failures >= 1
+
+
+class TestProcessFleet:
+    def test_two_process_fleet_kill9(self, reference):
+        """The acceptance run: a REAL 2-process fleet behind one
+        router, one worker killed -9 mid-stream — greedy outputs
+        byte-identical to the single-process fleet, exactly-once
+        delivery, zero page leak on the survivor."""
+        prompts, budgets, ref = reference
+        handle = spawn_fleet(SPEC, 2)
+        try:
+            router = EngineRouter(backends=handle.replicas,
+                                  prefix_index=handle.prefix_index,
+                                  probe_backoff=10_000)
+            uids = [router.add_request(p, max_new_tokens=b)
+                    for p, b in zip(prompts, budgets)]
+            for _ in range(4):
+                router.step()
+            victim = handle.procs[0]
+            os.kill(victim.pid, signal.SIGKILL)
+            victim.join()
+            router.drain()
+            for u, want in zip(uids, ref):
+                assert np.array_equal(router.result(u), want)
+            assert router.health()["failed"] == 0
+            assert router.failovers >= 1
+            assert router.duplicates_dropped == 0
+            assert_no_worker_leak(handle.replicas[1])
+        finally:
+            handle.shutdown()
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    def test_process_disagg_chaos_zero_lost(self, reference):
+        """Cross-process chaos: a real 1 prefill + 2 decode process
+        fleet under seeded rpc.call faults AND a real SIGKILL — every
+        request delivers exactly once, byte-identical to the
+        single-engine reference, zero page leak on every survivor."""
+        prompts, budgets, ref = reference
+        handle = spawn_fleet(SPEC, 3,
+                             roles=["prefill", "decode", "decode"])
+        try:
+            router = EngineRouter(
+                backends=handle.replicas,
+                topology={"prefill": 1, "decode": 2},
+                probe_backoff=10_000, quarantine_threshold=4)
+            uids = [router.add_request(p, max_new_tokens=b)
+                    for p, b in zip(prompts, budgets)]
+            killed = False
+            steps = 0
+            with failsafe.inject("rpc.call", p=0.02, seed=7,
+                                 times=3):
+                while router.pending():
+                    router.step()
+                    steps += 1
+                    if steps == 6 and not killed:
+                        victim = handle.procs[2]
+                        os.kill(victim.pid, signal.SIGKILL)
+                        victim.join()
+                        killed = True
+            router.drain()
+            for u, want in zip(uids, ref):
+                assert np.array_equal(router.result(u), want)
+            assert router.health()["failed"] == 0
+            for rep in handle.replicas[:2]:
+                assert_no_worker_leak(rep)
+        finally:
+            handle.shutdown()

@@ -312,6 +312,57 @@ class TestDemoteRestore:
         h = eng.health()
         assert h["kv_tier"] == "host" and h["demotions"] == eng.demotions
 
+    def test_demote_on_idle_byte_identity(self, tiny):
+        """tier_idle_steps=N (ISSUE 14 satellite, the ROADMAP item 2
+        demote-on-idle follow-up): a seated decode request that waits
+        N consecutive steps without emitting — blocked behind another
+        prompt's prefill — demotes WITHOUT page pressure (oversubscribe
+        off), frees its slot for queued work, and restores
+        byte-identically."""
+        model, cfg = tiny
+        rng = np.random.RandomState(31)
+        pa = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int64)
+        pb = rng.randint(0, cfg.vocab_size, (20,)).astype(np.int64)
+        pc = rng.randint(0, cfg.vocab_size, (5,)).astype(np.int64)
+        ref = _mk(model, prefill_chunk=4)
+        want = ref.generate_many([pa, pb, pc], max_new_tokens=8)
+
+        eng = _mk(model, kv_tier="host", oversubscribe=False,
+                  tier_idle_steps=1, prefill_chunk=4)
+        ua = eng.add_request(pa, max_new_tokens=8)
+        for _ in range(4):
+            eng.step()                  # A seats and emits a couple
+        ub = eng.add_request(pb, max_new_tokens=8)   # long prefill
+        uc = eng.add_request(pc, max_new_tokens=8)   # queued waiter
+        eng.drain()
+        assert eng.idle_demotions >= 1, "idle demotion never fired"
+        assert eng.restores == eng.demotions
+        for u, w in zip((ua, ub, uc), want):
+            np.testing.assert_array_equal(eng.result(u), w)
+        assert_no_leak(eng)
+
+    def test_demote_on_idle_needs_tier_and_queue(self, tiny):
+        model, cfg = tiny
+        with pytest.raises(ValueError):
+            _mk(model, tier_idle_steps=2)           # no tier to park in
+        rng = np.random.RandomState(37)
+        eng = _mk(model, kv_tier="host", oversubscribe=False,
+                  tier_idle_steps=1, prefill_chunk=4)
+        ua = eng.add_request(
+            rng.randint(0, cfg.vocab_size, (6,)).astype(np.int64),
+            max_new_tokens=6)
+        for _ in range(3):
+            eng.step()
+        # an idle counter without QUEUED work never demotes (that
+        # would just thrash the restore sweep)
+        ub = eng.add_request(
+            rng.randint(0, cfg.vocab_size, (18,)).astype(np.int64),
+            max_new_tokens=6)
+        eng.drain()
+        assert eng.status(ua) == "done" and eng.status(ub) == "done"
+        assert eng.idle_demotions == 0
+        assert_no_leak(eng)
+
     def test_demote_fault_leaves_request_serving(self, tiny):
         model, cfg = tiny
         rng = np.random.RandomState(29)

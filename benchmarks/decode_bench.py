@@ -1262,6 +1262,117 @@ def main():
                "unit": "ms",
                "error": f"{type(e).__name__}: {e}"})
 
+    # -- multi-LoRA adapter serving: the marginal cost of a fine-tune ----
+    # cb_lora (docs/serving.md "Multi-LoRA & the model zoo"): steady
+    # decode tokens/s with 1/4/16 DISTINCT adapters spread across a
+    # 16-slot batch vs the same engine serving base weights only, and
+    # adapter_overhead_frac = 1 - adapters/base — the price of the
+    # grouped low-rank delta (two batched rank-R matmuls per target per
+    # layer). The mixed-batch byte-identity pin is asserted IN-BENCH
+    # (rows under adapter a0 match a dedicated single-adapter engine).
+    # Micro 1-layer geometry: the claim is the DELTA PATH's relative
+    # cost, absolute device speed rides the main sections. Own rc=0
+    # guard like every section.
+    try:
+        from paddle_tpu.inference.adapters import make_lora_adapter
+        paddle.seed(11)
+        lo_cfg = LlamaConfig.tiny(num_hidden_layers=1, hidden_size=32,
+                                  intermediate_size=64,
+                                  num_attention_heads=4,
+                                  num_key_value_heads=2)
+        lo_model = LlamaForCausalLM(lo_cfg)
+        lo_kw = dict(max_len=64, page_size=8, max_batch=16,
+                     prefill_chunk=8, decode_block=8,
+                     slot_buckets=(16,), megakernel=False,
+                     adapters={"rank": 8, "max_adapters": 16})
+        lo_rng = np.random.RandomState(23)
+        lo_prompts = [lo_rng.randint(0, lo_cfg.vocab_size, (8,))
+                      .astype(np.int64) for _ in range(16)]
+        lo_new = 24
+        lo_ads = {f"lo{i}": make_lora_adapter(lo_cfg, rank=8, seed=40 + i)
+                  for i in range(16)}
+
+        def _lora_run(n_adapters):
+            eng = ContinuousBatchingEngine(lo_model, **lo_kw)
+            names = list(lo_ads)[:n_adapters]
+            for nm in names:
+                eng.load_adapter(nm, lo_ads[nm])
+            # warm BOTH programs outside the timed window: the plain
+            # fused block AND (when adapters ride) the adapter-aware
+            # variant — otherwise the adapter cells bill their jit
+            # compile as "overhead" and the frac reads compile time
+            warm_u = [eng.add_request((p + 1) % 256, max_new_tokens=2,
+                                      adapter=(names[i % len(names)]
+                                               if names else None))
+                      for i, p in enumerate(lo_prompts)]
+            eng.drain()
+            for u in warm_u:
+                eng.result(u)
+            uids = []
+            t0_ = time.perf_counter()
+            for i, p in enumerate(lo_prompts):
+                ad = names[i % len(names)] if names else None
+                uids.append(eng.add_request(p, max_new_tokens=lo_new,
+                                            adapter=ad))
+            eng.drain()
+            wall = time.perf_counter() - t0_
+            outs = [eng.result(u) for u in uids]
+            toks = sum(o.size for o in outs) - sum(p.size
+                                                   for p in lo_prompts)
+            return outs, toks / max(wall, 1e-9), eng
+
+        _, base_tps, _ = _lora_run(0)
+        for n_ad in (1, 4, 16):
+            outs, tps, eng = _lora_run(n_ad)
+            if n_ad == 1:
+                # the mixed-batch pin, in-bench, on a GENUINELY mixed
+                # batch (the measured cells are uniform — every row
+                # adapterized — so they cannot exercise the base-row
+                # where-gate): lo0 on even rows, base on odd; lo0 rows
+                # must match a dedicated lo0-only engine, base rows a
+                # no-adapter engine
+                mx = ContinuousBatchingEngine(lo_model, **lo_kw)
+                mx.load_adapter("lo0", lo_ads["lo0"])
+                mu = [mx.add_request(p, max_new_tokens=lo_new,
+                                     adapter=("lo0" if i % 2 == 0
+                                              else None))
+                      for i, p in enumerate(lo_prompts)]
+                mx.drain()
+                ded = ContinuousBatchingEngine(lo_model, **lo_kw)
+                ded.load_adapter("lo0", lo_ads["lo0"])
+                du = [ded.add_request(p, max_new_tokens=lo_new,
+                                      adapter="lo0")
+                      for p in lo_prompts[0::2]]
+                ded.drain()
+                plain = ContinuousBatchingEngine(lo_model, **lo_kw)
+                pu = [plain.add_request(p, max_new_tokens=lo_new)
+                      for p in lo_prompts[1::2]]
+                plain.drain()
+                want = {}
+                for i, u in zip(range(0, len(lo_prompts), 2), du):
+                    want[i] = ded.result(u)
+                for i, u in zip(range(1, len(lo_prompts), 2), pu):
+                    want[i] = plain.result(u)
+                for i, u in enumerate(mu):
+                    a, b = mx.result(u), want[i]
+                    assert a.shape == b.shape and (a == b).all(), (
+                        f"mixed-batch request {i} diverged from its "
+                        "dedicated-engine reference — the byte-"
+                        "identity pin failed in-bench")
+            _emit({"metric": "cb_lora_tokens_per_sec",
+                   "adapters_in_batch": n_ad,
+                   "model": "llama-micro", "requests": len(lo_prompts),
+                   "value": round(tps, 2),
+                   "base_tokens_per_sec": round(base_tps, 2),
+                   "adapter_overhead_frac": round(
+                       max(0.0, 1.0 - tps / max(base_tps, 1e-9)), 3),
+                   "adapter_rank": 8,
+                   "unit": "tokens/s"})
+    except Exception as e:  # noqa: BLE001 — bench must stay rc=0
+        _emit({"metric": "cb_lora_tokens_per_sec", "value": 0.0,
+               "unit": "tokens/s",
+               "error": f"{type(e).__name__}: {e}"})
+
 
 if __name__ == "__main__":
     main()

@@ -176,6 +176,35 @@ def main():
                          "TPOT/queue-wait p50+p99, counters, and "
                          "rate-converted health() deltas "
                          "(docs/observability.md)")
+    ap.add_argument("--adapters", metavar="NAME=PATH,...", default=None,
+                    help="multi-LoRA serving: load each NAME=PATH LoRA "
+                         "adapter into the engine's paged adapter pool "
+                         "(a path that does not exist yet is CREATED "
+                         "as a random rank---adapter-rank adapter "
+                         "first — a self-contained round-trip demo, "
+                         "like --hot-swap); deploying to a router/"
+                         "fleet is ONE registry write fanned to every "
+                         "replica (docs/serving.md \"Multi-LoRA & the "
+                         "model zoo\")")
+    ap.add_argument("--adapter-rotate", action="store_true",
+                    help="round-robin the demo requests across the "
+                         "--adapters names (plus one base-weights "
+                         "request), demonstrating a MIXED batch — "
+                         "byte-identical to per-adapter dedicated "
+                         "engines; works under --fleet via the "
+                         "ProcessReplica registry write path")
+    ap.add_argument("--adapter-rank", type=int, default=8,
+                    help="rank of the adapter pool (and of the demo "
+                         "adapters created for missing --adapters "
+                         "paths)")
+    ap.add_argument("--calibrate", metavar="NPZ", default=None,
+                    help="PTQ: run quantization.ptq.calibrate over the "
+                         "model on a small sample stream, save the "
+                         "per-channel int8 scales to NPZ, and serve "
+                         "through quant='int8' WITH them (implies "
+                         "--quant int8) — the model-zoo deploy shape: "
+                         "one base checkpoint, calibrated once, N "
+                         "adapters on top")
     ap.add_argument("--megakernel", choices=["auto", "off", "layer",
                                              "multi"], default="auto",
                     help="decode megakernel: one fused Pallas kernel "
@@ -232,7 +261,7 @@ def main():
                           max_batch=max(2, g["bs"]),
                           quant=(None if args.quant == "none"
                                  else args.quant),
-                          decode_block=args.decode_block)
+                          decode_block=args.decode_block, **ad_kw)
         if args.tp > 1:
             # workers inherit the parent env (device count flags), so
             # TP shards inside each worker exactly like the in-process
@@ -248,6 +277,63 @@ def main():
                                          args.kv_tier == "disk"
                                          else None))
         return {"model": model_spec, "engine": engine_spec}
+
+    # -- multi-LoRA adapters (docs/serving.md "Multi-LoRA & the model
+    # -- zoo"): parse NAME=PATH pairs, create missing demo adapters,
+    # -- and round-robin requests across them under --adapter-rotate
+    adapter_list = []
+    if args.adapters:
+        for item in args.adapters.split(","):
+            name, _, path = item.partition("=")
+            if not name.strip() or not path.strip():
+                ap.error("--adapters expects NAME=PATH[,NAME=PATH...]")
+            adapter_list.append((name.strip(), path.strip()))
+    if adapter_list and not (args.scheduler or args.replicas > 1
+                             or args.disagg or args.fleet
+                             or args.fleet_worker):
+        ap.error("--adapters needs a continuous-batching mode "
+                 "(--scheduler, --replicas N, --disagg P:D, or "
+                 "--fleet N) — the static LLMEngine path has no "
+                 "adapter pool")
+    ad_kw = ({"adapters": {"rank": args.adapter_rank,
+                           "max_adapters": max(4, len(adapter_list))}}
+             if adapter_list else {})
+
+    def ensure_adapter_files():
+        """Missing --adapters paths are created as random adapters of
+        the engine geometry first (self-contained round trip, the
+        --hot-swap pattern) — a real deploy points at fine-tune
+        artifacts written by adapters.save_adapter."""
+        from paddle_tpu.inference.adapters import (make_lora_adapter,
+                                                   save_adapter)
+        for i, (name, path) in enumerate(adapter_list):
+            if not os.path.isdir(path):
+                save_adapter(path, make_lora_adapter(
+                    g["cfg"], rank=args.adapter_rank, seed=100 + i))
+                print(f"  adapter {name}: wrote random "
+                      f"rank-{args.adapter_rank} demo adapter -> {path}")
+
+    def adapter_for(i):
+        """Adapter name for demo request i: round-robin over base +
+        every named adapter (--adapter-rotate), else the first name
+        (single-fine-tune deploy)."""
+        if not adapter_list:
+            return None
+        if args.adapter_rotate:
+            names = [None] + [n for n, _ in adapter_list]
+            return names[i % len(names)]
+        return adapter_list[0][0]
+
+    def deploy_adapters(target):
+        """The ONE deploy sequence every branch runs: materialize
+        missing demo files, then one registry write per adapter on the
+        target (an engine prints its pool slot, a router its
+        per-replica summary)."""
+        if not adapter_list:
+            return
+        ensure_adapter_files()
+        for name, path in adapter_list:
+            print(f"  adapter {name}: {target.load_adapter(name, path)}")
 
     if args.fleet_worker:
         # multi-host mode: one of these per host, all pointing at the
@@ -286,6 +372,29 @@ def main():
         weight_dtype = None
 
     quant = None if args.quant == "none" else args.quant
+    # PTQ calibration (quantization/ptq.py): observe the model, save the
+    # per-channel int8 scales, serve int8 WITH them — byte-identical to
+    # the absmax-from-weights engine (the observers reduce identically),
+    # which is the point: the zoo path swaps in any later calibration
+    # without touching the serving stack
+    quant_scales = None
+    if args.calibrate:
+        if args.fleet:
+            ap.error("--calibrate needs an in-process model (fleet "
+                     "workers build their own engines; calibrate once, "
+                     "ship the NPZ, load via quant_scales=)")
+        if args.model == "7b":
+            ap.error("--calibrate runs eager forwards (calibrate the "
+                     "checkpoint before meta-init serving)")
+        from paddle_tpu.quantization import ptq
+        c_rng = np.random.RandomState(42)
+        batches = [c_rng.randint(0, g["cfg"].vocab_size, (2, 12))
+                   for _ in range(4)]
+        quant_scales = ptq.calibrate(model, sample_batches=batches)
+        quant_scales.save(args.calibrate)
+        quant = args.quant = "int8"
+        print(f"  PTQ: calibrated {len(batches)} batches -> "
+              f"{args.calibrate} (serving int8 with calibrated scales)")
     # observability (docs/observability.md): --trace-out/--metrics-every
     # turn the telemetry plane on; router modes aggregate per-replica
     # registries into the fleet view printed/exported below
@@ -375,12 +484,16 @@ def main():
                                   prefix_index=handle.prefix_index,
                                   telemetry=want_tel)
             srv = metrics_endpoint(router)
+            # registry write over the ProcessReplica RPC surface:
+            # every worker hot-loads from the shared path
+            deploy_adapters(router)
             rng = np.random.RandomState(0)
             prompts = [rng.randint(0, g["cfg"].vocab_size, (t,))
                        .astype(np.int64) for t in (16, 9, 5, 12)]
             uids = [router.add_request(p,
-                                       max_new_tokens=args.max_new_tokens)
-                    for p in prompts]
+                                       max_new_tokens=args.max_new_tokens,
+                                       adapter=adapter_for(i))
+                    for i, p in enumerate(prompts)]
             drive_router(router)
             router_trace_out(router)
             h = router.health()
@@ -417,19 +530,22 @@ def main():
             return ContinuousBatchingEngine(
                 model, max_len=g["max_len"], page_size=g["page"],
                 max_batch=max(2, g["bs"]), quant=quant,
-                weight_dtype=weight_dtype,
-                decode_block=args.decode_block, **tp_kw, **tier_kw)
+                quant_scales=quant_scales, weight_dtype=weight_dtype,
+                decode_block=args.decode_block, **tp_kw, **tier_kw,
+                **ad_kw)
 
         router = EngineRouter(factory,
                               topology={"prefill": p_n, "decode": d_n},
                               prefix_routing=args.prefix_routing,
                               telemetry=want_tel)
         srv = metrics_endpoint(router)
+        deploy_adapters(router)
         rng = np.random.RandomState(0)
         prompts = [rng.randint(0, g["cfg"].vocab_size, (t,))
                    .astype(np.int64) for t in (16, 9, 5, 12)]
-        uids = [router.add_request(p, max_new_tokens=args.max_new_tokens)
-                for p in prompts]
+        uids = [router.add_request(p, max_new_tokens=args.max_new_tokens,
+                                   adapter=adapter_for(i))
+                for i, p in enumerate(prompts)]
         drive_router(router)
         router_trace_out(router)
         h = router.health()
@@ -457,13 +573,15 @@ def main():
             return ContinuousBatchingEngine(
                 model, max_len=g["max_len"], page_size=g["page"],
                 max_batch=max(2, g["bs"]), quant=quant,
-                weight_dtype=weight_dtype,
-                decode_block=args.decode_block, **tp_kw, **tier_kw)
+                quant_scales=quant_scales, weight_dtype=weight_dtype,
+                decode_block=args.decode_block, **tp_kw, **tier_kw,
+                **ad_kw)
 
         router = EngineRouter(factory, replicas=args.replicas,
                               prefix_routing=args.prefix_routing,
                               telemetry=want_tel)
         srv = metrics_endpoint(router)
+        deploy_adapters(router)
         rng = np.random.RandomState(0)
         prompts = [rng.randint(0, g["cfg"].vocab_size, (t,))
                    .astype(np.int64) for t in (16, 9, 5, 12)]
@@ -484,8 +602,9 @@ def main():
                 for p in prompts[1:]]
         else:
             uids = [router.add_request(
-                p, max_new_tokens=args.max_new_tokens)
-                for p in prompts]
+                p, max_new_tokens=args.max_new_tokens,
+                adapter=adapter_for(i))
+                for i, p in enumerate(prompts)]
         for _ in range(2):
             router.step()                    # replicas mid-flight
         if args.hot_swap:
@@ -532,7 +651,7 @@ def main():
         engine = ContinuousBatchingEngine(
             model, max_len=g["max_len"], page_size=g["page"],
             max_batch=max(2, g["bs"]), quant=quant,
-            weight_dtype=weight_dtype,
+            quant_scales=quant_scales, weight_dtype=weight_dtype,
             queue_limit=args.queue_limit,
             default_deadline_ms=args.deadline_ms,
             decode_block=args.decode_block,
@@ -543,24 +662,29 @@ def main():
             # the tq>1 verify schedule / per-shard segments itself
             megakernel={"auto": None, "off": False}.get(args.megakernel,
                                                         args.megakernel),
-            telemetry=tel, **tp_kw, **tier_kw)
+            telemetry=tel, **tp_kw, **tier_kw, **ad_kw)
+        deploy_adapters(engine)
         rng = np.random.RandomState(0)
         # ragged prompts; 1 shares 0's prefix (once 0 finishes prefill,
         # the cache turns the shared pages into refcounted read-only
-        # references — request 1 skips that prefill work entirely)
+        # references — request 1 skips that prefill work entirely;
+        # adapter-carrying requests never share — their KV carries the
+        # adapter's deltas)
         base = rng.randint(0, g["cfg"].vocab_size, (16,)).astype(np.int64)
         prompts = [base, base[:9],
                    rng.randint(0, g["cfg"].vocab_size, (5,))
                    .astype(np.int64)]
         submitted = [(0, engine.add_request(
-            prompts[0], max_new_tokens=args.max_new_tokens))]
+            prompts[0], max_new_tokens=args.max_new_tokens,
+            adapter=adapter_for(0)))]
         while engine._requests[submitted[0][1]].state in ("queued",
                                                           "prefill"):
             engine.step()            # request 0 publishes its pages
         for i, p in enumerate(prompts[1:], start=1):
             try:
                 submitted.append((i, engine.add_request(
-                    p, max_new_tokens=args.max_new_tokens)))
+                    p, max_new_tokens=args.max_new_tokens,
+                    adapter=adapter_for(i))))
             except EngineBusyError as e:
                 # bounded queue: backpressure is a client-visible signal,
                 # not an engine crash
@@ -606,6 +730,12 @@ def main():
         h = engine.health()
         print(f"  health: {h['done']} done / {h['failed']} failed, "
               f"{h['pages_free']}/{h['pages_total']} pages free")
+        if adapter_list:
+            a = h["adapters"]
+            print(f"  adapters: {a['loaded']} loaded "
+                  f"({a['pages_total'] - a['pages_free']}/"
+                  f"{a['pages_total']} pool pages), per-adapter "
+                  f"requests {a['requests']}, tokens {a['tokens']}")
         if args.kv_tier:
             print(f"  kv tier ({h['kv_tier']}): {h['demotions']} "
                   f"demotions / {h['restores']} restores "
@@ -621,7 +751,7 @@ def main():
 
     engine = LLMEngine(model, max_len=g["max_len"], page_size=g["page"],
                        max_batch=g["bs"],
-                       quant=quant,
+                       quant=quant, quant_scales=quant_scales,
                        weight_dtype=weight_dtype, **tp_kw)
 
     rng = np.random.RandomState(0)

@@ -385,6 +385,14 @@ _SERVING_EXPORTS = {
     # host/disk tier store
     "PrefixIndex": "prefix_index", "StorePrefixIndex": "prefix_index",
     "KVTierStore": "tiering", "KVTierError": "tiering",
+    # multi-LoRA adapter serving (docs/serving.md "Multi-LoRA & the
+    # model zoo"): paged adapter pool, grouped delta math, snapshot
+    # save/load, typed errors
+    "AdapterPool": "adapters", "AdapterError": "adapters",
+    "AdapterFullError": "adapters", "AdapterCorruptError": "adapters",
+    "UnknownAdapterError": "adapters", "make_lora_adapter": "adapters",
+    "save_adapter": "adapters", "load_adapter_file": "adapters",
+    "AdapterDeployError": "router",
     # serving telemetry plane (docs/observability.md): per-request
     # lifecycle tracing, latency histograms, fleet metrics export
     "Telemetry": "telemetry", "MetricsRegistry": "telemetry",

@@ -1,0 +1,515 @@
+"""Multi-LoRA adapter serving: a paged adapter pool beside the KV pool.
+
+One engine, many fine-tunes (ROADMAP item 3; the Gemma-on-TPU serving
+comparison in PAPERS.md makes adapter-sliced serving the TPU cost/
+throughput case): LoRA A/B factors live in a page-granular pool with
+the SAME allocator discipline as the KV pages — refcounted pages via
+`serving.PageAllocator`, LRU eviction of idle adapters under pressure,
+typed `AdapterFullError` backpressure — and each decode/prefill/verify
+dispatch applies the batched low-rank delta
+
+    y += where(aid > 0,  (x · A[aid]) · B[aid] · (alpha / r),  0)
+
+after the shared q/k/v/gate/up/down projections. Rows are GROUPED by
+adapter through the gather (the bgmv shape: every adapter's factors are
+fetched once into the batched einsum, rows with the same `aid` read the
+same block), f32 accumulate, and the `where` gate keeps `adapter=None`
+rows bit-exact — a mixed batch is byte-identical to running each
+adapter's requests on a dedicated engine, and the no-adapter engine is
+byte-identical to an engine with no pool at all (pinned in
+tests/test_adapters.py).
+
+Deployment is a REGISTRY WRITE, not a fleet swap: adapters load through
+the PR 8 snapshot surface (`save_adapter`/`load_adapter_file`: CRC32
+manifest + per-leaf shape verification against the pool geometry before
+anything installs), `engine.load_adapter(name, path)` hot-loads into
+the pool (`adapter.load` is the fault point — it fires PRE-install, so
+a failed load leaves the pool untouched and the engine serving on base
+weights), and `EngineRouter.load_adapter` / the fleet's
+`ProcessReplica` RPC surface fan the registry write across replicas.
+
+Tensor parallelism (tp_mode="exact" only): the factor carrying a
+projection's SHARDED output axis shards with it — B of q/k/v/gate/up
+column-shards its out axis exactly like the projections themselves —
+while A (fed by the replicated post-norm activations) and the down
+pair's factors stay replicated, mirroring the o/down exact-mode weight
+placement. Byte-identity with tp=1 survives because the delta math runs
+at the projections' own sharded shapes.
+
+See docs/serving.md "Multi-LoRA & the model zoo".
+"""
+import collections
+import json
+import os
+import time
+
+import numpy as np
+import jax.numpy as jnp
+
+
+# LoRA targets: the projections whose outputs take a low-rank delta.
+# o_proj is deliberately absent (the common LoRA recipe, and the delta
+# of the attention OUTPUT is representable through wv/wq anyway);
+# quantization targets all seven + the head (quantization/ptq.py).
+ADAPTER_TARGETS = ("wq", "wk", "wv", "wg", "wu", "wd")
+
+
+class AdapterError(RuntimeError):
+    """Base of the adapter subsystem's typed errors."""
+
+
+class AdapterFullError(AdapterError):
+    """Backpressure: the adapter pool cannot take another adapter right
+    now — every installed adapter is referenced by live requests, so
+    nothing is LRU-evictable. Retry after retirements (nothing was
+    installed, the pool is untouched)."""
+
+
+class AdapterCorruptError(AdapterError):
+    """An adapter file failed CRC/shape/metadata verification — rejected
+    BEFORE anything touched the pool (zero page leak)."""
+
+
+class UnknownAdapterError(AdapterError, KeyError):
+    """An adapter name this engine has never loaded (and that is not in
+    its registry for a lazy hot-load)."""
+
+    def __str__(self):              # KeyError repr-quotes its arg
+        return self.args[0] if self.args else ""
+
+
+def target_dims(hidden, ffn, nh, nh_kv, hd):
+    """(in_dim, out_dim) per LoRA target for one transformer layer —
+    the SHAPE CONTRACT a pool verifies adapter files against."""
+    return {"wq": (hidden, nh * hd), "wk": (hidden, nh_kv * hd),
+            "wv": (hidden, nh_kv * hd), "wg": (hidden, ffn),
+            "wu": (hidden, ffn), "wd": (ffn, hidden)}
+
+
+def engine_target_dims(cfg):
+    """target_dims from a LlamaConfig."""
+    nh = cfg.num_attention_heads
+    hd = cfg.hidden_size // nh
+    nh_kv = getattr(cfg, "num_key_value_heads", nh) or nh
+    return target_dims(cfg.hidden_size, cfg.intermediate_size, nh,
+                       nh_kv, hd)
+
+
+def make_lora_adapter(cfg, rank=4, alpha=None, seed=0,
+                      targets=ADAPTER_TARGETS, init_std=0.5):
+    """A random LoRA adapter for `cfg` (demo/test/bench factory — a real
+    fine-tune would come out of training). Both factors are small random
+    so the delta is NONZERO (a conventional zero-init B would make every
+    adapter indistinguishable from base weights, which is useless for
+    pinning the serving path). Returns the adapter dict
+    {"meta": {...}, "layers": [{target: {"a": [in, r], "b": [r, out]}}]}."""
+    rng = np.random.RandomState(seed)
+    dims = engine_target_dims(cfg)
+    layers = []
+    for _ in range(cfg.num_hidden_layers):
+        lay = {}
+        for t in targets:
+            din, dout = dims[t]
+            lay[t] = {
+                "a": (rng.randn(din, rank) * init_std).astype(np.float32),
+                "b": (rng.randn(rank, dout) * init_std).astype(np.float32),
+            }
+        layers.append(lay)
+    meta = {"rank": int(rank),
+            "alpha": float(alpha if alpha is not None else 2 * rank),
+            "targets": list(targets),
+            "layers": int(cfg.num_hidden_layers),
+            "dims": {t: list(dims[t]) for t in targets}}
+    return {"meta": meta, "layers": layers}
+
+
+_META_FILE = "adapter.json"
+
+
+def save_adapter(path, adapter, step=None):
+    """Persist an adapter through the PR 8 snapshot surface: the factor
+    pytree rides `checkpoint.save_snapshot` (atomic, CRC32 manifest) and
+    the metadata (rank/alpha/targets/dims — what a loader needs to build
+    the verification tree) lands as a JSON sidecar inside the committed
+    directory."""
+    from ..distributed import checkpoint as ckpt
+    ckpt.save_snapshot({"layers": adapter["layers"]}, path, step=step)
+    with open(os.path.join(path, _META_FILE), "w") as f:
+        json.dump(adapter["meta"], f)
+    return path
+
+
+def load_adapter_file(path, expect_dims=None, expect_layers=None):
+    """Load + verify an adapter directory: metadata first, then the
+    factor pytree through `checkpoint.load_snapshot_for` (per-leaf CRC32
+    + tree structure + SHAPES against a zeros tree built from the
+    metadata — the same verify-before-install contract the weight
+    hot-swap uses). `expect_dims`/`expect_layers` (from the pool's
+    geometry) are checked BEFORE the factor read, so a wrong-model
+    adapter fails with the dims named rather than a leaf-count mismatch.
+    Every failure raises typed `AdapterCorruptError` and touches
+    nothing."""
+    from ..distributed.checkpoint import CheckpointCorruptError
+    from ..distributed import checkpoint as ckpt
+    meta_path = os.path.join(path, _META_FILE)
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as e:
+        raise AdapterCorruptError(
+            f"adapter {path!r}: unreadable metadata "
+            f"({type(e).__name__}: {e})") from e
+    try:
+        rank = int(meta["rank"])
+        targets = list(meta["targets"])
+        n_layers = int(meta["layers"])
+        dims = {t: tuple(int(x) for x in meta["dims"][t])
+                for t in targets}
+    except (KeyError, TypeError, ValueError) as e:
+        raise AdapterCorruptError(
+            f"adapter {path!r}: malformed metadata {meta!r}") from e
+    if expect_layers is not None and n_layers != int(expect_layers):
+        raise AdapterCorruptError(
+            f"adapter {path!r} has {n_layers} layers, this engine "
+            f"serves {expect_layers}")
+    if expect_dims is not None:
+        for t in targets:
+            if t not in expect_dims or dims[t] != tuple(expect_dims[t]):
+                raise AdapterCorruptError(
+                    f"adapter {path!r} target {t!r} dims {dims.get(t)} "
+                    f"do not match this engine's "
+                    f"{tuple(expect_dims.get(t, ()))}")
+    like = {"layers": [
+        {t: {"a": np.zeros((dims[t][0], rank), np.float32),
+             "b": np.zeros((rank, dims[t][1]), np.float32)}
+         for t in targets} for _ in range(n_layers)]}
+    try:
+        state = ckpt.load_snapshot_for(like, path)
+    except CheckpointCorruptError as e:
+        raise AdapterCorruptError(
+            f"adapter {path!r} failed snapshot verification: {e}") from e
+    except Exception as e:
+        # a torn .npy header dies inside np.load before the CRC walk
+        # even runs — still a corrupt artifact, still typed
+        raise AdapterCorruptError(
+            f"adapter {path!r} unreadable ({type(e).__name__}: "
+            f"{e})") from e
+    return {"meta": meta, "layers": state["layers"]}
+
+
+# -- the grouped delta math (traced; shared by every dispatch form) ----------
+def lora_delta(x, a_stack, b_stack, aid, scale):
+    """Batched grouped low-rank delta for one target: x [w, t, in],
+    a_stack [C, in, r], b_stack [C, r, out], aid [w] int32 pool-slot
+    ids, scale [w] f32 (alpha/r per row; 0-rows' value is irrelevant —
+    the caller's gate discards them). Rows group by adapter through the
+    gather (same aid -> same factor block) and both contractions
+    accumulate in f32. Row-independent by construction (each row's
+    einsum touches only its own row), which is what makes a mixed batch
+    byte-identical to per-adapter dedicated engines."""
+    a_sel = a_stack[aid].astype(jnp.float32)        # [w, in, r]
+    b_sel = b_stack[aid].astype(jnp.float32)        # [w, r, out]
+    xa = jnp.einsum("wti,wir->wtr", x.astype(jnp.float32), a_sel)
+    xa = xa * scale[:, None, None]
+    return jnp.einsum("wtr,wro->wto", xa, b_sel)
+
+
+def lora_apply(y, x, target, sel):
+    """y + the target's delta, WHERE-GATED per row: aid == 0 rows take
+    the untouched `y` bits (not y + 0.0 — that could flip a -0.0), so
+    no-adapter slots in a mixed batch stay byte-identical to the plain
+    engine. sel is the per-layer selection tuple the engine builds
+    (`_ad_sel`): (a_dict, b_dict, aid, scale, gate)."""
+    a_l, b_l, aid, scale, gate = sel
+    if target not in a_l:
+        return y
+    d = lora_delta(x, a_l[target], b_l[target], aid, scale)
+    return jnp.where(gate[:, None, None], y + d.astype(y.dtype), y)
+
+
+class AdapterPool:
+    """Page-granular device pool of LoRA factor stacks.
+
+    Device layout (rides every adapter-aware dispatch as an argument
+    pytree, exactly like the weight snapshot — never a closure capture):
+
+      {"a": [per-layer {target: [C+1, in, r]}],
+       "b": [per-layer {target: [C+1, r, out]}],
+       "scale": [C+1] f32}
+
+    Slot 0 is the RESERVED zero adapter (aid 0 = no adapter; its rows
+    are where-gated out anyway, the zeros are defense in depth). C =
+    capacity = pool_pages // pages_per_adapter, where an adapter's page
+    bill is its factor elements at `page_elems` f32 elements per page —
+    the same fixed-page accounting the KV pool uses, down to reusing
+    `serving.PageAllocator` (refcounts, typed exhaustion) for the page
+    ledger.
+
+    Lifecycle: `install` claims pages + a slot (LRU-evicting an IDLE
+    adapter when full; every-adapter-busy raises AdapterFullError and
+    changes nothing); `acquire`/`release` track live requests per
+    adapter (an acquired adapter is never evicted under it); `evict`
+    frees the slot + pages.
+    """
+
+    def __init__(self, n_layers, dims, rank, pool_pages=None,
+                 max_adapters=4, page_elems=8192, targets=ADAPTER_TARGETS):
+        from .serving import PageAllocator
+        self.rank = int(rank)
+        if self.rank < 1:
+            raise ValueError(f"adapter rank must be >= 1, got {rank}")
+        self.n_layers = int(n_layers)
+        self.targets = tuple(targets)
+        self.dims = {t: tuple(dims[t]) for t in self.targets}
+        self.page_elems = int(page_elems)
+        per_layer = sum(din * self.rank + self.rank * dout
+                       for (din, dout) in self.dims.values())
+        self.elems_per_adapter = per_layer * self.n_layers
+        self.pages_per_adapter = max(
+            1, -(-self.elems_per_adapter // self.page_elems))
+        if pool_pages is None:
+            pool_pages = int(max_adapters) * self.pages_per_adapter
+        self.n_pages = int(pool_pages)
+        self.capacity = self.n_pages // self.pages_per_adapter
+        if self.capacity < 1:
+            raise ValueError(
+                f"adapter pool of {self.n_pages} pages cannot hold one "
+                f"adapter ({self.pages_per_adapter} pages at rank "
+                f"{self.rank}); raise pool_pages/page_elems")
+        self.allocator = PageAllocator(self.n_pages)
+        self.device = {
+            "a": [{t: jnp.zeros((self.capacity + 1, d[0], self.rank),
+                                jnp.float32)
+                   for t, d in self.dims.items()}
+                  for _ in range(self.n_layers)],
+            "b": [{t: jnp.zeros((self.capacity + 1, self.rank, d[1]),
+                                jnp.float32)
+                   for t, d in self.dims.items()}
+                  for _ in range(self.n_layers)],
+            "scale": jnp.zeros((self.capacity + 1,), jnp.float32),
+        }
+        self._slots = {}                       # name -> slot (1..C)
+        self._pages = {}                       # name -> [page ids]
+        self._free_slots = list(range(self.capacity, 0, -1))
+        self._active = collections.Counter()   # name -> live request refs
+        self._lru = collections.OrderedDict()  # name -> None (LRU order)
+        self._alpha = {}                       # name -> alpha
+        self._tpc = None
+        # lifetime counters (health()/telemetry surface)
+        self.loads = 0
+        self.evictions = 0
+        self.load_errors = 0
+        self.last_load_ms = 0.0
+
+    # -- tensor-parallel placement ------------------------------------------
+    def specs(self):
+        """PartitionSpec pytree mirroring `device`: B stacks of the
+        column-parallel targets shard their OUT axis over "mp" (the
+        axis the projections themselves shard); A stacks, the down
+        pair, and the scales stay replicated — the o/down exact-mode
+        placement."""
+        from jax.sharding import PartitionSpec as P
+        from .tp import AXIS
+        col = frozenset(("wq", "wk", "wv", "wg", "wu"))
+        return {
+            "a": [{t: P() for t in self.dims} for _ in range(self.n_layers)],
+            "b": [{t: (P(None, None, AXIS) if t in col else P())
+                   for t in self.dims} for _ in range(self.n_layers)],
+            "scale": P(),
+        }
+
+    def place(self, tpc):
+        """device_put the stacks onto the TP mesh (idempotent); every
+        later install re-places so dispatches stay zero-copy."""
+        self._tpc = tpc
+        if tpc is not None:
+            self.device = tpc.place(self.device, self.specs())
+        return self
+
+    # -- install / evict ----------------------------------------------------
+    def has(self, name):
+        return name in self._slots
+
+    def names(self):
+        return list(self._slots)
+
+    def slot(self, name):
+        """Pool slot id for a loaded adapter (LRU-touched: slot reads
+        are the use signal eviction ranks by)."""
+        s = self._slots.get(name)
+        if s is None:
+            raise UnknownAdapterError(
+                f"adapter {name!r} is not loaded "
+                f"(loaded: {sorted(self._slots)})")
+        self._lru.move_to_end(name)
+        return s
+
+    def install(self, name, adapter):
+        """Install a verified adapter dict under `name`; returns the
+        pool slot. Shape/rank verified against the pool geometry FIRST
+        (typed AdapterCorruptError, nothing claimed); a full pool
+        LRU-evicts one idle adapter, or raises AdapterFullError when
+        every installed adapter has live requests. Page claim is
+        guarded — any failure rolls the claim back (zero page leak)."""
+        meta = adapter.get("meta") or {}
+        rank = int(meta.get("rank", self.rank))
+        if rank > self.rank:
+            raise AdapterCorruptError(
+                f"adapter {name!r} rank {rank} exceeds the pool's "
+                f"rank {self.rank} (rebuild the engine with a larger "
+                "adapters= rank)")
+        layers = adapter["layers"]
+        if len(layers) != self.n_layers:
+            raise AdapterCorruptError(
+                f"adapter {name!r} has {len(layers)} layers, pool "
+                f"serves {self.n_layers}")
+        for li, lay in enumerate(layers):
+            for t, fac in lay.items():
+                if t not in self.dims:
+                    raise AdapterCorruptError(
+                        f"adapter {name!r} layer {li} names unknown "
+                        f"target {t!r} (pool targets: {self.targets})")
+                din, dout = self.dims[t]
+                a = np.asarray(fac["a"])
+                b = np.asarray(fac["b"])
+                if a.shape != (din, rank) or b.shape != (rank, dout):
+                    raise AdapterCorruptError(
+                        f"adapter {name!r} layer {li} target {t!r} "
+                        f"shapes a{a.shape}/b{b.shape} do not match "
+                        f"pool dims ({din}, {rank})/({rank}, {dout})")
+        if name in self._slots:
+            if self._active[name]:
+                raise AdapterError(
+                    f"adapter {name!r} is already loaded with "
+                    f"{self._active[name]} live request(s) — evict is "
+                    "only safe once they retire (load under a new name "
+                    "to roll a fine-tune forward)")
+            self.evict(name)            # idle reinstall = registry update
+        if not self._free_slots:
+            victim = next((n for n in self._lru
+                           if not self._active[n]), None)
+            if victim is None:
+                raise AdapterFullError(
+                    f"adapter pool full: {len(self._slots)} adapters "
+                    f"installed ({self.capacity} slots), every one has "
+                    "live requests — retry after retirements")
+            self.evict(victim)
+        slot = self._free_slots.pop()
+        pages = []
+        try:
+            for _ in range(self.pages_per_adapter):
+                pages.append(self.allocator.alloc())
+        except Exception:
+            if pages:
+                self.allocator.free(pages)
+            self._free_slots.append(slot)
+            raise
+        alpha = float(meta.get("alpha", 2.0 * rank))
+        dev = self.device
+        try:
+            # the device writes are part of the zero-leak guarantee
+            # too: a failure here (device OOM is the realistic case)
+            # must return the claimed pages AND the slot, or the pool
+            # permanently loses capacity. The .at updates build a NEW
+            # dict entry per write, so a partial failure leaves stale
+            # values only in the still-free slot — overwritten by the
+            # next install, never read (slot 0 gating).
+            for li, lay in enumerate(layers):
+                for t, fac in lay.items():
+                    a = jnp.asarray(np.asarray(fac["a"], np.float32))
+                    b = jnp.asarray(np.asarray(fac["b"], np.float32))
+                    dev["a"][li][t] = dev["a"][li][t] \
+                        .at[slot, :, :rank].set(a)
+                    dev["b"][li][t] = dev["b"][li][t] \
+                        .at[slot, :rank, :].set(b)
+            dev["scale"] = dev["scale"].at[slot].set(alpha / rank)
+            if self._tpc is not None:
+                self.device = self._tpc.place(dev, self.specs())
+        except Exception:
+            self.allocator.free(pages)
+            try:
+                # zero whatever landed before re-offering the slot —
+                # a later LOWER-rank install would otherwise read this
+                # install's stale rank-tail through the full-rank
+                # contraction (the same hazard evict() zeroes for)
+                for li in range(self.n_layers):
+                    for t in self.dims:
+                        dev["a"][li][t] = dev["a"][li][t].at[slot] \
+                            .set(0.0)
+                        dev["b"][li][t] = dev["b"][li][t].at[slot] \
+                            .set(0.0)
+                dev["scale"] = dev["scale"].at[slot].set(0.0)
+                self._free_slots.append(slot)
+            except Exception:
+                # cannot even zero it (the device is truly wedged):
+                # BURN the slot rather than re-offer stale factors —
+                # a one-slot capacity loss, never silent wrong output
+                pass
+            raise
+        self._slots[name] = slot
+        self._pages[name] = pages
+        self._lru[name] = None
+        self._alpha[name] = alpha
+        self.loads += 1
+        return slot
+
+    def evict(self, name, force=False):
+        """Free an adapter's slot + pages. Refuses (typed) while live
+        requests hold it unless force=True (force is for engine
+        teardown, where the requests are being failed anyway)."""
+        slot = self._slots.get(name)
+        if slot is None:
+            raise UnknownAdapterError(f"adapter {name!r} is not loaded")
+        if self._active[name] and not force:
+            raise AdapterError(
+                f"adapter {name!r} has {self._active[name]} live "
+                "request(s); evict after they retire")
+        dev = self.device
+        # zero the slot so a later install of a LOWER-rank adapter
+        # cannot read the evicted tenant's stale factor tail
+        for li in range(self.n_layers):
+            for t in self.dims:
+                dev["a"][li][t] = dev["a"][li][t].at[slot].set(0.0)
+                dev["b"][li][t] = dev["b"][li][t].at[slot].set(0.0)
+        dev["scale"] = dev["scale"].at[slot].set(0.0)
+        if self._tpc is not None:
+            self.device = self._tpc.place(dev, self.specs())
+        self.allocator.free(self._pages.pop(name))
+        del self._slots[name]
+        self._lru.pop(name, None)
+        self._alpha.pop(name, None)
+        self._active.pop(name, None)
+        self._free_slots.append(slot)
+        self.evictions += 1
+        return slot
+
+    # -- request refcounts --------------------------------------------------
+    def acquire(self, name):
+        if name not in self._slots:
+            raise UnknownAdapterError(
+                f"adapter {name!r} is not loaded "
+                f"(loaded: {sorted(self._slots)})")
+        self._active[name] += 1
+        self._lru.move_to_end(name)
+
+    def release(self, name):
+        if self._active.get(name, 0) > 0:
+            self._active[name] -= 1
+
+    def active(self, name):
+        return self._active.get(name, 0)
+
+    # -- observability ------------------------------------------------------
+    def stats(self):
+        return {
+            "loaded": len(self._slots),
+            "capacity": self.capacity,
+            "rank": self.rank,
+            "pages_total": self.n_pages,
+            "pages_free": self.allocator.available,
+            "pages_per_adapter": self.pages_per_adapter,
+            "loads": self.loads,
+            "evictions": self.evictions,
+            "load_errors": self.load_errors,
+            "active": {n: c for n, c in self._active.items() if c},
+        }

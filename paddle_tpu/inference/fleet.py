@@ -479,6 +479,13 @@ class EngineHost:
         self.engine.attach_prefix_index(index, self.name)
         return None
 
+    # -- multi-LoRA adapters ---------------------------------------------------
+    def rpc_load_adapter(self, name, path):
+        return self.engine.load_adapter(name, path)
+
+    def rpc_evict_adapter(self, name):
+        return self.engine.evict_adapter(name)
+
     # -- weights --------------------------------------------------------------
     def rpc_export_weights(self):
         import jax
@@ -571,6 +578,14 @@ class ProcessReplica:
         self.call_timeout = float(call_timeout)
         self.connect_timeout_ms = int(connect_timeout_ms)
         self.rpc_errors = 0             # transport-level call failures
+        self.adapters = {}              # name -> path registry (LoRA;
+        #                                 replayed into a respawned
+        #                                 worker by rebuild())
+        self.adapters_pending = {}      # name -> "load"|"evict": ops
+        #                                 deferred while quarantined,
+        #                                 drained at the next clean
+        #                                 probe (router._drain_
+        #                                 adapter_pending)
         self._prefix_index = None
         self._sock = None
         self._sock_lock = threading.Lock()
@@ -813,6 +828,25 @@ class ProcessReplica:
         except FleetRPCError:
             return None                 # dead worker: ticket died too
 
+    # -- multi-LoRA adapters -----------------------------------------------------
+    def load_adapter(self, name, path):
+        """Registry write over RPC: the worker hot-loads the adapter
+        from `path` (a path every host can read — the deploy contract,
+        same as weight snapshots); recorded replica-side so rebuild()
+        replays it into a respawned worker."""
+        slot = self._call("load_adapter", name, str(path))
+        self.adapters[name] = str(path)
+        self.adapters_pending.pop(name, None)
+        return slot
+
+    def evict_adapter(self, name):
+        """Worker first, registry second — a refused evict (live
+        requests pin the adapter) keeps the rebuild-replay entry."""
+        slot = self._call("evict_adapter", name)
+        self.adapters.pop(name, None)
+        self.adapters_pending.pop(name, None)
+        return slot
+
     # -- weights ----------------------------------------------------------------
     def export_weights(self):
         return self._call("export_weights")
@@ -882,6 +916,15 @@ class ProcessReplica:
                 pass
             host, port, prefix = self._prefix_index.endpoint
             self._call("attach_prefix_index", host, port, prefix)
+        for name, path in self.adapters.items():
+            try:
+                self._call("load_adapter", name, path)
+            except Exception:
+                pass                    # registry kept; requests naming
+                #                         it fail typed on this replica
+        self.adapters_pending.clear()   # replay covered the loads; the
+        #                                 respawned worker never held an
+        #                                 evict-pending adapter
         return self
 
     def shutdown(self):

@@ -57,6 +57,7 @@ import numpy as np
 
 from ..failsafe import (InjectedFault, RetriesExhaustedError, fault_point,
                         retry_with_backoff)
+from .adapters import AdapterError
 from .scheduler import (DECODE, DEMOTED, DONE, FAILED, PREFILL, QUEUED,
                         EngineBusyError, EngineFullError, RequestFailure,
                         RequestFailedError, RequestNotFinishedError,
@@ -87,6 +88,13 @@ class HotSwapError(SchedulerError):
     """A weight hot-swap aborted; every replica was rolled back to (or
     never left) the old weights and serving continued throughout.
     Carries the underlying cause as __cause__."""
+
+
+class AdapterDeployError(SchedulerError):
+    """A fleet-wide adapter registry write (EngineRouter.load_adapter)
+    landed on ZERO replicas — the fine-tune is not servable anywhere.
+    Partial failures do NOT raise: the summary names the stragglers and
+    the fleet keeps serving from the replicas that loaded it."""
 
 
 class CircuitBreaker:
@@ -182,6 +190,15 @@ class EngineReplica:
         self.telemetry = None           # per-replica Telemetry — lives
         #                                 HERE, not on the engine, so
         #                                 histograms survive a rebuild
+        self.adapters = {}              # name -> path registry (LoRA;
+        #                                 replayed across rebuilds so a
+        #                                 fresh engine serves the same
+        #                                 fine-tunes)
+        self.adapters_pending = {}      # name -> "load"|"evict": ops
+        #                                 deferred while quarantined,
+        #                                 drained at the next clean
+        #                                 probe (rebuild covers them
+        #                                 via the registry replay)
 
     # -- traffic -----------------------------------------------------------
     def submit(self, spec):
@@ -274,6 +291,26 @@ class EngineReplica:
         backend adds its worker block here)."""
         return {}
 
+    # -- multi-LoRA adapters (inference/adapters.py) --------------------------
+    def load_adapter(self, name, path):
+        """Hot-load a LoRA adapter into this replica's pool and record
+        it in the replica registry (replayed by rebuild() so a fresh
+        engine serves the same fine-tunes)."""
+        slot = self.engine.load_adapter(name, path)
+        self.adapters[name] = str(path)
+        self.adapters_pending.pop(name, None)
+        return slot
+
+    def evict_adapter(self, name):
+        """Engine first, registry second: a REFUSED evict (live
+        requests pin the adapter) must leave the rebuild-replay
+        registry intact, or a later rebuild would strand salvaged
+        requests that still name it."""
+        slot = self.engine.evict_adapter(name)
+        self.adapters.pop(name, None)
+        self.adapters_pending.pop(name, None)
+        return slot
+
     # -- fleet prefix index (cache-aware routing) -----------------------------
     def attach_prefix_index(self, index):
         """Wire this replica's engine into the fleet prefix index under
@@ -363,6 +400,16 @@ class EngineReplica:
             self.engine.attach_prefix_index(self._prefix_index, self.name)
         if self.telemetry is not None:
             self.engine.attach_telemetry(self.telemetry, src=self.name)
+        for name, path in self.adapters.items():
+            try:
+                self.engine.load_adapter(name, path)
+            except Exception:
+                pass                    # the registry stays; a request
+                #                         naming it fails typed, the
+                #                         fleet's other replicas serve
+        self.adapters_pending.clear()   # replay covered the loads; a
+        #                                 fresh engine never held an
+        #                                 evict-pending adapter
         return self.engine
 
 
@@ -556,11 +603,13 @@ class EngineRouter:
     # -- public ------------------------------------------------------------
     def add_request(self, ids, max_new_tokens=32, eos_token_id=None,
                     deadline_ms=None, ttl_steps=None, tenant=None,
-                    priority=None):
+                    priority=None, adapter=None):
         """Queue one prompt on the healthiest replica; returns a ROUTER
         uid (stable across failovers — the engine-level uid may change
         when the request migrates). Signature mirrors
-        ContinuousBatchingEngine.add_request; per-tenant admission is
+        ContinuousBatchingEngine.add_request (adapter= names a LoRA
+        fine-tune deployed via load_adapter — the name rides the spec
+        through failover and KV handoff); per-tenant admission is
         enforced by each replica's own policy."""
         ids = np.asarray(ids, np.int64).ravel()
         deadline = (time.monotonic() + deadline_ms / 1e3
@@ -568,7 +617,7 @@ class EngineRouter:
         spec = {"prompt": ids, "max_new_tokens": int(max_new_tokens),
                 "eos_token_id": eos_token_id, "tenant": tenant or "default",
                 "priority": priority, "ttl_steps": ttl_steps,
-                "deadline": deadline}
+                "deadline": deadline, "adapter": adapter}
         rr = _RouterRequest(self._next_uid, spec["tenant"])
         self._next_uid += 1
         self._reqs[rr.uid] = rr
@@ -831,6 +880,66 @@ class EngineRouter:
                               if rep.telemetry is not None]
         return export_chrome_trace(path, tels)
 
+    # -- multi-LoRA adapter deployment (inference/adapters.py) ---------------
+    def load_adapter(self, name, path):
+        """Deploy a fine-tune to the FLEET: one registry write fanned
+        to every reachable replica's pool (quarantined replicas pick
+        it up at rebuild — EngineReplica.rebuild replays its adapter
+        registry). Returns {replica: "loaded" | "error: ..."}; raises
+        AdapterDeployError only when NO replica could load (a partial
+        fleet still serves the adapter — routing is health-ordered and
+        a replica without it fails that request typed, which failover
+        then re-routes)."""
+        summary = {}
+        ok = deferred = 0
+        for rep in self._replicas:
+            if rep.breaker.state == "open":
+                # recorded for the drain at the next clean probe AND
+                # for rebuild's registry replay — a quarantined
+                # replica usually re-enters via a probe, not a rebuild
+                rep.adapters[name] = str(path)
+                rep.adapters_pending[name] = "load"
+                summary[rep.name] = "deferred-quarantined"
+                deferred += 1
+                continue
+            try:
+                rep.load_adapter(name, path)
+                summary[rep.name] = "loaded"
+                ok += 1
+            except Exception as e:
+                summary[rep.name] = f"error: {type(e).__name__}: {e}"
+        if not ok and not deferred:
+            raise AdapterDeployError(
+                f"adapter {name!r} failed to load on every replica: "
+                f"{summary}")
+        if self._tel is not None:
+            # counted only for deploys that LANDED (or deferred) —
+            # a fleet-wide failure raised above, and a dashboard must
+            # not read it as a successful deploy
+            self._tel.event("adapter_deploy", name=name, loaded=ok)
+            self._tel.registry.count("adapter_deploys")
+        return summary
+
+    def evict_adapter(self, name):
+        """Evict a fine-tune fleet-wide (replicas with live requests
+        on it refuse typed and keep it — report, don't force)."""
+        summary = {}
+        for rep in self._replicas:
+            if rep.breaker.state == "open":
+                # the live worker (if any) keeps serving it until the
+                # next clean probe drains the pending evict; rebuild
+                # satisfies it too (the registry entry is gone)
+                rep.adapters.pop(name, None)
+                rep.adapters_pending[name] = "evict"
+                summary[rep.name] = "deferred-quarantined"
+                continue
+            try:
+                rep.evict_adapter(name)
+                summary[rep.name] = "evicted"
+            except Exception as e:
+                summary[rep.name] = f"error: {type(e).__name__}: {e}"
+        return summary
+
     # -- weight hot-swap ---------------------------------------------------
     def save_weights_snapshot(self, path, step=None):
         """Snapshot the fleet's CURRENT weights (from the first
@@ -1012,10 +1121,15 @@ class EngineRouter:
             try:
                 fault_point("replica.admit", detail=rep.name)
                 euid = rep.submit(spec)
-            except (EngineBusyError, ValueError) as e:
+            except (EngineBusyError, ValueError, AdapterError) as e:
                 # ValueError = this engine can't EVER take it (length
                 # beyond max_len) — with homogeneous replicas that is a
-                # caller error on fresh admissions
+                # caller error on fresh admissions. AdapterError = the
+                # adapter isn't deployed HERE (a partial registry
+                # write, or a rebuild whose replay failed) — a
+                # DEPLOYMENT gap, not a replica fault: try the next
+                # replica without charging the breaker; surfaced typed
+                # when no replica serves it.
                 if isinstance(e, ValueError):
                     if internal:
                         self._deliver(rr.uid, failure=RequestFailure(
@@ -1042,6 +1156,19 @@ class EngineRouter:
                 self._tel.req_event("router", rr.uid, "route",
                                     replica=rep.name)
             return True
+        if isinstance(last_busy, AdapterError):
+            # every tried replica refused the ADAPTER (not capacity):
+            # if NO replica's registry knows the name, no probe or
+            # retry can ever place it — surface typed instead of
+            # holding the request forever on a typo (a name some
+            # quarantined replica still registers may recover: hold)
+            name = spec.get("adapter")
+            if not any(name in r.adapters for r in self._replicas):
+                if internal:
+                    self._deliver(rr.uid, failure=RequestFailure(
+                        rr.uid, "adapter", last_busy, self.steps))
+                    return False
+                raise last_busy
         if not internal:
             if last_busy is not None and not self._held and \
                     all(r.breaker.state != "open" and r.state == ACTIVE
@@ -1339,7 +1466,8 @@ class EngineRouter:
         local token is not the request's TTFT)."""
         return {k: spec[k] for k in
                 ("prompt", "max_new_tokens", "eos_token_id", "tenant",
-                 "priority", "ttl_steps", "deadline", "generated")
+                 "priority", "ttl_steps", "deadline", "generated",
+                 "adapter")
                 if k in spec}
 
     def _migrate_running(self, rep):
@@ -1580,4 +1708,28 @@ class EngineRouter:
             return False
         rep.failed_probes = 0
         rep.breaker.record_probe_success()
+        self._drain_adapter_pending(rep)
         return True
+
+    def _drain_adapter_pending(self, rep):
+        """Apply adapter registry writes that landed while `rep` was
+        quarantined (the probe just proved it answers): loads replay
+        from the registry, evicts retire the stale fine-tune. A
+        failure keeps the op pending for the next probe (a busy
+        adapter refuses evicts until its requests retire)."""
+        for name, op in list(rep.adapters_pending.items()):
+            try:
+                if op == "load":
+                    rep.load_adapter(name, rep.adapters[name])
+                else:
+                    rep.evict_adapter(name)
+                rep.adapters_pending.pop(name, None)
+            except AdapterError as e:
+                from .adapters import UnknownAdapterError
+                if op == "evict" and isinstance(e, UnknownAdapterError):
+                    # the replica never held it (its load was itself
+                    # deferred, or a respawn dropped it): the desired
+                    # end state — adapter absent — already holds
+                    rep.adapters_pending.pop(name, None)
+            except Exception:
+                pass

@@ -48,6 +48,7 @@ from ..failsafe import InjectedFault, fault_point
 from ..failsafe import armed as _faults_armed
 from ..profiler import RecordEvent as _RecordEvent
 from ..profiler import spans_active as _spans_active
+from .adapters import AdapterError, UnknownAdapterError
 from .serving import LLMEngine, EngineFullError, _rms, _mm
 from .speculative import resolve_drafter
 
@@ -170,7 +171,7 @@ class Request:
                  "pages_shared", "deadline", "ttl_steps", "born_step",
                  "error", "tenant", "priority", "draft_k",
                  "spec_drafted", "spec_accepted", "demote", "seated_step",
-                 "idle_steps")
+                 "idle_steps", "adapter", "adapter_released")
 
     def __init__(self, uid, ids, max_new_tokens, eos_token_id,
                  deadline=None, ttl_steps=None, born_step=0,
@@ -212,6 +213,11 @@ class Request:
         #                                 seated decode request waited
         #                                 without emitting (the
         #                                 demote-on-idle trigger)
+        self.adapter = None             # LoRA adapter NAME (None = base
+        #                                 weights; inference/adapters.py)
+        self.adapter_released = False   # pool ref dropped (terminal
+        #                                 transition ran); the NAME
+        #                                 stays for salvage/export
 
 
 class PrefixCache:
@@ -398,7 +404,7 @@ class _FusedBlock:
     __slots__ = ("w", "K", "pf_items", "dec_items", "tables", "eos_dev",
                  "first", "toks", "emitted", "tok_fin", "lens_fin",
                  "act_fin", "rem_fin", "has_prefill", "has_decode",
-                 "chained", "dlens")
+                 "chained", "dlens", "aid")
 
     def __init__(self, w, K):
         self.w = w
@@ -416,6 +422,9 @@ class _FusedBlock:
         self.chained = False
         self.dlens = None           # np [K, w] drafts offered per pass
         #                             per slot (speculative blocks only)
+        self.aid = None             # device [w] adapter pool-slot ids
+        #                             (None = adapter-free block: the
+        #                             plain compiled program ran)
 
 
 class ContinuousBatchingEngine(LLMEngine):
@@ -512,7 +521,8 @@ class ContinuousBatchingEngine(LLMEngine):
                  megakernel=None, speculate=None, drafter="ngram",
                  spec_adaptive=True, tenants=None, kv_tier=None,
                  tier_dir=None, tier_host_cap_mb=None, oversubscribe=None,
-                 tier_idle_steps=None, telemetry=None, **kw):
+                 tier_idle_steps=None, telemetry=None, adapters=None,
+                 **kw):
         super().__init__(model, max_len=max_len, page_size=page_size,
                          max_batch=max_batch, **kw)
         # telemetry=: a telemetry.Telemetry instance (or True to build
@@ -745,12 +755,59 @@ class ContinuousBatchingEngine(LLMEngine):
         self.draft_errors = 0           # real (non-injected) drafter
         #                                 exceptions, degraded to dlen=0
         self._slot_used = [False] * max_batch
+        # multi-LoRA adapter serving (inference/adapters.py): adapters=
+        # {"rank": R, "max_adapters": N, "pool_pages": P, "page_elems":
+        # E} (True = defaults) builds a page-granular ADAPTER POOL
+        # beside the KV pool — LoRA A/B factor stacks on device, the
+        # KV allocator's refcount/LRU/backpressure discipline for the
+        # pages. add_request(adapter=name) threads a pool-slot id into
+        # the slot state; adapter-carrying dispatches run ADAPTER-AWARE
+        # compiled variants (the no-adapter programs are untouched, so
+        # an adapter-free engine — or an adapter-free batch — is
+        # byte-identical to pre-adapter serving), applying the grouped
+        # low-rank delta after the shared q/k/v/gate/up/down
+        # projections. Adapter requests skip the prefix cache (their
+        # KV bytes are adapter-specific; content addressing is by
+        # tokens alone) and, under megakernel=, fall back per-dispatch
+        # to the op-chain delta (counted in adapter_mk_fallbacks;
+        # docs/serving.md "Multi-LoRA & the model zoo").
+        self._apool = None
+        self._adapter_registry = {}     # name -> path (lazy hot-load)
+        self.adapter_requests = collections.Counter()   # name -> reqs
+        self.adapter_tokens = collections.Counter()     # name -> tokens
+        self.adapter_mk_fallbacks = 0   # adapter dispatches that left
+        #                                 the megakernel for the op chain
+        self._cb_step_ad_fns = {}
+        self._cb_prefill_ad_fn = None
+        if adapters is not None and adapters is not False:
+            from .adapters import AdapterPool, engine_target_dims
+            if self.tp > 1 and self.tp_mode != "exact":
+                raise ValueError(
+                    "adapters with tp > 1 require tp_mode='exact': the "
+                    "down-projection delta needs the full activation "
+                    "row, which psum mode never materializes")
+            acfg = {} if adapters is True else dict(adapters)
+            self._apool = AdapterPool(
+                self.cfg.num_hidden_layers,
+                engine_target_dims(self.cfg),
+                rank=acfg.pop("rank", 4), **acfg)
+            self._apool.place(self._tpc)
 
     # -- public ------------------------------------------------------------
     def add_request(self, ids, max_new_tokens=32, eos_token_id=None,
                     deadline_ms=None, ttl_steps=None, tenant=None,
-                    priority=None):
+                    priority=None, adapter=None):
         """Queue one prompt (1-D int sequence). Returns a request uid.
+
+        adapter: name of a loaded LoRA adapter (inference/adapters.py)
+          this request decodes under — the grouped low-rank delta rides
+          every prefill chunk, decode step and verify pass the request
+          touches, so a mixed batch is byte-identical to per-adapter
+          dedicated engines. A name not yet in the pool hot-loads from
+          the registry (register_adapter/load_adapter); an unknown name
+          raises UnknownAdapterError typed. The adapter is refcounted
+          for the request's whole life (LRU eviction never pulls it out
+          from under live traffic).
 
         deadline_ms: wall-clock budget from NOW; a request still
           unfinished when it expires retires with a DeadlineExceededError
@@ -785,6 +842,8 @@ class ContinuousBatchingEngine(LLMEngine):
                 f"requests at queue_limit={self.queue_limit} "
                 f"({sum(1 for s in self._slots if s)} running); retry "
                 "later or raise queue_limit")
+        if adapter is not None:
+            self._resolve_adapter(adapter)   # raises typed; may hot-load
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
         deadline = (time.monotonic() + deadline_ms / 1e3
@@ -797,6 +856,10 @@ class ContinuousBatchingEngine(LLMEngine):
                     ttl_steps=None if ttl_steps is None else int(ttl_steps),
                     born_step=self.steps, tenant=tenant, priority=priority,
                     draft_k=max(1, self._spec - 1) if self._spec else 0)
+        if adapter is not None:
+            self._apool.acquire(adapter)
+            r.adapter = adapter
+            self.adapter_requests[adapter] += 1
         self._next_uid += 1
         self._requests[r.uid] = r
         self._queue.append(r)
@@ -1074,6 +1137,14 @@ class ContinuousBatchingEngine(LLMEngine):
             "index_publish_errors": self.index_publish_errors,
             "prefix_exports": self.prefix_exports,
             "prefix_imports": self.prefix_imports,
+            # multi-LoRA adapter serving (inference/adapters.py): pool
+            # occupancy + per-adapter request/token counters (None =
+            # engine built without an adapter pool)
+            "adapters": (dict(self._apool.stats(),
+                              mk_fallbacks=self.adapter_mk_fallbacks,
+                              requests=dict(self.adapter_requests),
+                              tokens=dict(self.adapter_tokens))
+                         if self._apool is not None else None),
             # multi-tenant admission: preemptions + per-tenant service
             "preemptions": self.preemptions,
             "tenants": {
@@ -1290,7 +1361,13 @@ class ContinuousBatchingEngine(LLMEngine):
         inside a shared page. Both consumers (_admit and the
         _idle_demote_sweep capacity gate) MUST price through here, or
         the gate demotes victims for heads admission would seat."""
-        shared, covered = ([], 0) if self._prefix is None else \
+        # adapter requests NEVER share (or publish) prefix-cache pages:
+        # the cache is content-addressed by TOKENS alone, but an
+        # adapter request's KV bytes carry its adapter's k/v deltas —
+        # sharing across adapters (or with base) would silently serve
+        # another model's cache (docs/serving.md)
+        shared, covered = ([], 0) \
+            if self._prefix is None or r.adapter is not None else \
             self._prefix.match(r.ids)
         resume = min(covered, r.t0 - 1)
         need = self._pages_needed(r.t0, r.max_new_tokens)
@@ -1431,24 +1508,31 @@ class ContinuousBatchingEngine(LLMEngine):
                 self._cow(r, idx)
 
     # -- prefill -----------------------------------------------------------
-    def _build_cb_prefill(self, chunk):
+    def _build_cb_prefill(self, chunk, with_adapters=False):
         """One prompt chunk of ONE sequence: write its KV into the
         sequence's pages, then attend over the sequence's whole gathered
         context (shared prefix pages included) with causal masking.
         Static shape: [1, chunk]; t_start/t_end ride as traced scalars
-        so every chunk of every prompt reuses ONE compiled program."""
+        so every chunk of every prompt reuses ONE compiled program.
+        with_adapters=True builds the ADAPTER-AWARE variant (aid [1] —
+        the request's pool slot; an adapter request's prompt KV must
+        carry the delta too, or its cache would diverge from a
+        dedicated engine's)."""
         p = self.page_size
         mp = self.max_pages_per_seq
 
         def prefill(W, ids, k_pages_all, v_pages_all, table, t_start,
-                    t_end):
+                    t_end, AD=None, aid=None):
+            ad = None if AD is None else (AD, aid)
             h = jnp.take(W["emb"], ids, axis=0).astype(self.kv_dtype)
             pos = t_start + jnp.arange(chunk, dtype=jnp.int32)
             pos_ids = pos[None, :]
             oob = jnp.int32(self.n_pages * p)
             new_k, new_v = [], []
             for li, wset in enumerate(W["layers"]):
-                q, k, v = self._layer_qkv(W, wset, h, pos_ids)
+                ad_li = None if ad is None else \
+                    self._ad_sel(AD, aid, li)
+                q, k, v = self._layer_qkv(W, wset, h, pos_ids, ad=ad_li)
                 slots = table[0, pos // p] * p + pos % p
                 # padded tail positions (>= the true prompt end) write
                 # NOTHING — scatter-drop, so cached pages stay garbage-
@@ -1479,7 +1563,7 @@ class ContinuousBatchingEngine(LLMEngine):
                 w = jax.nn.softmax(logits.astype(jnp.float32),
                                    -1).astype(q.dtype)
                 attn = jnp.einsum("hqk,khd->qhd", w, cv)[None]
-                h = self._layer_tail(W, wset, h, attn)
+                h = self._layer_tail(W, wset, h, attn, ad=ad_li)
             h = _rms(h, W["norm"], W["eps"])
             last = jnp.clip(t_end - 1 - t_start, 0, chunk - 1)
             h_last = jax.lax.dynamic_index_in_dim(h, last, axis=1)
@@ -1488,6 +1572,19 @@ class ContinuousBatchingEngine(LLMEngine):
                     _pools_result(v_pages_all, new_v))
 
         W, R, POOL = self._tp_specs()
+        if with_adapters:
+            def prefill_ad(W, AD, aid, ids, k_pages_all, v_pages_all,
+                           table, t_start, t_end):
+                return prefill(W, ids, k_pages_all, v_pages_all, table,
+                               t_start, t_end, AD=AD, aid=aid)
+
+            ADsp = (self._apool.specs() if self._tpc is not None
+                    else None)
+            return self._jit_tp(prefill_ad,
+                                in_specs=(W, ADsp, R, R, POOL, POOL,
+                                          R, R, R),
+                                out_specs=(R, POOL, POOL),
+                                donate_argnums=(4, 5))
         return self._jit_tp(prefill,
                             in_specs=(W, R, POOL, POOL, R, R, R),
                             out_specs=(R, POOL, POOL),
@@ -1500,12 +1597,25 @@ class ContinuousBatchingEngine(LLMEngine):
         self._make_writable(r, start, end)
         ids_chunk = np.zeros((1, chunk), np.int64)
         ids_chunk[0, :end - start] = r.ids[start:end]
-        if self._cb_prefill_fn is None:
-            self._cb_prefill_fn = self._build_cb_prefill(chunk)
+        if r.adapter is not None:
+            # (not an adapter_mk_fallbacks site: chunked prefill is
+            # always the op chain — there is no megakernel to leave)
+            if self._cb_prefill_ad_fn is None:
+                self._cb_prefill_ad_fn = self._build_cb_prefill(
+                    chunk, with_adapters=True)
+            fn = self._cb_prefill_ad_fn
+            pre = (self.weights, self._apool.device,
+                   jnp.asarray(np.asarray(
+                       [self._apool.slot(r.adapter)], np.int32)))
+        else:
+            if self._cb_prefill_fn is None:
+                self._cb_prefill_fn = self._build_cb_prefill(chunk)
+            fn = self._cb_prefill_fn
+            pre = (self.weights,)
         t_dev = time.perf_counter()
         with _prof_span("cb.prefill_chunk"):
-            logits, self.k_pages, self.v_pages = self._cb_prefill_fn(
-                self.weights, jnp.asarray(ids_chunk), self.k_pages,
+            logits, self.k_pages, self.v_pages = fn(
+                *pre, jnp.asarray(ids_chunk), self.k_pages,
                 self.v_pages,
                 jnp.asarray(self._tables_np[r.slot:r.slot + 1]),
                 jnp.int32(start), jnp.int32(r.t0))
@@ -1534,8 +1644,10 @@ class ContinuousBatchingEngine(LLMEngine):
         tail page stays private — decode writes land there). With a
         fleet prefix index attached, every full-page prefix digest is
         published alongside — advisory (an index failure never fails
-        the request)."""
-        if self._prefix is None:
+        the request). Adapter requests publish NOTHING — their KV
+        bytes carry the adapter's deltas, and the cache is content-
+        addressed by tokens alone (see _price_admission)."""
+        if self._prefix is None or r.adapter is not None:
             return
         key = ()
         dig = None
@@ -1578,6 +1690,123 @@ class ContinuousBatchingEngine(LLMEngine):
                                        chain_key_digest(chain_key))
         except Exception:
             self.index_publish_errors += 1
+
+    # -- multi-LoRA adapters (inference/adapters.py) -------------------------
+    def register_adapter(self, name, path):
+        """Registry write WITHOUT loading: the adapter hot-loads from
+        `path` on the first add_request(adapter=name). Deploying a
+        fine-tune = this call on every replica (EngineRouter.
+        load_adapter / the fleet RPC surface fan it out)."""
+        if self._apool is None:
+            raise AdapterError(
+                "this engine was built without an adapter pool "
+                "(adapters=); see docs/serving.md 'Multi-LoRA & the "
+                "model zoo'")
+        self._adapter_registry[name] = str(path)
+        return name
+
+    def load_adapter(self, name, source):
+        """Hot-load a LoRA adapter into the pool under `name` (source:
+        a directory written by adapters.save_adapter, or an adapter
+        dict). `adapter.load` is the fault point and fires PRE-install
+        — a failed/corrupt load raises typed, leaves the pool untouched
+        (zero page leak), and the engine keeps serving on base weights
+        (counted in the pool's load_errors). The load wall lands in the
+        `adapter_load_ms` telemetry histogram. Returns the pool slot."""
+        from .adapters import load_adapter_file
+        if self._apool is None:
+            raise AdapterError(
+                "this engine was built without an adapter pool "
+                "(adapters=); see docs/serving.md 'Multi-LoRA & the "
+                "model zoo'")
+        t0 = time.monotonic()
+        try:
+            fault_point("adapter.load", detail=f"name={name}")
+            if isinstance(source, dict):
+                ad = source
+            else:
+                ad = load_adapter_file(
+                    source, expect_dims=self._apool.dims,
+                    expect_layers=self._apool.n_layers)
+            slot = self._apool.install(name, ad)
+        except Exception:
+            self._apool.load_errors += 1
+            raise
+        if not isinstance(source, dict):
+            self._adapter_registry[name] = str(source)
+        dt_ms = (time.monotonic() - t0) * 1e3
+        self._apool.last_load_ms = dt_ms
+        if self._tel is not None:
+            self._tel.observe("adapter_load_ms", dt_ms)
+            self._tel.registry.count("adapter_loads")
+        return slot
+
+    def evict_adapter(self, name):
+        """Explicit pool eviction (LRU handles the implicit case);
+        refuses typed while live requests hold the adapter. The
+        `adapter_evict` counter rides telemetry."""
+        if self._apool is None:
+            raise AdapterError("this engine has no adapter pool "
+                               "(adapters=)")
+        slot = self._apool.evict(name)
+        # the lazy-load registry entry goes WITH the pool slot — an
+        # evicted fine-tune must not resurrect itself on the next
+        # request naming it (register_adapter re-arms lazy loading)
+        self._adapter_registry.pop(name, None)
+        if self._tel is not None:
+            self._tel.registry.count("adapter_evict")
+        return slot
+
+    def _resolve_adapter(self, name):
+        """Pool slot for `name`, hot-loading from the registry when not
+        resident; typed UnknownAdapterError otherwise."""
+        if self._apool is None:
+            raise AdapterError(
+                "add_request(adapter=...) needs an engine built with "
+                "an adapter pool (adapters=)")
+        if not self._apool.has(name):
+            path = self._adapter_registry.get(name)
+            if path is None:
+                raise UnknownAdapterError(
+                    f"adapter {name!r} is neither loaded nor "
+                    f"registered (loaded: {sorted(self._apool.names())}, "
+                    f"registered: {sorted(self._adapter_registry)})")
+            self.load_adapter(name, path)
+        return self._apool.slot(name)
+
+    def _release_adapter(self, r):
+        """Drop a retiring request's pool reference ONCE — but keep
+        the NAME on the request: failover salvage reads export_request
+        AFTER the failure transition, and a nulled name would resume
+        the continuation on base weights silently (wrong model, no
+        error)."""
+        if r.adapter is not None and self._apool is not None \
+                and not r.adapter_released:
+            self._apool.release(r.adapter)
+            r.adapter_released = True
+
+    def _ad_sel(self, AD, aid, li):
+        """The per-layer LoRA selection tuple the traced layer math
+        consumes (adapters.lora_apply): factor stacks for layer `li`,
+        the per-row pool-slot ids, per-row alpha/r scales, and the
+        aid > 0 gate that keeps adapter-free rows bit-exact."""
+        return (AD["a"][li], AD["b"][li], aid, AD["scale"][aid], aid > 0)
+
+    def _slot_aid(self, requests, w):
+        """Per-slot adapter pool-slot ids (0 = base weights) for a
+        dispatch over `requests`; None when the batch carries no
+        adapter (the caller then runs the untouched no-adapter
+        program)."""
+        if self._apool is None:
+            return None
+        aid = np.zeros(w, np.int32)
+        any_ad = False
+        for r in requests:
+            if r.adapter is not None and r.slot is not None \
+                    and r.slot < w:
+                aid[r.slot] = self._apool.slot(r.adapter)
+                any_ad = True
+        return aid if any_ad else None
 
     # -- telemetry (inference/telemetry.py) ----------------------------------
     def attach_telemetry(self, tel, src=None):
@@ -1862,7 +2091,7 @@ class ContinuousBatchingEngine(LLMEngine):
         return self._gather_logits(loc), tok_g, new_k, new_v
 
     def _cb_decode_math(self, W, tok, k_pages_all, v_pages_all, tables,
-                        lens, active, w):
+                        lens, active, w, ad=None):
         """One decode step at slot-bucket width w, fully traceable
         (shared by the per-step jit and the fused multi-step scan, so
         both paths run byte-identical math): one token for every slot,
@@ -1871,15 +2100,22 @@ class ContinuousBatchingEngine(LLMEngine):
         the per-layer op chain is replaced by the fused Pallas
         megakernel (same math, same page writes).
 
+        ad: (AD, aid) adapter selection for an adapter-carrying batch —
+        the grouped LoRA delta rides the op chain (a megakernel engine
+        FALLS BACK to the op-chain delta for these dispatches — counted
+        in adapter_mk_fallbacks; megakernel/op-chain byte-identity is
+        pinned, so the mixed-batch contract survives the mode split).
+
         Returns (logits, tok, new_k, new_v): logits the FULL-vocab row
         (gathered under a vocab-parallel head — unused consumers are
         DCE'd), tok the greedy argmax token (what the whole-step kernel
         emits directly; computed psum-free under tp). Greedy callers
         use tok, sampled callers logits — bitwise the same choice."""
-        if self.megakernel:
+        if self.megakernel and ad is None:
             return self._cb_decode_math_mk(W, tok, k_pages_all,
                                            v_pages_all, tables, lens,
                                            active, w)
+        AD, aid = ad if ad is not None else (None, None)
         p = self.page_size
         h = jnp.take(W["emb"], tok[:, None], axis=0).astype(
             self.kv_dtype)
@@ -1887,7 +2123,8 @@ class ContinuousBatchingEngine(LLMEngine):
         oob = jnp.int32(self.n_pages * p)
         new_k, new_v = [], []
         for li, wset in enumerate(W["layers"]):
-            q, k, v = self._layer_qkv(W, wset, h, pos_ids)
+            ad_li = None if ad is None else self._ad_sel(AD, aid, li)
+            q, k, v = self._layer_qkv(W, wset, h, pos_ids, ad=ad_li)
             slots = (tables[jnp.arange(w), lens // p] * p + lens % p)
             slots = jnp.where(active, slots, oob)
             kp = k_pages_all[li].reshape(-1, self.nh_kv_l, self.hd)
@@ -1898,21 +2135,23 @@ class ContinuousBatchingEngine(LLMEngine):
                                   mode="drop")
             kp = kp.reshape(self.n_pages, p, self.nh_kv_l, self.hd)
             vp = vp.reshape(self.n_pages, p, self.nh_kv_l, self.hd)
-            new_k.append(kp)
-            new_v.append(vp)
+            k_pages_all = _pools_put(k_pages_all, li, kp, new_k)
+            v_pages_all = _pools_put(v_pages_all, li, vp, new_v)
             attn = paged_attention(
                 q[:, 0], kp, vp, tables,
                 jnp.where(active, lens + 1, 0),
                 interpret=self.interpret,
                 active=active.astype(jnp.int32))
-            h = self._layer_tail(W, wset, h, attn[:, None])
+            h = self._layer_tail(W, wset, h, attn[:, None], ad=ad_li)
         h = _rms(h, W["norm"], W["eps"])
         loc = _mm(h, W["head"], self.interpret)[:, 0]
         return (self._gather_logits(loc), self._tp_greedy_token(loc),
-                new_k, new_v)
+                _pools_result(k_pages_all, new_k),
+                _pools_result(v_pages_all, new_v))
 
     def _cb_spec_verify_math(self, W, feed, k_pages_all, v_pages_all,
-                             tables, lens, active, rem, dlen, w):
+                             tables, lens, active, rem, dlen, w,
+                             ad=None):
         """ONE speculative VERIFY pass at slot width w: slot b feeds T
         tokens (its pending token + up to T-1 drafts) at global
         positions lens[b] + [0, T), writing their KV length-gated and
@@ -1935,11 +2174,16 @@ class ContinuousBatchingEngine(LLMEngine):
         _cb_decode_math, per feed position. With megakernel= on, the
         verify pass rides the kernel's tq>1 schedule instead
         (_cb_spec_verify_math_mk): same substituted block contents,
-        same ragged causal mask, same pool bytes."""
-        if self.megakernel:
+        same ragged causal mask, same pool bytes. ad: adapter selection
+        — verify rows carry the SLOT's adapter (every feed position of
+        slot b shares aid[b]), riding the op-chain delta exactly like
+        plain decode (megakernel engines fall back here for adapter
+        batches)."""
+        if self.megakernel and ad is None:
             return self._cb_spec_verify_math_mk(
                 W, feed, k_pages_all, v_pages_all, tables, lens, active,
                 rem, dlen, w)
+        AD, aid = ad if ad is not None else (None, None)
         p = self.page_size
         T = feed.shape[1]
         h = jnp.take(W["emb"], feed, axis=0).astype(self.kv_dtype)
@@ -1956,7 +2200,8 @@ class ContinuousBatchingEngine(LLMEngine):
         oob = jnp.int32(self.n_pages * p)
         new_k, new_v = [], []
         for li, wset in enumerate(W["layers"]):
-            q, k, v = self._layer_qkv(W, wset, h, pos_c)
+            ad_li = None if ad is None else self._ad_sel(AD, aid, li)
+            q, k, v = self._layer_qkv(W, wset, h, pos_c, ad=ad_li)
             slots = tables[jnp.arange(w)[:, None], pos_c // p] * p \
                 + pos_c % p
             slots = jnp.where(write_ok, slots, oob)
@@ -1966,17 +2211,18 @@ class ContinuousBatchingEngine(LLMEngine):
             vp = vp.at[slots].set(v.astype(self.kv_dtype), mode="drop")
             kp = kp.reshape(self.n_pages, p, self.nh_kv_l, self.hd)
             vp = vp.reshape(self.n_pages, p, self.nh_kv_l, self.hd)
-            new_k.append(kp)
-            new_v.append(vp)
+            k_pages_all = _pools_put(k_pages_all, li, kp, new_k)
+            v_pages_all = _pools_put(v_pages_all, li, vp, new_v)
             attn = spec_verify_attention(
                 q, kp, vp, tables, lens,
                 active=active.astype(jnp.int32),
                 interpret=self.interpret)
-            h = self._layer_tail(W, wset, h, attn)
+            h = self._layer_tail(W, wset, h, attn, ad=ad_li)
         h = _rms(h, W["norm"], W["eps"])
         loc = _mm(h, W["head"], self.interpret)
         return (self._gather_logits(loc), self._tp_greedy_token(loc),
-                new_k, new_v)
+                _pools_result(k_pages_all, new_k),
+                _pools_result(v_pages_all, new_v))
 
     def _cb_spec_verify_math_mk(self, W, feed, k_pages_all, v_pages_all,
                                 tables, lens, active, rem, dlen, w):
@@ -2019,14 +2265,29 @@ class ContinuousBatchingEngine(LLMEngine):
         return (logits.reshape(w, T, -1), tok_g.reshape(w, T),
                 new_k, new_v)
 
-    def _build_cb_step(self, w):
+    def _build_cb_step(self, w, with_adapters=False):
         def step(W, tok, k_pages_all, v_pages_all, tables, lens, active):
             logits, _tok, kps, vps = self._cb_decode_math(
                 W, tok, k_pages_all, v_pages_all, tables, lens, active,
                 w)
             return logits, kps, vps
 
+        def step_ad(W, AD, aid, tok, k_pages_all, v_pages_all, tables,
+                    lens, active):
+            logits, _tok, kps, vps = self._cb_decode_math(
+                W, tok, k_pages_all, v_pages_all, tables, lens, active,
+                w, ad=(AD, aid))
+            return logits, kps, vps
+
         Wsp, R, POOL = self._tp_specs()
+        if with_adapters:
+            ADsp = (self._apool.specs() if self._tpc is not None
+                    else None)
+            return self._jit_tp(step_ad,
+                                in_specs=(Wsp, ADsp, R, R, POOL, POOL,
+                                          R, R, R),
+                                out_specs=(R, POOL, POOL),
+                                donate_argnums=(4, 5))
         return self._jit_tp(step,
                             in_specs=(Wsp, R, POOL, POOL, R, R, R),
                             out_specs=(R, POOL, POOL),
@@ -2045,14 +2306,28 @@ class ContinuousBatchingEngine(LLMEngine):
         for r in decodes:
             if r.slot < w:
                 active[r.slot] = True
-        fn = self._cb_step_fns.get(w)
-        if fn is None:
-            fn = self._build_cb_step(w)
-            self._cb_step_fns[w] = fn
+        aid = self._slot_aid(decodes, w)
+        if aid is not None:
+            # adapter-carrying batch: the ADAPTER-AWARE program (the
+            # plain program stays untouched — and with megakernel= on,
+            # this dispatch IS the documented op-chain fallback)
+            if self.megakernel:
+                self.adapter_mk_fallbacks += 1
+            fn = self._cb_step_ad_fns.get(w)
+            if fn is None:
+                fn = self._build_cb_step(w, with_adapters=True)
+                self._cb_step_ad_fns[w] = fn
+            args = (self.weights, self._apool.device, jnp.asarray(aid))
+        else:
+            fn = self._cb_step_fns.get(w)
+            if fn is None:
+                fn = self._build_cb_step(w)
+                self._cb_step_fns[w] = fn
+            args = (self.weights,)
         t_dev = time.perf_counter()
         with _prof_span("cb.decode_step"):
             logits, self.k_pages, self.v_pages = fn(
-                self.weights, jnp.asarray(self._tok_np[:w]), self.k_pages,
+                *args, jnp.asarray(self._tok_np[:w]), self.k_pages,
                 self.v_pages, jnp.asarray(self._tables_np[:w]),
                 jnp.asarray(self._lens_np[:w]), jnp.asarray(active))
             toks = self._sample_tokens(logits)
@@ -2087,7 +2362,8 @@ class ContinuousBatchingEngine(LLMEngine):
                 "pinned?)")
         return False
 
-    def _build_cb_fused(self, w, with_prefill, with_decode):
+    def _build_cb_fused(self, w, with_prefill, with_decode,
+                        with_adapters=False):
         """ONE compiled program for a whole scheduling block at slot
         width w: a ragged prefill phase — every prefilling slot advances
         one chunk at its OWN offset, in one dispatch — followed by
@@ -2112,14 +2388,16 @@ class ContinuousBatchingEngine(LLMEngine):
             (self.ragged_kernel is None and not self.interpret)
 
         def prefill_phase(W, ids, k_pages_all, v_pages_all, tables,
-                          starts, ends, pf_act):
+                          starts, ends, pf_act, ad=None):
             h = jnp.take(W["emb"], ids, axis=0).astype(self.kv_dtype)
             pos = starts[:, None] + jnp.arange(chunk, dtype=jnp.int32)
             oob = jnp.int32(self.n_pages * p)
             ctx = jnp.minimum(starts + chunk, ends)
             new_k, new_v = [], []
             for li, wset in enumerate(W["layers"]):
-                q, k, v = self._layer_qkv(W, wset, h, pos)
+                ad_li = None if ad is None else \
+                    self._ad_sel(ad[0], ad[1], li)
+                q, k, v = self._layer_qkv(W, wset, h, pos, ad=ad_li)
                 slots = tables[jnp.arange(w)[:, None], pos // p] * p \
                     + pos % p
                 # inactive slots and padded tails write NOTHING
@@ -2156,7 +2434,7 @@ class ContinuousBatchingEngine(LLMEngine):
                     wts = jax.nn.softmax(logits.astype(jnp.float32),
                                          -1).astype(q.dtype)
                     attn = jnp.einsum("bhqk,bkhd->bqhd", wts, cv)
-                h = self._layer_tail(W, wset, h, attn)
+                h = self._layer_tail(W, wset, h, attn, ad=ad_li)
             h = _rms(h, W["norm"], W["eps"])
             last = jnp.clip(ends - 1 - starts, 0, chunk - 1)
             h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)
@@ -2165,11 +2443,11 @@ class ContinuousBatchingEngine(LLMEngine):
                     _pools_result(v_pages_all, new_v))
 
         def decode_scan(W, k_pages_all, v_pages_all, tables, tok, lens,
-                        act, rem, eos_ids, key):
+                        act, rem, eos_ids, key, ad=None):
             def body(carry, _):
                 tok, lens, act, rem, key, kps, vps = carry
                 logits, gtok, kps, vps = self._cb_decode_math(
-                    W, tok, kps, vps, tables, lens, act, w)
+                    W, tok, kps, vps, tables, lens, act, w, ad=ad)
                 key, sub = jax.random.split(key)
                 if do_sample:
                     nxt = _sample(logits, sub, True, temperature,
@@ -2204,7 +2482,7 @@ class ContinuousBatchingEngine(LLMEngine):
               if T else None)
 
         def spec_scan(W, k_pages_all, v_pages_all, tables, tok, lens,
-                      act, rem, eos_ids, key, drafts, dlen):
+                      act, rem, eos_ids, key, drafts, dlen, ad=None):
             """K VERIFY passes with accept/reject inside the scan
             carries: each pass feeds [tok, drafts_s] (T tokens), samples
             the target's token at every position, and commits the
@@ -2223,7 +2501,8 @@ class ContinuousBatchingEngine(LLMEngine):
                 tok, lens, act, rem, key, kps, vps = carry
                 feed = jnp.concatenate([tok[:, None], drafts_s], axis=1)
                 logits, gtok, kps, vps = self._cb_spec_verify_math(
-                    W, feed, kps, vps, tables, lens, act, rem, dlen_s, w)
+                    W, feed, kps, vps, tables, lens, act, rem, dlen_s, w,
+                    ad=ad)
                 key, sub = jax.random.split(key)
                 if do_sample:
                     g = _sample(logits.reshape(w * T, -1), sub, True,
@@ -2277,12 +2556,12 @@ class ContinuousBatchingEngine(LLMEngine):
 
         def fused(W, k_pages_all, v_pages_all, tables, pf_ids, pf_act,
                   pf_start, pf_end, tok, lens, act, rem, eos_ids, key,
-                  drafts=None, dlen=None):
+                  drafts=None, dlen=None, ad=None):
             first = toks = emitted = None
             if with_prefill:
                 pf_logits, k_pages_all, v_pages_all = prefill_phase(
                     W, pf_ids, k_pages_all, v_pages_all, tables,
-                    pf_start, pf_end, pf_act)
+                    pf_start, pf_end, pf_act, ad=ad)
                 key, sub = jax.random.split(key)
                 first = _sample(pf_logits, sub, do_sample, temperature,
                                 top_k, top_p)
@@ -2291,27 +2570,48 @@ class ContinuousBatchingEngine(LLMEngine):
                     (toks, emitted, tok, lens, act, rem, key,
                      k_pages_all, v_pages_all) = spec_scan(
                         W, k_pages_all, v_pages_all, tables, tok, lens,
-                        act, rem, eos_ids, key, drafts, dlen)
+                        act, rem, eos_ids, key, drafts, dlen, ad=ad)
                 else:
                     (toks, emitted, tok, lens, act, rem, key,
                      k_pages_all, v_pages_all) = decode_scan(
                         W, k_pages_all, v_pages_all, tables, tok, lens,
-                        act, rem, eos_ids, key)
+                        act, rem, eos_ids, key, ad=ad)
             return (first, toks, emitted, tok, lens, act, rem, key,
                     k_pages_all, v_pages_all)
 
         Wsp, R, POOL = self._tp_specs()
+        out_specs = (R, R, R, R, R, R, R, R, POOL, POOL)
+        if with_adapters:
+            # adapter-aware block: (AD, aid) ride right after W; same
+            # carries, same outputs — the plain program is untouched
+            def fused_ad(W, AD, aid, k_pages_all, v_pages_all, tables,
+                         pf_ids, pf_act, pf_start, pf_end, tok, lens,
+                         act, rem, eos_ids, key, *spec_args):
+                drafts, dlen = spec_args if T else (None, None)
+                return fused(W, k_pages_all, v_pages_all, tables,
+                             pf_ids, pf_act, pf_start, pf_end, tok,
+                             lens, act, rem, eos_ids, key,
+                             drafts=drafts, dlen=dlen, ad=(AD, aid))
+
+            ADsp = (self._apool.specs() if self._tpc is not None
+                    else None)
+            in_specs = (Wsp, ADsp, R, POOL, POOL) \
+                + (R,) * (11 + (2 if T else 0))
+            return self._jit_tp(fused_ad, in_specs=in_specs,
+                                out_specs=out_specs,
+                                donate_argnums=(3, 4))
         # positional arg specs: drafts/dlen ride only when speculating
         in_specs = (Wsp, POOL, POOL) + (R,) * (11 + (2 if T else 0))
-        out_specs = (R, R, R, R, R, R, R, R, POOL, POOL)
         return self._jit_tp(fused, in_specs=in_specs,
                             out_specs=out_specs, donate_argnums=(1, 2))
 
-    def _get_fused(self, w, with_prefill, with_decode):
-        key = (w, with_prefill, with_decode)
+    def _get_fused(self, w, with_prefill, with_decode,
+                   with_adapters=False):
+        key = (w, with_prefill, with_decode, with_adapters)
         fn = self._cb_fused_fns.get(key)
         if fn is None:
-            fn = self._build_cb_fused(w, with_prefill, with_decode)
+            fn = self._build_cb_fused(w, with_prefill, with_decode,
+                                      with_adapters)
             self._cb_fused_fns[key] = fn
         return fn
 
@@ -2474,7 +2774,17 @@ class ContinuousBatchingEngine(LLMEngine):
             return True
         blk.has_prefill = bool(live_pf)
         blk.has_decode = bool(blk.dec_items)
-        fn = self._get_fused(w, blk.has_prefill, blk.has_decode)
+        aid = self._slot_aid(live_pf + blk.dec_items, w)
+        ad_args = ()
+        if aid is not None:
+            if self.megakernel and blk.has_decode:
+                # only decode/verify dispatches ever RUN the megakernel
+                # — a prefill-only block left nothing
+                self.adapter_mk_fallbacks += 1
+            blk.aid = jnp.asarray(aid)
+            ad_args = (self._apool.device, blk.aid)
+        fn = self._get_fused(w, blk.has_prefill, blk.has_decode,
+                             aid is not None)
         blk.tables = jnp.asarray(self._tables_np[:w])
         blk.eos_dev = jnp.asarray(eos)
         if T:
@@ -2486,7 +2796,8 @@ class ContinuousBatchingEngine(LLMEngine):
             (blk.first, blk.toks, blk.emitted, blk.tok_fin, blk.lens_fin,
              blk.act_fin, blk.rem_fin, self._key, self.k_pages,
              self.v_pages) = fn(
-                self.weights, self.k_pages, self.v_pages, blk.tables,
+                self.weights, *ad_args, self.k_pages, self.v_pages,
+                blk.tables,
                 jnp.asarray(pf_ids), jnp.asarray(pf_act),
                 jnp.asarray(pf_start), jnp.asarray(pf_end),
                 jnp.asarray(self._tok_np[:w]),
@@ -2553,7 +2864,13 @@ class ContinuousBatchingEngine(LLMEngine):
         nxt.eos_dev = blk.eos_dev
         nxt.has_decode = True
         nxt.chained = True
-        fn = self._get_fused(w, False, True)
+        nxt.aid = blk.aid               # adapter ids are static across
+        ad_args = ()                    # a chain (admission happens at
+        if blk.aid is not None:         # host sync points only)
+            if self.megakernel:
+                self.adapter_mk_fallbacks += 1
+            ad_args = (self._apool.device, blk.aid)
+        fn = self._get_fused(w, False, True, blk.aid is not None)
         dummy = self._pf_dummies.get(w)
         if dummy is None:
             dummy = (jnp.asarray(np.zeros((w, chunk), np.int64)),
@@ -2565,7 +2882,8 @@ class ContinuousBatchingEngine(LLMEngine):
             (nxt.first, nxt.toks, nxt.emitted, nxt.tok_fin, nxt.lens_fin,
              nxt.act_fin, nxt.rem_fin, self._key, self.k_pages,
              self.v_pages) = fn(
-                self.weights, self.k_pages, self.v_pages, blk.tables,
+                self.weights, *ad_args, self.k_pages, self.v_pages,
+                blk.tables,
                 *dummy, blk.tok_fin, blk.lens_fin, blk.act_fin,
                 blk.rem_fin, blk.eos_dev, self._key)
         self.fused_blocks += 1
@@ -2687,6 +3005,8 @@ class ContinuousBatchingEngine(LLMEngine):
         share = self._tenant_cfg.get(r.tenant, {}).get("share", 1.0)
         self._tenant_vt[r.tenant] = self._vt(r.tenant) + 1.0 / share
         self._tenant_tokens[r.tenant] += 1
+        if r.adapter is not None:
+            self.adapter_tokens[r.adapter] += 1
         if (r.eos_token_id is not None and tok == r.eos_token_id) or \
                 len(r.out) >= r.max_new_tokens:
             self._retire(r)
@@ -2723,6 +3043,9 @@ class ContinuousBatchingEngine(LLMEngine):
             "priority": r.priority,
             "ttl_steps": ttl,
             "deadline": r.deadline,        # absolute monotonic cutoff
+            "adapter": r.adapter,          # LoRA adapter name (the
+            #                                importer resolves it in
+            #                                ITS pool/registry)
         }
 
     def export_inflight(self):
@@ -2752,7 +3075,7 @@ class ContinuousBatchingEngine(LLMEngine):
             spec["prompt"], max_new_tokens=spec["max_new_tokens"],
             eos_token_id=spec["eos_token_id"], deadline_ms=deadline_ms,
             ttl_steps=spec["ttl_steps"], tenant=spec["tenant"],
-            priority=spec["priority"])
+            priority=spec["priority"], adapter=spec.get("adapter"))
         gen = int(spec.get("generated") or 0)
         if gen and self._tel is not None:
             # a resumed continuation: the folded prompt already holds
@@ -2924,6 +3247,7 @@ class ContinuousBatchingEngine(LLMEngine):
         r.pages = [pg for pg in r.pages if pg not in used]
         r.state = MIGRATED
         self._release_slot(r)
+        self._release_adapter(r)
         self.handoffs_out += 1
         if self._tel is not None:
             # "migrated" pairs with "kv_export" -> handoff_ms histogram
@@ -2976,6 +3300,12 @@ class ContinuousBatchingEngine(LLMEngine):
             raise ValueError(
                 f"prompt {t0} + total budget {mnt_total} exceeds "
                 f"max_len={self.max_len}")
+        ad_name = spec.get("adapter")
+        if ad_name is not None:
+            # resolved (hot-loading from the registry if needed) BEFORE
+            # the CRC sweep/page claim: an adapter this engine cannot
+            # serve must cost the coordinator a cheap typed refusal
+            self._resolve_adapter(ad_name)
         lens = int(payload["lens"])
         p = self.page_size
         n_used = -(-lens // p)
@@ -3041,6 +3371,10 @@ class ContinuousBatchingEngine(LLMEngine):
             self._tables_np[slot] = 0
             self._tables_np[slot, :len(pages)] = pages
             self._lens_np[slot] = lens
+            if ad_name is not None:
+                r.adapter = ad_name
+                self._apool.acquire(ad_name)
+                self.adapter_requests[ad_name] += 1
             self._publish_prefix(r)
             self.allocator.import_commit(payload["token"])
         except Exception:
@@ -3048,6 +3382,7 @@ class ContinuousBatchingEngine(LLMEngine):
             # burned (a retry may target this engine again), slot and
             # request maps untouched by the partial seat
             if r is not None:
+                self._release_adapter(r)
                 if self._requests.get(r.uid) is r:
                     del self._requests[r.uid]
                 if self._slots[slot] is r:
@@ -3587,6 +3922,7 @@ class ContinuousBatchingEngine(LLMEngine):
                                  tokens_generated=len(r.out))
         r.state = state
         self._release_slot(r)
+        self._release_adapter(r)
         self.failure_count += 1
         if self._tel is not None:
             self._tel.req_done(self._tel_src, r.uid, state,
@@ -3598,6 +3934,7 @@ class ContinuousBatchingEngine(LLMEngine):
                                    np.asarray(r.out, np.int64)])
         r.state = DONE
         self._release_slot(r)
+        self._release_adapter(r)
         if self._tel is not None:
             self._tel.req_done(self._tel_src, r.uid, DONE,
                                n_tokens=len(r.out))
@@ -3622,6 +3959,7 @@ class ContinuousBatchingEngine(LLMEngine):
             # entries are gone) — typed engine-stage failure, like any
             # in-flight request
             self._drop_demoted(r)
+            self._release_adapter(r)
             r.pages = []
             r.shared_idx = set()
             r.state = FAILED
@@ -3638,6 +3976,7 @@ class ContinuousBatchingEngine(LLMEngine):
                              n_tokens=len(r.out), stage="engine")
         for i, r in enumerate(getattr(self, "_slots", [])):
             if r is not None:
+                self._release_adapter(r)
                 r.state = FAILED
                 if r.error is None:
                     r.error = RequestFailure(

@@ -216,10 +216,14 @@ class PageAllocator:
         return len(self._free)
 
 
-def _snapshot_llama(model, quant, weight_dtype=None):
+def _snapshot_llama(model, quant, weight_dtype=None, quant_scales=None):
     """Pull per-layer weights out of the Layer tree into plain arrays.
     quant='int8' replaces the six projection weights of every layer (and
-    the lm_head) with (int8, scales) pairs.
+    the lm_head) with (int8, scales) pairs; quant_scales (a
+    quantization.ptq.CalibrationResult) swaps the absmax-from-weights
+    scales for PTQ-calibrated ones, leaf by leaf — a leaf the
+    calibration lacks keeps the absmax fallback, and a scale vector of
+    the wrong width fails typed before anything installs.
 
     Lazy-aware: a model built under framework.LazyGuard (meta init) is
     materialized HERE, one leaf at a time, straight to `weight_dtype` —
@@ -238,33 +242,38 @@ def _snapshot_llama(model, quant, weight_dtype=None):
             w = w.astype(wdt)
         return w
 
-    def maybe_q(param):
+    def maybe_q(param, li=None, proj=None):
         # int8 quantizes from the natively-materialized values (NOT from a
         # weight_dtype-rounded copy: scales should see full init precision)
         if quant == "int8":
             w = materialize_lazy(param)
+            sc_cal = (quant_scales.weight_scale(li, proj)
+                      if quant_scales is not None else None)
+            if sc_cal is not None:
+                from ..quantization.ptq import quantize_with_scales
+                return quantize_with_scales(w.astype(jnp.float32), sc_cal)
             wq, sc = quantize_weights(w.astype(jnp.float32))
             return (wq, sc)
         return take(param)
 
     layers = []
-    for layer in model.llama.layers:
+    for li, layer in enumerate(model.llama.layers):
         a = layer.self_attn
         layers.append(dict(
             ln1=take(layer.input_layernorm.weight),
             ln2=take(layer.post_attention_layernorm.weight),
-            wq=maybe_q(a.q_proj.weight),
-            wk=maybe_q(a.k_proj.weight),
-            wv=maybe_q(a.v_proj.weight),
-            wo=maybe_q(a.o_proj.weight),
-            wg=maybe_q(layer.mlp.gate_proj.weight),
-            wu=maybe_q(layer.mlp.up_proj.weight),
-            wd=maybe_q(layer.mlp.down_proj.weight),
+            wq=maybe_q(a.q_proj.weight, li, "wq"),
+            wk=maybe_q(a.k_proj.weight, li, "wk"),
+            wv=maybe_q(a.v_proj.weight, li, "wv"),
+            wo=maybe_q(a.o_proj.weight, li, "wo"),
+            wg=maybe_q(layer.mlp.gate_proj.weight, li, "wg"),
+            wu=maybe_q(layer.mlp.up_proj.weight, li, "wu"),
+            wd=maybe_q(layer.mlp.down_proj.weight, li, "wd"),
         ))
     return dict(emb=take(model.llama.embed_tokens.weight),
                 norm=take(model.llama.norm.weight),
-                head=maybe_q(model.lm_head.weight), layers=layers,
-                eps=cfg.rms_norm_eps)
+                head=maybe_q(model.lm_head.weight, None, "head"),
+                layers=layers, eps=cfg.rms_norm_eps)
 
 
 def _mm(x, w, interpret):
@@ -294,10 +303,15 @@ class LLMEngine:
     def __init__(self, model, max_len=1024, page_size=128, max_batch=8,
                  quant=None, use_pallas=None, batch_buckets=None,
                  weight_dtype=None, flash_prefill_min=256,
-                 tp=1, tp_mode="exact", tp_compress=None):
+                 tp=1, tp_mode="exact", tp_compress=None,
+                 quant_scales=None):
         assert isinstance(model, LlamaForCausalLM), "LLaMA family only"
         if quant not in (None, "int8"):
             raise ValueError(f"unsupported quant {quant!r}")
+        if quant_scales is not None and quant != "int8":
+            raise ValueError(
+                "quant_scales (PTQ calibration) only applies with "
+                "quant='int8' — the scales feed the int8 snapshot")
         if weight_dtype is not None:
             asked = weight_dtype
             try:
@@ -354,7 +368,9 @@ class LLMEngine:
         # kernel instead of dense scores (see _attn_prefill)
         self.flash_prefill_min = int(flash_prefill_min)
         self._flash = None
-        self.weights = _snapshot_llama(model, quant, weight_dtype)
+        self.quant_scales = quant_scales
+        self.weights = _snapshot_llama(model, quant, weight_dtype,
+                                       quant_scales)
         dtype = (jnp.bfloat16 if jax.default_backend() != "cpu"
                  else jnp.float32)
         self.kv_dtype = dtype
@@ -513,16 +529,29 @@ class LLMEngine:
                                1.0 / math.sqrt(self.hd))
         return self._attn_dense(q, k, v)
 
-    def _layer_qkv(self, W, wset, h, pos_ids):
+    def _layer_qkv(self, W, wset, h, pos_ids, ad=None):
         # head-count comes from the matmul's own width (nh_l/nh_kv_l):
         # under shard_map the column-sharded wq/wk/wv produce this
-        # shard's heads only, at tp=1 the full set — same code path
+        # shard's heads only, at tp=1 the full set — same code path.
+        # ad: per-layer LoRA selection (inference/adapters.py) — the
+        # grouped low-rank delta lands on the projection OUTPUTS
+        # (pre-rope, pre-reshape), where-gated so adapter-free rows
+        # keep their exact bits; None (the default, and the only value
+        # the static-generate paths ever pass) is zero-cost.
         cos, sin = W["cos"], W["sin"]
         b, t, H = h.shape
         x = _rms(h, wset["ln1"], W["eps"])
-        q = _mm(x, wset["wq"], self.interpret).reshape(b, t, -1, self.hd)
-        k = _mm(x, wset["wk"], self.interpret).reshape(b, t, -1, self.hd)
-        v = _mm(x, wset["wv"], self.interpret).reshape(b, t, -1, self.hd)
+        q = _mm(x, wset["wq"], self.interpret)
+        k = _mm(x, wset["wk"], self.interpret)
+        v = _mm(x, wset["wv"], self.interpret)
+        if ad is not None:
+            from .adapters import lora_apply
+            q = lora_apply(q, x, "wq", ad)
+            k = lora_apply(k, x, "wk", ad)
+            v = lora_apply(v, x, "wv", ad)
+        q = q.reshape(b, t, -1, self.hd)
+        k = k.reshape(b, t, -1, self.hd)
+        v = v.reshape(b, t, -1, self.hd)
         # GQA: k/v STAY at nh_kv heads — the paged cache stores the
         # checkpoint's kv width (1/rep the HBM of an expanded cache) and
         # the decode kernel maps q head i -> kv head i // rep natively
@@ -536,13 +565,18 @@ class LLMEngine:
 
         return rope(q), rope(k), v
 
-    def _layer_tail(self, W, wset, h, attn_out):
+    def _layer_tail(self, W, wset, h, attn_out, ad=None):
         # TP row-parallel pair (o_proj / down_proj): "exact" mode
         # gathers the sharded operand and runs the full matmul
         # replicated (byte-identical to tp=1 — the gather is pure data
         # movement); "psum" mode keeps the operand local against
         # row-sharded weights and all-reduces the partial outputs. At
         # tp=1 every hook is identity and this is the original chain.
+        # ad: per-layer LoRA selection — deltas on gate/up (local
+        # columns under tp, like the projections) and on down (after
+        # the exact-mode gather, replicated like wd itself); adapters
+        # require tp_mode="exact" (gated at engine build) because the
+        # down delta needs the FULL activation row.
         b, t = attn_out.shape[:2]
         attn_out = self._tp_gather_heads(attn_out)
         o = _mm(attn_out.reshape(b, t, -1), wset["wo"], self.interpret)
@@ -551,9 +585,17 @@ class LLMEngine:
         x = _rms(h, wset["ln2"], W["eps"])
         g = _mm(x, wset["wg"], self.interpret)
         u = _mm(x, wset["wu"], self.interpret)
+        if ad is not None:
+            from .adapters import lora_apply
+            g = lora_apply(g, x, "wg", ad)
+            u = lora_apply(u, x, "wu", ad)
         act = jax.nn.silu(g.astype(jnp.float32)).astype(g.dtype) * u
         act = self._tp_gather_cols(act)
-        return h + self._tp_reduce(_mm(act, wset["wd"], self.interpret))
+        d = _mm(act, wset["wd"], self.interpret)
+        if ad is not None:
+            from .adapters import lora_apply
+            d = lora_apply(d, act, "wd", ad)
+        return h + self._tp_reduce(d)
 
     # -- prefill ------------------------------------------------------------
     def _build_prefill(self, t_pad):

@@ -1,0 +1,235 @@
+"""PTQ calibration for the int8 serving stack — the model-zoo half.
+
+The dormant observer tier (`BaseObserver`/`AbsmaxObserver`,
+`_AbsmaxActObserver`) finally gets its consumer: `calibrate(model,
+sample_batches)` runs per-output-channel weight observers over every
+projection the serving engine quantizes (wq/wk/wv/wo/gate/up/down +
+lm_head) and — when sample batches are given — absmax ACTIVATION
+observers hooked over the same Linears for a forward pass per batch,
+then emits a `CalibrationResult` whose per-channel int8 scales are
+exactly what `LLMEngine(quant="int8", quant_scales=result)` eats (the
+`ops/pallas/quantized_matmul.quantize_weights` convention: symmetric,
+per-output-channel, absmax/127, clip to [-127, 127]).
+
+The zoo workflow (docs/serving.md "Multi-LoRA & the model zoo"): one
+base checkpoint, calibrated ONCE, served int8, with N LoRA adapters on
+top (`inference/adapters.py`) — per-tenant models at marginal cost.
+The absmax weight observers reduce over the same materialized values
+`quantize_weights` would, so a calibrated engine's greedy output is
+byte-identical to the absmax-from-weights baseline (pinned in
+tests/test_ptq.py); a calibration produced by a different observer
+(histogram/MSE later) plugs into the same scales slot.
+"""
+import json
+import os
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import BaseObserver, _AbsmaxActObserver, _ObservedLinear
+
+# engine projection keys, in _snapshot_llama's layer order; "head" is
+# the lm_head. LoRA targets (adapters.ADAPTER_TARGETS) are the subset
+# without wo — quantization covers all seven + the head.
+PROJ_KEYS = ("wq", "wk", "wv", "wo", "wg", "wu", "wd")
+QMAX = 127.0
+
+
+class CalibrationError(RuntimeError):
+    """Typed calibration failures (corrupt file, geometry mismatch)."""
+
+
+class ChannelAbsmaxObserver(BaseObserver):
+    """Per-output-channel absmax WEIGHT observer: observes [in, out]
+    arrays, reports scales [out] = absmax(axis=0)/127 — the
+    quantize_weights convention, expressed through the observer API so
+    a different reduction (percentile, MSE) is a subclass away."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._absmax = None
+
+    def _observe(self, x):
+        arr = jnp.asarray(getattr(x, "data", x))
+        am = jnp.max(jnp.abs(arr), axis=0)
+        self._absmax = am if self._absmax is None \
+            else jnp.maximum(self._absmax, am)
+
+    @property
+    def observed(self):
+        return self._absmax is not None
+
+    def scales(self):
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        return np.asarray(self._absmax, np.float32) / qmax
+
+
+class CalibrationResult:
+    """Per-channel int8 weight scales (+ absmax activation scales) for
+    one model geometry — what `LLMEngine(quant="int8",
+    quant_scales=...)` consumes and what `save`/`load` round-trip."""
+
+    def __init__(self, weight_scales, act_scales=None, bits=8,
+                 n_layers=None):
+        self.weight = weight_scales     # {"layers": [{proj: np [out]}],
+        #                                  "head": np [vocab]}
+        self.act = act_scales or {}     # {"layers": [{proj: float}],
+        #                                  "head": float} (absmax/qmax)
+        self.bits = int(bits)
+        self.n_layers = (len(self.weight["layers"])
+                         if n_layers is None else int(n_layers))
+
+    def weight_scale(self, li, proj):
+        """Scales [out] for layer `li`'s projection (or ("head",) via
+        li=None); None when the calibration lacks it (the engine then
+        falls back to absmax-from-weights for that leaf)."""
+        if li is None or proj == "head":
+            return self.weight.get("head")
+        if li >= len(self.weight["layers"]):
+            return None
+        return self.weight["layers"][li].get(proj)
+
+    def save(self, path):
+        """One .npz of scales + a JSON meta blob (bits/layers/act)."""
+        arrs = {}
+        for li, lay in enumerate(self.weight["layers"]):
+            for proj, sc in lay.items():
+                arrs[f"layer{li}.{proj}"] = np.asarray(sc, np.float32)
+        if self.weight.get("head") is not None:
+            arrs["head"] = np.asarray(self.weight["head"], np.float32)
+        arrs["__meta__"] = np.frombuffer(json.dumps(
+            {"bits": self.bits, "n_layers": self.n_layers,
+             "act": self.act}).encode(), np.uint8)
+        np.savez(path, **arrs)
+        return path
+
+    @classmethod
+    def load(cls, path):
+        try:
+            data = np.load(path if str(path).endswith(".npz")
+                           else str(path) + ".npz")
+            meta = json.loads(bytes(data["__meta__"]).decode())
+            n_layers = int(meta["n_layers"])
+            layers = [{} for _ in range(n_layers)]
+            head = None
+            for key in data.files:
+                if key == "__meta__":
+                    continue
+                if key == "head":
+                    head = np.asarray(data[key], np.float32)
+                    continue
+                lay, _, proj = key.partition(".")
+                layers[int(lay[len("layer"):])][proj] = np.asarray(
+                    data[key], np.float32)
+        except (OSError, KeyError, ValueError,
+                json.JSONDecodeError) as e:
+            raise CalibrationError(
+                f"calibration {path!r} unreadable/corrupt "
+                f"({type(e).__name__}: {e})") from e
+        return cls({"layers": layers, "head": head},
+                   act_scales=meta.get("act"), bits=meta.get("bits", 8),
+                   n_layers=n_layers)
+
+
+def quantize_with_scales(w, scales):
+    """Symmetric int8 quantization of a [in, out] weight under GIVEN
+    per-output-channel scales — the deploy step a CalibrationResult
+    feeds. Same clip/round as quantize_weights; raises typed when the
+    scale vector does not match the weight's out dim (a calibration
+    from a different geometry must fail before install)."""
+    w = jnp.asarray(w)
+    scales = np.asarray(scales, np.float32).reshape(-1)
+    if scales.shape[0] != w.shape[-1]:
+        raise CalibrationError(
+            f"scale vector of {scales.shape[0]} channels does not "
+            f"match weight out dim {w.shape[-1]} (calibration from a "
+            "different model geometry?)")
+    s = jnp.maximum(jnp.asarray(scales), 1e-12)
+    wq = jnp.clip(jnp.round(w / s), -QMAX, QMAX).astype(jnp.int8)
+    return wq, jnp.asarray(scales)
+
+
+def _llama_linears(model):
+    """[(li or None, proj_key, Linear)] over every projection the
+    serving snapshot quantizes, in snapshot order."""
+    out = []
+    for li, layer in enumerate(model.llama.layers):
+        a = layer.self_attn
+        out += [(li, "wq", a.q_proj), (li, "wk", a.k_proj),
+                (li, "wv", a.v_proj), (li, "wo", a.o_proj),
+                (li, "wg", layer.mlp.gate_proj),
+                (li, "wu", layer.mlp.up_proj),
+                (li, "wd", layer.mlp.down_proj)]
+    out.append((None, "head", model.lm_head))
+    return out
+
+
+def calibrate(model, sample_batches=None, bits=8):
+    """Run the PTQ observers over a LlamaForCausalLM and emit the
+    engine-consumable scales.
+
+    Weight pass: a `ChannelAbsmaxObserver` per projection (per-output-
+    channel absmax/qmax — bitwise the `quantize_weights` reduction, so
+    `LLMEngine(quant="int8", quant_scales=calibrate(model))` is
+    byte-identical to the absmax-from-weights engine; pinned in
+    tests/test_ptq.py).
+
+    Activation pass (sample_batches = iterable of [b, t] int token
+    arrays): every projection Linear is wrapped IN PLACE with the
+    dormant `_AbsmaxActObserver` (via `_ObservedLinear`), the model
+    runs one forward per batch, the running absmax scales are read out,
+    and the wrappers are removed — the model leaves exactly as it
+    arrived. Act scales ride the result for the QuantizedLinear
+    act_scale deploy path and observability; the serving engine's int8
+    path is weight-only and does not consume them.
+    """
+    from ..tensor.tensor import Tensor
+    sites = _llama_linears(model)
+    layers = [{} for _ in model.llama.layers]
+    head = None
+    for li, proj, lin in sites:
+        obs = ChannelAbsmaxObserver(bits)
+        obs._observe(lin.weight)
+        sc = obs.scales()
+        if li is None:
+            head = sc
+        else:
+            layers[li][proj] = sc
+    act = None
+    if sample_batches is not None:
+        wrapped = []                    # (parent, attr, wrapper)
+        acc = {}
+        for li, proj, lin in sites:
+            if li is None:
+                parent, attr = model, "lm_head"
+            elif proj in ("wq", "wk", "wv", "wo"):
+                parent = model.llama.layers[li].self_attn
+                attr = {"wq": "q_proj", "wk": "k_proj", "wv": "v_proj",
+                        "wo": "o_proj"}[proj]
+            else:
+                parent = model.llama.layers[li].mlp
+                attr = {"wg": "gate_proj", "wu": "up_proj",
+                        "wd": "down_proj"}[proj]
+            factory = _AbsmaxActObserver(quant_bits=bits)
+            wrapper = _ObservedLinear(lin, factory._instance(lin))
+            parent._sub_layers[attr] = wrapper
+            wrapped.append((parent, attr, lin, wrapper))
+            acc[(li, proj)] = wrapper.act_observer
+        try:
+            model.eval()
+            for batch in sample_batches:
+                ids = batch if isinstance(batch, Tensor) else \
+                    Tensor(np.asarray(batch, np.int64))
+                model(ids)
+        finally:
+            for parent, attr, lin, _w in wrapped:
+                parent._sub_layers[attr] = lin
+        act = {"layers": [{} for _ in model.llama.layers], "head": None}
+        for (li, proj), obs in acc.items():
+            s = float(obs.scales()) if obs.observed else None
+            if li is None:
+                act["head"] = s
+            else:
+                act["layers"][li][proj] = s
+    return CalibrationResult({"layers": layers, "head": head},
+                             act_scales=act, bits=bits)

@@ -120,6 +120,8 @@ def pytest_sessionstart(session):
 # silently skipping the tests this PR is gated on. (Ordering is
 # file-granular; within a file, order is unchanged.)
 _COLLECT_FIRST = (
+    "tests/test_adapters.py",         # PR 15 multi-LoRA adapter serving
+    "tests/test_ptq.py",              # PR 15 PTQ calibration / int8 zoo
     "tests/test_fleet.py",            # PR 14 process-backed fleet
     "tests/test_telemetry.py",        # PR 13 serving telemetry plane
     "tests/test_megakernel_v2.py",    # PR 12 whole-step megakernel

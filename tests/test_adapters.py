@@ -1,0 +1,583 @@
+"""Multi-LoRA adapter serving (ISSUE 15): paged adapter pool + ragged
+grouped adapter matmul in the decode path.
+
+The acceptance contract: (a) an engine with an adapter pool but NO
+adapter requests is byte-identical to the pre-adapter engine; (b) a
+MIXED batch (two adapters + base rows) is byte-identical to running
+each adapter's requests on a dedicated engine — pinned across
+decode_block ∈ {1, 8} × speculate ∈ {off, 4} × tp ∈ {1, 2}; (c) pool
+discipline is the KV pool's (refcounts, LRU evict of idle adapters,
+typed AdapterFullError, zero page leak on a corrupt file); (d) the
+registry write path deploys fleet-wide and survives failover (the
+adapter name rides the resume spec). Micro 1-layer GQA geometry
+throughout (nh=4, nh_kv=2 — a whole GQA group per shard at tp=2).
+"""
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import failsafe
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.inference.adapters import (AdapterCorruptError,
+                                           AdapterError,
+                                           AdapterFullError, AdapterPool,
+                                           UnknownAdapterError,
+                                           load_adapter_file,
+                                           make_lora_adapter,
+                                           save_adapter)
+from paddle_tpu.inference.router import EngineRouter
+from paddle_tpu.inference.scheduler import ContinuousBatchingEngine
+
+
+def _micro_cfg():
+    return LlamaConfig.tiny(num_hidden_layers=1, hidden_size=32,
+                            intermediate_size=64, num_attention_heads=4,
+                            num_key_value_heads=2)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    paddle.seed(3)
+    cfg = _micro_cfg()
+    return LlamaForCausalLM(cfg), cfg
+
+
+ENGINE_KW = dict(max_len=64, page_size=8, max_batch=4, prefill_chunk=8)
+POOL = {"rank": 4}
+
+
+@pytest.fixture(scope="module")
+def adapters(tiny):
+    _, cfg = tiny
+    return (make_lora_adapter(cfg, rank=4, seed=1),
+            make_lora_adapter(cfg, rank=4, seed=2))
+
+
+def _stream(cfg, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, cfg.vocab_size, (t,)).astype(np.int64)
+            for t in (9, 5, 12)]
+
+
+@pytest.fixture(scope="module")
+def base_ref(tiny):
+    """Pre-adapter engine outputs (no pool at all)."""
+    model, cfg = tiny
+    return ContinuousBatchingEngine(model, **ENGINE_KW).generate_many(
+        _stream(cfg), max_new_tokens=6)
+
+
+def _dedicated(model, ad, prompt, mnt=6, **over):
+    kw = dict(ENGINE_KW)
+    kw.update(over)
+    e = ContinuousBatchingEngine(model, adapters=POOL, **kw)
+    e.load_adapter("only", ad)
+    u = e.add_request(prompt, mnt, adapter="only")
+    e.drain()
+    return e.result(u)
+
+
+def _mixed(model, ad1, ad2, prompts, **over):
+    kw = dict(ENGINE_KW)
+    kw.update(over)
+    e = ContinuousBatchingEngine(model, adapters=POOL, **kw)
+    e.load_adapter("a1", ad1)
+    e.load_adapter("a2", ad2)
+    uids = [e.add_request(prompts[0], 6, adapter="a1"),
+            e.add_request(prompts[1], 6, adapter="a2"),
+            e.add_request(prompts[2], 6)]
+    e.drain()
+    return [e.result(u) for u in uids], e
+
+
+# -- pool units ---------------------------------------------------------------
+class TestPoolUnits:
+    def test_install_pages_and_slots(self, tiny, adapters):
+        _, cfg = tiny
+        from paddle_tpu.inference.adapters import engine_target_dims
+        pool = AdapterPool(1, engine_target_dims(cfg), rank=4,
+                           max_adapters=2)
+        free0 = pool.allocator.available
+        s1 = pool.install("a", adapters[0])
+        assert s1 >= 1                  # slot 0 is the zero adapter
+        assert pool.allocator.available == free0 - pool.pages_per_adapter
+        pool.evict("a")
+        assert pool.allocator.available == free0
+
+    def test_lru_evicts_idle_full_pool_raises(self, tiny, adapters):
+        _, cfg = tiny
+        from paddle_tpu.inference.adapters import engine_target_dims
+        pool = AdapterPool(1, engine_target_dims(cfg), rank=4,
+                           max_adapters=2)
+        pool.install("x0", adapters[0])
+        pool.install("x1", adapters[1])
+        pool.slot("x1")                 # touch: x0 becomes LRU
+        pool.install("x2", adapters[0])
+        assert not pool.has("x0") and pool.has("x1") and pool.has("x2")
+        assert pool.evictions == 1
+        pool.acquire("x1")
+        pool.acquire("x2")
+        with pytest.raises(AdapterFullError):
+            pool.install("x3", adapters[1])
+        pool.release("x1")
+        pool.install("x3", adapters[1])     # x1 idle again -> evictable
+        assert pool.has("x3")
+
+    def test_busy_adapter_never_evicted(self, tiny, adapters):
+        _, cfg = tiny
+        from paddle_tpu.inference.adapters import engine_target_dims
+        pool = AdapterPool(1, engine_target_dims(cfg), rank=4)
+        pool.install("a", adapters[0])
+        pool.acquire("a")
+        with pytest.raises(AdapterError):
+            pool.evict("a")
+        pool.release("a")
+        pool.evict("a")
+
+    def test_rank_and_shape_verified(self, tiny, adapters):
+        _, cfg = tiny
+        from paddle_tpu.inference.adapters import engine_target_dims
+        pool = AdapterPool(1, engine_target_dims(cfg), rank=2)
+        with pytest.raises(AdapterCorruptError):
+            pool.install("big", adapters[0])    # rank 4 > pool rank 2
+
+    def test_unknown_adapter_typed(self, tiny):
+        model, cfg = tiny
+        e = ContinuousBatchingEngine(model, adapters=POOL, **ENGINE_KW)
+        with pytest.raises(UnknownAdapterError):
+            e.add_request(_stream(cfg)[0], 4, adapter="nope")
+        e2 = ContinuousBatchingEngine(model, **ENGINE_KW)
+        with pytest.raises(AdapterError):
+            e2.add_request(_stream(cfg)[0], 4, adapter="nope")
+
+
+# -- snapshot surface ---------------------------------------------------------
+class TestAdapterFiles:
+    def test_save_load_roundtrip(self, tiny, adapters, tmp_path):
+        _, cfg = tiny
+        p = str(tmp_path / "a1")
+        save_adapter(p, adapters[0])
+        loaded = load_adapter_file(p)
+        assert loaded["meta"]["rank"] == 4
+        a0 = np.asarray(adapters[0]["layers"][0]["wq"]["a"])
+        assert np.array_equal(np.asarray(loaded["layers"][0]["wq"]["a"]),
+                              a0)
+
+    def test_corrupt_file_rejected_zero_pool_leak(self, tiny, adapters,
+                                                  tmp_path):
+        model, _ = tiny
+        p = str(tmp_path / "bad")
+        save_adapter(p, adapters[0])
+        victim = [f for f in glob.glob(os.path.join(p, "*"))
+                  if not f.endswith(".json")][0]
+        with open(victim, "r+b") as f:
+            f.seek(10)
+            f.write(b"\xff\xff\xff")
+        e = ContinuousBatchingEngine(model, adapters=POOL, **ENGINE_KW)
+        free0 = e._apool.allocator.available
+        with pytest.raises(AdapterCorruptError):
+            e.load_adapter("bad", p)
+        assert e._apool.allocator.available == free0, "pool page leak"
+        assert not e._apool.has("bad")
+        assert e._apool.load_errors == 1
+
+    def test_wrong_geometry_rejected(self, adapters, tmp_path):
+        other = LlamaConfig.tiny(num_hidden_layers=1, hidden_size=16,
+                                 intermediate_size=32,
+                                 num_attention_heads=2)
+        paddle.seed(5)
+        model = LlamaForCausalLM(other)
+        p = str(tmp_path / "wrong")
+        save_adapter(p, adapters[0])        # 32-hidden adapter
+        e = ContinuousBatchingEngine(model, adapters=POOL,
+                                     max_len=64, page_size=8,
+                                     max_batch=2, prefill_chunk=8)
+        with pytest.raises(AdapterCorruptError):
+            e.load_adapter("wrong", p)
+
+    def test_load_fault_point_pre_install(self, tiny, adapters, tmp_path):
+        """adapter.load fires PRE-install: typed raise, zero pool leak,
+        and the engine keeps serving on base weights."""
+        model, cfg = tiny
+        p = str(tmp_path / "ok")
+        save_adapter(p, adapters[0])
+        e = ContinuousBatchingEngine(model, adapters=POOL, **ENGINE_KW)
+        free0 = e._apool.allocator.available
+        with failsafe.inject("adapter.load", nth=1):
+            with pytest.raises(failsafe.InjectedFault):
+                e.load_adapter("a1", p)
+        assert e._apool.allocator.available == free0
+        assert e._apool.load_errors == 1
+        out = e.generate_many(_stream(cfg)[:1], max_new_tokens=4)
+        assert out[0].size > 0              # engine serves on
+        e.load_adapter("a1", p)             # and the retry lands
+
+
+# -- byte-identity matrix -----------------------------------------------------
+class TestByteIdentity:
+    """Mixed batch == per-adapter dedicated engines, base rows == the
+    pre-adapter engine. Tier-1 runs the single-knob cells; the crossed
+    cells are slow-marked (each pays its own compiles)."""
+
+    def _cell(self, tiny, adapters, base_ref, **over):
+        model, cfg = tiny
+        prompts = _stream(cfg)
+        mixed, eng = _mixed(model, *adapters, prompts, **over)
+        assert np.array_equal(mixed[0],
+                              _dedicated(model, adapters[0], prompts[0],
+                                         **over))
+        assert np.array_equal(mixed[1],
+                              _dedicated(model, adapters[1], prompts[1],
+                                         **over))
+        # base row untouched by its adapter neighbors — and identical
+        # to the engine with no pool at all
+        kw = dict(ENGINE_KW)
+        kw.update(over)
+        ref = ContinuousBatchingEngine(model, **kw).generate_many(
+            prompts, max_new_tokens=6)
+        assert np.array_equal(mixed[2], ref[2])
+        # the adapter actually changes outputs (a no-op delta would
+        # pass every identity above vacuously)
+        assert not np.array_equal(mixed[0], ref[0])
+        return eng
+
+    def test_no_adapter_engine_byte_identical(self, tiny, base_ref):
+        model, cfg = tiny
+        eng = ContinuousBatchingEngine(model, adapters=POOL, **ENGINE_KW)
+        outs = eng.generate_many(_stream(cfg), max_new_tokens=6)
+        for a, b in zip(base_ref, outs):
+            assert np.array_equal(a, b)
+
+    def test_mixed_k1(self, tiny, adapters, base_ref):
+        self._cell(tiny, adapters, base_ref)
+
+    def test_mixed_k8(self, tiny, adapters, base_ref):
+        self._cell(tiny, adapters, base_ref, decode_block=8)
+
+    def test_mixed_spec4(self, tiny, adapters, base_ref):
+        self._cell(tiny, adapters, base_ref, speculate=4)
+
+    def test_mixed_tp2(self, tiny, adapters, base_ref):
+        self._cell(tiny, adapters, base_ref, tp=2)
+
+    @pytest.mark.slow
+    def test_mixed_int8_base(self, tiny, adapters, base_ref):
+        """The zoo cell: adapters over an int8-quantized base (slow:
+        the int8 compiles push it past the per-test budget; tier-1's
+        int8 zoo coverage lives in test_ptq's calibrated-zoo test)."""
+        self._cell(tiny, adapters, base_ref, quant="int8")
+
+    def test_megakernel_falls_back_per_dispatch(self, tiny, adapters,
+                                                base_ref):
+        """megakernel= + adapters: adapter-carrying dispatches run the
+        op-chain delta (counted); outputs match the plain engine cell
+        (megakernel/op-chain byte-identity is pinned elsewhere)."""
+        eng = self._cell(tiny, adapters, base_ref, megakernel="layer")
+        assert eng.adapter_mk_fallbacks > 0
+
+    def test_megakernel_multi_stacked_pools(self, tiny, adapters,
+                                            base_ref):
+        """The "multi" fallback exercises the op-chain math over
+        NATIVELY STACKED pools (the _pools_put form)."""
+        self._cell(tiny, adapters, base_ref, megakernel="multi",
+                   decode_block=8)
+
+    def test_adapters_reject_psum_tp(self, tiny):
+        model, _ = tiny
+        with pytest.raises(ValueError, match="exact"):
+            ContinuousBatchingEngine(model, adapters=POOL, tp=2,
+                                     tp_mode="psum", **ENGINE_KW)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("over", [
+        dict(decode_block=8, speculate=4),
+        dict(decode_block=8, tp=2),
+        dict(speculate=4, tp=2),
+        dict(decode_block=8, speculate=4, tp=2),
+        dict(decode_block=8, tp=2, quant="int8"),
+    ], ids=lambda o: "-".join(f"{k}{v}" for k, v in o.items()))
+    def test_crossed_cells(self, tiny, adapters, base_ref, over):
+        self._cell(tiny, adapters, base_ref, **over)
+
+
+# -- lifecycle under load -----------------------------------------------------
+class TestLifecycle:
+    def test_hot_load_evict_under_load(self, tiny, adapters):
+        """Load a second adapter while the first decodes; evict it only
+        after its requests retire (refcounts pin it)."""
+        model, cfg = tiny
+        prompts = _stream(cfg)
+        e = ContinuousBatchingEngine(model, adapters=POOL, **ENGINE_KW)
+        e.load_adapter("a1", adapters[0])
+        u1 = e.add_request(prompts[0], 8, adapter="a1")
+        for _ in range(3):
+            e.step()                    # a1 mid-flight
+        e.load_adapter("a2", adapters[1])   # hot-load under load
+        u2 = e.add_request(prompts[1], 6, adapter="a2")
+        with pytest.raises(AdapterError):
+            e.evict_adapter("a1")       # live request pins it
+        e.drain()
+        r1 = e.result(u1)
+        assert np.array_equal(r1, _dedicated(model, adapters[0],
+                                             prompts[0], mnt=8))
+        e.evict_adapter("a1")           # retired: eviction is clean
+        assert not e._apool.has("a1")
+        assert e.result(u2).size > 0
+
+    def test_registry_lazy_hot_load(self, tiny, adapters, tmp_path):
+        model, cfg = tiny
+        p = str(tmp_path / "lazy")
+        save_adapter(p, adapters[0])
+        e = ContinuousBatchingEngine(model, adapters=POOL, **ENGINE_KW)
+        e.register_adapter("lazy", p)
+        assert not e._apool.has("lazy")
+        u = e.add_request(_stream(cfg)[0], 6, adapter="lazy")
+        assert e._apool.has("lazy")     # loaded at first request
+        e.drain()
+        assert np.array_equal(e.result(u),
+                              _dedicated(model, adapters[0],
+                                         _stream(cfg)[0]))
+
+    def test_counters_and_health(self, tiny, adapters):
+        model, cfg = tiny
+        mixed, eng = _mixed(model, *adapters, _stream(cfg))
+        h = eng.health()["adapters"]
+        assert h["loaded"] == 2
+        assert h["requests"]["a1"] == 1 and h["requests"]["a2"] == 1
+        assert h["tokens"]["a1"] == 6 and h["tokens"]["a2"] == 6
+        assert h["loads"] == 2
+
+    def test_preemption_keeps_adapter(self, tiny, adapters):
+        """A preempted adapter request re-queues WITH its adapter and
+        continues byte-identically (the fold + adapter name survive)."""
+        model, cfg = tiny
+        prompts = _stream(cfg)
+        e = ContinuousBatchingEngine(
+            model, adapters=POOL,
+            tenants={"lo": {"priority": 0}, "hi": {"priority": 5}},
+            **dict(ENGINE_KW, max_batch=1))
+        e.load_adapter("a1", adapters[0])
+        u1 = e.add_request(prompts[0], 8, adapter="a1", tenant="lo")
+        for _ in range(4):
+            e.step()
+        u2 = e.add_request(prompts[2][:4], 2, tenant="hi")
+        e.drain()
+        assert e.preemptions >= 1
+        assert np.array_equal(
+            e.result(u1),
+            _dedicated(model, adapters[0], prompts[0], mnt=8,
+                       max_batch=1))
+
+
+# -- router / fleet registry write -------------------------------------------
+class TestRouterDeploy:
+    def test_fleet_registry_write_and_failover(self, tiny, adapters,
+                                               tmp_path):
+        """EngineRouter.load_adapter = ONE registry write; an adapter
+        request failing over mid-stream continues byte-identically on
+        the survivor (the name rides the resume spec)."""
+        model, cfg = tiny
+        p = str(tmp_path / "a1")
+        save_adapter(p, adapters[0])
+        prompts = _stream(cfg)
+        ref = _dedicated(model, adapters[0], prompts[0],
+                         max_batch=2)
+
+        def factory():
+            return ContinuousBatchingEngine(
+                model, adapters=POOL, **dict(ENGINE_KW, max_batch=2))
+
+        router = EngineRouter(factory, replicas=2)
+        summary = router.load_adapter("a1", p)
+        assert all(v == "loaded" for v in summary.values())
+        u1 = router.add_request(prompts[0], 6, adapter="a1")
+        u2 = router.add_request(prompts[1], 6)
+        for _ in range(2):
+            router.step()
+        with failsafe.inject("replica.step", nth=1):
+            router.step()
+        router.drain()
+        assert router.failovers == 1
+        assert np.array_equal(router.result(u1), ref)
+        assert router.result(u2).size > 0
+
+    def test_partial_deploy_routes_around(self, tiny, adapters,
+                                          tmp_path):
+        """A load that fails on ONE replica (injected adapter.load)
+        reports the straggler; requests naming the adapter route to
+        the replica that has it — no breaker charge, zero loss."""
+        model, cfg = tiny
+        p = str(tmp_path / "a1")
+        save_adapter(p, adapters[0])
+
+        def factory():
+            return ContinuousBatchingEngine(
+                model, adapters=POOL, **dict(ENGINE_KW, max_batch=2))
+
+        router = EngineRouter(factory, replicas=2)
+        with failsafe.inject("adapter.load", nth=1):
+            summary = router.load_adapter("a1", p)
+        vals = sorted(summary.values())
+        assert vals[0] == "error: InjectedFault: injected fault at " \
+            "'adapter.load' (name=a1)" or "error" in vals[0]
+        assert vals[1] == "loaded"
+        u = router.add_request(_stream(cfg)[0], 6, adapter="a1")
+        router.drain()
+        assert router.result(u).size > 0
+        assert all(r.breaker.state == "closed"
+                   for r in router._replicas)
+
+    def test_rebuild_replays_registry(self, tiny, adapters, tmp_path):
+        model, cfg = tiny
+        p = str(tmp_path / "a1")
+        save_adapter(p, adapters[0])
+
+        def factory():
+            return ContinuousBatchingEngine(
+                model, adapters=POOL, **dict(ENGINE_KW, max_batch=2))
+
+        router = EngineRouter(factory, replicas=1)
+        router.load_adapter("a1", p)
+        rep = router._replicas[0]
+        rep.rebuild()
+        assert rep.engine._apool.has("a1")
+        u = router.add_request(_stream(cfg)[0], 6, adapter="a1")
+        router.drain()
+        assert np.array_equal(
+            router.result(u),
+            _dedicated(model, adapters[0], _stream(cfg)[0],
+                       max_batch=2))
+
+
+class TestFleetDeploy:
+    @pytest.mark.slow
+    def test_sigkill_during_load_zero_loss(self, tiny, adapters,
+                                           tmp_path):
+        """A REAL process fleet: one worker SIGKILLed as the registry
+        write lands — load_adapter reports the dead replica, the
+        survivor serves the fine-tune, and every request (adapter and
+        base) completes byte-identically. Zero loss."""
+        import os as _os
+        import signal
+        from paddle_tpu.inference.fleet import spawn_fleet
+        model, cfg = tiny
+        p = str(tmp_path / "a1")
+        save_adapter(p, adapters[0])
+        prompts = _stream(cfg)
+        ref = _dedicated(model, adapters[0], prompts[0], max_batch=2)
+        spec = {"model": {"preset": "tiny", "seed": 3,
+                          "num_hidden_layers": 1, "hidden_size": 32,
+                          "intermediate_size": 64,
+                          "num_attention_heads": 4,
+                          "num_key_value_heads": 2},
+                "engine": dict(ENGINE_KW, max_batch=2, adapters=POOL)}
+        handle = spawn_fleet(spec, 2)
+        try:
+            router = EngineRouter(backends=handle.replicas,
+                                  prefix_index=handle.prefix_index,
+                                  probe_backoff=10_000)
+            victim = handle.procs[0]
+            _os.kill(victim.pid, signal.SIGKILL)   # dies DURING deploy
+            victim.join()
+            summary = router.load_adapter("a1", p)
+            vals = sorted(summary.values())
+            assert vals[0].startswith("error") or \
+                vals[0] == "deferred-quarantined", summary
+            assert "loaded" in vals, summary
+            u1 = router.add_request(prompts[0], 6, adapter="a1")
+            u2 = router.add_request(prompts[1], 6)
+            router.drain()
+            assert np.array_equal(router.result(u1), ref)
+            assert router.result(u2).size > 0
+            assert router.health()["failed"] == 0   # zero loss
+        finally:
+            handle.shutdown()
+
+    def test_unknown_adapter_fleet_wide_raises_typed(self, tiny):
+        """A name NO replica's registry knows can never be served —
+        surfaced typed at admission, never held forever."""
+        model, cfg = tiny
+
+        def factory():
+            return ContinuousBatchingEngine(
+                model, adapters=POOL, **dict(ENGINE_KW, max_batch=2))
+
+        router = EngineRouter(factory, replicas=2)
+        with pytest.raises(AdapterError):
+            router.add_request(_stream(cfg)[0], 4, adapter="typo")
+        assert len(router) == 0          # nothing held
+        assert all(r.breaker.state == "closed"
+                   for r in router._replicas)
+
+    def test_quarantined_deploy_defers_and_drains(self, tiny, adapters,
+                                                  tmp_path):
+        """A registry write landing while a replica is quarantined
+        defers (no AdapterDeployError even when EVERY replica is) and
+        drains at the next clean probe — the normal re-entry path,
+        which never calls rebuild()."""
+        model, _ = tiny
+        p = str(tmp_path / "a1")
+        save_adapter(p, adapters[0])
+
+        def factory():
+            return ContinuousBatchingEngine(
+                model, adapters=POOL, **dict(ENGINE_KW, max_batch=2))
+
+        router = EngineRouter(factory, replicas=2,
+                              quarantine_threshold=1)
+        for rep in router._replicas:
+            router._on_replica_failure(rep, RuntimeError("boom"))
+            assert rep.breaker.state == "open"
+        summary = router.load_adapter("a1", p)   # must NOT raise
+        assert all(v == "deferred-quarantined"
+                   for v in summary.values()), summary
+        rep = router._replicas[0]
+        assert rep.adapters_pending == {"a1": "load"}
+        router._drain_adapter_pending(rep)       # the probe's tail
+        assert rep.adapters_pending == {}
+        assert rep.engine._apool.has("a1")
+
+    def test_refused_evict_keeps_registry(self, tiny, adapters,
+                                          tmp_path):
+        """An evict refused by live requests must leave the rebuild
+        registry intact — a later rebuild still serves the adapter."""
+        model, cfg = tiny
+        p = str(tmp_path / "a1")
+        save_adapter(p, adapters[0])
+
+        def factory():
+            return ContinuousBatchingEngine(
+                model, adapters=POOL, **dict(ENGINE_KW, max_batch=2))
+
+        router = EngineRouter(factory, replicas=1)
+        router.load_adapter("a1", p)
+        u = router.add_request(_stream(cfg)[0], 8, adapter="a1")
+        for _ in range(2):
+            router.step()                        # a1 pinned by u
+        summary = router.evict_adapter("a1")
+        assert "error" in summary["r0"]          # refused, typed
+        assert router._replicas[0].adapters == {"a1": p}
+        router.drain()
+        assert router.result(u).size > 0
+
+    def test_engine_stage_failure_keeps_adapter_name(self, tiny,
+                                                     adapters):
+        """A request failed at the ENGINE stage (pool rebuild) releases
+        its pool ref but KEEPS its adapter name — failover salvage
+        reads export_request after the failure, and a nulled name
+        would silently resume the continuation on base weights."""
+        model, cfg = tiny
+        e = ContinuousBatchingEngine(model, adapters=POOL, **ENGINE_KW)
+        e.load_adapter("a1", adapters[0])
+        u = e.add_request(_stream(cfg)[0], 8, adapter="a1")
+        for _ in range(3):
+            e.step()                    # seated, mid-decode
+        e._reset_kv()                   # the compiled-call-died path
+        assert e.status(u) == "failed"
+        spec = e.export_request(u)
+        assert spec["adapter"] == "a1"  # salvage resumes on a1
+        assert e._apool.active("a1") == 0   # ...but the ref dropped
+        e.evict_adapter("a1")           # idle: eviction is clean

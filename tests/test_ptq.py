@@ -1,0 +1,183 @@
+"""PTQ calibration feeding the int8 model zoo (ISSUE 15).
+
+The acceptance contract: the observer → scale → engine round trip —
+`ptq.calibrate(model, sample_batches)` runs the (formerly dormant)
+observers over weights and activations and emits per-channel int8
+scales that `LLMEngine(quant="int8", quant_scales=...)` eats; because
+the channel-absmax observer reduces exactly like `quantize_weights`,
+the calibrated engine's greedy output is BYTE-IDENTICAL to the
+absmax-from-weights baseline. The zoo cell stacks LoRA adapters on the
+calibrated int8 base (one checkpoint, calibrated once, N adapters).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.inference.scheduler import ContinuousBatchingEngine
+from paddle_tpu.inference.serving import LLMEngine
+from paddle_tpu.ops.pallas.quantized_matmul import quantize_weights
+from paddle_tpu.quantization import ptq
+
+
+def _micro_cfg():
+    return LlamaConfig.tiny(num_hidden_layers=1, hidden_size=32,
+                            intermediate_size=64, num_attention_heads=2)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    paddle.seed(3)
+    cfg = _micro_cfg()
+    return LlamaForCausalLM(cfg), cfg
+
+
+@pytest.fixture(scope="module")
+def calib(tiny):
+    model, cfg = tiny
+    rng = np.random.RandomState(7)
+    batches = [rng.randint(0, cfg.vocab_size, (2, 8)) for _ in range(2)]
+    return ptq.calibrate(model, sample_batches=batches)
+
+
+def _prompts(cfg, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, cfg.vocab_size, (2, 8)).astype(np.int64)
+
+
+class TestObservers:
+    def test_channel_absmax_matches_quantize_weights(self, tiny):
+        """The observer reduction IS the quantize_weights scale rule —
+        the identity the byte-identical round trip rests on."""
+        model, _ = tiny
+        w = np.asarray(model.llama.layers[0].self_attn.q_proj
+                       .weight.data, np.float32)
+        obs = ptq.ChannelAbsmaxObserver()
+        obs._observe(w)
+        _, sc_ref = quantize_weights(w)
+        assert np.array_equal(obs.scales(), np.asarray(sc_ref))
+
+    def test_calibrate_covers_every_projection(self, tiny, calib):
+        _, cfg = tiny
+        assert calib.n_layers == cfg.num_hidden_layers
+        for lay in calib.weight["layers"]:
+            assert set(lay) == set(ptq.PROJ_KEYS)
+        assert calib.weight["head"].shape == (cfg.vocab_size,)
+
+    def test_activation_observers_saw_data(self, tiny, calib):
+        """The dormant _AbsmaxActObserver tier actually observed the
+        calibration forwards (nonzero running absmax everywhere the
+        batches flowed)."""
+        acts = calib.act["layers"][0]
+        assert set(acts) == set(ptq.PROJ_KEYS)
+        assert all(v is not None and v > 0 for v in acts.values())
+        assert calib.act["head"] and calib.act["head"] > 0
+
+    def test_model_left_unwrapped(self, tiny):
+        """calibrate() wraps Linears in place and MUST unwrap — the
+        model leaves exactly as it arrived."""
+        model, cfg = tiny
+        rng = np.random.RandomState(1)
+        ptq.calibrate(model, [rng.randint(0, cfg.vocab_size, (1, 6))])
+        from paddle_tpu.quantization import _ObservedLinear
+        for lay in model.llama.layers:
+            assert not isinstance(lay.self_attn.q_proj, _ObservedLinear)
+        assert not isinstance(model.lm_head, _ObservedLinear)
+
+
+class TestRoundTrip:
+    def test_calibrated_engine_byte_identical_to_absmax(self, tiny,
+                                                        calib):
+        """THE acceptance pin: calibrated int8 scales load through the
+        existing quant='int8' path and greedy tails match the
+        absmax-from-weights baseline."""
+        model, cfg = tiny
+        kw = dict(max_len=64, page_size=8, max_batch=2)
+        base = LLMEngine(model, quant="int8", **kw)
+        cal = LLMEngine(model, quant="int8", quant_scales=calib, **kw)
+        p = _prompts(cfg)
+        o1 = base.generate(p, max_new_tokens=8)
+        o2 = cal.generate(p, max_new_tokens=8)
+        assert np.array_equal(o1, o2)
+
+    def test_scheduler_engine_eats_calibration(self, tiny, calib):
+        model, cfg = tiny
+        kw = dict(max_len=64, page_size=8, max_batch=2, prefill_chunk=8)
+        ref = ContinuousBatchingEngine(model, quant="int8",
+                                       **kw).generate_many(
+            [_prompts(cfg)[0]], max_new_tokens=6)
+        out = ContinuousBatchingEngine(model, quant="int8",
+                                       quant_scales=calib,
+                                       **kw).generate_many(
+            [_prompts(cfg)[0]], max_new_tokens=6)
+        assert np.array_equal(ref[0], out[0])
+
+    def test_save_load_roundtrip(self, tiny, calib, tmp_path):
+        model, cfg = tiny
+        path = calib.save(str(tmp_path / "calib.npz"))
+        c2 = ptq.CalibrationResult.load(path)
+        for proj in ptq.PROJ_KEYS:
+            assert np.array_equal(c2.weight_scale(0, proj),
+                                  calib.weight_scale(0, proj))
+        kw = dict(max_len=64, page_size=8, max_batch=2)
+        o1 = LLMEngine(model, quant="int8", **kw).generate(
+            _prompts(cfg), max_new_tokens=6)
+        o2 = LLMEngine(model, quant="int8", quant_scales=c2,
+                       **kw).generate(_prompts(cfg), max_new_tokens=6)
+        assert np.array_equal(o1, o2)
+
+    def test_corrupt_calibration_typed(self, tmp_path):
+        bad = tmp_path / "bad.npz"
+        bad.write_bytes(b"not an npz at all")
+        with pytest.raises(ptq.CalibrationError):
+            ptq.CalibrationResult.load(str(bad))
+
+    def test_wrong_geometry_scales_typed(self, tiny, calib):
+        """A calibration from a DIFFERENT geometry must fail before the
+        engine installs anything."""
+        other = LlamaConfig.tiny(num_hidden_layers=1, hidden_size=16,
+                                 intermediate_size=32,
+                                 num_attention_heads=2)
+        paddle.seed(9)
+        model2 = LlamaForCausalLM(other)
+        with pytest.raises(ptq.CalibrationError):
+            LLMEngine(model2, quant="int8", quant_scales=calib,
+                      max_len=64, page_size=8, max_batch=2)
+
+    def test_quant_scales_requires_int8(self, tiny, calib):
+        model, _ = tiny
+        with pytest.raises(ValueError, match="int8"):
+            LLMEngine(model, quant=None, quant_scales=calib,
+                      max_len=64, page_size=8, max_batch=2)
+
+
+class TestModelZoo:
+    def test_calibrated_base_plus_adapters(self, tiny, calib):
+        """The zoo: ONE base checkpoint, calibrated once, int8-served,
+        N adapters on top — a mixed batch on the calibrated engine is
+        byte-identical to dedicated calibrated engines per adapter."""
+        from paddle_tpu.inference.adapters import make_lora_adapter
+        model, cfg = tiny
+        ad1 = make_lora_adapter(cfg, rank=4, seed=1)
+        kw = dict(max_len=64, page_size=8, max_batch=2, prefill_chunk=8,
+                  quant="int8", quant_scales=calib,
+                  adapters={"rank": 4})
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, cfg.vocab_size, (t,)).astype(np.int64)
+                   for t in (9, 5)]
+        eng = ContinuousBatchingEngine(model, **kw)
+        eng.load_adapter("a1", ad1)
+        uids = [eng.add_request(prompts[0], 6, adapter="a1"),
+                eng.add_request(prompts[1], 6)]
+        eng.drain()
+        ded = ContinuousBatchingEngine(model, **kw)
+        ded.load_adapter("a1", ad1)
+        u = ded.add_request(prompts[0], 6, adapter="a1")
+        ded.drain()
+        assert np.array_equal(eng.result(uids[0]), ded.result(u))
+        base = ContinuousBatchingEngine(
+            model, max_len=64, page_size=8, max_batch=2,
+            prefill_chunk=8, quant="int8",
+            quant_scales=calib).generate_many(
+            [prompts[1]], max_new_tokens=6)
+        assert np.array_equal(eng.result(uids[1]), base[0])

@@ -146,7 +146,7 @@ ENGINE_HEALTH_KEYS = frozenset({
     "kv_tier", "demoted", "pages_demoted", "demotions", "restores",
     "restore_failures", "demote_errors", "tier", "index_publishes",
     "index_publish_errors", "prefix_exports", "prefix_imports",
-    "preemptions", "tenants",
+    "adapters", "preemptions", "tenants",
 })
 
 ROUTER_HEALTH_KEYS = frozenset({

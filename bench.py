@@ -102,6 +102,28 @@ def _init_backend_with_retry(retries=5, base_delay=5.0, probe_timeout=120.0):
     raise RuntimeError(f"backend unavailable: {last}")
 
 
+def backend_or_skip(metric, emit=None, **probe_kw):
+    """The shared bench-script guard for the BENCH_r03-r05 tunnel
+    failure: probe the backend (watchdog + retry); when it is
+    unavailable, record the skip IN the BENCH JSON and exit 0 — a dead
+    backend must not kill a sweep with an artifact-less rc=1.  Exits
+    via os._exit: a hung probe leaves non-daemon backend threads behind
+    that would block a normal interpreter exit (and with it the stdout
+    flush that gets the skip line into the artifact).  Returns the
+    device list when healthy."""
+    try:
+        return _init_backend_with_retry(**probe_kw)
+    except RuntimeError as e:
+        if "backend unavailable" not in str(e):
+            raise
+        (emit or _emit)({"metric": metric,
+                         "skipped": "backend unavailable",
+                         "detail": str(e)[:300]})
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
+
+
 def _measure(cfg, bs, seq, steps, warmup, dtype, recompute, on_tpu,
              moment_dtype="float32", lazy=False, **trainer_kw):
     import jax
@@ -310,6 +332,18 @@ def main():
         _run()
     except Exception as e:
         traceback.print_exc()
+        if "backend unavailable" in str(e):
+            # the BENCH_r03-r05 tunnel state: no backend is a fact
+            # about the environment, not a bench failure — record the
+            # skip in the artifact and exit CLEAN so the sweep goes on
+            _emit({
+                "metric": "llama350m_tokens_per_sec_per_chip",
+                "skipped": "backend unavailable",
+                "detail": f"{type(e).__name__}: {e}"[:300],
+            })
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(0)
         _emit({
             "metric": "llama350m_tokens_per_sec_per_chip",
             "value": 0.0,

@@ -55,6 +55,7 @@ def _bench_collectives(on_tpu):
     chunk = cc.DEFAULT_CHUNK
     # per-rank gradient sizes (elements); bucket-scale payloads
     sizes = [1 << 20, 1 << 22] if not on_tpu else [1 << 22, 1 << 24]
+    calib_rows = []   # the cost_model.Calibration table (--calib-out)
 
     def timed(fn, x, iters=20):
         y = jax.block_until_ready(fn(x))  # compile + warm
@@ -111,6 +112,13 @@ def _bench_collectives(on_tpu):
                 "unit": "GB/s",
                 "backend": jax.default_backend(),
             })
+            calib_rows.append({
+                "verb": verb, "kind": kind,
+                "size_bytes": int(wire), "gbps": round(gbps, 4),
+                "devices": n,
+                "step_time_ms": round(dt * 1e3, 4),
+            })
+    return calib_rows
 
 
 def _convergence_guard(steps=8, rtol=0.05):
@@ -165,17 +173,52 @@ def _convergence_guard(steps=8, rtol=0.05):
     return ok
 
 
+def _write_calib(path, rows, backend):
+    """The machine-readable calibration file cost_model.Calibration
+    loads (benchmarks/calib/collectives.json by default) — the GB/s
+    table plus the backend it was measured on.  CPU-measured numbers
+    are a dispatch+compute proxy, which is exactly what the planner
+    needs there: predictions stay in the units the machine actually
+    exhibits."""
+    import platform
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"backend": backend,
+                   "hostname": platform.node(),
+                   "source": "collective_bench.py --calib-out",
+                   "collectives": rows}, f, indent=1, sort_keys=True)
+    _emit({"metric": "calibration_written", "path": path,
+           "rows": len(rows), "backend": backend})
+
+
 def main():
+    calib_out = None
+    if "--calib-out" in sys.argv:
+        i = sys.argv.index("--calib-out")
+        calib_out = (sys.argv[i + 1] if i + 1 < len(sys.argv) else None)
+        if not calib_out or calib_out.startswith("-"):
+            # default destination: the checked-in fallback the planner
+            # loads when nothing fresher exists
+            calib_out = os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "calib", "collectives.json")
     # the virtual multi-device CPU mesh must be pinned BEFORE the jax
     # backend initializes (jax_compat routes to jax_num_cpu_devices or
     # the XLA_FLAGS spelling depending on the toolchain)
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         from paddle_tpu.jax_compat import set_cpu_device_count
         set_cpu_device_count(N_CPU_DEVICES)
+    # backend unavailable (the BENCH_r03-r05 tunnel state): record the
+    # skip IN the BENCH JSON and exit clean — a dead backend must not
+    # kill the whole sweep (backend_or_skip watchdogs the probe; a
+    # dead tunnel HANGS jax.devices() rather than raising)
+    from bench import backend_or_skip
+    backend_or_skip("collective_bench", emit=_emit, retries=2)
     import jax
 
     on_tpu = jax.default_backend() not in ("cpu",)
-    _bench_collectives(on_tpu)
+    rows = _bench_collectives(on_tpu)
+    if calib_out:
+        _write_calib(calib_out, rows, jax.default_backend())
     if "--skip-convergence" not in sys.argv:
         ok = _convergence_guard()
         if not ok:

@@ -106,6 +106,8 @@ def main():
     os.environ["XLA_FLAGS"] = (
         _flags.strip() + " --xla_force_host_platform_device_count=8"
     ).strip()
+    from bench import backend_or_skip
+    backend_or_skip("decode_tokens_per_sec", retries=2)  # exits 0 on dead backend
     import jax
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM

@@ -14,6 +14,7 @@ LOWERING of the same kernels is pinned in CI without a chip by
 tests/test_mosaic_lowering.py (jax.export platforms=["tpu"]).
 """
 import json
+import os
 import sys
 import threading
 import time
@@ -42,7 +43,18 @@ def _probe_backend(timeout=120.0):
 
 
 def main():
-    devs = _probe_backend()
+    try:
+        devs = _probe_backend()
+    except RuntimeError as e:
+        # dead tunnel (BENCH_r03-r05): the skip goes IN the artifact
+        # and the sweep continues — rc=0, not a traceback. os._exit:
+        # the hung probe leaves non-daemon backend threads behind that
+        # would block (and so swallow) a normal exit.
+        print(json.dumps({"metric": "kernel_sweep",
+                          "skipped": "backend unavailable",
+                          "detail": str(e)[:300]}))
+        sys.stdout.flush()
+        os._exit(0)
     platform = devs[0].platform
     if platform == "cpu":
         print("[kernel_sweep] WARNING: cpu backend — interpret-mode only, "

@@ -18,6 +18,7 @@ The compute-bound variant chains dependent exps so HBM streaming cannot
 hide the VPU latency the way the single-pass variant lets it.
 """
 import json
+import os
 import sys
 import time
 
@@ -36,6 +37,13 @@ def bench(f, x, n=50):
 
 
 def main():
+    # watchdog probe (bench.backend_or_skip): jax.devices() HANGS, not
+    # errors, when the tunnel is down — the skip must still reach the
+    # BENCH JSON and the script must still exit 0
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import backend_or_skip
+    backend_or_skip("vpu_probe", retries=2)    # exits 0 on dead backend
     import jax
     import jax.numpy as jnp
 

@@ -36,6 +36,20 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", choices=["tiny", "350m", "7b"],
                     default="tiny", help="geometry (tiny = CPU smoke)")
+    ap.add_argument("--plan", metavar="auto|PATH.json", default=None,
+                    help="serving plan from the cost-model planner "
+                         "(docs/distributed_perf.md \"Plan search\"): "
+                         "'auto' searches the feasible tp x topology x "
+                         "megakernel x decode_block space for this "
+                         "--model on the visible devices and applies "
+                         "the top-ranked EngineSpec; a PATH.json loads "
+                         "a spec saved by EngineSpec.save / "
+                         "benchmarks/plan_sweep.py. The plan SUBSUMES "
+                         "--tp/--tp-mode/--tp-compress/--decode-block/"
+                         "--megakernel/--replicas/--disagg (still "
+                         "accepted, but the plan's values win with a "
+                         "DeprecationWarning). Prints the chosen plan "
+                         "and its predicted TTFT/TPOT at startup")
     ap.add_argument("--quant", choices=["none", "int8"], default="none")
     ap.add_argument("--max_new_tokens", type=int, default=16)
     ap.add_argument("--scheduler", action="store_true",
@@ -236,6 +250,88 @@ def main():
         "7b": dict(cfg=LlamaConfig.llama_7b(), max_len=256, page=64, bs=1),
     }
     g = geometries[args.model]
+
+    if args.plan:
+        # -- cost-model-driven serving plan: the searcher (or a saved
+        # -- spec) pins the knobs a human used to hand-pick; the
+        # -- individual flags it subsumes still parse but lose, loudly
+        import warnings
+        import jax
+        from paddle_tpu.cost_model import (Calibration, EngineSpec,
+                                           predict_serving, search_plan)
+        subsumed = [("--tp", args.tp != 1),
+                    ("--tp-mode", args.tp_mode != "exact"),
+                    ("--tp-compress", args.tp_compress != "none"),
+                    ("--decode-block", args.decode_block != 1),
+                    ("--megakernel", args.megakernel != "auto"),
+                    ("--replicas", args.replicas != 1),
+                    ("--disagg", args.disagg is not None)]
+        for flag, was_set in subsumed:
+            if was_set:
+                warnings.warn(
+                    f"{flag} is subsumed by --plan; the plan's value "
+                    f"wins (drop the flag, or edit the plan JSON)",
+                    DeprecationWarning, stacklevel=1)
+        calib = Calibration.load()
+        if args.plan == "auto":
+            base = EngineSpec.from_model_cfg(
+                g["cfg"], seed=0, max_len=g["max_len"],
+                page_size=g["page"], max_batch=max(2, g["bs"]),
+                quant=(None if args.quant == "none" else args.quant))
+            if args.model == "tiny":
+                base.model = {"preset": "tiny", "seed": 0}
+            n_dev = len(jax.devices())
+            ranked = search_plan(g["cfg"], n_dev, mode="serving",
+                                 base_spec=base, calib=calib,
+                                 prompt_len=16,
+                                 gen_tokens=args.max_new_tokens)
+            if not ranked:
+                ap.error(f"--plan auto: no feasible serving plan for "
+                         f"{args.model} on {n_dev} device(s)")
+            spec, cost = ranked[0].plan, ranked[0].cost
+        else:
+            spec = EngineSpec.load(args.plan)
+            cost = predict_serving(g["cfg"], spec, calib=calib,
+                                   prompt_len=16,
+                                   gen_tokens=args.max_new_tokens)
+        # the spec is the source of truth: push its knobs back into
+        # args so every mode branch below consumes them unchanged
+        args.tp = spec.tp
+        args.tp_mode = spec.tp_mode
+        args.tp_compress = spec.tp_compress or "none"
+        args.decode_block = spec.decode_block
+        args.megakernel = {False: "off", None: "auto"}.get(
+            spec.megakernel, spec.megakernel)
+        if spec.quant is not None:
+            args.quant = spec.quant
+        topo = spec.topology()
+        if args.fleet:
+            if spec.replicas != args.fleet:
+                ap.error(f"--fleet {args.fleet} but the plan wants "
+                         f"{spec.replicas} replicas")
+            args.disagg = (f"{topo['prefill']}:{topo['decode']}"
+                           if topo else None)
+        elif topo:
+            args.disagg = f"{topo['prefill']}:{topo['decode']}"
+            args.replicas = 1
+        else:
+            args.replicas = spec.replicas
+            args.disagg = None
+        if spec.replicas > 1 and not args.scheduler and not args.fleet:
+            args.scheduler = False      # router modes drive themselves
+        elif spec.replicas == 1 and not args.fleet:
+            # the searched knobs (decode_block/megakernel) live on the
+            # continuous-batching engine — route through --scheduler
+            args.scheduler = True
+        print(f"plan[{'auto' if args.plan == 'auto' else args.plan}]: "
+              f"tp={spec.tp}({spec.tp_mode}) replicas={spec.replicas}"
+              + (f" disagg={topo['prefill']}:{topo['decode']}" if topo
+                 else "")
+              + f" megakernel={spec.megakernel}"
+                f" decode_block={spec.decode_block}")
+        print(f"  predicted: TTFT {cost.meta['ttft_ms']:.2f} ms, "
+              f"TPOT {cost.meta['tpot_ms']:.3f} ms/tok — {cost.why()} "
+              f"[{cost.meta['calibration']}]")
 
     def _fleet_spec():
         """Engine spec for fleet WORKER processes — the same model +

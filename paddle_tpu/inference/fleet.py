@@ -126,7 +126,14 @@ def build_engine_from_spec(spec):
     Seeding before construction makes weights BYTE-IDENTICAL across
     processes (the fleet byte-identity contract needs every replica to
     hold the same parameters, and there is no shared memory to alias).
+
+    Also accepts a `cost_model.EngineSpec` directly (the planner's
+    output) — it lowers to exactly this dict via .fleet_spec(), so a
+    searched spec and a hand-written dict with the same fields build
+    byte-identical engines through ONE construction path.
     """
+    if hasattr(spec, "fleet_spec"):   # cost_model.EngineSpec
+        spec = spec.fleet_spec()
     import paddle_tpu as paddle
     from ..models import LlamaConfig, LlamaForCausalLM
     from .scheduler import ContinuousBatchingEngine
@@ -146,8 +153,10 @@ def build_engine_from_spec(spec):
 
 def resolve_factory(factory):
     """Engine factory from any of the worker-config forms: a spec dict
-    (build_engine_from_spec), a "module:function" import path, or a
-    picklable zero-arg callable."""
+    (build_engine_from_spec), a `cost_model.EngineSpec`, a
+    "module:function" import path, or a picklable zero-arg callable."""
+    if hasattr(factory, "fleet_spec"):   # cost_model.EngineSpec
+        factory = factory.fleet_spec()
     if isinstance(factory, dict):
         return lambda: build_engine_from_spec(factory)
     if isinstance(factory, str):

@@ -99,7 +99,36 @@ class SpmdTrainer:
                  virtual_pp_degree=1, fuse_head_ce=True, ce_chunk=4096,
                  matmul_precision=None, recompute_policy="save_attn",
                  moment_dtype="float32", grad_compress=None,
-                 compress_chunk=None, grad_accum=1):
+                 compress_chunk=None, grad_accum=1, plan=None):
+        # --- declarative plan (cost_model.Plan) -------------------------
+        # The planner's output is the single source of truth for the
+        # knobs it carries: when plan= is given (a Plan or its JSON
+        # dict), its fields REPLACE the corresponding constructor
+        # arguments, so a trainer built from a searched plan and one
+        # built by hand with the same fields are identical by
+        # construction.  The mesh must agree with plan.mesh_axes().
+        self.plan = None
+        if plan is not None:
+            from ..cost_model import Plan
+            if isinstance(plan, dict):
+                plan = Plan.from_json(plan)
+            mesh_shape = dict(mesh.shape)
+            for axis, want in plan.mesh_axes().items():
+                have = mesh_shape.get(axis, 1)
+                if have != want:
+                    raise ValueError(
+                        f"mesh axis {axis!r} is {have} but the plan "
+                        f"needs {want} (plan.mesh_axes()="
+                        f"{plan.mesh_axes()}) — build the mesh with "
+                        f"plan.build_mesh()")
+            self.plan = plan
+            sharding_stage = plan.sharding_stage
+            grad_compress = plan.grad_compress
+            grad_accum = plan.grad_accum
+            micro_batch_size = plan.micro_batch_size
+            pp_schedule = plan.pp_schedule
+            virtual_pp_degree = plan.virtual_pp_degree
+            recompute = plan.recompute
         if sharding_stage not in (1, 2, 3):
             raise ValueError(f"sharding_stage must be 1/2/3, got "
                              f"{sharding_stage}")

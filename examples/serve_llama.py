@@ -170,6 +170,29 @@ def main():
                          "--fleet spawner creates its own)")
     ap.add_argument("--fleet-name", default="w0",
                     help="this worker's replica name (--fleet-worker)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="close the telemetry→control loop: tick an "
+                         "SLO-driven FleetController while draining — "
+                         "scale out on windowed p99 breach, drain-then-"
+                         "retire on sustained slack, rebalance the "
+                         "prefill:decode split under --disagg, shed "
+                         "load as last resort (inference/autoscale.py; "
+                         "router modes: --replicas/--disagg/--fleet; "
+                         "docs/serving.md \"Elastic fleet\")")
+    ap.add_argument("--slo-ttft-ms", type=float, default=None,
+                    metavar="MS",
+                    help="--autoscale: p99 TTFT target over the sliding "
+                         "window (unset = not watched)")
+    ap.add_argument("--slo-queue-wait-ms", type=float, default=50.0,
+                    metavar="MS",
+                    help="--autoscale: p99 queue-wait target over the "
+                         "sliding window (default 50)")
+    ap.add_argument("--min-replicas", type=int, default=1, metavar="N",
+                    help="--autoscale: never drain the fleet below N")
+    ap.add_argument("--max-replicas", type=int, default=4, metavar="N",
+                    help="--autoscale: never grow the fleet past N "
+                         "(breaches beyond the cap fall through the "
+                         "degradation ladder to load-shedding)")
     ap.add_argument("--metrics-port", type=int, default=None,
                     metavar="P",
                     help="serve router.prometheus() at "
@@ -495,7 +518,10 @@ def main():
     # turn the telemetry plane on; router modes aggregate per-replica
     # registries into the fleet view printed/exported below
     want_tel = bool(args.trace_out or args.metrics_every
-                    or args.metrics_port is not None)
+                    or args.metrics_port is not None
+                    # the controller reads the windowed fleet
+                    # percentiles — no telemetry, no control signal
+                    or args.autoscale)
 
     def metrics_endpoint(router):
         """--metrics-port: the Prometheus scrape endpoint over the
@@ -509,13 +535,31 @@ def main():
               "/metrics")
         return srv
 
-    def drive_router(router):
+    def make_controller(router, spawner=None, retirer=None):
+        """--autoscale: the SLO-driven elastic-fleet controller
+        (docs/serving.md "Elastic fleet") that drive_router ticks
+        between steps; scale actions land on the live router."""
+        if not args.autoscale:
+            return None
+        from paddle_tpu.inference.autoscale import (FleetController,
+                                                    SLOTarget)
+        slo = SLOTarget(ttft_p99_ms=args.slo_ttft_ms,
+                        queue_wait_p99_ms=args.slo_queue_wait_ms)
+        return FleetController(router, slo, spawner=spawner,
+                               retirer=retirer,
+                               min_replicas=args.min_replicas,
+                               max_replicas=args.max_replicas)
+
+    def drive_router(router, ctl=None):
         """Drain the router, printing a compact fleet-metrics line
         every --metrics-every steps (TTFT/TPOT/queue-wait p50s from the
-        merged per-replica histograms)."""
+        merged per-replica histograms); with --autoscale the controller
+        ticks on the same cadence the traffic advances."""
         n = 0
         while router.step():
             n += 1
+            if ctl is not None:
+                ctl.maybe_tick(every_steps=4)
             if args.metrics_every and n % args.metrics_every == 0:
                 hists = (router.metrics().get("fleet") or {}).get(
                     "histograms", {})
@@ -524,6 +568,14 @@ def main():
                         for k, v in hists.items() if v.get("count")}
                 print(f"  metrics@{n}: {json.dumps(line)}")
         router.drain()                  # final collect pass
+        if ctl is not None:
+            s = ctl.stats()
+            last = s["last_decision"]
+            print(f"  autoscale: {s['ticks']} ticks, "
+                  f"+{s['scale_outs']}/-{s['scale_ins']} replicas "
+                  f"({s['replicas']} final), {s['rebalances']} "
+                  f"rebalances, {s['sheds']} sheds, "
+                  f"last={last and last['action']}")
 
     def router_trace_out(router):
         if args.trace_out and want_tel:
@@ -542,6 +594,10 @@ def main():
     if args.prefix_routing and args.replicas < 2 and not args.disagg:
         ap.error("--prefix-routing needs --replicas >= 2 (a fleet to "
                  "route across)")
+    if args.autoscale and not (args.fleet or args.disagg
+                               or args.replicas > 1):
+        ap.error("--autoscale needs a router mode (--replicas >= 2, "
+                 "--disagg P:D, or --fleet N)")
     tier_kw = {}
     if args.kv_tier:
         tier_kw = dict(kv_tier=args.kv_tier,
@@ -590,7 +646,13 @@ def main():
                                        max_new_tokens=args.max_new_tokens,
                                        adapter=adapter_for(i))
                     for i, p in enumerate(prompts)]
-            drive_router(router)
+            # elastic fleet: scale-out forks REAL worker processes via
+            # the handle (respawn-governed), scale-in drains then
+            # reaps them — the full docs/serving.md control loop
+            drive_router(router,
+                         make_controller(router,
+                                         spawner=handle.spawn_worker,
+                                         retirer=handle.retire_worker))
             router_trace_out(router)
             h = router.health()
             print(f"model={args.model} quant={args.quant} fleet "
@@ -642,7 +704,10 @@ def main():
         uids = [router.add_request(p, max_new_tokens=args.max_new_tokens,
                                    adapter=adapter_for(i))
                 for i, p in enumerate(prompts)]
-        drive_router(router)
+        # in-process elastic: the factory IS the spawner (controller
+        # falls back to router.add_replica()); topology present, so
+        # the controller may also rebalance the prefill:decode split
+        drive_router(router, make_controller(router))
         router_trace_out(router)
         h = router.health()
         print(f"model={args.model} quant={args.quant} disagg "
@@ -708,7 +773,7 @@ def main():
                 # round-trip demo: snapshot the live weights first
                 router.save_weights_snapshot(args.hot_swap, step=0)
             print(f"  hot-swap: {router.hot_swap(args.hot_swap)}")
-        drive_router(router)
+        drive_router(router, make_controller(router))
         router_trace_out(router)
         h = router.health()
         print(f"model={args.model} quant={args.quant} "

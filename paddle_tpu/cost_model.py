@@ -48,8 +48,9 @@ import warnings
 __all__ = [
     "CostModel", "Plan", "EngineSpec", "PlanCost", "RankedPlan",
     "Calibration", "predict_train_step", "predict_serving",
-    "search_plan", "brute_force_plans", "DEFAULT_CALIB_PATH",
-    "DEFAULT_RESIDUALS_PATH",
+    "search_plan", "brute_force_plans", "size_fleet",
+    "model_cfg_from_fleet_spec", "spec_from_fleet_dict",
+    "DEFAULT_CALIB_PATH", "DEFAULT_RESIDUALS_PATH",
 ]
 
 _CALIB_DIR = os.path.join(os.path.dirname(os.path.dirname(
@@ -780,6 +781,75 @@ def predict_serving(model_cfg, spec, calib=None, prompt_len=128,
               "tpot_engine_ms": tpot, "fleet_tokens_per_sec": fleet_tok_s,
               "feasible": feasible, "notes": notes,
               "calibration": calib.source})
+
+
+# --------------------------------------------------------------------------
+# fleet sizing (traffic target -> replica count)
+# --------------------------------------------------------------------------
+
+def model_cfg_from_fleet_spec(spec):
+    """LlamaConfig from a fleet spec dict's model half — the same
+    preset resolution `build_engine_from_spec` uses, minus the
+    construction (sizing needs geometry, not weights)."""
+    from .models import LlamaConfig
+    model = dict((spec.get("model") if isinstance(spec, dict)
+                  else spec) or {})
+    model.pop("seed", None)
+    preset = model.pop("preset", "tiny")
+    if preset == "tiny":
+        return LlamaConfig.tiny(**model)
+    if preset == "config":
+        return LlamaConfig(**model)
+    raise ValueError(f"unknown model preset {preset!r}")
+
+
+def spec_from_fleet_dict(spec, replicas=1):
+    """EngineSpec view of a `{"model":..., "engine":...}` worker dict
+    (the inverse of fleet_spec() as far as pricing needs): known
+    EngineSpec fields lift out of the engine kwargs, the rest ride in
+    engine_extra."""
+    if hasattr(spec, "fleet_spec"):     # already an EngineSpec
+        return dataclasses.replace(spec, replicas=int(replicas))
+    eng = dict(spec.get("engine") or {})
+    fields = {f.name for f in dataclasses.fields(EngineSpec)} - {
+        "model", "engine_extra", "replicas", "prefill", "decode"}
+    known = {k: eng.pop(k) for k in list(eng) if k in fields}
+    return EngineSpec(model=dict(spec.get("model") or {}),
+                      replicas=int(replicas), engine_extra=eng, **known)
+
+
+def size_fleet(spec, qps=1.0, prompt_len=128, gen_tokens=64,
+               util=0.7, max_replicas=64, calib=None):
+    """Replica count for a traffic target, priced by predict_serving.
+
+    Little's law: offered concurrency = qps x per-request latency;
+    each replica holds max_batch concurrent requests, derated to
+    `util` so bursts queue instead of shed.  Returns (n, info) where
+    info records the prediction feeding the decision — spawn_fleet
+    stows it on handle.plan and the autoscale controller reuses the
+    same pricing for scale-up decisions.
+    """
+    cfg = model_cfg_from_fleet_spec(spec)
+    one = spec_from_fleet_dict(spec, replicas=1)
+    cost = predict_serving(cfg, one, calib=calib,
+                           prompt_len=prompt_len, gen_tokens=gen_tokens)
+    e2e_s = cost.total_ms / 1e3
+    concurrency = float(qps) * e2e_s
+    per_rep = max(1, one.max_batch) * float(util)
+    n = max(1, min(int(max_replicas),
+                   int(math.ceil(concurrency / max(1e-9, per_rep)))))
+    info = {"replicas": n, "qps": float(qps),
+            "prompt_len": int(prompt_len), "gen_tokens": int(gen_tokens),
+            "util": float(util), "concurrency": concurrency,
+            "per_replica_concurrency": per_rep,
+            "e2e_ms": cost.total_ms,
+            "ttft_ms": cost.meta["ttft_ms"],
+            "tpot_ms": cost.meta["tpot_ms"],
+            "fleet_tokens_per_sec":
+                n * cost.meta["fleet_tokens_per_sec"],
+            "fits": cost.fits, "hbm_gb": cost.hbm_gb,
+            "calibration": cost.meta["calibration"]}
+    return n, info
 
 
 # --------------------------------------------------------------------------

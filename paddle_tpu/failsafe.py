@@ -23,9 +23,12 @@ against an unmodified binary):
 
 Sites self-register on first call; `fault_points()` returns the catalog
 of every site this process has passed through (docs/robustness.md lists
-the stable ones). `retry_with_backoff` is the shared bounded-retry
-helper (TCP-store rendezvous, collective setup) with deterministic,
-injectable sleep for tests.
+the stable ones — including the elastic-fleet control-plane sites
+`scale.spawn` / `scale.retire` / `scale.rebalance`, which fire BEFORE
+the autoscale controller commits a scaling action so chaos runs
+exercise the abort paths). `retry_with_backoff` is the shared
+bounded-retry helper (TCP-store rendezvous, collective setup) with
+deterministic, injectable sleep for tests.
 """
 import os
 import random

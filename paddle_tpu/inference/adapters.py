@@ -293,6 +293,9 @@ class AdapterPool:
         self._free_slots = list(range(self.capacity, 0, -1))
         self._active = collections.Counter()   # name -> live request refs
         self._lru = collections.OrderedDict()  # name -> None (LRU order)
+        self._pinned = set()                   # names exempt from LRU
+        #                                        eviction (autoscale
+        #                                        affinity placement)
         self._alpha = {}                       # name -> alpha
         self._tpc = None
         # lifetime counters (health()/telemetry surface)
@@ -387,12 +390,14 @@ class AdapterPool:
             self.evict(name)            # idle reinstall = registry update
         if not self._free_slots:
             victim = next((n for n in self._lru
-                           if not self._active[n]), None)
+                           if not self._active[n]
+                           and n not in self._pinned), None)
             if victim is None:
                 raise AdapterFullError(
                     f"adapter pool full: {len(self._slots)} adapters "
                     f"installed ({self.capacity} slots), every one has "
-                    "live requests — retry after retirements")
+                    "live requests or is pinned — retry after "
+                    "retirements (or unpin)")
             self.evict(victim)
         slot = self._free_slots.pop()
         pages = []
@@ -464,6 +469,10 @@ class AdapterPool:
             raise AdapterError(
                 f"adapter {name!r} has {self._active[name]} live "
                 "request(s); evict after they retire")
+        if name in self._pinned and not force:
+            raise AdapterError(
+                f"adapter {name!r} is pinned (affinity placement); "
+                "unpin before evicting")
         dev = self.device
         # zero the slot so a later install of a LOWER-rank adapter
         # cannot read the evicted tenant's stale factor tail
@@ -479,9 +488,23 @@ class AdapterPool:
         self._lru.pop(name, None)
         self._alpha.pop(name, None)
         self._active.pop(name, None)
+        self._pinned.discard(name)
         self._free_slots.append(slot)
         self.evictions += 1
         return slot
+
+    def pin(self, name):
+        """Exempt a loaded adapter from LRU eviction — the autoscale
+        controller pins hot fine-tunes pool-resident on their affinity
+        replicas so traffic bursts can't churn them out."""
+        if name not in self._slots:
+            raise UnknownAdapterError(
+                f"adapter {name!r} is not loaded "
+                f"(loaded: {sorted(self._slots)})")
+        self._pinned.add(name)
+
+    def unpin(self, name):
+        self._pinned.discard(name)
 
     # -- request refcounts --------------------------------------------------
     def acquire(self, name):
@@ -511,5 +534,6 @@ class AdapterPool:
             "loads": self.loads,
             "evictions": self.evictions,
             "load_errors": self.load_errors,
+            "pinned": sorted(self._pinned),
             "active": {n: c for n, c in self._active.items() if c},
         }

@@ -58,6 +58,7 @@ Numerics: the fleet never changes tokens.  Greedy outputs through a
 import importlib
 import os
 import pickle
+import random
 import socket
 import threading
 import time
@@ -82,6 +83,69 @@ class FleetRPCError(SchedulerError):
     peer closed, deadline) — the signal the router treats as a replica
     failure.  Application errors re-raise TYPED (the worker pickles
     the exception object itself)."""
+
+
+class ReplicaCrashLoopError(SchedulerError):
+    """A worker hit its respawn circuit-breaker cap: every respawn
+    died again before a clean probe.  The replica stays quarantined
+    (breaker open, never half-opens into a rebuild) until an operator
+    — or the autoscale controller — replaces it; the router counts
+    these in metrics() as `router.crash_loops`."""
+
+
+class RespawnGovernor:
+    """Backoff + circuit breaker for `ProcessReplica.rebuild()`.
+
+    Quarantine probes fire on the router's schedule, not the crash's:
+    a worker that dies on boot would otherwise be respawned in a tight
+    loop (fork, crash, probe, fork ...).  The governor makes each
+    successive respawn wait exponentially longer (with jitter, so a
+    fleet of crashed workers doesn't thundering-herd the host) and
+    refuses outright after `cap` attempts without an intervening clean
+    probe.  A refusal inside the backoff window raises FleetRPCError —
+    the probe records an ordinary failure and the router's own breaker
+    backoff keeps the replica parked; past the cap it raises the typed
+    ReplicaCrashLoopError.
+
+    time_fn is injectable so tests pin the window without sleeping.
+    """
+
+    def __init__(self, cap=5, base_delay=0.25, max_delay=30.0,
+                 jitter=0.5, seed=None, time_fn=None):
+        self.cap = int(cap)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.jitter = float(jitter)
+        self._rng = random.Random(seed)
+        self._time = time_fn or time.monotonic
+        self.attempts = 0               # respawns since last recovery
+        self.not_before = 0.0           # earliest next admit (time_fn)
+
+    def admit(self, name="worker"):
+        """Gate one respawn attempt; on admission, start the next
+        backoff window."""
+        if self.attempts >= self.cap:
+            raise ReplicaCrashLoopError(
+                f"worker {name!r} hit the respawn cap "
+                f"({self.attempts}/{self.cap}) without a clean probe "
+                "— crash loop; replace the worker")
+        now = self._time()
+        if now < self.not_before:
+            raise FleetRPCError(
+                f"worker {name!r} respawn refused for another "
+                f"{self.not_before - now:.2f}s (backoff after attempt "
+                f"{self.attempts}/{self.cap})")
+        self.attempts += 1
+        delay = min(self.max_delay,
+                    self.base_delay * (2 ** (self.attempts - 1)))
+        delay *= 1.0 + self.jitter * self._rng.random()
+        self.not_before = now + delay
+        return self
+
+    def recovered(self):
+        """A clean probe after a respawn closes the breaker."""
+        self.attempts = 0
+        self.not_before = 0.0
 
 
 class _RemoteTraceback(Exception):
@@ -495,6 +559,9 @@ class EngineHost:
     def rpc_evict_adapter(self, name):
         return self.engine.evict_adapter(name)
 
+    def rpc_pin_adapter(self, name, pinned=True):
+        return self.engine.pin_adapter(name, pinned=pinned)
+
     # -- weights --------------------------------------------------------------
     def rpc_export_weights(self):
         import jax
@@ -572,7 +639,7 @@ class ProcessReplica:
 
     def __init__(self, name, store, namespace="fleet", role="any",
                  respawn=None, call_timeout=300.0,
-                 connect_timeout_ms=60000):
+                 connect_timeout_ms=60000, governor=None):
         self.name = name
         self.store = store
         self.ns = namespace
@@ -584,6 +651,9 @@ class ProcessReplica:
         self.failed_probes = 0
         self.telemetry = None
         self.respawn = respawn
+        self.governor = (governor if governor is not None
+                         else RespawnGovernor())
+        self.respawns = 0               # rebuild()s actually admitted
         self.call_timeout = float(call_timeout)
         self.connect_timeout_ms = int(connect_timeout_ms)
         self.rpc_errors = 0             # transport-level call failures
@@ -856,6 +926,9 @@ class ProcessReplica:
         self.adapters_pending.pop(name, None)
         return slot
 
+    def pin_adapter(self, name, pinned=True):
+        return self._call("pin_adapter", name, pinned=pinned)
+
     # -- weights ----------------------------------------------------------------
     def export_weights(self):
         return self._call("export_weights")
@@ -878,6 +951,9 @@ class ProcessReplica:
             "pid": (self._addr or {}).get("pid"),
             "incarnation": (self._addr or {}).get("incarnation"),
             "rpc_errors": self.rpc_errors,
+            "respawns": self.respawns,
+            "respawn_attempts": (self.governor.attempts
+                                 if self.governor else 0),
         }}
 
     def rebuild(self):
@@ -890,6 +966,9 @@ class ProcessReplica:
             raise RuntimeError(
                 f"worker {self.name} is unreachable and no respawner "
                 "is wired (spawn_fleet provides one)")
+        if self.governor is not None:
+            self.governor.admit(self.name)
+        self.respawns += 1
         if self.telemetry is not None:
             self.telemetry.fold_incarnation()
         old = (self._addr or {}).get("incarnation")
@@ -936,6 +1015,12 @@ class ProcessReplica:
         #                                 evict-pending adapter
         return self
 
+    def note_recovery(self):
+        """Router hook: a clean quarantine probe resets the respawn
+        governor so a later crash starts a fresh backoff ladder."""
+        if self.governor is not None:
+            self.governor.recovered()
+
     def shutdown(self):
         try:
             return self._call("shutdown")
@@ -959,17 +1044,94 @@ def _worker_entry(cfg):
     host.serve_forever()
 
 
+def _make_respawner(cfg, procs, rank):
+    """Zero-arg respawn closure for worker `rank`: re-launch via
+    _respawn_wrap with the rank env var, track the process in `procs`
+    so FleetHandle.shutdown() still reaps it."""
+    def respawn():
+        import multiprocessing
+        ctx = multiprocessing.get_context("spawn")
+        env = dict(os.environ, PADDLE_TRAINER_ID=str(rank),
+                   PADDLE_TRAINERS_NUM=str(len(cfg["names"])))
+        p = ctx.Process(target=_respawn_wrap, args=(cfg, env),
+                        daemon=False)
+        p.start()
+        procs.append(p)
+    return respawn
+
+
 class FleetHandle:
     """What spawn_fleet returns: the ProcessReplicas (pass them to
     EngineRouter(backends=...)), the spawned processes, the rendezvous
     store, and the fleet-default StorePrefixIndex (None when prefix
-    publication is off)."""
+    publication is off).  `plan` carries the cost-model sizing record
+    when spawn_fleet sized the fleet from a traffic target."""
 
-    def __init__(self, replicas, procs, store, prefix_index):
+    def __init__(self, replicas, procs, store, prefix_index,
+                 cfg=None, call_timeout=300.0,
+                 connect_timeout_ms=120000, plan=None):
         self.replicas = replicas
         self.procs = procs
         self.store = store
         self.prefix_index = prefix_index
+        self.plan = plan
+        self._cfg = cfg
+        self._call_timeout = call_timeout
+        self._connect_timeout_ms = connect_timeout_ms
+
+    def spawn_worker(self, role="any", name=None):
+        """Scale-out: launch ONE more worker into this fleet and
+        return its ProcessReplica (hand it to router.add_replica).
+        The new worker rendezvouses through the same store; a worker
+        that never registers is reaped before the error surfaces."""
+        if self._cfg is None:
+            raise RuntimeError(
+                "this FleetHandle was not built by spawn_fleet — no "
+                "worker config to launch from")
+        rank = len(self._cfg["names"])
+        name = name or f"{self._cfg.get('name_prefix', 'w')}{rank}"
+        self._cfg["names"].append(name)
+        _make_respawner(self._cfg, self.procs, rank)()
+        p = self.procs[-1]
+        rep = ProcessReplica(
+            name, self.store,
+            namespace=self._cfg.get("namespace", "fleet"), role=role,
+            respawn=_make_respawner(self._cfg, self.procs, rank),
+            call_timeout=self._call_timeout,
+            connect_timeout_ms=self._connect_timeout_ms)
+        try:
+            rep._resolve()              # block until the worker is up
+        except BaseException:
+            self._cfg["names"].pop()
+            if p.is_alive():
+                p.terminate()
+            p.join(timeout=2.0)
+            if p.is_alive():
+                p.kill()
+            raise
+        self.replicas.append(rep)
+        return rep
+
+    def retire_worker(self, name, timeout=5.0):
+        """Scale-in counterpart: shut the named worker down and drop
+        it from the handle.  The router must have drained/retired the
+        replica FIRST — this only reaps the process.  Its rank slot in
+        the worker config stays (ranks are append-only), so later
+        spawns never reuse a live name."""
+        rep = next((r for r in self.replicas if r.name == name), None)
+        if rep is None:
+            return False
+        alive_before = sum(p.is_alive() for p in self.procs)
+        ok = rep.shutdown()
+        self.replicas.remove(rep)
+        if not ok:
+            return True                 # worker already unreachable —
+            #                             nothing to wait for
+        deadline = time.monotonic() + timeout
+        while (sum(p.is_alive() for p in self.procs) >= alive_before
+               and alive_before and time.monotonic() < deadline):
+            time.sleep(0.05)            # wait for ITS process to exit
+        return True
 
     def shutdown(self, timeout=5.0):
         """Graceful worker shutdown, then escalate: join, terminate,
@@ -987,14 +1149,22 @@ class FleetHandle:
         return self
 
 
-def spawn_fleet(factory, n, store=None, namespace="fleet", roles=None,
-                name_prefix="w", ledger_every=8, prefix_index=True,
-                call_timeout=300.0, connect_timeout_ms=120000):
+def spawn_fleet(factory, n=None, store=None, namespace="fleet",
+                roles=None, name_prefix="w", ledger_every=8,
+                prefix_index=True, call_timeout=300.0,
+                connect_timeout_ms=120000, traffic_target=None):
     """Spawn an n-worker process fleet and return a FleetHandle.
 
     factory: an engine-spec dict (build_engine_from_spec — the
       no-code-shipped form the CLI uses), a "module:function" import
       path, or a picklable zero-arg callable.
+    n: worker count; None asks the cost model to size the fleet from
+      `traffic_target` (spec-dict factories only — sizing needs the
+      model config).
+    traffic_target: {"qps": float, "prompt_len": int, "gen_tokens":
+      int, ...} forwarded to cost_model.size_fleet; the sizing record
+      (predictions + headroom) lands on handle.plan, and the autoscale
+      controller reuses the same pricing for scale-up decisions.
     store: an existing TCPStore MASTER client to rendezvous through;
       None creates one on an ephemeral loopback port.
     roles: per-worker roles for a disaggregated topology (e.g.
@@ -1006,25 +1176,25 @@ def spawn_fleet(factory, n, store=None, namespace="fleet", roles=None,
     """
     from ..distributed.spawn import spawn
     from ..distributed.store import TCPStore
+    plan = None
+    if n is None:
+        if traffic_target is None:
+            raise ValueError("spawn_fleet needs n= or traffic_target=")
+        if not isinstance(factory, dict):
+            raise ValueError(
+                "traffic_target sizing needs a spec-dict factory (the "
+                "cost model prices from the model config; a callable "
+                "factory hides it)")
+        from ..cost_model import size_fleet
+        n, plan = size_fleet(factory, **dict(traffic_target))
     if store is None:
         store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
     names = [f"{name_prefix}{i}" for i in range(int(n))]
     cfg = {"names": names, "store_host": store.host,
            "store_port": store.port, "namespace": namespace,
-           "factory": factory, "ledger_every": int(ledger_every)}
+           "factory": factory, "ledger_every": int(ledger_every),
+           "name_prefix": name_prefix}
     procs = spawn(_worker_entry, args=(cfg,), nprocs=int(n), join=False)
-
-    def respawner(rank):
-        def respawn():
-            import multiprocessing
-            ctx = multiprocessing.get_context("spawn")
-            env = dict(os.environ, PADDLE_TRAINER_ID=str(rank),
-                       PADDLE_TRAINERS_NUM=str(n))
-            p = ctx.Process(target=_respawn_wrap, args=(cfg, env),
-                            daemon=False)
-            p.start()
-            procs.append(p)
-        return respawn
 
     index = None
     if prefix_index:
@@ -1036,7 +1206,8 @@ def spawn_fleet(factory, n, store=None, namespace="fleet", roles=None,
             rep = ProcessReplica(
                 name, store, namespace=namespace,
                 role=(roles[i] if roles else "any"),
-                respawn=respawner(i), call_timeout=call_timeout,
+                respawn=_make_respawner(cfg, procs, i),
+                call_timeout=call_timeout,
                 connect_timeout_ms=connect_timeout_ms)
             rep._resolve()              # block until the worker is up
             replicas.append(rep)
@@ -1053,7 +1224,10 @@ def spawn_fleet(factory, n, store=None, namespace="fleet", roles=None,
             if p.is_alive():
                 p.kill()
         raise
-    return FleetHandle(replicas, procs, store, index)
+    return FleetHandle(replicas, procs, store, index, cfg=cfg,
+                       call_timeout=call_timeout,
+                       connect_timeout_ms=connect_timeout_ms,
+                       plan=plan)
 
 
 def _respawn_wrap(cfg, env):

@@ -311,6 +311,9 @@ class EngineReplica:
         self.adapters_pending.pop(name, None)
         return slot
 
+    def pin_adapter(self, name, pinned=True):
+        return self.engine.pin_adapter(name, pinned=pinned)
+
     # -- fleet prefix index (cache-aware routing) -----------------------------
     def attach_prefix_index(self, index):
         """Wire this replica's engine into the fleet prefix index under
@@ -571,6 +574,19 @@ class EngineRouter:
                               max_elapsed=probe_max_elapsed,
                               seed=int(probe_seed), sleep=probe_sleep,
                               raise_exhausted=True)
+        # elastic-fleet seams (inference/autoscale.py FleetController):
+        # the factory and breaker config are kept so add_replica can
+        # build new in-process replicas after construction; affinity
+        # maps adapter name -> replica-name set (routing preference,
+        # not a constraint); shedding=True is the controller's LAST
+        # resort — fresh admissions refuse typed until it clears.
+        # All of it is INERT until a controller acts: a router nobody
+        # scales behaves byte-identically to one without these fields.
+        self._factory = factory
+        self._breaker_kw = dict(threshold=int(quarantine_threshold),
+                                probe_backoff=int(probe_backoff))
+        self._adapter_affinity = {}
+        self.shedding = False
         self.hold_limit = None if hold_limit is None else int(hold_limit)
         self._reqs = {}                 # router uid -> _RouterRequest
         self._assigned = collections.defaultdict(set)  # name -> {ruid}
@@ -599,6 +615,12 @@ class EngineRouter:
         #                                 a fresh replica pre-admission
         self.prefix_ship_failures = 0   # ships that fell back (request
         #                                 re-prefills — never lost)
+        self.crash_loops = 0            # replicas that hit the respawn
+        #                                 circuit-breaker cap (fleet
+        #                                 mode; counted once per
+        #                                 crash-loop episode)
+        self.shed_rejections = 0        # admissions refused while the
+        #                                 controller had shedding on
 
     # -- public ------------------------------------------------------------
     def add_request(self, ids, max_new_tokens=32, eos_token_id=None,
@@ -611,6 +633,15 @@ class EngineRouter:
         fine-tune deployed via load_adapter — the name rides the spec
         through failover and KV handoff); per-tenant admission is
         enforced by each replica's own policy."""
+        if self.shedding:
+            # the autoscale controller's documented last resort: fleet
+            # at max_replicas and still SLO-breached — refuse typed at
+            # the door (clients retry with backoff) instead of growing
+            # an unbounded hold queue
+            self.shed_rejections += 1
+            raise NoReplicaAvailableError(
+                "router is load-shedding (fleet at max capacity and "
+                "SLO-breached); retry later")
         ids = np.asarray(ids, np.int64).ravel()
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
@@ -781,6 +812,11 @@ class EngineRouter:
             "prefix_ship_failures": self.prefix_ship_failures,
             "prefix_index": (self.prefix_index.stats()
                              if self.prefix_index is not None else None),
+            # elastic fleet (inference/autoscale.py)
+            "crash_loops": self.crash_loops,
+            "shedding": self.shedding,
+            "shed_rejections": self.shed_rejections,
+            "adapter_affinity": self.adapter_affinity(),
         }
 
     # -- telemetry / fleet metrics -----------------------------------------
@@ -802,6 +838,9 @@ class EngineRouter:
             "kv_handoffs": self.kv_handoffs,
             "handoff_failures": self.handoff_failures,
             "held": len(self._held), "pending": len(self.pending()),
+            "crash_loops": self.crash_loops,
+            "shed_rejections": self.shed_rejections,
+            "replicas": len(self._replicas),
         }}
         if self._tel is None:
             out["fleet"] = None
@@ -881,7 +920,7 @@ class EngineRouter:
         return export_chrome_trace(path, tels)
 
     # -- multi-LoRA adapter deployment (inference/adapters.py) ---------------
-    def load_adapter(self, name, path):
+    def load_adapter(self, name, path, replicas=None):
         """Deploy a fine-tune to the FLEET: one registry write fanned
         to every reachable replica's pool (quarantined replicas pick
         it up at rebuild — EngineReplica.rebuild replays its adapter
@@ -889,10 +928,22 @@ class EngineRouter:
         AdapterDeployError only when NO replica could load (a partial
         fleet still serves the adapter — routing is health-ordered and
         a replica without it fails that request typed, which failover
-        then re-routes)."""
+        then re-routes).
+
+        replicas=[names]: AFFINITY deploy — fan only to that subset
+        and record it as the adapter's routing preference (the
+        autoscale controller places hot fine-tunes this way so every
+        replica stops paying pool pages for every adapter)."""
+        targets = self._replicas
+        if replicas is not None:
+            unknown = [r for r in replicas if r not in self._by_name]
+            if unknown:
+                raise ValueError(
+                    f"load_adapter names unknown replicas {unknown}")
+            targets = [self._by_name[r] for r in replicas]
         summary = {}
         ok = deferred = 0
-        for rep in self._replicas:
+        for rep in targets:
             if rep.breaker.state == "open":
                 # recorded for the drain at the next clean probe AND
                 # for rebuild's registry replay — a quarantined
@@ -912,6 +963,8 @@ class EngineRouter:
             raise AdapterDeployError(
                 f"adapter {name!r} failed to load on every replica: "
                 f"{summary}")
+        if replicas is not None:
+            self.set_adapter_affinity(name, list(replicas))
         if self._tel is not None:
             # counted only for deploys that LANDED (or deferred) —
             # a fleet-wide failure raised above, and a dashboard must
@@ -923,6 +976,7 @@ class EngineRouter:
     def evict_adapter(self, name):
         """Evict a fine-tune fleet-wide (replicas with live requests
         on it refuse typed and keep it — report, don't force)."""
+        self._adapter_affinity.pop(name, None)
         summary = {}
         for rep in self._replicas:
             if rep.breaker.state == "open":
@@ -1027,6 +1081,177 @@ class EngineRouter:
     def activate(self, name):
         self._by_name[name].state = ACTIVE
 
+    # -- elastic fleet (inference/autoscale.py drives these) ----------------
+    def add_replica(self, backend=None, name=None, role="any"):
+        """Scale-out seam: wire ONE new replica into the live router.
+
+        backend: a pre-built replica (FleetHandle.spawn_worker's
+        ProcessReplica, or anything serving the EngineReplica surface);
+        None builds an in-process EngineReplica from the router's own
+        factory. The new replica gets a fresh breaker and — when the
+        router runs telemetry / a prefix index — its own Telemetry and
+        the shared index, exactly as construction wires them."""
+        if backend is None:
+            if self._factory is None:
+                raise ValueError(
+                    "add_replica needs backend= on a router built "
+                    "over backends (no factory to construct from)")
+            name = name or f"r{self._next_replica_ordinal()}"
+            backend = EngineReplica(name, self._factory, role=role)
+        else:
+            if role != "any" or not getattr(backend, "role", None):
+                backend.role = role
+        if backend.name in self._by_name:
+            raise ValueError(
+                f"replica name {backend.name!r} already serves")
+        backend.breaker = CircuitBreaker(**self._breaker_kw)
+        if self._tel is not None:
+            from .telemetry import Telemetry
+            backend.attach_telemetry(
+                Telemetry(name=backend.name, capture_faults=False))
+        if self.prefix_index is not None:
+            backend.attach_prefix_index(self.prefix_index)
+        self._replicas.append(backend)
+        self._by_name[backend.name] = backend
+        if self._topology is not None and \
+                backend.role in self._topology:
+            self._topology[backend.role] += 1
+        if self._tel is not None:
+            self._tel.event("scale_out", replica=backend.name,
+                            role=backend.role,
+                            fleet=len(self._replicas))
+        return backend
+
+    def _next_replica_ordinal(self):
+        n = len(self._replicas)
+        while f"r{n}" in self._by_name:
+            n += 1
+        return n
+
+    def retire_replica(self, name):
+        """Drain-then-retire with ZERO lost requests: full evacuation
+        through the same salvage triage failover uses — finished work
+        delivers exactly-once, live work re-queues on the rest of the
+        fleet with committed tokens folded in, engine-queued requests
+        re-route too (they carry no KV). The retired replica's
+        lifetime telemetry merges into the router registry so fleet
+        p99s survive the retirement (the PR 13 contract). Returns the
+        detached replica — the caller shuts its worker down."""
+        rep = self._by_name.get(name)
+        if rep is None:
+            raise ValueError(f"unknown replica {name!r}")
+        if len(self._replicas) <= 1:
+            raise ValueError("cannot retire the last replica")
+        if self._topology is not None and rep.role in self._topology \
+                and self._topology[rep.role] <= 1:
+            raise ValueError(
+                f"cannot retire the last {rep.role!r} worker of a "
+                "disaggregated topology")
+        rep.state = DRAINING            # routing skips it from here on
+        for ruid in list(self._assigned[rep.name]):
+            self._salvage_one(rep, ruid)
+        if self._tel is not None:
+            reg = getattr(rep.telemetry, "registry", None)
+            if reg is not None:
+                self._tel.registry.merge(reg)
+            self._tel.event("scale_in", replica=rep.name,
+                            fleet=len(self._replicas) - 1)
+        self._replicas.remove(rep)
+        del self._by_name[name]
+        self._assigned.pop(name, None)
+        if self._topology is not None and rep.role in self._topology:
+            self._topology[rep.role] -= 1
+        if self.prefix_index is not None:
+            try:
+                self.prefix_index.drop_replica(name)
+            except Exception:
+                pass
+        for aff in self._adapter_affinity.values():
+            aff.discard(name)
+        return rep
+
+    def set_replica_role(self, name, role):
+        """Live prefill<->decode rebalance (topology mode): flip the
+        worker's role in place — no drain, no respawn. A decode worker
+        that becomes a prefill worker keeps its running requests; the
+        next step's handoff sweep migrates their decode-state KV to
+        the decode pool over the negotiated transport (byte-identical
+        continuation, zero recompute) — the hot-swap drain + KV
+        handoff machinery repurposed for role changes."""
+        rep = self._by_name.get(name)
+        if rep is None:
+            raise ValueError(f"unknown replica {name!r}")
+        if role not in ("prefill", "decode", "any"):
+            raise ValueError(f"unknown role {role!r}")
+        if self._topology is None:
+            raise ValueError(
+                "set_replica_role needs a disaggregated topology "
+                "(EngineRouter(topology=...))")
+        old = rep.role
+        if old == role:
+            return rep
+        if old in self._topology and self._topology[old] <= 1:
+            raise ValueError(
+                f"cannot re-role the last {old!r} worker")
+        rep.role = role
+        if old in self._topology:
+            self._topology[old] -= 1
+        self._topology[role] = self._topology.get(role, 0) + 1
+        if self._tel is not None:
+            self._tel.event("rebalance", replica=name,
+                            from_role=old, to_role=role,
+                            topology=dict(self._topology))
+        return rep
+
+    def shift_queued(self, max_moves=8):
+        """Post-scale-out rebalance: salvage engine-QUEUED requests
+        off the deepest backlogs so they re-route health-ordered —
+        typically onto the fresh empty replica. Queued requests carry
+        no KV, so each move is a pure re-route (the same
+        keep-nothing-behind triage as failover, minus the failure).
+        Returns how many moved."""
+        moved = 0
+        by_depth = sorted(self._replicas,
+                          key=lambda r: -len(self._assigned[r.name]))
+        for rep in by_depth:
+            if moved >= max_moves:
+                break
+            if rep.breaker.state == "open" or rep.state != ACTIVE:
+                continue
+            for ruid in list(self._assigned[rep.name]):
+                if moved >= max_moves:
+                    break
+                rr = self._reqs[ruid]
+                if rr.state != QUEUED:
+                    continue
+                try:
+                    if rep.status(rr.engine_uid) != QUEUED:
+                        continue        # seated since we looked
+                except Exception:
+                    continue            # next step's failover handles
+                self._salvage_one(rep, ruid)
+                moved += 1
+        return moved
+
+    def set_adapter_affinity(self, name, replicas):
+        """Pin adapter `name`'s routing preference to a replica
+        subset: admissions naming it try these first (health-ordered
+        within the subset), everyone else stays fallback — a replica
+        without the adapter refuses typed and routing moves on, so
+        affinity can never strand a request. Empty/None clears."""
+        if not replicas:
+            self._adapter_affinity.pop(name, None)
+            return
+        unknown = [r for r in replicas if r not in self._by_name]
+        if unknown:
+            raise ValueError(
+                f"affinity names unknown replicas {unknown}")
+        self._adapter_affinity[name] = set(replicas)
+
+    def adapter_affinity(self):
+        return {n: sorted(s)
+                for n, s in self._adapter_affinity.items()}
+
     # -- routing -----------------------------------------------------------
     # TIER-AWARE routing (ROADMAP item 2 follow-up): an admission whose
     # KV page need reaches this floor counts as a "long conversation"
@@ -1117,6 +1342,17 @@ class EngineRouter:
             reps = pf + [r for r in reps if r.role != "prefill"]
         elif self.prefix_index is not None and reps:
             reps = self._prefix_order(spec, reps)
+        aff = (self._adapter_affinity.get(spec.get("adapter"))
+               if spec.get("adapter") else None)
+        if aff:
+            # affinity is a PREFERENCE: the pool-resident subset tries
+            # first (its internal health order kept), the rest stay as
+            # fallback — a non-affinity replica without the adapter
+            # refuses typed and the loop moves on, so a dead affinity
+            # set degrades to the ordinary deployment-gap path instead
+            # of stranding the request
+            reps = ([r for r in reps if r.name in aff]
+                    + [r for r in reps if r.name not in aff])
         for rep in reps:
             try:
                 fault_point("replica.admit", detail=rep.name)
@@ -1699,15 +1935,33 @@ class EngineRouter:
                     self._salvage_one(rep, ruid)
                 try:
                     rep.rebuild()
-                except Exception as re_exc:  # factory itself broken:
-                    rep.breaker.last_error = (   # keep probing, the
+                except Exception as re_exc:  # factory itself broken,
+                    #                          or the respawn governor
+                    #                          refused (backoff window /
+                    #                          crash-loop cap): keep
+                    #                          probing, breaker stays
+                    #                          open
+                    from .fleet import ReplicaCrashLoopError
+                    if isinstance(re_exc, ReplicaCrashLoopError) and \
+                            not getattr(rep, "_crash_looped", False):
+                        # one crash-loop EPISODE counts once, however
+                        # many later probes re-refuse
+                        rep._crash_looped = True
+                        self.crash_loops += 1
+                        if self._tel is not None:
+                            self._tel.event("crash_loop",
+                                            replica=rep.name)
+                    rep.breaker.last_error = (
                         f"rebuild failed: {type(re_exc).__name__}: "
-                        f"{re_exc}")             # breaker stays open
+                        f"{re_exc}")
                 else:
                     rep.failed_probes = 0
             return False
         rep.failed_probes = 0
         rep.breaker.record_probe_success()
+        rep._crash_looped = False       # clean probe ends the episode
+        if hasattr(rep, "note_recovery"):
+            rep.note_recovery()         # reset the respawn governor
         self._drain_adapter_pending(rep)
         return True
 

@@ -1757,6 +1757,19 @@ class ContinuousBatchingEngine(LLMEngine):
             self._tel.registry.count("adapter_evict")
         return slot
 
+    def pin_adapter(self, name, pinned=True):
+        """Pin (or unpin) a loaded adapter against LRU eviction — the
+        autoscale controller keeps hot fine-tunes pool-resident on
+        their affinity replicas this way."""
+        if self._apool is None:
+            raise AdapterError("this engine has no adapter pool "
+                               "(adapters=)")
+        if pinned:
+            self._apool.pin(name)
+        else:
+            self._apool.unpin(name)
+        return pinned
+
     def _resolve_adapter(self, name):
         """Pool slot for `name`, hot-loading from the registry when not
         resident; typed UnknownAdapterError otherwise."""

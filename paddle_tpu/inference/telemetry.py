@@ -33,7 +33,10 @@ Pieces:
     Telemetry object, not the engine that died).
   - `MetricsRegistry` — named histograms + counters + rate-converted
     deltas of `health()` counter snapshots (`sample()`); Prometheus
-    text exposition (`prometheus()`).
+    text exposition (`prometheus()`); a sliding-window view of every
+    histogram (`SlidingWindowHistogram` — last-window_s-seconds
+    percentiles, the signal inference/autoscale.py reacts to instead
+    of lifetime aggregates).
   - `RequestTrace` — one request's lifecycle record: submit, queue
     wait, prefill chunks, first token (TTFT), decode blocks,
     speculation passes with accept counts, preemption, demote/restore,
@@ -139,6 +142,91 @@ class Histogram:
                 "p99_ms": round(self.percentile(99), 3)}
 
 
+class SlidingWindowHistogram:
+    """Last-N-seconds view of a latency stream: K rotating Histogram
+    slices of window_s/K seconds each.  `observe` lands in the current
+    slice; `window()` merges the slices still inside the window into
+    one plain Histogram, so p50/p99 answer "what is TTFT NOW", not
+    "since boot" — the signal an autoscaler must react to (a lifetime
+    aggregate takes minutes to reflect a spike that started seconds
+    ago, and never forgets one that ended).
+
+    Slices are timestamped with time.monotonic(); cross-process state
+    ships slice AGES instead (monotonic clocks do not survive a process
+    boundary — the PR 10 relative-budget rule applied to time itself):
+    `state()` emits [(age_s, Histogram)], `install()` rebases onto the
+    receiver's clock.
+    """
+
+    __slots__ = ("window_s", "n_slices", "slice_s", "buckets", "slices")
+
+    def __init__(self, window_s=60.0, n_slices=6,
+                 buckets=DEFAULT_BUCKETS_MS):
+        self.window_s = float(window_s)
+        self.n_slices = max(1, int(n_slices))
+        self.slice_s = self.window_s / self.n_slices
+        self.buckets = tuple(buckets)
+        self.slices = collections.deque()   # [(t_slice_start, Histogram)]
+
+    def observe(self, v, now=None):
+        now = time.monotonic() if now is None else float(now)
+        while self.slices and \
+                now - self.slices[0][0] > self.window_s + self.slice_s:
+            self.slices.popleft()
+        if not self.slices or now - self.slices[-1][0] >= self.slice_s:
+            self.slices.append((now, Histogram(self.buckets)))
+        self.slices[-1][1].observe(v)
+
+    def window(self, now=None):
+        """One merged Histogram over the slices still inside the
+        window (a fresh object — the live slices are never mutated by
+        a read)."""
+        now = time.monotonic() if now is None else float(now)
+        out = Histogram(self.buckets)
+        for t0, h in self.slices:
+            if now - t0 <= self.window_s + self.slice_s:
+                out.merge(h)
+        return out
+
+    def merge(self, other):
+        """Fleet aggregation: adopt the other view's slices (slice
+        objects are shared read-only — window() copies, and a merged
+        registry is a throwaway snapshot, never observed into).
+        Staleness is window()'s problem — it filters by age at read
+        time, so adopting everything here stays correct.  Keeps the
+        deque time-ordered so a later observe still rotates right."""
+        if other.slices:
+            self.slices = collections.deque(
+                sorted(list(self.slices) + list(other.slices),
+                       key=lambda s: s[0]))
+        return self
+
+    def state(self, now=None):
+        """Picklable cross-process snapshot: slice ages, not
+        timestamps."""
+        now = time.monotonic() if now is None else float(now)
+        return {"window_s": self.window_s, "n_slices": self.n_slices,
+                "slices": [(now - t0, h) for t0, h in self.slices]}
+
+    @classmethod
+    def install(cls, state, now=None):
+        """Rebase a state() snapshot onto THIS process's clock."""
+        now = time.monotonic() if now is None else float(now)
+        swh = cls(window_s=state["window_s"],
+                  n_slices=state.get("n_slices", 6))
+        swh.slices = collections.deque(
+            sorted(((now - age, h) for age, h in state["slices"]),
+                   key=lambda s: s[0]))
+        return swh
+
+
+# Default sliding-window span for MetricsRegistry's windowed
+# percentiles (docs/observability.md "Windowed metrics") — wide enough
+# to smooth one noisy request, short enough that a spike that ended is
+# forgotten within a minute.
+DEFAULT_WINDOW_S = 60.0
+
+
 class MetricsRegistry:
     """Named histograms + counters + health-counter rates.
 
@@ -156,18 +244,47 @@ class MetricsRegistry:
       e2e_ms           submit -> retirement (any terminal state)
     """
 
-    def __init__(self, buckets=DEFAULT_BUCKETS_MS):
+    def __init__(self, buckets=DEFAULT_BUCKETS_MS,
+                 window_s=DEFAULT_WINDOW_S):
         self._buckets = tuple(buckets)
         self.hist = {}
         self.counters = collections.Counter()
+        self.window_s = float(window_s)
+        self.win = {}                   # name -> SlidingWindowHistogram
         self._last_sample = None        # (t_monotonic, {name: value})
         self._rates = {}
 
-    def observe(self, name, value_ms):
+    def observe(self, name, value_ms, now=None):
         h = self.hist.get(name)
         if h is None:
             h = self.hist[name] = Histogram(self._buckets)
         h.observe(value_ms)
+        w = self.win.get(name)
+        if w is None:
+            w = self.win[name] = SlidingWindowHistogram(
+                self.window_s, buckets=self._buckets)
+        w.observe(value_ms, now=now)
+
+    def window_hist(self, name, now=None):
+        """Merged last-window Histogram for `name` (empty Histogram
+        when nothing was observed — .count == 0, percentile == 0)."""
+        w = self.win.get(name)
+        if w is None:
+            return Histogram(self._buckets)
+        return w.window(now=now)
+
+    def window_snapshot(self, now=None):
+        """{name: histogram-snapshot + window_s} over the sliding
+        windows — the `windows` key of snapshot().  Keys inside each
+        entry are the Histogram.snapshot() schema plus `window_s`
+        (schema-pinned in tests/test_telemetry.py — renaming one must
+        fail a test, not a dashboard or the autoscale controller)."""
+        out = {}
+        for name in sorted(self.win):
+            snap = self.window_hist(name, now=now).snapshot()
+            snap["window_s"] = self.win[name].window_s
+            out[name] = snap
+        return out
 
     def count(self, name, n=1):
         self.counters[name] += n
@@ -202,6 +319,12 @@ class MetricsRegistry:
             if mine is None:
                 mine = self.hist[name] = Histogram(h.buckets)
             mine.merge(h)
+        for name, w in list(getattr(other, "win", {}).items()):
+            mine = self.win.get(name)
+            if mine is None:
+                mine = self.win[name] = SlidingWindowHistogram(
+                    w.window_s, buckets=w.buckets)
+            mine.merge(w)
         self.counters.update(dict(other.counters))
         for k, v in list(other._rates.items()):
             self._rates[k] = self._rates.get(k, 0.0) + v
@@ -219,6 +342,7 @@ class MetricsRegistry:
     def snapshot(self):
         return {"histograms": {n: h.snapshot()
                                for n, h in sorted(self.hist.items())},
+                "windows": self.window_snapshot(),
                 "counters": dict(sorted(self.counters.items())),
                 "rates": {k: round(v, 4)
                           for k, v in sorted(self._rates.items())}}
@@ -524,6 +648,10 @@ class Telemetry:
         only on `sync_telemetry()` (the chrome-trace export path)."""
         st = {"name": self.name,
               "hist": dict(self.registry.hist),
+              # sliding windows ship as slice AGES (monotonic clocks do
+              # not survive a process boundary); install rebases them
+              "win": {n: w.state()
+                      for n, w in self.registry.win.items()},
               "counters": collections.Counter(self.registry.counters)}
         if full:
             st.update(done=list(self.done),
@@ -641,6 +769,14 @@ class ReplicaTelemetryMirror(Telemetry):
         # the refresh — it is what the router merges and samples
         self.registry.hist = merged.hist
         self.registry.counters = merged.counters
+        if "win" in state:
+            # windows are a CURRENT-load view: the live incarnation's
+            # rebased slices replace the mirror's (a dead incarnation's
+            # recent samples age out of the window anyway — the base
+            # registry keeps its lifetime histograms, not its windows)
+            self.registry.win = {
+                n: SlidingWindowHistogram.install(st)
+                for n, st in state["win"].items()}
         if "done" in state:             # a full pull (sync_telemetry);
             #                             registry-only pulls keep the
             #                             mirror's last-known traces

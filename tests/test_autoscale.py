@@ -1,0 +1,608 @@
+"""SLO-driven elastic fleet (ISSUE 17): the FleetController closing
+the telemetry -> control loop over the EngineRouter's elastic seams.
+
+The acceptance contract: (a) a router nobody ticks is byte-identical
+to the pre-controller router (every seam is inert by default); (b)
+drain-then-retire loses ZERO requests — finished work delivers
+exactly-once, live/queued work re-routes byte-identically; (c) a live
+prefill<->decode role flip continues every in-flight request
+byte-identically (the handoff sweep migrates the KV); (d) adapter
+affinity is a routing preference with a typed fallback, never a
+constraint; (e) the controller degrades instead of oscillating —
+hysteresis, cooldown, respawn circuit breaker, load-shed last resort;
+(f) the slow chaos soak: a traffic spike + SIGKILL mid-scale-up and
+the fleet still delivers every request exactly-once, byte-identical.
+
+Tier-1 economy: controller/governor units run on a stub router (no
+engines at all); the real-engine tests share the micro 1-layer model
+and reference stream.  The cross-process soak is slow-marked.
+"""
+import os
+import signal
+import types
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import failsafe
+from paddle_tpu.inference.adapters import make_lora_adapter, save_adapter
+from paddle_tpu.inference.autoscale import FleetController, SLOTarget
+from paddle_tpu.inference.fleet import (FleetRPCError,
+                                        ReplicaCrashLoopError,
+                                        RespawnGovernor, spawn_fleet)
+from paddle_tpu.inference.router import (EngineRouter,
+                                         NoReplicaAvailableError)
+from paddle_tpu.inference.scheduler import ContinuousBatchingEngine
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def _micro_cfg():
+    return LlamaConfig.tiny(num_hidden_layers=1, hidden_size=32,
+                            intermediate_size=64, num_attention_heads=2)
+
+
+ENGINE_KW = dict(max_len=64, page_size=8, max_batch=2, prefill_chunk=8)
+
+# the spec worker processes build from — same geometry + seed as the
+# in-process fixture, so cross-process outputs are byte-identical
+SPEC = {"model": {"preset": "tiny", "seed": 3, "num_hidden_layers": 1,
+                  "hidden_size": 32, "intermediate_size": 64,
+                  "num_attention_heads": 2},
+        "engine": dict(ENGINE_KW)}
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    paddle.seed(3)
+    cfg = _micro_cfg()
+    return LlamaForCausalLM(cfg), cfg
+
+
+def factory_for(model, **over):
+    kw = dict(ENGINE_KW)
+    kw.update(over)
+    return lambda: ContinuousBatchingEngine(model, **kw)
+
+
+def stream(cfg, n=6, seed=0):
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, cfg.vocab_size, (int(t),)).astype(np.int64)
+               for t in rng.randint(4, 14, n)]
+    budgets = [int(b) for b in rng.randint(3, 8, n)]
+    return prompts, budgets
+
+
+@pytest.fixture(scope="module")
+def reference(tiny):
+    """Single-engine greedy outputs — the byte-identity target for
+    every elastic topology (scale-out, retire, role flips)."""
+    model, cfg = tiny
+    prompts, budgets = stream(cfg)
+    eng = factory_for(model)()
+    return prompts, budgets, eng.generate_many(prompts,
+                                               max_new_tokens=budgets)
+
+
+# -- stub router: controller units without a single engine build --------------
+class FakeRep:
+    def __init__(self, name, role="any"):
+        self.name = name
+        self.state = "active"
+        self.role = role
+        self.breaker = types.SimpleNamespace(state="closed")
+
+
+class StubRouter:
+    """The exact surface FleetController reads/acts on, scripted."""
+
+    def __init__(self, roles=("any",), topology=None):
+        self._replicas = [FakeRep(f"r{i}", role)
+                          for i, role in enumerate(roles)]
+        self._by_name = {r.name: r for r in self._replicas}
+        self._assigned = {r.name: [] for r in self._replicas}
+        self._topology = dict(topology) if topology else None
+        self.steps = 0
+        self.shedding = False
+        self.windows = {}               # scripted metrics view
+        self.held = 0
+        self.loads = {}                 # name -> (queued, running)
+        self.retired = []
+        self.role_flips = []
+        self.shifts = 0
+
+    def metrics(self):
+        return {"router": {}, "fleet": {"windows": self.windows}}
+
+    def health(self):
+        reps = {}
+        for r in self._replicas:
+            q, run = self.loads.get(r.name, (0, 0))
+            reps[r.name] = {"role": r.role, "breaker": r.breaker.state,
+                            "queued": q, "running": run}
+        return {"held": self.held, "pending": 0, "replicas": reps}
+
+    def add_replica(self, backend=None, name=None, role="any"):
+        rep = backend or FakeRep(name or f"r{len(self._replicas)}", role)
+        rep.role = role
+        self._replicas.append(rep)
+        self._by_name[rep.name] = rep
+        self._assigned[rep.name] = []
+        if self._topology is not None and role in self._topology:
+            self._topology[role] += 1
+        return rep
+
+    def retire_replica(self, name):
+        rep = self._by_name.pop(name)
+        self._replicas.remove(rep)
+        self._assigned.pop(name)
+        if self._topology is not None and rep.role in self._topology:
+            self._topology[rep.role] -= 1
+        self.retired.append(name)
+        return rep
+
+    def set_replica_role(self, name, role):
+        rep = self._by_name[name]
+        old = rep.role
+        rep.role = role
+        self._topology[old] -= 1
+        self._topology[role] = self._topology.get(role, 0) + 1
+        self.role_flips.append((name, old, role))
+        return rep
+
+    def shift_queued(self, max_moves=8):
+        self.shifts += 1
+        return 0
+
+    def adapter_affinity(self):
+        return {}
+
+
+BAD = {"ttft_ms": {"count": 10, "p99_ms": 500.0}}
+GOOD = {"ttft_ms": {"count": 10, "p99_ms": 10.0}}
+SLO = dict(ttft_p99_ms=100.0)
+
+
+class TestSLOTarget:
+    def test_needs_a_target(self):
+        with pytest.raises(ValueError):
+            SLOTarget()
+
+    def test_watched_maps_histogram_names(self):
+        t = SLOTarget(ttft_p99_ms=1.0, queue_wait_p99_ms=2.0)
+        assert dict(t.watched()) == {"ttft_ms": 1.0,
+                                     "queue_wait_ms": 2.0}
+
+
+class TestControllerUnits:
+    def _ctl(self, r, **kw):
+        base = dict(breach_ticks=2, slack_ticks=2, cooldown_ticks=2,
+                    shed_after_ticks=2, min_window_count=1,
+                    max_replicas=4)
+        base.update(kw)
+        return FleetController(r, SLOTarget(**SLO), **base)
+
+    def test_hysteresis_one_bad_scrape_buys_nothing(self):
+        r = StubRouter()
+        ctl = self._ctl(r)
+        r.windows = BAD
+        assert ctl.tick()["action"] == "none"       # streak 1 < 2
+        d = ctl.tick()
+        assert d["action"] == "scale_out"           # streak 2
+        assert len(r._replicas) == 2
+        assert r.shifts == 1                        # backlog re-routed
+
+    def test_cooldown_blocks_back_to_back_actions(self):
+        r = StubRouter()
+        ctl = self._ctl(r, breach_ticks=1)
+        r.windows = BAD
+        assert ctl.tick()["action"] == "scale_out"
+        assert ctl.tick()["action"] == "cooldown"
+        assert ctl.tick()["action"] == "cooldown"
+        assert ctl.tick()["action"] == "scale_out"  # cooldown spent
+        assert len(r._replicas) == 3
+
+    def test_small_windows_do_not_vote(self):
+        r = StubRouter()
+        ctl = self._ctl(r, breach_ticks=1, min_window_count=50)
+        r.windows = BAD                             # count=10 < 50
+        assert ctl.tick()["action"] == "none"
+        assert len(r._replicas) == 1
+
+    def test_held_queue_is_a_breach_even_without_latency_data(self):
+        r = StubRouter()
+        ctl = FleetController(r, SLOTarget(queue_wait_p99_ms=100.0),
+                              breach_ticks=1, min_window_count=1)
+        r.held = 3                                  # windows empty
+        assert ctl.tick()["action"] == "scale_out"
+
+    def test_slack_scales_in_down_to_the_floor(self):
+        r = StubRouter(roles=("any", "any", "any"))
+        ctl = self._ctl(r, slack_ticks=2, cooldown_ticks=0,
+                        min_replicas=2)
+        r.windows = GOOD                            # idle + under slo/2
+        assert ctl.tick()["action"] == "none"       # streak 1 < 2
+        assert ctl.tick()["action"] == "scale_in"
+        assert r.retired and len(r._replicas) == 2
+        ctl.tick()
+        ctl.tick()
+        assert len(r._replicas) == 2                # floor holds
+
+    def test_price_gate_refuses_unfit_spawn_then_sheds(self):
+        r = StubRouter()
+        ctl = self._ctl(r, breach_ticks=1,
+                        price=lambda n: {"fits": False})
+        r.windows = BAD
+        d1, d2 = ctl.tick(), ctl.tick()
+        assert d1["action"] == "capped" and d2["action"] == "shed"
+        assert len(r._replicas) == 1                # never spawned
+        assert r.shedding and ctl.sheds == 1
+
+    def test_shed_clears_with_the_breach(self):
+        r = StubRouter()
+        ctl = self._ctl(r, breach_ticks=1, max_replicas=1)
+        r.windows = BAD
+        ctl.tick(), ctl.tick()
+        assert r.shedding
+        r.windows = GOOD
+        d = ctl.tick()
+        assert d.get("shed_cleared") and not r.shedding
+
+    def test_rebalance_flips_the_idlest_decode_to_prefill(self):
+        r = StubRouter(roles=("prefill", "decode", "decode"),
+                       topology={"prefill": 1, "decode": 2})
+        ctl = self._ctl(r, slack_ticks=99, min_replicas=3)
+        r.windows = GOOD
+        r.loads = {"r0": (6, 2), "r1": (0, 1), "r2": (0, 0)}
+        d = ctl.tick()
+        assert d["action"] == "rebalance"
+        assert r.role_flips == [("r2", "decode", "prefill")]
+        assert r._topology == {"prefill": 2, "decode": 1}
+        # never below one worker per role: decode pool is now size 1
+        r.loads = {"r0": (6, 2), "r2": (6, 2), "r1": (0, 0)}
+        for _ in range(ctl.cooldown_ticks + 1):
+            d = ctl.tick()
+        assert r._topology["decode"] == 1
+
+    def test_fault_points_abort_cleanly(self):
+        r = StubRouter(roles=("any", "any"))
+        ctl = self._ctl(r, breach_ticks=1, slack_ticks=1,
+                        cooldown_ticks=0)
+        r.windows = BAD
+        with failsafe.inject("scale.spawn"):
+            d = ctl.tick()
+        assert d["action"] == "spawn_failed"
+        assert "InjectedFault" in d["error"]
+        assert len(r._replicas) == 2 and ctl.spawn_failures == 1
+        r.windows = GOOD
+        with failsafe.inject("scale.retire"):
+            d = ctl.tick()
+        assert d["action"] == "retire_failed"
+        assert len(r._replicas) == 2 and not r.retired
+        rt = StubRouter(roles=("prefill", "decode", "decode"),
+                        topology={"prefill": 1, "decode": 2})
+        ctl = self._ctl(rt, slack_ticks=99, min_replicas=3)
+        rt.windows = GOOD
+        rt.loads = {"r0": (6, 2)}
+        with failsafe.inject("scale.rebalance"):
+            d = ctl.tick()
+        assert d["action"] == "rebalance_failed"
+        assert not rt.role_flips
+
+    def test_decisions_logged_with_latency(self):
+        r = StubRouter()
+        ctl = self._ctl(r, decision_log=4)
+        for _ in range(9):
+            ctl.tick()
+        assert len(ctl.decisions) == 4              # bounded
+        assert all(d["decision_ms"] >= 0.0 for d in ctl.decisions)
+        st = ctl.stats()
+        assert st["ticks"] == 9 and st["last_decision"] is not None
+
+    def test_maybe_tick_keys_on_router_steps(self):
+        r = StubRouter()
+        ctl = self._ctl(r)
+        assert ctl.maybe_tick(every_steps=8) is None  # steps 0, last -1
+        r.steps = 8
+        assert ctl.maybe_tick(every_steps=8) is not None
+        r.steps = 15
+        assert ctl.maybe_tick(every_steps=8) is None  # only +7
+        r.steps = 16
+        assert ctl.maybe_tick(every_steps=8) is not None
+
+
+class TestRespawnGovernor:
+    def test_backoff_schedule_and_refusal_window(self):
+        t = [0.0]
+        g = RespawnGovernor(cap=5, base_delay=1.0, jitter=0.0,
+                            time_fn=lambda: t[0])
+        g.admit("w")                                # attempt 1: +1s
+        with pytest.raises(FleetRPCError):
+            g.admit("w")                            # inside the window
+        t[0] = 1.5
+        g.admit("w")                                # attempt 2: +2s
+        with pytest.raises(FleetRPCError):
+            g.admit("w")
+        t[0] = 4.0
+        g.admit("w")                                # attempt 3
+        assert g.attempts == 3
+
+    def test_cap_raises_typed_crash_loop(self):
+        t = [0.0]
+        g = RespawnGovernor(cap=2, base_delay=0.0, jitter=0.0,
+                            time_fn=lambda: t[0])
+        g.admit("w")
+        g.admit("w")
+        with pytest.raises(ReplicaCrashLoopError):
+            g.admit("w")
+
+    def test_clean_probe_resets_the_breaker(self):
+        t = [0.0]
+        g = RespawnGovernor(cap=2, base_delay=0.0, jitter=0.0,
+                            time_fn=lambda: t[0])
+        g.admit("w")
+        g.admit("w")
+        g.recovered()
+        g.admit("w")                                # breathing again
+        assert g.attempts == 1
+
+
+# -- real engines: the elastic seams ------------------------------------------
+class TestElasticRouter:
+    def test_controller_off_byte_identity(self, tiny, reference):
+        """The structural pin: a router nobody ticks — with every
+        elastic seam present but untouched — serves byte-identically
+        to the pre-controller fleet."""
+        model, _ = tiny
+        prompts, budgets, ref = reference
+        router = EngineRouter(factory_for(model), replicas=2)
+        uids = [router.add_request(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        router.drain()
+        for u, want in zip(uids, ref):
+            assert np.array_equal(router.result(u), want)
+        h = router.health()
+        assert h["crash_loops"] == 0 and h["shed_rejections"] == 0
+        assert not h["shedding"] and h["adapter_affinity"] == {}
+
+    def test_scale_out_relieves_backlog_byte_identical(self, tiny,
+                                                       reference):
+        """Breach -> spawn -> shift_queued: the fresh replica takes
+        re-routed queued work and every request still matches the
+        single-engine reference."""
+        model, _ = tiny
+        prompts, budgets, ref = reference
+        router = EngineRouter(factory_for(model), replicas=1,
+                              telemetry=True)
+        ctl = FleetController(
+            router, SLOTarget(queue_wait_p99_ms=1e-3),
+            breach_ticks=1, cooldown_ticks=0, max_replicas=2,
+            min_window_count=1)
+        uids = [router.add_request(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        for _ in range(3):              # queue-wait observations land
+            router.step()
+        d = ctl.tick()
+        assert d["action"] == "scale_out"
+        assert len(router._replicas) == 2
+        assert d["shifted"] >= 1        # backlog moved to the newcomer
+        router.drain()
+        for u, want in zip(uids, ref):
+            assert np.array_equal(router.result(u), want)
+        assert router.health()["failed"] == 0
+        assert router.duplicates_dropped == 0
+
+    def test_drain_then_retire_loses_nothing(self, tiny, reference):
+        """Scale-in mid-stream: retiring a replica with live + queued
+        work re-routes everything — byte-identical results, exactly
+        once, and the retiree's histograms survive in the fleet
+        registry (the PR 13 contract)."""
+        model, _ = tiny
+        prompts, budgets, ref = reference
+        router = EngineRouter(factory_for(model), replicas=2,
+                              telemetry=True)
+        uids = [router.add_request(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        for _ in range(2):
+            router.step()
+        victim = max(router._replicas,
+                     key=lambda r: len(router._assigned[r.name]))
+        rep = router.retire_replica(victim.name)
+        assert rep.state == "draining"
+        assert len(router._replicas) == 1
+        assert victim.name not in router._by_name
+        router.drain()
+        for u, want in zip(uids, ref):
+            assert np.array_equal(router.result(u), want)
+        h = router.health()
+        assert h["failed"] == 0 and router.duplicates_dropped == 0
+        # merged fleet registry still counts every first token — the
+        # retiree's histograms survived the retirement
+        fleet = router.metrics()["fleet"]
+        assert fleet["histograms"]["ttft_ms"]["count"] >= len(prompts)
+
+    def test_retire_refuses_to_empty_the_fleet(self, tiny):
+        model, _ = tiny
+        router = EngineRouter(factory_for(model), replicas=1)
+        with pytest.raises(ValueError):
+            router.retire_replica(router._replicas[0].name)
+        with pytest.raises(ValueError):
+            router.retire_replica("nope")
+
+    def test_controller_scales_in_idle_fleet(self, tiny):
+        model, _ = tiny
+        router = EngineRouter(factory_for(model), replicas=2,
+                              telemetry=True)
+        reaped = []
+        ctl = FleetController(router, SLOTarget(ttft_p99_ms=1e9),
+                              retirer=reaped.append, slack_ticks=2,
+                              cooldown_ticks=0, min_replicas=1)
+        assert ctl.tick()["action"] == "none"
+        d = ctl.tick()
+        assert d["action"] == "scale_in"
+        assert len(router._replicas) == 1
+        assert reaped == [d["replica"]]
+        for _ in range(4):              # floor: never below min
+            ctl.tick()
+        assert len(router._replicas) == 1
+
+    def test_live_role_flip_byte_identity(self, tiny, reference):
+        """Rebalance mid-stream: a decode worker re-rolled to prefill
+        keeps serving — the handoff sweep migrates its decode-state
+        requests and every output matches the reference."""
+        model, _ = tiny
+        prompts, budgets, ref = reference
+        router = EngineRouter(factory_for(model),
+                              topology={"prefill": 1, "decode": 2})
+        uids = [router.add_request(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        for _ in range(3):
+            router.step()
+        router.set_replica_role("d2", "prefill")
+        assert router._topology == {"prefill": 2, "decode": 1}
+        with pytest.raises(ValueError):   # last decode worker
+            router.set_replica_role("d1", "prefill")
+        router.drain()
+        for u, want in zip(uids, ref):
+            assert np.array_equal(router.result(u), want)
+        assert router.health()["failed"] == 0
+        assert router.duplicates_dropped == 0
+
+    def test_adapter_affinity_prefers_then_falls_back(self, tiny,
+                                                      tmp_path):
+        """Affinity is a preference, not a constraint: admissions
+        naming the adapter land on the affinity subset while it is
+        healthy, and route around it the moment it is not."""
+        model, cfg = tiny
+        ad = make_lora_adapter(cfg, rank=4, seed=1)
+        p = str(tmp_path / "hot")
+        save_adapter(p, ad)
+
+        def factory():
+            return ContinuousBatchingEngine(
+                model, adapters={"rank": 4}, **ENGINE_KW)
+
+        router = EngineRouter(factory, replicas=2)
+        router.load_adapter("hot", p)   # fan to both (fallback works)
+        router.set_adapter_affinity("hot", ["r1"])
+        assert router.health()["adapter_affinity"] == {"hot": ["r1"]}
+        prompts, budgets = stream(cfg, n=3, seed=5)
+        uids = [router.add_request(pr, max_new_tokens=b, adapter="hot")
+                for pr, b in zip(prompts, budgets)]
+        assert all(u in router._assigned["r1"] for u in uids)
+        assert not router._assigned["r0"]
+        router.drain()
+        assert all(router.result(u).size > 0 for u in uids)
+        # affinity replica down -> typed refusal moves routing on
+        router._by_name["r1"].breaker.state = "open"
+        u = router.add_request(prompts[0], max_new_tokens=2,
+                               adapter="hot")
+        assert u in router._assigned["r0"]
+        router._by_name["r1"].breaker.state = "closed"
+        router.drain()
+        assert router.result(u).size > 0
+        # retirement scrubs the affinity set
+        router.retire_replica("r1")
+        assert router.health()["adapter_affinity"] == {"hot": []}
+        with pytest.raises(ValueError):
+            router.set_adapter_affinity("hot", ["ghost"])
+
+    def test_pinned_adapter_survives_pool_pressure(self, tiny):
+        """pin_adapter: the controller's pool-resident guarantee — a
+        pinned fine-tune is never the LRU victim."""
+        model, cfg = tiny
+        e = ContinuousBatchingEngine(model, adapters={"rank": 4,
+                                                      "max_adapters": 2},
+                                     **ENGINE_KW)
+        e.load_adapter("hot", make_lora_adapter(cfg, rank=4, seed=1))
+        e.pin_adapter("hot")
+        e.load_adapter("b", make_lora_adapter(cfg, rank=4, seed=2))
+        e.load_adapter("c", make_lora_adapter(cfg, rank=4, seed=3))
+        st = e.health()["adapters"]
+        assert st["pinned"] == ["hot"]
+        assert "hot" in e._apool._slots
+        assert "b" not in e._apool._slots   # the unpinned LRU victim
+        e.pin_adapter("hot", pinned=False)
+        assert e.health()["adapters"]["pinned"] == []
+
+    def test_shed_gate_refuses_typed(self, tiny):
+        model, cfg = tiny
+        router = EngineRouter(factory_for(model), replicas=1)
+        router.shedding = True
+        with pytest.raises(NoReplicaAvailableError):
+            router.add_request(np.array([1, 2, 3]), max_new_tokens=2)
+        assert router.shed_rejections == 1
+        assert router.health()["shed_rejections"] == 1
+        router.shedding = False
+        u = router.add_request(np.array([1, 2, 3]), max_new_tokens=2)
+        router.drain()
+        assert router.result(u).size > 0
+
+
+# -- chaos soak ---------------------------------------------------------------
+@pytest.mark.slow
+class TestChaosSoak:
+    def test_spike_kill9_mid_scale_up_zero_lost(self, tiny):
+        """The acceptance run: a 1-worker process fleet takes a
+        Poisson spike, the controller scales out against the
+        queue-wait SLO, the ORIGINAL worker is killed -9 right after
+        the new one joins — and every request still delivers exactly
+        once, byte-identical to the single-engine reference, on the
+        worker the controller bought.  Then the slack phase drains and
+        retires back down with zero loss."""
+        model, cfg = tiny
+        rng = np.random.RandomState(42)
+        n = int(rng.poisson(9)) + 4     # seeded spike size
+        prompts = [rng.randint(0, cfg.vocab_size,
+                               (int(t),)).astype(np.int64)
+                   for t in rng.randint(4, 14, n)]
+        budgets = [int(b) for b in rng.randint(3, 8, n)]
+        ref = factory_for(model)().generate_many(prompts,
+                                                 max_new_tokens=budgets)
+        handle = spawn_fleet(SPEC, 1, prefix_index=False)
+        try:
+            router = EngineRouter(backends=handle.replicas,
+                                  telemetry=True, probe_backoff=10_000)
+            ctl = FleetController(
+                router, SLOTarget(queue_wait_p99_ms=1.0),
+                spawner=lambda role: handle.spawn_worker(role=role),
+                retirer=handle.retire_worker,
+                breach_ticks=1, cooldown_ticks=2, slack_ticks=2,
+                min_window_count=1, max_replicas=2,
+                shed_after_ticks=99)
+            uids = [router.add_request(p, max_new_tokens=b)
+                    for p, b in zip(prompts, budgets)]
+            killed = False
+            steps = 0
+            while router.pending():
+                router.step()
+                steps += 1
+                ctl.maybe_tick(every_steps=3)
+                if ctl.scale_outs >= 1 and not killed:
+                    victim = handle.procs[0]   # the ORIGINAL worker,
+                    os.kill(victim.pid, signal.SIGKILL)
+                    victim.join()              # mid-scale-up
+                    killed = True
+                assert steps < 3000, "soak did not converge"
+            assert killed and ctl.scale_outs >= 1
+            for u, want in zip(uids, ref):
+                assert np.array_equal(router.result(u), want)
+            h = router.health()
+            assert h["failed"] == 0
+            assert router.duplicates_dropped == 0
+            # slack phase: a controller with lazy targets retires the
+            # extra capacity — drain-then-retire, nothing in flight,
+            # nothing lost
+            reaped = []
+            lazy = FleetController(
+                router, SLOTarget(queue_wait_p99_ms=1e9),
+                retirer=lambda name: reaped.append(
+                    handle.retire_worker(name)),
+                slack_ticks=1, cooldown_ticks=0, min_replicas=1)
+            d = lazy.tick()
+            assert d["action"] == "scale_in"
+            assert reaped == [True]
+            assert len(router._replicas) == 1
+            assert router.health()["failed"] == 0
+        finally:
+            handle.shutdown()

@@ -231,8 +231,9 @@ class TestFleetRouting:
         # top-level metrics schema (fleet mode == in-process mode)
         assert sorted(m) == ["fleet", "replicas", "router"]
         assert sorted(m["router"]) == [
-            "failovers", "handoff_failures", "held", "hot_swaps",
-            "kv_handoffs", "pending", "probes", "requeued", "steps",
+            "crash_loops", "failovers", "handoff_failures", "held",
+            "hot_swaps", "kv_handoffs", "pending", "probes",
+            "replicas", "requeued", "shed_rejections", "steps",
             "swap_rollbacks"]
         assert sorted(m["replicas"]) == ["p0", "p1"]
         # the merged fleet registry carries every replica's histograms
@@ -247,6 +248,7 @@ class TestFleetRouting:
         # schema plus the pinned worker block
         h = router.health()["replicas"]["p0"]
         assert sorted(h["worker"]) == ["incarnation", "pid",
+                                       "respawn_attempts", "respawns",
                                        "rpc_errors"]
         # prometheus exposition spans the fleet
         prom = router.prometheus()

@@ -131,6 +131,72 @@ class TestRegistry:
         assert "paddle_tpu_requests_done 7" in text
 
 
+# -- windowed percentiles (PR 17 satellite: the autoscale controller
+# -- reacts to CURRENT load, not lifetime aggregates) -------------------------
+WINDOW_SNAPSHOT_KEYS = frozenset({
+    "count", "sum_ms", "min_ms", "max_ms", "p50_ms", "p90_ms",
+    "p95_ms", "p99_ms", "window_s",
+})
+
+
+class TestWindowedPercentiles:
+    def test_window_reflects_recent_not_lifetime(self):
+        reg = MetricsRegistry(window_s=10.0)
+        reg.observe("ttft_ms", 100.0, now=0.0)
+        reg.observe("ttft_ms", 100.0, now=3.0)
+        reg.observe("ttft_ms", 500.0, now=20.0)
+        assert reg.hist["ttft_ms"].count == 3       # lifetime keeps all
+        w = reg.window_hist("ttft_ms", now=21.0)
+        assert w.count == 1                         # window: recent only
+        assert w.percentile(99) > 200.0
+        # an old-only window reads empty, lifetime still answers
+        assert reg.window_hist("ttft_ms", now=200.0).count == 0
+
+    def test_window_snapshot_schema_pinned(self):
+        reg = MetricsRegistry(window_s=10.0)
+        reg.observe("queue_wait_ms", 5.0)
+        snap = reg.window_snapshot()
+        got = frozenset(snap["queue_wait_ms"])
+        assert got == WINDOW_SNAPSHOT_KEYS, (
+            f"window snapshot schema drifted: "
+            f"added={sorted(got - WINDOW_SNAPSHOT_KEYS)} "
+            f"removed={sorted(WINDOW_SNAPSHOT_KEYS - got)} — the "
+            "autoscale controller and dashboards consume these keys; "
+            "update docs/observability.md and this pin TOGETHER")
+        # the registry snapshot carries the windows view alongside the
+        # lifetime histograms under its own key
+        assert "windows" in reg.snapshot()
+        # an aged-out window degrades to the empty histogram shape
+        empty = reg.window_snapshot(now=1e9)["queue_wait_ms"]
+        assert frozenset(empty) == frozenset({"count", "window_s"})
+        assert empty["count"] == 0
+
+    def test_merge_aggregates_windows(self):
+        a = MetricsRegistry(window_s=10.0)
+        b = MetricsRegistry(window_s=10.0)
+        a.observe("ttft_ms", 10.0, now=20.0)
+        b.observe("ttft_ms", 30.0, now=20.5)
+        b.merge(a)
+        assert b.window_hist("ttft_ms", now=21.0).count == 2
+        fleet = MetricsRegistry.merged([a, b])
+        assert fleet.window_hist("ttft_ms", now=21.0).count >= 2
+
+    def test_state_ships_ages_not_timestamps(self):
+        # cross-process rule (same as relative deadline budgets):
+        # monotonic clocks do not cross process boundaries, so the
+        # shipped state carries slice AGES and install() rebases them
+        # onto the local clock
+        tel = Telemetry(name="w0")
+        tel.registry.observe("tpot_ms", 7.0)
+        state = tel.state()
+        assert "win" in state
+        from paddle_tpu.inference.telemetry import (
+            ReplicaTelemetryMirror)
+        mir = ReplicaTelemetryMirror("w0")
+        mir.install_state(state)
+        assert mir.registry.window_hist("tpot_ms").count == 1
+
+
 # -- the pinned health() schemas (satellite: dashboards + the registry's
 # -- rate sampler consume these keys; a rename must fail a test, not a
 # -- dashboard) --------------------------------------------------------------
@@ -155,6 +221,8 @@ ROUTER_HEALTH_KEYS = frozenset({
     "swap_rollbacks", "topology", "kv_handoffs", "handoff_failures",
     "prefix_routing", "prefix_routed", "prefix_ships",
     "prefix_ship_failures", "prefix_index",
+    # elastic fleet (PR 17: inference/autoscale.py)
+    "crash_loops", "shedding", "shed_rejections", "adapter_affinity",
 })
 
 REPLICA_HEALTH_KEYS = frozenset({

@@ -255,6 +255,33 @@ def main():
                          "lane-aligned geometry; forcing it on CPU runs "
                          "interpret mode (parity, not speed; scheduler "
                          "mode, docs/serving.md \"Megakernel decode\")")
+    ap.add_argument("--temperature", type=float, default=None,
+                    help="per-request sampled decoding: softmax "
+                         "temperature (unset = greedy argmax). With "
+                         "--megakernel multi the top-K candidates come "
+                         "out of the whole-step kernel — the [batch, "
+                         "vocab] logits never materialize "
+                         "(docs/serving.md \"Sampling & structured "
+                         "decoding\")")
+    ap.add_argument("--top-k", type=int, default=0,
+                    help="sampled decoding: keep the k most likely "
+                         "tokens before renormalizing (0 = no top-k "
+                         "cut; capped by the engine's sample_k "
+                         "candidate width)")
+    ap.add_argument("--top-p", type=float, default=1.0,
+                    help="sampled decoding: nucleus cutoff — smallest "
+                         "probability mass kept (1.0 = off)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="sampled decoding: base PRNG seed; request i "
+                         "streams from seed+i, and the counter-based "
+                         "key schedule makes each stream reproducible "
+                         "across batch composition, preemption, and "
+                         "failover")
+    ap.add_argument("--sample-rotate", action="store_true",
+                    help="alternate sampled/greedy demo requests, "
+                         "demonstrating a MIXED batch — greedy rows in "
+                         "a sampled block stay bit-identical to an "
+                         "all-greedy block (needs --temperature)")
     args = ap.parse_args()
 
     import paddle_tpu as paddle
@@ -442,6 +469,33 @@ def main():
             names = [None] + [n for n, _ in adapter_list]
             return names[i % len(names)]
         return adapter_list[0][0]
+
+    # -- per-request sampling (docs/serving.md "Sampling & structured
+    # -- decoding"): --temperature arms it; the other knobs without it
+    # -- are inert, which deserves a loud flag-convention warning
+    if args.temperature is None and (args.top_k or args.top_p != 1.0
+                                     or args.seed or args.sample_rotate):
+        import warnings
+        warnings.warn(
+            "--top-k/--top-p/--seed/--sample-rotate do nothing without "
+            "--temperature (decoding stays greedy); set --temperature "
+            "to sample", DeprecationWarning, stacklevel=1)
+
+    def sampling_for(i):
+        """SamplingParams spec dict for demo request i, or None for
+        engine-default greedy. --sample-rotate alternates sampled and
+        greedy rows — a MIXED batch, where the greedy rows are pinned
+        bit-identical to an all-greedy block. seed+i gives every
+        request its own counter-based key stream, so re-running with
+        the same flags reproduces the same tokens regardless of which
+        replica serves it or how the batch packs."""
+        if args.temperature is None:
+            return None
+        if args.sample_rotate and i % 2 == 1:
+            return None
+        return {"do_sample": True, "temperature": args.temperature,
+                "top_k": args.top_k, "top_p": args.top_p,
+                "seed": args.seed + i}
 
     def deploy_adapters(target):
         """The ONE deploy sequence every branch runs: materialize
@@ -644,7 +698,8 @@ def main():
                        .astype(np.int64) for t in (16, 9, 5, 12)]
             uids = [router.add_request(p,
                                        max_new_tokens=args.max_new_tokens,
-                                       adapter=adapter_for(i))
+                                       adapter=adapter_for(i),
+                                       sampling=sampling_for(i))
                     for i, p in enumerate(prompts)]
             # elastic fleet: scale-out forks REAL worker processes via
             # the handle (respawn-governed), scale-in drains then
@@ -702,7 +757,8 @@ def main():
         prompts = [rng.randint(0, g["cfg"].vocab_size, (t,))
                    .astype(np.int64) for t in (16, 9, 5, 12)]
         uids = [router.add_request(p, max_new_tokens=args.max_new_tokens,
-                                   adapter=adapter_for(i))
+                                   adapter=adapter_for(i),
+                                   sampling=sampling_for(i))
                 for i, p in enumerate(prompts)]
         # in-process elastic: the factory IS the spawner (controller
         # falls back to router.add_replica()); topology present, so
@@ -756,15 +812,18 @@ def main():
             # index claims) before the prefix-sharing follow-ups
             # arrive — that is the traffic shape the index steers
             uids = [router.add_request(
-                prompts[0], max_new_tokens=args.max_new_tokens)]
+                prompts[0], max_new_tokens=args.max_new_tokens,
+                sampling=sampling_for(0))]
             router.drain()
             uids += [router.add_request(
-                p, max_new_tokens=args.max_new_tokens)
-                for p in prompts[1:]]
+                p, max_new_tokens=args.max_new_tokens,
+                sampling=sampling_for(i))
+                for i, p in enumerate(prompts[1:], start=1)]
         else:
             uids = [router.add_request(
                 p, max_new_tokens=args.max_new_tokens,
-                adapter=adapter_for(i))
+                adapter=adapter_for(i),
+                sampling=sampling_for(i))
                 for i, p in enumerate(prompts)]
         for _ in range(2):
             router.step()                    # replicas mid-flight
@@ -837,7 +896,7 @@ def main():
                    .astype(np.int64)]
         submitted = [(0, engine.add_request(
             prompts[0], max_new_tokens=args.max_new_tokens,
-            adapter=adapter_for(0)))]
+            adapter=adapter_for(0), sampling=sampling_for(0)))]
         while engine._requests[submitted[0][1]].state in ("queued",
                                                           "prefill"):
             engine.step()            # request 0 publishes its pages
@@ -845,7 +904,7 @@ def main():
             try:
                 submitted.append((i, engine.add_request(
                     p, max_new_tokens=args.max_new_tokens,
-                    adapter=adapter_for(i))))
+                    adapter=adapter_for(i), sampling=sampling_for(i))))
             except EngineBusyError as e:
                 # bounded queue: backpressure is a client-visible signal,
                 # not an engine crash
@@ -921,8 +980,15 @@ def main():
     # device_loop=True: one lax.scan dispatch for the whole generation —
     # the per-token host round trip (the latency killer through any
     # networked accelerator) is paid ONCE per generation
+    sample_kw = {}
+    if args.temperature is not None:
+        # the static LLMEngine keeps the legacy whole-batch knobs (its
+        # generate() has no per-request surface to hang SamplingParams
+        # on); the continuous-batching modes above take sampling_for(i)
+        sample_kw = dict(do_sample=True, temperature=args.temperature,
+                         top_k=args.top_k, top_p=args.top_p)
     out = engine.generate(prompts, max_new_tokens=args.max_new_tokens,
-                          device_loop=True)
+                          device_loop=True, **sample_kw)
     print(f"model={args.model} quant={args.quant} "
           f"prompt={prompts.shape} -> generated={out.shape}")
     print("first sequence tail:", out[0, -args.max_new_tokens:].tolist())

@@ -625,14 +625,17 @@ class EngineRouter:
     # -- public ------------------------------------------------------------
     def add_request(self, ids, max_new_tokens=32, eos_token_id=None,
                     deadline_ms=None, ttl_steps=None, tenant=None,
-                    priority=None, adapter=None):
+                    priority=None, adapter=None, sampling=None):
         """Queue one prompt on the healthiest replica; returns a ROUTER
         uid (stable across failovers — the engine-level uid may change
         when the request migrates). Signature mirrors
         ContinuousBatchingEngine.add_request (adapter= names a LoRA
         fine-tune deployed via load_adapter — the name rides the spec
-        through failover and KV handoff); per-tenant admission is
-        enforced by each replica's own policy."""
+        through failover and KV handoff; sampling= is a SamplingParams
+        or its to_spec() dict and likewise rides the spec, so a sampled
+        request keeps its temperature/top-k/top-p AND its counter-based
+        key stream across failover and disagg handoff); per-tenant
+        admission is enforced by each replica's own policy."""
         if self.shedding:
             # the autoscale controller's documented last resort: fleet
             # at max_replicas and still SLO-breached — refuse typed at
@@ -645,10 +648,13 @@ class EngineRouter:
         ids = np.asarray(ids, np.int64).ravel()
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
+        if sampling is not None and not isinstance(sampling, dict):
+            sampling = sampling.to_spec()   # SamplingParams -> wire dict
         spec = {"prompt": ids, "max_new_tokens": int(max_new_tokens),
                 "eos_token_id": eos_token_id, "tenant": tenant or "default",
                 "priority": priority, "ttl_steps": ttl_steps,
-                "deadline": deadline, "adapter": adapter}
+                "deadline": deadline, "adapter": adapter,
+                "sampling": sampling}
         rr = _RouterRequest(self._next_uid, spec["tenant"])
         self._next_uid += 1
         self._reqs[rr.uid] = rr

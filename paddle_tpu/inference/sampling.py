@@ -1,0 +1,594 @@
+"""On-device sampling v2 (ISSUE 18 / ROADMAP item 4): per-request
+sampling params, a counter-based key stream, the shared top-K selection
+math, the logit-processor chain, and grammar-constrained decoding.
+
+The design has one load-bearing invariant: EVERY path that can emit a
+sampled token — the whole-step megakernel's in-kernel top-K fold, the
+op-chain `lax.scan` mirror, the decode_block=1 step, the prefill first
+token, and speculative verify — routes through the SAME
+`select_from_topk` over the SAME `(request_seed, position)` key stream.
+Identical inputs through identical math is what makes sampled outputs
+bit-identical across megakernel on/off, decode_block 1/8, batch
+composition, preemption/restore, failover resume, and tp — the pins
+tests/test_sampling_v2.py holds.
+
+Key stream: token at absolute position `pos` (0-indexed in the
+request's prompt+generated stream) is drawn with
+`jax.random.fold_in(jax.random.key(seed), pos)`. Positions are absolute
+and the engine's preemption path folds generated tokens into the prompt
+WITHOUT renumbering (`scheduler._preempt`), so a resumed request
+continues the exact stream — reproducibility is a property of the
+(seed, position) pair alone, never of scheduling.
+
+Top-K fold semantics: the engine selects from the top `sample_k`
+(engine-level, default 8) logits, computed in-kernel by the megakernel's
+running top-K merge (the greedy running (max, argmax) generalized — the
+[w, V] logits stay dead code) and by `lax.top_k` on the materialized
+reference path. `top_p`/`min_p` therefore act WITHIN the top-sample_k
+candidate set — a documented approximation that is exact whenever the
+nucleus fits in sample_k candidates (docs/serving.md has the math); a
+request's `top_k` must fit in `sample_k` to take the folded path.
+
+Processor chain (materialized-logits path only — penalties and grammar
+masks need the full vocab row) applies in a fixed documented order:
+  1. repetition / presence / frequency penalties (over GENERATED tokens,
+     tracked per request; prompt tokens do not count)
+  2. grammar token-mask (precompiled automaton, device applies the mask,
+     host advances the authoritative state at block boundaries)
+  3. temperature -> top_k -> top_p -> min_p -> categorical
+     (via select_from_topk over lax.top_k survivors)
+Stop sequences are host-side (tail-match on generated ids at
+`_push_token` time) so they cost nothing on device.
+"""
+import numpy as np
+
+NEG = -1e30      # matches ops.pallas.paged_attention.NEG_INF
+
+
+# ---------------------------------------------------------------------------
+# SamplingParams
+
+
+class SamplingParams:
+    """Per-request sampling spec (engine API: `add_request(...,
+    sampling=SamplingParams(...))`).
+
+    do_sample=False is greedy (argmax) — the other knobs are ignored.
+    `top_k=0` means "all sample_k candidates"; a nonzero top_k must be
+    <= the engine's `sample_k`. `stop` is a tuple of token-id tuples
+    (the engine works in ids; detokenized string matching belongs to the
+    caller). `grammar` is a TokenMaskAutomaton (or None).
+    """
+
+    __slots__ = ("do_sample", "temperature", "top_k", "top_p", "min_p",
+                 "seed", "repetition_penalty", "presence_penalty",
+                 "frequency_penalty", "stop", "grammar")
+
+    def __init__(self, do_sample=False, temperature=1.0, top_k=0,
+                 top_p=1.0, min_p=0.0, seed=0, repetition_penalty=1.0,
+                 presence_penalty=0.0, frequency_penalty=0.0, stop=(),
+                 grammar=None):
+        self.do_sample = bool(do_sample)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.min_p = float(min_p)
+        self.seed = int(seed) & 0xFFFFFFFF
+        self.repetition_penalty = float(repetition_penalty)
+        self.presence_penalty = float(presence_penalty)
+        self.frequency_penalty = float(frequency_penalty)
+        self.stop = tuple(tuple(int(t) for t in s) for s in stop)
+        self.grammar = grammar
+        self.validate()
+
+    def validate(self):
+        if self.temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, "
+                             f"got {self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if not 0.0 <= self.min_p <= 1.0:
+            raise ValueError(f"min_p must be in [0, 1], got {self.min_p}")
+        if self.repetition_penalty <= 0.0:
+            raise ValueError(f"repetition_penalty must be > 0, "
+                             f"got {self.repetition_penalty}")
+        for s in self.stop:
+            if not s:
+                raise ValueError("empty stop sequence")
+
+    @property
+    def needs_processors(self):
+        """True when this request needs the materialized-logits
+        processor path (penalties over the full vocab row or a grammar
+        mask) rather than the folded top-K fast path."""
+        return (self.repetition_penalty != 1.0
+                or self.presence_penalty != 0.0
+                or self.frequency_penalty != 0.0
+                or self.grammar is not None)
+
+    def to_spec(self):
+        """Serializable dict for export_request / failover resume. The
+        grammar automaton serializes its tables (they are small: states
+        x vocab)."""
+        spec = {"do_sample": self.do_sample,
+                "temperature": self.temperature, "top_k": self.top_k,
+                "top_p": self.top_p, "min_p": self.min_p,
+                "seed": self.seed,
+                "repetition_penalty": self.repetition_penalty,
+                "presence_penalty": self.presence_penalty,
+                "frequency_penalty": self.frequency_penalty,
+                "stop": [list(s) for s in self.stop]}
+        if self.grammar is not None:
+            spec["grammar"] = self.grammar.to_spec()
+        return spec
+
+    @classmethod
+    def from_spec(cls, spec):
+        if spec is None:
+            return None
+        if isinstance(spec, SamplingParams):
+            return spec
+        spec = dict(spec)
+        g = spec.pop("grammar", None)
+        return cls(grammar=TokenMaskAutomaton.from_spec(g)
+                   if g is not None else None, **spec)
+
+    def __repr__(self):
+        if not self.do_sample and not self.needs_processors \
+                and not self.stop:
+            return "SamplingParams(greedy)"
+        return (f"SamplingParams(do_sample={self.do_sample}, "
+                f"temperature={self.temperature}, top_k={self.top_k}, "
+                f"top_p={self.top_p}, min_p={self.min_p}, "
+                f"seed={self.seed})")
+
+
+GREEDY = SamplingParams()
+
+
+# ---------------------------------------------------------------------------
+# key stream + shared selection math (jax; imported lazily so the module
+# stays importable for host-only automaton work)
+
+
+def fold_keys(seeds, positions):
+    """[w] uint32 seeds x [w] i32 absolute positions -> [w] threefry
+    keys: key(seed) folded with the position counter. THE key-stream
+    definition — every sampling site derives keys through here."""
+    import jax
+
+    def one(s, c):
+        return jax.random.fold_in(jax.random.key(s), c)
+
+    return jax.vmap(one)(seeds, positions)
+
+
+def select_from_topk(topv, topi, keys, dos, temp, topk, topp, minp):
+    """Select one token per row from its top-K survivor set.
+
+    topv [w, K] f32 logits sorted descending (ties: lower vocab id
+    first — both `lax.top_k` and the megakernel's running merge honor
+    this order), topi [w, K] i32 their vocab ids, keys [w] per-row
+    threefry keys (fold_keys), dos [w] bool do_sample, temp/topp/minp
+    [w] f32, topk [w] i32 (0 = all K candidates). Returns [w] i32.
+
+    Greedy rows take topi[:, 0] — identical bits to the running-argmax
+    token, so mixed greedy/sampled batches cost greedy rows nothing.
+    Order within a row: temperature -> top_k -> top_p -> min_p ->
+    categorical. top_p keeps ids whose EXCLUSIVE cumulative probability
+    is < top_p (the smallest nucleus covering top_p, matching the
+    sort-based reference rule); min_p keeps probs >= min_p * max_prob —
+    prob RATIOS are normalizer-free, so min-p over the survivor set
+    equals global min-p intersected with the survivor set exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    w, K = topv.shape
+    neg = jnp.float32(NEG)
+    scaled = topv.astype(jnp.float32) / jnp.maximum(
+        temp, jnp.float32(1e-6))[:, None]
+    j = jax.lax.broadcasted_iota(jnp.int32, (w, K), 1)
+    keep_k = jnp.where(topk[:, None] > 0, j < topk[:, None], True)
+    masked = jnp.where(keep_k, scaled, neg)
+    probs = jax.nn.softmax(masked, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_p = (cum - probs) < topp[:, None]
+    keep_m = probs >= minp[:, None] * probs[:, :1]
+    final = jnp.where(jnp.logical_and(keep_p, keep_m), masked, neg)
+    pick = jax.vmap(jax.random.categorical)(keys, final).astype(jnp.int32)
+    pick = jnp.clip(pick, 0, K - 1)
+    sampled = jnp.take_along_axis(topi, pick[:, None], axis=1)[:, 0]
+    return jnp.where(dos, sampled, topi[:, 0]).astype(jnp.int32)
+
+
+def apply_penalties(logits, counts, rep, pres, frq):
+    """Repetition / presence / frequency penalties over a materialized
+    [w, V] logits row. `counts` [w, V] i32 — occurrences among the
+    request's GENERATED tokens. rep multiplies/divides (CTRL-style:
+    positive logits divide by rep, negative multiply), pres subtracts a
+    flat penalty per seen token, frq subtracts per occurrence. rep=1 /
+    pres=0 / frq=0 rows pass through bit-identically (the mixed-batch
+    no-op guarantee)."""
+    import jax.numpy as jnp
+
+    cf = counts.astype(logits.dtype)
+    seen = (counts > 0).astype(logits.dtype)
+    r = rep[:, None].astype(logits.dtype)
+    pen = jnp.where(logits > 0, logits / r, logits * r)
+    out = jnp.where(jnp.logical_and(r != 1.0, seen > 0), pen, logits)
+    out = out - frq[:, None].astype(logits.dtype) * cf
+    out = out - pres[:, None].astype(logits.dtype) * seen
+    return out
+
+
+def stop_hit(out_ids, stop):
+    """Host-side stop-sequence tail match: True when the generated ids
+    end with any stop sequence. O(len(stop) * max seq len) per token —
+    stop sequences are short."""
+    if not stop:
+        return False
+    n = len(out_ids)
+    for s in stop:
+        m = len(s)
+        if m <= n and tuple(out_ids[n - m:]) == s:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# grammar-constrained decoding: pattern -> NFA -> DFA -> token automaton
+
+
+class _NFA:
+    """Thompson NFA under construction: char transitions + epsilon
+    edges. Fragments return (start, accepts); the builder owns state
+    allocation so combinators compose freely."""
+
+    def __init__(self):
+        self.n = 0
+        self.trans = {}     # (state, char) -> set(states)
+        self.eps = {}       # state -> set(states)
+
+    def state(self):
+        self.n += 1
+        return self.n - 1
+
+    def edge(self, s, ch, d):
+        self.trans.setdefault((s, ch), set()).add(d)
+
+    def eedge(self, s, d):
+        self.eps.setdefault(s, set()).add(d)
+
+    def closure(self, states):
+        out = set(states)
+        work = list(states)
+        while work:
+            s = work.pop()
+            for d in self.eps.get(s, ()):
+                if d not in out:
+                    out.add(d)
+                    work.append(d)
+        return frozenset(out)
+
+
+class Pat:
+    """Tiny regular-pattern combinators for compiling grammars to
+    character DFAs: Lit / Chars / Seq / Alt / Star / Plus / Opt.
+    Enough to express the JSON-schema subset below; users can
+    hand-build patterns for custom grammars."""
+
+    def build(self, nfa):
+        """Return (start_state, accept_state_set), adding transitions
+        to `nfa` (standard Thompson construction)."""
+        raise NotImplementedError
+
+    def __or__(self, other):
+        return Alt(self, other)
+
+    def __add__(self, other):
+        return Seq(self, other)
+
+
+def _pat(p):
+    return p if isinstance(p, Pat) else Lit(p)
+
+
+class Lit(Pat):
+    def __init__(self, s):
+        self.s = str(s)
+
+    def build(self, nfa):
+        start = nfa.state()
+        cur = start
+        for ch in self.s:
+            nxt = nfa.state()
+            nfa.edge(cur, ch, nxt)
+            cur = nxt
+        return start, {cur}
+
+
+class Chars(Pat):
+    """One character from a set."""
+
+    def __init__(self, chars):
+        self.chars = sorted(set(chars))
+
+    def build(self, nfa):
+        start = nfa.state()
+        end = nfa.state()
+        for ch in self.chars:
+            nfa.edge(start, ch, end)
+        return start, {end}
+
+
+class Seq(Pat):
+    def __init__(self, *parts):
+        self.parts = [_pat(p) for p in parts]
+
+    def build(self, nfa):
+        start = nfa.state()
+        cur = {start}
+        for p in self.parts:
+            ps, pa = p.build(nfa)
+            for s in cur:
+                nfa.eedge(s, ps)
+            cur = pa
+        return start, cur
+
+
+class Alt(Pat):
+    def __init__(self, *parts):
+        self.parts = [_pat(p) for p in parts]
+
+    def build(self, nfa):
+        start = nfa.state()
+        accepts = set()
+        for p in self.parts:
+            ps, pa = p.build(nfa)
+            nfa.eedge(start, ps)
+            accepts |= pa
+        return start, accepts
+
+
+class Star(Pat):
+    """Zero or more repetitions."""
+
+    def __init__(self, part):
+        self.part = _pat(part)
+
+    def build(self, nfa):
+        start = nfa.state()
+        ps, pa = self.part.build(nfa)
+        nfa.eedge(start, ps)
+        for a in pa:
+            nfa.eedge(a, ps)
+        return start, pa | {start}
+
+
+class Plus(Pat):
+    """One or more repetitions."""
+
+    def __init__(self, part):
+        self.part = _pat(part)
+
+    def build(self, nfa):
+        ps, pa = self.part.build(nfa)
+        for a in pa:
+            nfa.eedge(a, ps)
+        return ps, pa
+
+
+class Opt(Pat):
+    def __init__(self, part):
+        self.part = _pat(part)
+
+    def build(self, nfa):
+        ps, pa = self.part.build(nfa)
+        return ps, pa | {ps}
+
+
+class CharDFA:
+    """Deterministic char automaton: `step[state][ch] -> state` (missing
+    key = dead), `accept` set of accepting state ids. Built from a Pat
+    via Thompson construction + epsilon-closure subset construction."""
+
+    def __init__(self, step, accept):
+        self.step = step        # list[dict char -> int]
+        self.accept = accept    # set[int]
+
+    @classmethod
+    def compile(cls, pat):
+        nfa = _NFA()
+        start, accepts = _pat(pat).build(nfa)
+        start_key = nfa.closure({start})
+        states = {start_key: 0}
+        step = [dict()]
+        accept = set()
+        work = [start_key]
+        while work:
+            cur = work.pop()
+            ci = states[cur]
+            if cur & accepts:
+                accept.add(ci)
+            moves = {}
+            for (src, ch), dsts in nfa.trans.items():
+                if src in cur:
+                    moves.setdefault(ch, set()).update(dsts)
+            for ch, dst in sorted(moves.items()):
+                key = nfa.closure(dst)
+                if key not in states:
+                    states[key] = len(step)
+                    step.append(dict())
+                    work.append(key)
+                step[ci][ch] = states[key]
+        return cls(step, accept)
+
+    def run(self, state, text):
+        """Advance from `state` over `text`. Returns the end state or
+        None (dead)."""
+        for ch in text:
+            state = self.step[state].get(ch)
+            if state is None:
+                return None
+        return state
+
+
+DIGITS = "0123456789"
+
+
+def json_schema_pattern(schema):
+    """Compile a JSON-schema SUBSET to a character pattern producing
+    exactly the schema's valid compact-JSON texts:
+
+      {"type": "integer"}                  -> -?[0-9]+
+      {"type": "boolean"}                  -> true|false
+      {"type": "string", "enum": [...]}    -> one of the quoted strings
+      {"type": "null"}                     -> null
+      {"type": "array", "items": S,
+       "minItems": m, "maxItems": M}       -> bounded [S, S, ...]
+      {"type": "object", "properties": P,
+       "required": [...]}                  -> fixed key order (sorted),
+                                              required keys only
+
+    Finite/regular by construction (no unbounded nesting — arrays are
+    bounded, objects flatten their fixed keys), which is what makes the
+    token-mask automaton small and exact."""
+    t = schema.get("type")
+    if t == "integer":
+        return Seq(Opt("-"), Plus(Chars(DIGITS)))
+    if t == "boolean":
+        return Alt("true", "false")
+    if t == "null":
+        return Lit("null")
+    if t == "string":
+        enum = schema.get("enum")
+        if not enum:
+            raise ValueError("string schemas need an 'enum' (free-form "
+                             "strings are unbounded; this subset stays "
+                             "finite)")
+        return Alt(*[Lit('"%s"' % e) for e in enum])
+    if t == "array":
+        items = json_schema_pattern(schema["items"])
+        lo = int(schema.get("minItems", 0))
+        hi = int(schema.get("maxItems", max(lo, 3)))
+        if hi < lo:
+            raise ValueError(f"maxItems {hi} < minItems {lo}")
+        alts = []
+        for n in range(lo, hi + 1):
+            if n == 0:
+                alts.append(Lit("[]"))
+            else:
+                inner = [items] * n
+                seq = ["["]
+                for i, it in enumerate(inner):
+                    if i:
+                        seq.append(",")
+                    seq.append(it)
+                seq.append("]")
+                alts.append(Seq(*seq))
+        return Alt(*alts) if len(alts) > 1 else alts[0]
+    if t == "object":
+        props = schema.get("properties", {})
+        req = schema.get("required", sorted(props))
+        seq = ["{"]
+        for i, name in enumerate(req):
+            if i:
+                seq.append(",")
+            seq.append('"%s":' % name)
+            seq.append(json_schema_pattern(props[name]))
+        seq.append("}")
+        return Seq(*seq)
+    raise ValueError(f"unsupported schema type {t!r}")
+
+
+class TokenMaskAutomaton:
+    """Precompiled token-level grammar automaton: `mask [S, V] bool`
+    (token allowed in state) and `table [S, V] i32` (next state). Built
+    by lifting a character DFA over a token vocabulary (`token_strs`:
+    token id -> its text); a token is allowed iff consuming its text
+    from the state stays inside the DFA. `eos_id` is allowed exactly in
+    accepting states (and keeps the state — the request retires on EOS
+    anyway). State 0 is the start state.
+
+    The engine applies `mask[state]` on-device inside the decode block
+    (packed [G, S, V] across the batch's distinct automatons) and the
+    HOST advances the authoritative state per emitted token at block
+    boundaries — the decode_block=K rhythm the ISSUE names. Dead states
+    cannot occur by construction (masked sampling only emits allowed
+    tokens), but `advance` clamps defensively."""
+
+    def __init__(self, table, mask, accept_states, eos_id):
+        self.table = np.asarray(table, np.int32)
+        self.mask = np.asarray(mask, bool)
+        self.accept_states = frozenset(int(s) for s in accept_states)
+        self.eos_id = int(eos_id)
+        assert self.table.shape == self.mask.shape
+
+    @property
+    def n_states(self):
+        return self.table.shape[0]
+
+    @property
+    def vocab(self):
+        return self.table.shape[1]
+
+    @classmethod
+    def from_pattern(cls, pat, token_strs, eos_id):
+        dfa = CharDFA.compile(pat)
+        S = len(dfa.step)
+        V = len(token_strs)
+        table = np.zeros((S, V), np.int32)
+        mask = np.zeros((S, V), bool)
+        for s in range(S):
+            for t, text in enumerate(token_strs):
+                if t == eos_id:
+                    ok = s in dfa.accept
+                    table[s, t] = s
+                    mask[s, t] = ok
+                    continue
+                if not text:
+                    continue
+                end = dfa.run(s, text)
+                if end is not None:
+                    table[s, t] = end
+                    mask[s, t] = True
+        return cls(table, mask, dfa.accept, eos_id)
+
+    @classmethod
+    def from_json_schema(cls, schema, token_strs, eos_id):
+        return cls.from_pattern(json_schema_pattern(schema), token_strs,
+                                eos_id)
+
+    @classmethod
+    def trivial(cls, vocab):
+        """The always-allow automaton (grammar id 0 in packed batches:
+        slots without a grammar ride it as an exact no-op)."""
+        return cls(np.zeros((1, vocab), np.int32),
+                   np.ones((1, vocab), bool), {0}, vocab - 1)
+
+    def allowed(self, state):
+        return self.mask[int(state)]
+
+    def advance(self, state, token):
+        s = int(state)
+        t = int(token)
+        if not (0 <= t < self.vocab) or not self.mask[s, t]:
+            return s            # defensive: stay (mask made this
+        return int(self.table[s, t])   # unreachable for device picks)
+
+    def accepts(self, state):
+        return int(state) in self.accept_states
+
+    def to_spec(self):
+        return {"table": self.table.tolist(), "mask": self.mask.tolist(),
+                "accept_states": sorted(self.accept_states),
+                "eos_id": self.eos_id}
+
+    @classmethod
+    def from_spec(cls, spec):
+        if isinstance(spec, TokenMaskAutomaton):
+            return spec
+        return cls(spec["table"], spec["mask"], spec["accept_states"],
+                   spec["eos_id"])

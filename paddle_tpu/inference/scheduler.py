@@ -39,6 +39,7 @@ import collections
 import contextlib
 import math
 import time
+import warnings
 
 import numpy as np
 import jax
@@ -49,6 +50,9 @@ from ..failsafe import armed as _faults_armed
 from ..profiler import RecordEvent as _RecordEvent
 from ..profiler import spans_active as _spans_active
 from .adapters import AdapterError, UnknownAdapterError
+from .sampling import (GREEDY, NEG, SamplingParams, TokenMaskAutomaton,
+                       apply_penalties, fold_keys, select_from_topk,
+                       stop_hit)
 from .serving import LLMEngine, EngineFullError, _rms, _mm
 from .speculative import resolve_drafter
 
@@ -171,11 +175,12 @@ class Request:
                  "pages_shared", "deadline", "ttl_steps", "born_step",
                  "error", "tenant", "priority", "draft_k",
                  "spec_drafted", "spec_accepted", "demote", "seated_step",
-                 "idle_steps", "adapter", "adapter_released")
+                 "idle_steps", "adapter", "adapter_released",
+                 "sampling", "counts", "gstate")
 
     def __init__(self, uid, ids, max_new_tokens, eos_token_id,
                  deadline=None, ttl_steps=None, born_step=0,
-                 tenant="default", priority=0, draft_k=0):
+                 tenant="default", priority=0, draft_k=0, sampling=None):
         self.uid = uid
         self.ids = ids                  # np.int64 [t0]
         self.t0 = int(ids.size)
@@ -218,6 +223,17 @@ class Request:
         self.adapter_released = False   # pool ref dropped (terminal
         #                                 transition ran); the NAME
         #                                 stays for salvage/export
+        self.sampling = sampling if sampling is not None else GREEDY
+        self.counts = {}                # token -> occurrences among
+        #                                 GENERATED tokens (the penalty
+        #                                 state; prompt tokens never
+        #                                 count). Survives preemption
+        #                                 (the ids-fold keeps `out`'s
+        #                                 history here) and rides
+        #                                 export_request for resume.
+        self.gstate = 0                 # grammar automaton state (host-
+        #                                 authoritative; advanced per
+        #                                 emitted token in _push_token)
 
 
 class PrefixCache:
@@ -404,13 +420,16 @@ class _FusedBlock:
     __slots__ = ("w", "K", "pf_items", "dec_items", "tables", "eos_dev",
                  "first", "toks", "emitted", "tok_fin", "lens_fin",
                  "act_fin", "rem_fin", "has_prefill", "has_decode",
-                 "chained", "dlens", "aid")
+                 "chained", "dlens", "aid", "mode", "extras")
 
     def __init__(self, w, K):
         self.w = w
         self.K = K
         self.pf_items = []          # [(Request, chunk-end position)]
         self.dec_items = []         # [Request]
+        self.mode = "greedy"        # _block_mode of the participants
+        self.extras = ()            # device sampling inputs (see
+        #                             _build_cb_fused; () in greedy)
         self.tables = None          # device [w, mp] (reused by chains)
         self.eos_dev = None         # device [w] eos ids (-1 = none)
         self.first = None           # device [w] first tokens (prefill)
@@ -499,10 +518,26 @@ class ContinuousBatchingEngine(LLMEngine):
         an unbounded backlog. None (default) = unbounded.
       default_deadline_ms: deadline applied to requests submitted
         without one (None = no deadline).
-      do_sample/temperature/top_k/top_p/seed: engine-level sampling for
-        step(); greedy (default) is deterministic per request and
-        byte-equivalent to LLMEngine.generate(). Sampled mode draws from
-        one engine-wide stream, so tokens depend on scheduling order.
+      do_sample/temperature/top_k/top_p/seed: DEPRECATED engine-level
+        sampling knobs — per-request `add_request(sampling=
+        SamplingParams(...))` is the first-class path (ISSUE 18). The
+        engine-level values now only form the DEFAULT SamplingParams a
+        request gets when it brings none; the engine seed is folded
+        with the request uid so even defaulted requests draw
+        per-request `(seed, position)` key streams (reproducible and
+        invariant to batch composition — NOT the old engine-wide
+        stream). Passing do_sample=True warns DeprecationWarning.
+      sample_k: size of the top-K survivor set every sampled selection
+        draws from (default 8; 1 <= sample_k <= 128). In whole-step
+        megakernel mode the set is computed by the in-kernel running
+        top-K merge and the [w, V] logits never materialize; top_p /
+        min_p act within the survivor set (exact whenever the nucleus
+        fits — docs/serving.md "Sampling & structured decoding").
+        A request's top_k must be <= sample_k.
+      sample_fold: False forces sampled selection through MATERIALIZED
+        logits + lax.top_k (the reference path; what decode_bench's
+        cb_sampling section measures against). Tokens are bit-identical
+        either way — the fold is a pure perf knob.
 
     Failure posture: a request that trips a fault (injected or real) at
     a per-request boundary — admission allocation, a prefill chunk, its
@@ -517,7 +552,8 @@ class ContinuousBatchingEngine(LLMEngine):
                  prefill_chunk=None, slot_buckets=None, prefix_cache=True,
                  queue_limit=None, default_deadline_ms=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                 seed=0, decode_block=1, ragged_kernel=None,
+                 seed=0, sample_k=8, sample_fold=True,
+                 decode_block=1, ragged_kernel=None,
                  megakernel=None, speculate=None, drafter="ngram",
                  spec_adaptive=True, tenants=None, kv_tier=None,
                  tier_dir=None, tier_host_cap_mb=None, oversubscribe=None,
@@ -622,9 +658,33 @@ class ContinuousBatchingEngine(LLMEngine):
                 w *= 2
         self._slot_buckets = tuple(sorted(
             {min(int(w), max_batch) for w in slot_buckets} | {max_batch}))
+        # DEPRECATED engine-global sampling tuple: now only the source
+        # of the per-request DEFAULT below (kept as an attribute for
+        # introspection parity with older code)
         self._sampling = (bool(do_sample), float(temperature), int(top_k),
                           float(top_p))
         self._key = jax.random.key(seed)
+        self.sample_k = int(sample_k)
+        if not 1 <= self.sample_k <= 128:
+            raise ValueError(
+                f"sample_k must be in [1, 128] (the in-kernel top-K "
+                f"fold rides the megakernel's [R, 128] select scratch), "
+                f"got {sample_k}")
+        self.sample_fold = bool(sample_fold)
+        self._engine_seed = int(seed) & 0xFFFFFFFF
+        if do_sample:
+            warnings.warn(
+                "engine-level do_sample/temperature/top_k/top_p are "
+                "deprecated: pass add_request(sampling=SamplingParams("
+                "...)) per request. The engine-level values now form a "
+                "per-request DEFAULT whose seed folds in the request "
+                "uid (a per-request key stream, not the old engine-wide "
+                "one).", DeprecationWarning, stacklevel=2)
+        if int(top_k) and int(top_k) > self.sample_k:
+            raise ValueError(
+                f"engine default top_k={top_k} exceeds sample_k="
+                f"{self.sample_k} — the sampled path selects from the "
+                "top-sample_k survivor set")
         self._prefix = PrefixCache(page_size) if prefix_cache else None
         self._drafter = (resolve_drafter(drafter, self._prefix)
                          if self._spec else None)
@@ -754,6 +814,12 @@ class ContinuousBatchingEngine(LLMEngine):
         self.spec_accepted_total = 0    # drafts accepted
         self.draft_errors = 0           # real (non-injected) drafter
         #                                 exceptions, degraded to dlen=0
+        self.sampled_requests = 0       # admitted with do_sample=True
+        self._spec_sampled_offered = 0  # drafts offered to SAMPLED
+        self._spec_sampled_accepted = 0  # verify passes / accepted
+        self._trivial_gram = None       # lazily-built always-allow
+        #                                 automaton (grammar id 0 in
+        #                                 packed proc batches)
         self._slot_used = [False] * max_batch
         # multi-LoRA adapter serving (inference/adapters.py): adapters=
         # {"rank": R, "max_adapters": N, "pool_pages": P, "page_elems":
@@ -794,9 +860,144 @@ class ContinuousBatchingEngine(LLMEngine):
             self._apool.place(self._tpc)
 
     # -- public ------------------------------------------------------------
+    def _default_sampling(self, uid):
+        """The SamplingParams a request gets when add_request carries
+        none: the deprecated engine-level knobs, with the engine seed
+        folded with the request uid (Knuth multiplicative hash) so even
+        defaulted sampled requests draw per-request key streams."""
+        dos, temp, tk, tp_ = self._sampling
+        if not dos:
+            return GREEDY
+        return SamplingParams(
+            do_sample=True, temperature=temp, top_k=tk, top_p=tp_,
+            seed=(self._engine_seed ^ ((uid * 2654435761) & 0xFFFFFFFF)))
+
+    @staticmethod
+    def _block_mode(requests):
+        """Compiled-program family a dispatch needs for these
+        participants: 'proc' when any request needs the materialized
+        logit-processor chain, 'sampled' when any samples, else
+        'greedy' (the untouched all-greedy program — no PRNG, no
+        extra inputs)."""
+        mode = "greedy"
+        for r in requests:
+            sp = r.sampling
+            if sp.needs_processors:
+                return "proc"
+            if sp.do_sample:
+                mode = "sampled"
+        return mode
+
+    def _row_params(self, rows, mode):
+        """Per-row sampling inputs for a 'sampled'/'proc' dispatch,
+        assembled FRESH from the participants each time (no persistent
+        per-slot state to seat/release): rows is a list of
+        Request-or-None, one entry per batch row; empty rows keep
+        neutral defaults and never emit. Returns the numpy arrays in
+        the exact order the compiled programs unpack them."""
+        n = len(rows)
+        seeds = np.zeros(n, np.uint32)
+        dos = np.zeros(n, bool)
+        temp = np.ones(n, np.float32)
+        tkk = np.zeros(n, np.int32)
+        tpp = np.ones(n, np.float32)
+        minp = np.zeros(n, np.float32)
+        for i, r in enumerate(rows):
+            if r is None:
+                continue
+            sp = r.sampling
+            seeds[i] = sp.seed
+            dos[i] = sp.do_sample
+            temp[i] = sp.temperature
+            tkk[i] = sp.top_k
+            tpp[i] = sp.top_p
+            minp[i] = sp.min_p
+        ex = [seeds, dos, temp, tkk, tpp, minp]
+        if mode == "proc":
+            V = self.cfg.vocab_size
+            rep = np.ones(n, np.float32)
+            pres = np.zeros(n, np.float32)
+            frq = np.zeros(n, np.float32)
+            counts = np.zeros((n, V), np.int32)
+            gid = np.zeros(n, np.int32)
+            gstate = np.zeros(n, np.int32)
+            if self._trivial_gram is None or \
+                    self._trivial_gram.vocab != V:
+                self._trivial_gram = TokenMaskAutomaton.trivial(V)
+            grams = [self._trivial_gram]   # gid 0 = no grammar
+            for i, r in enumerate(rows):
+                if r is None:
+                    continue
+                sp = r.sampling
+                rep[i] = sp.repetition_penalty
+                pres[i] = sp.presence_penalty
+                frq[i] = sp.frequency_penalty
+                for t, c in r.counts.items():
+                    counts[i, t] = c
+                if sp.grammar is not None:
+                    gid[i] = len(grams)
+                    grams.append(sp.grammar)
+                    gstate[i] = r.gstate
+            S = max(g.n_states for g in grams)
+            gtab = np.zeros((len(grams), S, V), np.int32)
+            gmask = np.zeros((len(grams), S, V), bool)
+            gmask[0] = True                # trivial: everything allowed
+            for i, g in enumerate(grams):
+                gtab[i, :g.n_states] = np.asarray(g.table)
+                gmask[i, :g.n_states] = np.asarray(g.mask)
+            ex += [rep, pres, frq, counts, gid, gstate, gtab, gmask]
+        return tuple(ex)
+
+    def _block_extras(self, blk):
+        """Device-resident sampling inputs for a fused block (order
+        matches _build_cb_fused's unpack; () for greedy blocks)."""
+        if blk.mode == "greedy":
+            return ()
+        rows = [None] * blk.w
+        for r, _end in blk.pf_items:
+            rows[r.slot] = r
+        for r in blk.dec_items:
+            rows[r.slot] = r
+        return tuple(jnp.asarray(a)
+                     for a in self._row_params(rows, blk.mode))
+
+    def _select_tokens(self, rows, positions, mode, logits=None,
+                       topv=None, topi=None):
+        """Host-side token selection for the per-step (decode_block=1)
+        and chunked-prefill paths: the SAME select_from_topk math the
+        fused scan compiles, applied eagerly to one dispatch's rows —
+        so per-step and fused engines emit bit-identical streams.
+        positions[i] is the absolute sequence position row i's new
+        token will occupy (= its PRNG counter). Pass either the
+        materialized logits or the decode math's folded (topv, topi)
+        candidate rows."""
+        if mode == "greedy":
+            return np.asarray(jnp.argmax(logits, axis=-1))
+        ex = self._row_params(rows, mode)
+        seeds, dos, temp, tkk, tpp, minp = ex[:6]
+        if logits is not None:
+            lg = jnp.asarray(logits)
+            if mode == "proc":
+                rep, pres, frq, counts, gid, gstate, gmask = (
+                    ex[6], ex[7], ex[8], ex[9], ex[10], ex[11], ex[13])
+                lg = apply_penalties(
+                    lg.astype(jnp.float32), jnp.asarray(counts),
+                    jnp.asarray(rep), jnp.asarray(pres),
+                    jnp.asarray(frq))
+                lg = jnp.where(jnp.asarray(gmask)[gid, gstate], lg, NEG)
+            topv, topi = jax.lax.top_k(lg, self.sample_k)
+            topv = topv.astype(jnp.float32)
+            topi = topi.astype(jnp.int32)
+        keys = fold_keys(jnp.asarray(seeds),
+                         jnp.asarray(np.asarray(positions, np.int32)))
+        toks = select_from_topk(topv, topi, keys, jnp.asarray(dos),
+                                jnp.asarray(temp), jnp.asarray(tkk),
+                                jnp.asarray(tpp), jnp.asarray(minp))
+        return np.asarray(toks)
+
     def add_request(self, ids, max_new_tokens=32, eos_token_id=None,
                     deadline_ms=None, ttl_steps=None, tenant=None,
-                    priority=None, adapter=None):
+                    priority=None, adapter=None, sampling=None):
         """Queue one prompt (1-D int sequence). Returns a request uid.
 
         adapter: name of a loaded LoRA adapter (inference/adapters.py)
@@ -814,6 +1015,18 @@ class ContinuousBatchingEngine(LLMEngine):
           record (queued requests are shed without ever running).
         ttl_steps: the same contract counted in ENGINE STEPS instead of
           wall time — deterministic, the form chaos tests use.
+        sampling: a SamplingParams (inference/sampling.py) — or a
+          to_spec() dict — giving THIS request's sampling behavior:
+          do_sample/temperature/top_k/top_p/min_p under a per-request
+          `(seed, position)` key stream (reproducible regardless of
+          batch composition, decode_block, preemption, failover or tp),
+          repetition/presence/frequency penalties, stop sequences, and
+          grammar-constrained decoding (TokenMaskAutomaton). None takes
+          the engine default (greedy unless the deprecated engine-level
+          do_sample was set). Mixed greedy/sampled batches are
+          first-class. Penalties/grammar require the materialized
+          processor path and cannot compose with speculate= (typed
+          ValueError here, not a silent fallback).
         tenant: admission-policy tenant name (fair-share virtual time is
           tracked per tenant; unregistered tenants get share 1.0).
         priority: admission priority (higher first, strict); defaults to
@@ -844,6 +1057,25 @@ class ContinuousBatchingEngine(LLMEngine):
                 "later or raise queue_limit")
         if adapter is not None:
             self._resolve_adapter(adapter)   # raises typed; may hot-load
+        sp = (SamplingParams.from_spec(sampling) if sampling is not None
+              else self._default_sampling(self._next_uid))
+        if sp.do_sample and sp.top_k > self.sample_k:
+            raise ValueError(
+                f"sampling.top_k={sp.top_k} exceeds this engine's "
+                f"sample_k={self.sample_k} — the sampled path selects "
+                "from the top-sample_k survivor set (raise sample_k= "
+                "at engine build)")
+        if self._spec and sp.needs_processors:
+            raise ValueError(
+                "logit processors (penalties / grammar) do not compose "
+                "with speculate= — the verify pass scores positions "
+                "whose processor state depends on in-pass emissions; "
+                "run this request on a non-speculative engine")
+        if sp.grammar is not None and \
+                sp.grammar.vocab != self.cfg.vocab_size:
+            raise ValueError(
+                f"grammar automaton vocab {sp.grammar.vocab} != model "
+                f"vocab {self.cfg.vocab_size}")
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
         deadline = (time.monotonic() + deadline_ms / 1e3
@@ -855,7 +1087,10 @@ class ContinuousBatchingEngine(LLMEngine):
                     deadline=deadline,
                     ttl_steps=None if ttl_steps is None else int(ttl_steps),
                     born_step=self.steps, tenant=tenant, priority=priority,
-                    draft_k=max(1, self._spec - 1) if self._spec else 0)
+                    draft_k=max(1, self._spec - 1) if self._spec else 0,
+                    sampling=sp)
+        if sp.do_sample:
+            self.sampled_requests += 1
         if adapter is not None:
             self._apool.acquire(adapter)
             r.adapter = adapter
@@ -1117,6 +1352,16 @@ class ContinuousBatchingEngine(LLMEngine):
                 self.spec_emitted / self.spec_passes
                 if self.spec_passes else 0.0),
             "draft_errors": self.draft_errors,
+            # on-device sampling: per-request sampled admissions, the
+            # candidate-fold width, whether the in-kernel fold is on,
+            # and sampled speculation's own acceptance rate (its
+            # ceiling is set by temperature, unlike the greedy rate)
+            "sampled_requests": self.sampled_requests,
+            "sample_k": self.sample_k,
+            "sample_fold": self.sample_fold,
+            "spec_sampled_accept_rate": (
+                self._spec_sampled_accepted / self._spec_sampled_offered
+                if self._spec_sampled_offered else 0.0),
             # disaggregated prefill/decode: KV-page handoffs through
             # this engine (docs/serving.md)
             "handoffs_out": self.handoffs_out,
@@ -1633,7 +1878,9 @@ class ContinuousBatchingEngine(LLMEngine):
         # then sample the first token from the final chunk's logits
         self._publish_prefix(r)
         t_dev = time.perf_counter()
-        tok = self._sample_tokens(logits)[0]
+        # the first generated token enters position t0 — its counter
+        tok = self._select_tokens([r], [r.t0], self._block_mode([r]),
+                                  logits=logits)[0]
         self.dispatch_seconds += time.perf_counter() - t_dev
         self._lens_np[r.slot] = r.t0
         r.state = DECODE
@@ -1948,28 +2195,36 @@ class ContinuousBatchingEngine(LLMEngine):
                 W["mk_head"] = mk_head
 
     def _mk_walk(self, W, h, k_pages_all, v_pages_all, tables, lens,
-                 act_i, cos_sel, sin_sel, tq=1, wmask=None):
+                 act_i, cos_sel, sin_sel, tq=1, wmask=None, head_k=None):
         """The megakernel layer walk shared by plain decode (tq=1) and
         the speculative verify pass (tq=T): runs the whole stack as one
         invocation ("multi", tp=1), per-layer invocations ("layer",
         tp=1), or the per-shard qkv/tail/down SEGMENTS with exact-mode
         gathers between them (tp>1). Returns (h, k_rows, v_rows, tok,
-        logits_local): tok/logits are None unless the whole-step head
-        fold ran (then tok is the combined GLOBAL greedy argmax and
-        logits_local this shard's vocab columns)."""
+        maxv, logits_local): tok/maxv/logits are None unless the
+        whole-step head fold ran. head_k=None (greedy): tok is the
+        combined GLOBAL greedy argmax, maxv its logit, logits_local
+        this shard's vocab columns. head_k=K>1 (the sampling fold):
+        tok/maxv become the GLOBAL [rows, K] top-K (ids, f32 logits) —
+        combined across vocab shards gather-free — and logits_local is
+        None: the kernel drops the [R, V] output entirely."""
         from ..ops.pallas.decode_megakernel import decode_megakernel
         kw = dict(nh=self.nh_l, nh_kv=self.nh_kv_l, hd=self.hd,
                   eps=self.cfg.rms_norm_eps, interpret=self.interpret)
         head = W.get("mk_head") if self._mk_head else None
         head_v = self._mk_vl
+        fold = head is not None and head_k is not None and head_k > 1
         tok = maxv = logits = None
         if self.tp == 1:
             if self.megakernel == "multi":
                 out = decode_megakernel(
                     h, W["mk"], k_pages_all, v_pages_all, tables, lens,
                     act_i, cos_sel, sin_sel, tq=tq, wmask=wmask,
-                    head=head, head_v=head_v if head else None, **kw)
-                if head is not None:
+                    head=head, head_v=head_v if head else None,
+                    head_k=head_k if fold else None, **kw)
+                if fold:
+                    h, k_all, v_all, tok, maxv = out
+                elif head is not None:
                     h, k_all, v_all, tok, maxv, logits = out
                 else:
                     h, k_all, v_all = out
@@ -2011,19 +2266,33 @@ class ContinuousBatchingEngine(LLMEngine):
                     h, mset, seg="tail", attn_in=attn_f, mlp_v=Fl, **kw)
                 act_f = self._tpc.gather_cols(act_l)
                 if li == L - 1 and head is not None:
-                    h, tok, maxv, logits = decode_megakernel(
-                        h, mset, seg="down", act_in=act_f, head=head,
-                        head_v=head_v, **kw)
+                    if fold:
+                        h, tok, maxv = decode_megakernel(
+                            h, mset, seg="down", act_in=act_f,
+                            head=head, head_v=head_v, head_k=head_k,
+                            **kw)
+                    else:
+                        h, tok, maxv, logits = decode_megakernel(
+                            h, mset, seg="down", act_in=act_f,
+                            head=head, head_v=head_v, **kw)
                 else:
                     h = decode_megakernel(h, mset, seg="down",
                                           act_in=act_f, **kw)
             if tok is not None:
-                # vocab-parallel whole-step select: combine the shards'
-                # (max, argmax) pairs psum-free — bitwise equal to
-                # argmax over the full gathered logits
-                tok = self._tpc.argmax_of_local_max(maxv, tok,
-                                                    self._mk_vl)
-        return h, k_all, v_all, tok, logits
+                if fold:
+                    # vocab-parallel sampling fold: combine the shards'
+                    # LOCAL top-K pairs gather-free — bitwise equal to
+                    # lax.top_k over the full gathered logits (shard-
+                    # major concat keeps the id-asc tie order)
+                    maxv, tok = self._tpc.topk_of_local_topk(
+                        maxv, tok, self._mk_vl, head_k)
+                else:
+                    # vocab-parallel whole-step select: combine the
+                    # shards' (max, argmax) pairs psum-free — bitwise
+                    # equal to argmax over the full gathered logits
+                    tok = self._tpc.argmax_of_local_max(maxv, tok,
+                                                        self._mk_vl)
+        return h, k_all, v_all, tok, maxv, logits
 
     def _mk_scatter(self, k_pages_all, v_pages_all, k_all, v_all,
                     slots_raw, ok):
@@ -2077,7 +2346,7 @@ class ContinuousBatchingEngine(LLMEngine):
         return (put_all(k_pages_all, k_all), put_all(v_pages_all, v_all))
 
     def _cb_decode_math_mk(self, W, tok, k_pages_all, v_pages_all,
-                           tables, lens, active, w):
+                           tables, lens, active, w, topk=None):
         """Megakernel decode step: each layer (or, in "multi" mode, the
         whole stack PLUS the final norm, lm_head and greedy argmax)
         runs as ONE Pallas invocation — matmuls, norms, rope and paged
@@ -2085,18 +2354,30 @@ class ContinuousBatchingEngine(LLMEngine):
         attends with the current token's k/v substituted into its page
         block and returns them for the SAME scatter the op-chain path
         performs, so the page pool contents stay byte-identical between
-        the two paths."""
+        the two paths.
+
+        topk=K (the sampling fold): returns (topv [w, K] f32, topi
+        [w, K] i32, new_k, new_v) from the kernel's in-kernel running
+        top-K merge — the [w, V] logits never exist (whole-step mode);
+        "layer" mode and the no-head fallback materialize + lax.top_k
+        (same bits — the fold is selection only)."""
         p = self.page_size
         h = jnp.take(W["emb"], tok, axis=0).astype(self.kv_dtype)  # [w, H]
         cos_sel = W["cos"][lens].astype(h.dtype)
         sin_sel = W["sin"][lens].astype(h.dtype)
         slots_raw = (tables[jnp.arange(w), lens // p] * p + lens % p)
         act_i = active.astype(jnp.int32)
-        h, k_all, v_all, tok_g, loc = self._mk_walk(
+        h, k_all, v_all, tok_g, maxv, loc = self._mk_walk(
             W, h, k_pages_all, v_pages_all, tables, lens, act_i,
-            cos_sel, sin_sel)
+            cos_sel, sin_sel, head_k=topk)
         new_k, new_v = self._mk_scatter(k_pages_all, v_pages_all,
                                         k_all, v_all, slots_raw, active)
+        if topk is not None:
+            if tok_g is None:      # "layer" mode / head fold off
+                hN = _rms(h[:, None], W["norm"], W["eps"])
+                loc = _mm(hN, W["head"], self.interpret)[:, 0]
+                maxv, tok_g = self._tp_topk(loc, topk)
+            return maxv, tok_g, new_k, new_v
         if loc is None:
             hN = _rms(h[:, None], W["norm"], W["eps"])
             loc = _mm(hN, W["head"], self.interpret)[:, 0]
@@ -2104,7 +2385,7 @@ class ContinuousBatchingEngine(LLMEngine):
         return self._gather_logits(loc), tok_g, new_k, new_v
 
     def _cb_decode_math(self, W, tok, k_pages_all, v_pages_all, tables,
-                        lens, active, w, ad=None):
+                        lens, active, w, ad=None, topk=None):
         """One decode step at slot-bucket width w, fully traceable
         (shared by the per-step jit and the fused multi-step scan, so
         both paths run byte-identical math): one token for every slot,
@@ -2123,11 +2404,19 @@ class ContinuousBatchingEngine(LLMEngine):
         (gathered under a vocab-parallel head — unused consumers are
         DCE'd), tok the greedy argmax token (what the whole-step kernel
         emits directly; computed psum-free under tp). Greedy callers
-        use tok, sampled callers logits — bitwise the same choice."""
+        use tok, sampled callers logits — bitwise the same choice.
+
+        topk=K (sampled fold): returns (topv [w, K], topi [w, K],
+        new_k, new_v) instead — the per-row top-K logits and vocab ids
+        in lax.top_k order (value desc, id asc on ties). Under the
+        whole-step megakernel these come from the IN-KERNEL running
+        top-K merge and the [w, V] logits are never materialized; the
+        op-chain path computes lax.top_k of the same logits (the fold
+        is selection-only, so both are bitwise identical)."""
         if self.megakernel and ad is None:
             return self._cb_decode_math_mk(W, tok, k_pages_all,
                                            v_pages_all, tables, lens,
-                                           active, w)
+                                           active, w, topk=topk)
         AD, aid = ad if ad is not None else (None, None)
         p = self.page_size
         h = jnp.take(W["emb"], tok[:, None], axis=0).astype(
@@ -2158,13 +2447,18 @@ class ContinuousBatchingEngine(LLMEngine):
             h = self._layer_tail(W, wset, h, attn[:, None], ad=ad_li)
         h = _rms(h, W["norm"], W["eps"])
         loc = _mm(h, W["head"], self.interpret)[:, 0]
+        if topk is not None:
+            topv, topi = self._tp_topk(loc, topk)
+            return (topv, topi,
+                    _pools_result(k_pages_all, new_k),
+                    _pools_result(v_pages_all, new_v))
         return (self._gather_logits(loc), self._tp_greedy_token(loc),
                 _pools_result(k_pages_all, new_k),
                 _pools_result(v_pages_all, new_v))
 
     def _cb_spec_verify_math(self, W, feed, k_pages_all, v_pages_all,
                              tables, lens, active, rem, dlen, w,
-                             ad=None):
+                             ad=None, topk=None):
         """ONE speculative VERIFY pass at slot width w: slot b feeds T
         tokens (its pending token + up to T-1 drafts) at global
         positions lens[b] + [0, T), writing their KV length-gated and
@@ -2191,11 +2485,13 @@ class ContinuousBatchingEngine(LLMEngine):
         — verify rows carry the SLOT's adapter (every feed position of
         slot b shares aid[b]), riding the op-chain delta exactly like
         plain decode (megakernel engines fall back here for adapter
-        batches)."""
+        batches). topk=K: returns (topv [w, T, K], topi [w, T, K],
+        new_k, new_v) per feed position — same fold contract as
+        _cb_decode_math(topk=K)."""
         if self.megakernel and ad is None:
             return self._cb_spec_verify_math_mk(
                 W, feed, k_pages_all, v_pages_all, tables, lens, active,
-                rem, dlen, w)
+                rem, dlen, w, topk=topk)
         AD, aid = ad if ad is not None else (None, None)
         p = self.page_size
         T = feed.shape[1]
@@ -2233,12 +2529,18 @@ class ContinuousBatchingEngine(LLMEngine):
             h = self._layer_tail(W, wset, h, attn, ad=ad_li)
         h = _rms(h, W["norm"], W["eps"])
         loc = _mm(h, W["head"], self.interpret)
+        if topk is not None:
+            topv, topi = self._tp_topk(loc, topk)
+            return (topv, topi,
+                    _pools_result(k_pages_all, new_k),
+                    _pools_result(v_pages_all, new_v))
         return (self._gather_logits(loc), self._tp_greedy_token(loc),
                 _pools_result(k_pages_all, new_k),
                 _pools_result(v_pages_all, new_v))
 
     def _cb_spec_verify_math_mk(self, W, feed, k_pages_all, v_pages_all,
-                                tables, lens, active, rem, dlen, w):
+                                tables, lens, active, rem, dlen, w,
+                                topk=None):
         """The verify pass on the MEGAKERNEL's tq>1 schedule: feed rows
         flatten slot-major into the matmul phases, the ATTN phase runs
         the ragged kernel's causal mask with every WRITE-GATED feed
@@ -2262,14 +2564,22 @@ class ContinuousBatchingEngine(LLMEngine):
         cos_sel = W["cos"][pos_c.reshape(-1)].astype(h.dtype)
         sin_sel = W["sin"][pos_c.reshape(-1)].astype(h.dtype)
         wm = write_ok.reshape(R).astype(jnp.int32)
-        h, k_all, v_all, tok_g, loc = self._mk_walk(
+        h, k_all, v_all, tok_g, maxv, loc = self._mk_walk(
             W, h, k_pages_all, v_pages_all, tables, lens,
-            active.astype(jnp.int32), cos_sel, sin_sel, tq=T, wmask=wm)
+            active.astype(jnp.int32), cos_sel, sin_sel, tq=T, wmask=wm,
+            head_k=topk)
         slots_raw = (tables[jnp.arange(w)[:, None], pos_c // p] * p
                      + pos_c % p).reshape(R)
         new_k, new_v = self._mk_scatter(k_pages_all, v_pages_all,
                                         k_all, v_all, slots_raw,
                                         write_ok.reshape(R))
+        if topk is not None:
+            if tok_g is None:      # "layer" mode / head fold off
+                hN = _rms(h[:, None], W["norm"], W["eps"])
+                loc = _mm(hN, W["head"], self.interpret)[:, 0]
+                maxv, tok_g = self._tp_topk(loc, topk)
+            return (maxv.reshape(w, T, -1), tok_g.reshape(w, T, -1),
+                    new_k, new_v)
         if loc is None:
             hN = _rms(h[:, None], W["norm"], W["eps"])
             loc = _mm(hN, W["head"], self.interpret)[:, 0]
@@ -2278,11 +2588,24 @@ class ContinuousBatchingEngine(LLMEngine):
         return (logits.reshape(w, T, -1), tok_g.reshape(w, T),
                 new_k, new_v)
 
-    def _build_cb_step(self, w, with_adapters=False):
+    def _build_cb_step(self, w, with_adapters=False, mode="greedy"):
+        # "sampled" under sample_fold returns the folded top-sample_k
+        # candidate rows instead of logits — under the whole-step
+        # megakernel the [w, V] row never materializes even at
+        # decode_block=1. "proc" (and the materialized sampled arm)
+        # keeps the logits return; the host runs the processor chain +
+        # select eagerly (_select_tokens) — same math, same bits.
+        fold = mode == "sampled" and self.sample_fold
+        sK = self.sample_k
+
         def step(W, tok, k_pages_all, v_pages_all, tables, lens, active):
-            logits, _tok, kps, vps = self._cb_decode_math(
+            out = self._cb_decode_math(
                 W, tok, k_pages_all, v_pages_all, tables, lens, active,
-                w)
+                w, topk=sK if fold else None)
+            if fold:
+                topv, topi, kps, vps = out
+                return topv, topi, kps, vps
+            logits, _tok, kps, vps = out
             return logits, kps, vps
 
         def step_ad(W, AD, aid, tok, k_pages_all, v_pages_all, tables,
@@ -2303,7 +2626,8 @@ class ContinuousBatchingEngine(LLMEngine):
                                 donate_argnums=(4, 5))
         return self._jit_tp(step,
                             in_specs=(Wsp, R, POOL, POOL, R, R, R),
-                            out_specs=(R, POOL, POOL),
+                            out_specs=((R, R, POOL, POOL) if fold
+                                       else (R, POOL, POOL)),
                             donate_argnums=(2, 3))
 
     def _decode_step(self, decodes):
@@ -2319,11 +2643,14 @@ class ContinuousBatchingEngine(LLMEngine):
         for r in decodes:
             if r.slot < w:
                 active[r.slot] = True
+        mode = self._block_mode(decodes)
         aid = self._slot_aid(decodes, w)
+        fold = mode == "sampled" and self.sample_fold and aid is None
         if aid is not None:
             # adapter-carrying batch: the ADAPTER-AWARE program (the
             # plain program stays untouched — and with megakernel= on,
-            # this dispatch IS the documented op-chain fallback)
+            # this dispatch IS the documented op-chain fallback; same
+            # for the sampling fold, which keeps the materialized arm)
             if self.megakernel:
                 self.adapter_mk_fallbacks += 1
             fn = self._cb_step_ad_fns.get(w)
@@ -2332,18 +2659,31 @@ class ContinuousBatchingEngine(LLMEngine):
                 self._cb_step_ad_fns[w] = fn
             args = (self.weights, self._apool.device, jnp.asarray(aid))
         else:
-            fn = self._cb_step_fns.get(w)
+            fn = self._cb_step_fns.get((w, mode))
             if fn is None:
-                fn = self._build_cb_step(w)
-                self._cb_step_fns[w] = fn
+                fn = self._build_cb_step(w, mode=mode)
+                self._cb_step_fns[(w, mode)] = fn
             args = (self.weights,)
+        # the new token of the row fed at position lens occupies
+        # position lens+1 — its PRNG counter (BEFORE the increment)
+        positions = self._lens_np[:w] + 1
+        rows = [None] * w
+        for r in decodes:
+            rows[r.slot] = r
         t_dev = time.perf_counter()
         with _prof_span("cb.decode_step"):
-            logits, self.k_pages, self.v_pages = fn(
+            out = fn(
                 *args, jnp.asarray(self._tok_np[:w]), self.k_pages,
                 self.v_pages, jnp.asarray(self._tables_np[:w]),
                 jnp.asarray(self._lens_np[:w]), jnp.asarray(active))
-            toks = self._sample_tokens(logits)
+            if fold:
+                topv, topi, self.k_pages, self.v_pages = out
+                toks = self._select_tokens(rows, positions, mode,
+                                           topv=topv, topi=topi)
+            else:
+                logits, self.k_pages, self.v_pages = out
+                toks = self._select_tokens(rows, positions, mode,
+                                           logits=logits)
         self.dispatch_seconds += time.perf_counter() - t_dev
         for r in decodes:
             self._lens_np[r.slot] += 1
@@ -2376,7 +2716,7 @@ class ContinuousBatchingEngine(LLMEngine):
         return False
 
     def _build_cb_fused(self, w, with_prefill, with_decode,
-                        with_adapters=False):
+                        with_adapters=False, mode="greedy"):
         """ONE compiled program for a whole scheduling block at slot
         width w: a ragged prefill phase — every prefilling slot advances
         one chunk at its OWN offset, in one dispatch — followed by
@@ -2387,16 +2727,40 @@ class ContinuousBatchingEngine(LLMEngine):
         (which the next block can consume WITHOUT a host round trip —
         see _chain_block).
 
+        mode selects the per-block sampling program (see _block_mode):
+
+        * "greedy"  — no extra inputs; tokens are the decode math's own
+          argmax. No PRNG anywhere in the program.
+        * "sampled" — six extra [w] arrays ride after eos_ids (seeds
+          u32, do_sample bool, temperature/top_p/min_p f32, top_k i32).
+          Tokens come from select_from_topk over the top-sample_k
+          (value, id) rows — under sample_fold the IN-KERNEL fold, so
+          the [w, V] logits are never materialized; otherwise
+          lax.top_k of the materialized logits (bitwise-identical
+          candidates either way). Every token's key is
+          fold_in(key(seed), absolute_position) — no split chain, so
+          the stream is invariant to batch composition, block size and
+          megakernel mode.
+        * "proc"    — the sampled inputs plus penalty/grammar state
+          (repetition/presence/frequency [w] f32, counts [w, V] i32,
+          grammar id/state [w] i32 and the stacked [G, S, V] automaton
+          table/mask). Logits materialize in f32, ride the processor
+          chain (penalties, then the grammar mask), then the same
+          top-k select. counts/gstate advance in the scan carry;
+          their final values are DISCARDED — the host recomputes them
+          authoritatively in _push_token.
+
         Ragged prefill attention: the Pallas ragged kernel
         (per-slot q_start/ctx_len scalar prefetch) on TPU; under
         interpret/CPU the dense gathered form, which is what stays
         byte-identical to the per-step engine's chunk prefill."""
-        from ..models.generation import _sample
         chunk = self.prefill_chunk
         K = self.decode_block
         p = self.page_size
         mp = self.max_pages_per_seq
-        do_sample, temperature, top_k, top_p = self._sampling
+        sK = self.sample_k
+        sfold = self.sample_fold
+        NEX = {"greedy": 0, "sampled": 6, "proc": 14}[mode]
         use_kernel = (self.ragged_kernel is True) or \
             (self.ragged_kernel is None and not self.interpret)
 
@@ -2456,22 +2820,56 @@ class ContinuousBatchingEngine(LLMEngine):
                     _pools_result(v_pages_all, new_v))
 
         def decode_scan(W, k_pages_all, v_pages_all, tables, tok, lens,
-                        act, rem, eos_ids, key, ad=None):
+                        act, rem, eos_ids, ex, ad=None):
+            proc = mode == "proc"
+            if proc:
+                (seeds, dos, temp, tkk, tpp, minp, rep, pres, frq,
+                 counts0, gid, gstate0, gtab, gmask) = ex
+            elif mode == "sampled":
+                seeds, dos, temp, tkk, tpp, minp = ex
+
             def body(carry, _):
-                tok, lens, act, rem, key, kps, vps = carry
-                logits, gtok, kps, vps = self._cb_decode_math(
-                    W, tok, kps, vps, tables, lens, act, w, ad=ad)
-                key, sub = jax.random.split(key)
-                if do_sample:
-                    nxt = _sample(logits, sub, True, temperature,
-                                  top_k, top_p)
+                if proc:
+                    tok, lens, act, rem, counts, gstate, kps, vps = carry
                 else:
+                    tok, lens, act, rem, kps, vps = carry
+                if mode == "sampled" and sfold and ad is None:
+                    # the sampling fold: top-sample_k (value, id) rows
+                    # straight from the decode math — under the whole-
+                    # step megakernel the IN-KERNEL running merge, so
+                    # the [w, V] logits are never materialized
+                    topv, topi, kps, vps = self._cb_decode_math(
+                        W, tok, kps, vps, tables, lens, act, w, topk=sK)
+                    gtok = None
+                else:
+                    logits, gtok, kps, vps = self._cb_decode_math(
+                        W, tok, kps, vps, tables, lens, act, w, ad=ad)
+                if mode == "greedy":
                     # the greedy token came out of the decode math
                     # itself (whole-step mode: the kernel's running
                     # argmax; tp: argmax-of-local-max) — bitwise equal
                     # to argmax over the gathered logits, which DCE
                     # then prunes from the compiled scan
                     nxt = gtok
+                else:
+                    if proc:
+                        lg = apply_penalties(
+                            logits.astype(jnp.float32), counts,
+                            rep, pres, frq)
+                        lg = jnp.where(gmask[gid, gstate], lg, NEG)
+                        topv, topi = jax.lax.top_k(lg, sK)
+                        topi = topi.astype(jnp.int32)
+                    elif gtok is not None:
+                        # materialized arm (sample_fold off / adapter
+                        # fallback) — bitwise the fold's candidates
+                        topv, topi = jax.lax.top_k(logits, sK)
+                        topv = topv.astype(jnp.float32)
+                        topi = topi.astype(jnp.int32)
+                    # counter-based stream: the token entering position
+                    # lens+1 is ALWAYS drawn with fold_in(seed, lens+1)
+                    nxt = select_from_topk(
+                        topv, topi, fold_keys(seeds, lens + 1), dos,
+                        temp, tkk, tpp, minp)
                 nxt = jnp.where(act, nxt.astype(tok.dtype), tok)
                 emit = act
                 rem = jnp.where(act, rem - 1, rem)
@@ -2482,12 +2880,25 @@ class ContinuousBatchingEngine(LLMEngine):
                 # compute/DMA for the REST of the block
                 act = jnp.logical_and(
                     act, jnp.logical_and(rem > 0, nxt != eos_ids))
-                return (nxt, lens, act, rem, key, kps, vps), (nxt, emit)
+                if proc:
+                    counts = counts.at[jnp.arange(w), nxt].add(
+                        jnp.where(emit, jnp.int32(1), jnp.int32(0)))
+                    gstate = jnp.where(emit, gtab[gid, gstate, nxt],
+                                       gstate)
+                    return ((nxt, lens, act, rem, counts, gstate,
+                             kps, vps), (nxt, emit))
+                return (nxt, lens, act, rem, kps, vps), (nxt, emit)
 
-            carry0 = (tok, lens, act, rem, key, k_pages_all, v_pages_all)
-            (tok, lens, act, rem, key, kps, vps), (toks, emitted) = \
-                jax.lax.scan(body, carry0, None, length=K)
-            return toks, emitted, tok, lens, act, rem, key, kps, vps
+            if proc:
+                carry0 = (tok, lens, act, rem, counts0, gstate0,
+                          k_pages_all, v_pages_all)
+                (tok, lens, act, rem, _, _, kps, vps), (toks, emitted) \
+                    = jax.lax.scan(body, carry0, None, length=K)
+            else:
+                carry0 = (tok, lens, act, rem, k_pages_all, v_pages_all)
+                (tok, lens, act, rem, kps, vps), (toks, emitted) = \
+                    jax.lax.scan(body, carry0, None, length=K)
+            return toks, emitted, tok, lens, act, rem, kps, vps
 
         T = self._spec                  # verify width (0 = spec off)
         iT = (jnp.arange(T, dtype=jnp.int32)[None, :] if T else None)
@@ -2495,7 +2906,7 @@ class ContinuousBatchingEngine(LLMEngine):
               if T else None)
 
         def spec_scan(W, k_pages_all, v_pages_all, tables, tok, lens,
-                      act, rem, eos_ids, key, drafts, dlen, ad=None):
+                      act, rem, eos_ids, ex, drafts, dlen, ad=None):
             """K VERIFY passes with accept/reject inside the scan
             carries: each pass feeds [tok, drafts_s] (T tokens), samples
             the target's token at every position, and commits the
@@ -2507,19 +2918,50 @@ class ContinuousBatchingEngine(LLMEngine):
             continuation offers fewer — possibly zero — drafts in later
             passes; zero-padding is never counted as an offered draft).
             Outputs [K, w, T] tokens + an emitted mask; the host replays
-            them through the same `_push_token` path."""
+            them through the same `_push_token` path.
+
+            Sampled verify is SAMPLE-AND-MATCH: the target's token g_j
+            at feed position j is drawn with the position key
+            fold_in(seed, lens+1+j) — the SAME key the unspeculated
+            stream would use for that position — and draft j is
+            accepted iff it EQUALS g_j. That is rejection sampling for
+            the q=delta(draft) proposal (accept prob = p(draft); the
+            emitted token is distributed exactly p either way), and it
+            makes the committed stream byte-identical to the
+            unspeculated sampled stream at the same key schedule.
+            ("proc" never reaches here — speculation + processors is
+            rejected at add_request.)"""
+            if mode == "sampled":
+                seeds, dos, temp, tkk, tpp, minp = ex
+
+                def bt(a):             # [w] -> [w*T] slot-major
+                    return jnp.broadcast_to(
+                        a[:, None], (w, T)).reshape(-1)
 
             def body(carry, xs):
                 drafts_s, dlen_s = xs
-                tok, lens, act, rem, key, kps, vps = carry
+                tok, lens, act, rem, kps, vps = carry
                 feed = jnp.concatenate([tok[:, None], drafts_s], axis=1)
-                logits, gtok, kps, vps = self._cb_spec_verify_math(
-                    W, feed, kps, vps, tables, lens, act, rem, dlen_s, w,
-                    ad=ad)
-                key, sub = jax.random.split(key)
-                if do_sample:
-                    g = _sample(logits.reshape(w * T, -1), sub, True,
-                                temperature, top_k, top_p)
+                if mode == "sampled" and sfold and ad is None:
+                    topv, topi, kps, vps = self._cb_spec_verify_math(
+                        W, feed, kps, vps, tables, lens, act, rem,
+                        dlen_s, w, topk=sK)
+                    gtok = None
+                else:
+                    logits, gtok, kps, vps = self._cb_spec_verify_math(
+                        W, feed, kps, vps, tables, lens, act, rem,
+                        dlen_s, w, ad=ad)
+                if mode == "sampled":
+                    if gtok is not None:
+                        topv, topi = jax.lax.top_k(logits, sK)
+                        topv = topv.astype(jnp.float32)
+                        topi = topi.astype(jnp.int32)
+                    pos = (lens[:, None] + jnp.int32(1) + iT).reshape(-1)
+                    g = select_from_topk(
+                        topv.reshape(w * T, -1),
+                        topi.reshape(w * T, -1),
+                        fold_keys(bt(seeds), pos), bt(dos), bt(temp),
+                        bt(tkk), bt(tpp), bt(minp))
                     g = g.reshape(w, T).astype(tok.dtype)
                 else:
                     g = gtok.astype(tok.dtype)
@@ -2559,72 +3001,94 @@ class ContinuousBatchingEngine(LLMEngine):
                 act = jnp.logical_and(
                     act, jnp.logical_and(rem > 0,
                                          jnp.logical_not(hit_eos)))
-                return (nxt, lens, act, rem, key, kps, vps), (g, emit)
+                return (nxt, lens, act, rem, kps, vps), (g, emit)
 
-            carry0 = (tok, lens, act, rem, key, k_pages_all, v_pages_all)
-            (tok, lens, act, rem, key, kps, vps), (toks, emitted) = \
+            carry0 = (tok, lens, act, rem, k_pages_all, v_pages_all)
+            (tok, lens, act, rem, kps, vps), (toks, emitted) = \
                 jax.lax.scan(body, carry0,
                              (drafts, dlen))   # [K,w,T-1] / [K,w]
-            return toks, emitted, tok, lens, act, rem, key, kps, vps
+            return toks, emitted, tok, lens, act, rem, kps, vps
 
         def fused(W, k_pages_all, v_pages_all, tables, pf_ids, pf_act,
-                  pf_start, pf_end, tok, lens, act, rem, eos_ids, key,
-                  drafts=None, dlen=None, ad=None):
+                  pf_start, pf_end, tok, lens, act, rem, eos_ids,
+                  *rest, ad=None):
+            ex = rest[:NEX]
+            drafts, dlen = ((rest[NEX], rest[NEX + 1]) if T
+                            else (None, None))
             first = toks = emitted = None
             if with_prefill:
                 pf_logits, k_pages_all, v_pages_all = prefill_phase(
                     W, pf_ids, k_pages_all, v_pages_all, tables,
                     pf_start, pf_end, pf_act, ad=ad)
-                key, sub = jax.random.split(key)
-                first = _sample(pf_logits, sub, do_sample, temperature,
-                                top_k, top_p)
+                if mode == "greedy":
+                    first = jnp.argmax(pf_logits, axis=-1)
+                else:
+                    seeds, dos, temp, tkk, tpp, minp = ex[:6]
+                    lg = pf_logits
+                    if mode == "proc":
+                        rep, pres, frq = ex[6:9]
+                        counts0, gid, gstate0 = ex[9], ex[10], ex[11]
+                        gtab, gmask = ex[12], ex[13]
+                        lg = apply_penalties(lg.astype(jnp.float32),
+                                             counts0, rep, pres, frq)
+                        lg = jnp.where(gmask[gid, gstate0], lg, NEG)
+                    topv, topi = jax.lax.top_k(lg, sK)
+                    topv = topv.astype(jnp.float32)
+                    topi = topi.astype(jnp.int32)
+                    # the chunk's last token sits at position pf_end-1;
+                    # the token it emits enters position pf_end — its
+                    # key counter, same schedule as the decode scan
+                    first = select_from_topk(
+                        topv, topi, fold_keys(seeds, pf_end), dos,
+                        temp, tkk, tpp, minp)
             if with_decode:
                 if T:
-                    (toks, emitted, tok, lens, act, rem, key,
+                    (toks, emitted, tok, lens, act, rem,
                      k_pages_all, v_pages_all) = spec_scan(
                         W, k_pages_all, v_pages_all, tables, tok, lens,
-                        act, rem, eos_ids, key, drafts, dlen, ad=ad)
+                        act, rem, eos_ids, ex, drafts, dlen, ad=ad)
                 else:
-                    (toks, emitted, tok, lens, act, rem, key,
+                    (toks, emitted, tok, lens, act, rem,
                      k_pages_all, v_pages_all) = decode_scan(
                         W, k_pages_all, v_pages_all, tables, tok, lens,
-                        act, rem, eos_ids, key, ad=ad)
-            return (first, toks, emitted, tok, lens, act, rem, key,
+                        act, rem, eos_ids, ex, ad=ad)
+            return (first, toks, emitted, tok, lens, act, rem,
                     k_pages_all, v_pages_all)
 
         Wsp, R, POOL = self._tp_specs()
-        out_specs = (R, R, R, R, R, R, R, R, POOL, POOL)
+        out_specs = (R, R, R, R, R, R, R, POOL, POOL)
         if with_adapters:
             # adapter-aware block: (AD, aid) ride right after W; same
             # carries, same outputs — the plain program is untouched
             def fused_ad(W, AD, aid, k_pages_all, v_pages_all, tables,
                          pf_ids, pf_act, pf_start, pf_end, tok, lens,
-                         act, rem, eos_ids, key, *spec_args):
-                drafts, dlen = spec_args if T else (None, None)
+                         act, rem, eos_ids, *rest):
                 return fused(W, k_pages_all, v_pages_all, tables,
                              pf_ids, pf_act, pf_start, pf_end, tok,
-                             lens, act, rem, eos_ids, key,
-                             drafts=drafts, dlen=dlen, ad=(AD, aid))
+                             lens, act, rem, eos_ids, *rest,
+                             ad=(AD, aid))
 
             ADsp = (self._apool.specs() if self._tpc is not None
                     else None)
             in_specs = (Wsp, ADsp, R, POOL, POOL) \
-                + (R,) * (11 + (2 if T else 0))
+                + (R,) * (10 + NEX + (2 if T else 0))
             return self._jit_tp(fused_ad, in_specs=in_specs,
                                 out_specs=out_specs,
                                 donate_argnums=(3, 4))
-        # positional arg specs: drafts/dlen ride only when speculating
-        in_specs = (Wsp, POOL, POOL) + (R,) * (11 + (2 if T else 0))
+        # positional arg specs: mode extras ride after eos_ids,
+        # drafts/dlen after those (only when speculating)
+        in_specs = (Wsp, POOL, POOL) + (R,) * (10 + NEX
+                                               + (2 if T else 0))
         return self._jit_tp(fused, in_specs=in_specs,
                             out_specs=out_specs, donate_argnums=(1, 2))
 
     def _get_fused(self, w, with_prefill, with_decode,
-                   with_adapters=False):
-        key = (w, with_prefill, with_decode, with_adapters)
+                   with_adapters=False, mode="greedy"):
+        key = (w, with_prefill, with_decode, with_adapters, mode)
         fn = self._cb_fused_fns.get(key)
         if fn is None:
             fn = self._build_cb_fused(w, with_prefill, with_decode,
-                                      with_adapters)
+                                      with_adapters, mode=mode)
             self._cb_fused_fns[key] = fn
         return fn
 
@@ -2736,7 +3200,8 @@ class ContinuousBatchingEngine(LLMEngine):
                         cont = np.asarray(self._drafter.timed_propose(
                             np.concatenate(
                                 [r.ids, np.asarray(r.out, np.int64)]),
-                            K * (want + 1)), np.int64).ravel()
+                            K * (want + 1),
+                            sampling=r.sampling), np.int64).ravel()
                     except Exception:
                         # a broken drafter degrades speculation for this
                         # request, never its correctness (verification
@@ -2787,6 +3252,9 @@ class ContinuousBatchingEngine(LLMEngine):
             return True
         blk.has_prefill = bool(live_pf)
         blk.has_decode = bool(blk.dec_items)
+        blk.mode = self._block_mode(
+            [r for r, _end in blk.pf_items] + blk.dec_items)
+        blk.extras = self._block_extras(blk)
         aid = self._slot_aid(live_pf + blk.dec_items, w)
         ad_args = ()
         if aid is not None:
@@ -2797,7 +3265,7 @@ class ContinuousBatchingEngine(LLMEngine):
             blk.aid = jnp.asarray(aid)
             ad_args = (self._apool.device, blk.aid)
         fn = self._get_fused(w, blk.has_prefill, blk.has_decode,
-                             aid is not None)
+                             aid is not None, blk.mode)
         blk.tables = jnp.asarray(self._tables_np[:w])
         blk.eos_dev = jnp.asarray(eos)
         if T:
@@ -2807,7 +3275,7 @@ class ContinuousBatchingEngine(LLMEngine):
                      if T else ())
         with _prof_span("cb.block"):
             (blk.first, blk.toks, blk.emitted, blk.tok_fin, blk.lens_fin,
-             blk.act_fin, blk.rem_fin, self._key, self.k_pages,
+             blk.act_fin, blk.rem_fin, self.k_pages,
              self.v_pages) = fn(
                 self.weights, *ad_args, self.k_pages, self.v_pages,
                 blk.tables,
@@ -2816,7 +3284,7 @@ class ContinuousBatchingEngine(LLMEngine):
                 jnp.asarray(self._tok_np[:w]),
                 jnp.asarray(self._lens_np[:w]),
                 jnp.asarray(act), jnp.asarray(rem), blk.eos_dev,
-                self._key, *spec_args)
+                *blk.extras, *spec_args)
         self.dispatch_seconds += time.perf_counter() - t_dev
         self.fused_blocks += 1
         # steps advance by the block's DEVICE micro-steps so TTL budgets
@@ -2853,6 +3321,11 @@ class ContinuousBatchingEngine(LLMEngine):
             return False
         if _faults_armed():
             return False
+        if blk.mode == "proc":
+            # penalty counts and grammar state advance on the HOST in
+            # _push_token; a chained block would run the processor
+            # chain against stale state
+            return False
         ok = False
         for r in blk.dec_items:
             if r.state != DECODE:
@@ -2860,6 +3333,10 @@ class ContinuousBatchingEngine(LLMEngine):
             if r.deadline is not None or r.ttl_steps is not None:
                 return False
             if r.shared_idx:
+                return False
+            if r.sampling.stop:
+                # stop sequences retire on the HOST; a chained block
+                # would keep writing KV into pages the retirement frees
                 return False
             if r.max_new_tokens - len(r.out) > blk.K:
                 ok = True
@@ -2877,13 +3354,17 @@ class ContinuousBatchingEngine(LLMEngine):
         nxt.eos_dev = blk.eos_dev
         nxt.has_decode = True
         nxt.chained = True
+        nxt.mode = blk.mode             # sampled params are static
+        nxt.extras = blk.extras         # across a chain; the PRNG
+        #                                 counters ride the device lens
         nxt.aid = blk.aid               # adapter ids are static across
         ad_args = ()                    # a chain (admission happens at
         if blk.aid is not None:         # host sync points only)
             if self.megakernel:
                 self.adapter_mk_fallbacks += 1
             ad_args = (self._apool.device, blk.aid)
-        fn = self._get_fused(w, False, True, blk.aid is not None)
+        fn = self._get_fused(w, False, True, blk.aid is not None,
+                             blk.mode)
         dummy = self._pf_dummies.get(w)
         if dummy is None:
             dummy = (jnp.asarray(np.zeros((w, chunk), np.int64)),
@@ -2893,12 +3374,12 @@ class ContinuousBatchingEngine(LLMEngine):
             self._pf_dummies[w] = dummy
         with _prof_span("cb.block_chain"):
             (nxt.first, nxt.toks, nxt.emitted, nxt.tok_fin, nxt.lens_fin,
-             nxt.act_fin, nxt.rem_fin, self._key, self.k_pages,
+             nxt.act_fin, nxt.rem_fin, self.k_pages,
              self.v_pages) = fn(
                 self.weights, *ad_args, self.k_pages, self.v_pages,
                 blk.tables,
                 *dummy, blk.tok_fin, blk.lens_fin, blk.act_fin,
-                blk.rem_fin, blk.eos_dev, self._key)
+                blk.rem_fin, blk.eos_dev, *blk.extras)
         self.fused_blocks += 1
         self.chained_blocks += 1
         self.steps += blk.K
@@ -2961,6 +3442,13 @@ class ContinuousBatchingEngine(LLMEngine):
                     self.spec_accepted_total += accepted
                     r.spec_drafted += offered
                     r.spec_accepted += accepted
+                    if r.sampling.do_sample:
+                        # sampled speculation (sample-and-match): its
+                        # own acceptance telemetry, since its rate is
+                        # governed by the temperature, not just drafter
+                        # quality
+                        self._spec_sampled_offered += offered
+                        self._spec_sampled_accepted += accepted
                     if self._tel is not None:
                         self._tel.req_event(
                             self._tel_src, r.uid, "spec_pass",
@@ -2994,17 +3482,18 @@ class ContinuousBatchingEngine(LLMEngine):
                     self._lens_np[r.slot] += 1
                     self._push_token(r, int(toks[k, r.slot]))
 
-    def _sample_tokens(self, logits):
-        from ..models.generation import _sample
-        do_sample, temperature, top_k, top_p = self._sampling
-        self._key, sub = jax.random.split(self._key)
-        return np.asarray(_sample(logits, sub, do_sample, temperature,
-                                  top_k, top_p))
-
     def _push_token(self, r, tok):
         tok = int(tok)
         r.out.append(tok)
         r.tok = tok
+        if r.sampling.needs_processors:
+            # host-authoritative processor state: the device scan's
+            # carries are recomputed here so preemption/export/chaining
+            # boundaries can never desynchronize them
+            r.counts[tok] = r.counts.get(tok, 0) + 1
+            g = r.sampling.grammar
+            if g is not None:
+                r.gstate = int(g.advance(r.gstate, tok))
         r.idle_steps = 0                # progress: the demote-on-idle
         #                                 clock restarts
         if self._tel is not None and len(r.out) == 1:
@@ -3022,6 +3511,11 @@ class ContinuousBatchingEngine(LLMEngine):
             self.adapter_tokens[r.adapter] += 1
         if (r.eos_token_id is not None and tok == r.eos_token_id) or \
                 len(r.out) >= r.max_new_tokens:
+            self._retire(r)
+        elif r.sampling.stop and stop_hit(r.out, r.sampling.stop):
+            # stop sequences retire HERE, on the host: the device scan
+            # is ignorant of them (which is why _can_chain refuses to
+            # chain a block whose participants carry any)
             self._retire(r)
 
     # -- replica boundary: in-flight export + weight flip --------------------
@@ -3059,6 +3553,17 @@ class ContinuousBatchingEngine(LLMEngine):
             "adapter": r.adapter,          # LoRA adapter name (the
             #                                importer resolves it in
             #                                ITS pool/registry)
+            # sampled continuation: the params + key stream ride the
+            # spec verbatim. The PRNG counter is IMPLICIT — keys fold
+            # from absolute positions, and the folded prompt preserves
+            # them — so the resumed sampled tail is byte-identical to
+            # the uninterrupted stream. counts/gstate ride explicitly:
+            # the folded prompt would otherwise reclassify generated
+            # tokens as prompt for penalty/grammar purposes.
+            "sampling": (None if r.sampling is GREEDY
+                         else r.sampling.to_spec()),
+            "counts": dict(r.counts),
+            "gstate": r.gstate,
         }
 
     def export_inflight(self):
@@ -3088,7 +3593,13 @@ class ContinuousBatchingEngine(LLMEngine):
             spec["prompt"], max_new_tokens=spec["max_new_tokens"],
             eos_token_id=spec["eos_token_id"], deadline_ms=deadline_ms,
             ttl_steps=spec["ttl_steps"], tenant=spec["tenant"],
-            priority=spec["priority"], adapter=spec.get("adapter"))
+            priority=spec["priority"], adapter=spec.get("adapter"),
+            sampling=spec.get("sampling"))
+        if spec.get("counts") or spec.get("gstate"):
+            r = self._requests[uid]
+            r.counts = {int(t): int(c)
+                        for t, c in (spec.get("counts") or {}).items()}
+            r.gstate = int(spec.get("gstate") or 0)
         gen = int(spec.get("generated") or 0)
         if gen and self._tel is not None:
             # a resumed continuation: the folded prompt already holds
@@ -3319,6 +3830,24 @@ class ContinuousBatchingEngine(LLMEngine):
             # the CRC sweep/page claim: an adapter this engine cannot
             # serve must cost the coordinator a cheap typed refusal
             self._resolve_adapter(ad_name)
+        sp_spec = spec.get("sampling")
+        sp = (SamplingParams.from_spec(sp_spec)
+              if sp_spec is not None else GREEDY)
+        # the same sampled-continuation refusals add_request makes —
+        # BEFORE the CRC sweep/page claim, like the adapter resolve
+        if sp.do_sample and sp.top_k > self.sample_k:
+            raise ValueError(
+                f"import_kv_pages: top_k={sp.top_k} exceeds this "
+                f"engine's sample_k={self.sample_k} candidate fold")
+        if self._spec and sp.needs_processors:
+            raise ValueError(
+                "import_kv_pages: logit processors cannot ride "
+                "speculative decoding (engine has speculate= on)")
+        if sp.grammar is not None and \
+                sp.grammar.vocab != self.cfg.vocab_size:
+            raise ValueError(
+                f"import_kv_pages: grammar vocab {sp.grammar.vocab} "
+                f"!= model vocab {self.cfg.vocab_size}")
         lens = int(payload["lens"])
         p = self.page_size
         n_used = -(-lens // p)
@@ -3378,6 +3907,25 @@ class ContinuousBatchingEngine(LLMEngine):
             r.slot = slot
             r.filled = r.resume = t0
             r.state = DECODE
+            r.sampling = sp
+            if sp.do_sample:
+                self.sampled_requests += 1
+            if sp.needs_processors:
+                cts = spec.get("counts")
+                if cts:
+                    r.counts = {int(t): int(c) for t, c in cts.items()}
+                else:
+                    # older payloads: reconstruct from the committed
+                    # tokens (counts cover GENERATED tokens only)
+                    for t in out:
+                        r.counts[t] = r.counts.get(t, 0) + 1
+                if sp.grammar is not None:
+                    gs = spec.get("gstate")
+                    if gs is None:
+                        gs = 0
+                        for t in out:
+                            gs = int(sp.grammar.advance(gs, t))
+                    r.gstate = int(gs)
             self._next_uid += 1
             self._requests[r.uid] = r
             self._slots[slot] = r

@@ -476,6 +476,24 @@ class LLMEngine:
         return self._tpc.argmax_of_local_max(
             m, a, local_logits.shape[-1])
 
+    def _tp_topk(self, local_logits, k):
+        """Top-K (f32 values, i32 vocab ids) rows from (possibly
+        vocab-local) logits — the sampled-path sibling of
+        _tp_greedy_token: plain lax.top_k at tp=1 / replicated head;
+        under the vocab-parallel head, the gather-free topk-of-local-
+        topk combine — bitwise equal to lax.top_k over the full
+        gathered logits (shard-major concat preserves the id-asc tie
+        order). Values return as f32 (an exact upcast) so both this
+        path and the megakernel's f32 select scratch feed the selection
+        math identical bits."""
+        lv, li = jax.lax.top_k(local_logits, k)
+        lv = lv.astype(jnp.float32)
+        li = li.astype(jnp.int32)
+        if self._tpc is None or not self._tpc.head_sharded:
+            return lv, li
+        return self._tpc.topk_of_local_topk(
+            lv, li, local_logits.shape[-1], k)
+
     def _tp_gather_heads(self, x):
         """exact-mode TP: reassemble full heads before o_proj (identity
         at tp=1 and in psum mode, where wo is row-sharded instead)."""

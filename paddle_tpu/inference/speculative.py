@@ -33,15 +33,63 @@ classic two-model speculation; the zero-model drafters above are the
 default because they add no weights and no extra HBM streams.
 
 Acceptance semantics (engine side, documented here for drafter authors):
-the target samples its own token at every draft position (greedy =
-argmax); draft token i is accepted iff it EQUALS the target's token at
-that position and every earlier draft was accepted. For deterministic
-(delta-distribution) drafters this is exactly the rejection-sampling
-rule, so sampled-mode outputs keep the target model's distribution.
+the target samples its own token at every draft position — greedy =
+argmax; sampled = select_from_topk with the POSITION key
+fold_in(seed, position), the same key the unspeculated stream would
+use there. Draft token i is accepted iff it EQUALS the target's token
+at that position and every earlier draft was accepted
+(sample-and-match). Because every drafter here proposes a single
+deterministic continuation, the proposal is a delta distribution and
+sample-and-match IS rejection sampling for that case: the acceptance
+probability is exactly p(draft) under the target's (temperature/top-k/
+top-p shaped) distribution p, and the emitted token is distributed
+exactly p whether the draft is accepted or not — see
+`rejection_sample` below for the general-q rule it specializes. It
+also makes the committed sampled stream byte-identical to the
+unspeculated sampled stream at the same key schedule, which is the
+pinned correctness contract.
+
+Consequence for drafter authors: sampled requests accept LESS often
+than greedy ones at the same draft quality (the ceiling is p(draft),
+not 1.0), and the gap widens with temperature. `timed_propose` hands
+sampling-aware drafters the request's SamplingParams so they can adapt
+— e.g. shrink k, or skip drafting above a temperature threshold.
 """
 import time
 
 import numpy as np
+
+
+def rejection_sample(p_probs, q_probs, draft, key):
+    """Reference distribution-preserving verification of ONE draft
+    token (the general-q rejection-sampling rule the engine's
+    sample-and-match specializes): accept `draft` with probability
+    min(1, p[draft] / q[draft]); on rejection, emit a sample from the
+    normalized residual max(p - q, 0). The emitted token is distributed
+    EXACTLY p for any proposal q — for q = delta(draft) (every drafter
+    in this module) the acceptance probability reduces to p[draft] and
+    the residual to p excluding the draft, which has the same marginal
+    as drawing g ~ p and emitting it (accepting iff g == draft), i.e.
+    the engine's in-scan rule. The seeded chi-squared pin in
+    tests/test_sampling_v2.py holds this function and the engine's
+    stream to the same target distribution.
+
+    p_probs/q_probs: [V] probability rows; draft: proposed token id;
+    key: JAX PRNG key. Returns (accepted bool, token) as JAX scalars.
+    """
+    import jax
+    import jax.numpy as jnp
+    p = jnp.asarray(p_probs, jnp.float32)
+    q = jnp.asarray(q_probs, jnp.float32)
+    d = jnp.asarray(draft, jnp.int32)
+    k_u, k_r = jax.random.split(key)
+    u = jax.random.uniform(k_u, dtype=jnp.float32)
+    accepted = u * q[d] <= p[d]
+    resid = jnp.clip(p - q, 0.0, None)
+    resid = resid / jnp.maximum(resid.sum(), jnp.float32(1e-30))
+    alt = jax.random.categorical(
+        k_r, jnp.log(jnp.maximum(resid, jnp.float32(1e-30))))
+    return accepted, jnp.where(accepted, d, alt.astype(jnp.int32))
 
 
 class Drafter:
@@ -54,20 +102,32 @@ class Drafter:
     request per block, between device dispatches."""
 
     name = "base"
+    # sampling-aware drafters opt IN to the acceptance hook: set True
+    # and accept propose(ctx, k, sampling=...) — `sampling` is the
+    # request's SamplingParams (None for engine-default greedy). The
+    # base drafters ignore it (their proposals are delta distributions
+    # either way; the module docstring explains why acceptance still
+    # preserves the target distribution), but a temperature-adaptive
+    # drafter can shrink k or bail out entirely.
+    sampling_aware = False
 
     def propose(self, ctx, k):
         raise NotImplementedError
 
-    def timed_propose(self, ctx, k):
+    def timed_propose(self, ctx, k, sampling=None):
         """propose() with self-accounting: `proposals` / `propose_seconds`
         accumulate on the instance (lazily, so subclasses that skip
         super().__init__ still work). The engine calls THIS — the
         drafter is host work on the block's critical path (the PR 12
         NGramDrafter max_ctx bound exists for exactly that reason), so
         its wall cost must be attributable: the telemetry plane's
-        `draft_ms` histogram and these counters are the two views."""
+        `draft_ms` histogram and these counters are the two views.
+        `sampling` reaches propose() only for sampling_aware drafters —
+        the base signature stays two-argument."""
         t0 = time.perf_counter()
         try:
+            if self.sampling_aware:
+                return self.propose(ctx, k, sampling=sampling)
             return self.propose(ctx, k)
         finally:
             self.proposals = getattr(self, "proposals", 0) + 1

@@ -213,6 +213,34 @@ class TPContext:
         return loc.astype(jnp.int32) \
             + s.astype(jnp.int32) * jnp.int32(v_local)
 
+    def topk_of_local_topk(self, topv, topi, v_local, k):
+        """Global top-k (value desc, vocab-id-asc ties) from per-shard
+        top-k pairs — the vocab-parallel head's sampling-fold combine
+        (ISSUE 18), gather-free over the [w, V] logits: all_gather the
+        [*, k] local pairs (tiny), offset local ids by each shard's
+        vocab base, and lax.top_k the shard-ordered [*, tp*k] concat.
+        Ties resolve to the lower position = the lower GLOBAL vocab id,
+        because shard blocks concatenate in vocab order and each block
+        is already (value desc, id asc) — so the result is bitwise what
+        lax.top_k over the full logits row would produce. Requires each
+        shard to contribute its full local top-k (the engine's
+        sample_k), which the megakernel head fold does."""
+        vs = lax.all_gather(topv, AXIS)                 # [tp, ..., k]
+        is_ = lax.all_gather(topi, AXIS)
+        tp = vs.shape[0]
+        base = (jnp.arange(tp, dtype=jnp.int32)
+                * jnp.int32(v_local)).reshape(
+            (tp,) + (1,) * (is_.ndim - 1))
+        gids = is_.astype(jnp.int32) + base
+        # [tp, ..., k] -> [..., tp*k] with shard-major column order
+        vs = jnp.moveaxis(vs, 0, -2).reshape(
+            topv.shape[:-1] + (tp * topv.shape[-1],))
+        gids = jnp.moveaxis(gids, 0, -2).reshape(
+            topi.shape[:-1] + (tp * topi.shape[-1],))
+        gv, gpos = lax.top_k(vs, k)
+        gi = jnp.take_along_axis(gids, gpos, axis=-1)
+        return gv, gi.astype(jnp.int32)
+
     def gather_heads(self, x):
         """[..., nh_local, hd] -> [..., nh, hd]: reassemble the exact
         per-head attention outputs in shard (= original head) order —

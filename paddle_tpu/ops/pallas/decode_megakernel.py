@@ -270,7 +270,7 @@ _STACKED_KEYS = frozenset(
 
 
 def _mk_kernel(*args, names, seg, stacked, counts, bks, bns, dims,
-               eps, p, mp, scale, head, T):
+               eps, p, mp, scale, head, T, head_k=1):
     """One grid step of the schedule walk. `names` maps every ref
     (scalar prefetch, inputs, outputs, scratch — in pallas_call order)
     so the same body serves every segment/variant; python-level
@@ -590,25 +590,69 @@ def _mk_kernel(*args, names, seg, stacked, counts, bks, bns, dims,
             @pl.when(a0 == nkh - 1)
             def _():
                 out = scale_emit(acc[:, :bnh], refs["sh"][0], cdtype)
-                refs["logits"][...] = out
-                # running argmax over the CAST logits (what jnp.argmax
-                # sees on the unfused path): strictly-greater update +
-                # first-index-within-tile argmax reproduces the global
-                # first-max-wins tie rule tile by tile; pad columns
-                # (zero scales -> exact 0.0) mask to NEG_INF
+                if "logits" in refs:
+                    # head_k > 1 drops the [R, V] logits OUTPUT from the
+                    # pallas_call entirely — the sampled fold's whole
+                    # point is that full logits never exist, not even as
+                    # an unused buffer (the in-test jaxpr assert)
+                    refs["logits"][...] = out
+                # running select over the CAST logits (what argmax /
+                # lax.top_k see on the unfused path); pad columns (zero
+                # scales -> exact 0.0) mask to NEG_INF
                 col = jax.lax.broadcasted_iota(
                     jnp.int32, (R, bnh), 1) + a1 * jnp.int32(bnh)
                 vals = jnp.where(col < jnp.int32(Vh),
                                  out.astype(jnp.float32),
                                  jnp.float32(NEG_INF))
-                tmax = jnp.max(vals, axis=1, keepdims=True)
-                targ = jnp.argmax(vals, axis=1).astype(
-                    jnp.int32)[:, None] + a1 * jnp.int32(bnh)
-                upd = tmax > amax[:, :1]
-                aidx[...] = jnp.where(
-                    upd, jnp.broadcast_to(targ, aidx.shape), aidx[...])
-                amax[...] = jnp.where(
-                    upd, jnp.broadcast_to(tmax, amax.shape), amax[...])
+                if head_k == 1:
+                    # running argmax: strictly-greater update +
+                    # first-index-within-tile argmax reproduces the
+                    # global first-max-wins tie rule tile by tile
+                    tmax = jnp.max(vals, axis=1, keepdims=True)
+                    targ = jnp.argmax(vals, axis=1).astype(
+                        jnp.int32)[:, None] + a1 * jnp.int32(bnh)
+                    upd = tmax > amax[:, :1]
+                    aidx[...] = jnp.where(
+                        upd, jnp.broadcast_to(targ, aidx.shape),
+                        aidx[...])
+                    amax[...] = jnp.where(
+                        upd, jnp.broadcast_to(tmax, amax.shape),
+                        amax[...])
+                else:
+                    # running top-K merge (the sampling fold): merge the
+                    # K running entries with this tile's columns under
+                    # the total order (value desc, vocab id asc) — K
+                    # unrolled select-and-mask steps over the [R, K+bnh]
+                    # concat. First-max-wins argmax reproduces the
+                    # id-asc tie rule because running entries precede
+                    # tile columns in the concat AND carry strictly
+                    # smaller vocab ids (tiles arrive in ascending a1),
+                    # and columns within a tile are id-ascending — so
+                    # position order IS vocab-id order throughout.
+                    # Bitwise identical to lax.top_k on the full row:
+                    # no arithmetic happens, only selection.
+                    Ks = head_k
+                    cand_v = jnp.concatenate([amax[:, :Ks], vals], 1)
+                    cand_i = jnp.concatenate([aidx[:, :Ks], col], 1)
+                    cpos = jax.lax.broadcasted_iota(
+                        jnp.int32, cand_v.shape, 1)
+                    new_v, new_i = [], []
+                    for _ in range(Ks):
+                        m = jnp.max(cand_v, axis=1, keepdims=True)
+                        a = jnp.argmax(cand_v, axis=1).astype(
+                            jnp.int32)[:, None]
+                        sel = cpos == a
+                        new_v.append(m)
+                        new_i.append(jnp.sum(
+                            jnp.where(sel, cand_i, jnp.int32(0)),
+                            axis=1, keepdims=True))
+                        cand_v = jnp.where(sel, jnp.float32(NEG_INF),
+                                           cand_v)
+                    amax[...] = jnp.concatenate(
+                        new_v + [jnp.full((R, 128 - Ks), NEG_INF,
+                                          jnp.float32)], 1)
+                    aidx[...] = jnp.concatenate(
+                        new_i + [jnp.zeros((R, 128 - Ks), jnp.int32)], 1)
 
                 @pl.when(a1 == nnh - 1)
                 def _():
@@ -628,8 +672,9 @@ def _pad_to(a, width):
 def decode_megakernel(h, mk, k_pages=None, v_pages=None, page_table=None,
                       lens=None, active=None, cos_sel=None, sin_sel=None,
                       *, nh, nh_kv, hd, eps, scale=None, interpret=False,
-                      seg="full", head=None, head_v=None, mlp_v=None,
-                      tq=1, wmask=None, attn_in=None, act_in=None):
+                      seg="full", head=None, head_v=None, head_k=None,
+                      mlp_v=None, tq=1, wmask=None, attn_in=None,
+                      act_in=None):
     """Run decode layer(s) — up to the FULL decode step — as ONE Pallas
     megakernel invocation.
 
@@ -644,6 +689,14 @@ def decode_megakernel(h, mk, k_pages=None, v_pages=None, page_table=None,
       running argmax and ALSO returns (tok [R] i32 greedy argmax,
       maxv [R] f32 its logit, logits [R, head_v]) — the whole-step
       mode. head_v = real (unpadded, local under tp) vocab columns.
+      head_k = K > 1 generalizes the running argmax to a running top-K
+      merge (the sampling fold): the return becomes (tok [R, K] i32
+      vocab ids, maxv [R, K] f32 their logits), BOTH ordered (value
+      desc, id-asc ties) bitwise-identically to `lax.top_k` on the full
+      row — column 0 is exactly the greedy pair — and the [R, V]
+      logits OUTPUT IS DROPPED from the pallas_call: full logits never
+      exist, not even as an unused buffer (asserted on the traced
+      jaxpr in tests). Requires K <= 128 and K <= head_v.
 
     tq > 1 (speculative verify): rows are slot-major feed tokens;
       wmask [R] gates which feed tokens' k/v substitute into their page
@@ -734,6 +787,12 @@ def decode_megakernel(h, mk, k_pages=None, v_pages=None, page_table=None,
         bns[PH_H] = _ktile(Vp, DEF_BN)
         counts[PH_H] = (hk // bks[PH_H], Vp // bns[PH_H])
         dims["Vh"] = int(Vp if head_v is None else head_v)
+        if head_k is not None and not 1 <= int(head_k) <= min(
+                128, dims["Vh"]):
+            raise ValueError(
+                f"head_k must be in [1, min(128, head_v)] — the top-K "
+                f"merge rides the [R, 128] select scratch — got "
+                f"{head_k} with head_v={dims['Vh']}")
     dims.update(Hp=Hp, NQp=NQp, b=b)
 
     ph_arr, a0_arr, a1_arr, li_arr = _build_schedule(
@@ -889,7 +948,8 @@ def decode_megakernel(h, mk, k_pages=None, v_pages=None, page_table=None,
     if head is not None:
         add_out("tok", (R, 128), full_spec((R, 128)), jnp.int32)
         add_out("maxv", (R, 128), full_spec((R, 128)), jnp.float32)
-        add_out("logits", (R, head["wh"].shape[1]), logits_spec())
+        if head_k is None or int(head_k) == 1:
+            add_out("logits", (R, head["wh"].shape[1]), logits_spec())
 
     scr_names = ["h_scr", "x_scr", "acc_scr"]
     scratch = [pltpu.VMEM((R, Hp), cdtype), pltpu.VMEM((R, Hp), cdtype),
@@ -919,7 +979,8 @@ def decode_megakernel(h, mk, k_pages=None, v_pages=None, page_table=None,
                                 + scr_names),
         seg=seg, stacked=stacked, counts=counts, bks=bks, bns=bns,
         dims=dims, eps=float(eps), p=p, mp=mp, scale=float(s),
-        head=head is not None, T=T)
+        head=head is not None, T=T,
+        head_k=1 if head_k is None else int(head_k))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=len(pre_names),
@@ -951,8 +1012,12 @@ def decode_megakernel(h, mk, k_pages=None, v_pages=None, page_table=None,
     else:
         ret = [res["ho"][:, :H]]
     if head is not None:
-        ret += [res["tok"][:, 0], res["maxv"][:, 0],
-                res["logits"][:, :dims["Vh"]]]
+        if head_k is not None and int(head_k) > 1:
+            K = int(head_k)
+            ret += [res["tok"][:, :K], res["maxv"][:, :K]]
+        else:
+            ret += [res["tok"][:, 0], res["maxv"][:, 0],
+                    res["logits"][:, :dims["Vh"]]]
     return tuple(ret) if len(ret) > 1 else ret[0]
 
 

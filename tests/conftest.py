@@ -120,6 +120,7 @@ def pytest_sessionstart(session):
 # silently skipping the tests this PR is gated on. (Ordering is
 # file-granular; within a file, order is unchanged.)
 _COLLECT_FIRST = (
+    "tests/test_sampling_v2.py",      # PR 18 on-device sampling v2
     "tests/test_autoscale.py",        # PR 17 SLO-driven elastic fleet
     "tests/test_cost_model.py",       # PR 16 cost-model plan search
     "tests/test_adapters.py",         # PR 15 multi-LoRA adapter serving

@@ -208,7 +208,11 @@ ENGINE_HEALTH_KEYS = frozenset({
     "fused_blocks", "chained_blocks", "megakernel",
     "megakernel_whole_step", "tp", "tp_mode", "tp_compress", "speculate",
     "drafter", "spec_passes", "spec_emitted", "spec_accept_rate",
-    "spec_tokens_per_pass", "draft_errors", "handoffs_out", "handoffs_in",
+    "spec_tokens_per_pass", "draft_errors",
+    # on-device sampling v2 (PR 18: inference/sampling.py)
+    "sampled_requests", "sample_k", "sample_fold",
+    "spec_sampled_accept_rate",
+    "handoffs_out", "handoffs_in",
     "kv_tier", "demoted", "pages_demoted", "demotions", "restores",
     "restore_failures", "demote_errors", "tier", "index_publishes",
     "index_publish_errors", "prefix_exports", "prefix_imports",
